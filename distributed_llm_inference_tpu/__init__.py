@@ -1,0 +1,14 @@
+"""distributed_llm_inference_tpu — TPU-native pipeline-parallel LLM inference.
+
+A from-scratch JAX/XLA framework with the capability surface of
+Tulsi027/distributed-llm-inference (see SURVEY.md): layer-sharded
+multi-device pipeline inference of HF causal LMs with a sampling decode
+loop, chat templating, an HTTP serving API, an interactive client, and
+per-request perf stats — redesigned TPU-first (jit-compiled stage
+functions, ppermute over ICI, HBM KV cache, scan-based decode).
+"""
+
+__version__ = "0.1.0"
+
+from .config import EngineConfig, MeshConfig, ModelConfig, SamplingConfig, stage_layer_range
+from .models.registry import get_model_config, list_models

@@ -1,0 +1,119 @@
+"""Model / engine / mesh configuration.
+
+Replaces the reference's hand-edited module constants (MODEL_NAME / LAYER_START /
+LAYER_END / WORKER_*_URL, /root/reference/Worker1.py:26-31,
+/root/reference/orchestration.py:20-24) with dataclass configs: the layer ranges
+per pipeline stage are *computed* from (n_layers, pp_stages) instead of pasted by
+hand, and the mesh shape replaces the manual URL wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a decoder-only causal LM.
+
+    Covers the Llama family (RMSNorm + RoPE + GQA + SwiGLU: TinyLlama,
+    Llama-2-7B/13B, Llama-3-8B) and the GPT-2 family (LayerNorm + learned
+    positions + MHA + gelu_new, tied embeddings).
+    """
+
+    name: str = "tinyllama-1.1b"
+    arch: str = "llama"  # "llama" | "gpt2"
+    vocab_size: int = 32000
+    dim: int = 2048
+    n_layers: int = 22
+    n_heads: int = 32
+    n_kv_heads: int = 4  # GQA; == n_heads for MHA
+    ffn_dim: int = 5632
+    max_seq_len: int = 2048
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = False
+    # GPT-2 only: learned absolute position embeddings.
+    use_learned_pos: bool = False
+    dtype: str = "float32"  # parameter / activation dtype: "float32" | "bfloat16"
+    eos_token_id: int = 2
+    bos_token_id: int = 1
+    pad_token_id: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def jnp_dtype(self):
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[self.dtype]
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Shape of the device mesh. Axes: data, pipeline, tensor.
+
+    The reference's topology (orchestrator + 2 HTTP workers) maps to
+    pp_stages=2; here any (dp, pp, tp) factorization of the available
+    devices is valid as long as n_layers % pp_stages == 0 and
+    n_kv_heads % tp == 0.
+    """
+
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.pp * self.tp
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Per-request sampling parameters.
+
+    Defaults mirror the reference's /generate route
+    (/root/reference/orchestration.py:339-354): temperature 0.7,
+    top_k 50, top_p 0.9, max_tokens default 20.
+    """
+
+    temperature: float = 0.7
+    top_k: int = 50
+    top_p: float = 0.9
+    max_new_tokens: int = 20
+    greedy: bool = False
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Decode-engine settings."""
+
+    max_seq_len: int = 2048
+    max_batch_size: int = 1
+    # Prompt-length buckets for prefill compilation (TTFT: avoids recompiling
+    # per prompt length; prompts are right-padded up to the bucket).
+    prefill_buckets: tuple = (64, 128, 256, 512, 1024, 2048)
+    # Microbatches for the pipelined decode schedule (config 5). 1 = no
+    # microbatching.
+    microbatches: int = 1
+
+
+def stage_layer_range(n_layers: int, pp: int, stage: int) -> tuple[int, int]:
+    """Contiguous layer range [start, end) owned by `stage`.
+
+    The reference hardcodes 0-11 / 11-22 for TinyLlama's 22 layers
+    (/root/reference/Worker1.py:27-28, Worker2.py:26-27); we compute the
+    split and require an even partition so stacked-layer params shard
+    cleanly along the pipeline mesh axis.
+    """
+    if n_layers % pp != 0:
+        raise ValueError(f"n_layers={n_layers} not divisible by pp={pp}")
+    per = n_layers // pp
+    return stage * per, (stage + 1) * per

@@ -1,0 +1,158 @@
+"""HuggingFace checkpoint -> JAX params converter.
+
+Replaces the reference's L0 loading/partitioning, which downloads the *full*
+torch model on every process and slices `nn.ModuleList`s
+(/root/reference/orchestration.py:38-53, Worker1.py:60-77 — keeping the whole
+model around just for rotary access). Here a HF state dict (torch tensors or
+a safetensors file) is converted once into the stacked-layer pytree of
+models/llama.py / models/gpt2.py; pipeline stages then slice the stacked
+layer axis, so a stage only ever materializes its own shard.
+
+Works fully offline: accepts any in-memory `state_dict()` (tests build
+tiny-random HF models from configs, no hub access needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / np array -> float32 numpy (converted to model dtype at
+    the end, matching HF's fp32 master weights for small models)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32") -> ModelConfig:
+    """Map a transformers LlamaConfig/GPT2Config to our ModelConfig."""
+    mt = getattr(hf_cfg, "model_type", "llama")
+    if mt == "gpt2":
+        return ModelConfig(
+            name=name,
+            arch="gpt2",
+            vocab_size=hf_cfg.vocab_size,
+            dim=hf_cfg.n_embd,
+            n_layers=hf_cfg.n_layer,
+            n_heads=hf_cfg.n_head,
+            n_kv_heads=hf_cfg.n_head,
+            ffn_dim=hf_cfg.n_inner if hf_cfg.n_inner is not None else 4 * hf_cfg.n_embd,
+            max_seq_len=hf_cfg.n_positions,
+            norm_eps=hf_cfg.layer_norm_epsilon,
+            tie_embeddings=True,
+            use_learned_pos=True,
+            dtype=dtype,
+            eos_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 50256,
+            bos_token_id=hf_cfg.bos_token_id if hf_cfg.bos_token_id is not None else 50256,
+            pad_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 50256,
+        )
+    return ModelConfig(
+        name=name,
+        arch="llama",
+        vocab_size=hf_cfg.vocab_size,
+        dim=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
+        ffn_dim=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        norm_eps=hf_cfg.rms_norm_eps,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
+        dtype=dtype,
+        eos_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 2,
+        bos_token_id=hf_cfg.bos_token_id if hf_cfg.bos_token_id is not None else 1,
+        pad_token_id=hf_cfg.pad_token_id if hf_cfg.pad_token_id is not None else 0,
+    )
+
+
+def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
+    """Convert a HF Llama-family `state_dict()` into the stacked pytree.
+
+    torch Linear stores weight as [out, in]; our matmuls are x @ W with
+    W [in, out], so every projection is transposed once here.
+    """
+    dt = cfg.jnp_dtype
+    L = cfg.n_layers
+    p = lambda k: _np(sd[k])
+
+    def stack(fmt: str, transpose: bool) -> jnp.ndarray:
+        mats = [p(fmt.format(i)) for i in range(L)]
+        arr = np.stack([m.T if transpose else m for m in mats], axis=0)
+        return jnp.asarray(arr, dtype=dt)
+
+    params = {
+        "embed": jnp.asarray(p("model.embed_tokens.weight"), dtype=dt),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
+        },
+        "final_norm": jnp.asarray(p("model.norm.weight"), dtype=dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(p("lm_head.weight").T, dtype=dt)
+    return params
+
+
+def gpt2_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
+    """Convert a HF GPT-2 `state_dict()` into the stacked pytree.
+
+    GPT-2 uses Conv1D modules whose weights are already [in, out] — no
+    transpose — and a fused qkv projection `c_attn` [D, 3D] that we split.
+    """
+    dt = cfg.jnp_dtype
+    L, D = cfg.n_layers, cfg.dim
+    p = lambda k: _np(sd[k])
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([p(fmt.format(i)) for i in range(L)], axis=0)
+
+    c_attn_w = stack("transformer.h.{}.attn.c_attn.weight")  # [L, D, 3D]
+    c_attn_b = stack("transformer.h.{}.attn.c_attn.bias")  # [L, 3D]
+    params = {
+        "embed": jnp.asarray(p("transformer.wte.weight"), dtype=dt),
+        "pos_embed": jnp.asarray(p("transformer.wpe.weight"), dtype=dt),
+        "layers": {
+            "ln1_w": jnp.asarray(stack("transformer.h.{}.ln_1.weight"), dtype=dt),
+            "ln1_b": jnp.asarray(stack("transformer.h.{}.ln_1.bias"), dtype=dt),
+            "ln2_w": jnp.asarray(stack("transformer.h.{}.ln_2.weight"), dtype=dt),
+            "ln2_b": jnp.asarray(stack("transformer.h.{}.ln_2.bias"), dtype=dt),
+            "wq": jnp.asarray(c_attn_w[:, :, :D], dtype=dt),
+            "wk": jnp.asarray(c_attn_w[:, :, D : 2 * D], dtype=dt),
+            "wv": jnp.asarray(c_attn_w[:, :, 2 * D :], dtype=dt),
+            "bq": jnp.asarray(c_attn_b[:, :D], dtype=dt),
+            "bk": jnp.asarray(c_attn_b[:, D : 2 * D], dtype=dt),
+            "bv": jnp.asarray(c_attn_b[:, 2 * D :], dtype=dt),
+            "wo": jnp.asarray(stack("transformer.h.{}.attn.c_proj.weight"), dtype=dt),
+            "bo": jnp.asarray(stack("transformer.h.{}.attn.c_proj.bias"), dtype=dt),
+            "w_fc": jnp.asarray(stack("transformer.h.{}.mlp.c_fc.weight"), dtype=dt),
+            "b_fc": jnp.asarray(stack("transformer.h.{}.mlp.c_fc.bias"), dtype=dt),
+            "w_proj": jnp.asarray(stack("transformer.h.{}.mlp.c_proj.weight"), dtype=dt),
+            "b_proj": jnp.asarray(stack("transformer.h.{}.mlp.c_proj.bias"), dtype=dt),
+        },
+        "final_norm_w": jnp.asarray(p("transformer.ln_f.weight"), dtype=dt),
+        "final_norm_b": jnp.asarray(p("transformer.ln_f.bias"), dtype=dt),
+    }
+    return params
+
+
+def params_from_hf_model(hf_model: Any, dtype: str = "float32"):
+    """(cfg, params) from an in-memory transformers model instance."""
+    cfg = config_from_hf(hf_model.config, name=getattr(hf_model.config, "name_or_path", "") or "converted", dtype=dtype)
+    sd = hf_model.state_dict()
+    if cfg.arch == "gpt2":
+        return cfg, gpt2_params_from_state_dict(sd, cfg)
+    return cfg, llama_params_from_state_dict(sd, cfg)

@@ -1,0 +1,187 @@
+"""Llama-family decoder (TinyLlama / Llama-2 / Llama-3) in pure JAX.
+
+TPU-first redesign of the compute the reference spreads across three
+processes: the orchestrator's embed/norm/lm_head
+(/root/reference/orchestration.py:45-47,111,140-141) and the workers'
+decoder-layer slices (/root/reference/Worker1.py:68-70,82-177) become one
+functional model over a parameter pytree whose per-layer tensors are
+*stacked on a leading layer axis*. That layout gives us:
+
+  * `lax.scan` over layers (one compiled layer body, no Python loop),
+  * clean pipeline partitioning — a stage's params are a contiguous slice
+    of the layer axis, shardable with `NamedSharding` over the `pp` mesh
+    axis (replacing the reference's LAYER_START/LAYER_END module constants,
+    Worker1.py:27-28),
+  * a KV cache with the same stacked layout, threaded through the scan.
+
+Params pytree (L = n_layers, D = dim, H/KV heads, Dh = head_dim, F = ffn_dim,
+V = vocab):
+  embed       [V, D]
+  layers:
+    attn_norm [L, D]      mlp_norm [L, D]
+    wq [L, D, H*Dh]  wk [L, D, KV*Dh]  wv [L, D, KV*Dh]  wo [L, H*Dh, D]
+    w_gate [L, D, F]  w_up [L, D, F]  w_down [L, F, D]
+  final_norm  [D]
+  lm_head     [D, V]   (absent when tie_embeddings)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..ops.attention import attend, causal_mask, update_kv_cache
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope, rope_cos_sin
+
+Params = dict
+KVCache = dict  # {"k": [L, B, S, KV, Dh], "v": [L, B, S, KV, Dh]}
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random-init params (for tests/benchmarks; real weights come from
+    models/convert.py). Scaled-normal init, dtype = cfg.dtype."""
+    dt = cfg.jnp_dtype
+    L, D, F, V = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.vocab_size
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    ks = jax.random.split(key, 10)
+
+    def normal(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    s = D ** -0.5
+    params = {
+        "embed": normal(ks[0], (V, D), 0.02),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dt),
+            "mlp_norm": jnp.ones((L, D), dt),
+            "wq": normal(ks[1], (L, D, H * Dh), s),
+            "wk": normal(ks[2], (L, D, KV * Dh), s),
+            "wv": normal(ks[3], (L, D, KV * Dh), s),
+            "wo": normal(ks[4], (L, H * Dh, D), s),
+            "w_gate": normal(ks[5], (L, D, F), s),
+            "w_up": normal(ks[6], (L, D, F), s),
+            "w_down": normal(ks[7], (L, F, D), F ** -0.5),
+        },
+        "final_norm": jnp.ones((D,), dt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(ks[8], (D, V), s)
+    return params
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_seq: Optional[int] = None, n_layers: Optional[int] = None
+) -> KVCache:
+    """Zeroed static-shape KV cache, stacked on the layer axis (shardable
+    over `pp` exactly like the layer params)."""
+    S = max_seq or cfg.max_seq_len
+    L = n_layers if n_layers is not None else cfg.n_layers
+    shape = (L, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    dt = cfg.jnp_dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def decoder_layer(
+    cfg: ModelConfig,
+    lp: Params,
+    x: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    pos: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    mask: jnp.ndarray,
+    update_gate: Optional[jnp.ndarray] = None,
+):
+    """One pre-norm decoder block on a chunk x [B,T,D] at offset `pos`.
+
+    lp: this layer's params (no leading L axis). Returns (x, cache_k, cache_v).
+    update_gate: optional traced bool — when False the cache write is
+    discarded (needed by the pipeline runtime, where a stage executes
+    speculatively on microsteps when it holds no valid microbatch).
+    """
+    B, T, D = x.shape
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, T, H, Dh)
+    k = (h @ lp["wk"]).reshape(B, T, KV, Dh)
+    v = (h @ lp["wv"]).reshape(B, T, KV, Dh)
+    q, k = apply_rope(q, k, cos, sin)
+
+    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos)
+    if update_gate is not None:
+        keep = update_gate
+        new_k = jnp.where(keep, new_k, cache_k)
+        new_v = jnp.where(keep, new_v, cache_v)
+    attn = attend(q, new_k, new_v, mask)
+    x = x + attn.reshape(B, T, H * Dh) @ lp["wo"]
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    return x, new_k, new_v
+
+
+def forward_layers(
+    cfg: ModelConfig,
+    layers: Params,
+    x: jnp.ndarray,
+    cache: KVCache,
+    pos: jnp.ndarray,
+    update_gate: Optional[jnp.ndarray] = None,
+):
+    """Scan the stacked layer params over a chunk. Works for any contiguous
+    slice of layers (full model or one pipeline stage's slice).
+
+    x: [B, T, D]; cache k/v: [L_slice, B, S, KV, Dh]; pos: scalar int32.
+    Returns (x, new_cache).
+    """
+    T = x.shape[1]
+    S = cache["k"].shape[2]
+    positions = pos + jnp.arange(T, dtype=jnp.int32)
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    mask = causal_mask(pos, T, S)
+
+    def body(carry, xs):
+        xc = carry
+        lp, ck, cv = xs
+        xc, ck, cv = decoder_layer(
+            cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate
+        )
+        return xc, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))
+    return x, {"k": new_k, "v": new_v}
+
+
+def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup: [B, T] -> [B, T, D]
+    (reference orchestration.py:111)."""
+    return params["embed"][tokens]
+
+
+def unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Final RMSNorm + LM head: [B, T, D] -> [B, T, V] logits
+    (reference orchestration.py:140-141)."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head).astype(jnp.float32)
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jnp.ndarray,
+    cache: KVCache,
+    pos: jnp.ndarray,
+):
+    """Full-model chunk forward: tokens [B,T] at offset pos -> (logits
+    [B,T,V] fp32, new_cache). One call == prefill; T=1 call == decode step."""
+    x = embed(cfg, params, tokens)
+    x, cache = forward_layers(cfg, params["layers"], x, cache, pos)
+    return unembed(cfg, params, x), cache

@@ -1,0 +1,80 @@
+"""Model registry: named presets for the BASELINE.json configs.
+
+Replaces the reference's single hardcoded MODEL_NAME
+(/root/reference/orchestration.py:20). Architecture hyperparameters are
+pinned here so the framework runs fully offline (random-init or converted
+weights); when a HF checkpoint is available, models/convert.py produces the
+params and the converted config overrides these.
+"""
+
+from __future__ import annotations
+
+from ..config import ModelConfig
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def register(cfg: ModelConfig) -> ModelConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# --- Llama family ----------------------------------------------------------
+register(ModelConfig(
+    name="tinyllama-1.1b", arch="llama", vocab_size=32000, dim=2048,
+    n_layers=22, n_heads=32, n_kv_heads=4, ffn_dim=5632, max_seq_len=2048,
+    rope_theta=10000.0, eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="llama2-7b", arch="llama", vocab_size=32000, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=32, ffn_dim=11008, max_seq_len=4096,
+    rope_theta=10000.0, eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="llama2-13b", arch="llama", vocab_size=32000, dim=5120,
+    n_layers=40, n_heads=40, n_kv_heads=40, ffn_dim=13824, max_seq_len=4096,
+    rope_theta=10000.0, eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="llama3-8b", arch="llama", vocab_size=128256, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+    rope_theta=500000.0, eos_token_id=128001, bos_token_id=128000,
+))
+
+# --- GPT-2 family ----------------------------------------------------------
+register(ModelConfig(
+    name="gpt2-small", arch="gpt2", vocab_size=50257, dim=768,
+    n_layers=12, n_heads=12, n_kv_heads=12, ffn_dim=3072, max_seq_len=1024,
+    norm_eps=1e-5, tie_embeddings=True, use_learned_pos=True,
+    eos_token_id=50256, bos_token_id=50256, pad_token_id=50256,
+))
+register(ModelConfig(
+    name="gpt2-medium", arch="gpt2", vocab_size=50257, dim=1024,
+    n_layers=24, n_heads=16, n_kv_heads=16, ffn_dim=4096, max_seq_len=1024,
+    norm_eps=1e-5, tie_embeddings=True, use_learned_pos=True,
+    eos_token_id=50256, bos_token_id=50256, pad_token_id=50256,
+))
+
+# --- tiny test configs (CI-sized) -----------------------------------------
+register(ModelConfig(
+    name="test-llama-tiny", arch="llama", vocab_size=256, dim=64,
+    n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+    eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="test-gpt2-tiny", arch="gpt2", vocab_size=256, dim=64,
+    n_layers=4, n_heads=4, n_kv_heads=4, ffn_dim=256, max_seq_len=128,
+    tie_embeddings=True, use_learned_pos=True,
+    eos_token_id=250, bos_token_id=250, pad_token_id=250,
+))

@@ -1,0 +1,76 @@
+"""Causal attention with GQA and a static-shape KV cache.
+
+Replaces the reference's per-layer HF `LlamaAttention` calls, which it runs
+with `attention_mask=None, past_key_value=None, use_cache=False`
+(/root/reference/Worker1.py:125-154) — i.e. full-sequence recompute per
+decoded token. Here the KV cache is a static-shape HBM buffer written with
+`lax.dynamic_update_slice`, so one compiled program covers both prefill
+(chunk of length T at offset 0) and decode (T=1 at offset `pos`), and the
+decode cost per token is O(seq) attention instead of O(seq²) recompute.
+
+Shapes (B=batch, T=chunk len, S=max_seq, H=q heads, KV=kv heads, Dh=head_dim):
+  q          [B, T, H, Dh]
+  k_new/v_new[B, T, KV, Dh]
+  cache_k/v  [B, S, KV, Dh]
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def update_kv_cache(
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Write the new K/V chunk at offset `pos` (scalar int32). Static shapes.
+
+    Caller contract: pos + T must be <= max_seq. `dynamic_update_slice`
+    CLAMPS out-of-range starts instead of erroring, which would silently
+    misplace K/V relative to `causal_mask`'s absolute positions — the decode
+    engine enforces the bound (engine/generate.py caps max_new_tokens by the
+    cache capacity) so this never triggers in serving.
+    """
+    zero = jnp.int32(0)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k_new, (zero, pos, zero, zero))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v_new, (zero, pos, zero, zero))
+    return cache_k, cache_v
+
+
+def causal_mask(pos: jnp.ndarray, chunk_len: int, max_seq: int) -> jnp.ndarray:
+    """[T, S] boolean mask: query at absolute position pos+t may attend to
+    cache slots 0..pos+t inclusive (earlier prompt + itself)."""
+    q_pos = pos + jnp.arange(chunk_len, dtype=jnp.int32)  # [T]
+    kv_pos = jnp.arange(max_seq, dtype=jnp.int32)  # [S]
+    return kv_pos[None, :] <= q_pos[:, None]
+
+
+def attend(
+    q: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Grouped-query attention over the (already updated) cache.
+
+    Softmax in fp32; output cast back to q.dtype. Returns [B, T, H, Dh].
+    """
+    B, T, H, Dh = q.shape
+    KV = cache_k.shape[2]
+    group = H // KV
+    # [B, T, KV, group, Dh] so each kv head serves its query group without
+    # materializing repeated K/V (XLA keeps this as a batched matmul).
+    qg = q.reshape(B, T, KV, group, Dh)
+    scale = Dh ** -0.5
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
+    ) * scale  # [B, KV, group, T, S]
+    neg = jnp.finfo(jnp.float32).min
+    scores = jnp.where(mask[None, None, None, :, :], scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, cache_v.astype(jnp.float32))
+    return out.reshape(B, T, H, Dh).astype(q.dtype)

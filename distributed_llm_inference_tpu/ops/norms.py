@@ -1,0 +1,36 @@
+"""Normalization ops.
+
+TPU-native replacements for the torch modules the reference leans on
+(`model.norm` RMSNorm at /root/reference/orchestration.py:46,140 and the
+per-layer input/post-attention norms inside the HF decoder layers run at
+/root/reference/Worker1.py:128-137). Accumulation is in float32 regardless
+of activation dtype, matching HF LlamaRMSNorm semantics so logits-parity
+tests hold in bfloat16.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm: x / rms(x) * weight, variance in fp32."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xf = xf * jax.lax.rsqrt(var + eps)
+    return (xf * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-5
+) -> jnp.ndarray:
+    """LayerNorm with affine params (GPT-2 family), fp32 accumulation."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+    xf = (xf - mean) * (var + eps) ** -0.5
+    out = xf * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(orig_dtype)

@@ -1,0 +1,59 @@
+"""Rotary position embeddings (Llama semantics).
+
+The reference burns ~25 lines on transformers-version compat fallbacks just to
+get cos/sin tables out of HF (/root/reference/Worker1.py:98-120) and rebuilds
+position ids 0..seq-1 on every call (/root/reference/Worker1.py:93-94). Here
+RoPE is a pure function of (positions, head_dim, theta) with pinned HF
+"rotate_half" semantics: inv_freq over even indices, angles tiled twice, and
+rotation by concat(-x2, x1) — matching transformers' LlamaRotaryEmbedding so
+converter parity tests hold exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray, head_dim: int, theta: float = 10000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for integer positions.
+
+    positions: [...] int array. Returns (cos, sin), each [..., head_dim],
+    computed in float32 (HF computes RoPE tables in fp32 even for bf16 models).
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., head_dim/2]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [..., head_dim]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply rotary embedding to q [B,T,H,Dh] and k [B,T,KV,Dh].
+
+    cos/sin: [T, Dh] or [B, T, Dh]; broadcast over the head axis.
+    """
+    if cos.ndim == 2:  # [T, Dh] -> [1, T, 1, Dh]
+        cos_b = cos[None, :, None, :]
+        sin_b = sin[None, :, None, :]
+    else:  # [B, T, Dh] -> [B, T, 1, Dh]
+        cos_b = cos[:, :, None, :]
+        sin_b = sin[:, :, None, :]
+    orig = q.dtype
+    qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_out = qf * cos_b + _rotate_half(qf) * sin_b
+    k_out = kf * cos_b + _rotate_half(kf) * sin_b
+    return q_out.astype(orig), k_out.astype(orig)

@@ -1,0 +1,113 @@
+"""Token sampling: temperature / top-k / top-p / greedy.
+
+Behavioral spec is the reference's inline sampling stack
+(/root/reference/orchestration.py:144-169): divide logits by temperature,
+top-k filter, top-p nucleus filter with the keep-first-over-threshold shift,
+then a categorical draw — rebuilt as pure jittable functions over
+`jax.random` keys instead of torch in-place mutation, so the whole sampler
+lives inside the decode `lax.scan`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+def apply_temperature(logits: jnp.ndarray, temperature: jnp.ndarray) -> jnp.ndarray:
+    """logits / temperature (reference orchestration.py:147). Guard t>0."""
+    t = jnp.maximum(jnp.asarray(temperature, dtype=logits.dtype), 1e-6)
+    return logits / t
+
+
+def top_k_filter(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Keep the k highest logits, set the rest to -inf.
+
+    Matches reference orchestration.py:150-152 (threshold = k-th largest
+    value; ties at the threshold are kept, identical to the torch topk
+    comparison). k is a traced scalar; k <= 0 disables filtering.
+    """
+    vocab = logits.shape[-1]
+    k_eff = jnp.clip(k, 1, vocab)
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+    idx = jnp.broadcast_to(jnp.asarray(k_eff - 1), logits.shape[:-1] + (1,))
+    threshold = jnp.take_along_axis(sorted_logits, idx, axis=-1)
+    filtered = jnp.where(logits < threshold, NEG_INF, logits)
+    return jnp.where(k <= 0, logits, filtered)
+
+
+def top_p_filter(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering (reference orchestration.py:155-165).
+
+    Sort descending, softmax, cumulative sum; remove tokens whose cumulative
+    probability exceeds p — shifted right one slot so the first token over
+    the threshold is kept (`sorted_indices_to_remove[..., 0] = False` in the
+    reference). p >= 1 disables filtering.
+    """
+    sort_idx = jnp.argsort(logits, axis=-1)[..., ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    remove = cum > p
+    remove = jnp.concatenate(
+        [jnp.zeros_like(remove[..., :1]), remove[..., :-1]], axis=-1
+    )
+    sorted_filtered = jnp.where(remove, NEG_INF, sorted_logits)
+    # Scatter back to vocab order.
+    inv = jnp.argsort(sort_idx, axis=-1)
+    filtered = jnp.take_along_axis(sorted_filtered, inv, axis=-1)
+    return jnp.where(p >= 1.0, logits, filtered)
+
+
+def sample_token(
+    key: jax.Array,
+    logits: jnp.ndarray,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    greedy: jnp.ndarray,
+) -> jnp.ndarray:
+    """Full sampling stack -> int32 token ids, shape logits.shape[:-1].
+
+    greedy is a traced bool: argmax bypass (the BASELINE configs use greedy
+    decode; the reference always samples).
+
+    Hot-path note: this runs inside the decode `lax.scan` every token, so
+    top-k and top-p share ONE descending sort (the standalone filters above
+    are the unfused behavioral spec used by tests); the draw happens in
+    sorted order and maps back through the sort permutation — equivalent to
+    top_p_filter(top_k_filter(.)) + categorical, with 1 sort instead of 3.
+    """
+    logits = logits.astype(jnp.float32)
+    scaled = apply_temperature(logits, temperature)
+    vocab = scaled.shape[-1]
+
+    sort_idx = jnp.argsort(scaled, axis=-1)[..., ::-1]
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    rank = jnp.arange(vocab, dtype=jnp.int32)
+    # top-k: keep ranks < k (rank ordering matches the threshold semantics
+    # of top_k_filter up to ties at the threshold). k <= 0 disables.
+    keep_k = jnp.where(top_k <= 0, True, rank < jnp.clip(top_k, 1, vocab))
+    # top-p: shifted cumulative-probability removal, first token always kept.
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    over = cum > top_p
+    keep_p = ~jnp.concatenate([jnp.zeros_like(over[..., :1]), over[..., :-1]], axis=-1)
+    keep_p = jnp.where(top_p >= 1.0, True, keep_p)
+
+    sorted_filtered = jnp.where(keep_k & keep_p, sorted_logits, NEG_INF)
+    draw = jax.random.categorical(key, sorted_filtered, axis=-1)  # rank index
+    sampled = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)[..., 0]
+    argmax = sort_idx[..., 0]
+    return jnp.where(greedy, argmax, sampled).astype(jnp.int32)
+
+
+def top_n_probs(logits: jnp.ndarray, n: int = 5):
+    """Top-n (prob, token) pairs for debug observability — the reference
+    prints top-5 next-token predictions for the first 3 steps
+    (/root/reference/orchestration.py:172-178)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_probs, top_ids = jax.lax.top_k(probs, n)
+    return top_probs, top_ids

@@ -1,0 +1,26 @@
+"""Test harness: force the CPU backend with 8 virtual devices so N-stage
+pipeline tests run on any host with no TPU (SURVEY.md §4). Must run before
+any test module initializes a JAX backend."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+# The axon site package pins JAX_PLATFORMS=axon at interpreter start; the
+# config update (pre-backend-init) wins over the env var.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs

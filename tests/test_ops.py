@@ -1,0 +1,84 @@
+"""Unit tests for norms / RoPE / attention-cache ops against torch or
+closed-form references."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu.ops import attention, norms, rope
+
+
+def test_rms_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.default_rng(0).normal(size=(2, 5, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    xt = torch.from_numpy(x)
+    var = xt.pow(2).mean(-1, keepdim=True)
+    ref = (xt * torch.rsqrt(var + 1e-5)) * torch.from_numpy(w)
+    ours = norms.rms_norm(jnp.asarray(x), jnp.asarray(w), 1e-5)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_layer_norm_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.random.default_rng(0).normal(size=(2, 5, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    b = np.random.default_rng(2).normal(size=(16,)).astype(np.float32)
+    ref = torch.nn.functional.layer_norm(
+        torch.from_numpy(x), (16,), torch.from_numpy(w), torch.from_numpy(b), 1e-5
+    )
+    ours = norms.layer_norm(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), 1e-5)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    cos, sin = rope.rope_cos_sin(jnp.arange(8), 16, 10000.0)
+    q = jnp.ones((1, 8, 2, 16))
+    k = jnp.ones((1, 8, 2, 16))
+    qr, kr = rope.apply_rope(q, k, cos, sin)
+    # rotation preserves per-head vector norm
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr), axis=-1),
+        np.linalg.norm(np.asarray(q), axis=-1),
+        rtol=1e-5,
+    )
+    # position 0 is identity rotation
+    np.testing.assert_allclose(np.asarray(qr[0, 0]), np.asarray(q[0, 0]), rtol=1e-6)
+    # q.k depends only on relative offset: <q_i, k_j> == <q_{i+d}, k_{j+d}>
+    qi_kj = np.einsum("d,d->", np.asarray(qr)[0, 2, 0], np.asarray(kr)[0, 5, 0])
+    qi_kj_shift = np.einsum("d,d->", np.asarray(qr)[0, 3, 0], np.asarray(kr)[0, 6, 0])
+    np.testing.assert_allclose(qi_kj, qi_kj_shift, rtol=1e-4)
+
+
+def test_kv_cache_update_and_mask():
+    ck = jnp.zeros((1, 8, 2, 4))
+    cv = jnp.zeros((1, 8, 2, 4))
+    k_new = jnp.ones((1, 3, 2, 4))
+    ck2, cv2 = attention.update_kv_cache(ck, cv, k_new, k_new * 2, jnp.int32(2))
+    arr = np.asarray(ck2)
+    assert (arr[:, 2:5] == 1).all() and (arr[:, :2] == 0).all() and (arr[:, 5:] == 0).all()
+    assert (np.asarray(cv2)[:, 2:5] == 2).all()
+
+    mask = np.asarray(attention.causal_mask(jnp.int32(2), 3, 8))
+    # query t=0 is absolute position 2: sees slots 0..2
+    assert mask[0, :3].all() and not mask[0, 3:].any()
+    assert mask[2, :5].all() and not mask[2, 5:].any()
+
+
+def test_attend_gqa_equals_repeated_mha():
+    """GQA grouped einsum == explicitly repeating KV heads."""
+    rng = np.random.default_rng(0)
+    B, T, S, H, KV, Dh = 1, 4, 6, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, H, Dh)), jnp.float32)
+    ck = jnp.asarray(rng.normal(size=(B, S, KV, Dh)), jnp.float32)
+    cv = jnp.asarray(rng.normal(size=(B, S, KV, Dh)), jnp.float32)
+    mask = attention.causal_mask(jnp.int32(2), T, S)
+    out = attention.attend(q, ck, cv, mask)
+
+    ck_rep = jnp.repeat(ck, H // KV, axis=2)
+    cv_rep = jnp.repeat(cv, H // KV, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, ck_rep) * (Dh ** -0.5)
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    ref = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(scores, -1), cv_rep)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
