@@ -1,0 +1,18 @@
+"""Chat prompt templating (reference C2, /root/reference/orchestration.py:60-67).
+
+The TinyLlama-Chat Zephyr-style format is the behavioral spec; other model
+families get their own template or passthrough.
+"""
+
+from __future__ import annotations
+
+TINYLLAMA_SYSTEM = "You are a helpful assistant."
+
+
+def format_chat_prompt(user_message: str, system: str = TINYLLAMA_SYSTEM, arch: str = "llama") -> str:
+    """TinyLlama chat format — identical layout to the reference's
+    format_chat_prompt (orchestration.py:66). GPT-2 has no chat format;
+    the raw prompt passes through."""
+    if arch == "gpt2":
+        return user_message
+    return f"<|system|>\n{system}</s>\n<|user|>\n{user_message}</s>\n<|assistant|>\n"

@@ -1,0 +1,213 @@
+"""InferenceEngine: the request-level decode engine (reference L3).
+
+Replaces `Orchestrator.generate_with_sampling`
+(/root/reference/orchestration.py:69-228): tokenize → chat-template →
+prefill (TTFT) → decode loop → detokenize → perf stats, with the same
+response schema (`prompt`, `response`, `status`, `time_taken`,
+`tokens_generated`, `tokens_per_sec` — orchestration.py:211-218) plus
+first-class `ttft_s` (BASELINE.json's p50-TTFT metric is a measurement, not
+a print).
+
+Single-owner by construction: one lock serializes generations — the
+reference's shared-global Flask state would interleave worker calls across
+concurrent requests with no locking (SURVEY.md §5 race note).
+
+The compute backend is pluggable: `SingleDeviceBackend` (this file) runs
+the whole model on one chip; `parallel.pipeline.PipelineBackend` runs
+N stages over a mesh with the same (prefill, decode) interface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import EngineConfig, ModelConfig
+from ..models import llama
+from ..utils.tokenizer import load_tokenizer
+from . import generate as G
+from .chat import format_chat_prompt
+
+DECODE_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+
+class SingleDeviceBackend:
+    """Whole model on one device: prefill + while-loop decode, both jitted."""
+
+    name = "single-device"
+    n_stages = 1
+
+    def __init__(self, cfg: ModelConfig, params):
+        self.cfg = cfg
+        self.params = params
+
+    def init_cache(self, batch: int, max_seq: int):
+        return llama.init_kv_cache(self.cfg, batch, max_seq=max_seq)
+
+    def prefill(self, tokens, prompt_len, cache, key, sampling):
+        return G.prefill(self.cfg, self.params, tokens, prompt_len, cache, key, sampling)
+
+    def decode(self, first_token, cache, start_pos, limit, key, sampling, *, max_steps):
+        return G.decode(
+            self.cfg, self.params, first_token, cache, start_pos, limit, key,
+            sampling, max_steps=max_steps,
+        )
+
+    def health(self) -> list[dict]:
+        """Per-device health (reference /workers sweep, orchestration.py:306-329)."""
+        devs = jax.devices()
+        return [
+            {"stage": 0, "devices": [str(d) for d in devs[:1]], "status": "online"}
+        ]
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any = None,
+        backend: Any = None,
+        tokenizer: Any = None,
+        engine_cfg: EngineConfig = EngineConfig(),
+        seed: int = 0,
+    ):
+        if backend is None:
+            if params is None:
+                params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+            backend = SingleDeviceBackend(cfg, params)
+        self.cfg = cfg
+        self.backend = backend
+        self.engine_cfg = engine_cfg
+        self.tokenizer = tokenizer or load_tokenizer(
+            None, pad_id=cfg.pad_token_id, bos_id=cfg.bos_token_id, eos_id=cfg.eos_token_id
+        )
+        self._lock = threading.Lock()
+        self._key = jax.random.PRNGKey(seed)
+        self.request_count = 0
+        # Reusable KV cache buffer: allocated once, donated to prefill/decode
+        # each request and replaced by the returned buffer. Stale contents
+        # between requests are harmless — prefill rewrites slots [0, bucket)
+        # and the causal mask hides every slot beyond the current position.
+        self._cache = None
+
+    # -- helpers ------------------------------------------------------------
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _buckets(self):
+        return tuple(b for b in self.engine_cfg.prefill_buckets if b <= self.cfg.max_seq_len)
+
+    # -- main entry ----------------------------------------------------------
+    def generate(
+        self,
+        prompt: str,
+        max_tokens: int = 20,
+        temperature: float = 0.7,
+        top_k: int = 50,
+        top_p: float = 0.9,
+        greedy: bool = False,
+        chat: bool = True,
+        seed: Optional[int] = None,
+    ) -> dict:
+        """Full generation; returns the reference-schema response dict."""
+        t_start = time.time()
+        try:
+            with self._lock:
+                return self._generate_locked(
+                    prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
+                    seed, t_start,
+                )
+        except Exception as e:  # error envelope (orchestration.py:220-228)
+            return {"error": f"Error: {e}", "status": "failed"}
+
+    def _generate_locked(
+        self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat, seed, t_start
+    ):
+        cfg = self.cfg
+        self.request_count += 1
+        text = format_chat_prompt(prompt, arch=cfg.arch) if chat else prompt
+        ids = self.tokenizer.encode(text)
+        prompt_len = len(ids)
+
+        buckets = self._buckets()
+        if not buckets or prompt_len > buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds max prefill bucket "
+                f"{buckets[-1] if buckets else 0}"
+            )
+        bucket = G.pick_bucket(buckets, prompt_len)
+
+        # cache capacity bound: prompt + generated must fit max_seq
+        # (update_kv_cache clamps silently out of range — never allow it);
+        # also bounded by the largest compiled decode bucket
+        max_tokens = max(
+            1,
+            min(int(max_tokens), cfg.max_seq_len - prompt_len - 1, DECODE_BUCKETS[-1]),
+        )
+        decode_bucket = G.pick_bucket(DECODE_BUCKETS, max_tokens)
+
+        pad = cfg.pad_token_id
+        tokens = jnp.asarray([ids + [pad] * (bucket - prompt_len)], jnp.int32)
+        sampling = G.default_sampling(temperature, top_k, top_p, greedy)
+        key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
+        key_pre, key_dec = jax.random.split(key)
+
+        if self._cache is None:
+            self._cache = self.backend.init_cache(1, cfg.max_seq_len)
+        cache = self._cache
+        self._cache = None  # donated below; restored from the decode result
+        first, logits, cache = self.backend.prefill(
+            tokens, jnp.int32(prompt_len), cache, key_pre, sampling
+        )
+        first = jax.block_until_ready(first)
+        ttft = time.time() - t_start
+
+        out, n_gen, cache = self.backend.decode(
+            first, cache, jnp.int32(prompt_len), jnp.int32(max_tokens - 1),
+            key_dec, sampling, max_steps=decode_bucket,
+        )
+        out = jax.block_until_ready(out)
+        self._cache = cache
+
+        first_id = int(first[0])
+        first_ok = first_id != cfg.eos_token_id
+        gen_ids = ([first_id] if first_ok else []) + [
+            int(t) for t in list(out[0][: int(n_gen[0])])
+        ]
+        response = self.tokenizer.decode(gen_ids, skip_special_tokens=True)
+
+        elapsed = time.time() - t_start
+        n = len(gen_ids)
+        tps = n / elapsed if elapsed > 0 else 0.0
+        return {
+            "prompt": prompt,
+            "response": response,
+            "status": "success",
+            "time_taken": f"{elapsed:.2f}s",
+            "tokens_generated": n,
+            "tokens_per_sec": f"{tps:.2f}",
+            "ttft_s": round(ttft, 4),
+            "backend": self.backend.name,
+        }
+
+    # -- health (reference /health + /workers, orchestration.py:297-329) ----
+    def health(self) -> dict:
+        return {
+            "status": "healthy",
+            "model": self.cfg.name,
+            "backend": self.backend.name,
+            "n_stages": getattr(self.backend, "n_stages", 1),
+            "requests_served": self.request_count,
+        }
+
+    def workers(self) -> dict:
+        stages = self.backend.health()
+        return {
+            "workers": {f"stage_{s['stage']}": s for s in stages},
+            "total": len(stages),
+        }

@@ -1,0 +1,145 @@
+"""Single-device decode engine: prefill + early-exit decode loop.
+
+TPU-native replacement for the reference's hot loop
+(/root/reference/orchestration.py:109-196), which re-embeds and re-runs the
+*full* sequence through every stage per token with no KV cache. Here:
+
+  * **prefill** is one jit call over the (bucket-padded) prompt — this is
+    the TTFT-critical path; right-padding is safe without extra masking
+    because pad slots sit at positions > prompt_len-1, are never attended
+    by valid queries (causal mask), and are overwritten by decode tokens
+    before any valid query can reach them;
+  * **decode** is one jit call: a `lax.while_loop` over steps with the KV
+    cache threaded through (donated, so XLA updates it in place in HBM),
+    the fused sampler inside the loop, and early exit when every row hits
+    EOS — zero Python per token;
+  * logits are only computed for the positions that get sampled (the
+    reference runs lm_head over the whole sequence every step,
+    orchestration.py:140-144).
+
+Batch rows share one prompt length (serving uses batch=1; the batched bench
+configs use equal-length prompts).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..models import llama
+from ..ops.sampling import sample_token
+
+
+class SamplingParams(NamedTuple):
+    """Traced sampling knobs (one compiled program serves all values)."""
+
+    temperature: jnp.ndarray  # f32 scalar
+    top_k: jnp.ndarray  # i32 scalar, <=0 disables
+    top_p: jnp.ndarray  # f32 scalar, >=1 disables
+    greedy: jnp.ndarray  # bool scalar
+
+
+def default_sampling(temperature=0.7, top_k=50, top_p=0.9, greedy=False) -> SamplingParams:
+    return SamplingParams(
+        jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p), jnp.bool_(greedy)
+    )
+
+
+def _forward_step(cfg, params, tokens, cache, pos):
+    """One chunk through the stack; logits only at the final chunk position."""
+    x = llama.embed(cfg, params, tokens)
+    x, cache = llama.forward_layers(cfg, params["layers"], x, cache, pos)
+    logits = llama.unembed(cfg, params, x[:, -1:, :])
+    return logits[:, 0, :], cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill(cfg: ModelConfig, params, tokens, prompt_len, cache, key, sampling: SamplingParams):
+    """Run the padded prompt, sample the first token.
+
+    tokens: [B, T_bucket] right-padded; prompt_len: scalar int32 (shared by
+    the batch). Returns (first_token [B], logits [B,V], cache).
+    """
+    x = llama.embed(cfg, params, tokens)
+    x, cache = llama.forward_layers(cfg, params["layers"], x, cache, jnp.int32(0))
+    # logits only at the last *valid* prompt position (traced start is fine
+    # for dynamic_slice; prompt_len >= 1 by the engine's contract)
+    last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)  # [B,1,D]
+    logits = llama.unembed(cfg, params, last)[:, 0, :]
+    first = sample_token(key, logits, *sampling)
+    return first, logits, cache
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "max_steps"), donate_argnames=("cache",)
+)
+def decode(
+    cfg: ModelConfig,
+    params,
+    first_token,
+    cache,
+    start_pos,
+    limit,
+    key,
+    sampling: SamplingParams,
+    *,
+    max_steps: int,
+):
+    """Early-exit decode loop after prefill.
+
+    first_token: [B] (already counted as generated token #0 unless EOS).
+    start_pos: scalar int32 = prompt_len (first_token's K/V lands there).
+    limit: traced cap on steps this call (<= static max_steps), so one
+    compiled program serves every requested max_tokens in the bucket.
+
+    Returns (tokens [B, max_steps] — pad-masked after EOS, EOS excluded,
+    matching the reference's break-before-append at orchestration.py:181-186
+    — and n_gen [B] counting tokens emitted by THIS loop).
+    """
+    B = first_token.shape[0]
+    pad = jnp.int32(cfg.pad_token_id)
+    eos = jnp.int32(cfg.eos_token_id)
+    out0 = jnp.full((B, max_steps), pad, jnp.int32)
+    finished0 = first_token == eos
+
+    def cond(c):
+        step, _, _, _, _, finished, _, _ = c
+        return (step < limit) & ~jnp.all(finished)
+
+    def body(c):
+        step, token, pos, cache, key, finished, out, n_gen = c
+        logits, cache = _forward_step(cfg, params, token[:, None], cache, pos)
+        key, sub = jax.random.split(key)
+        nxt = sample_token(sub, logits, *sampling)
+        is_eos = nxt == eos
+        newly_finished = finished | is_eos
+        emit = jnp.where(newly_finished, pad, nxt)
+        out = jax.lax.dynamic_update_slice(out, emit[:, None], (jnp.int32(0), step))
+        n_gen = n_gen + (~newly_finished).astype(jnp.int32)
+        token = jnp.where(newly_finished, pad, nxt)
+        return step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen
+
+    init = (
+        jnp.int32(0),
+        jnp.where(finished0, pad, first_token),
+        start_pos,
+        cache,
+        key,
+        finished0,
+        out0,
+        jnp.zeros((B,), jnp.int32),
+    )
+    _, _, _, cache, _, _, out, n_gen = jax.lax.while_loop(cond, body, init)
+    return out, n_gen, cache
+
+
+def pick_bucket(buckets: tuple, n: int) -> int:
+    """Smallest bucket >= n (compile-once-per-bucket shape discipline)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"length {n} exceeds largest bucket {buckets[-1]}")

@@ -100,7 +100,10 @@ def sample_token(
     sorted_filtered = jnp.where(keep_k & keep_p, sorted_logits, NEG_INF)
     draw = jax.random.categorical(key, sorted_filtered, axis=-1)  # rank index
     sampled = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)[..., 0]
-    argmax = sort_idx[..., 0]
+    # greedy uses a true argmax (first index on ties, like torch/np), NOT
+    # sort_idx[..., 0]: the reversed stable ascending argsort would break
+    # ties toward the LAST index.
+    argmax = jnp.argmax(logits, axis=-1)
     return jnp.where(greedy, argmax, sampled).astype(jnp.int32)
 
 

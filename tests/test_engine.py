@@ -1,0 +1,126 @@
+"""Decode-engine tests: schema, EOS semantics, determinism, bounds.
+
+The response schema is the reference's API contract
+(/root/reference/orchestration.py:211-218); EOS break-before-append is
+orchestration.py:181-186.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine, SingleDeviceBackend
+from distributed_llm_inference_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(64, 128)))
+
+
+def _zero_params(cfg):
+    """All-zero params -> logits identically zero -> greedy argmax is
+    always token 0. Lets us pin EOS semantics deterministically."""
+    p = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return jax.tree.map(jnp.zeros_like, p)
+
+
+def test_response_schema(tiny_engine):
+    r = tiny_engine.generate("hello world", max_tokens=8, seed=0)
+    assert r["status"] == "success"
+    for k in ("prompt", "response", "time_taken", "tokens_generated", "tokens_per_sec"):
+        assert k in r, k
+    assert r["prompt"] == "hello world"
+    assert isinstance(r["tokens_generated"], int)
+    assert r["time_taken"].endswith("s")
+    assert r["ttft_s"] > 0
+    assert 0 < r["tokens_generated"] <= 8
+
+
+def test_eos_immediate_stop():
+    """argmax token == EOS from the very first sample -> zero tokens,
+    empty response (reference breaks before appending EOS)."""
+    cfg = get_model_config("test-llama-tiny").replace(eos_token_id=0, pad_token_id=3)
+    eng = InferenceEngine(
+        cfg,
+        backend=SingleDeviceBackend(cfg, _zero_params(cfg)),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    r = eng.generate("hi", max_tokens=8, greedy=True, chat=False)
+    assert r["status"] == "success"
+    assert r["tokens_generated"] == 0
+    assert r["response"] == ""
+
+
+def test_no_eos_runs_to_max_tokens():
+    """With EOS unreachable (argmax is always 0, eos=5), the loop must emit
+    exactly max_tokens tokens."""
+    cfg = get_model_config("test-llama-tiny").replace(eos_token_id=5, pad_token_id=3)
+    eng = InferenceEngine(
+        cfg,
+        backend=SingleDeviceBackend(cfg, _zero_params(cfg)),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    r = eng.generate("hi", max_tokens=6, greedy=True, chat=False)
+    assert r["tokens_generated"] == 6
+
+
+def test_seeded_determinism(tiny_engine):
+    r1 = tiny_engine.generate("same seed", max_tokens=10, seed=42)
+    r2 = tiny_engine.generate("same seed", max_tokens=10, seed=42)
+    assert r1["response"] == r2["response"]
+
+
+def test_greedy_matches_manual_decode():
+    """Engine greedy output == a hand-rolled argmax loop over the raw model."""
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
+    params = eng.backend.params
+
+    r = eng.generate("abc", max_tokens=5, greedy=True, chat=False)
+
+    ids = eng.tokenizer.encode("abc")
+    cache = llama.init_kv_cache(cfg, 1, max_seq=cfg.max_seq_len)
+    logits, cache = llama.forward(
+        cfg, params, jnp.asarray([ids], jnp.int32), cache, jnp.int32(0)
+    )
+    tok = int(jnp.argmax(logits[0, -1]))
+    manual = []
+    pos = len(ids)
+    while len(manual) < 5 and tok != cfg.eos_token_id:
+        manual.append(tok)
+        lg, cache = llama.forward(
+            cfg, params, jnp.asarray([[tok]], jnp.int32), cache, jnp.int32(pos)
+        )
+        tok = int(jnp.argmax(lg[0, -1]))
+        pos += 1
+    assert r["response"] == eng.tokenizer.decode(manual)
+
+
+def test_prompt_too_long_fails_cleanly(tiny_engine):
+    r = tiny_engine.generate("x" * 500, max_tokens=4)
+    assert r["status"] == "failed"
+    assert "error" in r
+
+
+def test_max_tokens_clamped_by_cache_capacity():
+    cfg = get_model_config("test-llama-tiny").replace(max_seq_len=48, eos_token_id=5)
+    eng = InferenceEngine(
+        cfg,
+        backend=SingleDeviceBackend(cfg, _zero_params(cfg)),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    # prompt ~4 tokens; request far more than fits -> clamped, still succeeds
+    r = eng.generate("hi", max_tokens=1000, greedy=True, chat=False)
+    assert r["status"] == "success"
+    assert r["tokens_generated"] <= 48
+
+
+def test_health_and_workers(tiny_engine):
+    h = tiny_engine.health()
+    assert h["status"] == "healthy" and h["n_stages"] == 1
+    w = tiny_engine.workers()
+    assert w["total"] == 1 and w["workers"]["stage_0"]["status"] == "online"
