@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import EngineConfig, ModelConfig
-from ..models import llama
+from ..models import api as M
 from ..utils.tokenizer import load_tokenizer
 from . import generate as G
 from .chat import format_chat_prompt
@@ -46,7 +46,7 @@ class SingleDeviceBackend:
         self.params = params
 
     def init_cache(self, batch: int, max_seq: int):
-        return llama.init_kv_cache(self.cfg, batch, max_seq=max_seq)
+        return M.init_kv_cache(self.cfg, batch, max_seq=max_seq)
 
     def prefill(self, tokens, prompt_len, cache, key, sampling):
         return G.prefill(self.cfg, self.params, tokens, prompt_len, cache, key, sampling)
@@ -77,7 +77,7 @@ class InferenceEngine:
     ):
         if backend is None:
             if params is None:
-                params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+                params = M.init_params(cfg, jax.random.PRNGKey(seed))
             backend = SingleDeviceBackend(cfg, params)
         self.cfg = cfg
         self.backend = backend

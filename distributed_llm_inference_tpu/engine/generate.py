@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..models import llama
+from ..models import api as M
 from ..ops.sampling import sample_token
 
 
@@ -51,9 +51,9 @@ def default_sampling(temperature=0.7, top_k=50, top_p=0.9, greedy=False) -> Samp
 
 def _forward_step(cfg, params, tokens, cache, pos):
     """One chunk through the stack; logits only at the final chunk position."""
-    x = llama.embed(cfg, params, tokens)
-    x, cache = llama.forward_layers(cfg, params["layers"], x, cache, pos)
-    logits = llama.unembed(cfg, params, x[:, -1:, :])
+    x = M.embed(cfg, params, tokens, pos)
+    x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+    logits = M.unembed(cfg, params, x[:, -1:, :])
     return logits[:, 0, :], cache
 
 
@@ -64,12 +64,12 @@ def prefill(cfg: ModelConfig, params, tokens, prompt_len, cache, key, sampling: 
     tokens: [B, T_bucket] right-padded; prompt_len: scalar int32 (shared by
     the batch). Returns (first_token [B], logits [B,V], cache).
     """
-    x = llama.embed(cfg, params, tokens)
-    x, cache = llama.forward_layers(cfg, params["layers"], x, cache, jnp.int32(0))
+    x = M.embed(cfg, params, tokens, jnp.int32(0))
+    x, cache = M.forward_layers(cfg, params["layers"], x, cache, jnp.int32(0))
     # logits only at the last *valid* prompt position (traced start is fine
     # for dynamic_slice; prompt_len >= 1 by the engine's contract)
     last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)  # [B,1,D]
-    logits = llama.unembed(cfg, params, last)[:, 0, :]
+    logits = M.unembed(cfg, params, last)[:, 0, :]
     first = sample_token(key, logits, *sampling)
     return first, logits, cache
 

@@ -1,0 +1,42 @@
+"""Arch dispatch: one functional interface over the model families.
+
+The engine and pipeline runtime call these; cfg.arch picks the family
+(llama: RMSNorm/RoPE/GQA/SwiGLU — gpt2: LayerNorm/learned-pos/MHA/gelu).
+Both families share the stacked-layer pytree + KV-cache layout, so the
+pipeline partitioner and cache plumbing are family-agnostic.
+"""
+
+from __future__ import annotations
+
+from ..config import ModelConfig
+from . import gpt2, llama
+
+_FAMILIES = {"llama": llama, "gpt2": gpt2}
+
+
+def family(cfg: ModelConfig):
+    return _FAMILIES[cfg.arch]
+
+
+def init_params(cfg, key):
+    return family(cfg).init_params(cfg, key)
+
+
+def init_kv_cache(cfg, batch, max_seq=None, n_layers=None):
+    return family(cfg).init_kv_cache(cfg, batch, max_seq=max_seq, n_layers=n_layers)
+
+
+def embed(cfg, params, tokens, pos=0):
+    return family(cfg).embed(cfg, params, tokens, pos)
+
+
+def forward_layers(cfg, layers, x, cache, pos, update_gate=None):
+    return family(cfg).forward_layers(cfg, layers, x, cache, pos, update_gate)
+
+
+def unembed(cfg, params, x):
+    return family(cfg).unembed(cfg, params, x)
+
+
+def forward(cfg, params, tokens, cache, pos):
+    return family(cfg).forward(cfg, params, tokens, cache, pos)

@@ -1,0 +1,138 @@
+"""GPT-2 family decoder in pure JAX (BASELINE configs 1-2).
+
+Same stacked-layer pytree discipline as models/llama.py (scan over layers,
+layer axis shardable over the pipeline mesh axis, KV cache threaded through)
+with GPT-2 architecture: LayerNorm with bias, learned absolute position
+embeddings, fused-qkv MHA with biases, gelu_new MLP, tied LM head.
+
+Params pytree:
+  embed      [V, D]      pos_embed [P, D]
+  layers:
+    ln1_w/ln1_b [L, D]   ln2_w/ln2_b [L, D]
+    wq/wk/wv [L, D, D]   bq/bk/bv [L, D]
+    wo [L, D, D]         bo [L, D]
+    w_fc [L, D, F]  b_fc [L, F]  w_proj [L, F, D]  b_proj [L, D]
+  final_norm_w / final_norm_b [D]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..ops.attention import attend, causal_mask, update_kv_cache
+from ..ops.norms import layer_norm
+
+Params = dict
+KVCache = dict
+
+
+def gelu_new(x: jnp.ndarray) -> jnp.ndarray:
+    """GPT-2's tanh-approximate GELU (HF activation 'gelu_new'), fp32."""
+    xf = x.astype(jnp.float32)
+    c = jnp.sqrt(2.0 / jnp.pi)
+    out = 0.5 * xf * (1.0 + jnp.tanh(c * (xf + 0.044715 * xf ** 3)))
+    return out.astype(x.dtype)
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    dt = cfg.jnp_dtype
+    L, D, F, V, P = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.vocab_size, cfg.max_seq_len
+    ks = jax.random.split(key, 8)
+
+    def normal(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "embed": normal(ks[0], (V, D)),
+        "pos_embed": normal(ks[1], (P, D), 0.01),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), dt),
+            "ln1_b": jnp.zeros((L, D), dt),
+            "ln2_w": jnp.ones((L, D), dt),
+            "ln2_b": jnp.zeros((L, D), dt),
+            "wq": normal(ks[2], (L, D, D)),
+            "wk": normal(ks[3], (L, D, D)),
+            "wv": normal(ks[4], (L, D, D)),
+            "bq": jnp.zeros((L, D), dt),
+            "bk": jnp.zeros((L, D), dt),
+            "bv": jnp.zeros((L, D), dt),
+            "wo": normal(ks[5], (L, D, D)),
+            "bo": jnp.zeros((L, D), dt),
+            "w_fc": normal(ks[6], (L, D, F)),
+            "b_fc": jnp.zeros((L, F), dt),
+            "w_proj": normal(ks[7], (L, F, D)),
+            "b_proj": jnp.zeros((L, D), dt),
+        },
+        "final_norm_w": jnp.ones((D,), dt),
+        "final_norm_b": jnp.zeros((D,), dt),
+    }
+
+
+def init_kv_cache(
+    cfg: ModelConfig, batch: int, max_seq: Optional[int] = None, n_layers: Optional[int] = None
+) -> KVCache:
+    # MHA is GQA with n_kv_heads == n_heads (enforced by the GPT-2 configs),
+    # so the cache-layout contract lives in one place: llama.init_kv_cache.
+    from .llama import init_kv_cache as _llama_init_kv_cache
+
+    return _llama_init_kv_cache(cfg, batch, max_seq=max_seq, n_layers=n_layers)
+
+
+def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None):
+    """One GPT-2 block on chunk x [B,T,D] at offset pos."""
+    B, T, D = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
+    q = (h @ lp["wq"] + lp["bq"]).reshape(B, T, H, Dh)
+    k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, H, Dh)
+    v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
+
+    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos)
+    if update_gate is not None:
+        new_k = jnp.where(update_gate, new_k, cache_k)
+        new_v = jnp.where(update_gate, new_v, cache_v)
+    attn = attend(q, new_k, new_v, mask)
+    x = x + attn.reshape(B, T, D) @ lp["wo"] + lp["bo"]
+
+    h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
+    x = x + gelu_new(h @ lp["w_fc"] + lp["b_fc"]) @ lp["w_proj"] + lp["b_proj"]
+    return x, new_k, new_v
+
+
+def forward_layers(cfg, layers, x, cache, pos, update_gate=None):
+    """Scan the stacked GPT-2 blocks over a chunk (any contiguous slice)."""
+    T = x.shape[1]
+    S = cache["k"].shape[2]
+    mask = causal_mask(pos, T, S)
+
+    def body(carry, xs):
+        xc = carry
+        lp, ck, cv = xs
+        xc, ck, cv = decoder_layer(cfg, lp, xc, ck, cv, pos, mask, update_gate)
+        return xc, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))
+    return x, {"k": new_k, "v": new_v}
+
+
+def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, pos=0) -> jnp.ndarray:
+    """Token + learned position embeddings. pos: chunk offset (scalar)."""
+    T = tokens.shape[1]
+    positions = jnp.asarray(pos, jnp.int32) + jnp.arange(T, dtype=jnp.int32)
+    return params["embed"][tokens] + params["pos_embed"][positions][None, :, :]
+
+
+def unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
+    x = layer_norm(x, params["final_norm_w"], params["final_norm_b"], cfg.norm_eps)
+    return (x @ params["embed"].T).astype(jnp.float32)
+
+
+def forward(cfg, params, tokens, cache, pos):
+    x = embed(cfg, params, tokens, pos)
+    x, cache = forward_layers(cfg, params["layers"], x, cache, pos)
+    return unembed(cfg, params, x), cache

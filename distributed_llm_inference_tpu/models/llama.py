@@ -159,9 +159,10 @@ def forward_layers(
     return x, {"k": new_k, "v": new_v}
 
 
-def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray) -> jnp.ndarray:
+def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, pos=0) -> jnp.ndarray:
     """Token embedding lookup: [B, T] -> [B, T, D]
-    (reference orchestration.py:111)."""
+    (reference orchestration.py:111). `pos` is accepted for interface parity
+    with gpt2.embed (learned positions); RoPE models ignore it here."""
     return params["embed"][tokens]
 
 
