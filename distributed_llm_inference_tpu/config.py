@@ -43,6 +43,18 @@ class ModelConfig:
     bos_token_id: int = 1
     pad_token_id: int = 0
 
+    def __post_init__(self):
+        if self.arch == "gpt2" and self.n_kv_heads != self.n_heads:
+            raise ValueError(
+                f"gpt2 is MHA: n_kv_heads ({self.n_kv_heads}) must equal "
+                f"n_heads ({self.n_heads})"
+            )
+        if self.n_heads % self.n_kv_heads != 0:
+            raise ValueError(
+                f"n_heads ({self.n_heads}) must be divisible by n_kv_heads "
+                f"({self.n_kv_heads})"
+            )
+
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads

@@ -92,10 +92,7 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None):
     k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, H, Dh)
     v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
 
-    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos)
-    if update_gate is not None:
-        new_k = jnp.where(update_gate, new_k, cache_k)
-        new_v = jnp.where(update_gate, new_v, cache_v)
+    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     attn = attend(q, new_k, new_v, mask)
     x = x + attn.reshape(B, T, D) @ lp["wo"] + lp["bo"]
 

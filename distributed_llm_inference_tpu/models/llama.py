@@ -113,11 +113,7 @@ def decoder_layer(
     v = (h @ lp["wv"]).reshape(B, T, KV, Dh)
     q, k = apply_rope(q, k, cos, sin)
 
-    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos)
-    if update_gate is not None:
-        keep = update_gate
-        new_k = jnp.where(keep, new_k, cache_k)
-        new_v = jnp.where(keep, new_v, cache_v)
+    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     attn = attend(q, new_k, new_v, mask)
     x = x + attn.reshape(B, T, H * Dh) @ lp["wo"]
 

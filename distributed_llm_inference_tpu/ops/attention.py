@@ -26,6 +26,7 @@ def update_kv_cache(
     k_new: jnp.ndarray,
     v_new: jnp.ndarray,
     pos: jnp.ndarray,
+    gate=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Write the new K/V chunk at offset `pos` (scalar int32). Static shapes.
 
@@ -34,10 +35,23 @@ def update_kv_cache(
     misplace K/V relative to `causal_mask`'s absolute positions — the decode
     engine enforces the bound (engine/generate.py caps max_new_tokens by the
     cache capacity) so this never triggers in serving.
+
+    gate: optional traced bool — when False the write is a no-op. Used by
+    the pipeline runtime, where a stage executes speculatively on
+    microsteps when it holds no valid microbatch. Gating selects over the
+    written SLICE only (read-modify-write of [B,T,KV,Dh]), not the whole
+    cache — a whole-cache `where` would copy max_seq slots per layer per
+    microstep.
     """
     zero = jnp.int32(0)
-    cache_k = jax.lax.dynamic_update_slice(cache_k, k_new, (zero, pos, zero, zero))
-    cache_v = jax.lax.dynamic_update_slice(cache_v, v_new, (zero, pos, zero, zero))
+    start = (zero, pos, zero, zero)
+    if gate is not None:
+        old_k = jax.lax.dynamic_slice(cache_k, start, k_new.shape)
+        old_v = jax.lax.dynamic_slice(cache_v, start, v_new.shape)
+        k_new = jnp.where(gate, k_new, old_k)
+        v_new = jnp.where(gate, v_new, old_v)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k_new, start)
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v_new, start)
     return cache_k, cache_v
 
 

@@ -1,0 +1,42 @@
+"""Device-mesh construction.
+
+Replaces the reference's topology wiring — hand-pasted ngrok worker URLs
+(/root/reference/orchestration.py:22-24, Worker1.py:264) — with a
+`jax.sharding.Mesh` over the (dp, pp, tp) axes. Intra-pod stage hand-off
+rides ICI collectives inside one compiled program; multi-host pods extend
+the same mesh over DCN via `jax.distributed.initialize` (no code change:
+`jax.devices()` then spans all hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..config import MeshConfig
+
+AXIS_DP, AXIS_PP, AXIS_TP = "dp", "pp", "tp"
+
+
+def build_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    """(dp, pp, tp) mesh over the given (default: all) devices.
+
+    Device order: pp is the middle axis so consecutive devices form a
+    pipeline ring over ICI neighbours; tp is innermost (highest-bandwidth
+    neighbour exchanges for the per-layer psum).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = mesh_cfg.n_devices
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices (dp*pp*tp), have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(mesh_cfg.dp, mesh_cfg.pp, mesh_cfg.tp)
+    return Mesh(grid, (AXIS_DP, AXIS_PP, AXIS_TP))
+
+
+def multihost_initialize(**kwargs) -> None:
+    """Multi-host bring-up over DCN (the reference's 'paste three ngrok
+    URLs' bootstrap, replaced by jax.distributed coordination)."""
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,49 @@
+"""Top-level factory: model name + mesh shape -> ready InferenceEngine.
+
+The single entry point the serving layer / bench / client tooling use —
+the reference needed three hand-edited scripts and manual URL wiring to
+assemble the same topology (SURVEY.md §2 C10).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from .config import EngineConfig, MeshConfig, ModelConfig
+from .engine.engine import InferenceEngine, SingleDeviceBackend
+from .models import api as M
+from .models.registry import get_model_config
+from .parallel.mesh import build_mesh
+from .parallel.pipeline import PipelineBackend
+
+
+def create_engine(
+    model: str | ModelConfig = "tinyllama-1.1b",
+    *,
+    mesh_cfg: MeshConfig = MeshConfig(),
+    engine_cfg: EngineConfig = EngineConfig(),
+    params: Any = None,
+    dtype: Optional[str] = None,
+    tokenizer: Any = None,
+    seed: int = 0,
+) -> InferenceEngine:
+    """Build an engine; pp>1 selects the SPMD pipeline backend.
+
+    params=None random-initializes (offline bring-up / benchmarks);
+    pass a converted HF pytree (models/convert.py) for real weights.
+    """
+    cfg = get_model_config(model) if isinstance(model, str) else model
+    if dtype is not None:
+        cfg = cfg.replace(dtype=dtype)
+    if params is None:
+        params = M.init_params(cfg, jax.random.PRNGKey(seed))
+    if mesh_cfg.pp > 1 or mesh_cfg.dp > 1 or mesh_cfg.tp > 1:
+        mesh = build_mesh(mesh_cfg)
+        backend = PipelineBackend(cfg, params, mesh)
+    else:
+        backend = SingleDeviceBackend(cfg, params)
+    return InferenceEngine(
+        cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed
+    )

@@ -1,0 +1,126 @@
+"""Pipeline-parallel equivalence tests (SURVEY.md §4 item 3): N-stage
+pipelined logits/decodes must match the single-device model exactly, on the
+8-virtual-CPU-device mesh — the CI stand-in for a TPU pod."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import EngineConfig, MeshConfig, get_model_config
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine, SingleDeviceBackend
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+
+def _mk(cfg_name, pp, eight_devices):
+    cfg = get_model_config(cfg_name)
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=pp, tp=1), eight_devices)
+    return cfg, params, PipelineBackend(cfg, params, mesh)
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pipeline_prefill_logits_match_single_device(pp, eight_devices):
+    cfg, params, pb = _mk("test-llama-tiny", pp, eight_devices)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, cfg.vocab_size, size=11, dtype=np.int64).tolist()
+    bucket = 16
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(1)
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, logits_s, _ = G.prefill(cfg, params, tokens, plen, cache_s, key, sampling)
+
+    cache_p = pb.init_cache(1, 64)
+    f_p, logits_p, _ = pb.prefill(tokens, plen, cache_p, key, sampling)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=1e-4, atol=1e-5
+    )
+    assert int(f_p[0]) == int(f_s[0])
+
+
+@pytest.mark.parametrize("cfg_name", ["test-llama-tiny", "test-gpt2-tiny"])
+def test_pipeline_greedy_decode_matches_single_device(cfg_name, eight_devices):
+    """Full prefill+decode: 4-stage pipeline == single device, both families."""
+    cfg = get_model_config(cfg_name)
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=4, tp=1), eight_devices)
+    pb = PipelineBackend(cfg, params, mesh)
+
+    rng = np.random.default_rng(2)
+    ids = rng.integers(3, min(cfg.vocab_size, 250), size=7, dtype=np.int64).tolist()
+    bucket, steps = 16, 8
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(3)
+    kp, kd = jax.random.split(key)
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, _, cache_s = G.prefill(cfg, params, tokens, plen, cache_s, kp, sampling)
+    out_s, n_s, _ = G.decode(
+        cfg, params, f_s, cache_s, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    cache_p = pb.init_cache(1, 64)
+    f_p, _, cache_p = pb.prefill(tokens, plen, cache_p, kp, sampling)
+    out_p, n_p, _ = pb.decode(
+        f_p, cache_p, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    assert int(f_p[0]) == int(f_s[0])
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+    assert int(n_p[0]) == int(n_s[0])
+
+
+def test_engine_with_pipeline_backend(eight_devices):
+    """InferenceEngine over the pipeline backend: same response as over the
+    single-device backend for a seeded greedy request."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), eight_devices)
+
+    ecfg = EngineConfig(prefill_buckets=(32,))
+    eng_s = InferenceEngine(cfg, backend=SingleDeviceBackend(cfg, params), engine_cfg=ecfg)
+    eng_p = InferenceEngine(cfg, backend=PipelineBackend(cfg, params, mesh), engine_cfg=ecfg)
+
+    r_s = eng_s.generate("pipeline", max_tokens=6, greedy=True, chat=False, seed=5)
+    r_p = eng_p.generate("pipeline", max_tokens=6, greedy=True, chat=False, seed=5)
+    assert r_s["status"] == r_p["status"] == "success"
+    assert r_s["response"] == r_p["response"]
+    assert r_p["backend"] == "pipeline"
+
+    w = eng_p.workers()
+    assert w["total"] == 2
+    assert w["workers"]["stage_1"]["layers"] == [2, 3]
+
+
+def test_pipeline_sampled_decode_matches_single_device(eight_devices):
+    """Sampling path (temperature/top-k/top-p) must also agree: identical
+    keys and identical logits => identical draws."""
+    cfg, params, pb = _mk("test-llama-tiny", 2, eight_devices)
+    ids = [5, 9, 13]
+    bucket, steps = 16, 8
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(temperature=0.9, top_k=20, top_p=0.95)
+    kp, kd = jax.random.split(jax.random.PRNGKey(11))
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, _, cache_s = G.prefill(cfg, params, tokens, plen, cache_s, kp, sampling)
+    out_s, _, _ = G.decode(
+        cfg, params, f_s, cache_s, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    cache_p = pb.init_cache(1, 64)
+    f_p, _, cache_p = pb.prefill(tokens, plen, cache_p, kp, sampling)
+    out_p, _, _ = pb.decode(
+        f_p, cache_p, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    assert int(f_p[0]) == int(f_s[0])
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
