@@ -1,0 +1,143 @@
+"""Client library + interactive CLI (reference L5, /root/reference/Test.py).
+
+Same flow as DistributedLLMClient: health check, worker sweep, generate
+with perf-stat printing (Test.py:83-88), an interactive chat REPL with
+`workers`/`health`/`quit` commands (Test.py:105-144), and a 3-option menu
+(Test.py:147-188). stdlib urllib only — no requests dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+
+class DistributedLLMClient:
+    def __init__(self, base_url: str = "http://127.0.0.1:5000", timeout: float = 200.0):
+        # 200 s default mirrors Test.py:71's request timeout; a TPU backend
+        # answers in milliseconds-to-seconds, but slow cold compiles exist.
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str, timeout: Optional[float] = None) -> dict:
+        with urllib.request.urlopen(
+            f"{self.base_url}{path}", timeout=timeout or self.timeout
+        ) as r:
+            return json.loads(r.read())
+
+    def _post(self, path: str, payload: dict, timeout: Optional[float] = None) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return json.loads(e.read())
+            except Exception:
+                return {"error": str(e), "status": "failed"}
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            # connection refused / timeout: error envelope, not a traceback
+            # (keeps the interactive REPL alive across server restarts)
+            return {"error": f"connection failed: {e}", "status": "failed"}
+
+    # -- reference-parity surface (Test.py:18-103) --------------------------
+    def check_health(self) -> dict:
+        """Orchestrator liveness (Test.py:18-33)."""
+        try:
+            return self._get("/health", timeout=5)
+        except Exception as e:
+            return {"status": "offline", "error": str(e)}
+
+    def check_workers(self) -> dict:
+        """Per-stage health sweep (Test.py:35-52)."""
+        try:
+            return self._get("/workers", timeout=5)
+        except Exception as e:
+            return {"error": str(e)}
+
+    def generate(
+        self,
+        prompt: str,
+        max_tokens: int = 20,
+        temperature: float = 0.7,
+        verbose: bool = True,
+        **kw: Any,
+    ) -> dict:
+        """Generate + print perf stats (Test.py:54-103)."""
+        result = self._post(
+            "/generate",
+            {"prompt": prompt, "max_tokens": max_tokens, "temperature": temperature, **kw},
+        )
+        if verbose:
+            if result.get("status") == "success":
+                print(f"\n🤖 Response: {result.get('response', '')}")
+                print(
+                    f"   ⏱  {result.get('time_taken')} | "
+                    f"{result.get('tokens_generated')} tokens | "
+                    f"{result.get('tokens_per_sec')} tok/s | "
+                    f"TTFT {result.get('ttft_s')}s"
+                )
+            else:
+                print(f"\n❌ {result.get('error', 'unknown error')}")
+        return result
+
+    # -- interactive REPL (Test.py:105-144) ---------------------------------
+    def interactive_chat(self):
+        print("\n💬 Interactive chat — 'workers', 'health', or 'quit'")
+        while True:
+            try:
+                line = input("\nYou: ").strip()
+            except (EOFError, KeyboardInterrupt):
+                break
+            if not line:
+                continue
+            if line.lower() in ("quit", "exit"):
+                break
+            if line.lower() == "workers":
+                print(json.dumps(self.check_workers(), indent=2, default=str))
+                continue
+            if line.lower() == "health":
+                print(json.dumps(self.check_health(), indent=2))
+                continue
+            self.generate(line, max_tokens=15)
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(description="distributed_llm_inference_tpu client")
+    ap.add_argument("--url", default="http://127.0.0.1:5000")
+    ap.add_argument("--prompt", default=None, help="one-shot prompt (skips menu)")
+    ap.add_argument("--max-tokens", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    client = DistributedLLMClient(args.url)
+    if args.prompt is not None:
+        client.generate(args.prompt, max_tokens=args.max_tokens)
+        return
+
+    # 3-option menu (Test.py:147-188)
+    print("1) single prompt  2) interactive chat  3) quick test")
+    try:
+        choice = input("choice: ").strip()
+    except (EOFError, KeyboardInterrupt):
+        return
+    if choice == "1":
+        prompt = input("prompt: ").strip()
+        client.generate(prompt, max_tokens=args.max_tokens)
+    elif choice == "2":
+        client.interactive_chat()
+    else:
+        print("health:", json.dumps(client.check_health()))
+        print("workers:", json.dumps(client.check_workers(), default=str))
+        client.generate("Hello", max_tokens=15)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,15 @@ def create_engine(
         cfg = cfg.replace(dtype=dtype)
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
-    if mesh_cfg.pp > 1 or mesh_cfg.dp > 1 or mesh_cfg.tp > 1:
+    if mesh_cfg.dp > 1 or mesh_cfg.tp > 1:
+        # dp/tp execution lands with parallel.schedule (microbatched dp) and
+        # the tp psum wiring; silently replicating compute across those axes
+        # would burn devices for nothing.
+        raise NotImplementedError(
+            "dp/tp mesh axes are not wired up yet — use pp=N for pipeline "
+            "parallelism"
+        )
+    if mesh_cfg.pp > 1:
         mesh = build_mesh(mesh_cfg)
         backend = PipelineBackend(cfg, params, mesh)
     else:

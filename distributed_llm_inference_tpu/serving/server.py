@@ -1,0 +1,208 @@
+"""HTTP serving surface (reference L4).
+
+Same API shape as the reference's Flask app
+(/root/reference/orchestration.py:231-356): `POST /generate` (prompt,
+max_tokens default 20 clamped to a cap, temperature default 0.7; top_k=50 /
+top_p=0.9 defaults), `GET /health`, `GET /workers`, `GET /` HTML status page
+— but on the stdlib ThreadingHTTPServer (no Flask/ngrok dependency), and
+`/workers` reports pipeline-stage health from the mesh instead of polling
+remote Flask processes over HTTP (the stages live inside this process's
+compiled program; there is no remote worker to poll — that is the point).
+
+HTTP survives only at this serving edge; it never sits between stages.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+__version__ = "tpu_pipeline_v1"
+
+# Reference defaults: orchestration.py:339-347 (max_tokens default 20, cap
+# 30) and 353-354 (top_k 50, top_p 0.9). The cap is configurable here.
+DEFAULT_MAX_TOKENS = 20
+DEFAULT_TEMPERATURE = 0.7
+DEFAULT_TOP_K = 50
+DEFAULT_TOP_P = 0.9
+
+
+def _parse_bool(v, name: str) -> bool:
+    """Strict JSON-ish bool: bool(\"false\") is True, which would silently
+    invert the caller's intent — reject non-bool junk with a 400 instead."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, str):
+        low = v.strip().lower()
+        if low in ("true", "1", "yes"):
+            return True
+        if low in ("false", "0", "no"):
+            return False
+    raise ValueError(f"{name} must be a boolean, got {v!r}")
+
+
+def _status_html(engine) -> str:
+    h = engine.health()
+    stages = engine.backend.health()
+    rows = "".join(
+        f"<tr><td>stage {s['stage']}</td><td>{', '.join(s['devices'])}</td>"
+        f"<td>{s.get('layers', '-')}</td><td>{s['status']}</td></tr>"
+        for s in stages
+    )
+    return f"""<html><head><title>distributed_llm_inference_tpu</title></head>
+<body style="font-family: monospace; margin: 2em;">
+<h1>distributed_llm_inference_tpu — orchestrator</h1>
+<p>status: <b>{h['status']}</b> | model: <b>{h['model']}</b> |
+backend: <b>{h['backend']}</b> | stages: <b>{h['n_stages']}</b> |
+requests served: <b>{h['requests_served']}</b></p>
+<table border="1" cellpadding="4">
+<tr><th>stage</th><th>devices</th><th>layers</th><th>status</th></tr>
+{rows}
+</table>
+<p>POST /generate {{"prompt": ..., "max_tokens": ..., "temperature": ...}}
+| GET /health | GET /workers</p>
+</body></html>"""
+
+
+def make_handler(engine, max_tokens_cap: int):
+    class Handler(BaseHTTPRequestHandler):
+        # quiet default request logging; serving logs are structured
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, code: int, payload: Any, content_type="application/json"):
+            body = (
+                payload.encode()
+                if isinstance(payload, str)
+                else json.dumps(payload).encode()
+            )
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path.split("?")[0].rstrip("/") or "/"
+            if path == "/":
+                self._send(200, _status_html(engine), content_type="text/html")
+            elif path == "/health":
+                h = engine.health()
+                # reference shape: status/role/model/version
+                # (orchestration.py:297-304) + our backend detail
+                self._send(
+                    200,
+                    {
+                        "status": h["status"],
+                        "role": "orchestrator",
+                        "model": h["model"],
+                        "version": __version__,
+                        "backend": h["backend"],
+                        "n_stages": h["n_stages"],
+                        "requests_served": h["requests_served"],
+                    },
+                )
+            elif path == "/workers":
+                stages = engine.backend.health()
+                # reference shape: {"worker_1": "online", ...}
+                # (orchestration.py:306-329); stages are in-process mesh
+                # slices, so liveness == device presence
+                results = {
+                    f"worker_{s['stage'] + 1}": s["status"] for s in stages
+                }
+                results["detail"] = stages
+                self._send(200, results)
+            else:
+                self._send(404, {"error": f"no route {path}"})
+
+        def do_POST(self):
+            path = self.path.split("?")[0].rstrip("/")
+            if path != "/generate":
+                self._send(404, {"error": f"no route {path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                data = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._send(400, {"error": "invalid JSON body"})
+                return
+            prompt = data.get("prompt", "")
+            if not prompt:
+                # reference: 400 "No prompt provided" (orchestration.py:343)
+                self._send(400, {"error": "No prompt provided"})
+                return
+            try:
+                max_tokens = min(int(data.get("max_tokens", DEFAULT_MAX_TOKENS)), max_tokens_cap)
+                seed = data.get("seed")
+                result = engine.generate(
+                    prompt,
+                    max_tokens=max_tokens,
+                    temperature=float(data.get("temperature", DEFAULT_TEMPERATURE)),
+                    top_k=int(data.get("top_k", DEFAULT_TOP_K)),
+                    top_p=float(data.get("top_p", DEFAULT_TOP_P)),
+                    greedy=_parse_bool(data.get("greedy", False), "greedy"),
+                    chat=_parse_bool(data.get("chat", True), "chat"),
+                    seed=int(seed) if seed is not None else None,
+                )
+            except (TypeError, ValueError) as e:
+                self._send(400, {"error": f"bad parameter: {e}"})
+                return
+            code = 200 if result.get("status") == "success" else 500
+            self._send(code, result)
+
+    return Handler
+
+
+class InferenceServer:
+    """Owns the HTTP server + engine; start()/shutdown() for embedding in
+    tests, serve_forever() for the CLI."""
+
+    def __init__(self, engine, host: str = "0.0.0.0", port: int = 5000, max_tokens_cap: int = 30):
+        self.engine = engine
+        self.httpd = ThreadingHTTPServer((host, port), make_handler(engine, max_tokens_cap))
+        self.port = self.httpd.server_address[1]
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def serve_forever(self):
+        print(f"🚀 serving on :{self.port} — /generate /health /workers /")
+        self.httpd.serve_forever()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv: Optional[list] = None):
+    from ..config import EngineConfig, MeshConfig
+    from ..runtime import create_engine
+
+    ap = argparse.ArgumentParser(description="distributed_llm_inference_tpu server")
+    ap.add_argument("--model", default="tinyllama-1.1b")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
+    ap.add_argument("--max-tokens-cap", type=int, default=30)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    engine = create_engine(
+        args.model,
+        mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, tp=args.tp),
+        dtype=args.dtype,
+        seed=args.seed,
+    )
+    InferenceServer(engine, args.host, args.port, args.max_tokens_cap).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""End-to-end serving tests (SURVEY.md §4 item 4): the reference client's
+flow — health → workers → generate — against a locally served engine, over
+real HTTP."""
+
+import json
+import urllib.request
+
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, MeshConfig, create_engine
+from distributed_llm_inference_tpu.client import DistributedLLMClient
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def served():
+    engine = create_engine(
+        "test-llama-tiny",
+        mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(prefill_buckets=(64, 128)),
+    )
+    server = InferenceServer(engine, host="127.0.0.1", port=0)  # ephemeral port
+    server.start()
+    yield server
+    server.shutdown()
+
+
+@pytest.fixture()
+def client(served):
+    return DistributedLLMClient(f"http://127.0.0.1:{served.port}")
+
+
+def test_health(client):
+    h = client.check_health()
+    assert h["status"] == "healthy"
+    assert h["role"] == "orchestrator"
+    assert h["n_stages"] == 2
+
+
+def test_workers_sweep(client):
+    w = client.check_workers()
+    # reference shape: worker_N -> online (orchestration.py:306-329)
+    assert w["worker_1"] == "online"
+    assert w["worker_2"] == "online"
+    assert len(w["detail"]) == 2
+
+
+def test_generate_over_http(client):
+    r = client.generate("Hello over HTTP", max_tokens=6, verbose=False, seed=0)
+    assert r["status"] == "success"
+    for k in ("response", "time_taken", "tokens_generated", "tokens_per_sec"):
+        assert k in r
+    assert r["tokens_generated"] <= 6
+
+
+def test_generate_missing_prompt_is_400(served):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served.port}/generate",
+        data=json.dumps({"max_tokens": 5}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"] == "No prompt provided"
+
+
+def test_generate_invalid_json_is_400(served):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served.port}/generate",
+        data=b"{not json",
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_max_tokens_capped_at_30(client):
+    # reference clamps to 30 (orchestration.py:347)
+    r = client.generate("cap", max_tokens=500, verbose=False, chat=False)
+    assert r["status"] == "success"
+    assert r["tokens_generated"] <= 30
+
+
+def test_unknown_route_404(served):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"http://127.0.0.1:{served.port}/nope", timeout=10)
+    assert ei.value.code == 404
+
+
+def test_status_page_html(served):
+    with urllib.request.urlopen(f"http://127.0.0.1:{served.port}/", timeout=10) as r:
+        body = r.read().decode()
+    assert "orchestrator" in body and "stage 1" in body
+
+
+def test_bad_seed_and_bool_are_400(served):
+    for payload in (
+        {"prompt": "x", "seed": "lots"},
+        {"prompt": "x", "greedy": "maybe"},
+        {"prompt": "x", "chat": 3.5},
+    ):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{served.port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400, payload
+
+
+def test_stringy_bools_accepted(client):
+    r = client.generate("x", max_tokens=3, verbose=False, greedy="true", chat="false")
+    assert r["status"] == "success"
+
+
+def test_client_connection_refused_envelope():
+    from distributed_llm_inference_tpu.client import DistributedLLMClient
+
+    c = DistributedLLMClient("http://127.0.0.1:1", timeout=2)
+    r = c.generate("x", verbose=False)
+    assert r["status"] == "failed" and "connection failed" in r["error"]
+
+
+def test_bad_param_type_is_400(served):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served.port}/generate",
+        data=json.dumps({"prompt": "x", "max_tokens": "many"}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
