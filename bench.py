@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Headline benchmark: TinyLlama-1.1B autoregressive decode throughput.
+
+Apples-to-apples with the reference's own observed number on the same
+model (`TinyLlama/TinyLlama-1.1B-Chat-v1.0`): ~0.12-0.2 tokens/sec end to
+end across 3 Colab CPU VMs with no KV cache and 4 JSON-over-WAN activation
+transfers per token (/root/reference/Test.py:61, orchestration.py:202).
+Baseline pinned at the midpoint, 0.16 tok/s.
+
+Here the same architecture runs as one jit-compiled program on one TPU
+chip: bf16 params in HBM, prefill in a single call, decode as an on-device
+while-loop with a donated KV cache. Weights are random-init (zero network
+egress; throughput is weight-value independent).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_TOK_S = 0.16  # midpoint of the reference's 0.12-0.2 tok/s
+PROMPT_LEN = 128
+DECODE_STEPS = 64
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    return time.perf_counter() - t0, out
+
+
+def main():
+    from distributed_llm_inference_tpu.engine import generate as G
+    from distributed_llm_inference_tpu.models import api as M
+    from distributed_llm_inference_tpu.models.registry import get_model_config
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    # eos_token_id=-1: no token id can match, so the decode loop never
+    # early-exits — every run measures exactly DECODE_STEPS steps.
+    cfg = get_model_config(
+        "tinyllama-1.1b",
+        dtype="bfloat16" if on_tpu else "float32",
+        eos_token_id=-1,
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+
+    tokens = jnp.asarray(
+        [[cfg.bos_token_id] + [7] * (PROMPT_LEN - 1)], jnp.int32
+    )
+    plen = jnp.int32(PROMPT_LEN)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(1))
+    limit = jnp.int32(DECODE_STEPS)
+
+    import numpy as np
+
+    # Under the axon TPU tunnel, jax.block_until_ready returns immediately;
+    # only a device->host fetch waits for the compute queue. The fetch has a
+    # fixed tunnel round-trip (~70 ms), so: time K back-to-back device calls
+    # ending in one scalar fetch, subtract the separately-measured RTT, and
+    # divide by K. (On a local backend RTT measures ~0 and this is exact.)
+    def fetch(x):
+        return np.asarray(x)
+
+    trivial = jax.jit(lambda x: x + 1)
+    fetch(trivial(jnp.float32(0)))  # warm
+    rtt = min(
+        _timed(lambda: fetch(trivial(jnp.float32(i))))[0] for i in range(5)
+    )
+
+    # warm-up: compile prefill + decode, drain the queue
+    cache = M.init_kv_cache(cfg, 1, max_seq=512)
+    first, _, cache = G.prefill(cfg, params, tokens, plen, cache, kp, sampling)
+    out, n_gen, cache = G.decode(
+        cfg, params, first, cache, plen, limit, kd, sampling,
+        max_steps=DECODE_STEPS,
+    )
+    fetch(n_gen)
+
+    # TTFT: one prefill (cache re-init enqueued first), scalar-fetch the token
+    def prefill_once():
+        c = M.init_kv_cache(cfg, 1, max_seq=512)
+        f, _, c = G.prefill(cfg, params, tokens, plen, c, kp, sampling)
+        fetch(f)
+
+    ttft = max(min(_timed(prefill_once)[0] for _ in range(3)) - rtt, 0.0)
+
+    # decode throughput: K chained decode calls (donated cache threaded
+    # through), one scalar fetch at the end
+    K = 4
+
+    def decode_k():
+        nonlocal cache
+        for _ in range(K):
+            out, n_gen, cache = G.decode(
+                cfg, params, first, cache, plen, limit, kd, sampling,
+                max_steps=DECODE_STEPS,
+            )
+        fetch(n_gen)
+
+    decode_s = max(min(_timed(decode_k)[0] for _ in range(3)) - rtt, 1e-9) / K
+    tok_s = DECODE_STEPS / decode_s
+    result = {
+        "metric": "tinyllama_1.1b_decode_throughput",
+        "value": round(tok_s, 3),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tok_s / REFERENCE_TOK_S, 1),
+        "ttft_s": round(ttft, 4),
+        "prompt_len": PROMPT_LEN,
+        "decode_steps": DECODE_STEPS,
+        "platform": platform,
+        "dtype": cfg.dtype,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,11 @@ class InferenceEngine:
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start,
                 )
+        except ValueError as e:
+            # caller-caused (e.g. prompt longer than the largest prefill
+            # bucket): tagged so the serving edge can answer 400, not 500
+            return {"error": f"Error: {e}", "status": "failed",
+                    "error_type": "invalid_request"}
         except Exception as e:  # error envelope (orchestration.py:220-228)
             return {"error": f"Error: {e}", "status": "failed"}
 

@@ -106,10 +106,11 @@ def make_handler(engine, max_tokens_cap: int):
                     },
                 )
             elif path == "/workers":
-                stages = engine.backend.health()
                 # reference shape: {"worker_1": "online", ...}
                 # (orchestration.py:306-329); stages are in-process mesh
-                # slices, so liveness == device presence
+                # slices, so liveness == device presence. Single source:
+                # engine.workers(), re-keyed to the reference's 1-based names.
+                stages = list(engine.workers()["workers"].values())
                 results = {
                     f"worker_{s['stage'] + 1}": s["status"] for s in stages
                 }
@@ -150,7 +151,12 @@ def make_handler(engine, max_tokens_cap: int):
             except (TypeError, ValueError) as e:
                 self._send(400, {"error": f"bad parameter: {e}"})
                 return
-            code = 200 if result.get("status") == "success" else 500
+            if result.get("status") == "success":
+                code = 200
+            elif result.get("error_type") == "invalid_request":
+                code = 400
+            else:
+                code = 500
             self._send(code, result)
 
     return Handler
