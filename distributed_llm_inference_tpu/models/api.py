@@ -30,8 +30,9 @@ def embed(cfg, params, tokens, pos=0):
     return family(cfg).embed(cfg, params, tokens, pos)
 
 
-def forward_layers(cfg, layers, x, cache, pos, update_gate=None):
-    return family(cfg).forward_layers(cfg, layers, x, cache, pos, update_gate)
+def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
+    return family(cfg).forward_layers(cfg, layers, x, cache, pos, update_gate,
+                                      tp_axis)
 
 
 def unembed(cfg, params, x):

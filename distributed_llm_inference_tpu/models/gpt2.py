@@ -82,10 +82,19 @@ def init_kv_cache(
     return _llama_init_kv_cache(cfg, batch, max_seq=max_seq, n_layers=n_layers)
 
 
-def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None):
-    """One GPT-2 block on chunk x [B,T,D] at offset pos."""
+def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
+                  tp_axis=None):
+    """One GPT-2 block on chunk x [B,T,D] at offset pos.
+
+    Tensor parallelism mirrors models/llama.py: head-sliced qkv shards
+    (with their per-output-column biases bq/bk/bv sharded alongside),
+    row-sharded wo/w_proj partial outputs psummed over `tp_axis`; the
+    row-projection biases bo/b_proj are replicated and added once, OUTSIDE
+    the psum (inside it they'd be added tp times).
+    """
     B, T, D = x.shape
-    H, Dh = cfg.n_heads, cfg.head_dim
+    Dh = cfg.head_dim
+    H = lp["wq"].shape[-1] // Dh
 
     h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
     q = (h @ lp["wq"] + lp["bq"]).reshape(B, T, H, Dh)
@@ -94,14 +103,20 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None):
 
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     attn = attend(q, new_k, new_v, mask)
-    x = x + attn.reshape(B, T, D) @ lp["wo"] + lp["bo"]
+    attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
+    if tp_axis is not None:
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    x = x + attn_out + lp["bo"]
 
     h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
-    x = x + gelu_new(h @ lp["w_fc"] + lp["b_fc"]) @ lp["w_proj"] + lp["b_proj"]
+    mlp_out = gelu_new(h @ lp["w_fc"] + lp["b_fc"]) @ lp["w_proj"]
+    if tp_axis is not None:
+        mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    x = x + mlp_out + lp["b_proj"]
     return x, new_k, new_v
 
 
-def forward_layers(cfg, layers, x, cache, pos, update_gate=None):
+def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
     """Scan the stacked GPT-2 blocks over a chunk (any contiguous slice)."""
     T = x.shape[1]
     S = cache["k"].shape[2]
@@ -110,7 +125,8 @@ def forward_layers(cfg, layers, x, cache, pos, update_gate=None):
     def body(carry, xs):
         xc = carry
         lp, ck, cv = xs
-        xc, ck, cv = decoder_layer(cfg, lp, xc, ck, cv, pos, mask, update_gate)
+        xc, ck, cv = decoder_layer(cfg, lp, xc, ck, cv, pos, mask, update_gate,
+                                   tp_axis)
         return xc, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))

@@ -96,6 +96,7 @@ def decoder_layer(
     sin: jnp.ndarray,
     mask: jnp.ndarray,
     update_gate: Optional[jnp.ndarray] = None,
+    tp_axis: Optional[str] = None,
 ):
     """One pre-norm decoder block on a chunk x [B,T,D] at offset `pos`.
 
@@ -103,9 +104,18 @@ def decoder_layer(
     update_gate: optional traced bool — when False the cache write is
     discarded (needed by the pipeline runtime, where a stage executes
     speculatively on microsteps when it holds no valid microbatch).
+
+    Tensor parallelism (Megatron-style): under `shard_map` with a `tp` mesh
+    axis, lp holds the HEAD-SLICED shard (wq/wk/wv column-sharded over
+    heads, wo row-sharded; w_gate/w_up column-, w_down row-sharded) and
+    `tp_axis` names the axis — head counts are derived from the local param
+    shapes, and the two row-sharded projections psum their partial outputs
+    before the residual add, keeping activations replicated over tp.
     """
     B, T, D = x.shape
-    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    Dh = cfg.head_dim  # invariant under tp (heads shard, head_dim doesn't)
+    H = lp["wq"].shape[-1] // Dh
+    KV = lp["wk"].shape[-1] // Dh
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     q = (h @ lp["wq"]).reshape(B, T, H, Dh)
@@ -115,11 +125,17 @@ def decoder_layer(
 
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     attn = attend(q, new_k, new_v, mask)
-    x = x + attn.reshape(B, T, H * Dh) @ lp["wo"]
+    attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
+    if tp_axis is not None:
+        attn_out = jax.lax.psum(attn_out, tp_axis)
+    x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
     gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    if tp_axis is not None:
+        mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    x = x + mlp_out
     return x, new_k, new_v
 
 
@@ -130,6 +146,7 @@ def forward_layers(
     cache: KVCache,
     pos: jnp.ndarray,
     update_gate: Optional[jnp.ndarray] = None,
+    tp_axis: Optional[str] = None,
 ):
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
@@ -147,7 +164,7 @@ def forward_layers(
         xc = carry
         lp, ck, cv = xs
         xc, ck, cv = decoder_layer(
-            cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate
+            cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate, tp_axis
         )
         return xc, (ck, cv)
 

@@ -25,10 +25,9 @@ def build_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh
     """(dp, pp, tp) mesh over the given (default: all) devices.
 
     Device order: pp is the middle axis so consecutive devices form a
-    pipeline ring over ICI neighbours; tp is innermost so that when tensor
-    parallelism lands its per-layer collectives ride the highest-bandwidth
-    neighbour links. (Execution over dp/tp is not wired up yet —
-    runtime.create_engine rejects dp>1/tp>1.)
+    pipeline ring over ICI neighbours; tp is innermost so its per-layer
+    psums ride the highest-bandwidth neighbour links. All three axes
+    execute (parallel/pipeline.PipelineBackend); dp>1 needs batch % dp == 0.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     need = mesh_cfg.n_devices

@@ -1,12 +1,15 @@
-"""Parameter / KV-cache partitioning over the mesh.
+"""Parameter / KV-cache partitioning over the (dp, pp, tp) mesh.
 
 The reference partitions by hand: each worker downloads the full model and
 keeps `layers[LAYER_START:LAYER_END]` (plus, accidentally, the whole model
 — /root/reference/Worker1.py:68-75). Here partitioning is a sharding
-annotation: stacked layer params [L, ...] and the stacked KV cache
-[L, B, S, KV, Dh] shard their leading layer axis over `pp` (a stage's
-"layer range" is just its shard), embeddings/head replicate across `pp`,
-and XLA moves exactly one stage's weights to each device.
+annotation: stacked layer params [L, ...] shard their leading layer axis
+over `pp` (a stage's "layer range" is just its shard), and within a stage
+the Megatron-style tensor split shards attention heads and FFN columns over
+`tp` (column-sharded wq/wk/wv/w_gate/w_up, row-sharded wo/w_down — the psum
+pairing lives in models/*.decoder_layer). Embeddings/head replicate; the
+KV cache [L, B, S, KV, Dh] shards layers over pp, batch over dp, and kv
+heads over tp. XLA moves exactly one shard's weights to each device.
 """
 
 from __future__ import annotations
@@ -17,20 +20,72 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig
 from ..models import api as M
-from .mesh import AXIS_PP
+from .mesh import AXIS_DP, AXIS_PP, AXIS_TP
+
+# Per-leaf PartitionSpecs for the stacked layer params (leading axis = layer
+# axis, always sharded over pp). Column-sharded leaves put tp on the output
+# dim; row-sharded leaves put tp on the input (contraction) dim and rely on
+# the model's psum. Norm weights and row-projection biases replicate over tp.
+_LLAMA_LAYER_SPECS = {
+    "attn_norm": P(AXIS_PP),
+    "mlp_norm": P(AXIS_PP),
+    "wq": P(AXIS_PP, None, AXIS_TP),
+    "wk": P(AXIS_PP, None, AXIS_TP),
+    "wv": P(AXIS_PP, None, AXIS_TP),
+    "wo": P(AXIS_PP, AXIS_TP, None),
+    "w_gate": P(AXIS_PP, None, AXIS_TP),
+    "w_up": P(AXIS_PP, None, AXIS_TP),
+    "w_down": P(AXIS_PP, AXIS_TP, None),
+}
+
+_GPT2_LAYER_SPECS = {
+    "ln1_w": P(AXIS_PP),
+    "ln1_b": P(AXIS_PP),
+    "ln2_w": P(AXIS_PP),
+    "ln2_b": P(AXIS_PP),
+    "wq": P(AXIS_PP, None, AXIS_TP),
+    "wk": P(AXIS_PP, None, AXIS_TP),
+    "wv": P(AXIS_PP, None, AXIS_TP),
+    "bq": P(AXIS_PP, AXIS_TP),
+    "bk": P(AXIS_PP, AXIS_TP),
+    "bv": P(AXIS_PP, AXIS_TP),
+    "wo": P(AXIS_PP, AXIS_TP, None),
+    "bo": P(AXIS_PP),
+    "w_fc": P(AXIS_PP, None, AXIS_TP),
+    "b_fc": P(AXIS_PP, AXIS_TP),
+    "w_proj": P(AXIS_PP, AXIS_TP, None),
+    "b_proj": P(AXIS_PP),
+}
+
+_FAMILY_LAYER_SPECS = {"llama": _LLAMA_LAYER_SPECS, "gpt2": _GPT2_LAYER_SPECS}
+
+
+def validate_mesh(cfg: ModelConfig, pp: int, tp: int) -> None:
+    """Divisibility invariants for a (pp, tp) factorization of the model."""
+    if cfg.n_layers % pp != 0:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if cfg.n_heads % tp != 0:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    if cfg.n_kv_heads % tp != 0:
+        raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
+    if cfg.ffn_dim % tp != 0:
+        raise ValueError(f"ffn_dim={cfg.ffn_dim} not divisible by tp={tp}")
 
 
 def split_params(params: dict) -> tuple[dict, dict]:
-    """(shared, layers): shared = embeddings/final-norm/head (replicated
-    over pp), layers = stacked per-layer stacks (sharded over pp)."""
+    """(shared, layers): shared = embeddings/final-norm/head (replicated),
+    layers = stacked per-layer stacks (sharded over pp × tp)."""
     shared = {k: v for k, v in params.items() if k != "layers"}
     return shared, params["layers"]
 
 
-def layer_specs(layers: dict) -> dict:
-    """PartitionSpec pytree for the stacked layer params: shard axis 0
-    (the layer axis) over pp, replicate everything else."""
-    return jax.tree.map(lambda x: P(AXIS_PP), layers)
+def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
+    """PartitionSpec pytree for the stacked layer params."""
+    specs = _FAMILY_LAYER_SPECS[cfg.arch]
+    missing = set(layers) - set(specs)
+    if missing:
+        raise KeyError(f"no partition spec for layer params: {sorted(missing)}")
+    return {k: specs[k] for k in layers}
 
 
 def shared_specs(shared: dict) -> dict:
@@ -38,29 +93,34 @@ def shared_specs(shared: dict) -> dict:
 
 
 def cache_spec() -> P:
-    """KV cache [L, B, S, KV, Dh]: layer axis over pp."""
-    return P(AXIS_PP)
+    """KV cache [L, B, S, KV, Dh]: layers over pp, batch over dp, kv heads
+    over tp."""
+    return P(AXIS_PP, AXIS_DP, None, AXIS_TP, None)
 
 
 def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict]:
-    """Place (shared, layers) on the mesh. Requires n_layers % pp == 0
-    (config.stage_layer_range enforces the same invariant)."""
-    pp = mesh.shape[AXIS_PP]
-    if cfg.n_layers % pp != 0:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    """Place (shared, layers) on the mesh."""
+    validate_mesh(cfg, int(mesh.shape[AXIS_PP]), int(mesh.shape[AXIS_TP]))
     shared, layers = split_params(params)
     shared = jax.device_put(
         shared, jax.tree.map(lambda s: NamedSharding(mesh, s), shared_specs(shared))
     )
     layers = jax.device_put(
-        layers, jax.tree.map(lambda s: NamedSharding(mesh, s), layer_specs(layers))
+        layers,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), layer_specs(cfg, layers),
+            is_leaf=lambda x: isinstance(x, P),
+        ),
     )
     return shared, layers
 
 
 def init_sharded_cache(cfg: ModelConfig, mesh: Mesh, batch: int, max_seq: int):
-    """Zeroed KV cache sharded over pp along the stacked layer axis,
-    allocated shard-local (no full-size host materialization)."""
+    """Zeroed KV cache sharded per cache_spec(), allocated shard-local (no
+    full-size host materialization)."""
+    dp = int(mesh.shape[AXIS_DP])
+    if batch % dp != 0:
+        raise ValueError(f"batch={batch} not divisible by dp={dp}")
     sharding = NamedSharding(mesh, cache_spec())
 
     @jax.jit

@@ -37,8 +37,8 @@ from ..config import ModelConfig
 from ..engine.generate import SamplingParams
 from ..models import api as M
 from ..ops.sampling import sample_token
-from .mesh import AXIS_PP
-from .partition import init_sharded_cache, shard_params
+from .mesh import AXIS_DP, AXIS_PP, AXIS_TP
+from .partition import cache_spec, init_sharded_cache, layer_specs, shard_params
 
 
 def _ring_perm(S: int):
@@ -46,12 +46,17 @@ def _ring_perm(S: int):
 
 
 class PipelineBackend:
-    """Engine-compatible backend running pp stages over a mesh.
+    """Engine-compatible backend running (dp, pp, tp) SPMD over a mesh.
 
     Drop-in for SingleDeviceBackend (same init_cache/prefill/decode/health
     interface), so InferenceEngine and the serving layer are topology-
     agnostic — the reference needed three differently-coded processes for
     the same job (orchestration.py vs Worker1.py vs Worker2.py).
+
+    Axes: `pp` stages hand activations around the ICI ring; `tp` shards
+    heads/FFN within a stage (psums inside models/*.decoder_layer); `dp`
+    shards the batch — each dp slice is an independent pipeline ring (its
+    while-loop may even exit at a different step; no collective crosses dp).
     """
 
     name = "pipeline"
@@ -59,13 +64,13 @@ class PipelineBackend:
     def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
         self.cfg = cfg
         self.mesh = mesh
+        self.dp = int(mesh.shape.get(AXIS_DP, 1))
         self.pp = int(mesh.shape[AXIS_PP])
+        self.tp = int(mesh.shape.get(AXIS_TP, 1))
         self.n_stages = self.pp
-        if cfg.n_layers % self.pp != 0:
-            raise ValueError(
-                f"n_layers={cfg.n_layers} not divisible by pp={self.pp}"
-            )
+        self.tp_axis = AXIS_TP if self.tp > 1 else None
         self.shared, self.layers = shard_params(cfg, params, mesh)
+        self._layer_specs = layer_specs(cfg, self.layers)
         self._shard = functools.partial(
             jax.shard_map, mesh=mesh, check_vma=False
         )
@@ -114,6 +119,13 @@ class PipelineBackend:
         return out
 
     # -- compiled programs --------------------------------------------------
+    def _dp_key(self, key):
+        """Decorrelate sampling across dp batch shards. dp=1 keeps the key
+        untouched so the pipeline stays bit-identical to single-device."""
+        if self.dp == 1:
+            return key
+        return jax.random.fold_in(key, jax.lax.axis_index(AXIS_DP))
+
     def _microstep_loop(self, layers, x, cache, pos):
         """S microsteps of (apply local stage, ring-shift). Returns the
         final-stage output (landed on stage 0 by the last shift) + cache."""
@@ -125,7 +137,8 @@ class PipelineBackend:
             buf, cache = carry
             gate = i == s
             y, cache = M.forward_layers(
-                cfg, layers, buf, cache, pos, update_gate=gate
+                cfg, layers, buf, cache, pos, update_gate=gate,
+                tp_axis=self.tp_axis,
             )
             buf = jax.lax.ppermute(y, AXIS_PP, perm)
             return buf, cache
@@ -137,6 +150,7 @@ class PipelineBackend:
 
         def body(shared, layers, tokens, prompt_len, cache, key, sampling):
             s = jax.lax.axis_index(AXIS_PP)
+            key = self._dp_key(key)
             x = M.embed(cfg, shared, tokens, jnp.int32(0))
             buf, cache = self._microstep_loop(layers, x, cache, jnp.int32(0))
             last = jax.lax.dynamic_slice_in_dim(buf, prompt_len - 1, 1, axis=1)
@@ -149,8 +163,10 @@ class PipelineBackend:
 
         shmapped = self._shard(
             body,
-            in_specs=(P(), P(AXIS_PP), P(), P(), P(AXIS_PP), P(), P()),
-            out_specs=(P(), P(), P(AXIS_PP)),
+            in_specs=(
+                P(), self._layer_specs, P(AXIS_DP), P(), cache_spec(), P(), P(),
+            ),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
@@ -159,6 +175,7 @@ class PipelineBackend:
 
         def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
             s = jax.lax.axis_index(AXIS_PP)
+            key = self._dp_key(key)
             B = first_token.shape[0]
             pad = jnp.int32(cfg.pad_token_id)
             eos = jnp.int32(cfg.eos_token_id)
@@ -204,7 +221,10 @@ class PipelineBackend:
 
         shmapped = self._shard(
             body,
-            in_specs=(P(), P(AXIS_PP), P(), P(AXIS_PP), P(), P(), P(), P()),
-            out_specs=(P(), P(), P(AXIS_PP)),
+            in_specs=(
+                P(), self._layer_specs, P(AXIS_DP), cache_spec(), P(), P(),
+                P(), P(),
+            ),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )
         return jax.jit(shmapped, donate_argnums=(3,))

@@ -37,18 +37,18 @@ def create_engine(
     cfg = get_model_config(model) if isinstance(model, str) else model
     if dtype is not None:
         cfg = cfg.replace(dtype=dtype)
-    if mesh_cfg.dp > 1 or mesh_cfg.tp > 1:
-        # dp/tp execution lands with parallel.schedule (microbatched dp) and
-        # the tp psum wiring; silently replicating compute across those axes
-        # would burn devices for nothing. Rejected before params init — the
-        # expensive step — so a bad mesh fails instantly.
+    if mesh_cfg.dp > 1:
+        # the serving engine decodes batch=1, which cannot shard over dp;
+        # batched dp decode is a backend-level capability (PipelineBackend
+        # with batch % dp == 0 — used by the bench harness). Rejected before
+        # params init — the expensive step — so a bad mesh fails instantly.
         raise NotImplementedError(
-            "dp/tp mesh axes are not wired up yet — use pp=N for pipeline "
-            "parallelism"
+            "dp>1 is not available through the batch-1 serving engine; "
+            "use PipelineBackend directly for dp-sharded batched decode"
         )
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
-    if mesh_cfg.pp > 1:
+    if mesh_cfg.pp > 1 or mesh_cfg.tp > 1:
         mesh = build_mesh(mesh_cfg)
         backend = PipelineBackend(cfg, params, mesh)
     else:

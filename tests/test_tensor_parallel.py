@@ -1,0 +1,122 @@
+"""Tensor-parallel (tp axis) and data-parallel (dp axis) equivalence tests
+on the 8-virtual-CPU-device mesh: head/FFN-sharded execution and
+batch-sharded execution must reproduce single-device results.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import MeshConfig, get_model_config
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+from distributed_llm_inference_tpu.parallel.partition import validate_mesh
+from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+
+def _single_device(cfg, params, tokens, plen, steps, key, sampling, batch=1):
+    kp, kd = jax.random.split(key)
+    cache = M.init_kv_cache(cfg, batch, max_seq=64)
+    f, logits, cache = G.prefill(cfg, params, tokens, plen, cache, kp, sampling)
+    out, n, _ = G.decode(
+        cfg, params, f, cache, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    return f, logits, out, n
+
+
+def _backend(cfg, params, mesh_cfg, devices, tokens, plen, steps, key, sampling,
+             batch=1):
+    kp, kd = jax.random.split(key)
+    pb = PipelineBackend(cfg, params, build_mesh(mesh_cfg, devices))
+    cache = pb.init_cache(batch, 64)
+    f, logits, cache = pb.prefill(tokens, plen, cache, kp, sampling)
+    out, n, _ = pb.decode(
+        f, cache, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    return f, logits, out, n
+
+
+@pytest.mark.parametrize(
+    "cfg_name,mesh",
+    [
+        ("test-llama-tiny", MeshConfig(dp=1, pp=1, tp=2)),  # pure TP
+        ("test-llama-tiny", MeshConfig(dp=1, pp=2, tp=2)),  # PP × TP
+        ("test-gpt2-tiny", MeshConfig(dp=1, pp=1, tp=4)),   # MHA TP (biases)
+        ("test-gpt2-tiny", MeshConfig(dp=1, pp=2, tp=2)),
+    ],
+)
+def test_tp_greedy_decode_matches_single_device(cfg_name, mesh, eight_devices):
+    cfg = get_model_config(cfg_name)
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(4)
+    ids = rng.integers(3, min(cfg.vocab_size, 250), size=6, dtype=np.int64).tolist()
+    bucket, steps = 16, 8
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(7)
+
+    f_s, logits_s, out_s, n_s = _single_device(
+        cfg, params, tokens, plen, steps, key, sampling
+    )
+    f_t, logits_t, out_t, n_t = _backend(
+        cfg, params, mesh, eight_devices, tokens, plen, steps, key, sampling
+    )
+
+    # psum reassociates the contraction over tp shards: tolerance, not
+    # bit-equality, on logits; greedy tokens must still agree exactly
+    np.testing.assert_allclose(
+        np.asarray(logits_t), np.asarray(logits_s), rtol=2e-4, atol=2e-4
+    )
+    assert int(f_t[0]) == int(f_s[0])
+    np.testing.assert_array_equal(np.asarray(out_t), np.asarray(out_s))
+    assert int(n_t[0]) == int(n_s[0])
+
+
+def test_dp_batched_greedy_decode_matches_single_device(eight_devices):
+    """dp=2 batch-sharded decode == single-device batch=2 decode (greedy:
+    per-dp-group key folding cannot affect argmax)."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(9)
+    batch, bucket, steps = 2, 16, 6
+    plen_i = 5
+    rows = rng.integers(3, 250, size=(batch, plen_i), dtype=np.int64)
+    tokens = jnp.asarray(
+        np.pad(rows, ((0, 0), (0, bucket - plen_i)), constant_values=cfg.pad_token_id),
+        jnp.int32,
+    )
+    plen = jnp.int32(plen_i)
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(13)
+
+    f_s, _, out_s, n_s = _single_device(
+        cfg, params, tokens, plen, steps, key, sampling, batch=batch
+    )
+    f_d, _, out_d, n_d = _backend(
+        cfg, params, MeshConfig(dp=2, pp=2, tp=2), eight_devices,
+        tokens, plen, steps, key, sampling, batch=batch,
+    )
+    np.testing.assert_array_equal(np.asarray(f_d), np.asarray(f_s))
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(out_s))
+    np.testing.assert_array_equal(np.asarray(n_d), np.asarray(n_s))
+
+
+def test_validate_mesh_rejects_indivisible():
+    cfg = get_model_config("test-llama-tiny")  # 4 layers, 4 heads, 2 kv heads
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        validate_mesh(cfg, pp=1, tp=4)  # 2 kv heads % 4 != 0
+    with pytest.raises(ValueError, match="n_layers"):
+        validate_mesh(cfg, pp=3, tp=1)
+
+
+def test_dp_cache_requires_divisible_batch(eight_devices):
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    pb = PipelineBackend(
+        cfg, params, build_mesh(MeshConfig(dp=2, pp=2, tp=1), eight_devices)
+    )
+    with pytest.raises(ValueError, match="batch=1 not divisible"):
+        pb.init_cache(1, 64)
