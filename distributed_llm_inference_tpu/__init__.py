@@ -12,4 +12,4 @@ __version__ = "0.1.0"
 
 from .config import EngineConfig, MeshConfig, ModelConfig, SamplingConfig, stage_layer_range
 from .models.registry import get_model_config, list_models
-from .runtime import create_engine
+from .runtime import create_backend, create_engine

@@ -112,9 +112,6 @@ class EngineConfig:
     # Prompt-length buckets for prefill compilation (TTFT: avoids recompiling
     # per prompt length; prompts are right-padded up to the bucket).
     prefill_buckets: tuple = (64, 128, 256, 512, 1024, 2048)
-    # Microbatches for the pipelined decode schedule (config 5). 1 = no
-    # microbatching.
-    microbatches: int = 1
 
 
 def stage_layer_range(n_layers: int, pp: int, stage: int) -> tuple[int, int]:

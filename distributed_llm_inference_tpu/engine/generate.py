@@ -93,14 +93,18 @@ def decode(
 
     first_token: [B] (already counted as generated token #0 unless EOS).
     start_pos: scalar int32 = prompt_len (first_token's K/V lands there).
-    limit: traced cap on steps this call (<= static max_steps), so one
-    compiled program serves every requested max_tokens in the bucket.
+    limit: traced cap on steps this call (clamped to the static max_steps),
+    so one compiled program serves every requested max_tokens in the bucket.
 
     Returns (tokens [B, max_steps] — pad-masked after EOS, EOS excluded,
     matching the reference's break-before-append at orchestration.py:181-186
     — and n_gen [B] counting tokens emitted by THIS loop).
     """
     B = first_token.shape[0]
+    # clamp: limit > max_steps would walk dynamic_update_slice off the end
+    # of `out` (the start index clamps, corrupting the last column) and
+    # inflate n_gen past the buffer
+    limit = jnp.minimum(limit, jnp.int32(max_steps))
     pad = jnp.int32(cfg.pad_token_id)
     eos = jnp.int32(cfg.eos_token_id)
     out0 = jnp.full((B, max_steps), pad, jnp.int32)

@@ -45,21 +45,16 @@ def _ring_perm(S: int):
     return [(j, (j + 1) % S) for j in range(S)]
 
 
-class PipelineBackend:
-    """Engine-compatible backend running (dp, pp, tp) SPMD over a mesh.
+class SPMDBackendBase:
+    """Shared scaffolding for the SPMD mesh backends.
 
-    Drop-in for SingleDeviceBackend (same init_cache/prefill/decode/health
-    interface), so InferenceEngine and the serving layer are topology-
-    agnostic — the reference needed three differently-coded processes for
-    the same job (orchestration.py vs Worker1.py vs Worker2.py).
-
-    Axes: `pp` stages hand activations around the ICI ring; `tp` shards
-    heads/FFN within a stage (psums inside models/*.decoder_layer); `dp`
-    shards the batch — each dp slice is an independent pipeline ring (its
-    while-loop may even exit at a different step; no collective crosses dp).
+    Owns the mesh-axis bookkeeping, parameter sharding, shard_map partial,
+    per-max_steps decode-program memoization, dp key decorrelation, and the
+    per-stage health report. Subclasses implement `_build_prefill()` and
+    `_build_decode(max_steps)`.
     """
 
-    name = "pipeline"
+    name = "spmd-base"
 
     def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
         self.cfg = cfg
@@ -91,6 +86,10 @@ class PipelineBackend:
         if fn is None:
             fn = self._build_decode(max_steps)
             self._decode_cache[max_steps] = fn
+        # clamp: limit > max_steps would walk dynamic_update_slice off the
+        # end of `out` (the start index clamps, corrupting the last column)
+        # and inflate n_gen past the buffer
+        limit = jnp.minimum(jnp.int32(limit), jnp.int32(max_steps))
         return fn(
             self.shared, self.layers, first_token, cache, start_pos, limit, key, sampling
         )
@@ -100,25 +99,17 @@ class PipelineBackend:
         worker's /health over HTTP (orchestration.py:306-329); here a stage
         is a mesh slice, so health = device presence per slice."""
         devs = self.mesh.devices  # [dp, pp, tp]
-        out = []
-        for s in range(self.pp):
-            stage_devs = devs[:, s, :].reshape(-1)
-            out.append(
-                {
-                    "stage": s,
-                    "devices": [str(d) for d in stage_devs],
-                    "layers": list(
-                        range(
-                            s * (self.cfg.n_layers // self.pp),
-                            (s + 1) * (self.cfg.n_layers // self.pp),
-                        )
-                    ),
-                    "status": "online",
-                }
-            )
-        return out
+        per = self.cfg.n_layers // self.pp
+        return [
+            {
+                "stage": s,
+                "devices": [str(d) for d in devs[:, s, :].reshape(-1)],
+                "layers": list(range(s * per, (s + 1) * per)),
+                "status": "online",
+            }
+            for s in range(self.pp)
+        ]
 
-    # -- compiled programs --------------------------------------------------
     def _dp_key(self, key):
         """Decorrelate sampling across dp batch shards. dp=1 keeps the key
         untouched so the pipeline stays bit-identical to single-device."""
@@ -126,6 +117,30 @@ class PipelineBackend:
             return key
         return jax.random.fold_in(key, jax.lax.axis_index(AXIS_DP))
 
+    def _build_prefill(self):
+        raise NotImplementedError
+
+    def _build_decode(self, max_steps: int):
+        raise NotImplementedError
+
+
+class PipelineBackend(SPMDBackendBase):
+    """Engine-compatible backend running (dp, pp, tp) SPMD over a mesh.
+
+    Drop-in for SingleDeviceBackend (same init_cache/prefill/decode/health
+    interface), so InferenceEngine and the serving layer are topology-
+    agnostic — the reference needed three differently-coded processes for
+    the same job (orchestration.py vs Worker1.py vs Worker2.py).
+
+    Axes: `pp` stages hand activations around the ICI ring; `tp` shards
+    heads/FFN within a stage (psums inside models/*.decoder_layer); `dp`
+    shards the batch — each dp slice is an independent pipeline ring (its
+    while-loop may even exit at a different step; no collective crosses dp).
+    """
+
+    name = "pipeline"
+
+    # -- compiled programs --------------------------------------------------
     def _microstep_loop(self, layers, x, cache, pos):
         """S microsteps of (apply local stage, ring-shift). Returns the
         final-stage output (landed on stage 0 by the last shift) + cache."""

@@ -1,0 +1,298 @@
+"""Microbatched pipeline schedule: zero-bubble round-robin decode.
+
+BASELINE.json config 5 ("8-stage microbatched pipeline, batch=8, 1F1B
+schedule") — the inference analogue of the training-side 1F1B schedule.
+The plain `parallel.pipeline.PipelineBackend` keeps only one microbatch in
+flight: during batch-1 decode every stage computes every microstep but only
+1/S of that work is useful (the classic pipeline bubble — SURVEY.md §2's
+"stage 1 idles while stage 0 computes", /root/reference/orchestration.py:
+114-137, just hidden inside SPMD). Here the batch is split into
+M >= n_stages microbatches that chase each other around the `pp` ring:
+
+    microstep t:  stage s works on microbatch (t - s) mod M
+                  stage 0 ingests microbatch  t        mod M
+                  stage S-1's output (microbatch (t-S+1) mod M) rotates to
+                  stage 0, where it is sampled and immediately re-embedded
+
+With M == S, a microbatch's next token re-enters stage 0 on exactly the
+microstep its previous token vacates it: in steady state every stage does
+useful work on every microstep — the bubble is gone, and each microstep
+moves 1/M of the batch instead of recomputing the whole batch on every
+stage. Autoregressive dependencies are respected because a sequence's token
+t+1 starts only after token t has been sampled (the round-trip around the
+ring IS the dependency chain).
+
+All of it is one compiled SPMD program (shard_map over the (dp, pp, tp)
+mesh; `lax.while_loop` over microsteps; `lax.ppermute` hand-off), with the
+same gated-cache-write discipline as the plain pipeline: each stage's KV
+write lands in the batch-row slice of the microbatch it currently holds,
+and warmup/drain/finished microsteps are discarded at slice granularity.
+
+Decode state (per device, uniform across the mesh): per-microbatch token,
+position, finished mask, emit count. The sampled token is produced on
+stage 0 and broadcast with a masked `psum` over `pp` (an int32 per row —
+not the logits), so every device advances identical state and the loop
+never leaves the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models import api as M
+from ..ops.sampling import sample_token
+from .mesh import AXIS_DP, AXIS_PP
+from .partition import cache_spec, init_sharded_cache
+from .pipeline import SPMDBackendBase, _ring_perm
+
+
+class MicrobatchPipelineBackend(SPMDBackendBase):
+    """Engine-compatible backend: (dp, pp, tp) SPMD with M microbatches.
+
+    Same init_cache/prefill/decode/health interface as the other backends.
+    Batch contract: global batch % (dp * n_microbatches) == 0; rows are
+    grouped [dp block][microbatch block][rows] and returned in the same
+    order. Targets batched workloads (config 5: batch=8, 8 stages) — the
+    single-request serving path uses the plain backends.
+
+    RNG stream note: greedy decode is bit-identical to the single-device
+    and plain-pipeline backends (equivalence-tested). Stochastic sampling
+    draws from a DIFFERENT but equally deterministic stream — per-
+    (microbatch, emit-index) `fold_in` of the request key, because the
+    round-robin schedule has no single sequential split chain to follow —
+    so a fixed seed reproduces exactly on THIS backend but yields different
+    draws than the sequential backends' split-per-step stream.
+    """
+
+    name = "pipeline-1f1b"
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: dict,
+        mesh: Mesh,
+        n_microbatches: int | None = None,
+    ):
+        pp = int(mesh.shape[AXIS_PP])
+        self.n_microbatches = int(n_microbatches or pp)
+        if self.n_microbatches < pp:
+            raise ValueError(
+                f"n_microbatches={self.n_microbatches} must be >= pp={pp}: "
+                "a microbatch must vacate stage 0 before its next token returns"
+            )
+        super().__init__(cfg, params, mesh)
+
+    # -- engine interface ---------------------------------------------------
+    def init_cache(self, batch: int, max_seq: int):
+        if batch % (self.dp * self.n_microbatches) != 0:
+            raise ValueError(
+                f"batch={batch} not divisible by dp*M="
+                f"{self.dp * self.n_microbatches}"
+            )
+        return init_sharded_cache(self.cfg, self.mesh, batch, max_seq)
+
+    def health(self) -> list[dict]:
+        return [
+            dict(stage, microbatches=self.n_microbatches)
+            for stage in super().health()
+        ]
+
+    # -- schedule pieces ----------------------------------------------------
+    def _stage_apply(self, layers, x, cache, pos_m, m_here, b_m, gate):
+        """Run the local layer slice on microbatch `m_here`'s rows.
+
+        The cache batch dim holds all M microbatches; slice out this
+        microbatch's rows, scan the layers over them, write the slice back.
+        XLA keeps the slice/update in place on the donated buffer.
+        """
+        row0 = m_here * b_m
+        ck = jax.lax.dynamic_slice_in_dim(cache["k"], row0, b_m, axis=1)
+        cv = jax.lax.dynamic_slice_in_dim(cache["v"], row0, b_m, axis=1)
+        y, new = M.forward_layers(
+            self.cfg, layers, x, {"k": ck, "v": cv}, pos_m,
+            update_gate=gate, tp_axis=self.tp_axis,
+        )
+        cache = {
+            "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], new["k"], row0, axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], new["v"], row0, axis=1),
+        }
+        return y, cache
+
+    def _stage0_token_psum(self, s, key, buf, sampling):
+        """Sample stage 0's received buffer, broadcast the token over pp.
+
+        Every device runs the sampler (SPMD), but only stage 0 holds a
+        completed last-stage output; the masked psum ships one int32 per
+        row — not the [b_m, vocab] logits — around the ring.
+        """
+        logits = M.unembed(self.cfg, self.shared, buf[:, -1:, :])[:, 0, :]
+        tok = sample_token(key, logits, *sampling)
+        tok = jax.lax.psum(jnp.where(s == 0, tok, 0), AXIS_PP)
+        return tok, logits
+
+    # -- prefill ------------------------------------------------------------
+    def _build_prefill(self):
+        cfg, S, Mb = self.cfg, self.pp, self.n_microbatches
+        perm = _ring_perm(S)
+
+        def body(shared, layers, tokens, prompt_len, cache, key, sampling):
+            s = jax.lax.axis_index(AXIS_PP)
+            key = self._dp_key(key)
+            rows, bucket = tokens.shape
+            b_m = rows // Mb
+            toks = tokens.reshape(Mb, b_m, bucket)
+            D = shared["embed"].shape[-1]
+            dt = cfg.jnp_dtype
+
+            def micro(t, carry):
+                buf, cache, first, logits_acc = carry
+                # ingest: stage 0 embeds microbatch t's prompt (clamped so
+                # drain microsteps re-embed a stale microbatch — gated off)
+                m_in = jnp.clip(t, 0, Mb - 1)
+                x_in = M.embed(cfg, shared, toks[m_in], jnp.int32(0))
+                x = jnp.where(s == 0, x_in, buf)
+                m_here = jnp.mod(t - s, Mb)
+                gate = (t >= s) & (t - s < Mb)
+                y, cache = self._stage_apply(
+                    layers, x, cache, jnp.int32(0), m_here, b_m, gate
+                )
+                buf = jax.lax.ppermute(y, AXIS_PP, perm)
+                # sample: microbatch (t-S+1) finished all stages and just
+                # rotated onto stage 0
+                m_done = jnp.mod(t - (S - 1), Mb)
+                ev = (t >= S - 1) & (t - (S - 1) < Mb)
+                last = jax.lax.dynamic_slice_in_dim(buf, prompt_len - 1, 1, axis=1)
+                lg_local = M.unembed(cfg, shared, last)[:, 0, :]
+                lg = jax.lax.psum(jnp.where(s == 0, lg_local, 0.0), AXIS_PP)
+                tok = sample_token(jax.random.fold_in(key, m_done), lg, *sampling)
+                old_f = jax.lax.dynamic_slice_in_dim(first, m_done, 1, axis=0)
+                first = jax.lax.dynamic_update_slice_in_dim(
+                    first, jnp.where(ev, tok[None], old_f), m_done, axis=0
+                )
+                old_l = jax.lax.dynamic_slice_in_dim(logits_acc, m_done, 1, axis=0)
+                logits_acc = jax.lax.dynamic_update_slice_in_dim(
+                    logits_acc, jnp.where(ev, lg[None], old_l), m_done, axis=0
+                )
+                return buf, cache, first, logits_acc
+
+            init = (
+                jnp.zeros((b_m, bucket, D), dt),
+                cache,
+                jnp.zeros((Mb, b_m), jnp.int32),
+                jnp.zeros((Mb, b_m, cfg.vocab_size), jnp.float32),
+            )
+            _, cache, first, logits = jax.lax.fori_loop(0, Mb + S - 1, micro, init)
+            return first.reshape(rows), logits.reshape(rows, -1), cache
+
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                P(), self._layer_specs, P(AXIS_DP), P(), cache_spec(), P(), P(),
+            ),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
+        )
+        return jax.jit(shmapped, donate_argnums=(4,))
+
+    # -- decode -------------------------------------------------------------
+    def _build_decode(self, max_steps: int):
+        cfg, S, Mb = self.cfg, self.pp, self.n_microbatches
+        perm = _ring_perm(S)
+        pad = jnp.int32(cfg.pad_token_id)
+        eos = jnp.int32(cfg.eos_token_id)
+
+        def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
+            s = jax.lax.axis_index(AXIS_PP)
+            key = self._dp_key(key)
+            rows = first_token.shape[0]
+            b_m = rows // Mb
+            D = shared["embed"].shape[-1]
+            dt = cfg.jnp_dtype
+
+            finished0 = (first_token == eos).reshape(Mb, b_m)
+            cur0 = jnp.where(finished0, pad, first_token.reshape(Mb, b_m))
+            done0 = jnp.all(finished0, axis=1) | (limit <= 0)
+
+            # carry: t, buf, cache, cur [Mb,b_m], pos [Mb], finished [Mb,b_m],
+            #        done [Mb], emitted [Mb], out [Mb,b_m,max], n_gen [Mb,b_m]
+            def cond(c):
+                t = c[0]
+                done = c[6]
+                return (t < S - 1 + limit * Mb) & ~jnp.all(done)
+
+            def micro(c):
+                t, buf, cache, cur, pos, finished, done, emitted, out, n_gen = c
+                # ingest: stage 0 embeds microbatch (t mod M)'s current token
+                # at its current position
+                m_in = jnp.mod(t, Mb)
+                x_in = M.embed(cfg, shared, cur[m_in][:, None], pos[m_in])
+                x = jnp.where(s == 0, x_in, buf)
+                # apply local stage to the microbatch it holds
+                m_here = jnp.mod(t - s, Mb)
+                gate = (t >= s) & ~done[m_here]
+                y, cache = self._stage_apply(
+                    layers, x, cache, pos[m_here], m_here, b_m, gate
+                )
+                buf = jax.lax.ppermute(y, AXIS_PP, perm)
+                # sample event: microbatch (t-S+1) completed a ring pass
+                m_done = jnp.mod(t - (S - 1), Mb)
+                ev = (t >= S - 1) & ~done[m_done]
+                kk = jax.random.fold_in(
+                    jax.random.fold_in(key, m_done), emitted[m_done]
+                )
+                tok, _ = self._stage0_token_psum(s, kk, buf, sampling)
+                fin_m = finished[m_done]
+                newly = fin_m | (tok == eos)
+                emit = jnp.where(newly, pad, tok)
+                # gated per-microbatch state updates (uniform across devices)
+                old_row = jax.lax.dynamic_slice(
+                    out, (m_done, jnp.int32(0), emitted[m_done]), (1, b_m, 1)
+                )
+                out = jax.lax.dynamic_update_slice(
+                    out,
+                    jnp.where(ev, emit[None, :, None], old_row),
+                    (m_done, jnp.int32(0), emitted[m_done]),
+                )
+                upd = lambda arr, val: jax.lax.dynamic_update_slice_in_dim(
+                    arr,
+                    jnp.where(
+                        ev, val, jax.lax.dynamic_slice_in_dim(arr, m_done, 1, axis=0)
+                    ),
+                    m_done, axis=0,
+                )
+                n_gen = upd(n_gen, (n_gen[m_done] + (~newly).astype(jnp.int32))[None])
+                cur = upd(cur, jnp.where(newly, pad, tok)[None])
+                pos = upd(pos, (pos[m_done] + 1)[None])
+                finished = upd(finished, newly[None])
+                new_emitted = emitted[m_done] + 1
+                done_now = jnp.all(newly) | (new_emitted >= limit)
+                emitted = upd(emitted, new_emitted[None])
+                done = upd(done, done_now[None])
+                return t + 1, buf, cache, cur, pos, finished, done, emitted, out, n_gen
+
+            init = (
+                jnp.int32(0),
+                jnp.zeros((b_m, 1, D), dt),
+                cache,
+                cur0,
+                jnp.broadcast_to(start_pos, (Mb,)).astype(jnp.int32),
+                finished0,
+                done0,
+                jnp.zeros((Mb,), jnp.int32),
+                jnp.full((Mb, b_m, max_steps), pad, jnp.int32),
+                jnp.zeros((Mb, b_m), jnp.int32),
+            )
+            c = jax.lax.while_loop(cond, micro, init)
+            _, _, cache, _, _, _, _, _, out, n_gen = c
+            return out.reshape(rows, max_steps), n_gen.reshape(rows), cache
+
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                P(), self._layer_specs, P(AXIS_DP), cache_spec(), P(), P(), P(), P(),
+            ),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))

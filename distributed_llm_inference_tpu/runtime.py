@@ -17,6 +17,47 @@ from .models import api as M
 from .models.registry import get_model_config
 from .parallel.mesh import build_mesh
 from .parallel.pipeline import PipelineBackend
+from .parallel.schedule import MicrobatchPipelineBackend
+
+
+def create_backend(
+    model: str | ModelConfig = "tinyllama-1.1b",
+    *,
+    mesh_cfg: MeshConfig = MeshConfig(),
+    microbatches: int = 1,
+    params: Any = None,
+    dtype: Optional[str] = None,
+    seed: int = 0,
+):
+    """Build a compute backend alone (no engine/tokenizer around it).
+
+    Selection: single device when the mesh is trivial; the SPMD pipeline
+    for pp/tp meshes; the microbatched zero-bubble schedule
+    (parallel/schedule.py, BASELINE config 5) when microbatches > 1.
+    Batched workloads (bench harness, dryrun, batch-serving callers) use
+    the backend interface directly: batch % (dp * microbatches) == 0.
+    Returns (cfg, backend).
+    """
+    cfg = get_model_config(model) if isinstance(model, str) else model
+    if dtype is not None:
+        cfg = cfg.replace(dtype=dtype)
+    if params is None:
+        params = M.init_params(cfg, jax.random.PRNGKey(seed))
+    if microbatches > 1:
+        if mesh_cfg.pp < 2:
+            raise ValueError(
+                "microbatches > 1 needs a pipeline (pp >= 2): with one "
+                "stage there is no bubble to fill and the round-robin "
+                "schedule would only serialize the batch"
+            )
+        mesh = build_mesh(mesh_cfg)
+        return cfg, MicrobatchPipelineBackend(
+            cfg, params, mesh, n_microbatches=microbatches
+        )
+    if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1:
+        mesh = build_mesh(mesh_cfg)
+        return cfg, PipelineBackend(cfg, params, mesh)
+    return cfg, SingleDeviceBackend(cfg, params)
 
 
 def create_engine(
@@ -34,25 +75,18 @@ def create_engine(
     params=None random-initializes (offline bring-up / benchmarks);
     pass a converted HF pytree (models/convert.py) for real weights.
     """
-    cfg = get_model_config(model) if isinstance(model, str) else model
-    if dtype is not None:
-        cfg = cfg.replace(dtype=dtype)
     if mesh_cfg.dp > 1:
-        # the serving engine decodes batch=1, which cannot shard over dp;
-        # batched dp decode is a backend-level capability (PipelineBackend
-        # with batch % dp == 0 — used by the bench harness). Rejected before
+        # the serving engine decodes batch=1, which cannot shard over dp
+        # (nor split into microbatches); batched dp / microbatched decode is
+        # a backend-level capability — see create_backend. Rejected before
         # params init — the expensive step — so a bad mesh fails instantly.
         raise NotImplementedError(
             "dp>1 is not available through the batch-1 serving engine; "
-            "use PipelineBackend directly for dp-sharded batched decode"
+            "use create_backend() for dp-sharded / microbatched batched decode"
         )
-    if params is None:
-        params = M.init_params(cfg, jax.random.PRNGKey(seed))
-    if mesh_cfg.pp > 1 or mesh_cfg.tp > 1:
-        mesh = build_mesh(mesh_cfg)
-        backend = PipelineBackend(cfg, params, mesh)
-    else:
-        backend = SingleDeviceBackend(cfg, params)
+    cfg, backend = create_backend(
+        model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, seed=seed
+    )
     return InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed
     )

@@ -1,0 +1,168 @@
+"""Microbatched-pipeline (1F1B-style) equivalence tests: the zero-bubble
+round-robin schedule must produce exactly the tokens the single-device
+model produces, row for row, on the 8-virtual-CPU-device mesh (SURVEY.md §4
+item 3; BASELINE.json config 5)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import MeshConfig, get_model_config
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+from distributed_llm_inference_tpu.parallel.schedule import MicrobatchPipelineBackend
+
+
+def _prompt_batch(cfg, batch, plen, bucket, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(3, min(cfg.vocab_size, 250), size=(batch, plen), dtype=np.int64)
+    padded = np.pad(rows, ((0, 0), (0, bucket - plen)), constant_values=cfg.pad_token_id)
+    return jnp.asarray(padded, jnp.int32)
+
+
+def _single_device_reference(cfg, params, tokens, plen, steps, kp, kd, sampling):
+    cache = M.init_kv_cache(cfg, tokens.shape[0], max_seq=64)
+    first, logits, cache = G.prefill(cfg, params, tokens, plen, cache, kp, sampling)
+    out, n_gen, _ = G.decode(
+        cfg, params, first, cache, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    return first, logits, out, n_gen
+
+
+@pytest.mark.parametrize("pp,mb", [(2, 2), (4, 4), (2, 4)])
+def test_microbatch_prefill_matches_single_device(pp, mb, eight_devices):
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=pp, tp=1), eight_devices)
+    be = MicrobatchPipelineBackend(cfg, params, mesh, n_microbatches=mb)
+
+    batch, plen, bucket = mb * 2, 9, 16
+    tokens = _prompt_batch(cfg, batch, plen, bucket)
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(1)
+
+    cache_s = M.init_kv_cache(cfg, batch, max_seq=64)
+    f_s, logits_s, _ = G.prefill(cfg, params, tokens, jnp.int32(plen), cache_s, key, sampling)
+
+    f_p, logits_p, _ = be.prefill(tokens, jnp.int32(plen), be.init_cache(batch, 64), key, sampling)
+
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(f_p), np.asarray(f_s))
+
+
+@pytest.mark.parametrize("cfg_name", ["test-llama-tiny", "test-gpt2-tiny"])
+def test_microbatch_decode_matches_single_device(cfg_name, eight_devices):
+    """Greedy prefill+decode, 2 stages x 2 microbatches, both families."""
+    cfg = get_model_config(cfg_name)
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), eight_devices)
+    be = MicrobatchPipelineBackend(cfg, params, mesh)
+
+    batch, plen, bucket, steps = 4, 7, 16, 8
+    tokens = _prompt_batch(cfg, batch, plen, bucket, seed=2)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(3))
+
+    f_s, _, out_s, n_s = _single_device_reference(
+        cfg, params, tokens, jnp.int32(plen), steps, kp, kd, sampling
+    )
+    cache = be.init_cache(batch, 64)
+    f_p, _, cache = be.prefill(tokens, jnp.int32(plen), cache, kp, sampling)
+    out_p, n_p, _ = be.decode(
+        f_p, cache, jnp.int32(plen), jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    np.testing.assert_array_equal(np.asarray(f_p), np.asarray(f_s))
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+    np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_s))
+
+
+def test_microbatch_full_mesh_dp_pp_tp(eight_devices):
+    """All three mesh axes + microbatching: dp=2 x pp=2 x tp=2, batch=8."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=2, pp=2, tp=2), eight_devices)
+    be = MicrobatchPipelineBackend(cfg, params, mesh)
+
+    batch, plen, bucket, steps = 8, 5, 16, 6
+    tokens = _prompt_batch(cfg, batch, plen, bucket, seed=4)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(5))
+
+    f_s, _, out_s, n_s = _single_device_reference(
+        cfg, params, tokens, jnp.int32(plen), steps, kp, kd, sampling
+    )
+    cache = be.init_cache(batch, 64)
+    f_p, _, cache = be.prefill(tokens, jnp.int32(plen), cache, kp, sampling)
+    out_p, n_p, _ = be.decode(
+        f_p, cache, jnp.int32(plen), jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    np.testing.assert_array_equal(np.asarray(f_p), np.asarray(f_s))
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+    np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_s))
+
+
+def test_microbatch_eos_early_exit(eight_devices):
+    """Per-row EOS finishing + per-microbatch done gating: pick the token
+    greedy decode emits mid-stream as the EOS id and check both backends
+    truncate identically."""
+    base = get_model_config("test-llama-tiny", eos_token_id=-1)
+    params = M.init_params(base, jax.random.PRNGKey(0))
+    batch, plen, bucket, steps = 4, 6, 16, 8
+    tokens = _prompt_batch(base, batch, plen, bucket, seed=6)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(7))
+
+    _, _, out_free, _ = _single_device_reference(
+        base, params, tokens, jnp.int32(plen), steps, kp, kd, sampling
+    )
+    eos = int(np.asarray(out_free)[0, 3])  # token row 0 emits at step 3
+
+    cfg = base.replace(eos_token_id=eos)
+    f_s, _, out_s, n_s = _single_device_reference(
+        cfg, params, tokens, jnp.int32(plen), steps, kp, kd, sampling
+    )
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), eight_devices)
+    be = MicrobatchPipelineBackend(cfg, params, mesh)
+    cache = be.init_cache(batch, 64)
+    f_p, _, cache = be.prefill(tokens, jnp.int32(plen), cache, kp, sampling)
+    out_p, n_p, _ = be.decode(
+        f_p, cache, jnp.int32(plen), jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    assert int(np.asarray(n_s)[0]) < steps  # EOS actually truncated row 0
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+    np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_s))
+
+
+def test_create_backend_selects_schedule(eight_devices):
+    """runtime.create_backend: microbatches>1 -> the 1F1B schedule backend,
+    plain meshes -> pipeline, trivial mesh -> single device."""
+    from distributed_llm_inference_tpu import create_backend
+
+    cfg, be = create_backend(
+        "test-llama-tiny", mesh_cfg=MeshConfig(dp=1, pp=2, tp=1), microbatches=2
+    )
+    assert be.name == "pipeline-1f1b"
+    assert be.n_microbatches == 2
+    _, be2 = create_backend("test-llama-tiny", mesh_cfg=MeshConfig(dp=1, pp=2, tp=1))
+    assert be2.name == "pipeline"
+    _, be3 = create_backend("test-llama-tiny")
+    assert be3.name == "single-device"
+
+
+def test_microbatch_batch_contract(eight_devices):
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), eight_devices)
+    with pytest.raises(ValueError, match="n_microbatches"):
+        MicrobatchPipelineBackend(cfg, params, mesh, n_microbatches=1)
+    be = MicrobatchPipelineBackend(cfg, params, mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        be.init_cache(3, 64)
+    assert be.health()[0]["microbatches"] == 2
