@@ -39,11 +39,16 @@ class ModelConfig:
     # GPT-2 only: learned absolute position embeddings.
     use_learned_pos: bool = False
     dtype: str = "float32"  # parameter / activation dtype: "float32" | "bfloat16"
+    # Attention implementation: "xla" (einsum + full mask, fused by XLA) or
+    # "pallas" (flash kernel, ops/flash_attention.py; interpret-mode on CPU).
+    attn_impl: str = "xla"
     eos_token_id: int = 2
     bos_token_id: int = 1
     pad_token_id: int = 0
 
     def __post_init__(self):
+        if self.attn_impl not in ("xla", "pallas"):
+            raise ValueError(f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}")
         if self.arch == "gpt2" and self.n_kv_heads != self.n_heads:
             raise ValueError(
                 f"gpt2 is MHA: n_kv_heads ({self.n_kv_heads}) must equal "

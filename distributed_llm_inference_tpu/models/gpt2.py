@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..ops.attention import attend, causal_mask, update_kv_cache
+from ..ops.flash_attention import flash_attend
 from ..ops.norms import layer_norm
 
 Params = dict
@@ -102,7 +103,10 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
     v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
 
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    attn = attend(q, new_k, new_v, mask)
+    if cfg.attn_impl == "pallas":
+        attn = flash_attend(q, new_k, new_v, pos)
+    else:
+        attn = attend(q, new_k, new_v, mask)
     attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -119,7 +123,7 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
 def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
     """Scan the stacked GPT-2 blocks over a chunk (any contiguous slice)."""
     T = x.shape[1]
-    S = cache["k"].shape[2]
+    S = cache["k"].shape[3]
     mask = causal_mask(pos, T, S)
 
     def body(carry, xs):

@@ -34,11 +34,12 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..ops.attention import attend, causal_mask, update_kv_cache
+from ..ops.flash_attention import flash_attend
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_cos_sin
 
 Params = dict
-KVCache = dict  # {"k": [L, B, S, KV, Dh], "v": [L, B, S, KV, Dh]}
+KVCache = dict  # {"k": [L, B, KV, S, Dh], "v": [L, B, KV, S, Dh]}
 
 
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
@@ -80,7 +81,7 @@ def init_kv_cache(
     over `pp` exactly like the layer params)."""
     S = max_seq or cfg.max_seq_len
     L = n_layers if n_layers is not None else cfg.n_layers
-    shape = (L, batch, S, cfg.n_kv_heads, cfg.head_dim)
+    shape = (L, batch, cfg.n_kv_heads, S, cfg.head_dim)
     dt = cfg.jnp_dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -124,7 +125,10 @@ def decoder_layer(
     q, k = apply_rope(q, k, cos, sin)
 
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    attn = attend(q, new_k, new_v, mask)
+    if cfg.attn_impl == "pallas":
+        attn = flash_attend(q, new_k, new_v, pos)
+    else:
+        attn = attend(q, new_k, new_v, mask)
     attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -151,11 +155,11 @@ def forward_layers(
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
 
-    x: [B, T, D]; cache k/v: [L_slice, B, S, KV, Dh]; pos: scalar int32.
+    x: [B, T, D]; cache k/v: [L_slice, B, KV, S, Dh]; pos: scalar int32.
     Returns (x, new_cache).
     """
     T = x.shape[1]
-    S = cache["k"].shape[2]
+    S = cache["k"].shape[3]
     positions = pos + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     mask = causal_mask(pos, T, S)

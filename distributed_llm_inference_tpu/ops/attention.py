@@ -11,7 +11,13 @@ decode cost per token is O(seq) attention instead of O(seq²) recompute.
 Shapes (B=batch, T=chunk len, S=max_seq, H=q heads, KV=kv heads, Dh=head_dim):
   q          [B, T, H, Dh]
   k_new/v_new[B, T, KV, Dh]
-  cache_k/v  [B, S, KV, Dh]
+  cache_k/v  [B, KV, S, Dh]
+
+The cache keeps the head axis OUTSIDE the sequence axis so each head's
+[S, Dh] slab is contiguous — dense per-head reads for the Pallas flash
+kernel (whose BlockSpec tiles the trailing [S, Dh] dims; Pallas TPU
+requires the last two block dims be full-size or (8,128)-aligned) and for
+XLA's attention matmuls alike.
 """
 
 from __future__ import annotations
@@ -39,12 +45,15 @@ def update_kv_cache(
     gate: optional traced bool — when False the write is a no-op. Used by
     the pipeline runtime, where a stage executes speculatively on
     microsteps when it holds no valid microbatch. Gating selects over the
-    written SLICE only (read-modify-write of [B,T,KV,Dh]), not the whole
+    written SLICE only (read-modify-write of [B,KV,T,Dh]), not the whole
     cache — a whole-cache `where` would copy max_seq slots per layer per
     microstep.
     """
     zero = jnp.int32(0)
-    start = (zero, pos, zero, zero)
+    # [B, T, KV, Dh] chunk -> [B, KV, T, Dh] to match the cache layout.
+    k_new = k_new.transpose(0, 2, 1, 3)
+    v_new = v_new.transpose(0, 2, 1, 3)
+    start = (zero, zero, pos, zero)
     if gate is not None:
         old_k = jax.lax.dynamic_slice(cache_k, start, k_new.shape)
         old_v = jax.lax.dynamic_slice(cache_v, start, v_new.shape)
@@ -74,17 +83,17 @@ def attend(
     Softmax in fp32; output cast back to q.dtype. Returns [B, T, H, Dh].
     """
     B, T, H, Dh = q.shape
-    KV = cache_k.shape[2]
+    KV = cache_k.shape[1]
     group = H // KV
     # [B, T, KV, group, Dh] so each kv head serves its query group without
     # materializing repeated K/V (XLA keeps this as a batched matmul).
     qg = q.reshape(B, T, KV, group, Dh)
     scale = Dh ** -0.5
     scores = jnp.einsum(
-        "btkgd,bskd->bkgts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
+        "btkgd,bksd->bkgts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
     ) * scale  # [B, KV, group, T, S]
     neg = jnp.finfo(jnp.float32).min
     scores = jnp.where(mask[None, None, None, :, :], scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, cache_v.astype(jnp.float32))
+    out = jnp.einsum("bkgts,bksd->btkgd", probs, cache_v.astype(jnp.float32))
     return out.reshape(B, T, H, Dh).astype(q.dtype)

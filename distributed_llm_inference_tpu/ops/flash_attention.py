@@ -1,0 +1,205 @@
+"""Pallas TPU flash attention over the static-shape KV cache.
+
+Drop-in replacement for `ops.attention.attend` (the XLA einsum path): same
+GQA semantics, same [B,KV,S,Dh] cache layout, causal by absolute position.
+One kernel covers both phases:
+
+  * prefill — query chunk of length T at offset `pos`,
+  * decode  — T=1 query at offset `pos`,
+
+with an online-softmax (flash) loop over KV tiles, so the full [T,S] score
+matrix is never materialized. The reference has no analogue — its
+attention is HF eager attention recomputed over the whole sequence with no
+cache at all (/root/reference/Worker1.py:125-154); this kernel is the
+TPU-native hot path that makes decode O(prefix) per token.
+
+Kernel layout decisions (see /opt/skills/guides/pallas_guide.md):
+  * grid = (B, KV-heads, T-tiles, KV-tiles) under a
+    `PrefetchScalarGridSpec`: `pos` is a scalar-prefetch argument, so the
+    K/V BlockSpec index maps can CLAMP the KV-tile index to the live
+    prefix — tiles past ceil((pos+T)/block_k) map to the same block as
+    their predecessor, Pallas skips the redundant DMA, and HBM traffic is
+    one pass over the live prefix, not max_seq. VMEM holds one
+    [block_k, Dh] tile per operand, so max_seq is unbounded by VMEM.
+  * GQA is folded into the query-row dimension: a tile holds
+    block_t x group rows (row r = t*group + g), so one kernel serves MHA
+    (group=1) and GQA alike and the MXU sees tall skinny matmuls instead
+    of per-head vector products.
+  * (m, l, acc) live in VMEM scratch, which persists across the
+    sequentially-iterated KV-tile grid dimension (standard Pallas flash
+    pattern); the output block is written once, on the last KV tile.
+  * scores/accumulator in fp32 (preferred_element_type), output cast back
+    to the query dtype.
+
+On non-TPU backends the kernel runs in interpret mode, which is what the
+CPU test suite exercises; numerics match `attend` to fp32 tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # mask fill; avoids inf-inf NaNs
+
+
+def _needed_tiles(pos, qi, *, T: int, block_t: int, block_k: int):
+    """KV tiles live for query tile qi: keys up to its last valid query
+    position pos + min((qi+1)*block_t, T) - 1."""
+    t_hi = jnp.minimum((qi + 1) * block_t, T)
+    return pl.cdiv(pos + t_hi, block_k)
+
+
+def _flash_kernel(
+    pos_ref,  # scalar-prefetch [1] int32
+    q_ref,  # [1, block_t, 1, group, Dh] VMEM
+    k_ref,  # [1, 1, block_k, Dh] VMEM
+    v_ref,  # [1, 1, block_k, Dh] VMEM
+    o_ref,  # [1, block_t, 1, group, Dh] VMEM
+    m_ref,  # scratch [rows, 1] fp32
+    l_ref,  # scratch [rows, 1] fp32
+    acc_ref,  # scratch [rows, Dh] fp32
+    *,
+    T: int,
+    S: int,
+    block_t: int,
+    block_k: int,
+    group: int,
+    scale: float,
+):
+    pos = pos_ref[0]
+    qi = pl.program_id(2)
+    j = pl.program_id(3)
+    n_j = pl.num_programs(3)
+    rows = block_t * group
+    Dh = q_ref.shape[-1]
+
+    needed = _needed_tiles(pos, qi, T=T, block_t=block_t, block_k=block_k)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full((rows, 1), _NEG, jnp.float32)
+        l_ref[:] = jnp.zeros((rows, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((rows, Dh), jnp.float32)
+
+    @pl.when(j < needed)
+    def _():
+        q = q_ref[0].reshape(rows, Dh).astype(jnp.float32) * scale
+        # Row r of the tile is query (t_local = r // group, head g = r % group);
+        # its absolute position is pos + qi*block_t + t_local.
+        r_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+        t_global = qi * block_t + r_ids // group
+        q_pos = pos + t_global
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 1)
+
+        ks = k_ref[0, 0].astype(jnp.float32)  # [block_k, Dh]
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [rows, block_k]
+        kv_pos = j * block_k + col_ids
+        mask = (t_global < T) & (kv_pos <= q_pos) & (kv_pos < S)
+        s = jnp.where(mask, s, _NEG)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)  # first tile: exp(_NEG - _NEG) == 1
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        vs = v_ref[0, 0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == n_j - 1)
+    def _():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)  # padding rows (t >= T) are all-masked
+        o_ref[0] = (acc_ref[:] / l).reshape(block_t, 1, group, Dh).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_t", "block_k", "interpret"))
+def flash_attend(
+    q: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    pos: jnp.ndarray,
+    *,
+    block_t: int = 0,
+    block_k: int = 0,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Causal GQA flash attention over the (already updated) cache.
+
+    q [B,T,H,Dh], cache_k/v [B,KV,S,Dh], pos scalar int32 (chunk offset).
+    Returns [B,T,H,Dh] in q.dtype. Same contract as `attention.attend`
+    with the mask derived from `pos` instead of passed in.
+    """
+    B, T, H, Dh = q.shape
+    KV, S = cache_k.shape[1], cache_k.shape[2]
+    group = H // KV
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_t <= 0:
+        # ~<=1024 query rows per tile keeps q + fp32 acc well inside VMEM.
+        block_t = max(1, min(T, 1024 // group))
+    if block_k <= 0:
+        block_k = min(S, 256)
+
+    # Heads of one KV group are contiguous in H (h = kv*group + g), so a
+    # [*, block_t, 1, group, Dh] block at KV-index kv covers exactly that
+    # group's queries.
+    q5 = q.reshape(B, T, KV, group, Dh)
+    pos_arr = jnp.reshape(pos.astype(jnp.int32), (1,))
+
+    nt = _needed_tiles  # close over static tile params in the index maps
+
+    def kv_index(b, kv, qi, j, pos_ref):
+        # Clamp dead tiles to the last live one: the block index repeats, so
+        # Pallas skips the DMA and dead grid steps cost nothing.
+        needed = nt(pos_ref[0], qi, T=T, block_t=block_t, block_k=block_k)
+        return (b, kv, jnp.minimum(j, needed - 1), 0)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        T=T,
+        S=S,
+        block_t=block_t,
+        block_k=block_k,
+        group=group,
+        scale=Dh**-0.5,
+    )
+    rows = block_t * group
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, KV, pl.cdiv(T, block_t), pl.cdiv(S, block_k)),
+        in_specs=[
+            pl.BlockSpec(
+                (1, block_t, 1, group, Dh),
+                lambda b, kv, qi, j, pos_ref: (b, qi, kv, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_t, 1, group, Dh),
+            lambda b, kv, qi, j, pos_ref: (b, qi, kv, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, KV, group, Dh), q.dtype),
+        interpret=interpret,
+    )(pos_arr, q5, cache_k, cache_v)
+    return out.reshape(B, T, H, Dh)

@@ -8,7 +8,7 @@ over `pp` (a stage's "layer range" is just its shard), and within a stage
 the Megatron-style tensor split shards attention heads and FFN columns over
 `tp` (column-sharded wq/wk/wv/w_gate/w_up, row-sharded wo/w_down — the psum
 pairing lives in models/*.decoder_layer). Embeddings/head replicate; the
-KV cache [L, B, S, KV, Dh] shards layers over pp, batch over dp, and kv
+KV cache [L, B, KV, S, Dh] shards layers over pp, batch over dp, and kv
 heads over tp. XLA moves exactly one shard's weights to each device.
 """
 
@@ -93,9 +93,9 @@ def shared_specs(shared: dict) -> dict:
 
 
 def cache_spec() -> P:
-    """KV cache [L, B, S, KV, Dh]: layers over pp, batch over dp, kv heads
+    """KV cache [L, B, KV, S, Dh]: layers over pp, batch over dp, kv heads
     over tp."""
-    return P(AXIS_PP, AXIS_DP, None, AXIS_TP, None)
+    return P(AXIS_PP, AXIS_DP, AXIS_TP, None, None)
 
 
 def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict]:

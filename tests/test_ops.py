@@ -52,13 +52,14 @@ def test_rope_preserves_norm_and_relative_phase():
 
 
 def test_kv_cache_update_and_mask():
-    ck = jnp.zeros((1, 8, 2, 4))
-    cv = jnp.zeros((1, 8, 2, 4))
+    # cache [B, KV, S, Dh]; new chunk [B, T, KV, Dh]
+    ck = jnp.zeros((1, 2, 8, 4))
+    cv = jnp.zeros((1, 2, 8, 4))
     k_new = jnp.ones((1, 3, 2, 4))
     ck2, cv2 = attention.update_kv_cache(ck, cv, k_new, k_new * 2, jnp.int32(2))
     arr = np.asarray(ck2)
-    assert (arr[:, 2:5] == 1).all() and (arr[:, :2] == 0).all() and (arr[:, 5:] == 0).all()
-    assert (np.asarray(cv2)[:, 2:5] == 2).all()
+    assert (arr[:, :, 2:5] == 1).all() and (arr[:, :, :2] == 0).all() and (arr[:, :, 5:] == 0).all()
+    assert (np.asarray(cv2)[:, :, 2:5] == 2).all()
 
     mask = np.asarray(attention.causal_mask(jnp.int32(2), 3, 8))
     # query t=0 is absolute position 2: sees slots 0..2
@@ -71,13 +72,13 @@ def test_attend_gqa_equals_repeated_mha():
     rng = np.random.default_rng(0)
     B, T, S, H, KV, Dh = 1, 4, 6, 4, 2, 8
     q = jnp.asarray(rng.normal(size=(B, T, H, Dh)), jnp.float32)
-    ck = jnp.asarray(rng.normal(size=(B, S, KV, Dh)), jnp.float32)
-    cv = jnp.asarray(rng.normal(size=(B, S, KV, Dh)), jnp.float32)
+    ck = jnp.asarray(rng.normal(size=(B, KV, S, Dh)), jnp.float32)
+    cv = jnp.asarray(rng.normal(size=(B, KV, S, Dh)), jnp.float32)
     mask = attention.causal_mask(jnp.int32(2), T, S)
     out = attention.attend(q, ck, cv, mask)
 
-    ck_rep = jnp.repeat(ck, H // KV, axis=2)
-    cv_rep = jnp.repeat(cv, H // KV, axis=2)
+    ck_rep = jnp.repeat(ck.transpose(0, 2, 1, 3), H // KV, axis=2)
+    cv_rep = jnp.repeat(cv.transpose(0, 2, 1, 3), H // KV, axis=2)
     scores = jnp.einsum("bthd,bshd->bhts", q, ck_rep) * (Dh ** -0.5)
     scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
     ref = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(scores, -1), cv_rep)
