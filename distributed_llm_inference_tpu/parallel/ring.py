@@ -1,0 +1,211 @@
+"""Ring attention + context-parallel decode over a sequence (`sp`) mesh axis.
+
+Long-context support the reference cannot express at all — its whole
+sequence lives on every stage and is re-sent over the WAN four times per
+token (/root/reference/Worker1.py:82-177, orchestration.py:114-137). Here
+the SEQUENCE is the sharded axis:
+
+  * `ring_attend` — causal flash attention where Q stays put and K/V
+    chunks rotate around the `sp` ring via `lax.ppermute` (one hop per
+    step, compute overlapped by XLA's async collective-permute). Each
+    device holds seq/sp of the context, so max context scales linearly
+    with the ring size; per-hop traffic is O(chunk), all on ICI.
+
+  * `cp_decode_attend` — decode-time context parallelism: the KV cache is
+    sharded across `sp` devices as an UNORDERED set of (key, value,
+    position) triples. Softmax over a key set is permutation-invariant,
+    so each device attends its local slots (masked by per-slot position
+    tags) and the partials merge with one psum/pmax log-sum-exp combine —
+    a single collective per layer instead of a ring.
+
+Both operate on the LOCAL shard inside `shard_map` and are verified
+against the single-device `ops.attention.attend` in tests/test_ring.py.
+
+Shapes (Tc = local query chunk, Sc = local cache slots, G = H // KV):
+  q_local    [B, Tc, H, Dh]
+  k/v_local  [B, Tc, KV, Dh]   (ring_attend: this device's seq chunk)
+  cache_k/v  [B, KV, Sc, Dh]   (cp_decode_attend: local slot set)
+  pos_ids    [Sc] int32        (absolute position per slot, -1 = empty)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+AXIS_SP = "sp"
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _gqa_scores(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """q [B,T,KV,G,Dh] x k [B,Tk,KV,Dh] -> [B,KV,G,T,Tk] fp32 (unscaled)."""
+    return jnp.einsum(
+        "btkgd,bskd->bkgts", q, k.astype(jnp.float32)
+    )
+
+
+def ring_attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = AXIS_SP,
+) -> jnp.ndarray:
+    """Causal ring attention on sequence-sharded Q/K/V chunks.
+
+    Device i holds queries and keys for global positions
+    [i*Tc, (i+1)*Tc). K/V rotate around the ring; after sp steps every
+    query has seen every key, with causal masking by absolute position.
+    Online-softmax merge keeps only (m, l, acc) between steps.
+
+    q [B,Tc,H,Dh], k/v [B,Tc,KV,Dh] (local chunks) -> [B,Tc,H,Dh].
+    """
+    sp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, Tc, H, Dh = q.shape
+    KV = k.shape[2]
+    G = H // KV
+    scale = Dh**-0.5
+
+    qg = (q.astype(jnp.float32) * scale).reshape(B, Tc, KV, G, Dh)
+    q_pos = my * Tc + jnp.arange(Tc, dtype=jnp.int32)  # [Tc]
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def update(s, m, l, acc, kc, vc):
+        """Online-softmax update with the chunk held at ring step s."""
+        src = (my - s) % sp  # chunk id currently held
+        kv_pos = src * Tc + jnp.arange(Tc, dtype=jnp.int32)
+        mask = kv_pos[None, :] <= q_pos[:, None]  # [Tc, Tc_k]
+        scores = _gqa_scores(qg, kc)  # [B,KV,G,Tc,Tc]
+        scores = jnp.where(mask[None, None, None], scores, _NEG)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(mask[None, None, None], p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bkgts,bskd->bkgtd", p, vc.astype(jnp.float32)
+        )
+        return m_new, l, acc
+
+    def step(s, carry):
+        m, l, acc, kc, vc = carry
+        # Rotate FIRST (chunk ids held locally decrease by one per step, so
+        # causal work stays contiguous); step 0 runs outside the loop on the
+        # resident chunk, so only the sp-1 needed hops are ever sent.
+        kc, vc = jax.lax.ppermute((kc, vc), axis_name, perm)
+        m, l, acc = update(s, m, l, acc, kc, vc)
+        return m, l, acc, kc, vc
+
+    m0 = jnp.full((B, KV, G, Tc, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, KV, G, Tc, 1), jnp.float32)
+    a0 = jnp.zeros((B, KV, G, Tc, Dh), jnp.float32)
+    m0, l0, a0 = update(0, m0, l0, a0, k, v)
+    m, l, acc, _, _ = jax.lax.fori_loop(1, sp, step, (m0, l0, a0, k, v))
+
+    l = jnp.where(l == 0.0, 1.0, l)  # only padding rows can be all-masked
+    out = acc / l  # [B,KV,G,Tc,Dh]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tc, H, Dh).astype(q.dtype)
+
+
+def cp_decode_attend(
+    q: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    pos_ids: jnp.ndarray,
+    pos: jnp.ndarray,
+    axis_name: str = AXIS_SP,
+) -> jnp.ndarray:
+    """Decode attention over a context-sharded KV cache.
+
+    Each device holds an unordered local slot set (cache_k/v + pos_ids);
+    a slot participates iff 0 <= pos_ids[s] <= pos. Local flash partials
+    (m, l, acc) merge across `sp` with pmax/psum — softmax over a key set
+    is permutation-invariant, so slot placement across devices is free.
+
+    q [B,T,H,Dh] (replicated over sp), cache_k/v [B,KV,Sc,Dh],
+    pos_ids [Sc], pos scalar int32 -> [B,T,H,Dh] (replicated over sp).
+    """
+    B, T, H, Dh = q.shape
+    KV, Sc = cache_k.shape[1], cache_k.shape[2]
+    G = H // KV
+    scale = Dh**-0.5
+
+    qg = (q.astype(jnp.float32) * scale).reshape(B, T, KV, G, Dh)
+    # A slot participates iff occupied; each query t at absolute position
+    # pos+t sees slots with pos_ids <= pos+t (covers T>1 chunked decode).
+    q_abs = pos + jnp.arange(T, dtype=jnp.int32)
+    mask = (pos_ids >= 0)[None, :] & (pos_ids[None, :] <= q_abs[:, None])  # [T, Sc]
+
+    scores = jnp.einsum(
+        "btkgd,bksd->bkgts", qg, cache_k.astype(jnp.float32)
+    )
+    scores = jnp.where(mask[None, None, None], scores, _NEG)
+    m_loc = jnp.max(scores, axis=-1, keepdims=True)  # [B,KV,G,T,1]
+    p = jnp.exp(scores - m_loc)
+    p = jnp.where(mask[None, None, None], p, 0.0)
+    l_loc = jnp.sum(p, axis=-1, keepdims=True)
+    acc_loc = jnp.einsum("bkgts,bksd->bkgtd", p, cache_v.astype(jnp.float32))
+
+    # Log-sum-exp merge across the sp axis: one pmax + two psums.
+    m_glb = jax.lax.pmax(m_loc, axis_name)
+    w = jnp.exp(m_loc - m_glb)
+    l_glb = jax.lax.psum(l_loc * w, axis_name)
+    acc_glb = jax.lax.psum(acc_loc * w, axis_name)
+
+    l_glb = jnp.where(l_glb == 0.0, 1.0, l_glb)
+    out = acc_glb / l_glb  # [B,KV,G,T,Dh]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, Dh).astype(q.dtype)
+
+
+def cp_cache_append(
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    pos_ids: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+    fill: jnp.ndarray,
+    axis_name: str = AXIS_SP,
+):
+    """Append one decoded token's K/V to the context-sharded cache.
+
+    Ownership round-robins over the ring (owner = pos % sp) so local fill
+    stays balanced; the owner writes at its next free slot, everyone else
+    no-ops. All devices run the same program (SPMD) — the write is gated,
+    not branched.
+
+    k_new/v_new [B, 1, KV, Dh]; fill [1] int32 = this device's local fill
+    count (shape [1], not scalar, so shard_map can concatenate it over sp).
+    Returns (cache_k, cache_v, pos_ids, fill, overflow) — overflow [1] bool
+    is True (on every device) when the owner's shard was already full: the
+    token was NOT stored, and the caller must stop decoding. Size local
+    shards as Sc >= ceil(max positions / sp) so this never fires; there is
+    no silent eviction.
+    """
+    sp = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    Sc = cache_k.shape[2]
+    full = fill[0] >= Sc
+    owner = ((pos % sp) == my) & jnp.logical_not(full)
+    overflow = jax.lax.pmax(
+        (((pos % sp) == my) & full).astype(jnp.int32), axis_name
+    ).astype(bool)
+    slot = jnp.minimum(fill[0], Sc - 1)
+
+    kc = k_new.astype(cache_k.dtype).transpose(0, 2, 1, 3)  # [B,KV,1,Dh]
+    vc = v_new.astype(cache_v.dtype).transpose(0, 2, 1, 3)
+    zero = jnp.int32(0)
+    start = (zero, zero, slot, zero)
+    old_k = jax.lax.dynamic_slice(cache_k, start, kc.shape)
+    old_v = jax.lax.dynamic_slice(cache_v, start, vc.shape)
+    kc = jnp.where(owner, kc, old_k)
+    vc = jnp.where(owner, vc, old_v)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, kc, start)
+    cache_v = jax.lax.dynamic_update_slice(cache_v, vc, start)
+
+    old_id = jax.lax.dynamic_slice(pos_ids, (slot,), (1,))
+    new_id = jnp.where(owner, pos.astype(jnp.int32)[None], old_id)
+    pos_ids = jax.lax.dynamic_update_slice(pos_ids, new_id, (slot,))
+    fill = fill + owner.astype(jnp.int32)
+    return cache_k, cache_v, pos_ids, fill, overflow[None]

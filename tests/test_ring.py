@@ -1,0 +1,176 @@
+"""Ring attention / context-parallel decode vs single-device attention.
+
+Runs on the virtual 8-device CPU mesh (conftest.py) via shard_map over an
+`sp` axis; reference is ops.attention.attend over the full sequence.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from distributed_llm_inference_tpu.ops.attention import attend, causal_mask
+from distributed_llm_inference_tpu.parallel.ring import (
+    AXIS_SP,
+    cp_cache_append,
+    cp_decode_attend,
+    ring_attend,
+)
+
+
+def _sp_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), (AXIS_SP,))
+
+
+def _full_attend_ref(q, k, v):
+    """Causal full attention from [B,S,H,Dh] q and [B,S,KV,Dh] k/v."""
+    S = q.shape[1]
+    ck = k.transpose(0, 2, 1, 3)  # [B,KV,S,Dh]
+    cv = v.transpose(0, 2, 1, 3)
+    return attend(q, ck, cv, causal_mask(jnp.int32(0), S, S))
+
+
+@pytest.mark.parametrize("sp,B,S,H,KV,Dh", [(4, 2, 32, 4, 2, 16), (8, 1, 64, 8, 8, 8)])
+def test_ring_attend_matches_full(sp, B, S, H, KV, Dh):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, Dh), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, KV, Dh), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, KV, Dh), jnp.float32)
+    ref = _full_attend_ref(q, k, v)
+
+    mesh = _sp_mesh(sp)
+    spec = P(None, AXIS_SP)  # shard the sequence axis
+    fn = shard_map(
+        ring_attend,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    got = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("T", [1, 3])
+def test_cp_decode_attend_matches_full(T):
+    """Scatter a 20-token history across 4 devices in arbitrary slot order;
+    CP decode of the next chunk must equal single-device cached attention."""
+    sp, B, H, KV, Dh, Sc = 4, 2, 4, 2, 16, 8
+    hist = 20
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, Dh), jnp.float32)
+    k_hist = jax.random.normal(ks[1], (B, hist + T, KV, Dh), jnp.float32)
+    v_hist = jax.random.normal(ks[2], (B, hist + T, KV, Dh), jnp.float32)
+
+    # Reference: ordinary cache with history+chunk at slots 0..hist+T.
+    S = 32
+    ck = jnp.zeros((B, KV, S, Dh)).at[:, :, : hist + T].set(
+        k_hist.transpose(0, 2, 1, 3)
+    )
+    cv = jnp.zeros((B, KV, S, Dh)).at[:, :, : hist + T].set(
+        v_hist.transpose(0, 2, 1, 3)
+    )
+    ref = attend(q, ck, cv, causal_mask(jnp.int32(hist), T, S))
+
+    # CP cache: position p on device p % sp, in REVERSED local slot order to
+    # prove permutation invariance. Unused slots have pos_id -1 and garbage K/V.
+    rng = np.random.default_rng(0)
+    lk = np.asarray(rng.normal(size=(sp, B, KV, Sc, Dh)), np.float32)
+    lv = np.asarray(rng.normal(size=(sp, B, KV, Sc, Dh)), np.float32)
+    lpos = np.full((sp, Sc), -1, np.int32)
+    fill = np.zeros(sp, np.int32)
+    for p in range(hist + T):
+        d = p % sp
+        slot = Sc - 1 - fill[d]  # reversed order
+        lk[d, :, :, slot] = np.asarray(k_hist[:, p])
+        lv[d, :, :, slot] = np.asarray(v_hist[:, p])
+        lpos[d, slot] = p
+        fill[d] += 1
+
+    mesh = _sp_mesh(sp)
+    fn = shard_map(
+        functools.partial(cp_decode_attend, axis_name=AXIS_SP),
+        mesh=mesh,
+        in_specs=(P(), P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P()),
+        out_specs=P(),
+    )
+    # Stack shards on a leading sp axis and shard it away.
+    got = jax.jit(fn)(
+        q,
+        jnp.asarray(lk).reshape(sp * B, KV, Sc, Dh),
+        jnp.asarray(lv).reshape(sp * B, KV, Sc, Dh),
+        jnp.asarray(lpos).reshape(sp * Sc),
+        jnp.int32(hist),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+
+def test_cp_cache_append_round_robin():
+    """Appends land on owner = pos % sp at the next free slot; replicated
+    outputs stay consistent."""
+    sp, B, KV, Sc, Dh = 4, 1, 2, 4, 8
+    mesh = _sp_mesh(sp)
+
+    def body(ck, cv, pids, fill, k_new, v_new, pos):
+        return cp_cache_append(ck, cv, pids, k_new, v_new, pos, fill)
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P(), P(), P()),
+        out_specs=(P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P()),
+    )
+    ck = jnp.zeros((sp * B, KV, Sc, Dh))
+    cv = jnp.zeros((sp * B, KV, Sc, Dh))
+    pids = jnp.full((sp * Sc,), -1, jnp.int32)
+    fill = jnp.zeros((sp,), jnp.int32)
+    for p in range(6):
+        k_new = jnp.full((B, 1, KV, Dh), float(p + 1))
+        ck, cv, pids, fill, overflow = jax.jit(fn)(
+            ck, cv, pids, fill, k_new, k_new * 2, jnp.int32(p)
+        )
+        assert not bool(overflow[0])
+    pids = np.asarray(pids).reshape(sp, Sc)
+    fill = np.asarray(fill)
+    # positions 0..5 round-robin: dev0 got {0,4}, dev1 {1,5}, dev2 {2}, dev3 {3}
+    assert fill.tolist() == [2, 2, 1, 1]
+    assert pids[0, :2].tolist() == [0, 4] and pids[1, :2].tolist() == [1, 5]
+    assert pids[2, 0] == 2 and pids[3, 0] == 3
+    ck = np.asarray(ck).reshape(sp, B, KV, Sc, Dh)
+    assert ck[0, 0, 0, 0, 0] == 1.0 and ck[0, 0, 0, 1, 0] == 5.0
+    assert ck[1, 0, 0, 1, 0] == 6.0
+
+
+def test_cp_cache_append_overflow_flag():
+    """A full owner shard sets overflow on every device and stores nothing."""
+    sp, B, KV, Sc, Dh = 2, 1, 1, 1, 8
+    mesh = _sp_mesh(sp)
+    fn = shard_map(
+        lambda ck, cv, pids, fill, k_new, v_new, pos: cp_cache_append(
+            ck, cv, pids, k_new, v_new, pos, fill
+        ),
+        mesh=mesh,
+        in_specs=(P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P(), P(), P()),
+        out_specs=(P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P(AXIS_SP), P()),
+    )
+    ck = jnp.zeros((sp * B, KV, Sc, Dh))
+    cv = jnp.zeros((sp * B, KV, Sc, Dh))
+    pids = jnp.full((sp * Sc,), -1, jnp.int32)
+    fill = jnp.zeros((sp,), jnp.int32)
+    for p in range(2):  # fills both single-slot shards
+        k_new = jnp.full((B, 1, KV, Dh), float(p + 1))
+        ck, cv, pids, fill, overflow = jax.jit(fn)(
+            ck, cv, pids, fill, k_new, k_new, jnp.int32(p)
+        )
+        assert not bool(overflow[0])
+    k_new = jnp.full((B, 1, KV, Dh), 99.0)
+    ck2, cv2, pids2, fill2, overflow = jax.jit(fn)(
+        ck, cv, pids, fill, k_new, k_new, jnp.int32(2)
+    )
+    assert bool(overflow[0])
+    np.testing.assert_array_equal(np.asarray(ck2), np.asarray(ck))  # nothing stored
+    np.testing.assert_array_equal(np.asarray(pids2), np.asarray(pids))
+    np.testing.assert_array_equal(np.asarray(fill2), np.asarray(fill))
