@@ -1,0 +1,149 @@
+"""Local checkpoint store with per-stage slice loading.
+
+The reference has no checkpointing at all: every process re-downloads the
+FULL model from the HF Hub at every boot and then throws most of it away
+(/root/reference/Worker1.py:60-75, orchestration.py:39-53 — SURVEY.md §5
+"checkpoint/resume"). Here converted params (models/convert.py) are saved
+once to a local directory and reloaded in milliseconds, and — because the
+per-layer tensors are STACKED on a leading layer axis — a pipeline stage
+can load exactly its `layers[start:end]` slice via numpy memory-mapping:
+only the pages of its own shard are ever read from disk.
+
+Format: one `.npy` per pytree leaf (slash-joined key paths, `/` -> `__`)
+plus `manifest.json` holding the ModelConfig and each leaf's logical
+dtype. bfloat16 leaves are stored as their raw uint16 bit patterns (np.save
+round-trips ml_dtypes unreliably) and re-viewed on load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+from ..config import ModelConfig, stage_layer_range
+
+_MANIFEST = "manifest.json"
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(flat: dict) -> dict:
+    out: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def _leaf_file(key: str) -> str:
+    return key.replace("/", "__") + ".npy"
+
+
+def save_params(path: str, cfg: ModelConfig, params: dict) -> None:
+    """Write params + config to `path` (created if needed)."""
+    os.makedirs(path, exist_ok=True)
+    flat = _flatten(params)
+    leaves = {}
+    for key, leaf in flat.items():
+        arr = np.asarray(leaf)
+        logical = str(arr.dtype)
+        if arr.dtype == ml_dtypes.bfloat16:
+            arr = arr.view(np.uint16)
+        np.save(os.path.join(path, _leaf_file(key)), arr)
+        leaves[key] = {"dtype": logical}
+    manifest = {"config": dataclasses.asdict(cfg), "leaves": leaves}
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def _read_manifest(path: str) -> tuple[ModelConfig, dict]:
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    cfg = ModelConfig(**manifest["config"])
+    return cfg, manifest["leaves"]
+
+
+def _load_leaf(
+    path: str, key: str, logical: str, layer_slice: Optional[tuple] = None
+):
+    """mmap-load one leaf; with layer_slice=(start, end) only that slice of
+    the leading (layer) axis is copied out of the mapping."""
+    arr = np.load(os.path.join(path, _leaf_file(key)), mmap_mode="r")
+    if layer_slice is not None:
+        arr = arr[layer_slice[0] : layer_slice[1]]
+    arr = np.ascontiguousarray(arr)
+    if logical == "bfloat16":
+        arr = arr.view(ml_dtypes.bfloat16)
+    return jnp.asarray(arr)
+
+
+def load_params(path: str) -> tuple[ModelConfig, dict]:
+    """Full restore: (cfg, params)."""
+    cfg, leaves = _read_manifest(path)
+    flat = {k: _load_leaf(path, k, meta["dtype"]) for k, meta in leaves.items()}
+    return cfg, _unflatten(flat)
+
+
+def load_stage_params(
+    path: str,
+    pp: int,
+    stage: int,
+    *,
+    load_embed: Optional[bool] = None,
+    load_head: Optional[bool] = None,
+) -> tuple[ModelConfig, dict]:
+    """Restore one pipeline stage's shard: `layers/*` sliced to
+    stage_layer_range(n_layers, pp, stage); shared leaves filtered by role.
+
+    Embeddings are needed by the FIRST stage (token/pos embed) and the
+    final norm + LM head by the LAST (defaults follow §7's design: embed
+    and head live on first/last stages, not a separate orchestrator). Pass
+    load_embed/load_head to override. Note tied-embedding models
+    (gpt2/TinyLlama variants) need `embed` on the last stage too — the
+    default handles that.
+    """
+    cfg, leaves = _read_manifest(path)
+    start, end = stage_layer_range(cfg.n_layers, pp, stage)
+    first, last = stage == 0, stage == pp - 1
+    explicit_embed = load_embed  # None = role-based defaults below
+    if load_embed is None:
+        load_embed = first or (last and cfg.tie_embeddings)
+    if load_head is None:
+        load_head = last
+
+    flat = {}
+    for key, meta in leaves.items():
+        if key.startswith("layers/"):
+            flat[key] = _load_leaf(path, key, meta["dtype"], (start, end))
+            continue
+        if key == "pos_embed":
+            # read only by the first stage's embedding step — a tied-head
+            # last stage needs `embed` but never `pos_embed`
+            want = first if explicit_embed is None else explicit_embed
+        elif key == "embed":
+            want = load_embed
+        elif key in ("lm_head", "final_norm", "final_norm_w", "final_norm_b"):
+            want = load_head
+        else:
+            want = True  # unknown shared leaf: keep it everywhere (safe default)
+        if want:
+            flat[key] = _load_leaf(path, key, meta["dtype"])
+    return cfg, _unflatten(flat)
