@@ -19,6 +19,7 @@ N stages over a mesh with the same (prefill, decode) interface.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Optional
@@ -88,6 +89,12 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._key = jax.random.PRNGKey(seed)
         self.request_count = 0
+        # Rolling per-request perf samples for p50/p90 TTFT + throughput
+        # (BASELINE.json's metric is p50 TTFT — a measurement, not a print).
+        # Own lock, NOT self._lock: that one is held for a whole generation,
+        # and /health must not block behind a multi-second decode.
+        self._samples = collections.deque(maxlen=256)
+        self._samples_lock = threading.Lock()
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
         # between requests are harmless — prefill rewrites slots [0, bucket)
@@ -189,6 +196,8 @@ class InferenceEngine:
         elapsed = time.time() - t_start
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
+        with self._samples_lock:
+            self._samples.append({"ttft_s": ttft, "tokens_per_sec": tps, "tokens": n})
         return {
             "prompt": prompt,
             "response": response,
@@ -200,6 +209,34 @@ class InferenceEngine:
             "backend": self.backend.name,
         }
 
+    # -- perf stats ----------------------------------------------------------
+    def stats(self) -> dict:
+        """Rolling p50/p90 over recent requests (TTFT seconds, tokens/sec).
+
+        Snapshot under the samples lock: /stats and /health are served from
+        other threads while a generate() may be appending to the deque.
+        """
+        with self._samples_lock:
+            samples = list(self._samples)
+
+        def pct(vals, q):
+            if not vals:
+                return None
+            vals = sorted(vals)
+            idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
+            return round(vals[idx], 4)
+
+        ttfts = [s["ttft_s"] for s in samples]
+        tpss = [s["tokens_per_sec"] for s in samples]
+        return {
+            "window": len(samples),
+            "ttft_p50_s": pct(ttfts, 0.5),
+            "ttft_p90_s": pct(ttfts, 0.9),
+            "tokens_per_sec_p50": pct(tpss, 0.5),
+            "tokens_per_sec_p90": pct(tpss, 0.9),
+            "tokens_total": sum(s["tokens"] for s in samples),
+        }
+
     # -- health (reference /health + /workers, orchestration.py:297-329) ----
     def health(self) -> dict:
         return {
@@ -208,6 +245,7 @@ class InferenceEngine:
             "backend": self.backend.name,
             "n_stages": getattr(self.backend, "n_stages", 1),
             "requests_served": self.request_count,
+            "stats": self.stats(),
         }
 
     def workers(self) -> dict:

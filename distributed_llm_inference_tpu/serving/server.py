@@ -67,7 +67,63 @@ requests served: <b>{h['requests_served']}</b></p>
 </body></html>"""
 
 
-def make_handler(engine, max_tokens_cap: int):
+class _Profiler:
+    """jax.profiler trace capture behind HTTP (SURVEY.md §5 tracing note:
+    the reference's only 'profiling' is wall-clock prints,
+    /root/reference/orchestration.py:82,201). Traces are viewable in
+    TensorBoard / Perfetto.
+
+    Clients name a subdirectory, not a path: traces always land under
+    `base` — otherwise POST /profiler/start would be an arbitrary
+    filesystem-write primitive for anyone who can reach the port."""
+
+    def __init__(self, base: str = "/tmp/jax-traces"):
+        self._lock = threading.Lock()
+        self.base = base
+        self.dir: Optional[str] = None
+
+    def _resolve(self, name: str) -> str:
+        import os
+
+        name = name or "trace"
+        if os.path.isabs(name) or ".." in name.split("/"):
+            raise ValueError(f"trace_dir must be a relative subdir name, got {name!r}")
+        out = os.path.normpath(os.path.join(self.base, name))
+        if not (out + "/").startswith(os.path.normpath(self.base) + "/"):
+            raise ValueError(f"trace_dir escapes base: {name!r}")
+        return out
+
+    def start(self, trace_dir: str) -> dict:
+        import jax
+
+        with self._lock:
+            if self.dir is not None:
+                return {"error": f"trace already running to {self.dir}"}
+            try:
+                resolved = self._resolve(trace_dir)
+                jax.profiler.start_trace(resolved)
+            except Exception as e:
+                return {"error": f"profiler start failed: {e}"}
+            self.dir = resolved
+            return {"status": "tracing", "trace_dir": resolved}
+
+    def stop(self) -> dict:
+        import jax
+
+        with self._lock:
+            if self.dir is None:
+                return {"error": "no trace running"}
+            out, self.dir = self.dir, None  # clear even if stop raises
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                return {"error": f"profiler stop failed: {e}", "trace_dir": out}
+            return {"status": "stopped", "trace_dir": out}
+
+
+def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None):
+    profiler = profiler or _Profiler()
+
     class Handler(BaseHTTPRequestHandler):
         # quiet default request logging; serving logs are structured
         def log_message(self, fmt, *args):
@@ -103,6 +159,7 @@ def make_handler(engine, max_tokens_cap: int):
                         "backend": h["backend"],
                         "n_stages": h["n_stages"],
                         "requests_served": h["requests_served"],
+                        "stats": h["stats"],
                     },
                 )
             elif path == "/workers":
@@ -116,19 +173,39 @@ def make_handler(engine, max_tokens_cap: int):
                 }
                 results["detail"] = stages
                 self._send(200, results)
+            elif path == "/stats":
+                self._send(200, engine.stats())
             else:
                 self._send(404, {"error": f"no route {path}"})
 
+        def _read_json(self):
+            """Parse the request body; None (after a 400 reply) on bad JSON."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, json.JSONDecodeError):
+                self._send(400, {"error": "invalid JSON body"})
+                return None
+
         def do_POST(self):
             path = self.path.split("?")[0].rstrip("/")
+            if path == "/profiler/start":
+                data = self._read_json()
+                if data is None:
+                    return
+                # default is a subdir NAME under the profiler base, not a path
+                res = profiler.start(data.get("trace_dir", "trace"))
+                self._send(400 if "error" in res else 200, res)
+                return
+            if path == "/profiler/stop":
+                res = profiler.stop()
+                self._send(400 if "error" in res else 200, res)
+                return
             if path != "/generate":
                 self._send(404, {"error": f"no route {path}"})
                 return
-            try:
-                length = int(self.headers.get("Content-Length", 0))
-                data = json.loads(self.rfile.read(length) or b"{}")
-            except (ValueError, json.JSONDecodeError):
-                self._send(400, {"error": "invalid JSON body"})
+            data = self._read_json()
+            if data is None:
                 return
             prompt = data.get("prompt", "")
             if not prompt:

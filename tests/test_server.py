@@ -137,3 +137,54 @@ def test_bad_param_type_is_400(served):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req, timeout=10)
     assert ei.value.code == 400
+
+
+def test_stats_route_and_percentiles(served, client):
+    client.generate("warm stats", max_tokens=3, verbose=False)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{served.port}/stats", timeout=10
+    ) as r:
+        s = json.loads(r.read())
+    assert s["window"] >= 1
+    assert s["ttft_p50_s"] is not None and s["ttft_p50_s"] >= 0
+    assert s["tokens_per_sec_p50"] is not None
+    # /health embeds the same rolling stats
+    h = client.check_health()
+    assert h["stats"]["window"] >= 1
+
+
+def test_profiler_start_stop(served, tmp_path):
+    def post(path, payload=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{served.port}{path}",
+            data=json.dumps(payload or {}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    # trace_dir is a SUBDIR NAME under the server's base, never a raw path
+    res = post("/profiler/start", {"trace_dir": "unit-test-trace"})
+    assert res["status"] == "tracing"
+    assert res["trace_dir"].endswith("/unit-test-trace")
+    res = post("/profiler/stop")
+    assert res["status"] == "stopped"
+    # absolute / escaping paths are rejected (filesystem-write primitive)
+    for bad in ("/etc/cron.d", "../escape"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{served.port}/profiler/start",
+            data=json.dumps({"trace_dir": bad}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400, bad
+    # double-stop is a clean 400
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served.port}/profiler/stop", data=b"{}", method="POST"
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
