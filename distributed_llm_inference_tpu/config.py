@@ -74,21 +74,24 @@ class ModelConfig:
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Shape of the device mesh. Axes: data, pipeline, tensor.
+    """Shape of the device mesh. Axes: data, pipeline, sequence, tensor.
 
     The reference's topology (orchestrator + 2 HTTP workers) maps to
-    pp_stages=2; here any (dp, pp, tp) factorization of the available
-    devices is valid as long as n_layers % pp_stages == 0 and
-    n_kv_heads % tp == 0.
+    pp_stages=2; here any (dp, pp, sp, tp) factorization of the available
+    devices is valid as long as n_layers % pp == 0, n_kv_heads % tp == 0,
+    and (for sp > 1) the prefill bucket % sp == 0. sp is the long-context
+    axis: ring-attention prefill + context-parallel KV-cache decode
+    (parallel/ring.py, parallel/context.py).
     """
 
     dp: int = 1
     pp: int = 1
+    sp: int = 1
     tp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.pp * self.tp
+        return self.dp * self.pp * self.sp * self.tp
 
 
 @dataclasses.dataclass(frozen=True)

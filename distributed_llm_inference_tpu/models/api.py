@@ -30,7 +30,15 @@ def embed(cfg, params, tokens, pos=0):
     return family(cfg).embed(cfg, params, tokens, pos)
 
 
-def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
+def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None,
+                   attn_hook=None):
+    if attn_hook is not None:
+        # attn_hook is a llama-family seam (parallel/context.py); gpt2's
+        # block doesn't expose it, and callers that pass one have already
+        # checked the arch.
+        return family(cfg).forward_layers(
+            cfg, layers, x, cache, pos, update_gate, tp_axis, attn_hook
+        )
     return family(cfg).forward_layers(cfg, layers, x, cache, pos, update_gate,
                                       tp_axis)
 

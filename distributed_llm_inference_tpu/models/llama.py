@@ -86,6 +86,22 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate):
+    """Cache write + attention for the dense (whole-cache-per-device) case.
+
+    The hook seam lets SPMD backends swap the attention/cache strategy per
+    topology without forking the block: parallel/context.py substitutes
+    ring attention (prefill) and context-parallel merge (decode) here.
+    Returns (attn [B,T,H,Dh], cache_k, cache_v).
+    """
+    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
+    if cfg.attn_impl == "pallas":
+        attn = flash_attend(q, new_k, new_v, pos)
+    else:
+        attn = attend(q, new_k, new_v, mask)
+    return attn, new_k, new_v
+
+
 def decoder_layer(
     cfg: ModelConfig,
     lp: Params,
@@ -98,6 +114,7 @@ def decoder_layer(
     mask: jnp.ndarray,
     update_gate: Optional[jnp.ndarray] = None,
     tp_axis: Optional[str] = None,
+    attn_hook=None,
 ):
     """One pre-norm decoder block on a chunk x [B,T,D] at offset `pos`.
 
@@ -105,6 +122,8 @@ def decoder_layer(
     update_gate: optional traced bool — when False the cache write is
     discarded (needed by the pipeline runtime, where a stage executes
     speculatively on microsteps when it holds no valid microbatch).
+    attn_hook: optional override of `default_attn_hook` (same signature) —
+    the context-parallel backend injects ring / merged attention here.
 
     Tensor parallelism (Megatron-style): under `shard_map` with a `tp` mesh
     axis, lp holds the HEAD-SLICED shard (wq/wk/wv column-sharded over
@@ -124,11 +143,8 @@ def decoder_layer(
     v = (h @ lp["wv"]).reshape(B, T, KV, Dh)
     q, k = apply_rope(q, k, cos, sin)
 
-    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    if cfg.attn_impl == "pallas":
-        attn = flash_attend(q, new_k, new_v, pos)
-    else:
-        attn = attend(q, new_k, new_v, mask)
+    hook = attn_hook or default_attn_hook
+    attn, new_k, new_v = hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate)
     attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -151,12 +167,13 @@ def forward_layers(
     pos: jnp.ndarray,
     update_gate: Optional[jnp.ndarray] = None,
     tp_axis: Optional[str] = None,
+    attn_hook=None,
 ):
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
 
     x: [B, T, D]; cache k/v: [L_slice, B, KV, S, Dh]; pos: scalar int32.
-    Returns (x, new_cache).
+    Returns (x, new_cache). attn_hook: see decoder_layer.
     """
     T = x.shape[1]
     S = cache["k"].shape[3]
@@ -168,7 +185,8 @@ def forward_layers(
         xc = carry
         lp, ck, cv = xs
         xc, ck, cv = decoder_layer(
-            cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate, tp_axis
+            cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate, tp_axis,
+            attn_hook,
         )
         return xc, (ck, cv)
 

@@ -1,0 +1,268 @@
+"""Context-parallel SPMD backend: the SEQUENCE is the sharded axis.
+
+Long-context serving the reference cannot express — it ships the WHOLE
+sequence through every stage over the WAN four times per token
+(/root/reference/orchestration.py:114-137) and caps output at 30 tokens to
+survive its O(n²) recompute (orchestration.py:347). Here an `sp` ring of
+devices splits the context:
+
+  * prefill — tokens shard over `sp`; every layer runs `ring_attend`
+    (parallel/ring.py): K/V chunks rotate over ICI while queries stay put,
+    so each device holds seq/sp of the activations and KV cache and max
+    context scales linearly with the ring size;
+  * decode — activations are replicated (one token), but the KV cache
+    stays sharded: each device attends its local position-tagged slot set
+    and the partials merge with one pmax/psum log-sum-exp combine per
+    layer (`cp_decode_attend`); decoded tokens round-robin across shards;
+  * both phases inject their attention strategy through
+    `models/llama.decoder_layer`'s attn_hook seam — same block, same
+    weights, different cache topology.
+
+Engine-compatible (same init_cache/prefill/decode/health interface as
+SingleDeviceBackend / PipelineBackend); the cache pytree additionally
+carries `pos_ids` (absolute position per local slot, -1 = empty) and
+`fill` (per-device slot count). Composes with dp (batch shards) and tp
+(head shards); pp must be 1 — layer scans run whole-model per device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import ModelConfig
+from ..models import api as M
+from ..ops.sampling import sample_token
+from .mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
+from .pipeline import SPMDBackendBase
+from .ring import cp_decode_attend, cp_kv_write, cp_select_slot, ring_attend
+
+# pos_ids/fill carry a leading dp axis: each dp ring decodes independently
+# (its while_loop may exit at a different step), so its slot bookkeeping
+# diverges and must be dp-sharded, not replicated.
+_AUX_SPEC = P(AXIS_DP, AXIS_SP)
+
+
+def cp_cache_spec() -> P:
+    """KV cache [L, B, KV, S, Dh]: batch over dp, kv heads over tp, and —
+    unlike the dense cache_spec() — the SLOT axis over sp."""
+    return P(AXIS_PP, AXIS_DP, AXIS_TP, AXIS_SP, None)
+
+
+class ContextParallelBackend(SPMDBackendBase):
+    """dp × sp × tp backend with a sequence-sharded KV cache."""
+
+    name = "context-parallel"
+
+    def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
+        if cfg.arch != "llama":
+            raise NotImplementedError(
+                f"context parallelism is wired for the llama family (attn_hook "
+                f"seam); got arch={cfg.arch!r}"
+            )
+        if int(mesh.shape[AXIS_PP]) != 1:
+            raise ValueError("ContextParallelBackend needs pp == 1 (no layer sharding)")
+        self.sp = int(mesh.shape[AXIS_SP])
+        if self.sp < 2:
+            raise ValueError("ContextParallelBackend needs sp >= 2")
+        super().__init__(cfg, params, mesh)
+        self.n_stages = self.sp  # /workers reports context shards
+
+    # -- cache ---------------------------------------------------------------
+    def local_slots(self, max_seq: int) -> int:
+        """Per-device slot count: even share of max_seq plus one slot of
+        round-robin slack (decode appends differ by at most one across the
+        ring)."""
+        return -(-max_seq // self.sp) + 1
+
+    def init_cache(self, batch: int, max_seq: int):
+        cfg, sp, dp = self.cfg, self.sp, self.dp
+        Sc = self.local_slots(max_seq)
+        kv_sharding = NamedSharding(self.mesh, cp_cache_spec())
+        aux_sharding = NamedSharding(self.mesh, _AUX_SPEC)
+
+        @jax.jit
+        def make():
+            kv = M.init_kv_cache(cfg, batch, max_seq=sp * Sc)
+            kv = jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(x, kv_sharding), kv
+            )
+            pos_ids = jax.lax.with_sharding_constraint(
+                jnp.full((dp, sp * Sc), -1, jnp.int32), aux_sharding
+            )
+            fill = jax.lax.with_sharding_constraint(
+                jnp.zeros((dp, sp), jnp.int32), aux_sharding
+            )
+            return {"k": kv["k"], "v": kv["v"], "pos_ids": pos_ids, "fill": fill}
+
+        return make()
+
+    def prefill(self, tokens, prompt_len, cache, key, sampling):
+        if tokens.shape[1] % self.sp:
+            raise ValueError(
+                f"prefill bucket {tokens.shape[1]} not divisible by sp={self.sp}; "
+                f"pick prefill_buckets that are multiples of the ring size"
+            )
+        return super().prefill(tokens, prompt_len, cache, key, sampling)
+
+    # -- prefill -------------------------------------------------------------
+    def _build_prefill(self):
+        cfg = self.cfg
+
+        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate):
+            attn = ring_attend(q, k, v, AXIS_SP)
+            zero = jnp.int32(0)
+            kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
+            vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
+            ck = jax.lax.dynamic_update_slice(ck, kc, (zero, zero, zero, zero))
+            cv = jax.lax.dynamic_update_slice(cv, vc, (zero, zero, zero, zero))
+            return attn, ck, cv
+
+        def body(shared, layers, tokens, prompt_len, cache, key, sampling):
+            key = self._dp_key(key)
+            my = jax.lax.axis_index(AXIS_SP)
+            Tc = tokens.shape[1]  # local chunk of the padded bucket
+            Sc = cache["k"].shape[3]
+            chunk_start = my * Tc
+
+            x = M.embed(cfg, shared, tokens, chunk_start)
+            x, kv = M.forward_layers(
+                cfg, layers, x, {"k": cache["k"], "v": cache["v"]},
+                jnp.asarray(chunk_start, jnp.int32),
+                tp_axis=self.tp_axis, attn_hook=ring_hook,
+            )
+
+            # slot bookkeeping: slots [0,Tc) hold this chunk's positions,
+            # pad positions (>= prompt_len) stay invalid
+            lpos = chunk_start + jnp.arange(Tc, dtype=jnp.int32)
+            pos_ids = jnp.full((1, Sc), -1, jnp.int32)
+            pos_ids = pos_ids.at[0, :Tc].set(jnp.where(lpos < prompt_len, lpos, -1))
+            fill = jnp.clip(prompt_len - chunk_start, 0, Tc)[None, None]
+
+            # logits of the last prompt position live on one ring member;
+            # masked psum broadcasts them (same pattern as the pp backend)
+            li = prompt_len - 1 - chunk_start
+            owner = (li >= 0) & (li < Tc)
+            last = jax.lax.dynamic_slice_in_dim(x, jnp.clip(li, 0, Tc - 1), 1, axis=1)
+            logits_local = M.unembed(cfg, shared, last)[:, 0, :]
+            logits = jax.lax.psum(jnp.where(owner, logits_local, 0.0), AXIS_SP)
+            first = sample_token(key, logits, *sampling)
+            cache = {"k": kv["k"], "v": kv["v"], "pos_ids": pos_ids, "fill": fill}
+            return first, logits, cache
+
+        cache_specs = {
+            "k": cp_cache_spec(), "v": cp_cache_spec(),
+            "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
+        }
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                P(), self._layer_specs, P(AXIS_DP, AXIS_SP), P(), cache_specs,
+                P(), P(),
+            ),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_specs),
+        )
+        return jax.jit(shmapped, donate_argnums=(4,))
+
+    # -- decode --------------------------------------------------------------
+    def _build_decode(self, max_steps: int):
+        cfg, sp = self.cfg, self.sp
+
+        def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
+            key = self._dp_key(key)
+            Sc = cache["k"].shape[3]
+            B = first_token.shape[0]
+            pad = jnp.int32(cfg.pad_token_id)
+            eos = jnp.int32(cfg.eos_token_id)
+            out0 = jnp.full((B, max_steps), pad, jnp.int32)
+            finished0 = first_token == eos
+
+            def cond(c):
+                step, _, _, _, _, _, _, _, finished, _, _ = c
+                return (step < limit) & ~jnp.all(finished)
+
+            def step_fn(c):
+                (step, token, pos, ck, cv, pids, fill, key, finished, out,
+                 n_gen) = c
+                # least-filled shard stores this token (parallel/ring.py:
+                # cp_select_slot rationale — prefill places chunks
+                # contiguously, so pos % sp round-robin would overflow the
+                # prefill-heavy shard long before the cache is full)
+                fills, owner_idx, owner = cp_select_slot(fill[0], AXIS_SP)
+                overflow = fills[owner_idx] >= Sc
+                owner = owner & jnp.logical_not(overflow)
+                slot = jnp.minimum(fill[0, 0], Sc - 1)
+                # local pos_ids view with this token's slot tagged (owner only)
+                old_id = jax.lax.dynamic_slice(pids, (0, slot), (1, 1))
+                new_id = jnp.where(owner, pos.astype(jnp.int32)[None, None], old_id)
+                pids2 = jax.lax.dynamic_update_slice(pids, new_id, (0, slot))
+
+                def cp_hook(cfg_, q, k, v, ck_l, cv_l, pos_, mask, gate):
+                    ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner)
+                    attn = cp_decode_attend(q, ck_l, cv_l, pids2[0], pos_, AXIS_SP)
+                    return attn, ck_l, cv_l
+
+                x = M.embed(cfg, shared, token[:, None], pos)
+                x, kv = M.forward_layers(
+                    cfg, layers, x, {"k": ck, "v": cv}, pos,
+                    tp_axis=self.tp_axis, attn_hook=cp_hook,
+                )
+                logits = M.unembed(cfg, shared, x[:, -1:, :])[:, 0, :]
+                key, sub = jax.random.split(key)
+                nxt = sample_token(sub, logits, *sampling)
+                # overflow (every shard full): token was not stored, so this
+                # step's attention missed it — discard and stop, don't emit
+                newly = finished | (nxt == eos) | overflow
+                emit = jnp.where(newly, pad, nxt)
+                out = jax.lax.dynamic_update_slice(
+                    out, emit[:, None], (jnp.int32(0), step)
+                )
+                n_gen = n_gen + (~newly).astype(jnp.int32)
+                fill = fill + owner.astype(jnp.int32)
+                return (step + 1, emit, pos + 1, kv["k"], kv["v"], pids2, fill,
+                        key, newly, out, n_gen)
+
+            init = (
+                jnp.int32(0),
+                jnp.where(finished0, pad, first_token),
+                start_pos,
+                cache["k"], cache["v"], cache["pos_ids"], cache["fill"],
+                key,
+                finished0,
+                out0,
+                jnp.zeros((B,), jnp.int32),
+            )
+            (_, _, _, ck, cv, pids, fill, _, _, out, n_gen) = jax.lax.while_loop(
+                cond, step_fn, init
+            )
+            cache2 = {"k": ck, "v": cv, "pos_ids": pids, "fill": fill}
+            return out, n_gen, cache2
+
+        cache_specs = {
+            "k": cp_cache_spec(), "v": cp_cache_spec(),
+            "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
+        }
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                P(), self._layer_specs, P(AXIS_DP), cache_specs, P(), P(), P(), P(),
+            ),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_specs),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
+    # -- health --------------------------------------------------------------
+    def health(self) -> list[dict]:
+        """Context shards instead of pipeline stages: each 'worker' is one
+        ring member holding seq/sp of the KV cache."""
+        devs = self.mesh.devices  # [dp, pp, sp, tp]
+        return [
+            {
+                "stage": s,
+                "devices": [str(d) for d in devs[:, :, s, :].reshape(-1)],
+                "role": "context-shard",
+                "status": "online",
+            }
+            for s in range(self.sp)
+        ]

@@ -98,12 +98,12 @@ class SPMDBackendBase:
         """Per-stage liveness — the reference's /workers sweep polls each
         worker's /health over HTTP (orchestration.py:306-329); here a stage
         is a mesh slice, so health = device presence per slice."""
-        devs = self.mesh.devices  # [dp, pp, tp]
+        devs = self.mesh.devices  # [dp, pp, sp, tp]
         per = self.cfg.n_layers // self.pp
         return [
             {
                 "stage": s,
-                "devices": [str(d) for d in devs[:, s, :].reshape(-1)],
+                "devices": [str(d) for d in devs[:, s].reshape(-1)],
                 "layers": list(range(s * per, (s + 1) * per)),
                 "status": "online",
             }

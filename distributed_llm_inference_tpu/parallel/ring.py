@@ -33,7 +33,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-AXIS_SP = "sp"
+from .mesh import AXIS_SP
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -158,41 +158,43 @@ def cp_decode_attend(
     return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, Dh).astype(q.dtype)
 
 
-def cp_cache_append(
+def cp_select_slot(fill: jnp.ndarray, axis_name: str = AXIS_SP):
+    """Pick the ring member to store the next decoded token.
+
+    Ownership goes to the LEAST-FILLED shard (ties to the lowest index —
+    argmin is deterministic, so every device agrees). Prefill places
+    prompt chunks contiguously, which can load one shard up to its whole
+    chunk; least-filled placement re-balances decode appends around that,
+    so max fill never exceeds max(prefill chunk, ceil(total/sp)+1) and a
+    cache sized ceil(max_seq/sp)+1 cannot overflow. (A naive pos % sp
+    round-robin would overflow the prefill-heavy shard long before the
+    cache is actually full.)
+
+    fill [1] int32 (this device's count) -> (fills [sp] — every device's
+    count, identical everywhere; owner_idx [] int32; owner [] bool — True
+    on the selected device). Capacity/overflow is checked by the caller
+    against its cache: overflow iff fills[owner_idx] >= Sc.
+    """
+    my = jax.lax.axis_index(axis_name)
+    fills = jax.lax.all_gather(fill[0], axis_name)  # [sp], same everywhere
+    owner_idx = jnp.argmin(fills)
+    owner = owner_idx == my
+    return fills, owner_idx, owner
+
+
+def cp_kv_write(
     cache_k: jnp.ndarray,
     cache_v: jnp.ndarray,
-    pos_ids: jnp.ndarray,
     k_new: jnp.ndarray,
     v_new: jnp.ndarray,
-    pos: jnp.ndarray,
-    fill: jnp.ndarray,
-    axis_name: str = AXIS_SP,
+    slot: jnp.ndarray,
+    owner: jnp.ndarray,
 ):
-    """Append one decoded token's K/V to the context-sharded cache.
+    """Owner-gated write of one token's K/V at a local slot (SPMD: every
+    device runs the write, non-owners read-modify-write their own slot).
 
-    Ownership round-robins over the ring (owner = pos % sp) so local fill
-    stays balanced; the owner writes at its next free slot, everyone else
-    no-ops. All devices run the same program (SPMD) — the write is gated,
-    not branched.
-
-    k_new/v_new [B, 1, KV, Dh]; fill [1] int32 = this device's local fill
-    count (shape [1], not scalar, so shard_map can concatenate it over sp).
-    Returns (cache_k, cache_v, pos_ids, fill, overflow) — overflow [1] bool
-    is True (on every device) when the owner's shard was already full: the
-    token was NOT stored, and the caller must stop decoding. Size local
-    shards as Sc >= ceil(max positions / sp) so this never fires; there is
-    no silent eviction.
+    k_new/v_new [B, 1, KV, Dh] -> cache layout [B, KV, Sc, Dh].
     """
-    sp = jax.lax.psum(1, axis_name)
-    my = jax.lax.axis_index(axis_name)
-    Sc = cache_k.shape[2]
-    full = fill[0] >= Sc
-    owner = ((pos % sp) == my) & jnp.logical_not(full)
-    overflow = jax.lax.pmax(
-        (((pos % sp) == my) & full).astype(jnp.int32), axis_name
-    ).astype(bool)
-    slot = jnp.minimum(fill[0], Sc - 1)
-
     kc = k_new.astype(cache_k.dtype).transpose(0, 2, 1, 3)  # [B,KV,1,Dh]
     vc = v_new.astype(cache_v.dtype).transpose(0, 2, 1, 3)
     zero = jnp.int32(0)
@@ -203,6 +205,42 @@ def cp_cache_append(
     vc = jnp.where(owner, vc, old_v)
     cache_k = jax.lax.dynamic_update_slice(cache_k, kc, start)
     cache_v = jax.lax.dynamic_update_slice(cache_v, vc, start)
+    return cache_k, cache_v
+
+
+def cp_cache_append(
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    pos_ids: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+    fill: jnp.ndarray,
+    axis_name: str = AXIS_SP,
+):
+    """Append one decoded token's K/V to the context-sharded cache — the
+    one-shot convenience form of (cp_select_slot + cp_kv_write + pos_ids
+    tag), which is what parallel/context.py's decode loop does per layer
+    with shared slot bookkeeping.
+
+    k_new/v_new [B, 1, KV, Dh]; fill [1] int32 = this device's local fill
+    count (shape [1], not scalar, so shard_map can concatenate it over sp).
+    Returns (cache_k, cache_v, pos_ids, fill, overflow) — overflow [1] bool
+    is True (on every device) when even the least-filled shard is full: the
+    token was NOT stored, and the caller must stop decoding. There is no
+    silent eviction.
+    """
+    Sc = cache_k.shape[2]
+    fills, owner_idx, owner = cp_select_slot(fill, axis_name)
+    # pmax (not fills[owner_idx]) so shard_map can statically infer the
+    # flag is replicated over the ring
+    overflow = jax.lax.pmax(
+        (owner & (fill[0] >= Sc)).astype(jnp.int32), axis_name
+    ).astype(bool)
+    owner = owner & jnp.logical_not(overflow)
+    slot = jnp.minimum(fill[0], Sc - 1)
+
+    cache_k, cache_v = cp_kv_write(cache_k, cache_v, k_new, v_new, slot, owner)
 
     old_id = jax.lax.dynamic_slice(pos_ids, (slot,), (1,))
     new_id = jnp.where(owner, pos.astype(jnp.int32)[None], old_id)

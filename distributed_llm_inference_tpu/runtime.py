@@ -15,6 +15,7 @@ from .config import EngineConfig, MeshConfig, ModelConfig
 from .engine.engine import InferenceEngine, SingleDeviceBackend
 from .models import api as M
 from .models.registry import get_model_config
+from .parallel.context import ContextParallelBackend
 from .parallel.mesh import build_mesh
 from .parallel.pipeline import PipelineBackend
 from .parallel.schedule import MicrobatchPipelineBackend
@@ -41,6 +42,14 @@ def create_backend(
     cfg = get_model_config(model) if isinstance(model, str) else model
     if dtype is not None:
         cfg = cfg.replace(dtype=dtype)
+    if mesh_cfg.sp > 1 and (mesh_cfg.pp > 1 or microbatches > 1):
+        # checked before params init (the expensive step) and before the
+        # microbatch branch, which would otherwise claim the sp-wide mesh
+        # and silently replicate all work across it
+        raise ValueError(
+            "sp (context parallel) does not compose with pp/microbatching "
+            "yet: layer scans run whole-model per ring member"
+        )
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
     if microbatches > 1:
@@ -54,6 +63,9 @@ def create_backend(
         return cfg, MicrobatchPipelineBackend(
             cfg, params, mesh, n_microbatches=microbatches
         )
+    if mesh_cfg.sp > 1:
+        mesh = build_mesh(mesh_cfg)
+        return cfg, ContextParallelBackend(cfg, params, mesh)
     if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1:
         mesh = build_mesh(mesh_cfg)
         return cfg, PipelineBackend(cfg, params, mesh)

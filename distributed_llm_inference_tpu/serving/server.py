@@ -272,6 +272,7 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument("--sp", type=int, default=1, help="context-parallel ring size")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
     ap.add_argument("--max-tokens-cap", type=int, default=30)
@@ -280,7 +281,7 @@ def main(argv: Optional[list] = None):
 
     engine = create_engine(
         args.model,
-        mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, tp=args.tp),
+        mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp),
         dtype=args.dtype,
         seed=args.seed,
     )
