@@ -29,11 +29,17 @@ import jax.numpy as jnp
 
 from ..config import EngineConfig, ModelConfig
 from ..models import api as M
+from ..utils.logging import get_logger
 from ..utils.tokenizer import load_tokenizer
 from . import generate as G
 from .chat import format_chat_prompt
 
+log = get_logger("engine")
+
 DECODE_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+# generate_batch pads the row count up to one of these (compile-once per
+# batch bucket, like the prompt/decode buckets)
+BATCH_BUCKETS = (1, 2, 4, 8, 16)
 
 
 class SingleDeviceBackend:
@@ -41,6 +47,9 @@ class SingleDeviceBackend:
 
     name = "single-device"
     n_stages = 1
+    # Ragged (left-padded, per-row valid_start) batches: only this backend
+    # threads valid_start; the SPMD backends decode shared-position batches.
+    supports_ragged = True
 
     def __init__(self, cfg: ModelConfig, params):
         self.cfg = cfg
@@ -49,13 +58,17 @@ class SingleDeviceBackend:
     def init_cache(self, batch: int, max_seq: int):
         return M.init_kv_cache(self.cfg, batch, max_seq=max_seq)
 
-    def prefill(self, tokens, prompt_len, cache, key, sampling):
-        return G.prefill(self.cfg, self.params, tokens, prompt_len, cache, key, sampling)
+    def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
+        return G.prefill(
+            self.cfg, self.params, tokens, prompt_len, cache, key, sampling,
+            valid_start,
+        )
 
-    def decode(self, first_token, cache, start_pos, limit, key, sampling, *, max_steps):
+    def decode(self, first_token, cache, start_pos, limit, key, sampling,
+               valid_start=None, *, max_steps):
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
-            sampling, max_steps=max_steps,
+            sampling, valid_start, max_steps=max_steps,
         )
 
     def health(self) -> list[dict]:
@@ -109,6 +122,44 @@ class InferenceEngine:
     def _buckets(self):
         return tuple(b for b in self.engine_cfg.prefill_buckets if b <= self.cfg.max_seq_len)
 
+    def _plan(self, longest_prompt: int, max_tokens: int, frame_len=None):
+        """Shared bucketing/clamping for single and batched requests.
+
+        frame_len: slots the prompt frame occupies in the cache — the
+        prompt length for right-padded singles, the whole bucket for
+        left-padded batches. Returns (bucket, max_tokens, decode_bucket).
+        """
+        buckets = self._buckets()
+        if not buckets or longest_prompt > buckets[-1]:
+            raise ValueError(
+                f"prompt length {longest_prompt} exceeds max prefill bucket "
+                f"{buckets[-1] if buckets else 0}"
+            )
+        bucket = G.pick_bucket(buckets, longest_prompt)
+        frame = bucket if frame_len is None else frame_len
+        # cache capacity bound: frame + generated must fit max_seq
+        # (update_kv_cache clamps silently out of range — never allow it);
+        # also bounded by the largest compiled decode bucket
+        max_tokens = max(
+            1,
+            min(int(max_tokens), self.cfg.max_seq_len - frame - 1, DECODE_BUCKETS[-1]),
+        )
+        return bucket, max_tokens, G.pick_bucket(DECODE_BUCKETS, max_tokens)
+
+    def _row_tokens(self, first_id: int, row_out, n: int) -> list:
+        """Assemble one row's emitted ids (EOS-as-first excluded, matching
+        the reference's break-before-append, orchestration.py:181-186)."""
+        head = [first_id] if first_id != self.cfg.eos_token_id else []
+        return head + [int(t) for t in list(row_out[:n])]
+
+    def _record_sample(self, ttft: float, per_stream_tps: float, tokens: int):
+        """Per-STREAM throughput sample (batch requests divide by B), so
+        /stats percentiles stay comparable to the single-stream metric."""
+        with self._samples_lock:
+            self._samples.append(
+                {"ttft_s": ttft, "tokens_per_sec": per_stream_tps, "tokens": tokens}
+            )
+
     # -- main entry ----------------------------------------------------------
     def generate(
         self,
@@ -132,9 +183,11 @@ class InferenceEngine:
         except ValueError as e:
             # caller-caused (e.g. prompt longer than the largest prefill
             # bucket): tagged so the serving edge can answer 400, not 500
+            log.warning("invalid_request", error=str(e))
             return {"error": f"Error: {e}", "status": "failed",
                     "error_type": "invalid_request"}
         except Exception as e:  # error envelope (orchestration.py:220-228)
+            log.error("generate_failed", exc_info=True, error=str(e))
             return {"error": f"Error: {e}", "status": "failed"}
 
     def _generate_locked(
@@ -145,23 +198,9 @@ class InferenceEngine:
         text = format_chat_prompt(prompt, arch=cfg.arch) if chat else prompt
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
-
-        buckets = self._buckets()
-        if not buckets or prompt_len > buckets[-1]:
-            raise ValueError(
-                f"prompt length {prompt_len} exceeds max prefill bucket "
-                f"{buckets[-1] if buckets else 0}"
-            )
-        bucket = G.pick_bucket(buckets, prompt_len)
-
-        # cache capacity bound: prompt + generated must fit max_seq
-        # (update_kv_cache clamps silently out of range — never allow it);
-        # also bounded by the largest compiled decode bucket
-        max_tokens = max(
-            1,
-            min(int(max_tokens), cfg.max_seq_len - prompt_len - 1, DECODE_BUCKETS[-1]),
+        bucket, max_tokens, decode_bucket = self._plan(
+            prompt_len, max_tokens, frame_len=prompt_len
         )
-        decode_bucket = G.pick_bucket(DECODE_BUCKETS, max_tokens)
 
         pad = cfg.pad_token_id
         tokens = jnp.asarray([ids + [pad] * (bucket - prompt_len)], jnp.int32)
@@ -186,24 +225,164 @@ class InferenceEngine:
         out = jax.block_until_ready(out)
         self._cache = cache
 
-        first_id = int(first[0])
-        first_ok = first_id != cfg.eos_token_id
-        gen_ids = ([first_id] if first_ok else []) + [
-            int(t) for t in list(out[0][: int(n_gen[0])])
-        ]
+        gen_ids = self._row_tokens(int(first[0]), out[0], int(n_gen[0]))
         response = self.tokenizer.decode(gen_ids, skip_special_tokens=True)
 
         elapsed = time.time() - t_start
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
-        with self._samples_lock:
-            self._samples.append({"ttft_s": ttft, "tokens_per_sec": tps, "tokens": n})
+        self._record_sample(ttft, tps, n)
+        log.info(
+            "request", model=cfg.name, backend=self.backend.name,
+            prompt_len=prompt_len, bucket=bucket, tokens=n,
+            ttft_s=round(ttft, 4), tokens_per_sec=round(tps, 2),
+            elapsed_s=round(elapsed, 3),
+        )
         return {
             "prompt": prompt,
             "response": response,
             "status": "success",
             "time_taken": f"{elapsed:.2f}s",
             "tokens_generated": n,
+            "tokens_per_sec": f"{tps:.2f}",
+            "ttft_s": round(ttft, 4),
+            "backend": self.backend.name,
+        }
+
+    # -- batched entry -------------------------------------------------------
+    def generate_batch(
+        self,
+        prompts: list,
+        max_tokens: int = 20,
+        temperature: float = 0.7,
+        top_k: int = 50,
+        top_p: float = 0.9,
+        greedy: bool = False,
+        chat: bool = True,
+        seed: Optional[int] = None,
+    ) -> dict:
+        """One forward fleet for N prompts (shared sampling params).
+
+        Ragged prompts are LEFT-padded to a shared bucket: every row then
+        shares one position frame (prefill length == bucket, decode starts
+        at bucket), and per-row pad slots are masked via valid_start. RoPE
+        is relative, so the uniform per-row shift is harmless — which is
+        also why this is llama-family only (GPT-2's learned absolute
+        positions are not shift-invariant). The reference can't batch at
+        all: one request at a time, batch dim hardcoded to 1
+        (/root/reference/orchestration.py:98,144).
+        """
+        t_start = time.time()
+        try:
+            with self._lock:
+                return self._generate_batch_locked(
+                    prompts, max_tokens, temperature, top_k, top_p, greedy,
+                    chat, seed, t_start,
+                )
+        except ValueError as e:
+            log.warning("invalid_batch_request", error=str(e))
+            return {"error": f"Error: {e}", "status": "failed",
+                    "error_type": "invalid_request"}
+        except Exception as e:
+            log.error("generate_batch_failed", exc_info=True, error=str(e))
+            return {"error": f"Error: {e}", "status": "failed"}
+
+    def _generate_batch_locked(
+        self, prompts, max_tokens, temperature, top_k, top_p, greedy, chat,
+        seed, t_start
+    ):
+        cfg = self.cfg
+        if not prompts or not all(isinstance(p, str) and p for p in prompts):
+            raise ValueError("prompts must be a non-empty list of non-empty strings")
+        if cfg.arch != "llama":
+            raise ValueError(
+                f"batched generation is llama-family only (left-padding needs "
+                f"relative positions); model arch is {cfg.arch!r}"
+            )
+        if not getattr(self.backend, "supports_ragged", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support ragged "
+                f"batches; serve batches on the single-device backend"
+            )
+        self.request_count += 1
+        B = len(prompts)
+        if B > BATCH_BUCKETS[-1]:
+            raise ValueError(
+                f"batch size {B} exceeds the maximum {BATCH_BUCKETS[-1]}; "
+                f"split the request"
+            )
+        texts = [
+            format_chat_prompt(p, arch=cfg.arch) if chat else p for p in prompts
+        ]
+        ids = [self.tokenizer.encode(t) for t in texts]
+        plens = [len(i) for i in ids]
+        bucket, max_tokens, decode_bucket = self._plan(max(plens), max_tokens)
+
+        # pad the batch up to a bucketed size so XLA compiles one program
+        # per (B-bucket, prefill-bucket, decode-bucket) triple, not per
+        # client batch size; dummy rows are single-pad prompts, sliced off
+        # the results below
+        Bb = G.pick_bucket(BATCH_BUCKETS, B)
+        pad = cfg.pad_token_id
+        rows = ids + [[pad]] * (Bb - B)
+        row_lens = plens + [1] * (Bb - B)
+        tokens = jnp.asarray(
+            [[pad] * (bucket - n) + row for row, n in zip(rows, row_lens)],
+            jnp.int32,
+        )
+        valid_start = jnp.asarray([bucket - n for n in row_lens], jnp.int32)
+        sampling = G.default_sampling(temperature, top_k, top_p, greedy)
+        key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
+        key_pre, key_dec = jax.random.split(key)
+
+        # batch-sized cache per call (the reusable engine cache is batch-1)
+        cache = self.backend.init_cache(Bb, cfg.max_seq_len)
+        first, logits, cache = self.backend.prefill(
+            tokens, jnp.int32(bucket), cache, key_pre, sampling, valid_start
+        )
+        first = jax.block_until_ready(first)
+        ttft = time.time() - t_start
+
+        # dummy padding rows start "finished" (first token forced to EOS),
+        # so the decode loop's all-finished early exit still fires when the
+        # real rows are done
+        if Bb > B:
+            first = first.at[B:].set(cfg.eos_token_id)
+        out, n_gen, cache = self.backend.decode(
+            first, cache, jnp.int32(bucket), jnp.int32(max_tokens - 1),
+            key_dec, sampling, valid_start, max_steps=decode_bucket,
+        )
+        out = jax.block_until_ready(out)
+        del cache
+
+        results = []
+        total_tokens = 0
+        for b in range(B):  # dummy pad rows [B, Bb) sliced off here
+            row = self._row_tokens(int(first[b]), out[b], int(n_gen[b]))
+            total_tokens += len(row)
+            results.append(
+                {
+                    "prompt": prompts[b],
+                    "response": self.tokenizer.decode(row, skip_special_tokens=True),
+                    "tokens_generated": len(row),
+                    "status": "success",
+                }
+            )
+        elapsed = time.time() - t_start
+        tps = total_tokens / elapsed if elapsed > 0 else 0.0
+        self._record_sample(ttft, tps / B, total_tokens)
+        log.info(
+            "batch_request", model=cfg.name, backend=self.backend.name,
+            batch=B, batch_bucket=Bb, bucket=bucket, tokens=total_tokens,
+            ttft_s=round(ttft, 4), aggregate_tokens_per_sec=round(tps, 2),
+            elapsed_s=round(elapsed, 3),
+        )
+        return {
+            "results": results,
+            "status": "success",
+            "batch_size": B,
+            "time_taken": f"{elapsed:.2f}s",
+            "tokens_generated": total_tokens,
             "tokens_per_sec": f"{tps:.2f}",
             "ttft_s": round(ttft, 4),
             "backend": self.backend.name,

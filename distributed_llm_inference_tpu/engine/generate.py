@@ -49,23 +49,32 @@ def default_sampling(temperature=0.7, top_k=50, top_p=0.9, greedy=False) -> Samp
     )
 
 
-def _forward_step(cfg, params, tokens, cache, pos):
+def _forward_step(cfg, params, tokens, cache, pos, valid_start=None):
     """One chunk through the stack; logits only at the final chunk position."""
     x = M.embed(cfg, params, tokens, pos)
-    x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+    x, cache = M.forward_layers(
+        cfg, params["layers"], x, cache, pos, valid_start=valid_start
+    )
     logits = M.unembed(cfg, params, x[:, -1:, :])
     return logits[:, 0, :], cache
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def prefill(cfg: ModelConfig, params, tokens, prompt_len, cache, key, sampling: SamplingParams):
+def prefill(
+    cfg: ModelConfig, params, tokens, prompt_len, cache, key,
+    sampling: SamplingParams, valid_start=None,
+):
     """Run the padded prompt, sample the first token.
 
-    tokens: [B, T_bucket] right-padded; prompt_len: scalar int32 (shared by
-    the batch). Returns (first_token [B], logits [B,V], cache).
+    tokens: [B, T_bucket] right-padded (or LEFT-padded for ragged batches,
+    with valid_start [B] = each row's first real slot); prompt_len: scalar
+    int32 (shared by the batch — for left-padded batches this is the bucket
+    length). Returns (first_token [B], logits [B,V], cache).
     """
     x = M.embed(cfg, params, tokens, jnp.int32(0))
-    x, cache = M.forward_layers(cfg, params["layers"], x, cache, jnp.int32(0))
+    x, cache = M.forward_layers(
+        cfg, params["layers"], x, cache, jnp.int32(0), valid_start=valid_start
+    )
     # logits only at the last *valid* prompt position (traced start is fine
     # for dynamic_slice; prompt_len >= 1 by the engine's contract)
     last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)  # [B,1,D]
@@ -86,6 +95,7 @@ def decode(
     limit,
     key,
     sampling: SamplingParams,
+    valid_start=None,
     *,
     max_steps: int,
 ):
@@ -116,7 +126,9 @@ def decode(
 
     def body(c):
         step, token, pos, cache, key, finished, out, n_gen = c
-        logits, cache = _forward_step(cfg, params, token[:, None], cache, pos)
+        logits, cache = _forward_step(
+            cfg, params, token[:, None], cache, pos, valid_start
+        )
         key, sub = jax.random.split(key)
         nxt = sample_token(sub, logits, *sampling)
         is_eos = nxt == eos

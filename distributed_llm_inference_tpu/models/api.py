@@ -31,13 +31,15 @@ def embed(cfg, params, tokens, pos=0):
 
 
 def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None,
-                   attn_hook=None):
-    if attn_hook is not None:
-        # attn_hook is a llama-family seam (parallel/context.py); gpt2's
-        # block doesn't expose it, and callers that pass one have already
-        # checked the arch.
+                   attn_hook=None, valid_start=None):
+    if attn_hook is not None or valid_start is not None:
+        # llama-family seams (attn_hook: parallel/context.py; valid_start:
+        # ragged left-padded batching). gpt2's block exposes neither —
+        # learned absolute positions aren't shift-invariant, so left-padding
+        # is wrong there anyway — and callers have already checked the arch.
         return family(cfg).forward_layers(
-            cfg, layers, x, cache, pos, update_gate, tp_axis, attn_hook
+            cfg, layers, x, cache, pos, update_gate, tp_axis, attn_hook,
+            valid_start,
         )
     return family(cfg).forward_layers(cfg, layers, x, cache, pos, update_gate,
                                       tp_axis)

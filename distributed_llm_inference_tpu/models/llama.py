@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..ops.attention import attend, causal_mask, update_kv_cache
+from ..ops.attention import attend, causal_mask, ragged_causal_mask, update_kv_cache
 from ..ops.flash_attention import flash_attend
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope, rope_cos_sin
@@ -95,7 +95,10 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate):
     Returns (attn [B,T,H,Dh], cache_k, cache_v).
     """
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    if cfg.attn_impl == "pallas":
+    # 3D mask = per-row validity (ragged left-padded batch); the flash
+    # kernel derives its mask from `pos` alone, so that path needs the 2D
+    # shared-causal case.
+    if cfg.attn_impl == "pallas" and mask.ndim == 2:
         attn = flash_attend(q, new_k, new_v, pos)
     else:
         attn = attend(q, new_k, new_v, mask)
@@ -168,18 +171,24 @@ def forward_layers(
     update_gate: Optional[jnp.ndarray] = None,
     tp_axis: Optional[str] = None,
     attn_hook=None,
+    valid_start: Optional[jnp.ndarray] = None,
 ):
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
 
     x: [B, T, D]; cache k/v: [L_slice, B, KV, S, Dh]; pos: scalar int32.
     Returns (x, new_cache). attn_hook: see decoder_layer.
+    valid_start: optional [B] int32 — first REAL slot per row for ragged
+    left-padded batches (slots before it are pad and never attended).
     """
     T = x.shape[1]
     S = cache["k"].shape[3]
     positions = pos + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
-    mask = causal_mask(pos, T, S)
+    if valid_start is None:
+        mask = causal_mask(pos, T, S)
+    else:
+        mask = ragged_causal_mask(pos, T, S, valid_start)
 
     def body(carry, xs):
         xc = carry

@@ -72,6 +72,19 @@ def causal_mask(pos: jnp.ndarray, chunk_len: int, max_seq: int) -> jnp.ndarray:
     return kv_pos[None, :] <= q_pos[:, None]
 
 
+def ragged_causal_mask(
+    pos: jnp.ndarray, chunk_len: int, max_seq: int, valid_start: jnp.ndarray
+) -> jnp.ndarray:
+    """[B, T, S] mask for LEFT-padded batches: causal AND slot >= the row's
+    first real slot. Left-padding aligns ragged prompts to one shared
+    position frame (RoPE is relative, so a per-row uniform shift is
+    harmless); the pad slots in front must simply never be attended."""
+    causal = causal_mask(pos, chunk_len, max_seq)  # [T, S]
+    kv_pos = jnp.arange(max_seq, dtype=jnp.int32)
+    valid = kv_pos[None, None, :] >= valid_start[:, None, None]  # [B, 1, S]
+    return causal[None, :, :] & valid
+
+
 def attend(
     q: jnp.ndarray,
     cache_k: jnp.ndarray,
@@ -80,6 +93,7 @@ def attend(
 ) -> jnp.ndarray:
     """Grouped-query attention over the (already updated) cache.
 
+    mask: [T, S] (shared) or [B, T, S] (per-row, ragged left-padded batch).
     Softmax in fp32; output cast back to q.dtype. Returns [B, T, H, Dh].
     """
     B, T, H, Dh = q.shape
@@ -93,7 +107,8 @@ def attend(
         "btkgd,bksd->bkgts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
     ) * scale  # [B, KV, group, T, S]
     neg = jnp.finfo(jnp.float32).min
-    scores = jnp.where(mask[None, None, None, :, :], scores, neg)
+    bmask = mask[:, None, None, :, :] if mask.ndim == 3 else mask[None, None, None, :, :]
+    scores = jnp.where(bmask, scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bksd->btkgd", probs, cache_v.astype(jnp.float32))
     return out.reshape(B, T, H, Dh).astype(q.dtype)

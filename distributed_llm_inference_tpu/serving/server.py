@@ -208,15 +208,15 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             if data is None:
                 return
             prompt = data.get("prompt", "")
-            if not prompt:
+            prompts = data.get("prompts")
+            if not prompt and not prompts:
                 # reference: 400 "No prompt provided" (orchestration.py:343)
                 self._send(400, {"error": "No prompt provided"})
                 return
             try:
                 max_tokens = min(int(data.get("max_tokens", DEFAULT_MAX_TOKENS)), max_tokens_cap)
                 seed = data.get("seed")
-                result = engine.generate(
-                    prompt,
+                kwargs = dict(
                     max_tokens=max_tokens,
                     temperature=float(data.get("temperature", DEFAULT_TEMPERATURE)),
                     top_k=int(data.get("top_k", DEFAULT_TOP_K)),
@@ -225,6 +225,13 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     chat=_parse_bool(data.get("chat", True), "chat"),
                     seed=int(seed) if seed is not None else None,
                 )
+                if prompts is not None:
+                    # batched form: "prompts": [...] -> one fleet, N results
+                    if not isinstance(prompts, list):
+                        raise ValueError("prompts must be a list of strings")
+                    result = engine.generate_batch(prompts, **kwargs)
+                else:
+                    result = engine.generate(prompt, **kwargs)
             except (TypeError, ValueError) as e:
                 self._send(400, {"error": f"bad parameter: {e}"})
                 return
@@ -254,6 +261,13 @@ class InferenceServer:
         return t
 
     def serve_forever(self):
+        from ..utils.logging import configure, get_logger
+
+        configure()  # JSON-lines handler; entry-point-only (library-safe)
+        get_logger("server").info(
+            "serving", port=self.port,
+            routes=["/generate", "/health", "/workers", "/stats", "/profiler/*"],
+        )
         print(f"🚀 serving on :{self.port} — /generate /health /workers /")
         self.httpd.serve_forever()
 
