@@ -28,6 +28,10 @@ import jax.numpy as jnp
 REFERENCE_TOK_S = 0.16  # midpoint of the reference's 0.12-0.2 tok/s
 PROMPT_LEN = 128
 DECODE_STEPS = 64
+# skip the optional batch-8 leg when the single-stream part (compiles
+# included) has already used this much wall clock
+BATCH_LEG_DEADLINE_S = 420.0
+T_START = time.perf_counter()
 
 
 def _timed(fn):
@@ -108,6 +112,43 @@ def main():
 
     decode_s = max(min(_timed(decode_k)[0] for _ in range(3)) - rtt, 1e-9) / K
     tok_s = DECODE_STEPS / decode_s
+
+    # batched decode: 8 identical streams through the raw backend decode
+    # loop (NOT the engine's generate_batch ragged path — this measures the
+    # aggregate-throughput ceiling batching exposes, with no left-pad
+    # masking in the program). Weights stream from HBM once per step
+    # regardless of batch, so aggregate throughput scales ~linearly until
+    # compute-bound. The prefilled B=1 cache is tiled instead of compiling
+    # a batched prefill (identical rows; only the decode program costs a
+    # compile), and the leg is skipped entirely if the single-stream part
+    # already ate the time budget — the primary metric must always land.
+    batch_tok_s = None
+    if time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        BATCH = 8
+        first_b = jnp.tile(first, (BATCH,))
+        cache_b = jax.tree.map(
+            lambda x: jnp.tile(x, (1, BATCH) + (1,) * (x.ndim - 2)), cache
+        )
+        out, n_gen_b, cache_b = G.decode(
+            cfg, params, first_b, cache_b, plen, limit, kd, sampling,
+            max_steps=DECODE_STEPS,
+        )
+        fetch(n_gen_b)  # warm/compile
+
+        def decode_k_batch():
+            nonlocal cache_b
+            for _ in range(K):
+                out, n_gen, cache_b = G.decode(
+                    cfg, params, first_b, cache_b, plen, limit, kd, sampling,
+                    max_steps=DECODE_STEPS,
+                )
+            fetch(n_gen)
+
+        batch_s = max(
+            min(_timed(decode_k_batch)[0] for _ in range(3)) - rtt, 1e-9
+        ) / K
+        batch_tok_s = BATCH * DECODE_STEPS / batch_s
+
     result = {
         "metric": "tinyllama_1.1b_decode_throughput",
         "value": round(tok_s, 3),
@@ -119,6 +160,8 @@ def main():
         "platform": platform,
         "dtype": cfg.dtype,
     }
+    if batch_tok_s is not None:
+        result["batch8_tokens_per_sec"] = round(batch_tok_s, 3)
     print(json.dumps(result))
 
 
