@@ -1,0 +1,85 @@
+"""Structured JSON-lines logging.
+
+The reference logs with emoji print() banners throughout
+(/root/reference/orchestration.py:74-76, Worker1.py:84-87 — SURVEY.md §5
+metrics/logging). Here every log record is one JSON object on stderr
+(machine-parseable, greppable), with arbitrary structured fields:
+
+    log = get_logger("engine")
+    log.info("request", model="tinyllama-1.1b", tokens=20, ttft_s=0.01)
+
+Stdout stays clean for tool output (bench.py's single JSON line, the
+client CLI).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from typing import Any
+
+_CONFIGURED = False
+
+
+class _JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 3),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "event": record.getMessage(),
+        }
+        fields = getattr(record, "fields", None)
+        if fields:
+            out.update(fields)
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class StructuredLogger:
+    """Thin wrapper adding **fields kwargs to the stdlib logger."""
+
+    def __init__(self, logger: logging.Logger):
+        self._logger = logger
+
+    def _log(self, level: int, event: str, exc_info=None, **fields: Any):
+        if self._logger.isEnabledFor(level):
+            self._logger.log(level, event, extra={"fields": fields}, exc_info=exc_info)
+
+    def debug(self, event: str, **fields):
+        self._log(logging.DEBUG, event, **fields)
+
+    def info(self, event: str, **fields):
+        self._log(logging.INFO, event, **fields)
+
+    def warning(self, event: str, **fields):
+        self._log(logging.WARNING, event, **fields)
+
+    def error(self, event: str, exc_info=None, **fields):
+        self._log(logging.ERROR, event, exc_info=exc_info, **fields)
+
+
+def configure(level: int = logging.INFO, stream=None) -> None:
+    """Install the JSON handler on the package root logger (idempotent)."""
+    global _CONFIGURED
+    root = logging.getLogger("distributed_llm_inference_tpu")
+    if _CONFIGURED:
+        return
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(_JsonFormatter())
+    root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> StructuredLogger:
+    """Library-safe: does NOT install handlers — records propagate to the
+    host application's logging config by default. Entry points (the server
+    CLI) call configure() to get the JSON-lines handler."""
+    return StructuredLogger(
+        logging.getLogger(f"distributed_llm_inference_tpu.{name}")
+    )

@@ -1,0 +1,48 @@
+"""Structured JSON-lines logging."""
+
+import io
+import json
+import logging
+
+from distributed_llm_inference_tpu.utils import logging as slog
+
+
+def test_json_records_with_fields():
+    buf = io.StringIO()
+    # fresh handler onto our buffer regardless of prior configure() calls
+    root = logging.getLogger("distributed_llm_inference_tpu")
+    handler = logging.StreamHandler(buf)
+    handler.setFormatter(slog._JsonFormatter())
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    try:
+        log = slog.get_logger("unit")
+        log.info("request", model="m", tokens=3, tps=1.5)
+        log.warning("slow", elapsed_s=9.9)
+        lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+    finally:
+        root.removeHandler(handler)
+    assert lines[0]["event"] == "request"
+    assert lines[0]["model"] == "m" and lines[0]["tokens"] == 3
+    assert lines[0]["logger"] == "distributed_llm_inference_tpu.unit"
+    assert lines[1]["level"] == "warning" and lines[1]["elapsed_s"] == 9.9
+
+
+def test_exception_captured():
+    buf = io.StringIO()
+    root = logging.getLogger("distributed_llm_inference_tpu")
+    handler = logging.StreamHandler(buf)
+    handler.setFormatter(slog._JsonFormatter())
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    try:
+        log = slog.get_logger("unit2")
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            log.error("failed", exc_info=True, detail="x")
+        rec = json.loads(buf.getvalue().strip())
+    finally:
+        root.removeHandler(handler)
+    assert rec["event"] == "failed" and rec["detail"] == "x"
+    assert "boom" in rec["exc"]
