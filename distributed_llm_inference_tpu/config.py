@@ -35,6 +35,9 @@ class ModelConfig:
     max_seq_len: int = 2048
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # Sliding-window attention (Mistral-style): a query attends only the
+    # last `attn_window` positions. None = full causal.
+    attn_window: Optional[int] = None
     tie_embeddings: bool = False
     # GPT-2 only: learned absolute position embeddings.
     use_learned_pos: bool = False

@@ -64,6 +64,17 @@ class SingleDeviceBackend:
             valid_start,
         )
 
+    # chunked prefill (prompts longer than the largest bucket); the SPMD
+    # backends don't expose these yet, and the engine falls back to the
+    # bucket-limit error there
+    def extend(self, tokens, pos, cache):
+        return G.extend(self.cfg, self.params, tokens, pos, cache)
+
+    def prefill_at(self, tokens, pos, valid_len, cache, key, sampling):
+        return G.prefill_at(
+            self.cfg, self.params, tokens, pos, valid_len, cache, key, sampling
+        )
+
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
                valid_start=None, *, max_steps):
         return G.decode(
@@ -122,6 +133,17 @@ class InferenceEngine:
     def _buckets(self):
         return tuple(b for b in self.engine_cfg.prefill_buckets if b <= self.cfg.max_seq_len)
 
+    def _clamp_decode(self, frame: int, max_tokens: int) -> tuple[int, int]:
+        """Cache-capacity discipline in ONE place: frame + generated must
+        fit max_seq (update_kv_cache clamps silently out of range — never
+        allow it), also bounded by the largest compiled decode bucket.
+        Returns (max_tokens, decode_bucket)."""
+        max_tokens = max(
+            1,
+            min(int(max_tokens), self.cfg.max_seq_len - frame - 1, DECODE_BUCKETS[-1]),
+        )
+        return max_tokens, G.pick_bucket(DECODE_BUCKETS, max_tokens)
+
     def _plan(self, longest_prompt: int, max_tokens: int, frame_len=None):
         """Shared bucketing/clamping for single and batched requests.
 
@@ -137,14 +159,8 @@ class InferenceEngine:
             )
         bucket = G.pick_bucket(buckets, longest_prompt)
         frame = bucket if frame_len is None else frame_len
-        # cache capacity bound: frame + generated must fit max_seq
-        # (update_kv_cache clamps silently out of range — never allow it);
-        # also bounded by the largest compiled decode bucket
-        max_tokens = max(
-            1,
-            min(int(max_tokens), self.cfg.max_seq_len - frame - 1, DECODE_BUCKETS[-1]),
-        )
-        return bucket, max_tokens, G.pick_bucket(DECODE_BUCKETS, max_tokens)
+        max_tokens, decode_bucket = self._clamp_decode(frame, max_tokens)
+        return bucket, max_tokens, decode_bucket
 
     def _row_tokens(self, first_id: int, row_out, n: int) -> list:
         """Assemble one row's emitted ids (EOS-as-first excluded, matching
@@ -198,12 +214,44 @@ class InferenceEngine:
         text = format_chat_prompt(prompt, arch=cfg.arch) if chat else prompt
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
-        bucket, max_tokens, decode_bucket = self._plan(
-            prompt_len, max_tokens, frame_len=prompt_len
+
+        buckets = self._buckets()
+        chunked = (
+            buckets
+            and prompt_len > buckets[-1]
+            and prompt_len <= cfg.max_seq_len - 2
+            and hasattr(self.backend, "extend")
         )
+        if chunked:
+            # prompt exceeds the largest compiled bucket: feed it through
+            # full-bucket extend() chunks, then sample off the final chunk.
+            # n_full leaves >= 1 token for the sampling chunk.
+            chunk = buckets[-1]
+            n_full = (prompt_len - 1) // chunk
+            rem = prompt_len - n_full * chunk
+            # the final chunk is a PADDED bucket whose pads also write K/V:
+            # its end (n_full*chunk + bucket) must stay inside max_seq or
+            # update_kv_cache's silent clamp would overwrite real prompt
+            # slots. Pick the smallest bucket that fits both rem and the
+            # cache; a bucket layout with none fitting rejects the request.
+            fitting = [
+                b for b in buckets
+                if b >= rem and n_full * chunk + b <= cfg.max_seq_len
+            ]
+            if not fitting:
+                raise ValueError(
+                    f"prompt length {prompt_len} cannot be chunk-prefilled: "
+                    f"no prefill bucket fits the final {rem}-token chunk "
+                    f"within max_seq_len {cfg.max_seq_len}"
+                )
+            bucket = fitting[0]
+            max_tokens, decode_bucket = self._clamp_decode(prompt_len, max_tokens)
+        else:
+            bucket, max_tokens, decode_bucket = self._plan(
+                prompt_len, max_tokens, frame_len=prompt_len
+            )
 
         pad = cfg.pad_token_id
-        tokens = jnp.asarray([ids + [pad] * (bucket - prompt_len)], jnp.int32)
         sampling = G.default_sampling(temperature, top_k, top_p, greedy)
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
@@ -212,9 +260,23 @@ class InferenceEngine:
             self._cache = self.backend.init_cache(1, cfg.max_seq_len)
         cache = self._cache
         self._cache = None  # donated below; restored from the decode result
-        first, logits, cache = self.backend.prefill(
-            tokens, jnp.int32(prompt_len), cache, key_pre, sampling
-        )
+        if chunked:
+            for c in range(n_full):
+                chunk_tokens = jnp.asarray(
+                    [ids[c * chunk : (c + 1) * chunk]], jnp.int32
+                )
+                cache = self.backend.extend(chunk_tokens, jnp.int32(c * chunk), cache)
+            tail = ids[n_full * chunk :]
+            tokens = jnp.asarray([tail + [pad] * (bucket - rem)], jnp.int32)
+            first, logits, cache = self.backend.prefill_at(
+                tokens, jnp.int32(n_full * chunk), jnp.int32(rem), cache,
+                key_pre, sampling,
+            )
+        else:
+            tokens = jnp.asarray([ids + [pad] * (bucket - prompt_len)], jnp.int32)
+            first, logits, cache = self.backend.prefill(
+                tokens, jnp.int32(prompt_len), cache, key_pre, sampling
+            )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
 

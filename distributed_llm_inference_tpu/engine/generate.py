@@ -83,6 +83,36 @@ def prefill(
     return first, logits, cache
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def extend(cfg: ModelConfig, params, tokens, pos, cache):
+    """Chunked-prefill step: run a FULL chunk of prompt at offset `pos`
+    into the cache, producing no logits/samples. The engine feeds prompts
+    longer than the largest prefill bucket through repeated extend() calls
+    before the final `prefill_at` chunk — compile cost stays one program
+    per chunk shape, while supported prompt length grows to max_seq_len.
+    (The reference caps everything at 30 output tokens and O(n²) recompute
+    instead, /root/reference/orchestration.py:347.)"""
+    x = M.embed(cfg, params, tokens, pos)
+    _, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+    return cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_at(
+    cfg: ModelConfig, params, tokens, pos, valid_len, cache, key,
+    sampling: SamplingParams,
+):
+    """Final chunked-prefill step at offset `pos`: right-padded chunk whose
+    last real token sits at local index valid_len-1; samples the first
+    output token. prefill() == prefill_at(pos=0, valid_len=prompt_len)."""
+    x = M.embed(cfg, params, tokens, pos)
+    x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+    last = jax.lax.dynamic_slice_in_dim(x, valid_len - 1, 1, axis=1)
+    logits = M.unembed(cfg, params, last)[:, 0, :]
+    first = sample_token(key, logits, *sampling)
+    return first, logits, cache
+
+
 @functools.partial(
     jax.jit, static_argnames=("cfg", "max_steps"), donate_argnames=("cache",)
 )

@@ -64,6 +64,8 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         max_seq_len=hf_cfg.max_position_embeddings,
         norm_eps=hf_cfg.rms_norm_eps,
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        # Mistral-style sliding window (HF: None/absent = full causal)
+        attn_window=getattr(hf_cfg, "sliding_window", None),
         tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         dtype=dtype,
         eos_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 2,

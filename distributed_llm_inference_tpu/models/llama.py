@@ -99,7 +99,7 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate):
     # kernel derives its mask from `pos` alone, so that path needs the 2D
     # shared-causal case.
     if cfg.attn_impl == "pallas" and mask.ndim == 2:
-        attn = flash_attend(q, new_k, new_v, pos)
+        attn = flash_attend(q, new_k, new_v, pos, window=cfg.attn_window)
     else:
         attn = attend(q, new_k, new_v, mask)
     return attn, new_k, new_v
@@ -186,9 +186,9 @@ def forward_layers(
     positions = pos + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
     if valid_start is None:
-        mask = causal_mask(pos, T, S)
+        mask = causal_mask(pos, T, S, cfg.attn_window)
     else:
-        mask = ragged_causal_mask(pos, T, S, valid_start)
+        mask = ragged_causal_mask(pos, T, S, valid_start, cfg.attn_window)
 
     def body(carry, xs):
         xc = carry

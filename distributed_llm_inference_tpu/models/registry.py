@@ -52,6 +52,18 @@ register(ModelConfig(
     rope_theta=500000.0, eos_token_id=128001, bos_token_id=128000,
 ))
 
+# --- Mistral family (llama arch + sliding-window attention) ---------------
+register(ModelConfig(
+    name="mistral-7b", arch="llama", vocab_size=32000, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+    rope_theta=10000.0, attn_window=4096, eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="mistral-7b-v0.2", arch="llama", vocab_size=32000, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=32768,
+    rope_theta=1000000.0, eos_token_id=2, bos_token_id=1,
+))
+
 # --- GPT-2 family ----------------------------------------------------------
 register(ModelConfig(
     name="gpt2-small", arch="gpt2", vocab_size=50257, dim=768,

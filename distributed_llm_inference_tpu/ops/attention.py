@@ -64,22 +64,30 @@ def update_kv_cache(
     return cache_k, cache_v
 
 
-def causal_mask(pos: jnp.ndarray, chunk_len: int, max_seq: int) -> jnp.ndarray:
+def causal_mask(
+    pos: jnp.ndarray, chunk_len: int, max_seq: int, window=None
+) -> jnp.ndarray:
     """[T, S] boolean mask: query at absolute position pos+t may attend to
-    cache slots 0..pos+t inclusive (earlier prompt + itself)."""
+    cache slots 0..pos+t inclusive (earlier prompt + itself). With
+    `window` (sliding-window attention, Mistral-style) only the last
+    `window` positions qualify: q_pos - window < kv_pos <= q_pos."""
     q_pos = pos + jnp.arange(chunk_len, dtype=jnp.int32)  # [T]
     kv_pos = jnp.arange(max_seq, dtype=jnp.int32)  # [S]
-    return kv_pos[None, :] <= q_pos[:, None]
+    mask = kv_pos[None, :] <= q_pos[:, None]
+    if window is not None:
+        mask &= kv_pos[None, :] > q_pos[:, None] - window
+    return mask
 
 
 def ragged_causal_mask(
-    pos: jnp.ndarray, chunk_len: int, max_seq: int, valid_start: jnp.ndarray
+    pos: jnp.ndarray, chunk_len: int, max_seq: int, valid_start: jnp.ndarray,
+    window=None,
 ) -> jnp.ndarray:
     """[B, T, S] mask for LEFT-padded batches: causal AND slot >= the row's
     first real slot. Left-padding aligns ragged prompts to one shared
     position frame (RoPE is relative, so a per-row uniform shift is
     harmless); the pad slots in front must simply never be attended."""
-    causal = causal_mask(pos, chunk_len, max_seq)  # [T, S]
+    causal = causal_mask(pos, chunk_len, max_seq, window)  # [T, S]
     kv_pos = jnp.arange(max_seq, dtype=jnp.int32)
     valid = kv_pos[None, None, :] >= valid_start[:, None, None]  # [B, 1, S]
     return causal[None, :, :] & valid

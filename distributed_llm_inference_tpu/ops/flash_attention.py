@@ -70,6 +70,7 @@ def _flash_kernel(
     block_k: int,
     group: int,
     scale: float,
+    window: int | None,
 ):
     pos = pos_ref[0]
     qi = pl.program_id(2)
@@ -102,6 +103,8 @@ def _flash_kernel(
         )  # [rows, block_k]
         kv_pos = j * block_k + col_ids
         mask = (t_global < T) & (kv_pos <= q_pos) & (kv_pos < S)
+        if window is not None:  # sliding-window attention (Mistral-style)
+            mask &= kv_pos > q_pos - window
         s = jnp.where(mask, s, _NEG)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -122,7 +125,9 @@ def _flash_kernel(
         o_ref[0] = (acc_ref[:] / l).reshape(block_t, 1, group, Dh).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_t", "block_k", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("block_t", "block_k", "interpret", "window")
+)
 def flash_attend(
     q: jnp.ndarray,
     cache_k: jnp.ndarray,
@@ -132,12 +137,14 @@ def flash_attend(
     block_t: int = 0,
     block_k: int = 0,
     interpret: bool | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Causal GQA flash attention over the (already updated) cache.
 
     q [B,T,H,Dh], cache_k/v [B,KV,S,Dh], pos scalar int32 (chunk offset).
-    Returns [B,T,H,Dh] in q.dtype. Same contract as `attention.attend`
-    with the mask derived from `pos` instead of passed in.
+    window: sliding-window attention width (None = full causal). Returns
+    [B,T,H,Dh] in q.dtype. Same contract as `attention.attend` with the
+    mask derived from `pos` (and `window`) instead of passed in.
     """
     B, T, H, Dh = q.shape
     KV, S = cache_k.shape[1], cache_k.shape[2]
@@ -173,6 +180,7 @@ def flash_attend(
         block_k=block_k,
         group=group,
         scale=Dh**-0.5,
+        window=window,
     )
     rows = block_t * group
     grid_spec = pltpu.PrefetchScalarGridSpec(

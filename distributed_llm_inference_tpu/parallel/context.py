@@ -61,6 +61,12 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"context parallelism is wired for the llama family (attn_hook "
                 f"seam); got arch={cfg.arch!r}"
             )
+        if cfg.attn_window is not None:
+            raise NotImplementedError(
+                "sliding-window attention does not compose with context "
+                "parallelism yet: ring_attend/cp_decode_attend compute full "
+                "causal attention (fail loudly, not silently wrong)"
+            )
         if int(mesh.shape[AXIS_PP]) != 1:
             raise ValueError("ContextParallelBackend needs pp == 1 (no layer sharding)")
         self.sp = int(mesh.shape[AXIS_SP])
