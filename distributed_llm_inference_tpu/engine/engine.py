@@ -59,9 +59,12 @@ class SingleDeviceBackend:
         return M.init_kv_cache(self.cfg, batch, max_seq=max_seq)
 
     def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
+        # pos always passed as a traced array so ordinary prefill, warmup,
+        # and the chunked final chunk all share one compiled program per
+        # bucket shape
         return G.prefill(
             self.cfg, self.params, tokens, prompt_len, cache, key, sampling,
-            valid_start,
+            valid_start, jnp.int32(0),
         )
 
     # chunked prefill (prompts longer than the largest bucket); the SPMD
@@ -71,8 +74,9 @@ class SingleDeviceBackend:
         return G.extend(self.cfg, self.params, tokens, pos, cache)
 
     def prefill_at(self, tokens, pos, valid_len, cache, key, sampling):
-        return G.prefill_at(
-            self.cfg, self.params, tokens, pos, valid_len, cache, key, sampling
+        return G.prefill(
+            self.cfg, self.params, tokens, valid_len, cache, key, sampling,
+            None, pos,
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
@@ -310,6 +314,61 @@ class InferenceEngine:
             "ttft_s": round(ttft, 4),
             "backend": self.backend.name,
         }
+
+    # -- warmup --------------------------------------------------------------
+    def warmup(self, decode_buckets=None) -> dict:
+        """Pre-compile the single-prompt serving programs so those requests
+        never pay jit latency.
+
+        BASELINE.json's target is p50 TTFT — that requires warm-compiled
+        caches for every (prefill bucket, decode bucket) shape, not
+        compile-on-first-request (SURVEY.md §7 'TTFT < 500 ms' note). One
+        prefill program per bucket (shared with the chunked-prefill final
+        chunk — `pos` is traced), the extend() chunk program when the
+        backend supports chunking, and one decode program per decode
+        bucket; sampling params are traced scalars, so one program covers
+        every temperature/top-k/top-p/greedy combination.
+
+        Scope: batched ("prompts"-list) programs are NOT warmed here —
+        their shapes include the batch bucket and the ragged valid_start
+        operand; issue one representative generate_batch to warm those.
+
+        Returns {"programs": N, "seconds": wall}.
+        """
+        t0 = time.time()
+        decode_buckets = tuple(decode_buckets or DECODE_BUCKETS)
+        sampling = G.default_sampling(greedy=True)
+        key = jax.random.PRNGKey(0)
+        n = 0
+        buckets = self._buckets()
+        with self._lock:
+            cache = self._cache or self.backend.init_cache(1, self.cfg.max_seq_len)
+            self._cache = None
+            first = None
+            for bucket in buckets:
+                tokens = jnp.full((1, bucket), self.cfg.pad_token_id, jnp.int32)
+                first, _, cache = self.backend.prefill(
+                    tokens, jnp.int32(1), cache, key, sampling
+                )
+                n += 1
+            if buckets and hasattr(self.backend, "extend"):
+                chunk_tokens = jnp.full(
+                    (1, buckets[-1]), self.cfg.pad_token_id, jnp.int32
+                )
+                cache = self.backend.extend(chunk_tokens, jnp.int32(0), cache)
+                n += 1
+            for db in decode_buckets:
+                # limit=0: compiles the while_loop program, executes 0 steps
+                _, _, cache = self.backend.decode(
+                    first, cache, jnp.int32(1), jnp.int32(0), key, sampling,
+                    max_steps=db,
+                )
+                n += 1
+            jax.block_until_ready(cache)
+            self._cache = cache  # first real request reuses the buffer
+        out = {"programs": n, "seconds": round(time.time() - t0, 2)}
+        log.info("warmup", **out)
+        return out
 
     # -- batched entry -------------------------------------------------------
     def generate_batch(

@@ -62,20 +62,27 @@ def _forward_step(cfg, params, tokens, cache, pos, valid_start=None):
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill(
     cfg: ModelConfig, params, tokens, prompt_len, cache, key,
-    sampling: SamplingParams, valid_start=None,
+    sampling: SamplingParams, valid_start=None, pos=None,
 ):
-    """Run the padded prompt, sample the first token.
+    """Run the padded prompt (or final chunked-prefill chunk), sample the
+    first token.
 
     tokens: [B, T_bucket] right-padded (or LEFT-padded for ragged batches,
     with valid_start [B] = each row's first real slot); prompt_len: scalar
-    int32 (shared by the batch — for left-padded batches this is the bucket
-    length). Returns (first_token [B], logits [B,V], cache).
+    int32 — the number of valid tokens IN THIS CHUNK (shared by the batch;
+    for left-padded batches this is the bucket length). pos: traced chunk
+    offset into the cache (None == 0) — the chunked-prefill engine passes
+    the running offset after its extend() calls, and because pos is traced
+    the same compiled program serves every offset.
+    Returns (first_token [B], logits [B,V], cache).
     """
-    x = M.embed(cfg, params, tokens, jnp.int32(0))
+    if pos is None:
+        pos = jnp.int32(0)
+    x = M.embed(cfg, params, tokens, pos)
     x, cache = M.forward_layers(
-        cfg, params["layers"], x, cache, jnp.int32(0), valid_start=valid_start
+        cfg, params["layers"], x, cache, pos, valid_start=valid_start
     )
-    # logits only at the last *valid* prompt position (traced start is fine
+    # logits only at the last *valid* chunk position (traced start is fine
     # for dynamic_slice; prompt_len >= 1 by the engine's contract)
     last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)  # [B,1,D]
     logits = M.unembed(cfg, params, last)[:, 0, :]
@@ -88,29 +95,13 @@ def extend(cfg: ModelConfig, params, tokens, pos, cache):
     """Chunked-prefill step: run a FULL chunk of prompt at offset `pos`
     into the cache, producing no logits/samples. The engine feeds prompts
     longer than the largest prefill bucket through repeated extend() calls
-    before the final `prefill_at` chunk — compile cost stays one program
-    per chunk shape, while supported prompt length grows to max_seq_len.
-    (The reference caps everything at 30 output tokens and O(n²) recompute
-    instead, /root/reference/orchestration.py:347.)"""
+    before a final `prefill(..., pos=...)` chunk — compile cost stays one
+    program per chunk shape, while supported prompt length grows to
+    max_seq_len. (The reference caps everything at 30 output tokens and
+    O(n²) recompute instead, /root/reference/orchestration.py:347.)"""
     x = M.embed(cfg, params, tokens, pos)
     _, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
     return cache
-
-
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def prefill_at(
-    cfg: ModelConfig, params, tokens, pos, valid_len, cache, key,
-    sampling: SamplingParams,
-):
-    """Final chunked-prefill step at offset `pos`: right-padded chunk whose
-    last real token sits at local index valid_len-1; samples the first
-    output token. prefill() == prefill_at(pos=0, valid_len=prompt_len)."""
-    x = M.embed(cfg, params, tokens, pos)
-    x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
-    last = jax.lax.dynamic_slice_in_dim(x, valid_len - 1, 1, axis=1)
-    logits = M.unembed(cfg, params, last)[:, 0, :]
-    first = sample_token(key, logits, *sampling)
-    return first, logits, cache
 
 
 @functools.partial(

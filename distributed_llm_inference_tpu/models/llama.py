@@ -86,7 +86,8 @@ def init_kv_cache(
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
-def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate):
+def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
+                      valid_start=None):
     """Cache write + attention for the dense (whole-cache-per-device) case.
 
     The hook seam lets SPMD backends swap the attention/cache strategy per
@@ -95,11 +96,10 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate):
     Returns (attn [B,T,H,Dh], cache_k, cache_v).
     """
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    # 3D mask = per-row validity (ragged left-padded batch); the flash
-    # kernel derives its mask from `pos` alone, so that path needs the 2D
-    # shared-causal case.
-    if cfg.attn_impl == "pallas" and mask.ndim == 2:
-        attn = flash_attend(q, new_k, new_v, pos, window=cfg.attn_window)
+    if cfg.attn_impl == "pallas":
+        attn = flash_attend(
+            q, new_k, new_v, pos, valid_start, window=cfg.attn_window
+        )
     else:
         attn = attend(q, new_k, new_v, mask)
     return attn, new_k, new_v
@@ -118,6 +118,7 @@ def decoder_layer(
     update_gate: Optional[jnp.ndarray] = None,
     tp_axis: Optional[str] = None,
     attn_hook=None,
+    valid_start: Optional[jnp.ndarray] = None,
 ):
     """One pre-norm decoder block on a chunk x [B,T,D] at offset `pos`.
 
@@ -147,7 +148,9 @@ def decoder_layer(
     q, k = apply_rope(q, k, cos, sin)
 
     hook = attn_hook or default_attn_hook
-    attn, new_k, new_v = hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate)
+    attn, new_k, new_v = hook(
+        cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate, valid_start
+    )
     attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -195,7 +198,7 @@ def forward_layers(
         lp, ck, cv = xs
         xc, ck, cv = decoder_layer(
             cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate, tp_axis,
-            attn_hook,
+            attn_hook, valid_start,
         )
         return xc, (ck, cv)
 

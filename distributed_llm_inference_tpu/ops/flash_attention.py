@@ -54,8 +54,19 @@ def _needed_tiles(pos, qi, *, T: int, block_t: int, block_k: int):
     return pl.cdiv(pos + t_hi, block_k)
 
 
+def _first_tile(pos, qi, *, block_t: int, block_k: int, window):
+    """First KV tile any query in tile qi can see: with sliding-window
+    attention the tile's EARLIEST query (pos + qi*block_t) bounds it at
+    q_pos - window + 1; full causal starts at 0."""
+    if window is None:
+        return jnp.int32(0)
+    lo = pos + qi * block_t - window + 1
+    return jnp.maximum(lo, 0) // block_k
+
+
 def _flash_kernel(
     pos_ref,  # scalar-prefetch [1] int32
+    vs_ref,  # scalar-prefetch [B] int32: per-row first valid slot
     q_ref,  # [1, block_t, 1, group, Dh] VMEM
     k_ref,  # [1, 1, block_k, Dh] VMEM
     v_ref,  # [1, 1, block_k, Dh] VMEM
@@ -73,6 +84,7 @@ def _flash_kernel(
     window: int | None,
 ):
     pos = pos_ref[0]
+    valid_from = vs_ref[pl.program_id(0)]
     qi = pl.program_id(2)
     j = pl.program_id(3)
     n_j = pl.num_programs(3)
@@ -80,6 +92,7 @@ def _flash_kernel(
     Dh = q_ref.shape[-1]
 
     needed = _needed_tiles(pos, qi, T=T, block_t=block_t, block_k=block_k)
+    first_live = _first_tile(pos, qi, block_t=block_t, block_k=block_k, window=window)
 
     @pl.when(j == 0)
     def _():
@@ -87,7 +100,7 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros((rows, 1), jnp.float32)
         acc_ref[:] = jnp.zeros((rows, Dh), jnp.float32)
 
-    @pl.when(j < needed)
+    @pl.when((j >= first_live) & (j < needed))
     def _():
         q = q_ref[0].reshape(rows, Dh).astype(jnp.float32) * scale
         # Row r of the tile is query (t_local = r // group, head g = r % group);
@@ -103,6 +116,7 @@ def _flash_kernel(
         )  # [rows, block_k]
         kv_pos = j * block_k + col_ids
         mask = (t_global < T) & (kv_pos <= q_pos) & (kv_pos < S)
+        mask &= kv_pos >= valid_from  # left-pad slots (ragged batches)
         if window is not None:  # sliding-window attention (Mistral-style)
             mask &= kv_pos > q_pos - window
         s = jnp.where(mask, s, _NEG)
@@ -133,6 +147,7 @@ def flash_attend(
     cache_k: jnp.ndarray,
     cache_v: jnp.ndarray,
     pos: jnp.ndarray,
+    valid_start: jnp.ndarray | None = None,
     *,
     block_t: int = 0,
     block_k: int = 0,
@@ -142,9 +157,12 @@ def flash_attend(
     """Causal GQA flash attention over the (already updated) cache.
 
     q [B,T,H,Dh], cache_k/v [B,KV,S,Dh], pos scalar int32 (chunk offset).
-    window: sliding-window attention width (None = full causal). Returns
+    valid_start: optional [B] int32 — first real slot per row (ragged
+    LEFT-padded batches; earlier slots are never attended). window:
+    sliding-window attention width (None = full causal). Returns
     [B,T,H,Dh] in q.dtype. Same contract as `attention.attend` with the
-    mask derived from `pos` (and `window`) instead of passed in.
+    mask derived from `pos` (and `valid_start`/`window`) instead of
+    passed in.
     """
     B, T, H, Dh = q.shape
     KV, S = cache_k.shape[1], cache_k.shape[2]
@@ -163,14 +181,22 @@ def flash_attend(
     # group's queries.
     q5 = q.reshape(B, T, KV, group, Dh)
     pos_arr = jnp.reshape(pos.astype(jnp.int32), (1,))
+    if valid_start is None:
+        valid_start = jnp.zeros((B,), jnp.int32)
+    valid_start = valid_start.astype(jnp.int32)
 
     nt = _needed_tiles  # close over static tile params in the index maps
 
-    def kv_index(b, kv, qi, j, pos_ref):
-        # Clamp dead tiles to the last live one: the block index repeats, so
-        # Pallas skips the DMA and dead grid steps cost nothing.
+    def kv_index(b, kv, qi, j, pos_ref, vs_ref):
+        # Clamp dead tiles (past the causal frontier, or — with a sliding
+        # window — before the window) to the nearest live one: the block
+        # index repeats, so Pallas skips the DMA and dead grid steps cost
+        # nothing. The kernel's pl.when gate skips their compute too.
         needed = nt(pos_ref[0], qi, T=T, block_t=block_t, block_k=block_k)
-        return (b, kv, jnp.minimum(j, needed - 1), 0)
+        first = _first_tile(
+            pos_ref[0], qi, block_t=block_t, block_k=block_k, window=window
+        )
+        return (b, kv, jnp.clip(j, first, needed - 1), 0)
 
     kernel = functools.partial(
         _flash_kernel,
@@ -184,19 +210,19 @@ def flash_attend(
     )
     rows = block_t * group
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(B, KV, pl.cdiv(T, block_t), pl.cdiv(S, block_k)),
         in_specs=[
             pl.BlockSpec(
                 (1, block_t, 1, group, Dh),
-                lambda b, kv, qi, j, pos_ref: (b, qi, kv, 0, 0),
+                lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
             ),
             pl.BlockSpec((1, 1, block_k, Dh), kv_index),
             pl.BlockSpec((1, 1, block_k, Dh), kv_index),
         ],
         out_specs=pl.BlockSpec(
             (1, block_t, 1, group, Dh),
-            lambda b, kv, qi, j, pos_ref: (b, qi, kv, 0, 0),
+            lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((rows, 1), jnp.float32),
@@ -209,5 +235,5 @@ def flash_attend(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, T, KV, group, Dh), q.dtype),
         interpret=interpret,
-    )(pos_arr, q5, cache_k, cache_v)
+    )(pos_arr, valid_start, q5, cache_k, cache_v)
     return out.reshape(B, T, H, Dh)

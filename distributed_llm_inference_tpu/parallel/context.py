@@ -116,7 +116,7 @@ class ContextParallelBackend(SPMDBackendBase):
     def _build_prefill(self):
         cfg = self.cfg
 
-        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate):
+        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None):
             attn = ring_attend(q, k, v, AXIS_SP)
             zero = jnp.int32(0)
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
@@ -204,7 +204,8 @@ class ContextParallelBackend(SPMDBackendBase):
                 new_id = jnp.where(owner, pos.astype(jnp.int32)[None, None], old_id)
                 pids2 = jax.lax.dynamic_update_slice(pids, new_id, (0, slot))
 
-                def cp_hook(cfg_, q, k, v, ck_l, cv_l, pos_, mask, gate):
+                def cp_hook(cfg_, q, k, v, ck_l, cv_l, pos_, mask, gate,
+                            valid_start=None):
                     ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner)
                     attn = cp_decode_attend(q, ck_l, cv_l, pids2[0], pos_, AXIS_SP)
                     return attn, ck_l, cv_l

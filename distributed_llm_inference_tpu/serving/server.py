@@ -291,6 +291,11 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--warmup", action="store_true",
+        help="pre-compile every (prefill, decode) bucket before serving "
+             "(first requests then never pay jit latency)",
+    )
     args = ap.parse_args(argv)
 
     engine = create_engine(
@@ -299,6 +304,10 @@ def main(argv: Optional[list] = None):
         dtype=args.dtype,
         seed=args.seed,
     )
+    if args.warmup:
+        print("⏳ warming up (compiling all bucket shapes)...")
+        stats = engine.warmup()
+        print(f"✅ warm: {stats['programs']} programs in {stats['seconds']}s")
     InferenceServer(engine, args.host, args.port, args.max_tokens_cap).serve_forever()
 
 

@@ -44,9 +44,9 @@ def test_chunked_equals_single_prefill():
         )
     tail = ids[32:]
     tokens = jnp.asarray([tail + [cfg.pad_token_id] * (16 - len(tail))], jnp.int32)
-    first_c, _, cache = G.prefill_at(
-        cfg, params, tokens, jnp.int32(32), jnp.int32(len(tail)), cache,
-        kp, sampling,
+    first_c, _, cache = G.prefill(
+        cfg, params, tokens, jnp.int32(len(tail)), cache, kp, sampling,
+        None, jnp.int32(32),
     )
     out_c, n_c, _ = G.decode(
         cfg, params, first_c, cache, jnp.int32(plen), jnp.int32(steps),

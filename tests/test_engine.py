@@ -124,3 +124,24 @@ def test_health_and_workers(tiny_engine):
     assert h["status"] == "healthy" and h["n_stages"] == 1
     w = tiny_engine.workers()
     assert w["total"] == 1 and w["workers"]["stage_0"]["status"] == "online"
+
+
+def test_warmup_compiles_and_requests_stay_fast():
+    """warmup() precompiles all bucket programs; a following request works
+    and reuses the warmed cache buffer."""
+    import time as _time
+
+    from distributed_llm_inference_tpu import EngineConfig, create_engine
+
+    engine = create_engine(
+        "test-llama-tiny",
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+    )
+    stats = engine.warmup(decode_buckets=(16,))
+    # 2 prefill buckets + 1 chunked-prefill extend + 1 decode bucket
+    assert stats["programs"] == 4
+    t0 = _time.time()
+    r = engine.generate("hi", max_tokens=3, greedy=True, chat=False)
+    assert r["status"] == "success"
+    # warm path: no multi-second jit compile inside the request
+    assert _time.time() - t0 < 5.0
