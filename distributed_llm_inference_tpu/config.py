@@ -38,6 +38,9 @@ class ModelConfig:
     # Sliding-window attention (Mistral-style): a query attends only the
     # last `attn_window` positions. None = full causal.
     attn_window: Optional[int] = None
+    # Biases on the q/k/v projections (Qwen2-style; llama family only —
+    # gpt2 always has full biases).
+    attn_qkv_bias: bool = False
     tie_embeddings: bool = False
     # GPT-2 only: learned absolute position embeddings.
     use_learned_pos: bool = False

@@ -69,6 +69,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         },
         "final_norm": jnp.ones((D,), dt),
     }
+    if cfg.attn_qkv_bias:  # Qwen2-style
+        params["layers"]["bq"] = jnp.zeros((L, H * Dh), dt)
+        params["layers"]["bk"] = jnp.zeros((L, KV * Dh), dt)
+        params["layers"]["bv"] = jnp.zeros((L, KV * Dh), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(ks[8], (D, V), s)
     return params
@@ -142,9 +146,12 @@ def decoder_layer(
     KV = lp["wk"].shape[-1] // Dh
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(B, T, H, Dh)
-    k = (h @ lp["wk"]).reshape(B, T, KV, Dh)
-    v = (h @ lp["wv"]).reshape(B, T, KV, Dh)
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if cfg.attn_qkv_bias:  # Qwen2-style (biases tp-shard with their columns)
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, H, Dh)
+    k = k.reshape(B, T, KV, Dh)
+    v = v.reshape(B, T, KV, Dh)
     q, k = apply_rope(q, k, cos, sin)
 
     hook = attn_hook or default_attn_hook

@@ -32,6 +32,10 @@ _LLAMA_LAYER_SPECS = {
     "wq": P(AXIS_PP, None, AXIS_TP),
     "wk": P(AXIS_PP, None, AXIS_TP),
     "wv": P(AXIS_PP, None, AXIS_TP),
+    # Qwen2-style qkv biases: per-output-column, shard alongside them
+    "bq": P(AXIS_PP, AXIS_TP),
+    "bk": P(AXIS_PP, AXIS_TP),
+    "bv": P(AXIS_PP, AXIS_TP),
     "wo": P(AXIS_PP, AXIS_TP, None),
     "w_gate": P(AXIS_PP, None, AXIS_TP),
     "w_up": P(AXIS_PP, None, AXIS_TP),

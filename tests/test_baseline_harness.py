@@ -1,0 +1,23 @@
+"""Smoke test: the 5-config BASELINE harness stays runnable in CI."""
+
+import json
+import io
+import contextlib
+import sys
+
+
+def test_harness_runs_each_config_shape(capsys):
+    sys.path.insert(0, "benchmarks")
+    from benchmarks.run_baseline_configs import main
+
+    # conftest already forces the 8-device CPU mesh; run the two cheapest
+    # configs end to end (single-device + 2-stage pipeline)
+    main(["--scale", "tiny", "--configs", "1,2", "--steps", "4"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 2
+    for i, line in enumerate(lines):
+        rec = json.loads(line)
+        assert rec["config"] == i + 1
+        assert rec["tokens_per_sec"] > 0
+        assert rec["ttft_s"] >= 0
+        assert rec["platform"] == "cpu"
