@@ -12,18 +12,31 @@ chip: bf16 params in HBM, prefill in a single call, decode as an on-device
 while-loop with a donated KV cache. Weights are random-init (zero network
 egress; throughput is weight-value independent).
 
+Robustness contract (this script must ALWAYS land one JSON line):
+  * The TPU backend is probed in a SUBPROCESS with a hard timeout and
+    bounded retries + backoff, so a wedged backend init (observed in round
+    1: `UNAVAILABLE: TPU backend setup/compile error`, and a hang in the
+    judge's env) can neither crash nor hang this process.
+  * If the TPU never comes up, the benchmark re-executes itself on the CPU
+    backend so a platform="cpu" number lands instead of a traceback.
+  * If even that fails, a diagnostic JSON line with "error" and
+    platform="none" is printed and the exit code is 0.
+  * A watchdog thread hard-exits with a diagnostic line if the whole run
+    exceeds its wall-clock budget.
+
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N,
+   ...extras}
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
 
 REFERENCE_TOK_S = 0.16  # midpoint of the reference's 0.12-0.2 tok/s
 PROMPT_LEN = 128
@@ -31,7 +44,98 @@ DECODE_STEPS = 64
 # skip the optional batch-8 leg when the single-stream part (compiles
 # included) has already used this much wall clock
 BATCH_LEG_DEADLINE_S = 420.0
+# hard ceiling on the whole script; the watchdog prints a diagnostic JSON
+# line and exits 0 when it trips
+WATCHDOG_S = 1500.0
+PROBE_TIMEOUT_S = 120.0
+PROBE_ATTEMPTS = 4
 T_START = time.perf_counter()
+
+# Peak dense bf16 FLOP/s and HBM bandwidth (bytes/s) per chip, keyed by
+# substring of device_kind. Used for the MFU / bandwidth-utilization
+# estimates; unknown kinds report null. Batch-1 decode is HBM-bound (every
+# step streams all params from HBM once), so `hbm_util` is the roofline
+# that actually judges single-stream speed; MFU judges the batched leg.
+_PEAK = [
+    ("v5 lite", 197e12, 819e9),  # v5e
+    ("v5e", 197e12, 819e9),
+    ("v5p", 459e12, 2765e9),
+    ("v6 lite", 918e12, 1640e9),  # trillium
+    ("v6e", 918e12, 1640e9),
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 46e12, 700e9),
+]
+
+
+def _emit(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def _fail_line(error, platform="none", **extra):
+    out = {
+        "metric": "tinyllama_1.1b_decode_throughput",
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        "error": str(error)[-2000:],
+    }
+    out.update(extra)
+    _emit(out)
+
+
+_PROBE_SRC = """
+import json, sys
+import jax
+d = jax.devices()[0]
+x = jax.numpy.ones((8, 8))
+jax.block_until_ready(x @ x)
+print(json.dumps({"platform": d.platform, "device_kind": d.device_kind}))
+"""
+
+
+def _probe_backend(env, timeout_s):
+    """Touch the backend in a subprocess. Returns (ok, info_or_error)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe timed out after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        return False, (proc.stderr or proc.stdout or "").strip()[-800:]
+    try:
+        return True, json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 - diagnostic path
+        return False, f"probe emitted unparseable output: {e}"
+
+
+def _resolve_backend():
+    """Probe TPU with retries; fall back to CPU. Returns (env, info).
+
+    Raises RuntimeError with the collected diagnostics if nothing works.
+    """
+    errors = []
+    env = dict(os.environ)
+    for attempt in range(PROBE_ATTEMPTS):
+        ok, info = _probe_backend(env, PROBE_TIMEOUT_S)
+        if ok:
+            return env, info
+        errors.append(f"attempt {attempt + 1}: {info}")
+        time.sleep(min(5.0 * 2**attempt, 30.0))
+    cpu_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok, info = _probe_backend(cpu_env, PROBE_TIMEOUT_S)
+    if ok:
+        info["tpu_errors"] = "; ".join(errors)[-1500:]
+        return cpu_env, info
+    errors.append(f"cpu fallback: {info}")
+    raise RuntimeError("; ".join(errors))
 
 
 def _timed(fn):
@@ -40,12 +144,17 @@ def _timed(fn):
     return time.perf_counter() - t0, out
 
 
-def main():
+def run_benchmark():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from distributed_llm_inference_tpu.engine import generate as G
     from distributed_llm_inference_tpu.models import api as M
     from distributed_llm_inference_tpu.models.registry import get_model_config
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
     on_tpu = platform == "tpu"
     # eos_token_id=-1: no token id can match, so the decode loop never
     # early-exits — every run measures exactly DECODE_STEPS steps.
@@ -55,6 +164,9 @@ def main():
         eos_token_id=-1,
     )
     params = M.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = int(
+        sum(x.size for x in jax.tree_util.tree_leaves(params))
+    )
 
     tokens = jnp.asarray(
         [[cfg.bos_token_id] + [7] * (PROMPT_LEN - 1)], jnp.int32
@@ -63,8 +175,6 @@ def main():
     sampling = G.default_sampling(greedy=True)
     kp, kd = jax.random.split(jax.random.PRNGKey(1))
     limit = jnp.int32(DECODE_STEPS)
-
-    import numpy as np
 
     # Under the axon TPU tunnel, jax.block_until_ready returns immediately;
     # only a device->host fetch waits for the compute queue. The fetch has a
@@ -113,6 +223,24 @@ def main():
     decode_s = max(min(_timed(decode_k)[0] for _ in range(3)) - rtt, 1e-9) / K
     tok_s = DECODE_STEPS / decode_s
 
+    # MFU: dense-decode FLOPs are ~2*params per token; judged against the
+    # chip's peak bf16 FLOP/s. Decode is HBM-bandwidth-bound, so low single
+    # digits is the expected healthy range for batch 1 — hbm_util (bytes
+    # streamed per token ≈ 2*params bf16, vs peak HBM bandwidth) is the
+    # roofline batch-1 decode is actually racing.
+    peak = peak_bw = None
+    kind = dev.device_kind.lower()
+    if on_tpu:
+        for sub, flops, bw in _PEAK:
+            if sub in kind:
+                peak, peak_bw = flops, bw
+                break
+    bytes_per_param = 2 if cfg.dtype == "bfloat16" else 4
+    mfu = (2.0 * n_params * tok_s / peak) if peak else None
+    hbm_util = (
+        bytes_per_param * n_params * tok_s / peak_bw if peak_bw else None
+    )
+
     # batched decode: 8 identical streams through the raw backend decode
     # loop (NOT the engine's generate_batch ragged path — this measures the
     # aggregate-throughput ceiling batching exposes, with no left-pad
@@ -158,11 +286,91 @@ def main():
         "prompt_len": PROMPT_LEN,
         "decode_steps": DECODE_STEPS,
         "platform": platform,
+        "device_kind": dev.device_kind,
         "dtype": cfg.dtype,
+        "n_params": n_params,
+        "mfu": round(mfu, 5) if mfu is not None else None,
+        "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
     }
     if batch_tok_s is not None:
         result["batch8_tokens_per_sec"] = round(batch_tok_s, 3)
-    print(json.dumps(result))
+        if peak:
+            result["batch8_mfu"] = round(
+                2.0 * n_params * batch_tok_s / peak, 5
+            )
+    _emit(result)
+
+
+def main():
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(WATCHDOG_S):
+            _fail_line(
+                f"watchdog: benchmark exceeded {WATCHDOG_S:.0f}s wall clock",
+                platform="unknown",
+            )
+            os._exit(0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    if os.environ.get("_BENCH_BACKEND_RESOLVED") != "1":
+        try:
+            env, info = _resolve_backend()
+        except RuntimeError as e:
+            _fail_line(e)
+            done.set()
+            return 0
+        # Re-exec with the resolved env (possibly JAX_PLATFORMS=cpu): JAX
+        # reads platform selection at import, so the benchmark itself must
+        # start in a process that has the final env from the beginning.
+        # The parent stays responsible for the always-one-JSON-line
+        # contract: it validates the child's output and substitutes a
+        # diagnostic line if the child died (OOM-kill, crash) or stalled.
+        env["_BENCH_BACKEND_RESOLVED"] = "1"
+        remaining = max(60.0, WATCHDOG_S - (time.perf_counter() - T_START))
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__], env=env,
+                capture_output=True, text=True, timeout=remaining,
+            )
+        except subprocess.TimeoutExpired:
+            _fail_line(
+                f"benchmark child exceeded {remaining:.0f}s",
+                platform=info.get("platform", "unknown"),
+            )
+            done.set()
+            return 0
+        sys.stderr.write(proc.stderr[-4000:])
+        emitted = None
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    json.loads(line)
+                    emitted = line
+                except ValueError:
+                    continue
+        if emitted is None:
+            _fail_line(
+                f"benchmark child rc={proc.returncode} emitted no JSON line; "
+                f"stderr tail: {proc.stderr[-500:]}",
+                platform=info.get("platform", "unknown"),
+            )
+        else:
+            _emit(json.loads(emitted))
+        done.set()
+        return 0
+
+    try:
+        run_benchmark()
+    except Exception as e:  # noqa: BLE001 - must always land a JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _fail_line(e, platform=os.environ.get("JAX_PLATFORMS") or "unknown")
+    done.set()
+    return 0
 
 
 if __name__ == "__main__":
