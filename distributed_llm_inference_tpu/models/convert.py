@@ -4,16 +4,31 @@ Replaces the reference's L0 loading/partitioning, which downloads the *full*
 torch model on every process and slices `nn.ModuleList`s
 (/root/reference/orchestration.py:38-53, Worker1.py:60-77 — keeping the whole
 model around just for rotary access). Here a HF state dict (torch tensors or
-a safetensors file) is converted once into the stacked-layer pytree of
+safetensors files on disk) is converted once into the stacked-layer pytree of
 models/llama.py / models/gpt2.py; pipeline stages then slice the stacked
 layer axis, so a stage only ever materializes its own shard.
 
-Works fully offline: accepts any in-memory `state_dict()` (tests build
-tiny-random HF models from configs, no hub access needed).
+Two entry paths:
+  * `params_from_hf_model(model)` — an in-memory transformers model
+    (tests build tiny-random HF models from configs, no hub access);
+  * `load_hf_checkpoint(dir)` — a saved HF checkpoint directory
+    (`config.json` + `model.safetensors` or a sharded
+    `model.safetensors.index.json`), read with a hand-rolled zero-copy
+    mmap safetensors parser — no torch model is ever instantiated, unlike
+    the reference which materializes the full torch module on every
+    process just to slice it (/root/reference/Worker1.py:60-75).
+
+CLI (conversion to the local checkpoint store, models/checkpoint.py):
+  python -m distributed_llm_inference_tpu.models.convert \
+      --in <hf_checkpoint_dir> --out <ckpt_dir> [--dtype bfloat16]
 """
 
 from __future__ import annotations
 
+import glob
+import json
+import mmap
+import os
 from typing import Any, Mapping
 
 import numpy as np
@@ -31,7 +46,7 @@ def _np(t) -> np.ndarray:
 
 
 def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32") -> ModelConfig:
-    """Map a transformers LlamaConfig/GPT2Config to our ModelConfig."""
+    """Map a transformers LlamaConfig/GPT2Config/Qwen2Config to our ModelConfig."""
     mt = getattr(hf_cfg, "model_type", "llama")
     if mt == "gpt2":
         return ModelConfig(
@@ -52,6 +67,10 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
             bos_token_id=hf_cfg.bos_token_id if hf_cfg.bos_token_id is not None else 50256,
             pad_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 50256,
         )
+    # Qwen2 carries a sliding_window value but gates it off by default
+    window = getattr(hf_cfg, "sliding_window", None)
+    if mt == "qwen2" and not getattr(hf_cfg, "use_sliding_window", False):
+        window = None
     return ModelConfig(
         name=name,
         arch="llama",
@@ -65,7 +84,10 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         norm_eps=hf_cfg.rms_norm_eps,
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
         # Mistral-style sliding window (HF: None/absent = full causal)
-        attn_window=getattr(hf_cfg, "sliding_window", None),
+        attn_window=window,
+        # Qwen2-style q/k/v biases: Qwen2 has them unconditionally; Llama
+        # exposes the optional `attention_bias` flag
+        attn_qkv_bias=bool(getattr(hf_cfg, "attention_bias", False)) or mt == "qwen2",
         tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         dtype=dtype,
         eos_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 2,
@@ -104,6 +126,16 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
         },
         "final_norm": jnp.asarray(p("model.norm.weight"), dtype=dt),
     }
+    if cfg.attn_qkv_bias:
+        # Qwen2-style per-output-column biases, stacked like their weights
+        params["layers"]["bq"] = stack("model.layers.{}.self_attn.q_proj.bias", False)
+        params["layers"]["bk"] = stack("model.layers.{}.self_attn.k_proj.bias", False)
+        params["layers"]["bv"] = stack("model.layers.{}.self_attn.v_proj.bias", False)
+    elif "model.layers.0.self_attn.q_proj.bias" in sd:
+        raise ValueError(
+            "checkpoint has q/k/v projection biases but cfg.attn_qkv_bias is "
+            "False — converting would silently drop them"
+        )
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(p("lm_head.weight").T, dtype=dt)
     return params
@@ -158,3 +190,186 @@ def params_from_hf_model(hf_model: Any, dtype: str = "float32"):
     if cfg.arch == "gpt2":
         return cfg, gpt2_params_from_state_dict(sd, cfg)
     return cfg, llama_params_from_state_dict(sd, cfg)
+
+
+# -- safetensors files -------------------------------------------------------
+#
+# Hand-rolled reader for the safetensors on-disk format: 8-byte LE header
+# length, JSON header {name: {dtype, shape, data_offsets}}, then raw tensor
+# bytes. mmap + np.frombuffer gives zero-copy views — only the pages the
+# stacking step actually touches are read, and no torch module is ever
+# built (the reference instantiates the FULL model on every process and
+# throws half away, /root/reference/Worker1.py:60-75).
+
+_ST_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _st_dtype(name: str):
+    if name == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(_ST_DTYPES[name])
+    except KeyError:
+        raise ValueError(f"unsupported safetensors dtype {name!r}") from None
+
+
+def load_safetensors_file(path: str) -> dict:
+    """Read one .safetensors file into {name: np.ndarray} (zero-copy mmap
+    views; the file mapping stays alive as long as the arrays do)."""
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+    header_len = int.from_bytes(mm[:8], "little")
+    header = json.loads(mm[8 : 8 + header_len].decode("utf-8"))
+    base = 8 + header_len
+    out = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        dt = _st_dtype(meta["dtype"])
+        shape = meta["shape"]
+        o0, o1 = meta["data_offsets"]
+        n = int(np.prod(shape)) if shape else 1
+        if o1 - o0 != n * dt.itemsize:
+            raise ValueError(
+                f"{path}: tensor {name!r} length {o1 - o0} != "
+                f"prod(shape)*itemsize {n * dt.itemsize}"
+            )
+        out[name] = np.frombuffer(mm, dtype=dt, count=n, offset=base + o0).reshape(shape)
+    return out
+
+
+def load_safetensors_dir(path: str) -> dict:
+    """State dict from a HF checkpoint dir: `model.safetensors`, a sharded
+    `model.safetensors.index.json`, or any *.safetensors files present."""
+    index = os.path.join(path, "model.safetensors.index.json")
+    single = os.path.join(path, "model.safetensors")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        sd = {}
+        for shard in sorted(set(weight_map.values())):
+            sd.update(load_safetensors_file(os.path.join(path, shard)))
+        missing = set(weight_map) - set(sd)
+        if missing:
+            raise ValueError(f"{index}: shards missing tensors {sorted(missing)[:5]}")
+        return sd
+    if os.path.exists(single):
+        return load_safetensors_file(single)
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    sd = {}
+    for fp in files:
+        sd.update(load_safetensors_file(fp))
+    return sd
+
+
+class _JsonConfig:
+    """Attribute view over config.json.
+
+    Transformers config objects always carry the token-id attributes (as
+    None when unset), so those read as None here too; every other absent
+    key raises AttributeError so (a) the getattr(..., default) probes in
+    config_from_hf fall back to their real defaults instead of silently
+    producing None-valued model hyperparameters, and (b) a checkpoint
+    missing a required key (hidden_size, n_embd, ...) fails loudly."""
+
+    _NONE_DEFAULTED = frozenset(
+        {"eos_token_id", "bos_token_id", "pad_token_id", "n_inner"}
+    )
+
+    def __init__(self, d: dict):
+        self.__dict__.update(d)
+
+    def __getattr__(self, name):  # only called when not in __dict__
+        if name in self._NONE_DEFAULTED:
+            return None
+        raise AttributeError(
+            f"config.json has no {name!r} (and it has no None default)"
+        )
+
+
+def load_hf_checkpoint(path: str, name: str = None, dtype: str = "float32"):
+    """(cfg, params) from a HF checkpoint directory on disk.
+
+    `path` must hold config.json + safetensors weights (what
+    `save_pretrained(..., safe_serialization=True)` writes, and what the
+    Hub serves for every supported model family).
+    """
+    cfg_path = os.path.join(path, "config.json")
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    hf_cfg = _JsonConfig(raw)
+    cfg = config_from_hf(hf_cfg, name=name or os.path.basename(os.path.normpath(path)), dtype=dtype)
+    sd = load_safetensors_dir(path)
+    # HF omits lm_head.weight from checkpoints when tied even if the config
+    # says untied-capable; trust the tensors over the flag
+    if cfg.arch == "llama" and not cfg.tie_embeddings and "lm_head.weight" not in sd:
+        cfg = cfg.replace(tie_embeddings=True)
+    if cfg.arch == "gpt2":
+        return cfg, gpt2_params_from_state_dict(sd, cfg)
+    return cfg, llama_params_from_state_dict(sd, cfg)
+
+
+def main(argv=None) -> int:
+    """CLI: convert a HF checkpoint dir into the local checkpoint store."""
+    import argparse
+
+    import jax
+
+    # Conversion is a host-side file transform: force the CPU backend so
+    # the CLI neither waits on nor contends with an accelerator another
+    # process (e.g. the serving engine) is using. Must run before the
+    # first backend init; wins over the env-pinned platform.
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. main() called from tests)
+
+    from .checkpoint import save_params
+
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_llm_inference_tpu.models.convert",
+        description="Convert a HuggingFace safetensors checkpoint into the "
+        "stacked-layer local checkpoint store (models/checkpoint.py).",
+    )
+    ap.add_argument("--in", dest="src", required=True, help="HF checkpoint dir")
+    ap.add_argument("--out", dest="dst", required=True, help="output ckpt dir")
+    ap.add_argument("--dtype", default="bfloat16", choices=["float32", "bfloat16"])
+    ap.add_argument("--name", default=None, help="model name recorded in the config")
+    args = ap.parse_args(argv)
+
+    cfg, params = load_hf_checkpoint(args.src, name=args.name, dtype=args.dtype)
+    save_params(args.dst, cfg, params)
+    import jax
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    print(
+        json.dumps(
+            {
+                "model": cfg.name,
+                "arch": cfg.arch,
+                "n_layers": cfg.n_layers,
+                "n_params": int(n_params),
+                "dtype": cfg.dtype,
+                "out": args.dst,
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,198 @@
+"""Real-weights file path: HF save_pretrained dir -> our loader -> parity.
+
+The reference's whole entry point is loading actual checkpoint weights
+(/root/reference/orchestration.py:39, Worker1.py:60-65). Here the
+round-trip is through FILES — save_pretrained(safe_serialization=True) →
+our hand-rolled safetensors reader → stacked pytree — with logits parity
+against the in-memory torch model, plus the conversion CLI into the local
+checkpoint store.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from distributed_llm_inference_tpu.models import checkpoint, gpt2, llama
+from distributed_llm_inference_tpu.models.convert import (
+    load_hf_checkpoint,
+    load_safetensors_dir,
+    load_safetensors_file,
+    main as convert_main,
+    params_from_hf_model,
+)
+
+
+def _tiny_hf_llama(tmp_path, qkv_bias=False):
+    cfg = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        attention_bias=qkv_bias,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    d = os.path.join(tmp_path, "hf")
+    model.save_pretrained(d, safe_serialization=True)
+    return model, d
+
+
+def test_file_roundtrip_logits_parity(tmp_path):
+    hf, d = _tiny_hf_llama(tmp_path)
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.arch == "llama" and cfg.n_layers == 3
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 11), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_file_load_matches_in_memory_conversion(tmp_path):
+    hf, d = _tiny_hf_llama(tmp_path)
+    cfg_mem, params_mem = params_from_hf_model(hf, dtype="float32")
+    cfg_file, params_file = load_hf_checkpoint(d, dtype="float32")
+    assert cfg_file.replace(name=cfg_mem.name) == cfg_mem
+    flat_mem = jax.tree_util.tree_leaves_with_path(params_mem)
+    flat_file = jax.tree_util.tree_leaves_with_path(params_file)
+    assert [p for p, _ in flat_mem] == [p for p, _ in flat_file]
+    for (_, a), (_, b) in zip(flat_mem, flat_file):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_qkv_bias_checkpoint_roundtrip(tmp_path):
+    """ADVICE r1: biased checkpoints must map their biases, not drop them."""
+    hf, d = _tiny_hf_llama(tmp_path, qkv_bias=True)
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.attn_qkv_bias
+    assert "bq" in params["layers"] and params["layers"]["bq"].shape == (3, 64)
+
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 9), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=1, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_index_load(tmp_path):
+    """Sharded model.safetensors.index.json checkpoints merge correctly."""
+    from safetensors.numpy import save_file
+
+    hf, d = _tiny_hf_llama(tmp_path)
+    whole = load_safetensors_dir(d)
+    sharded = os.path.join(tmp_path, "sharded")
+    os.makedirs(sharded)
+    names = sorted(whole)
+    half = len(names) // 2
+    shards = {
+        "model-00001-of-00002.safetensors": {k: np.ascontiguousarray(whole[k]) for k in names[:half]},
+        "model-00002-of-00002.safetensors": {k: np.ascontiguousarray(whole[k]) for k in names[half:]},
+    }
+    weight_map = {}
+    for fname, tensors in shards.items():
+        save_file(tensors, os.path.join(sharded, fname))
+        weight_map.update({k: fname for k in tensors})
+    with open(os.path.join(sharded, "model.safetensors.index.json"), "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+    import shutil
+
+    shutil.copy(os.path.join(d, "config.json"), os.path.join(sharded, "config.json"))
+
+    cfg1, params1 = load_hf_checkpoint(d, dtype="float32")
+    cfg2, params2 = load_hf_checkpoint(sharded, dtype="float32")
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params1), jax.tree_util.tree_leaves(params2)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16_safetensors_load(tmp_path):
+    """BF16 tensors (how real checkpoints ship) decode bit-exactly."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(2)
+    arr = rng.standard_normal((4, 8)).astype(np.float32).astype(ml_dtypes.bfloat16)
+    path = os.path.join(tmp_path, "x.safetensors")
+    # safetensors.numpy rejects ml_dtypes; write the raw bit pattern and
+    # patch the header dtype to BF16 like real checkpoints carry
+    save_file({"x": arr.view(np.uint16)}, path)
+    raw = open(path, "rb").read()
+    n = int.from_bytes(raw[:8], "little")
+    header = json.loads(raw[8 : 8 + n])
+    header["x"]["dtype"] = "BF16"
+    new_header = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(len(new_header).to_bytes(8, "little") + new_header + raw[8 + n :])
+    out = load_safetensors_file(path)
+    assert out["x"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(out["x"].view(np.uint16), arr.view(np.uint16))
+
+
+def test_convert_cli_roundtrip(tmp_path, capsys):
+    """`--in hf_dir --out ckpt` lands a loadable checkpoint-store dir."""
+    hf, d = _tiny_hf_llama(tmp_path)
+    out = os.path.join(tmp_path, "ckpt")
+    rc = convert_main(["--in", d, "--out", out, "--dtype", "float32"])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip())
+    assert summary["arch"] == "llama" and summary["n_layers"] == 3
+
+    cfg, params = checkpoint.load_params(out)
+    cfg_mem, params_mem = params_from_hf_model(hf, dtype="float32")
+    for a, b in zip(
+        jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(params_mem)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gpt2_file_roundtrip(tmp_path):
+    cfg_hf = transformers.GPT2Config(
+        vocab_size=160,
+        n_positions=64,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(cfg_hf)
+    hf.eval()
+    d = os.path.join(tmp_path, "hf_gpt2")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.arch == "gpt2"
+    rng = np.random.default_rng(3)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 13), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = gpt2.init_kv_cache(cfg, batch=1, max_seq=32)
+    logits, _ = gpt2.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
