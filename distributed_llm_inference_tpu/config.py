@@ -84,7 +84,8 @@ class MeshConfig:
 
     The reference's topology (orchestrator + 2 HTTP workers) maps to
     pp_stages=2; here any (dp, pp, sp, tp) factorization of the available
-    devices is valid as long as n_layers % pp == 0, n_kv_heads % tp == 0,
+    devices is valid as long as pp <= n_layers (uneven splits are padded
+    with zero no-op layers), n_kv_heads % tp == 0,
     and (for sp > 1) the prefill bucket % sp == 0. sp is the long-context
     axis: ring-attention prefill + context-parallel KV-cache decode
     (parallel/ring.py, parallel/context.py).
@@ -126,17 +127,29 @@ class EngineConfig:
     # Prompt-length buckets for prefill compilation (TTFT: avoids recompiling
     # per prompt length; prompts are right-padded up to the bucket).
     prefill_buckets: tuple = (64, 128, 256, 512, 1024, 2048)
+    # Per-request wall-clock deadline in seconds (None = unlimited). The
+    # reference enforces 30s per stage hop (orchestration.py:118,131);
+    # here a whole request that exceeds the deadline gets a timeout error
+    # envelope and the engine keeps serving (the wedged device call is
+    # abandoned to a daemon thread; the engine lock frees when it dies).
+    request_deadline_s: Optional[float] = None
 
 
 def stage_layer_range(n_layers: int, pp: int, stage: int) -> tuple[int, int]:
     """Contiguous layer range [start, end) owned by `stage`.
 
     The reference hardcodes 0-11 / 11-22 for TinyLlama's 22 layers
-    (/root/reference/Worker1.py:27-28, Worker2.py:26-27); we compute the
-    split and require an even partition so stacked-layer params shard
-    cleanly along the pipeline mesh axis.
+    (/root/reference/Worker1.py:27-28, Worker2.py:26-27); we compute a
+    balanced split for ANY pp <= n_layers: the first n_layers % pp stages
+    own one extra layer (22/4 -> 6,6,5,5). Stages whose share is short of
+    ceil(n_layers/pp) are padded with zero no-op layers at shard time
+    (parallel/partition.pad_stacked_layers) so the stacked layer axis still
+    shards evenly over the pp mesh axis.
     """
-    if n_layers % pp != 0:
-        raise ValueError(f"n_layers={n_layers} not divisible by pp={pp}")
-    per = n_layers // pp
-    return stage * per, (stage + 1) * per
+    if not 1 <= pp <= n_layers:
+        raise ValueError(f"pp={pp} must be in [1, n_layers={n_layers}]")
+    if not 0 <= stage < pp:
+        raise ValueError(f"stage={stage} out of range for pp={pp}")
+    base, rem = divmod(n_layers, pp)
+    start = stage * base + min(stage, rem)
+    return start, start + base + (1 if stage < rem else 0)

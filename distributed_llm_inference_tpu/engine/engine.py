@@ -47,8 +47,8 @@ class SingleDeviceBackend:
 
     name = "single-device"
     n_stages = 1
-    # Ragged (left-padded, per-row valid_start) batches: only this backend
-    # threads valid_start; the SPMD backends decode shared-position batches.
+    # Ragged (left-padded, per-row valid_start) batches; PipelineBackend
+    # threads valid_start too, so pp meshes serve the same request surface.
     supports_ragged = True
 
     def __init__(self, cfg: ModelConfig, params):
@@ -67,9 +67,9 @@ class SingleDeviceBackend:
             valid_start, jnp.int32(0),
         )
 
-    # chunked prefill (prompts longer than the largest bucket); the SPMD
-    # backends don't expose these yet, and the engine falls back to the
-    # bucket-limit error there
+    # chunked prefill (prompts longer than the largest bucket); the engine
+    # uses these on any backend that exposes them (this one and the SPMD
+    # PipelineBackend) and falls back to the bucket-limit error elsewhere
     def extend(self, tokens, pos, cache):
         return G.extend(self.cfg, self.params, tokens, pos, cache)
 
@@ -87,11 +87,13 @@ class SingleDeviceBackend:
         )
 
     def health(self) -> list[dict]:
-        """Per-device health (reference /workers sweep, orchestration.py:306-329)."""
-        devs = jax.devices()
-        return [
-            {"stage": 0, "devices": [str(d) for d in devs[:1]], "status": "online"}
-        ]
+        """Per-device health: a timed device probe, the in-process analogue
+        of the reference's 5s-timeout /workers sweep
+        (orchestration.py:306-329)."""
+        from ..utils.probe import probe_device
+
+        dev = jax.devices()[0]
+        return [{"stage": 0, "devices": [str(dev)], **probe_device(dev)}]
 
 
 class InferenceEngine:
@@ -128,11 +130,51 @@ class InferenceEngine:
         # between requests are harmless — prefill rewrites slots [0, bucket)
         # and the causal mask hides every slot beyond the current position.
         self._cache = None
+        # Same donate-and-restore pattern per batch bucket: without it every
+        # batched request allocates (and drops) a Bb x max_seq cache — multi-
+        # GB HBM churn on the hot batched path.
+        self._batch_caches: dict[int, Any] = {}
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def _with_deadline(self, fn, what: str):
+        """Run fn() under the configured per-request deadline.
+
+        TPU-native analogue of the reference's per-hop 30s timeout
+        (orchestration.py:118,131): a request that overruns gets a timeout
+        envelope (error_type "timeout" -> HTTP 503) while the stuck call is
+        abandoned to a daemon thread. The engine lock frees when that
+        thread finishes, so one wedged device call delays — but never
+        permanently wedges — subsequent requests; they time out cleanly
+        against the same deadline until the lock frees.
+        """
+        deadline = self.engine_cfg.request_deadline_s
+        if not deadline:
+            return fn()
+        box: dict = {}
+
+        def run():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # re-raised on the caller thread
+                box["exc"] = e
+
+        t = threading.Thread(target=run, daemon=True, name=f"engine-{what}")
+        t.start()
+        t.join(deadline)
+        if t.is_alive():
+            log.error("request_deadline_exceeded", what=what, deadline_s=deadline)
+            return {
+                "error": f"Error: request exceeded the {deadline:g}s deadline",
+                "status": "failed",
+                "error_type": "timeout",
+            }
+        if "exc" in box:
+            raise box["exc"]
+        return box["result"]
 
     def _buckets(self):
         return tuple(b for b in self.engine_cfg.prefill_buckets if b <= self.cfg.max_seq_len)
@@ -191,15 +233,25 @@ class InferenceEngine:
         greedy: bool = False,
         chat: bool = True,
         seed: Optional[int] = None,
+        debug: bool = False,
     ) -> dict:
-        """Full generation; returns the reference-schema response dict."""
+        """Full generation; returns the reference-schema response dict.
+
+        debug=True adds "top_predictions": the top-5 first-token
+        candidates with probabilities (the reference prints these,
+        orchestration.py:172-178; here they are response data, not stdout).
+        """
         t_start = time.time()
-        try:
+
+        def locked():
             with self._lock:
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-                    seed, t_start,
+                    seed, t_start, debug,
                 )
+
+        try:
+            return self._with_deadline(locked, "generate")
         except ValueError as e:
             # caller-caused (e.g. prompt longer than the largest prefill
             # bucket): tagged so the serving edge can answer 400, not 500
@@ -211,7 +263,8 @@ class InferenceEngine:
             return {"error": f"Error: {e}", "status": "failed"}
 
     def _generate_locked(
-        self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat, seed, t_start
+        self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
+        seed, t_start, debug=False,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -294,6 +347,20 @@ class InferenceEngine:
         gen_ids = self._row_tokens(int(first[0]), out[0], int(n_gen[0]))
         response = self.tokenizer.decode(gen_ids, skip_special_tokens=True)
 
+        top_predictions = None
+        if debug and logits.shape[-1] > 0:  # 1F1B may return 0-width logits
+            from ..ops.sampling import top_n_probs
+
+            probs, tids = top_n_probs(logits, 5)
+            top_predictions = [
+                {
+                    "token": self.tokenizer.decode([int(t)]),
+                    "id": int(t),
+                    "prob": round(float(p), 5),
+                }
+                for p, t in zip(probs[0], tids[0])
+            ]
+
         elapsed = time.time() - t_start
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
@@ -304,7 +371,7 @@ class InferenceEngine:
             ttft_s=round(ttft, 4), tokens_per_sec=round(tps, 2),
             elapsed_s=round(elapsed, 3),
         )
-        return {
+        result = {
             "prompt": prompt,
             "response": response,
             "status": "success",
@@ -314,47 +381,70 @@ class InferenceEngine:
             "ttft_s": round(ttft, 4),
             "backend": self.backend.name,
         }
+        if top_predictions is not None:
+            result["top_predictions"] = top_predictions
+        return result
 
     # -- warmup --------------------------------------------------------------
-    def warmup(self, decode_buckets=None) -> dict:
-        """Pre-compile the single-prompt serving programs so those requests
-        never pay jit latency.
+    def warmup(self, decode_buckets=None, batch_buckets=None) -> dict:
+        """Pre-compile every serving program so no request pays jit latency.
 
         BASELINE.json's target is p50 TTFT — that requires warm-compiled
         caches for every (prefill bucket, decode bucket) shape, not
-        compile-on-first-request (SURVEY.md §7 'TTFT < 500 ms' note). One
-        prefill program per bucket (shared with the chunked-prefill final
-        chunk — `pos` is traced), the extend() chunk program when the
-        backend supports chunking, and one decode program per decode
-        bucket; sampling params are traced scalars, so one program covers
-        every temperature/top-k/top-p/greedy combination.
+        compile-on-first-request (SURVEY.md §7 'TTFT < 500 ms' note).
+        Covers:
+          * one single-stream prefill program per prefill bucket (shared
+            with the chunked-prefill final chunk — `pos` is traced);
+          * the extend() chunk program when the backend supports chunking
+            (single-device AND the SPMD pipeline);
+          * one single-stream decode program per decode bucket;
+          * the batched/ragged programs — (batch bucket x prefill bucket)
+            prefills with a valid_start operand and (batch bucket x decode
+            bucket) decodes — when the backend supports ragged batches
+            (round-1 gap: the first batched request on a warm server still
+            paid a full compile).
+        Sampling params are traced scalars, so one program covers every
+        temperature/top-k/top-p/greedy combination.
 
-        Scope: batched ("prompts"-list) programs are NOT warmed here —
-        their shapes include the batch bucket and the ragged valid_start
-        operand; issue one representative generate_batch to warm those.
+        batch_buckets: None = auto (all of BATCH_BUCKETS when the
+        model/backend can serve batches, else none); pass () to skip
+        batched warming or a tuple to warm specific batch sizes.
 
         Returns {"programs": N, "seconds": wall}.
         """
         t0 = time.time()
         decode_buckets = tuple(decode_buckets or DECODE_BUCKETS)
+        if batch_buckets is None:
+            can_batch = (
+                self.cfg.arch == "llama"
+                and getattr(self.backend, "supports_ragged", False)
+            )
+            batch_buckets = BATCH_BUCKETS if can_batch else ()
         sampling = G.default_sampling(greedy=True)
         key = jax.random.PRNGKey(0)
         n = 0
         buckets = self._buckets()
+        if not buckets:
+            # an empty bucket layout would leave `first` unset below and
+            # crash the decode warm loop with an opaque TypeError
+            raise ValueError(
+                f"warmup needs at least one prefill bucket <= max_seq_len "
+                f"{self.cfg.max_seq_len}; got prefill_buckets="
+                f"{self.engine_cfg.prefill_buckets}"
+            )
+        pad = self.cfg.pad_token_id
         with self._lock:
             cache = self._cache or self.backend.init_cache(1, self.cfg.max_seq_len)
             self._cache = None
             first = None
             for bucket in buckets:
-                tokens = jnp.full((1, bucket), self.cfg.pad_token_id, jnp.int32)
+                tokens = jnp.full((1, bucket), pad, jnp.int32)
                 first, _, cache = self.backend.prefill(
                     tokens, jnp.int32(1), cache, key, sampling
                 )
                 n += 1
-            if buckets and hasattr(self.backend, "extend"):
-                chunk_tokens = jnp.full(
-                    (1, buckets[-1]), self.cfg.pad_token_id, jnp.int32
-                )
+            if hasattr(self.backend, "extend"):
+                chunk_tokens = jnp.full((1, buckets[-1]), pad, jnp.int32)
                 cache = self.backend.extend(chunk_tokens, jnp.int32(0), cache)
                 n += 1
             for db in decode_buckets:
@@ -366,6 +456,36 @@ class InferenceEngine:
                 n += 1
             jax.block_until_ready(cache)
             self._cache = cache  # first real request reuses the buffer
+
+            # batched/ragged programs. Only the LARGEST warmed bucket's
+            # cache is retained afterwards: keeping one per bucket would
+            # pin sum(BATCH_BUCKETS) x max_seq of KV in HBM (multi-GB for
+            # an 8B-class model) whether or not batched traffic ever
+            # arrives — the compile warmth is what matters; reallocating a
+            # zeroed cache is cheap next to a compile.
+            for Bb in batch_buckets:
+                bcache = self._batch_caches.pop(Bb, None)
+                if bcache is None:
+                    bcache = self.backend.init_cache(Bb, self.cfg.max_seq_len)
+                valid_start = jnp.zeros((Bb,), jnp.int32)
+                bfirst = None
+                for bucket in buckets:
+                    tokens = jnp.full((Bb, bucket), pad, jnp.int32)
+                    bfirst, _, bcache = self.backend.prefill(
+                        tokens, jnp.int32(bucket), bcache, key, sampling,
+                        valid_start,
+                    )
+                    n += 1
+                for db in decode_buckets:
+                    _, _, bcache = self.backend.decode(
+                        bfirst, bcache, jnp.int32(buckets[-1]), jnp.int32(0),
+                        key, sampling, valid_start, max_steps=db,
+                    )
+                    n += 1
+                jax.block_until_ready(bcache)
+                self._batch_caches[Bb] = bcache
+            for Bb in sorted(batch_buckets)[:-1]:
+                self._batch_caches.pop(Bb, None)
         out = {"programs": n, "seconds": round(time.time() - t0, 2)}
         log.info("warmup", **out)
         return out
@@ -394,12 +514,16 @@ class InferenceEngine:
         (/root/reference/orchestration.py:98,144).
         """
         t_start = time.time()
-        try:
+
+        def locked():
             with self._lock:
                 return self._generate_batch_locked(
                     prompts, max_tokens, temperature, top_k, top_p, greedy,
                     chat, seed, t_start,
                 )
+
+        try:
+            return self._with_deadline(locked, "generate_batch")
         except ValueError as e:
             log.warning("invalid_batch_request", error=str(e))
             return {"error": f"Error: {e}", "status": "failed",
@@ -456,8 +580,11 @@ class InferenceEngine:
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
 
-        # batch-sized cache per call (the reusable engine cache is batch-1)
-        cache = self.backend.init_cache(Bb, cfg.max_seq_len)
+        # reusable batch-bucket cache (donated below, restored after decode);
+        # stale rows are invisible behind the ragged causal mask
+        cache = self._batch_caches.pop(Bb, None)
+        if cache is None:
+            cache = self.backend.init_cache(Bb, cfg.max_seq_len)
         first, logits, cache = self.backend.prefill(
             tokens, jnp.int32(bucket), cache, key_pre, sampling, valid_start
         )
@@ -474,7 +601,11 @@ class InferenceEngine:
             key_dec, sampling, valid_start, max_steps=decode_bucket,
         )
         out = jax.block_until_ready(out)
-        del cache
+        # keep at most ONE batch cache (the bucket just used): an entry per
+        # bucket would re-pin sum(BATCH_BUCKETS) x max_seq of KV in HBM —
+        # the footprint warmup's keep-only-largest eviction exists to avoid
+        self._batch_caches.clear()
+        self._batch_caches[Bb] = cache
 
         results = []
         total_tokens = 0
@@ -550,6 +681,14 @@ class InferenceEngine:
 
     def workers(self) -> dict:
         stages = self.backend.health()
+        if self._lock.locked():
+            # a generation holds the device(s): a timed-out probe means
+            # "queued behind real work", not unreachable — report busy so
+            # monitoring doesn't flap to offline exactly when loaded
+            for s in stages:
+                if s.get("status") == "offline":
+                    s["status"] = "busy"
+                    s["error"] = "probe queued behind an in-flight generation"
         return {
             "workers": {f"stage_{s['stage']}": s for s in stages},
             "total": len(stages),

@@ -161,11 +161,13 @@ class ContextParallelBackend(SPMDBackendBase):
             "k": cp_cache_spec(), "v": cp_cache_spec(),
             "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
         }
+        # shared specs name AXIS_PP on the vocab dims, but pp == 1 here so
+        # each "shard" is the full array and M.embed/M.unembed stay exact
         shmapped = self._shard(
             body,
             in_specs=(
-                P(), self._layer_specs, P(AXIS_DP, AXIS_SP), P(), cache_specs,
-                P(), P(),
+                self._shared_specs, self._layer_specs, P(AXIS_DP, AXIS_SP),
+                P(), cache_specs, P(), P(),
             ),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_specs),
         )
@@ -253,7 +255,8 @@ class ContextParallelBackend(SPMDBackendBase):
         shmapped = self._shard(
             body,
             in_specs=(
-                P(), self._layer_specs, P(AXIS_DP), cache_specs, P(), P(), P(), P(),
+                self._shared_specs, self._layer_specs, P(AXIS_DP), cache_specs,
+                P(), P(), P(), P(),
             ),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_specs),
         )
@@ -263,13 +266,18 @@ class ContextParallelBackend(SPMDBackendBase):
     def health(self) -> list[dict]:
         """Context shards instead of pipeline stages: each 'worker' is one
         ring member holding seq/sp of the KV cache."""
+        from ..utils.probe import probe_device
+
         devs = self.mesh.devices  # [dp, pp, sp, tp]
-        return [
-            {
-                "stage": s,
-                "devices": [str(d) for d in devs[:, :, s, :].reshape(-1)],
-                "role": "context-shard",
-                "status": "online",
-            }
-            for s in range(self.sp)
-        ]
+        out = []
+        for s in range(self.sp):
+            shard_devs = devs[:, :, s, :].reshape(-1)
+            out.append(
+                {
+                    "stage": s,
+                    "devices": [str(d) for d in shard_devs],
+                    "role": "context-shard",
+                    **probe_device(shard_devs[0]),
+                }
+            )
+        return out

@@ -7,15 +7,18 @@ annotation: stacked layer params [L, ...] shard their leading layer axis
 over `pp` (a stage's "layer range" is just its shard), and within a stage
 the Megatron-style tensor split shards attention heads and FFN columns over
 `tp` (column-sharded wq/wk/wv/w_gate/w_up, row-sharded wo/w_down — the psum
-pairing lives in models/*.decoder_layer). Embeddings/head replicate; the
-KV cache [L, B, KV, S, Dh] shards layers over pp, batch over dp, and kv
-heads over tp. XLA moves exactly one shard's weights to each device.
+pairing lives in models/*.decoder_layer). Embedding rows and LM-head
+columns shard their vocab dim over pp (parallel/vocab.py); norms and
+position rows replicate. The KV cache [L, B, KV, S, Dh] shards layers over
+pp, batch over dp, and kv heads over tp. XLA moves exactly one shard's
+weights to each device.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig
@@ -65,9 +68,12 @@ _FAMILY_LAYER_SPECS = {"llama": _LLAMA_LAYER_SPECS, "gpt2": _GPT2_LAYER_SPECS}
 
 
 def validate_mesh(cfg: ModelConfig, pp: int, tp: int) -> None:
-    """Divisibility invariants for a (pp, tp) factorization of the model."""
-    if cfg.n_layers % pp != 0:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    """Divisibility invariants for a (pp, tp) factorization of the model.
+
+    pp need not divide n_layers: uneven splits are padded with zero no-op
+    layers (pad_stacked_layers), so any pp <= n_layers is valid."""
+    if not 1 <= pp <= cfg.n_layers:
+        raise ValueError(f"pp={pp} must be in [1, n_layers={cfg.n_layers}]")
     if cfg.n_heads % tp != 0:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
     if cfg.n_kv_heads % tp != 0:
@@ -83,6 +89,46 @@ def split_params(params: dict) -> tuple[dict, dict]:
     return shared, params["layers"]
 
 
+def padded_layers_per_stage(n_layers: int, pp: int) -> int:
+    """Stacked-layer slots each stage holds after no-op padding."""
+    return -(-n_layers // pp)
+
+
+def pad_stacked_layers(cfg: ModelConfig, layers: dict, pp: int) -> dict:
+    """Pad the stacked [L, ...] layer leaves to ceil(L/pp)*pp slots so the
+    layer axis shards evenly over pp when pp does not divide n_layers
+    (TinyLlama's 22 layers at pp=4 -> 6,6,5+pad,5+pad; the reference's own
+    model split generalized, /root/reference/Worker1.py:27-28).
+
+    Padding layers are ALL-ZERO, which makes them exact no-ops in a
+    pre-norm residual block: zero norm weight zeroes q/k/v (and the MLP
+    input), so both residual branches contribute exactly 0 and x passes
+    through bit-identically. Their KV-cache slots only ever hold zeros, so
+    no real slot is ever polluted.
+    """
+    L = cfg.n_layers
+    per = padded_layers_per_stage(L, pp)
+    if per * pp == L:
+        return layers
+    from ..config import stage_layer_range
+
+    src = np.zeros(per * pp, np.int32)
+    valid = np.zeros(per * pp, bool)
+    for s in range(pp):
+        lo, hi = stage_layer_range(L, pp, s)
+        for j in range(hi - lo):
+            src[s * per + j] = lo + j
+            valid[s * per + j] = True
+    src_j = jnp.asarray(src)
+
+    def pad_leaf(x):
+        y = jnp.take(x, src_j, axis=0)
+        mask = jnp.asarray(valid.reshape((per * pp,) + (1,) * (x.ndim - 1)))
+        return jnp.where(mask, y, jnp.zeros((), x.dtype))
+
+    return jax.tree.map(pad_leaf, layers)
+
+
 def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
     """PartitionSpec pytree for the stacked layer params."""
     specs = _FAMILY_LAYER_SPECS[cfg.arch]
@@ -93,7 +139,20 @@ def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
 
 
 def shared_specs(shared: dict) -> dict:
-    return jax.tree.map(lambda x: P(), shared)
+    """Embed rows / head columns shard their VOCAB dim over pp
+    (parallel/vocab.py — round-1 review: full replicas cost ~2.1 GB/device
+    for a Llama-3-8B-class model); norms / position rows replicate."""
+    from .vocab import VOCAB_SHARDED
+
+    specs = {}
+    for k in shared:
+        if k in VOCAB_SHARDED:
+            axes = [None, None]
+            axes[VOCAB_SHARDED[k]] = AXIS_PP
+            specs[k] = P(*axes)
+        else:
+            specs[k] = P()
+    return specs
 
 
 def cache_spec() -> P:
@@ -103,11 +162,21 @@ def cache_spec() -> P:
 
 
 def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict]:
-    """Place (shared, layers) on the mesh."""
-    validate_mesh(cfg, int(mesh.shape[AXIS_PP]), int(mesh.shape[AXIS_TP]))
+    """Place (shared, layers) on the mesh (uneven pp splits are padded;
+    embed/head vocab dims are padded + sharded over pp)."""
+    from .vocab import pad_vocab
+
+    pp = int(mesh.shape[AXIS_PP])
+    validate_mesh(cfg, pp, int(mesh.shape[AXIS_TP]))
     shared, layers = split_params(params)
+    layers = pad_stacked_layers(cfg, layers, pp)
+    shared = pad_vocab(cfg, shared, pp)
     shared = jax.device_put(
-        shared, jax.tree.map(lambda s: NamedSharding(mesh, s), shared_specs(shared))
+        shared,
+        jax.tree.map(
+            lambda s: NamedSharding(mesh, s), shared_specs(shared),
+            is_leaf=lambda x: isinstance(x, P),
+        ),
     )
     layers = jax.device_put(
         layers,
@@ -121,15 +190,18 @@ def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict
 
 def init_sharded_cache(cfg: ModelConfig, mesh: Mesh, batch: int, max_seq: int):
     """Zeroed KV cache sharded per cache_spec(), allocated shard-local (no
-    full-size host materialization)."""
+    full-size host materialization). The layer axis matches the PADDED
+    stacked layers (ceil(L/pp)*pp slots) for uneven pp splits."""
     dp = int(mesh.shape[AXIS_DP])
+    pp = int(mesh.shape[AXIS_PP])
     if batch % dp != 0:
         raise ValueError(f"batch={batch} not divisible by dp={dp}")
+    n_layers = padded_layers_per_stage(cfg.n_layers, pp) * pp
     sharding = NamedSharding(mesh, cache_spec())
 
     @jax.jit
     def make():
-        cache = M.init_kv_cache(cfg, batch, max_seq=max_seq)
+        cache = M.init_kv_cache(cfg, batch, max_seq=max_seq, n_layers=n_layers)
         return jax.tree.map(
             lambda x: jax.lax.with_sharding_constraint(x, sharding), cache
         )

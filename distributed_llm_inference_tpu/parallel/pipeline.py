@@ -14,11 +14,13 @@ with a single `jax.shard_map` program over the `pp` mesh axis:
     buffer, then the ring shifts; a stage's cache write is gated on the
     microstep owning it, so speculative compute on stale buffers is
     discarded at slice granularity;
-  * after S microsteps the last stage's output has rotated to stage 0,
-    which computes logits for the final position only; a masked `psum`
-    broadcasts them so every device samples the SAME next token with the
-    same key — the decode loop (`lax.while_loop`) then continues entirely
-    on-device, with zero host round-trips per token.
+  * after S microsteps the last stage's output has rotated to stage 0; a
+    masked `psum` broadcasts that [B, 1, D] activation, every device
+    computes its VOCAB SHARD of the logits (parallel/vocab.py — embed and
+    head are vocab-sharded over pp, not replicated) and the all_gather'd
+    logits are identical everywhere, so every device samples the SAME next
+    token with the same key — the decode loop (`lax.while_loop`) then
+    continues entirely on-device, with zero host round-trips per token.
 
 Latency shape: batch-1 decode costs S microsteps/token (the classic
 pipeline bubble — the whole model's FLOPs, just spread over stages);
@@ -38,7 +40,10 @@ from ..engine.generate import SamplingParams
 from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP, AXIS_TP
-from .partition import cache_spec, init_sharded_cache, layer_specs, shard_params
+from .partition import (
+    cache_spec, init_sharded_cache, layer_specs, shard_params, shared_specs,
+)
+from .vocab import embed_sharded, unembed_sharded
 
 
 def _ring_perm(S: int):
@@ -66,49 +71,78 @@ class SPMDBackendBase:
         self.tp_axis = AXIS_TP if self.tp > 1 else None
         self.shared, self.layers = shard_params(cfg, params, mesh)
         self._layer_specs = layer_specs(cfg, self.layers)
+        self._shared_specs = shared_specs(self.shared)
         self._shard = functools.partial(
             jax.shard_map, mesh=mesh, check_vma=False
         )
+        # memoized compiled shard_map programs beyond the core pair
+        # (extend / ragged variants), keyed by (kind, flags)
+        self._programs: dict = {}
         self._prefill = self._build_prefill()
-        self._decode_cache: dict[int, object] = {}
+        self._decode_cache: dict = {}
 
     # -- engine interface ---------------------------------------------------
     def init_cache(self, batch: int, max_seq: int):
         return init_sharded_cache(self.cfg, self.mesh, batch, max_seq)
 
-    def prefill(self, tokens, prompt_len, cache, key, sampling):
+    def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
+        if valid_start is not None:
+            raise NotImplementedError(
+                f"{self.name} does not support ragged (valid_start) batches"
+            )
         return self._prefill(
             self.shared, self.layers, tokens, prompt_len, cache, key, sampling
         )
 
-    def decode(self, first_token, cache, start_pos, limit, key, sampling, *, max_steps):
-        fn = self._decode_cache.get(max_steps)
+    def decode(self, first_token, cache, start_pos, limit, key, sampling,
+               valid_start=None, *, max_steps):
+        ragged = valid_start is not None
+        fn = self._decode_cache.get((max_steps, ragged))
         if fn is None:
-            fn = self._build_decode(max_steps)
-            self._decode_cache[max_steps] = fn
+            fn = (
+                self._build_decode_ragged(max_steps)
+                if ragged
+                else self._build_decode(max_steps)
+            )
+            self._decode_cache[(max_steps, ragged)] = fn
         # clamp: limit > max_steps would walk dynamic_update_slice off the
         # end of `out` (the start index clamps, corrupting the last column)
         # and inflate n_gen past the buffer
         limit = jnp.minimum(jnp.int32(limit), jnp.int32(max_steps))
+        if ragged:
+            return fn(
+                self.shared, self.layers, first_token, cache, start_pos, limit,
+                key, sampling, valid_start,
+            )
         return fn(
             self.shared, self.layers, first_token, cache, start_pos, limit, key, sampling
         )
 
     def health(self) -> list[dict]:
         """Per-stage liveness — the reference's /workers sweep polls each
-        worker's /health over HTTP (orchestration.py:306-329); here a stage
-        is a mesh slice, so health = device presence per slice."""
+        worker's /health with a 5 s timeout and reports online/offline/
+        error (orchestration.py:306-329); here a stage is a mesh slice, so
+        each stage's first device gets a tiny timed device op
+        (utils/probe.py) instead of an HTTP GET."""
+        from ..config import stage_layer_range
+        from ..utils.probe import probe_device
+
         devs = self.mesh.devices  # [dp, pp, sp, tp]
-        per = self.cfg.n_layers // self.pp
-        return [
-            {
-                "stage": s,
-                "devices": [str(d) for d in devs[:, s].reshape(-1)],
-                "layers": list(range(s * per, (s + 1) * per)),
-                "status": "online",
-            }
-            for s in range(self.pp)
-        ]
+        out = []
+        for s in range(self.pp):
+            stage_devs = devs[:, s].reshape(-1)
+            probe = probe_device(stage_devs[0])
+            out.append(
+                {
+                    "stage": s,
+                    "devices": [str(d) for d in stage_devs],
+                    "layers": list(
+                        range(*stage_layer_range(self.cfg.n_layers, self.pp, s))
+                    ),
+                    **probe,
+                }
+            )
+        return out
 
     def _dp_key(self, key):
         """Decorrelate sampling across dp batch shards. dp=1 keeps the key
@@ -122,6 +156,11 @@ class SPMDBackendBase:
 
     def _build_decode(self, max_steps: int):
         raise NotImplementedError
+
+    def _build_decode_ragged(self, max_steps: int):
+        raise NotImplementedError(
+            f"{self.name} does not support ragged (valid_start) batches"
+        )
 
 
 class PipelineBackend(SPMDBackendBase):
@@ -139,9 +178,12 @@ class PipelineBackend(SPMDBackendBase):
     """
 
     name = "pipeline"
+    # Ragged left-padded batches thread valid_start through the llama-family
+    # mask; the engine checks arch before requesting them.
+    supports_ragged = True
 
     # -- compiled programs --------------------------------------------------
-    def _microstep_loop(self, layers, x, cache, pos):
+    def _microstep_loop(self, layers, x, cache, pos, valid_start=None):
         """S microsteps of (apply local stage, ring-shift). Returns the
         final-stage output (landed on stage 0 by the last shift) + cache."""
         cfg, S = self.cfg, self.pp
@@ -153,42 +195,118 @@ class PipelineBackend(SPMDBackendBase):
             gate = i == s
             y, cache = M.forward_layers(
                 cfg, layers, buf, cache, pos, update_gate=gate,
-                tp_axis=self.tp_axis,
+                tp_axis=self.tp_axis, valid_start=valid_start,
             )
             buf = jax.lax.ppermute(y, AXIS_PP, perm)
             return buf, cache
 
         return jax.lax.fori_loop(0, S, micro, (x, cache))
 
+    # -- chunked prefill (engine: prompts beyond the largest bucket) --------
+    def extend(self, tokens, pos, cache):
+        """Run a full prompt chunk at offset `pos` (no logits/sampling),
+        mirroring engine.generate's chunked-prefill contract with
+        SingleDeviceBackend (engine/generate.py extend)."""
+        fn = self._programs.get("extend")
+        if fn is None:
+            fn = self._build_extend()
+            self._programs["extend"] = fn
+        return fn(self.shared, self.layers, tokens, pos, cache)
+
+    def prefill_at(self, tokens, pos, valid_len, cache, key, sampling):
+        """Final chunked-prefill chunk at traced offset `pos`; samples the
+        first token off position pos + valid_len - 1."""
+        return self._prefill_any(tokens, pos, valid_len, cache, key, sampling, None)
+
+    def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
+        return self._prefill_any(
+            tokens, jnp.int32(0), prompt_len, cache, key, sampling, valid_start
+        )
+
+    def _prefill_any(self, tokens, pos, valid_len, cache, key, sampling, valid_start):
+        ragged = valid_start is not None
+        fn = self._programs.get(("prefill", ragged))
+        if fn is None:
+            fn = self._build_prefill_pos(ragged)
+            self._programs[("prefill", ragged)] = fn
+        if ragged:
+            return fn(
+                self.shared, self.layers, tokens, pos, valid_len, cache, key,
+                sampling, valid_start,
+            )
+        return fn(self.shared, self.layers, tokens, pos, valid_len, cache, key, sampling)
+
     def _build_prefill(self):
+        # base-class hook: the pos=0 non-ragged program, via the shared
+        # builder (prefill()/prefill_at() both route through _prefill_any)
+        fn = self._build_prefill_pos(False)
+        self._programs[("prefill", False)] = fn
+        return lambda shared, layers, tokens, prompt_len, cache, key, sampling: fn(
+            shared, layers, tokens, jnp.int32(0), prompt_len, cache, key, sampling
+        )
+
+    def _build_prefill_pos(self, ragged: bool):
         cfg, S = self.cfg, self.pp
 
-        def body(shared, layers, tokens, prompt_len, cache, key, sampling):
+        def body(shared, layers, tokens, pos, valid_len, cache, key, sampling,
+                 valid_start=None):
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
-            x = M.embed(cfg, shared, tokens, jnp.int32(0))
-            buf, cache = self._microstep_loop(layers, x, cache, jnp.int32(0))
-            last = jax.lax.dynamic_slice_in_dim(buf, prompt_len - 1, 1, axis=1)
-            logits_local = M.unembed(cfg, shared, last)[:, 0, :]
-            logits = jax.lax.psum(
-                jnp.where(s == 0, logits_local, 0.0), AXIS_PP
+            x = embed_sharded(cfg, shared, tokens, pos, S)
+            buf, cache = self._microstep_loop(layers, x, cache, pos, valid_start)
+            # the real final-stage output lives on stage 0; broadcast the
+            # [B, 1, D] slice (not the vocab row) then compute the vocab-
+            # sharded logits everywhere
+            last = jax.lax.dynamic_slice_in_dim(buf, valid_len - 1, 1, axis=1)
+            last = jax.lax.psum(
+                jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
             )
+            logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
             first = sample_token(key, logits, *sampling)
             return first, logits, cache
+
+        specs = [
+            self._shared_specs, self._layer_specs, P(AXIS_DP), P(), P(),
+            cache_spec(), P(), P(),
+        ]
+        if ragged:
+            specs.append(P(AXIS_DP))
+        shmapped = self._shard(
+            body,
+            in_specs=tuple(specs),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
+        )
+        return jax.jit(shmapped, donate_argnums=(5,))
+
+    def _build_extend(self):
+        cfg = self.cfg
+
+        def body(shared, layers, tokens, pos, cache):
+            x = embed_sharded(cfg, shared, tokens, pos, self.pp)
+            _, cache = self._microstep_loop(layers, x, cache, pos)
+            return cache
 
         shmapped = self._shard(
             body,
             in_specs=(
-                P(), self._layer_specs, P(AXIS_DP), P(), cache_spec(), P(), P(),
+                self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
+                cache_spec(),
             ),
-            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
+            out_specs=cache_spec(),
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
     def _build_decode(self, max_steps: int):
+        return self._build_decode_any(max_steps, ragged=False)
+
+    def _build_decode_ragged(self, max_steps: int):
+        return self._build_decode_any(max_steps, ragged=True)
+
+    def _build_decode_any(self, max_steps: int, *, ragged: bool):
         cfg, S = self.cfg, self.pp
 
-        def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
+        def body(shared, layers, first_token, cache, start_pos, limit, key,
+                 sampling, valid_start=None):
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             B = first_token.shape[0]
@@ -203,12 +321,18 @@ class PipelineBackend(SPMDBackendBase):
 
             def step_fn(c):
                 step, token, pos, cache, key, finished, out, n_gen = c
-                x = M.embed(cfg, shared, token[:, None], pos)
-                buf, cache = self._microstep_loop(layers, x, cache, pos)
-                logits_local = M.unembed(cfg, shared, buf[:, -1:, :])[:, 0, :]
-                logits = jax.lax.psum(
-                    jnp.where(s == 0, logits_local, 0.0), AXIS_PP
+                x = embed_sharded(cfg, shared, token[:, None], pos, S)
+                buf, cache = self._microstep_loop(layers, x, cache, pos, valid_start)
+                # broadcast stage 0's real [B, 1, D] output (a masked psum
+                # of activations, NOT the [B, vocab] fp32 logits round-1
+                # shipped), then every stage computes its vocab shard and
+                # the all_gather'd logits are identical everywhere — so the
+                # sampled token needs no further collective
+                last = jax.lax.psum(
+                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
+                    AXIS_PP,
                 )
+                logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 key, sub = jax.random.split(key)
                 nxt = sample_token(sub, logits, *sampling)
                 is_eos = nxt == eos
@@ -234,12 +358,15 @@ class PipelineBackend(SPMDBackendBase):
             _, _, _, cache, _, _, out, n_gen = jax.lax.while_loop(cond, step_fn, init)
             return out, n_gen, cache
 
+        specs = [
+            self._shared_specs, self._layer_specs, P(AXIS_DP), cache_spec(),
+            P(), P(), P(), P(),
+        ]
+        if ragged:
+            specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,
-            in_specs=(
-                P(), self._layer_specs, P(AXIS_DP), cache_spec(), P(), P(),
-                P(), P(),
-            ),
+            in_specs=tuple(specs),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )
         return jax.jit(shmapped, donate_argnums=(3,))

@@ -29,10 +29,11 @@ write lands in the batch-row slice of the microbatch it currently holds,
 and warmup/drain/finished microsteps are discarded at slice granularity.
 
 Decode state (per device, uniform across the mesh): per-microbatch token,
-position, finished mask, emit count. The sampled token is produced on
-stage 0 and broadcast with a masked `psum` over `pp` (an int32 per row —
-not the logits), so every device advances identical state and the loop
-never leaves the device.
+position, finished mask, emit count. Stage 0's completed [b_m, 1, D]
+output is broadcast with a masked `psum` over `pp`, each device computes
+its vocab shard of the logits (parallel/vocab.py) and samples the
+identical all_gather'd row with the shared key, so every device advances
+identical state and the loop never leaves the device.
 """
 
 from __future__ import annotations
@@ -47,6 +48,7 @@ from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP
 from .partition import cache_spec, init_sharded_cache
 from .pipeline import SPMDBackendBase, _ring_perm
+from .vocab import embed_sharded, unembed_sharded
 
 
 class MicrobatchPipelineBackend(SPMDBackendBase):
@@ -75,6 +77,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         params: dict,
         mesh: Mesh,
         n_microbatches: int | None = None,
+        return_prefill_logits: bool = False,
     ):
         pp = int(mesh.shape[AXIS_PP])
         self.n_microbatches = int(n_microbatches or pp)
@@ -83,6 +86,13 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 f"n_microbatches={self.n_microbatches} must be >= pp={pp}: "
                 "a microbatch must vacate stage 0 before its next token returns"
             )
+        # The engine only consumes prefill's sampled first tokens; carrying
+        # a [Mb, b_m, vocab] fp32 logits accumulator through the prefill
+        # loop costs ~0.5 GB per unit batch at a 128k vocab. Off by
+        # default: prefill returns a zero-width [rows, 0] logits array and
+        # each sample event psums one int32 per row instead of the full
+        # vocab row. Parity tests opt in to get comparable logits.
+        self.return_prefill_logits = bool(return_prefill_logits)
         super().__init__(cfg, params, mesh)
 
     # -- engine interface ---------------------------------------------------
@@ -121,22 +131,28 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         }
         return y, cache
 
-    def _stage0_token_psum(self, s, key, buf, sampling):
-        """Sample stage 0's received buffer, broadcast the token over pp.
+    def _stage0_sample(self, shared, s, key, last, sampling):
+        """Sample off stage 0's received buffer slice `last` [b_m, 1, D].
 
-        Every device runs the sampler (SPMD), but only stage 0 holds a
-        completed last-stage output; the masked psum ships one int32 per
-        row — not the [b_m, vocab] logits — around the ring.
+        Only stage 0 holds a completed last-stage output: a masked psum
+        broadcasts the [b_m, 1, D] activation (not the [b_m, vocab]
+        logits), each device computes its vocab shard
+        (parallel/vocab.py), and the all_gather'd logits — identical
+        everywhere — are sampled with the shared key. Returns
+        (tok [b_m], logits [b_m, V]).
         """
-        logits = M.unembed(self.cfg, self.shared, buf[:, -1:, :])[:, 0, :]
+        last = jax.lax.psum(
+            jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
+        )
+        logits = unembed_sharded(self.cfg, shared, last, self.pp)[:, 0, :]
         tok = sample_token(key, logits, *sampling)
-        tok = jax.lax.psum(jnp.where(s == 0, tok, 0), AXIS_PP)
         return tok, logits
 
     # -- prefill ------------------------------------------------------------
     def _build_prefill(self):
         cfg, S, Mb = self.cfg, self.pp, self.n_microbatches
         perm = _ring_perm(S)
+        with_logits = self.return_prefill_logits
 
         def body(shared, layers, tokens, prompt_len, cache, key, sampling):
             s = jax.lax.axis_index(AXIS_PP)
@@ -152,7 +168,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 # ingest: stage 0 embeds microbatch t's prompt (clamped so
                 # drain microsteps re-embed a stale microbatch — gated off)
                 m_in = jnp.clip(t, 0, Mb - 1)
-                x_in = M.embed(cfg, shared, toks[m_in], jnp.int32(0))
+                x_in = embed_sharded(cfg, shared, toks[m_in], jnp.int32(0), S)
                 x = jnp.where(s == 0, x_in, buf)
                 m_here = jnp.mod(t - s, Mb)
                 gate = (t >= s) & (t - s < Mb)
@@ -165,32 +181,35 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 m_done = jnp.mod(t - (S - 1), Mb)
                 ev = (t >= S - 1) & (t - (S - 1) < Mb)
                 last = jax.lax.dynamic_slice_in_dim(buf, prompt_len - 1, 1, axis=1)
-                lg_local = M.unembed(cfg, shared, last)[:, 0, :]
-                lg = jax.lax.psum(jnp.where(s == 0, lg_local, 0.0), AXIS_PP)
-                tok = sample_token(jax.random.fold_in(key, m_done), lg, *sampling)
+                kk = jax.random.fold_in(key, m_done)
+                tok, lg = self._stage0_sample(shared, s, kk, last, sampling)
+                if with_logits:
+                    # parity/debug path: accumulate the full vocab rows
+                    old_l = jax.lax.dynamic_slice_in_dim(logits_acc, m_done, 1, axis=0)
+                    logits_acc = jax.lax.dynamic_update_slice_in_dim(
+                        logits_acc, jnp.where(ev, lg[None], old_l), m_done, axis=0
+                    )
                 old_f = jax.lax.dynamic_slice_in_dim(first, m_done, 1, axis=0)
                 first = jax.lax.dynamic_update_slice_in_dim(
                     first, jnp.where(ev, tok[None], old_f), m_done, axis=0
                 )
-                old_l = jax.lax.dynamic_slice_in_dim(logits_acc, m_done, 1, axis=0)
-                logits_acc = jax.lax.dynamic_update_slice_in_dim(
-                    logits_acc, jnp.where(ev, lg[None], old_l), m_done, axis=0
-                )
                 return buf, cache, first, logits_acc
 
+            V_out = cfg.vocab_size if with_logits else 0
             init = (
                 jnp.zeros((b_m, bucket, D), dt),
                 cache,
                 jnp.zeros((Mb, b_m), jnp.int32),
-                jnp.zeros((Mb, b_m, cfg.vocab_size), jnp.float32),
+                jnp.zeros((Mb, b_m, V_out), jnp.float32),
             )
             _, cache, first, logits = jax.lax.fori_loop(0, Mb + S - 1, micro, init)
-            return first.reshape(rows), logits.reshape(rows, -1), cache
+            return first.reshape(rows), logits.reshape(rows, V_out), cache
 
         shmapped = self._shard(
             body,
             in_specs=(
-                P(), self._layer_specs, P(AXIS_DP), P(), cache_spec(), P(), P(),
+                self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
+                cache_spec(), P(), P(),
             ),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )
@@ -227,7 +246,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 # ingest: stage 0 embeds microbatch (t mod M)'s current token
                 # at its current position
                 m_in = jnp.mod(t, Mb)
-                x_in = M.embed(cfg, shared, cur[m_in][:, None], pos[m_in])
+                x_in = embed_sharded(cfg, shared, cur[m_in][:, None], pos[m_in], S)
                 x = jnp.where(s == 0, x_in, buf)
                 # apply local stage to the microbatch it holds
                 m_here = jnp.mod(t - s, Mb)
@@ -242,7 +261,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 kk = jax.random.fold_in(
                     jax.random.fold_in(key, m_done), emitted[m_done]
                 )
-                tok, _ = self._stage0_token_psum(s, kk, buf, sampling)
+                tok, _ = self._stage0_sample(shared, s, kk, buf[:, -1:, :], sampling)
                 fin_m = finished[m_done]
                 newly = fin_m | (tok == eos)
                 emit = jnp.where(newly, pad, tok)
@@ -291,7 +310,8 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         shmapped = self._shard(
             body,
             in_specs=(
-                P(), self._layer_specs, P(AXIS_DP), cache_spec(), P(), P(), P(), P(),
+                self._shared_specs, self._layer_specs, P(AXIS_DP), cache_spec(),
+                P(), P(), P(), P(),
             ),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )

@@ -75,9 +75,15 @@ def test_ragged_batch_matches_individual_runs():
         assert row == ref, f"row {b}: {row} != {ref}"
 
 
-def test_engine_generate_batch():
+@pytest.mark.parametrize(
+    "mesh_cfg",
+    [MeshConfig(), MeshConfig(dp=1, pp=2, tp=1)],
+    ids=["single-device", "pp2"],
+)
+def test_engine_generate_batch(mesh_cfg, eight_devices):
     engine = create_engine(
         "test-llama-tiny",
+        mesh_cfg=mesh_cfg,
         engine_cfg=EngineConfig(prefill_buckets=(64, 128)),
     )
     r = engine.generate_batch(
@@ -95,6 +101,53 @@ def test_engine_generate_batch():
         "short", max_tokens=5, greedy=True, chat=True, seed=0
     )
     assert single["status"] == "success"
+
+
+def test_pipeline_ragged_batch_matches_single_device(eight_devices):
+    """Backend-level bit-exactness: ragged left-padded batch on a pp=2 mesh
+    == the same batch on the single-device backend (greedy)."""
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(3)
+    prompts = [
+        [int(t) for t in rng.integers(3, cfg.vocab_size, size=n)]
+        for n in (4, 9, 16, 12)
+    ]
+    steps, bucket, max_seq = 6, 16, 64
+    pad = cfg.pad_token_id
+    tokens = jnp.asarray(
+        [[pad] * (bucket - len(ids)) + ids for ids in prompts], jnp.int32
+    )
+    valid_start = jnp.asarray([bucket - len(ids) for ids in prompts], jnp.int32)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(5))
+
+    cache = M.init_kv_cache(cfg, len(prompts), max_seq=max_seq)
+    f_s, _, cache = G.prefill(
+        cfg, params, tokens, jnp.int32(bucket), cache, kp, sampling, valid_start
+    )
+    out_s, n_s, _ = G.decode(
+        cfg, params, f_s, cache, jnp.int32(bucket), jnp.int32(steps - 1),
+        kd, sampling, valid_start, max_steps=steps,
+    )
+
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), eight_devices)
+    pb = PipelineBackend(cfg, params, mesh)
+    cache_p = pb.init_cache(len(prompts), max_seq)
+    f_p, _, cache_p = pb.prefill(
+        tokens, jnp.int32(bucket), cache_p, kp, sampling, valid_start
+    )
+    out_p, n_p, _ = pb.decode(
+        f_p, cache_p, jnp.int32(bucket), jnp.int32(steps - 1), kd, sampling,
+        valid_start, max_steps=steps,
+    )
+
+    np.testing.assert_array_equal(np.asarray(f_p), np.asarray(f_s))
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+    np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_s))
 
 
 def test_engine_generate_batch_rejects_bad_input():

@@ -68,6 +68,22 @@ def test_no_eos_runs_to_max_tokens():
     assert r["tokens_generated"] == 6
 
 
+def test_debug_top_predictions(tiny_engine):
+    """debug=True returns the top-5 first-token candidates with probs
+    (the reference's debug prints, orchestration.py:172-178)."""
+    r = tiny_engine.generate("debug me", max_tokens=3, greedy=True, debug=True)
+    assert r["status"] == "success"
+    preds = r["top_predictions"]
+    assert len(preds) == 5
+    probs = [p["prob"] for p in preds]
+    assert probs == sorted(probs, reverse=True)
+    assert all(0.0 <= p <= 1.0 for p in probs)
+    assert all(isinstance(p["id"], int) for p in preds)
+    # off by default
+    r2 = tiny_engine.generate("debug me", max_tokens=3, greedy=True)
+    assert "top_predictions" not in r2
+
+
 def test_seeded_determinism(tiny_engine):
     r1 = tiny_engine.generate("same seed", max_tokens=10, seed=42)
     r2 = tiny_engine.generate("same seed", max_tokens=10, seed=42)
@@ -137,7 +153,7 @@ def test_warmup_compiles_and_requests_stay_fast():
         "test-llama-tiny",
         engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
     )
-    stats = engine.warmup(decode_buckets=(16,))
+    stats = engine.warmup(decode_buckets=(16,), batch_buckets=())
     # 2 prefill buckets + 1 chunked-prefill extend + 1 decode bucket
     assert stats["programs"] == 4
     t0 = _time.time()
@@ -145,3 +161,32 @@ def test_warmup_compiles_and_requests_stay_fast():
     assert r["status"] == "success"
     # warm path: no multi-second jit compile inside the request
     assert _time.time() - t0 < 5.0
+
+
+def test_warmup_covers_batched_programs():
+    """Round-1 gap: the first batched request on a warmed server must not
+    pay a compile — warmup pre-compiles the ragged (batch bucket x prefill
+    bucket x decode bucket) programs and leaves warm per-bucket caches."""
+    import time as _time
+
+    from distributed_llm_inference_tpu import EngineConfig, create_engine
+
+    engine = create_engine(
+        "test-llama-tiny",
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    stats = engine.warmup(decode_buckets=(16,), batch_buckets=(2,))
+    # singles: 1 prefill + 1 extend + 1 decode; batch-2: 1 prefill + 1 decode
+    assert stats["programs"] == 5
+    assert 2 in engine._batch_caches  # warm reusable cache left behind
+
+    # the warmed engine's batched request must not trace/compile anything
+    # new (the jit trace caches are the compile-count ground truth; wall
+    # clock can't distinguish — jit caching is process-global)
+    from distributed_llm_inference_tpu.engine import generate as G
+
+    n0 = G.prefill._cache_size() + G.decode._cache_size()
+    r = engine.generate_batch(["a", "bb"], max_tokens=3, greedy=True, chat=False)
+    assert r["status"] == "success", r
+    n1 = G.prefill._cache_size() + G.decode._cache_size()
+    assert n1 == n0, f"batched request compiled {n1 - n0} new program(s)"

@@ -36,7 +36,11 @@ def test_microbatch_prefill_matches_single_device(pp, mb, eight_devices):
     cfg = get_model_config("test-llama-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))
     mesh = build_mesh(MeshConfig(dp=1, pp=pp, tp=1), eight_devices)
-    be = MicrobatchPipelineBackend(cfg, params, mesh, n_microbatches=mb)
+    # opt into full prefill logits (the serving default returns a
+    # zero-width logits array and psums only the sampled token)
+    be = MicrobatchPipelineBackend(
+        cfg, params, mesh, n_microbatches=mb, return_prefill_logits=True
+    )
 
     batch, plen, bucket = mb * 2, 9, 16
     tokens = _prompt_batch(cfg, batch, plen, bucket)
@@ -166,3 +170,25 @@ def test_microbatch_batch_contract(eight_devices):
     with pytest.raises(ValueError, match="divisible"):
         be.init_cache(3, 64)
     assert be.health()[0]["microbatches"] == 2
+
+
+def test_microbatch_prefill_default_skips_logits(eight_devices):
+    """Serving default: no [Mb, b_m, vocab] accumulator — prefill returns a
+    zero-width logits array but bit-identical first tokens."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), eight_devices)
+    be = MicrobatchPipelineBackend(cfg, params, mesh)
+
+    batch, plen, bucket = 4, 9, 16
+    tokens = _prompt_batch(cfg, batch, plen, bucket)
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(1)
+
+    cache_s = M.init_kv_cache(cfg, batch, max_seq=64)
+    f_s, _, _ = G.prefill(cfg, params, tokens, jnp.int32(plen), cache_s, key, sampling)
+    f_p, logits_p, _ = be.prefill(
+        tokens, jnp.int32(plen), be.init_cache(batch, 64), key, sampling
+    )
+    assert logits_p.shape == (batch, 0)
+    np.testing.assert_array_equal(np.asarray(f_p), np.asarray(f_s))

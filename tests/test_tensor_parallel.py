@@ -108,8 +108,11 @@ def test_validate_mesh_rejects_indivisible():
     cfg = get_model_config("test-llama-tiny")  # 4 layers, 4 heads, 2 kv heads
     with pytest.raises(ValueError, match="n_kv_heads"):
         validate_mesh(cfg, pp=1, tp=4)  # 2 kv heads % 4 != 0
-    with pytest.raises(ValueError, match="n_layers"):
-        validate_mesh(cfg, pp=3, tp=1)
+    # uneven pp (3 stages over 4 layers) is VALID since no-op padding;
+    # only pp > n_layers is rejected
+    validate_mesh(cfg, pp=3, tp=1)
+    with pytest.raises(ValueError, match="pp=5"):
+        validate_mesh(cfg, pp=5, tp=1)
 
 
 def test_dp_cache_requires_divisible_batch(eight_devices):
