@@ -1,0 +1,244 @@
+"""Bounded request queue with ragged-batch coalescing.
+
+Round-1 review: the serving edge had no backpressure — ThreadingHTTPServer
+spawns a thread per request and every one of them serializes on the engine
+lock, so a burst piles up unboundedly behind a multi-second decode. (The
+reference is strictly worse: concurrent /generate requests interleave
+worker HTTP calls with NO locking at all, SURVEY.md §5 race note.)
+
+Here concurrent single-prompt requests:
+
+  * enter a BOUNDED queue — when it is full the caller immediately gets an
+    `overloaded` envelope (HTTP 429), the standard shed-load answer the
+    reference lacks;
+  * are COALESCED: the dispatcher grabs every queued request with the same
+    sampling parameters (up to max_batch) and runs them as ONE ragged
+    left-padded fleet through engine.generate_batch — one prefill + one
+    decode loop for the lot instead of N serialized generations. This is
+    the first genuinely-beyond-reference serving feature: aggregate
+    throughput scales with concurrency because batch rows share each HBM
+    weight stream.
+
+Coalescing requires the llama family + a ragged-capable backend and only
+groups seedless requests (a per-request seed pins that request to a solo
+generation so its determinism contract survives). Anything that cannot
+coalesce still flows through the same queue one request at a time, so
+backpressure semantics are uniform.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("queue")
+
+
+class _Pending:
+    __slots__ = ("prompt", "kwargs", "done", "result", "enqueued", "is_batch")
+
+    def __init__(self, prompt, kwargs: dict, is_batch: bool = False):
+        self.prompt = prompt  # str, or list[str] for a client batch
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result: Optional[dict] = None
+        self.enqueued = time.time()
+        self.is_batch = is_batch
+
+    def coalesce_key(self):
+        k = self.kwargs
+        # client batches dispatch as their own fleet; seeded requests run
+        # solo (their determinism contract is the solo RNG stream); debug
+        # requests run solo (top_predictions needs the single-stream
+        # prefill logits)
+        if self.is_batch or k.get("seed") is not None or k.get("debug"):
+            return None
+        return (
+            k.get("max_tokens"), k.get("temperature"), k.get("top_k"),
+            k.get("top_p"), k.get("greedy"), k.get("chat"),
+        )
+
+
+class BatchingQueue:
+    """Bounded queue + coalescing dispatcher in front of an InferenceEngine."""
+
+    def __init__(
+        self,
+        engine: Any,
+        max_queue: int = 32,
+        max_batch: int = 8,
+        max_wait_ms: float = 5.0,
+    ):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self._cv = threading.Condition()
+        self._queue: list[_Pending] = []
+        self._closed = False
+        self.coalesced_batches = 0  # observability: fleets actually formed
+        self._can_coalesce = (
+            getattr(engine.cfg, "arch", None) == "llama"
+            and getattr(engine.backend, "supports_ragged", False)
+            and self.max_batch > 1
+        )
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="batching-queue"
+        )
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt: str, **kwargs) -> dict:
+        """Enqueue one request and block until its envelope is ready.
+
+        Returns an `overloaded` envelope immediately when the queue is
+        full — the serving edge maps it to HTTP 429.
+        """
+        return self._submit(_Pending(prompt, kwargs))
+
+    def submit_batch(self, prompts: list, **kwargs) -> dict:
+        """Enqueue a client 'prompts'-list request as one unit, so batched
+        traffic shares the same bounded-queue backpressure as singles (it
+        dispatches as its own fleet, never coalesced with others)."""
+        return self._submit(_Pending(prompts, kwargs, is_batch=True))
+
+    def _submit(self, pend: _Pending) -> dict:
+        with self._cv:
+            if self._closed:
+                return {
+                    "error": "Error: server shutting down", "status": "failed",
+                    "error_type": "overloaded",
+                }
+            if len(self._queue) >= self.max_queue:
+                log.warning("queue_full", depth=len(self._queue))
+                return {
+                    "error": f"Error: request queue full ({self.max_queue})",
+                    "status": "failed",
+                    "error_type": "overloaded",
+                }
+            self._queue.append(pend)
+            self._cv.notify_all()
+        pend.done.wait()
+        return pend.result
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+        # fail anything still queued
+        with self._cv:
+            for p in self._queue:
+                p.result = {
+                    "error": "Error: server shutting down", "status": "failed",
+                    "error_type": "overloaded",
+                }
+                p.done.set()
+            self._queue.clear()
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    # -- dispatcher ----------------------------------------------------------
+    def _take_group(self) -> list[_Pending]:
+        """Pop the head request plus every compatible queued request (in
+        arrival order) up to max_batch. Caller holds the lock."""
+        head = self._queue.pop(0)
+        key = head.coalesce_key() if self._can_coalesce else None
+        group = [head]
+        if key is None:
+            return group
+        rest = []
+        for p in self._queue:
+            if len(group) < self.max_batch and p.coalesce_key() == key:
+                group.append(p)
+            else:
+                rest.append(p)
+        self._queue[:] = rest
+        return group
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                depth = len(self._queue)
+                head_age = time.time() - self._queue[0].enqueued
+            # brief coalescing window: give a burst's stragglers a chance
+            # to arrive before the fleet is cut. The head only ever waits
+            # out the REMAINDER of its window — a request that already
+            # aged past it behind a running fleet dispatches immediately.
+            wait = self.max_wait_s - head_age
+            if self._can_coalesce and depth < self.max_batch and wait > 0:
+                time.sleep(wait)
+            with self._cv:
+                if not self._queue:
+                    continue
+                group = self._take_group()
+            self._run_group(group)
+
+    def _run_group(self, group: list[_Pending]):
+        try:
+            if len(group) == 1:
+                p = group[0]
+                if p.is_batch:
+                    p.result = self.engine.generate_batch(p.prompt, **p.kwargs)
+                else:
+                    p.result = self.engine.generate(p.prompt, **p.kwargs)
+                return
+            self.coalesced_batches += 1
+            kwargs = dict(group[0].kwargs)
+            kwargs.pop("seed", None)
+            kwargs.pop("debug", None)
+            t0 = time.time()
+            batch = self.engine.generate_batch(
+                [p.prompt for p in group], **kwargs
+            )
+            elapsed = time.time() - t0
+            if batch.get("status") != "success":
+                if batch.get("error_type") in ("timeout", "overloaded"):
+                    # capacity failures propagate as-is: retrying N members
+                    # solo against a wedged engine would stall the single
+                    # dispatcher thread N x deadline and outage the queue
+                    for p in group:
+                        p.result = batch
+                    return
+                # request-shaped fleet failure (e.g. one over-long prompt):
+                # retry each member SOLO so one bad request cannot fail the
+                # innocent ones it happened to coalesce with — solo also
+                # reaches paths batching lacks (chunked prefill)
+                for p in group:
+                    p.result = self.engine.generate(p.prompt, **p.kwargs)
+                return
+            for p, row in zip(group, batch["results"]):
+                n = row["tokens_generated"]
+                p.result = {
+                    "prompt": row["prompt"],
+                    "response": row["response"],
+                    "status": row["status"],
+                    "time_taken": batch["time_taken"],
+                    "tokens_generated": n,
+                    "tokens_per_sec": f"{(n / elapsed if elapsed > 0 else 0.0):.2f}",
+                    "ttft_s": batch["ttft_s"],
+                    "backend": batch["backend"],
+                    "batched_with": len(group),
+                }
+        except Exception as e:  # noqa: BLE001 - callers must always unblock
+            log.error("dispatch_failed", exc_info=True, error=str(e))
+            for p in group:
+                if p.result is None:
+                    p.result = {"error": f"Error: {e}", "status": "failed"}
+        finally:
+            for p in group:
+                if p.result is None:
+                    p.result = {
+                        "error": "Error: dispatcher produced no result",
+                        "status": "failed",
+                    }
+                p.done.set()

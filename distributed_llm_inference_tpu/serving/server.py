@@ -113,15 +113,24 @@ class _Profiler:
         with self._lock:
             if self.dir is None:
                 return {"error": "no trace running"}
-            out, self.dir = self.dir, None  # clear even if stop raises
+            out = self.dir
             try:
                 jax.profiler.stop_trace()
             except Exception as e:
-                return {"error": f"profiler stop failed: {e}", "trace_dir": out}
+                # JAX may still be mid-trace: keep self.dir so state stays
+                # truthful ('trace already running' on a retried /start)
+                # and tell the caller how to recover
+                return {
+                    "error": f"profiler stop failed: {e}; trace state is "
+                    "unknown — retry /profiler/stop or restart the server",
+                    "trace_dir": out,
+                }
+            self.dir = None
             return {"status": "stopped", "trace_dir": out}
 
 
-def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None):
+def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
+                 queue=None):
     profiler = profiler or _Profiler()
 
     class Handler(BaseHTTPRequestHandler):
@@ -229,9 +238,21 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     # batched form: "prompts": [...] -> one fleet, N results
                     if not isinstance(prompts, list):
                         raise ValueError("prompts must be a list of strings")
-                    result = engine.generate_batch(prompts, **kwargs)
+                    if queue is not None:
+                        # same bounded backpressure as singles; full -> 429
+                        result = queue.submit_batch(prompts, **kwargs)
+                    else:
+                        result = engine.generate_batch(prompts, **kwargs)
                 else:
-                    result = engine.generate(prompt, **kwargs)
+                    # debug=true adds top-5 first-token predictions
+                    # (reference's debug prints, orchestration.py:172-178)
+                    kwargs["debug"] = _parse_bool(data.get("debug", False), "debug")
+                    if queue is not None:
+                        # bounded backpressure + concurrent-singles
+                        # coalescing (serving/queue.py); full -> 429
+                        result = queue.submit(prompt, **kwargs)
+                    else:
+                        result = engine.generate(prompt, **kwargs)
             except (TypeError, ValueError) as e:
                 self._send(400, {"error": f"bad parameter: {e}"})
                 return
@@ -239,6 +260,14 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 code = 200
             elif result.get("error_type") == "invalid_request":
                 code = 400
+            elif result.get("error_type") == "timeout":
+                # request deadline exceeded (EngineConfig.request_deadline_s):
+                # service-unavailable, mirroring the reference's per-hop
+                # timeout failure mode (orchestration.py:118,131)
+                code = 503
+            elif result.get("error_type") == "overloaded":
+                # bounded queue full (serving/queue.py): shed load
+                code = 429
             else:
                 code = 500
             self._send(code, result)
@@ -250,9 +279,13 @@ class InferenceServer:
     """Owns the HTTP server + engine; start()/shutdown() for embedding in
     tests, serve_forever() for the CLI."""
 
-    def __init__(self, engine, host: str = "0.0.0.0", port: int = 5000, max_tokens_cap: int = 30):
+    def __init__(self, engine, host: str = "0.0.0.0", port: int = 5000,
+                 max_tokens_cap: int = 30, queue=None):
         self.engine = engine
-        self.httpd = ThreadingHTTPServer((host, port), make_handler(engine, max_tokens_cap))
+        self.queue = queue
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_handler(engine, max_tokens_cap, queue=queue)
+        )
         self.port = self.httpd.server_address[1]
 
     def start(self) -> threading.Thread:
@@ -274,6 +307,8 @@ class InferenceServer:
     def shutdown(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+        if self.queue is not None:
+            self.queue.close()
 
 
 def main(argv: Optional[list] = None):
@@ -292,6 +327,25 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
+        "--deadline", type=float, default=None, metavar="SECONDS",
+        help="per-request wall-clock deadline; overruns return a 503 "
+             "timeout envelope (reference: 30s per worker hop)",
+    )
+    ap.add_argument(
+        "--queue", type=int, default=0, metavar="N",
+        help="bounded request queue of depth N in front of the engine: "
+             "concurrent singles coalesce into ragged batched fleets, "
+             "full queue returns 429 (0 = disabled)",
+    )
+    ap.add_argument(
+        "--queue-max-batch", type=int, default=8,
+        help="largest coalesced fleet the queue dispatcher forms",
+    )
+    ap.add_argument(
+        "--queue-wait-ms", type=float, default=5.0,
+        help="coalescing window before a fleet is cut",
+    )
+    ap.add_argument(
         "--warmup", action="store_true",
         help="pre-compile every (prefill, decode) bucket before serving "
              "(first requests then never pay jit latency)",
@@ -301,14 +355,35 @@ def main(argv: Optional[list] = None):
     engine = create_engine(
         args.model,
         mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp),
+        engine_cfg=EngineConfig(request_deadline_s=args.deadline),
         dtype=args.dtype,
         seed=args.seed,
     )
     if args.warmup:
         print("⏳ warming up (compiling all bucket shapes)...")
-        stats = engine.warmup()
+        try:
+            stats = engine.warmup()
+        except ValueError as e:
+            # backend bucket-validation errors (e.g. a prefill bucket not
+            # divisible by sp on a context-parallel mesh) should name the
+            # fix, not crash startup with a bare traceback
+            raise SystemExit(
+                f"--warmup failed: {e}\nfix the engine prefill_buckets / "
+                f"mesh shape so every bucket is servable, or start without "
+                f"--warmup"
+            ) from e
         print(f"✅ warm: {stats['programs']} programs in {stats['seconds']}s")
-    InferenceServer(engine, args.host, args.port, args.max_tokens_cap).serve_forever()
+    queue = None
+    if args.queue > 0:
+        from .queue import BatchingQueue
+
+        queue = BatchingQueue(
+            engine, max_queue=args.queue, max_batch=args.queue_max_batch,
+            max_wait_ms=args.queue_wait_ms,
+        )
+    InferenceServer(
+        engine, args.host, args.port, args.max_tokens_cap, queue=queue
+    ).serve_forever()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,143 @@
+"""Failure surface: request deadlines + device-probing /workers.
+
+Reference behavior being matched: 30s per worker hop / 5s health probes,
+with online/offline/error worker states and clean error envelopes
+(/root/reference/orchestration.py:118,131,306-329).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+import jax
+
+from distributed_llm_inference_tpu import EngineConfig, create_engine
+from distributed_llm_inference_tpu.engine.engine import (
+    InferenceEngine, SingleDeviceBackend,
+)
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.models.registry import get_model_config
+from distributed_llm_inference_tpu.utils.probe import probe_device
+
+
+class SlowBackend(SingleDeviceBackend):
+    """Backend whose prefill paths all stall, simulating a wedged device
+    call. Covers extend/prefill_at too — a chat-templated prompt longer
+    than the bucket takes the chunked route and must stall identically."""
+
+    def __init__(self, cfg, params, delay_s):
+        super().__init__(cfg, params)
+        self.delay_s = delay_s
+
+    def prefill(self, *a, **kw):
+        time.sleep(self.delay_s)
+        return super().prefill(*a, **kw)
+
+    def extend(self, *a, **kw):
+        time.sleep(self.delay_s)
+        return super().extend(*a, **kw)
+
+    def prefill_at(self, *a, **kw):
+        time.sleep(self.delay_s)
+        return super().prefill_at(*a, **kw)
+
+
+def _slow_engine(delay_s, deadline_s):
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    return InferenceEngine(
+        cfg,
+        backend=SlowBackend(cfg, params, delay_s),
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32,), request_deadline_s=deadline_s
+        ),
+    )
+
+
+def test_deadline_times_out_and_engine_recovers():
+    engine = _slow_engine(delay_s=2.0, deadline_s=0.3)
+    t0 = time.time()
+    r = engine.generate("hi", max_tokens=3, greedy=True, chat=False)
+    elapsed = time.time() - t0
+    assert r["status"] == "failed" and r["error_type"] == "timeout", r
+    assert elapsed < 1.5  # envelope within the deadline, not after delay_s
+
+    # once the wedged call drains, the engine serves again
+    engine.backend.delay_s = 0.0
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        r2 = engine.generate("hi again", max_tokens=3, greedy=True, chat=False)
+        if r2["status"] == "success":
+            break
+        assert r2["error_type"] == "timeout"
+        time.sleep(0.2)
+    assert r2["status"] == "success", r2
+
+
+def test_no_deadline_means_no_timeout():
+    engine = _slow_engine(delay_s=0.5, deadline_s=None)
+    r = engine.generate("hi", max_tokens=3, greedy=True, chat=False)
+    assert r["status"] == "success", r
+
+
+def test_deadline_timeout_maps_to_503():
+    from distributed_llm_inference_tpu.serving.server import InferenceServer
+
+    engine = _slow_engine(delay_s=3.0, deadline_s=0.3)
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/generate",
+            data=json.dumps({"prompt": "x", "max_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc_info.value.code == 503
+        body = json.loads(exc_info.value.read())
+        assert body["error_type"] == "timeout"
+    finally:
+        server.shutdown()
+
+
+def test_workers_probe_reports_timing():
+    engine = create_engine(
+        "test-llama-tiny", engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+    w = engine.workers()
+    stage = w["workers"]["stage_0"]
+    assert stage["status"] == "online"
+    assert stage["probe_ms"] >= 0.0
+
+
+def test_probe_device_error_and_timeout_paths():
+    def raising():
+        raise RuntimeError("device exploded")
+
+    r = probe_device(None, _op=raising)
+    assert r["status"] == "error" and "device exploded" in r["error"]
+
+    def hanging():
+        time.sleep(5)
+
+    r = probe_device(None, timeout_s=0.2, _op=hanging)
+    assert r["status"] == "offline" and "timed out" in r["error"]
+
+
+def test_pipeline_workers_probe(eight_devices):
+    from distributed_llm_inference_tpu import MeshConfig
+
+    engine = create_engine(
+        "test-llama-tiny",
+        mesh_cfg=MeshConfig(dp=1, pp=2, tp=1),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    w = engine.workers()
+    assert w["total"] == 2
+    for s in w["workers"].values():
+        assert s["status"] == "online"
+        assert s["probe_ms"] >= 0.0
