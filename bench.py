@@ -208,20 +208,25 @@ def run_benchmark():
     ttft = max(min(_timed(prefill_once)[0] for _ in range(3)) - rtt, 0.0)
 
     # decode throughput: K chained decode calls (donated cache threaded
-    # through), one scalar fetch at the end
+    # through), one scalar fetch at the end. One timing helper serves the
+    # baseline, batch, and int8 legs so the discipline (rep count, RTT
+    # subtraction) can never drift between them.
     K = 4
 
-    def decode_k():
-        nonlocal cache
-        for _ in range(K):
-            out, n_gen, cache = G.decode(
-                cfg, params, first, cache, plen, limit, kd, sampling,
-                max_steps=DECODE_STEPS,
-            )
-        fetch(n_gen)
+    def time_decode(p, first_tok, c):
+        def run():
+            nonlocal c
+            for _ in range(K):
+                _, n_gen, c = G.decode(
+                    cfg, p, first_tok, c, plen, limit, kd, sampling,
+                    max_steps=DECODE_STEPS,
+                )
+            fetch(n_gen)
 
-    decode_s = max(min(_timed(decode_k)[0] for _ in range(3)) - rtt, 1e-9) / K
-    tok_s = DECODE_STEPS / decode_s
+        per_call = max(min(_timed(run)[0] for _ in range(3)) - rtt, 1e-9) / K
+        return DECODE_STEPS / per_call, c
+
+    tok_s, cache = time_decode(params, first, cache)
 
     # MFU: dense-decode FLOPs are ~2*params per token; judged against the
     # chip's peak bf16 FLOP/s. Decode is HBM-bandwidth-bound, so low single
@@ -262,20 +267,27 @@ def run_benchmark():
             max_steps=DECODE_STEPS,
         )
         fetch(n_gen_b)  # warm/compile
+        per_stream, cache_b = time_decode(params, first_b, cache_b)
+        batch_tok_s = BATCH * per_stream
 
-        def decode_k_batch():
-            nonlocal cache_b
-            for _ in range(K):
-                out, n_gen, cache_b = G.decode(
-                    cfg, params, first_b, cache_b, plen, limit, kd, sampling,
-                    max_steps=DECODE_STEPS,
-                )
-            fetch(n_gen)
+    # int8 weight-only leg (ops/quant.py): same decode, half the HBM
+    # bytes/token — the lever that moves the bandwidth roofline itself.
+    # Skipped under the same wall-clock budget discipline as the batch leg.
+    int8_tok_s = None
+    if time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        from distributed_llm_inference_tpu.ops.quant import quantize_params
 
-        batch_s = max(
-            min(_timed(decode_k_batch)[0] for _ in range(3)) - rtt, 1e-9
-        ) / K
-        batch_tok_s = BATCH * DECODE_STEPS / batch_s
+        qparams = quantize_params(cfg, params)
+        cache_q = M.init_kv_cache(cfg, 1, max_seq=512)
+        first_q, _, cache_q = G.prefill(
+            cfg, qparams, tokens, plen, cache_q, kp, sampling
+        )
+        out, n_gen_q, cache_q = G.decode(
+            cfg, qparams, first_q, cache_q, plen, limit, kd, sampling,
+            max_steps=DECODE_STEPS,
+        )
+        fetch(n_gen_q)  # warm/compile
+        int8_tok_s, cache_q = time_decode(qparams, first_q, cache_q)
 
     result = {
         "metric": "tinyllama_1.1b_decode_throughput",
@@ -297,6 +309,13 @@ def run_benchmark():
         if peak:
             result["batch8_mfu"] = round(
                 2.0 * n_params * batch_tok_s / peak, 5
+            )
+    if int8_tok_s is not None:
+        result["int8_tokens_per_sec"] = round(int8_tok_s, 3)
+        if peak_bw:
+            # int8 streams ~1 byte/param (+0.2% scales)
+            result["int8_hbm_util"] = round(
+                1.0 * n_params * int8_tok_s / peak_bw, 4
             )
     _emit(result)
 

@@ -45,6 +45,12 @@ class ModelConfig:
     # GPT-2 only: learned absolute position embeddings.
     use_learned_pos: bool = False
     dtype: str = "float32"  # parameter / activation dtype: "float32" | "bfloat16"
+    # Weight-only quantization of the matmul weights (ops/quant.py):
+    # None | "int8". Halves decode's HBM bytes/token (the batch-1 decode
+    # bound; ~1.6x measured on v5e). Llama family; works on the single
+    # device AND the SPMD mesh backends (QTensor leaves shard like their
+    # weights).
+    quant: Optional[str] = None
     # Attention implementation: "xla" (einsum + full mask, fused by XLA) or
     # "pallas" (flash kernel, ops/flash_attention.py; interpret-mode on CPU).
     attn_impl: str = "xla"
@@ -55,6 +61,8 @@ class ModelConfig:
     def __post_init__(self):
         if self.attn_impl not in ("xla", "pallas"):
             raise ValueError(f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}")
+        if self.quant not in (None, "int8"):
+            raise ValueError(f"quant must be None or 'int8', got {self.quant!r}")
         if self.arch == "gpt2" and self.n_kv_heads != self.n_heads:
             raise ValueError(
                 f"gpt2 is MHA: n_kv_heads ({self.n_kv_heads}) must equal "

@@ -36,6 +36,7 @@ from ..config import ModelConfig
 from ..ops.attention import attend, causal_mask, ragged_causal_mask, update_kv_cache
 from ..ops.flash_attention import flash_attend
 from ..ops.norms import rms_norm
+from ..ops.quant import matmul as mm
 from ..ops.rope import apply_rope, rope_cos_sin
 
 Params = dict
@@ -146,7 +147,8 @@ def decoder_layer(
     KV = lp["wk"].shape[-1] // Dh
 
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    # mm: plain array or int8 QTensor (ops/quant.py) transparently
+    q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
     if cfg.attn_qkv_bias:  # Qwen2-style (biases tp-shard with their columns)
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, T, H, Dh)
@@ -158,14 +160,14 @@ def decoder_layer(
     attn, new_k, new_v = hook(
         cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate, valid_start
     )
-    attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
+    attn_out = mm(attn.reshape(B, T, H * Dh), lp["wo"])
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
     x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
     if tp_axis is not None:
         mlp_out = jax.lax.psum(mlp_out, tp_axis)
     x = x + mlp_out
@@ -224,8 +226,9 @@ def unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     """Final RMSNorm + LM head: [B, T, D] -> [B, T, V] logits
     (reference orchestration.py:140-141)."""
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head).astype(jnp.float32)
+    if cfg.tie_embeddings:
+        return (x @ params["embed"].T).astype(jnp.float32)
+    return mm(x, params["lm_head"]).astype(jnp.float32)
 
 
 def forward(

@@ -1,0 +1,119 @@
+"""Weight-only int8 quantization for the decode hot path.
+
+Batch-1 decode is HBM-bandwidth-bound: every step streams every weight
+byte from HBM once (bench.py measures ~75% of the v5e roofline in bf16).
+Halving the bytes halves the floor — so the matmul weights are stored as
+**int8 with per-output-channel symmetric scales** and dequantized on-chip:
+
+    y = (x @ q.astype(x.dtype)) * s        # scale applied to the OUTPUT
+
+The `astype` is a convert feeding a dot, which XLA fuses into the
+operand read (the int8 tensor is what crosses HBM). Applying the scale
+after the matmul keeps the inner loop integer-clean and needs one
+multiply per output element.
+
+QTensor is a registered pytree, so it composes with everything that maps
+over params: `lax.scan` over stacked layers slices q [L, in, out] and
+s [L, out] together, `device_put`/`NamedSharding` shard both leaves, and
+donation just works. Per-output-channel scales ride with their columns
+under tensor parallelism (column-sharded weights shard s; row-sharded
+weights replicate s).
+
+Embeddings stay unquantized: the embed lookup is a gather (no matmul to
+fuse into) and its bytes are negligible per token; norms/biases are tiny.
+
+No reference analogue — the reference serves fp32 torch on CPU
+(/root/reference/Worker1.py:64, orchestration.py:41); this is a
+beyond-parity TPU-performance feature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+
+# llama-family stacked matmul weights eligible for quantization, and
+# whether their OUTPUT channels are the last axis (always true here:
+# weights are stored [L, in, out] / [in, out])
+_LLAMA_QUANT_KEYS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+)
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """int8 weight + per-output-channel scale; shapes q [..., in, out],
+    s [..., out]."""
+
+    __slots__ = ("q", "s")
+
+    def __init__(self, q, s):
+        self.q = q
+        self.s = s
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def size(self):
+        return self.q.size + self.s.size
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QTensor(q={self.q.shape}@{self.q.dtype}, s={self.s.shape})"
+
+
+def quantize_tensor(w: jnp.ndarray) -> QTensor:
+    """Symmetric per-output-channel int8 quantization of w [..., in, out]."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)  # [..., 1, out]
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q, scale[..., 0, :])
+
+
+def dequantize_tensor(t: QTensor, dtype=jnp.float32) -> jnp.ndarray:
+    return (t.q.astype(jnp.float32) * t.s[..., None, :].astype(jnp.float32)).astype(dtype)
+
+
+def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
+    """x @ w for a plain array or a QTensor (dequant fused into the dot)."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * w.s.astype(x.dtype)
+    return x @ w
+
+
+def quantize_params(cfg: ModelConfig, params: dict) -> dict:
+    """Quantize the llama-family matmul weights of a params pytree.
+
+    Quantizes the stacked per-layer projections and (when untied) the LM
+    head; leaves embed / norms / biases untouched. Idempotent on already-
+    quantized leaves.
+    """
+    if cfg.arch != "llama":
+        raise NotImplementedError(
+            f"weight-only quantization is wired for the llama family; "
+            f"got arch={cfg.arch!r}"
+        )
+    out = dict(params)
+    layers = dict(params["layers"])
+    for k in _LLAMA_QUANT_KEYS:
+        if k in layers and not isinstance(layers[k], QTensor):
+            layers[k] = quantize_tensor(layers[k])
+    out["layers"] = layers
+    if "lm_head" in params and not isinstance(params["lm_head"], QTensor):
+        out["lm_head"] = quantize_tensor(params["lm_head"])
+    return out

@@ -130,12 +130,26 @@ def pad_stacked_layers(cfg: ModelConfig, layers: dict, pp: int) -> dict:
 
 
 def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
-    """PartitionSpec pytree for the stacked layer params."""
+    """PartitionSpec pytree for the stacked layer params.
+
+    Quantized leaves (ops/quant.QTensor) get a QTensor-of-specs: the int8
+    weight q [L, in, out] keeps the weight's spec, and its per-output-
+    channel scale s [L, out] drops the contraction axis — so scales shard
+    with their columns under tp and replicate for row-sharded weights."""
+    from ..ops.quant import QTensor
+
     specs = _FAMILY_LAYER_SPECS[cfg.arch]
     missing = set(layers) - set(specs)
     if missing:
         raise KeyError(f"no partition spec for layer params: {sorted(missing)}")
-    return {k: specs[k] for k in layers}
+    out = {}
+    for k, v in layers.items():
+        base = specs[k]
+        if isinstance(v, QTensor):
+            out[k] = QTensor(base, P(base[0], base[2]))
+        else:
+            out[k] = base
+    return out
 
 
 def shared_specs(shared: dict) -> dict:
@@ -144,12 +158,18 @@ def shared_specs(shared: dict) -> dict:
     for a Llama-3-8B-class model); norms / position rows replicate."""
     from .vocab import VOCAB_SHARDED
 
+    from ..ops.quant import QTensor
+
     specs = {}
-    for k in shared:
+    for k, v in shared.items():
         if k in VOCAB_SHARDED:
             axes = [None, None]
             axes[VOCAB_SHARDED[k]] = AXIS_PP
-            specs[k] = P(*axes)
+            spec = P(*axes)
+            if isinstance(v, QTensor):
+                # lm_head [D, V]: scale s [V] shards with the vocab columns
+                spec = QTensor(spec, P(AXIS_PP))
+            specs[k] = spec
         else:
             specs[k] = P()
     return specs

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 from ..ops.norms import layer_norm, rms_norm
+from ..ops.quant import matmul as qmm
 from .mesh import AXIS_PP
 
 # shared leaves sharded on a vocab dim (leaf name -> vocab axis index)
@@ -48,14 +49,27 @@ def padded_vocab(vocab_size: int, pp: int) -> int:
 
 
 def pad_vocab(cfg: ModelConfig, shared: dict, pp: int) -> dict:
-    """Zero-pad the vocab dim of embed/lm_head to a multiple of pp."""
+    """Zero-pad the vocab dim of embed/lm_head to a multiple of pp.
+
+    A quantized lm_head (ops/quant.QTensor) pads both the int8 columns
+    (zeros) and their scales (zeros) — pad logits come out 0 and are
+    sliced off after the gather either way."""
+    from ..ops.quant import QTensor
+
     V_pad = padded_vocab(cfg.vocab_size, pp)
     if V_pad == cfg.vocab_size:
         return shared
     out = dict(shared)
     for name, axis in VOCAB_SHARDED.items():
-        if name in shared:
-            x = shared[name]
+        if name not in shared:
+            continue
+        x = shared[name]
+        if isinstance(x, QTensor):
+            n = V_pad - x.q.shape[axis]
+            qpad = [(0, 0)] * x.q.ndim
+            qpad[axis] = (0, n)
+            out[name] = QTensor(jnp.pad(x.q, qpad), jnp.pad(x.s, [(0, n)]))
+        else:
             pad = [(0, 0)] * x.ndim
             pad[axis] = (0, V_pad - x.shape[axis])
             out[name] = jnp.pad(x, pad)
@@ -95,8 +109,11 @@ def unembed_sharded(cfg: ModelConfig, shared: dict, x: jnp.ndarray, pp: int):
         h = layer_norm(x, shared["final_norm_w"], shared["final_norm_b"], cfg.norm_eps)
     else:
         h = rms_norm(x, shared["final_norm"], cfg.norm_eps)
-    head = shared["embed"].T if cfg.tie_embeddings else shared["lm_head"]
-    lg = (h @ head).astype(jnp.float32)  # [B, T, V_pad/pp]
+    if cfg.tie_embeddings:
+        lg = (h @ shared["embed"].T).astype(jnp.float32)  # [B, T, V_pad/pp]
+    else:
+        # qmm: dense array or int8 QTensor column shard transparently
+        lg = qmm(h, shared["lm_head"]).astype(jnp.float32)
     if pp > 1:
         lg = jax.lax.all_gather(lg, AXIS_PP, axis=lg.ndim - 1, tiled=True)
     return lg[..., : cfg.vocab_size]

@@ -28,6 +28,7 @@ def create_backend(
     microbatches: int = 1,
     params: Any = None,
     dtype: Optional[str] = None,
+    quant: Optional[str] = None,
     seed: int = 0,
 ):
     """Build a compute backend alone (no engine/tokenizer around it).
@@ -42,6 +43,8 @@ def create_backend(
     cfg = get_model_config(model) if isinstance(model, str) else model
     if dtype is not None:
         cfg = cfg.replace(dtype=dtype)
+    if quant is not None:
+        cfg = cfg.replace(quant=quant)
     if mesh_cfg.sp > 1 and (mesh_cfg.pp > 1 or microbatches > 1):
         # checked before params init (the expensive step) and before the
         # microbatch branch, which would otherwise claim the sp-wide mesh
@@ -50,8 +53,19 @@ def create_backend(
             "sp (context parallel) does not compose with pp/microbatching "
             "yet: layer scans run whole-model per ring member"
         )
+    if cfg.quant is not None and cfg.arch != "llama":
+        # checked before params init (the expensive step), like the sp/dp
+        # guards around it
+        raise NotImplementedError(
+            f"weight-only quantization is wired for the llama family; "
+            f"got arch={cfg.arch!r}"
+        )
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
+    if cfg.quant is not None:
+        from .ops.quant import quantize_params
+
+        params = quantize_params(cfg, params)
     if microbatches > 1:
         if mesh_cfg.pp < 2:
             raise ValueError(
@@ -79,6 +93,7 @@ def create_engine(
     engine_cfg: EngineConfig = EngineConfig(),
     params: Any = None,
     dtype: Optional[str] = None,
+    quant: Optional[str] = None,
     tokenizer: Any = None,
     seed: int = 0,
 ) -> InferenceEngine:
@@ -97,7 +112,8 @@ def create_engine(
             "use create_backend() for dp-sharded / microbatched batched decode"
         )
     cfg, backend = create_backend(
-        model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, seed=seed
+        model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, quant=quant,
+        seed=seed,
     )
     return InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed
