@@ -64,6 +64,21 @@ register(ModelConfig(
     rope_theta=1000000.0, eos_token_id=2, bos_token_id=1,
 ))
 
+# --- Qwen2 family (llama arch + q/k/v projection biases) ------------------
+register(ModelConfig(
+    name="qwen2-7b", arch="llama", vocab_size=152064, dim=3584,
+    n_layers=28, n_heads=28, n_kv_heads=4, ffn_dim=18944, max_seq_len=32768,
+    norm_eps=1e-6, rope_theta=1000000.0, attn_qkv_bias=True,
+    eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
+))
+register(ModelConfig(
+    name="qwen2-0.5b", arch="llama", vocab_size=151936, dim=896,
+    n_layers=24, n_heads=14, n_kv_heads=2, ffn_dim=4864, max_seq_len=32768,
+    norm_eps=1e-6, rope_theta=1000000.0, attn_qkv_bias=True,
+    tie_embeddings=True,
+    eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
+))
+
 # --- GPT-2 family ----------------------------------------------------------
 register(ModelConfig(
     name="gpt2-small", arch="gpt2", vocab_size=50257, dim=768,

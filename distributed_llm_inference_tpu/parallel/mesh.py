@@ -41,7 +41,40 @@ def build_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh
     return Mesh(grid, (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP))
 
 
-def multihost_initialize(**kwargs) -> None:
+def multihost_initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
     """Multi-host bring-up over DCN (the reference's 'paste three ngrok
-    URLs' bootstrap, replaced by jax.distributed coordination)."""
+    URLs' bootstrap, /root/reference/orchestration.py:22-24, replaced by
+    jax.distributed coordination).
+
+    All three of (coordinator_address, num_processes, process_id) must be
+    given together, or all omitted (TPU-pod metadata auto-detection).
+    After it returns, `jax.devices()` spans every host and build_mesh
+    lays the same (dp, pp, sp, tp) axes over the whole pod — stage
+    hand-off inside a host rides ICI, across hosts DCN, with no code
+    change anywhere above this layer.
+    """
+    explicit = (coordinator_address, num_processes, process_id)
+    given = [x is not None for x in explicit]
+    if any(given) and not all(given):
+        raise ValueError(
+            "multihost bring-up needs coordinator_address, num_processes "
+            "AND process_id together (or none, for TPU-pod auto-detection); "
+            f"got {dict(zip(('coordinator_address', 'num_processes', 'process_id'), explicit))}"
+        )
+    if all(given):
+        if not 0 <= process_id < num_processes:
+            raise ValueError(
+                f"process_id {process_id} out of range for "
+                f"num_processes {num_processes}"
+            )
+        kwargs.update(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     jax.distributed.initialize(**kwargs)

@@ -72,9 +72,18 @@ class BatchingQueue:
         max_batch: int = 8,
         max_wait_ms: float = 5.0,
     ):
+        from ..engine.engine import BATCH_BUCKETS
+
         self.engine = engine
         self.max_queue = int(max_queue)
-        self.max_batch = int(max_batch)
+        # clamp to the largest batch the engine compiles: a bigger fleet
+        # would be rejected by generate_batch and silently serialize solo
+        self.max_batch = min(int(max_batch), BATCH_BUCKETS[-1])
+        if self.max_batch < int(max_batch):
+            log.warning(
+                "max_batch_clamped", requested=int(max_batch),
+                clamped_to=self.max_batch,
+            )
         self.max_wait_s = float(max_wait_ms) / 1e3
         self._cv = threading.Condition()
         self._queue: list[_Pending] = []
@@ -170,18 +179,49 @@ class BatchingQueue:
                     return
                 depth = len(self._queue)
                 head_age = time.time() - self._queue[0].enqueued
+                head_solo = self._queue[0].coalesce_key() is None
             # brief coalescing window: give a burst's stragglers a chance
             # to arrive before the fleet is cut. The head only ever waits
             # out the REMAINDER of its window — a request that already
-            # aged past it behind a running fleet dispatches immediately.
+            # aged past it behind a running fleet dispatches immediately —
+            # and a head that can never coalesce (seeded/debug/client
+            # batch) skips the window entirely.
             wait = self.max_wait_s - head_age
-            if self._can_coalesce and depth < self.max_batch and wait > 0:
+            if (
+                self._can_coalesce and not head_solo
+                and depth < self.max_batch and wait > 0
+            ):
                 time.sleep(wait)
             with self._cv:
                 if not self._queue:
                     continue
                 group = self._take_group()
-            self._run_group(group)
+            group = self._expire(group)
+            if group:
+                self._run_group(group)
+
+    def _expire(self, group: list[_Pending]) -> list[_Pending]:
+        """Fail requests whose QUEUE WAIT already exceeded the engine's
+        per-request deadline — --deadline promises a per-request wall
+        clock, and under backlog (the only time deadlines matter) the
+        wait would otherwise not count against it."""
+        deadline = getattr(self.engine.engine_cfg, "request_deadline_s", None)
+        if not deadline:
+            return group
+        now = time.time()
+        live = []
+        for p in group:
+            if now - p.enqueued > deadline:
+                p.result = {
+                    "error": f"Error: request exceeded the {deadline:g}s "
+                    "deadline while queued",
+                    "status": "failed",
+                    "error_type": "timeout",
+                }
+                p.done.set()
+            else:
+                live.append(p)
+        return live
 
     def _run_group(self, group: list[_Pending]):
         try:
@@ -192,7 +232,6 @@ class BatchingQueue:
                 else:
                     p.result = self.engine.generate(p.prompt, **p.kwargs)
                 return
-            self.coalesced_batches += 1
             kwargs = dict(group[0].kwargs)
             kwargs.pop("seed", None)
             kwargs.pop("debug", None)
@@ -201,6 +240,11 @@ class BatchingQueue:
                 [p.prompt for p in group], **kwargs
             )
             elapsed = time.time() - t0
+            if batch.get("status") == "success":
+                # counted only for fleets that actually served (a failed
+                # fleet falls back to solo — counting it would mask a
+                # coalescing regression behind a healthy-looking metric)
+                self.coalesced_batches += 1
             if batch.get("status") != "success":
                 if batch.get("error_type") in ("timeout", "overloaded"):
                     # capacity failures propagate as-is: retrying N members

@@ -324,6 +324,11 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--sp", type=int, default=1, help="context-parallel ring size")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
+    ap.add_argument(
+        "--quant", default=None, choices=[None, "int8"],
+        help="weight-only quantization: int8 halves decode HBM bytes/token "
+             "(~1.6x measured decode speedup on v5e; llama family)",
+    )
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
@@ -346,17 +351,33 @@ def main(argv: Optional[list] = None):
         help="coalescing window before a fleet is cut",
     )
     ap.add_argument(
+        "--coordinator", default=None, metavar="HOST:PORT",
+        help="multi-host DCN bring-up: jax.distributed coordinator address "
+             "(use with --num-processes/--process-id on every host)",
+    )
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument(
         "--warmup", action="store_true",
         help="pre-compile every (prefill, decode) bucket before serving "
              "(first requests then never pay jit latency)",
     )
     args = ap.parse_args(argv)
 
+    if args.coordinator or args.num_processes is not None or args.process_id is not None:
+        from ..parallel.mesh import multihost_initialize
+
+        multihost_initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     engine = create_engine(
         args.model,
         mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp),
         engine_cfg=EngineConfig(request_deadline_s=args.deadline),
         dtype=args.dtype,
+        quant=args.quant,
         seed=args.seed,
     )
     if args.warmup:

@@ -58,6 +58,41 @@ def test_logits_match_hf(n_kv_heads):
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_qwen2_logits_match_hf():
+    """Qwen2 family = llama arch + q/k/v biases + tied option; parity vs a
+    tiny-random HF Qwen2ForCausalLM validates the bias path end to end."""
+    cfg_hf = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=1000000.0,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.Qwen2ForCausalLM(cfg_hf)
+    hf.eval()
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    assert cfg.attn_qkv_bias and cfg.attn_window is None
+    assert "bq" in params["layers"]
+
+    rng = np.random.default_rng(5)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 13), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
 def test_incremental_decode_matches_full_forward():
     """Prefill + T=1 decode steps through the KV cache must reproduce the
     full-sequence forward logits at every position (the property the

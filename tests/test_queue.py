@@ -156,6 +156,47 @@ def test_client_batch_flows_through_queue():
         queue.close()
 
 
+def test_queue_wait_counts_against_deadline():
+    """--deadline bounds the WHOLE request wall clock: a request whose
+    queue wait already blew the deadline gets a timeout envelope at
+    dequeue instead of running minutes late."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+
+    class SlowBackend(SingleDeviceBackend):
+        def prefill(self, *a, **kw):
+            time.sleep(1.0)
+            return super().prefill(*a, **kw)
+
+    engine = InferenceEngine(
+        cfg, backend=SlowBackend(cfg, params),
+        engine_cfg=EngineConfig(prefill_buckets=(64,), request_deadline_s=0.5),
+    )
+    queue = BatchingQueue(engine, max_queue=8, max_batch=1, max_wait_ms=0)
+    try:
+        results = _fire(
+            queue, [f"p{i}" for i in range(4)], max_tokens=2, greedy=True,
+            chat=False,
+        )
+        timeouts = [r for r in results if r.get("error_type") == "timeout"]
+        assert timeouts, results
+        queued_out = [r for r in timeouts if "while queued" in r["error"]]
+        assert queued_out, timeouts  # at least one expired IN the queue
+    finally:
+        queue.close()
+
+
+def test_max_batch_clamped_to_engine_limit():
+    from distributed_llm_inference_tpu.engine.engine import BATCH_BUCKETS
+
+    engine = _engine()
+    queue = BatchingQueue(engine, max_queue=4, max_batch=999, max_wait_ms=0)
+    try:
+        assert queue.max_batch == BATCH_BUCKETS[-1]
+    finally:
+        queue.close()
+
+
 def test_queue_over_http_429():
     from distributed_llm_inference_tpu.serving.server import InferenceServer
 
