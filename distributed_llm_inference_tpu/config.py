@@ -141,6 +141,13 @@ class EngineConfig:
     # envelope and the engine keeps serving (the wedged device call is
     # abandoned to a daemon thread; the engine lock frees when it dies).
     request_deadline_s: Optional[float] = None
+    # Prefix KV cache (engine/prefix.py): number of chunk-aligned prompt-
+    # prefix snapshots kept on device (0 = disabled). Requests whose
+    # prompt starts with a stored prefix splice its KV back and prefill
+    # only the tail — TTFT scales with the new tokens, not the prompt.
+    prefix_cache_entries: int = 0
+    # Snapshot alignment: prefixes are stored at multiples of this length.
+    prefix_chunk: int = 64
 
 
 def stage_layer_range(n_layers: int, pp: int, stage: int) -> tuple[int, int]:

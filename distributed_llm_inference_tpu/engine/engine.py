@@ -33,6 +33,7 @@ from ..utils.logging import get_logger
 from ..utils.tokenizer import load_tokenizer
 from . import generate as G
 from .chat import format_chat_prompt
+from .prefix import PrefixCache
 
 log = get_logger("engine")
 
@@ -134,6 +135,13 @@ class InferenceEngine:
         # batched request allocates (and drops) a Bb x max_seq cache — multi-
         # GB HBM churn on the hot batched path.
         self._batch_caches: dict[int, Any] = {}
+        # Prefix KV snapshots (engine/prefix.py); disabled at 0 entries and
+        # auto-disabled for cache layouts that cannot snapshot/splice.
+        self._prefix = (
+            PrefixCache(engine_cfg.prefix_cache_entries, engine_cfg.prefix_chunk)
+            if engine_cfg.prefix_cache_entries > 0
+            else None
+        )
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self):
@@ -262,6 +270,37 @@ class InferenceEngine:
             log.error("generate_failed", exc_info=True, error=str(e))
             return {"error": f"Error: {e}", "status": "failed"}
 
+    def _plan_ingest(self, prompt_len: int, p0: int, buckets: tuple):
+        """Plan feeding ids[p0:] into the cache at offset p0.
+
+        Returns (n_full, rem, bucket, chunk) — n_full full-`chunk`
+        extend() calls then a final `bucket`-padded sampling chunk of
+        `rem` valid tokens — or None when this backend/bucket layout
+        cannot ingest from that offset (callers retry with p0=0 or
+        raise). The final chunk is a PADDED bucket whose pads also write
+        K/V: its end must stay inside max_seq or update_kv_cache's
+        silent clamp would overwrite real prompt slots.
+        """
+        cfg = self.cfg
+        if not buckets:
+            return None
+        tail = prompt_len - p0
+        chunk = buckets[-1]
+        n_full = max(0, (tail - 1) // chunk)  # leaves >= 1 sampling token
+        rem = tail - n_full * chunk
+        needs_offset_ops = p0 > 0 or n_full > 0
+        if needs_offset_ops and not hasattr(self.backend, "extend"):
+            return None
+        if tail > chunk and prompt_len > cfg.max_seq_len - 2:
+            return None
+        fitting = [
+            b for b in buckets
+            if b >= rem and p0 + n_full * chunk + b <= cfg.max_seq_len
+        ]
+        if not fitting:
+            return None
+        return n_full, rem, fitting[0], chunk
+
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False,
@@ -273,67 +312,76 @@ class InferenceEngine:
         prompt_len = len(ids)
 
         buckets = self._buckets()
-        chunked = (
-            buckets
-            and prompt_len > buckets[-1]
-            and prompt_len <= cfg.max_seq_len - 2
-            and hasattr(self.backend, "extend")
-        )
-        if chunked:
-            # prompt exceeds the largest compiled bucket: feed it through
-            # full-bucket extend() chunks, then sample off the final chunk.
-            # n_full leaves >= 1 token for the sampling chunk.
-            chunk = buckets[-1]
-            n_full = (prompt_len - 1) // chunk
-            rem = prompt_len - n_full * chunk
-            # the final chunk is a PADDED bucket whose pads also write K/V:
-            # its end (n_full*chunk + bucket) must stay inside max_seq or
-            # update_kv_cache's silent clamp would overwrite real prompt
-            # slots. Pick the smallest bucket that fits both rem and the
-            # cache; a bucket layout with none fitting rejects the request.
-            fitting = [
-                b for b in buckets
-                if b >= rem and n_full * chunk + b <= cfg.max_seq_len
-            ]
-            if not fitting:
+        if self._cache is None:
+            self._cache = self.backend.init_cache(1, cfg.max_seq_len)
+        if self._prefix is not None and not PrefixCache.compatible(self._cache):
+            # e.g. the context-parallel backend's slot-tagged cache; checked
+            # against the live buffer so a warmup()-initialized cache is
+            # covered too
+            log.info("prefix_cache_disabled", reason="cache layout")
+            self._prefix = None
+
+        # prefix-cache lookup: reuse the KV of a stored prompt prefix and
+        # ingest only the tail (engine/prefix.py)
+        p0, entry, pkey = 0, None, None
+        if self._prefix is not None:
+            p0, entry, pkey = self._prefix.lookup(ids)
+        plan = self._plan_ingest(prompt_len, p0, buckets)
+        if plan is None and p0:
+            p0, entry = 0, None  # no fitting tail plan: fall back to cold
+            plan = self._plan_ingest(prompt_len, 0, buckets)
+        if self._prefix is not None:
+            # counted on the PLANNED outcome: a lookup hit that had to fall
+            # back to cold is a miss, not a hit
+            self._prefix.mark(pkey, hit=bool(p0) and plan is not None)
+        if plan is None:
+            if (
+                buckets
+                and prompt_len > buckets[-1]
+                and hasattr(self.backend, "extend")
+            ):
                 raise ValueError(
                     f"prompt length {prompt_len} cannot be chunk-prefilled: "
-                    f"no prefill bucket fits the final {rem}-token chunk "
-                    f"within max_seq_len {cfg.max_seq_len}"
+                    f"no prefill bucket fits the final chunk within "
+                    f"max_seq_len {cfg.max_seq_len}"
                 )
-            bucket = fitting[0]
-            max_tokens, decode_bucket = self._clamp_decode(prompt_len, max_tokens)
-        else:
-            bucket, max_tokens, decode_bucket = self._plan(
-                prompt_len, max_tokens, frame_len=prompt_len
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds max prefill bucket "
+                f"{buckets[-1] if buckets else 0}"
             )
+        n_full, rem, bucket, chunk = plan
+        max_tokens, decode_bucket = self._clamp_decode(prompt_len, max_tokens)
 
         pad = cfg.pad_token_id
         sampling = G.default_sampling(temperature, top_k, top_p, greedy)
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
 
-        if self._cache is None:
-            self._cache = self.backend.init_cache(1, cfg.max_seq_len)
         cache = self._cache
         self._cache = None  # donated below; restored from the decode result
-        if chunked:
-            for c in range(n_full):
-                chunk_tokens = jnp.asarray(
-                    [ids[c * chunk : (c + 1) * chunk]], jnp.int32
-                )
-                cache = self.backend.extend(chunk_tokens, jnp.int32(c * chunk), cache)
-            tail = ids[n_full * chunk :]
-            tokens = jnp.asarray([tail + [pad] * (bucket - rem)], jnp.int32)
-            first, logits, cache = self.backend.prefill_at(
-                tokens, jnp.int32(n_full * chunk), jnp.int32(rem), cache,
-                key_pre, sampling,
+        if entry is not None:
+            cache = self._prefix.splice(entry, cache, p0)
+        for c in range(n_full):
+            chunk_tokens = jnp.asarray(
+                [ids[p0 + c * chunk : p0 + (c + 1) * chunk]], jnp.int32
             )
-        else:
-            tokens = jnp.asarray([ids + [pad] * (bucket - prompt_len)], jnp.int32)
+            cache = self.backend.extend(
+                chunk_tokens, jnp.int32(p0 + c * chunk), cache
+            )
+        tail_start = p0 + n_full * chunk
+        tail = ids[tail_start:]
+        tokens = jnp.asarray([tail + [pad] * (bucket - rem)], jnp.int32)
+        if tail_start == 0:
             first, logits, cache = self.backend.prefill(
                 tokens, jnp.int32(prompt_len), cache, key_pre, sampling
             )
+        else:
+            first, logits, cache = self.backend.prefill_at(
+                tokens, jnp.int32(tail_start), jnp.int32(rem), cache,
+                key_pre, sampling,
+            )
+        if self._prefix is not None:
+            self._prefix.store(ids, prompt_len, cache)
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
 
@@ -381,6 +429,8 @@ class InferenceEngine:
             "ttft_s": round(ttft, 4),
             "backend": self.backend.name,
         }
+        if p0:
+            result["prefix_cached_tokens"] = p0
         if top_predictions is not None:
             result["top_predictions"] = top_predictions
         return result
@@ -659,7 +709,7 @@ class InferenceEngine:
 
         ttfts = [s["ttft_s"] for s in samples]
         tpss = [s["tokens_per_sec"] for s in samples]
-        return {
+        out = {
             "window": len(samples),
             "ttft_p50_s": pct(ttfts, 0.5),
             "ttft_p90_s": pct(ttfts, 0.9),
@@ -667,6 +717,9 @@ class InferenceEngine:
             "tokens_per_sec_p90": pct(tpss, 0.9),
             "tokens_total": sum(s["tokens"] for s in samples),
         }
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+        return out
 
     # -- health (reference /health + /workers, orchestration.py:297-329) ----
     def health(self) -> dict:

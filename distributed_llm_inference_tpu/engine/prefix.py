@@ -1,0 +1,156 @@
+"""Prefix KV cache: skip re-prefilling shared prompt prefixes.
+
+Chat traffic re-sends the same system prompt + conversation history with
+every request; the reference re-embeds and re-runs ALL of it through every
+stage per token (/root/reference/orchestration.py:109-141). Our prefill
+already makes that one compiled call — this store removes even that for
+the shared part: after a prefill, the KV of a chunk-aligned prompt prefix
+is snapshotted (an on-device slice); a later request whose prompt starts
+with the same token prefix splices the snapshot back into the cache
+(one donated dynamic_update_slice) and prefills only the tail from the
+cached offset via the chunked-prefill machinery (engine/generate.extend /
+prefill-at-pos). TTFT then scales with the NEW tokens, not the whole
+prompt.
+
+Causal correctness: KV at slot i depends only on tokens[:i+1], so the
+first P slots of a snapshot are byte-valid for any prompt whose first P
+tokens match the snapshot's. Lookup reuses the longest common token
+prefix (floored to the chunk alignment), splicing only those slots — so
+a snapshot whose own tail diverges still donates its shared head and no
+stale slot is ever attended.
+
+Store discipline: entries are device arrays [L, B=1, KV, P, Dh] (sharded
+like the live cache on SPMD backends), LRU-bounded by entry count; P is
+rounded DOWN to a multiple of `chunk` so the slice/splice programs
+compile once per (P, cache) shape. Only backends with the plain
+{"k", "v"} cache layout participate (the context-parallel backend's
+slot-tagged cache does not).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _extract(cache, p: int):
+    return {
+        "k": jax.lax.slice_in_dim(cache["k"], 0, p, axis=3),
+        "v": jax.lax.slice_in_dim(cache["v"], 0, p, axis=3),
+    }
+
+
+@functools.partial(jax.jit, static_argnames=("p",), donate_argnames=("cache",))
+def _splice(cache, entry, p: int):
+    zeros = (jnp.int32(0),) * 5
+    ek = jax.lax.slice_in_dim(entry["k"], 0, p, axis=3)
+    ev = jax.lax.slice_in_dim(entry["v"], 0, p, axis=3)
+    return {
+        "k": jax.lax.dynamic_update_slice(cache["k"], ek, zeros),
+        "v": jax.lax.dynamic_update_slice(cache["v"], ev, zeros),
+    }
+
+
+class PrefixCache:
+    """LRU store of chunk-aligned prompt-prefix KV snapshots."""
+
+    def __init__(self, max_entries: int, chunk: int):
+        if max_entries < 1:
+            raise ValueError("prefix cache needs max_entries >= 1")
+        if chunk < 1:
+            raise ValueError("prefix cache needs chunk >= 1")
+        self.max_entries = int(max_entries)
+        self.chunk = int(chunk)
+        self._entries: "collections.OrderedDict[tuple, dict]" = collections.OrderedDict()
+        # guards _entries + counters: lookup/mark/store run under the
+        # engine lock, but stats() serves /stats//health from OTHER
+        # threads (same reason the engine keeps a separate samples lock)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def compatible(cache) -> bool:
+        """Only plain {k, v} cache layouts can snapshot/splice."""
+        return isinstance(cache, dict) and set(cache) == {"k", "v"}
+
+    def lookup(self, ids: list) -> tuple[int, Optional[dict], Optional[tuple]]:
+        """(P, entry, key) for the deepest reusable snapshot; (0, None,
+        None) on miss. Pure — no counters or LRU promotion; the engine
+        calls mark() once it knows whether the reuse actually planned
+        (a hit that falls back to cold must not count as a hit).
+
+        Reuse depth = the longest common TOKEN prefix between a stored
+        snapshot's ids and the request, compared a CHUNK at a time (tuple
+        slice equality, C speed — only the chunk-floored depth is usable
+        anyway) and capped to leave at least one tail token to prefill —
+        a snapshot whose own tail diverges still donates its shared head
+        (slots < P are valid because the tokens match exactly).
+        """
+        ids_t = tuple(ids)
+        cap = ((len(ids_t) - 1) // self.chunk) * self.chunk
+        best_p, best_key, best = 0, None, None
+        with self._lock:
+            for key, entry in self._entries.items():
+                limit = min(len(key), cap)
+                p = 0
+                while (
+                    p < limit
+                    and key[p : p + self.chunk] == ids_t[p : p + self.chunk]
+                ):
+                    p += self.chunk
+                p = min(p, limit)
+                if p > best_p:
+                    best_p, best_key, best = p, key, entry
+        if best is None or best_p < self.chunk:
+            return 0, None, None
+        return best_p, best, best_key
+
+    def mark(self, key: Optional[tuple], hit: bool) -> None:
+        """Record the request outcome; promotes the entry on a REAL hit
+        (one whose tail actually planned and spliced)."""
+        with self._lock:
+            if hit:
+                self.hits += 1
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+            else:
+                self.misses += 1
+
+    def splice(self, entry: dict, cache, p: int):
+        """Write the snapshot's first `p` slots into slots [0, p) of the
+        (donated) cache."""
+        return _splice(cache, entry, p)
+
+    def store(self, ids: list, prompt_len: int, cache) -> int:
+        """Snapshot the chunk-aligned prefix of a just-prefilled prompt.
+        Returns the stored length (0 if below one chunk / already stored)."""
+        p = (prompt_len // self.chunk) * self.chunk
+        if p < self.chunk:
+            return 0
+        key = tuple(ids[:p])
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return 0
+        snapshot = _extract(cache, p)
+        with self._lock:
+            self._entries[key] = snapshot
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+        return p
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "cached_tokens": sum(len(k) for k in self._entries),
+            }

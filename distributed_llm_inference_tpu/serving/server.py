@@ -351,6 +351,12 @@ def main(argv: Optional[list] = None):
         help="coalescing window before a fleet is cut",
     )
     ap.add_argument(
+        "--prefix-cache", type=int, default=0, metavar="N",
+        help="keep N chunk-aligned prompt-prefix KV snapshots on device; "
+             "requests sharing a stored prefix prefill only their tail "
+             "(TTFT scales with new tokens, not the prompt)",
+    )
+    ap.add_argument(
         "--coordinator", default=None, metavar="HOST:PORT",
         help="multi-host DCN bring-up: jax.distributed coordinator address "
              "(use with --num-processes/--process-id on every host)",
@@ -375,7 +381,10 @@ def main(argv: Optional[list] = None):
     engine = create_engine(
         args.model,
         mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp),
-        engine_cfg=EngineConfig(request_deadline_s=args.deadline),
+        engine_cfg=EngineConfig(
+            request_deadline_s=args.deadline,
+            prefix_cache_entries=args.prefix_cache,
+        ),
         dtype=args.dtype,
         quant=args.quant,
         seed=args.seed,
