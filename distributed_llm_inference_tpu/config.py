@@ -41,6 +41,12 @@ class ModelConfig:
     # Biases on the q/k/v projections (Qwen2-style; llama family only —
     # gpt2 always has full biases).
     attn_qkv_bias: bool = False
+    # Sparse mixture-of-experts FFN (Mixtral-style): n_experts == 0 means a
+    # dense SwiGLU MLP; > 0 replaces it with a top-k routed expert bank
+    # (models/llama.moe_ffn). Expert weights stack an E axis and shard
+    # over the `ep` mesh axis.
+    n_experts: int = 0
+    n_experts_per_tok: int = 2
     tie_embeddings: bool = False
     # GPT-2 only: learned absolute position embeddings.
     use_learned_pos: bool = False
@@ -73,6 +79,14 @@ class ModelConfig:
                 f"n_heads ({self.n_heads}) must be divisible by n_kv_heads "
                 f"({self.n_kv_heads})"
             )
+        if self.n_experts:
+            if self.arch != "llama":
+                raise ValueError("MoE (n_experts > 0) is llama-family only")
+            if not 1 <= self.n_experts_per_tok <= self.n_experts:
+                raise ValueError(
+                    f"n_experts_per_tok ({self.n_experts_per_tok}) must be in "
+                    f"[1, n_experts={self.n_experts}]"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -103,10 +117,14 @@ class MeshConfig:
     pp: int = 1
     sp: int = 1
     tp: int = 1
+    # expert parallelism: shards the MoE expert bank (ModelConfig.n_experts
+    # % ep == 0); every device computes its local experts for all tokens
+    # and a psum combines — the small-batch inference EP pattern.
+    ep: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.pp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp * self.ep
 
 
 @dataclasses.dataclass(frozen=True)

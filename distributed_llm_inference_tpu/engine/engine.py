@@ -135,13 +135,19 @@ class InferenceEngine:
         # batched request allocates (and drops) a Bb x max_seq cache — multi-
         # GB HBM churn on the hot batched path.
         self._batch_caches: dict[int, Any] = {}
-        # Prefix KV snapshots (engine/prefix.py); disabled at 0 entries and
-        # auto-disabled for cache layouts that cannot snapshot/splice.
-        self._prefix = (
-            PrefixCache(engine_cfg.prefix_cache_entries, engine_cfg.prefix_chunk)
-            if engine_cfg.prefix_cache_entries > 0
-            else None
-        )
+        # Prefix KV snapshots (engine/prefix.py); disabled at 0 entries,
+        # for backends that cannot resume ingestion at an offset (no
+        # extend/prefill_at — snapshots could be stored but never
+        # spliced), and auto-disabled for cache layouts that cannot
+        # snapshot/splice (checked against the live buffer later).
+        self._prefix = None
+        if engine_cfg.prefix_cache_entries > 0:
+            if hasattr(self.backend, "prefill_at"):
+                self._prefix = PrefixCache(
+                    engine_cfg.prefix_cache_entries, engine_cfg.prefix_chunk
+                )
+            else:
+                log.info("prefix_cache_disabled", reason="backend lacks prefill_at")
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self):
@@ -198,13 +204,10 @@ class InferenceEngine:
         )
         return max_tokens, G.pick_bucket(DECODE_BUCKETS, max_tokens)
 
-    def _plan(self, longest_prompt: int, max_tokens: int, frame_len=None):
-        """Shared bucketing/clamping for single and batched requests.
-
-        frame_len: slots the prompt frame occupies in the cache — the
-        prompt length for right-padded singles, the whole bucket for
-        left-padded batches. Returns (bucket, max_tokens, decode_bucket).
-        """
+    def _plan(self, longest_prompt: int, max_tokens: int):
+        """Bucketing/clamping for BATCHED requests (left-padded: the whole
+        bucket is the position frame). Single requests plan through
+        _plan_ingest. Returns (bucket, max_tokens, decode_bucket)."""
         buckets = self._buckets()
         if not buckets or longest_prompt > buckets[-1]:
             raise ValueError(
@@ -212,8 +215,7 @@ class InferenceEngine:
                 f"{buckets[-1] if buckets else 0}"
             )
         bucket = G.pick_bucket(buckets, longest_prompt)
-        frame = bucket if frame_len is None else frame_len
-        max_tokens, decode_bucket = self._clamp_decode(frame, max_tokens)
+        max_tokens, decode_bucket = self._clamp_decode(bucket, max_tokens)
         return bucket, max_tokens, decode_bucket
 
     def _row_tokens(self, first_id: int, row_out, n: int) -> list:
@@ -284,14 +286,18 @@ class InferenceEngine:
         cfg = self.cfg
         if not buckets:
             return None
+        if prompt_len > cfg.max_seq_len - 2:
+            # capacity guard on EVERY path (not just chunked): a prefix-
+            # cache hit with a short tail must reject exactly the prompts
+            # the cold path rejects, or acceptance becomes a function of
+            # cache state and decode's first KV write can silently clamp
+            return None
         tail = prompt_len - p0
         chunk = buckets[-1]
         n_full = max(0, (tail - 1) // chunk)  # leaves >= 1 sampling token
         rem = tail - n_full * chunk
         needs_offset_ops = p0 > 0 or n_full > 0
         if needs_offset_ops and not hasattr(self.backend, "extend"):
-            return None
-        if tail > chunk and prompt_len > cfg.max_seq_len - 2:
             return None
         fitting = [
             b for b in buckets
@@ -335,6 +341,11 @@ class InferenceEngine:
             # back to cold is a miss, not a hit
             self._prefix.mark(pkey, hit=bool(p0) and plan is not None)
         if plan is None:
+            if prompt_len > cfg.max_seq_len - 2:
+                raise ValueError(
+                    f"prompt length {prompt_len} exceeds the cache capacity "
+                    f"(max_seq_len {cfg.max_seq_len} less decode headroom)"
+                )
             if (
                 buckets
                 and prompt_len > buckets[-1]

@@ -74,6 +74,9 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
     return ModelConfig(
         name=name,
         arch="llama",
+        # Mixtral-style sparse MoE (num_local_experts absent on dense cfgs)
+        n_experts=getattr(hf_cfg, "num_local_experts", None) or 0,
+        n_experts_per_tok=getattr(hf_cfg, "num_experts_per_tok", None) or 2,
         vocab_size=hf_cfg.vocab_size,
         dim=hf_cfg.hidden_size,
         n_layers=hf_cfg.num_hidden_layers,
@@ -120,12 +123,39 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
             "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
             "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
-            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
-            "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
-            "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
         },
         "final_norm": jnp.asarray(p("model.norm.weight"), dtype=dt),
     }
+    if cfg.n_experts:
+        # Mixtral MoE: per-expert SwiGLU (w1=gate, w3=up, w2=down) + router
+        def stack_experts(w_name: str) -> jnp.ndarray:
+            mats = [
+                np.stack(
+                    [
+                        p(
+                            f"model.layers.{i}.block_sparse_moe.experts."
+                            f"{e}.{w_name}.weight"
+                        ).T
+                        for e in range(cfg.n_experts)
+                    ],
+                    axis=0,
+                )
+                for i in range(L)
+            ]
+            return jnp.asarray(np.stack(mats, axis=0), dtype=dt)
+
+        params["layers"].update(
+            w_router=stack("model.layers.{}.block_sparse_moe.gate.weight", True),
+            w_gate=stack_experts("w1"),
+            w_up=stack_experts("w3"),
+            w_down=stack_experts("w2"),
+        )
+    else:
+        params["layers"].update(
+            w_gate=stack("model.layers.{}.mlp.gate_proj.weight", True),
+            w_up=stack("model.layers.{}.mlp.up_proj.weight", True),
+            w_down=stack("model.layers.{}.mlp.down_proj.weight", True),
+        )
     if cfg.attn_qkv_bias:
         # Qwen2-style per-output-column biases, stacked like their weights
         params["layers"]["bq"] = stack("model.layers.{}.self_attn.q_proj.bias", False)

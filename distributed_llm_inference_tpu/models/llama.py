@@ -64,12 +64,23 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "wk": normal(ks[2], (L, D, KV * Dh), s),
             "wv": normal(ks[3], (L, D, KV * Dh), s),
             "wo": normal(ks[4], (L, H * Dh, D), s),
-            "w_gate": normal(ks[5], (L, D, F), s),
-            "w_up": normal(ks[6], (L, D, F), s),
-            "w_down": normal(ks[7], (L, F, D), F ** -0.5),
         },
         "final_norm": jnp.ones((D,), dt),
     }
+    if cfg.n_experts:  # Mixtral-style MoE FFN: expert bank + router
+        E = cfg.n_experts
+        params["layers"].update(
+            w_router=normal(ks[9], (L, D, E), s),
+            w_gate=normal(ks[5], (L, E, D, F), s),
+            w_up=normal(ks[6], (L, E, D, F), s),
+            w_down=normal(ks[7], (L, E, F, D), F ** -0.5),
+        )
+    else:
+        params["layers"].update(
+            w_gate=normal(ks[5], (L, D, F), s),
+            w_up=normal(ks[6], (L, D, F), s),
+            w_down=normal(ks[7], (L, F, D), F ** -0.5),
+        )
     if cfg.attn_qkv_bias:  # Qwen2-style
         params["layers"]["bq"] = jnp.zeros((L, H * Dh), dt)
         params["layers"]["bk"] = jnp.zeros((L, KV * Dh), dt)
@@ -110,6 +121,50 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     return attn, new_k, new_v
 
 
+def moe_ffn(
+    cfg: ModelConfig,
+    lp: Params,
+    h: jnp.ndarray,
+    ep_axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Mixtral-style sparse MoE FFN on a (normed) chunk h [B, T, D].
+
+    HF MixtralSparseMoeBlock semantics (the behavioral spec): fp32 softmax
+    over the router logits, top-k, renormalize the selected weights, sum
+    the selected experts' SwiGLU outputs. Computed as all-local-experts +
+    masked weighted sum: for small decode batches that is the standard
+    inference pattern — under an `ep` mesh axis every device computes its
+    1/ep slice of the expert bank for ALL tokens and one psum combines, so
+    per-device FLOPs stay ~constant while parameters scale with E.
+
+    lp holds this layer's (possibly ep-sharded) expert slice:
+    w_router [D, E] (replicated), w_gate/w_up [E_loc, D, F],
+    w_down [E_loc, F, D].
+    """
+    E, k = cfg.n_experts, cfg.n_experts_per_tok
+    logits = (h @ lp["w_router"]).astype(jnp.float32)  # [B, T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topw, topi = jax.lax.top_k(probs, k)
+    topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+    weights = jnp.sum(
+        jax.nn.one_hot(topi, E, dtype=jnp.float32) * topw[..., None], axis=-2
+    )  # [B, T, E]: renormalized weight per expert, 0 for unselected
+    weights = weights.astype(h.dtype)
+    E_loc = lp["w_gate"].shape[0]
+    if ep_axis is not None:
+        lo = jax.lax.axis_index(ep_axis) * E_loc
+        weights = jax.lax.dynamic_slice_in_dim(weights, lo, E_loc, axis=-1)
+    gate = jax.nn.silu(
+        jnp.einsum("btd,edf->btef", h, lp["w_gate"]).astype(jnp.float32)
+    ).astype(h.dtype)
+    up = jnp.einsum("btd,edf->btef", h, lp["w_up"])
+    down = jnp.einsum("btef,efd->bted", gate * up, lp["w_down"])
+    out = jnp.einsum("bted,bte->btd", down, weights)
+    if ep_axis is not None:
+        out = jax.lax.psum(out, ep_axis)
+    return out
+
+
 def decoder_layer(
     cfg: ModelConfig,
     lp: Params,
@@ -124,6 +179,7 @@ def decoder_layer(
     tp_axis: Optional[str] = None,
     attn_hook=None,
     valid_start: Optional[jnp.ndarray] = None,
+    ep_axis: Optional[str] = None,
 ):
     """One pre-norm decoder block on a chunk x [B,T,D] at offset `pos`.
 
@@ -166,10 +222,13 @@ def decoder_layer(
     x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
-    if tp_axis is not None:
-        mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    if cfg.n_experts:
+        mlp_out = moe_ffn(cfg, lp, h, ep_axis)  # psums over ep internally
+    else:
+        gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
+        if tp_axis is not None:
+            mlp_out = jax.lax.psum(mlp_out, tp_axis)
     x = x + mlp_out
     return x, new_k, new_v
 
@@ -184,6 +243,7 @@ def forward_layers(
     tp_axis: Optional[str] = None,
     attn_hook=None,
     valid_start: Optional[jnp.ndarray] = None,
+    ep_axis: Optional[str] = None,
 ):
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
@@ -207,7 +267,7 @@ def forward_layers(
         lp, ck, cv = xs
         xc, ck, cv = decoder_layer(
             cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate, tp_axis,
-            attn_hook, valid_start,
+            attn_hook, valid_start, ep_axis,
         )
         return xc, (ck, cv)
 

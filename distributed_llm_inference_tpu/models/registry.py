@@ -64,6 +64,14 @@ register(ModelConfig(
     rope_theta=1000000.0, eos_token_id=2, bos_token_id=1,
 ))
 
+# --- Mixtral family (llama arch + sparse MoE FFN) -------------------------
+register(ModelConfig(
+    name="mixtral-8x7b", arch="llama", vocab_size=32000, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=32768,
+    rope_theta=1000000.0, n_experts=8, n_experts_per_tok=2,
+    eos_token_id=2, bos_token_id=1,
+))
+
 # --- Qwen2 family (llama arch + q/k/v projection biases) ------------------
 register(ModelConfig(
     name="qwen2-7b", arch="llama", vocab_size=152064, dim=3584,
@@ -97,6 +105,12 @@ register(ModelConfig(
 register(ModelConfig(
     name="test-llama-tiny", arch="llama", vocab_size=256, dim=64,
     n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+    eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="test-moe-tiny", arch="llama", vocab_size=256, dim=64,
+    n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=96, max_seq_len=128,
+    n_experts=4, n_experts_per_tok=2,
     eos_token_id=2, bos_token_id=1,
 ))
 register(ModelConfig(

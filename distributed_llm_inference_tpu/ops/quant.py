@@ -111,7 +111,14 @@ def quantize_params(cfg: ModelConfig, params: dict) -> dict:
     out = dict(params)
     layers = dict(params["layers"])
     for k in _LLAMA_QUANT_KEYS:
-        if k in layers and not isinstance(layers[k], QTensor):
+        # MoE expert banks ([L, E, in, out], 4-D) stay dense for now —
+        # the moe_ffn einsum path has no QTensor seam; attention weights
+        # still quantize on MoE models (partial quant is valid)
+        if (
+            k in layers
+            and not isinstance(layers[k], QTensor)
+            and layers[k].ndim == 3
+        ):
             layers[k] = quantize_tensor(layers[k])
     out["layers"] = layers
     if "lm_head" in params and not isinstance(params["lm_head"], QTensor):

@@ -18,27 +18,30 @@ from jax.sharding import Mesh
 
 from ..config import MeshConfig
 
-AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP = "dp", "pp", "sp", "tp"
+AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, AXIS_EP = "dp", "pp", "sp", "tp", "ep"
 
 
 def build_mesh(mesh_cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
-    """(dp, pp, sp, tp) mesh over the given (default: all) devices.
+    """(dp, pp, sp, tp, ep) mesh over the given (default: all) devices.
 
     Device order: pp and sp are middle axes so consecutive devices form
-    pipeline / ring-attention rings over ICI neighbours; tp is innermost so
-    its per-layer psums ride the highest-bandwidth neighbour links. All
-    axes execute (parallel/pipeline.PipelineBackend for dp×pp×tp,
-    parallel/context.ContextParallelBackend for dp×sp); dp>1 needs
-    batch % dp == 0.
+    pipeline / ring-attention rings over ICI neighbours; tp and ep are
+    innermost so their per-layer psums ride the highest-bandwidth
+    neighbour links. All axes execute (parallel/pipeline.PipelineBackend
+    for dp×pp×tp×ep, parallel/context.ContextParallelBackend for dp×sp);
+    dp>1 needs batch % dp == 0, ep>1 needs an MoE model with
+    n_experts % ep == 0.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     need = mesh_cfg.n_devices
     if len(devs) < need:
-        raise ValueError(f"need {need} devices (dp*pp*sp*tp), have {len(devs)}")
+        raise ValueError(
+            f"need {need} devices (dp*pp*sp*tp*ep), have {len(devs)}"
+        )
     grid = np.array(devs[:need]).reshape(
-        mesh_cfg.dp, mesh_cfg.pp, mesh_cfg.sp, mesh_cfg.tp
+        mesh_cfg.dp, mesh_cfg.pp, mesh_cfg.sp, mesh_cfg.tp, mesh_cfg.ep
     )
-    return Mesh(grid, (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP))
+    return Mesh(grid, (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP, AXIS_EP))
 
 
 def multihost_initialize(

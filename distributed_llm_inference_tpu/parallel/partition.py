@@ -23,7 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig
 from ..models import api as M
-from .mesh import AXIS_DP, AXIS_PP, AXIS_TP
+from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 
 # Per-leaf PartitionSpecs for the stacked layer params (leading axis = layer
 # axis, always sharded over pp). Column-sharded leaves put tp on the output
@@ -66,9 +66,18 @@ _GPT2_LAYER_SPECS = {
 
 _FAMILY_LAYER_SPECS = {"llama": _LLAMA_LAYER_SPECS, "gpt2": _GPT2_LAYER_SPECS}
 
+# MoE (Mixtral-style) expert leaves: the expert bank shards its E axis
+# over ep; the tiny router replicates.
+_MOE_LAYER_SPECS = {
+    "w_router": P(AXIS_PP, None, None),
+    "w_gate": P(AXIS_PP, AXIS_EP, None, None),
+    "w_up": P(AXIS_PP, AXIS_EP, None, None),
+    "w_down": P(AXIS_PP, AXIS_EP, None, None),
+}
 
-def validate_mesh(cfg: ModelConfig, pp: int, tp: int) -> None:
-    """Divisibility invariants for a (pp, tp) factorization of the model.
+
+def validate_mesh(cfg: ModelConfig, pp: int, tp: int, ep: int = 1) -> None:
+    """Divisibility invariants for a (pp, tp, ep) factorization.
 
     pp need not divide n_layers: uneven splits are padded with zero no-op
     layers (pad_stacked_layers), so any pp <= n_layers is valid."""
@@ -80,6 +89,18 @@ def validate_mesh(cfg: ModelConfig, pp: int, tp: int) -> None:
         raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
     if cfg.ffn_dim % tp != 0:
         raise ValueError(f"ffn_dim={cfg.ffn_dim} not divisible by tp={tp}")
+    if ep > 1 and not cfg.n_experts:
+        raise ValueError("ep>1 needs an MoE model (cfg.n_experts > 0)")
+    if cfg.n_experts:
+        if cfg.n_experts % ep != 0:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} not divisible by ep={ep}"
+            )
+        if tp > 1:
+            raise NotImplementedError(
+                "MoE + tensor parallelism is not wired yet: shard experts "
+                "over ep instead of splitting each expert over tp"
+            )
 
 
 def split_params(params: dict) -> tuple[dict, dict]:
@@ -138,7 +159,9 @@ def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
     with their columns under tp and replicate for row-sharded weights."""
     from ..ops.quant import QTensor
 
-    specs = _FAMILY_LAYER_SPECS[cfg.arch]
+    specs = dict(_FAMILY_LAYER_SPECS[cfg.arch])
+    if cfg.n_experts:
+        specs.update(_MOE_LAYER_SPECS)
     missing = set(layers) - set(specs)
     if missing:
         raise KeyError(f"no partition spec for layer params: {sorted(missing)}")
@@ -187,7 +210,9 @@ def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict
     from .vocab import pad_vocab
 
     pp = int(mesh.shape[AXIS_PP])
-    validate_mesh(cfg, pp, int(mesh.shape[AXIS_TP]))
+    validate_mesh(
+        cfg, pp, int(mesh.shape[AXIS_TP]), int(mesh.shape.get(AXIS_EP, 1))
+    )
     shared, layers = split_params(params)
     layers = pad_stacked_layers(cfg, layers, pp)
     shared = pad_vocab(cfg, shared, pp)

@@ -39,7 +39,7 @@ from ..config import ModelConfig
 from ..engine.generate import SamplingParams
 from ..models import api as M
 from ..ops.sampling import sample_token
-from .mesh import AXIS_DP, AXIS_PP, AXIS_TP
+from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 from .partition import (
     cache_spec, init_sharded_cache, layer_specs, shard_params, shared_specs,
 )
@@ -67,8 +67,10 @@ class SPMDBackendBase:
         self.dp = int(mesh.shape.get(AXIS_DP, 1))
         self.pp = int(mesh.shape[AXIS_PP])
         self.tp = int(mesh.shape.get(AXIS_TP, 1))
+        self.ep = int(mesh.shape.get(AXIS_EP, 1))
         self.n_stages = self.pp
         self.tp_axis = AXIS_TP if self.tp > 1 else None
+        self.ep_axis = AXIS_EP if self.ep > 1 else None
         self.shared, self.layers = shard_params(cfg, params, mesh)
         self._layer_specs = layer_specs(cfg, self.layers)
         self._shared_specs = shared_specs(self.shared)
@@ -196,6 +198,7 @@ class PipelineBackend(SPMDBackendBase):
             y, cache = M.forward_layers(
                 cfg, layers, buf, cache, pos, update_gate=gate,
                 tp_axis=self.tp_axis, valid_start=valid_start,
+                ep_axis=self.ep_axis,
             )
             buf = jax.lax.ppermute(y, AXIS_PP, perm)
             return buf, cache

@@ -123,7 +123,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         cv = jax.lax.dynamic_slice_in_dim(cache["v"], row0, b_m, axis=1)
         y, new = M.forward_layers(
             self.cfg, layers, x, {"k": ck, "v": cv}, pos_m,
-            update_gate=gate, tp_axis=self.tp_axis,
+            update_gate=gate, tp_axis=self.tp_axis, ep_axis=self.ep_axis,
         )
         cache = {
             "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], new["k"], row0, axis=1),

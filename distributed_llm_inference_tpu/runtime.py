@@ -45,13 +45,13 @@ def create_backend(
         cfg = cfg.replace(dtype=dtype)
     if quant is not None:
         cfg = cfg.replace(quant=quant)
-    if mesh_cfg.sp > 1 and (mesh_cfg.pp > 1 or microbatches > 1):
+    if mesh_cfg.sp > 1 and (mesh_cfg.pp > 1 or microbatches > 1 or mesh_cfg.ep > 1):
         # checked before params init (the expensive step) and before the
         # microbatch branch, which would otherwise claim the sp-wide mesh
         # and silently replicate all work across it
         raise ValueError(
-            "sp (context parallel) does not compose with pp/microbatching "
-            "yet: layer scans run whole-model per ring member"
+            "sp (context parallel) does not compose with pp/microbatching/"
+            "ep yet: layer scans run whole-model per ring member"
         )
     if cfg.quant is not None and cfg.arch != "llama":
         # checked before params init (the expensive step), like the sp/dp
@@ -80,7 +80,7 @@ def create_backend(
     if mesh_cfg.sp > 1:
         mesh = build_mesh(mesh_cfg)
         return cfg, ContextParallelBackend(cfg, params, mesh)
-    if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1:
+    if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1 or mesh_cfg.ep > 1:
         mesh = build_mesh(mesh_cfg)
         return cfg, PipelineBackend(cfg, params, mesh)
     return cfg, SingleDeviceBackend(cfg, params)

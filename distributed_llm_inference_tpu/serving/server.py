@@ -323,6 +323,7 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument("--sp", type=int, default=1, help="context-parallel ring size")
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1, help="expert-parallel width (MoE)")
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
     ap.add_argument(
         "--quant", default=None, choices=[None, "int8"],
@@ -380,7 +381,9 @@ def main(argv: Optional[list] = None):
         )
     engine = create_engine(
         args.model,
-        mesh_cfg=MeshConfig(dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp),
+        mesh_cfg=MeshConfig(
+            dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp, ep=args.ep
+        ),
         engine_cfg=EngineConfig(
             request_deadline_s=args.deadline,
             prefix_cache_entries=args.prefix_cache,

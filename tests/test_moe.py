@@ -1,0 +1,169 @@
+"""Mixtral-style sparse MoE: HF parity + expert parallelism.
+
+The HF MixtralForCausalLM is the behavioral spec for the router (fp32
+softmax -> top-k -> renormalize) and the expert SwiGLU; the ep mesh axis
+must reproduce the single-device MoE bit-for-bit (each device computes
+its expert slice for all tokens, one psum combines).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import EngineConfig, MeshConfig, create_engine
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.models.registry import get_model_config
+
+
+def test_moe_forward_shapes_and_sparsity():
+    cfg = get_model_config("test-moe-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    assert params["layers"]["w_gate"].shape == (4, 4, 64, 96)
+    assert params["layers"]["w_router"].shape == (4, 64, 4)
+    cache = M.init_kv_cache(cfg, 1, max_seq=32)
+    tokens = jnp.asarray([[5, 9, 13]], jnp.int32)
+    logits, _ = M.forward(cfg, params, tokens, cache, jnp.int32(0))
+    assert logits.shape == (1, 3, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_moe_logits_match_hf():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from distributed_llm_inference_tpu.models import llama
+    from distributed_llm_inference_tpu.models.convert import params_from_hf_model
+
+    cfg_hf = transformers.MixtralConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        sliding_window=None,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.MixtralForCausalLM(cfg_hf)
+    hf.eval()
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    assert cfg.n_experts == 4 and cfg.n_experts_per_tok == 2
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 11), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize(
+    "mesh_cfg",
+    [
+        MeshConfig(ep=4),
+        MeshConfig(ep=2),
+        MeshConfig(pp=2, ep=2),
+    ],
+    ids=["ep4", "ep2", "pp2ep2"],
+)
+def test_expert_parallel_matches_single_device(mesh_cfg, eight_devices):
+    """ep-sharded expert banks (optionally under pp) decode exactly what
+    the single-device MoE decodes."""
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = get_model_config("test-moe-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(2)
+    ids = rng.integers(3, cfg.vocab_size, size=9, dtype=np.int64).tolist()
+    bucket, steps = 16, 6
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(3))
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, logits_s, cache_s = G.prefill(cfg, params, tokens, plen, cache_s, kp, sampling)
+    out_s, n_s, _ = G.decode(
+        cfg, params, f_s, cache_s, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    mesh = build_mesh(mesh_cfg, eight_devices)
+    pb = PipelineBackend(cfg, params, mesh)
+    # expert bank actually sharded over ep
+    wg = pb.layers["w_gate"]
+    assert wg.sharding.shard_shape(wg.shape)[1] == cfg.n_experts // mesh_cfg.ep
+    cache_p = pb.init_cache(1, 64)
+    f_p, logits_p, cache_p = pb.prefill(tokens, plen, cache_p, kp, sampling)
+    out_p, n_p, _ = pb.decode(
+        f_p, cache_p, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=1e-4, atol=1e-5
+    )
+    assert int(f_p[0]) == int(f_s[0])
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+    assert int(n_p[0]) == int(n_s[0])
+
+
+def test_moe_engine_end_to_end(eight_devices):
+    engine = create_engine(
+        "test-moe-tiny",
+        mesh_cfg=MeshConfig(ep=2),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    r = engine.generate("mixture of experts", max_tokens=5, greedy=True, chat=False)
+    assert r["status"] == "success", r
+    assert r["tokens_generated"] >= 1
+
+
+def test_mesh_validation_for_experts(eight_devices):
+    from distributed_llm_inference_tpu.parallel.partition import validate_mesh
+
+    dense = get_model_config("test-llama-tiny")
+    with pytest.raises(ValueError, match="needs an MoE model"):
+        validate_mesh(dense, pp=1, tp=1, ep=2)
+    moe = get_model_config("test-moe-tiny")  # 4 experts
+    with pytest.raises(ValueError, match="not divisible by ep"):
+        validate_mesh(moe, pp=1, tp=1, ep=3)
+    with pytest.raises(NotImplementedError, match="tensor parallelism"):
+        validate_mesh(moe, pp=1, tp=2, ep=1)
+
+
+def test_moe_uneven_pp_no_op_padding(eight_devices):
+    """Zero-padded no-op layers stay exact no-ops with an MoE FFN (zero
+    router -> uniform top-k of zero experts -> zero output)."""
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = get_model_config("test-moe-tiny", n_layers=3)
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    ids = [5, 9, 13, 21]
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * 12], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(5))
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, logits_s, _ = G.prefill(cfg, params, tokens, plen, cache_s, kp, sampling)
+
+    mesh = build_mesh(MeshConfig(pp=2, ep=2), eight_devices)
+    pb = PipelineBackend(cfg, params, mesh)  # 3 layers over pp=2: 2,1+pad
+    cache_p = pb.init_cache(1, 64)
+    f_p, logits_p, _ = pb.prefill(tokens, plen, cache_p, kp, sampling)
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=1e-4, atol=1e-5
+    )
+    assert int(f_p[0]) == int(f_s[0])
