@@ -41,6 +41,9 @@ DECODE_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 # generate_batch pads the row count up to one of these (compile-once per
 # batch bucket, like the prompt/decode buckets)
 BATCH_BUCKETS = (1, 2, 4, 8, 16)
+# prompt-lookup speculation: drafted tokens verified per forward (the KV
+# headroom _clamp_decode reserves past the last emitted token)
+SPEC_DRAFT_LEN = 4
 
 
 class SingleDeviceBackend:
@@ -85,6 +88,16 @@ class SingleDeviceBackend:
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
             sampling, valid_start, max_steps=max_steps,
+        )
+
+    # greedy prompt-lookup speculative decode (engine opts in per request)
+    supports_speculative = True
+
+    def decode_speculative(self, first_token, cache, hist, hist_len, limit,
+                           *, max_steps, draft_len):
+        return G.decode_speculative(
+            self.cfg, self.params, first_token, cache, hist, hist_len, limit,
+            max_steps=max_steps, draft_len=draft_len,
         )
 
     def health(self) -> list[dict]:
@@ -193,14 +206,21 @@ class InferenceEngine:
     def _buckets(self):
         return tuple(b for b in self.engine_cfg.prefill_buckets if b <= self.cfg.max_seq_len)
 
-    def _clamp_decode(self, frame: int, max_tokens: int) -> tuple[int, int]:
-        """Cache-capacity discipline in ONE place: frame + generated must
-        fit max_seq (update_kv_cache clamps silently out of range — never
-        allow it), also bounded by the largest compiled decode bucket.
-        Returns (max_tokens, decode_bucket)."""
+    def _clamp_decode(
+        self, frame: int, max_tokens: int, headroom: int = 0
+    ) -> tuple[int, int]:
+        """Cache-capacity discipline in ONE place: frame + generated (+
+        `headroom` scratch slots, e.g. speculative drafts written past the
+        last emitted token) must fit max_seq (update_kv_cache clamps
+        silently out of range — never allow it), also bounded by the
+        largest compiled decode bucket. Returns (max_tokens, decode_bucket)."""
         max_tokens = max(
             1,
-            min(int(max_tokens), self.cfg.max_seq_len - frame - 1, DECODE_BUCKETS[-1]),
+            min(
+                int(max_tokens),
+                self.cfg.max_seq_len - frame - 1 - headroom,
+                DECODE_BUCKETS[-1],
+            ),
         )
         return max_tokens, G.pick_bucket(DECODE_BUCKETS, max_tokens)
 
@@ -244,12 +264,18 @@ class InferenceEngine:
         chat: bool = True,
         seed: Optional[int] = None,
         debug: bool = False,
+        speculative: bool = False,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
         debug=True adds "top_predictions": the top-5 first-token
         candidates with probabilities (the reference prints these,
         orchestration.py:172-178; here they are response data, not stdout).
+        speculative=True uses prompt-lookup self-speculation for GREEDY
+        requests on capable backends (several tokens per forward on
+        repetitive text; every emitted token is still an argmax — exact
+        vs plain greedy in fp32, while bf16 may resolve numerical
+        near-ties differently); ignored otherwise.
         """
         t_start = time.time()
 
@@ -257,7 +283,7 @@ class InferenceEngine:
             with self._lock:
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-                    seed, t_start, debug,
+                    seed, t_start, debug, speculative,
                 )
 
         try:
@@ -309,7 +335,7 @@ class InferenceEngine:
 
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-        seed, t_start, debug=False,
+        seed, t_start, debug=False, speculative=False,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -361,7 +387,15 @@ class InferenceEngine:
                 f"{buckets[-1] if buckets else 0}"
             )
         n_full, rem, bucket, chunk = plan
-        max_tokens, decode_bucket = self._clamp_decode(prompt_len, max_tokens)
+        use_spec = (
+            speculative
+            and greedy
+            and getattr(self.backend, "supports_speculative", False)
+        )
+        max_tokens, decode_bucket = self._clamp_decode(
+            prompt_len, max_tokens,
+            headroom=SPEC_DRAFT_LEN if use_spec else 0,
+        )
 
         pad = cfg.pad_token_id
         sampling = G.default_sampling(temperature, top_k, top_p, greedy)
@@ -396,10 +430,23 @@ class InferenceEngine:
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
 
-        out, n_gen, cache = self.backend.decode(
-            first, cache, jnp.int32(prompt_len), jnp.int32(max_tokens - 1),
-            key_dec, sampling, max_steps=decode_bucket,
-        )
+        if use_spec:
+            # H is static per model so the program compiles once
+            H = cfg.max_seq_len + SPEC_DRAFT_LEN + 2
+            hist = jnp.zeros((1, H), jnp.int32)
+            hist = jax.lax.dynamic_update_slice(
+                hist, jnp.asarray([ids], jnp.int32), (jnp.int32(0), jnp.int32(0))
+            )
+            out, n_gen, cache = self.backend.decode_speculative(
+                first, cache, hist, jnp.int32(prompt_len),
+                jnp.int32(max_tokens - 1), max_steps=decode_bucket,
+                draft_len=SPEC_DRAFT_LEN,
+            )
+        else:
+            out, n_gen, cache = self.backend.decode(
+                first, cache, jnp.int32(prompt_len), jnp.int32(max_tokens - 1),
+                key_dec, sampling, max_steps=decode_bucket,
+            )
         out = jax.block_until_ready(out)
         self._cache = cache
 
@@ -442,6 +489,8 @@ class InferenceEngine:
         }
         if p0:
             result["prefix_cached_tokens"] = p0
+        if use_spec:
+            result["speculative"] = True
         if top_predictions is not None:
             result["top_predictions"] = top_predictions
         return result
@@ -515,6 +564,17 @@ class InferenceEngine:
                     max_steps=db,
                 )
                 n += 1
+            if getattr(self.backend, "supports_speculative", False):
+                # speculative programs too — 'no request pays jit latency'
+                # includes speculative=true requests
+                H = self.cfg.max_seq_len + SPEC_DRAFT_LEN + 2
+                hist = jnp.zeros((1, H), jnp.int32)
+                for db in decode_buckets:
+                    _, _, cache = self.backend.decode_speculative(
+                        first, cache, hist, jnp.int32(1), jnp.int32(0),
+                        max_steps=db, draft_len=SPEC_DRAFT_LEN,
+                    )
+                    n += 1
             jax.block_until_ready(cache)
             self._cache = cache  # first real request reuses the buffer
 

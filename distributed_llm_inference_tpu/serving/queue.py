@@ -235,6 +235,9 @@ class BatchingQueue:
             kwargs = dict(group[0].kwargs)
             kwargs.pop("seed", None)
             kwargs.pop("debug", None)
+            # a coalesced greedy fleet already produces the exact tokens a
+            # speculative solo run would; the flag just doesn't apply
+            kwargs.pop("speculative", None)
             t0 = time.time()
             batch = self.engine.generate_batch(
                 [p.prompt for p in group], **kwargs

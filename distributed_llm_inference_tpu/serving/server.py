@@ -247,6 +247,13 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     # debug=true adds top-5 first-token predictions
                     # (reference's debug prints, orchestration.py:172-178)
                     kwargs["debug"] = _parse_bool(data.get("debug", False), "debug")
+                    # speculative=true: greedy prompt-lookup speculation
+                    # (faster on repetitive text; argmax-equivalent — exact
+                    # in fp32, bf16 may resolve numerical near-ties
+                    # differently)
+                    kwargs["speculative"] = _parse_bool(
+                        data.get("speculative", False), "speculative"
+                    )
                     if queue is not None:
                         # bounded backpressure + concurrent-singles
                         # coalescing (serving/queue.py); full -> 429

@@ -1,0 +1,126 @@
+"""Prompt-lookup speculative decoding (engine/generate.decode_speculative).
+
+Correctness bar: BIT-IDENTICAL output to plain greedy decode in this
+suite's fp32/highest-precision CPU environment — every emitted token is
+the model's argmax given the accepted context; speculation only changes
+how many land per forward. (In bf16 on TPU the chunked verify matmuls
+may resolve numerical near-ties differently — same benign class as
+chunked-vs-tokenwise prefill.) The reference has no analogue (0.12-0.2
+tok/s with no KV cache at all); this is a beyond-parity TPU feature.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import EngineConfig, create_engine
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.models.registry import get_model_config
+
+
+def _setup(cfg, ids, bucket=32, max_seq=256):
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        [ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32
+    )
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(1))
+    return params, tokens, sampling, kp, kd
+
+
+def _plain(cfg, params, tokens, plen, steps, kp, kd, sampling, max_seq=256):
+    cache = M.init_kv_cache(cfg, 1, max_seq=max_seq)
+    first, _, cache = G.prefill(
+        cfg, params, tokens, jnp.int32(plen), cache, kp, sampling
+    )
+    out, n, _ = G.decode(
+        cfg, params, first, cache, jnp.int32(plen), jnp.int32(steps),
+        kd, sampling, max_steps=steps,
+    )
+    return first, out, n
+
+
+def _spec(cfg, params, tokens, ids, plen, steps, kp, sampling, draft_len=4,
+          max_seq=256):
+    cache = M.init_kv_cache(cfg, 1, max_seq=max_seq)
+    first, _, cache = G.prefill(
+        cfg, params, tokens, jnp.int32(plen), cache, kp, sampling
+    )
+    hist = jnp.zeros((1, max_seq + draft_len + 2), jnp.int32)
+    hist = hist.at[0, :plen].set(jnp.asarray(ids, jnp.int32))
+    out, n, _ = G.decode_speculative(
+        cfg, params, first, cache, hist, jnp.int32(plen), jnp.int32(steps),
+        max_steps=steps, draft_len=draft_len,
+    )
+    return first, out, n
+
+
+@pytest.mark.parametrize("draft_len", [2, 4, 7])
+@pytest.mark.parametrize(
+    "ids",
+    [
+        ([7, 11, 13, 17] * 6)[:20],  # repetitive: speculation lands
+        [5, 9, 13, 21, 8, 3, 30, 12, 25, 6],  # no repeats: all rejected
+    ],
+    ids=["repetitive", "random"],
+)
+def test_speculative_bit_identical_to_greedy(ids, draft_len):
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1, max_seq_len=256)
+    params, tokens, sampling, kp, kd = _setup(cfg, ids)
+    steps = 24
+    f_r, out_r, n_r = _plain(cfg, params, tokens, len(ids), steps, kp, kd, sampling)
+    f_s, out_s, n_s = _spec(
+        cfg, params, tokens, ids, len(ids), steps, kp, sampling, draft_len
+    )
+    assert int(f_r[0]) == int(f_s[0])
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_s))
+    assert int(n_r[0]) == int(n_s[0])
+
+
+def test_speculative_eos_truncation_matches():
+    cfg0 = get_model_config("test-llama-tiny", eos_token_id=-1, max_seq_len=256)
+    ids = ([7, 11, 13, 17] * 6)[:20]
+    params, tokens, sampling, kp, kd = _setup(cfg0, ids)
+    steps = 24
+    _, out_free, _ = _plain(cfg0, params, tokens, len(ids), steps, kp, kd, sampling)
+    eos = int(np.asarray(out_free)[0, 6])  # token greedy emits mid-stream
+
+    cfg = cfg0.replace(eos_token_id=eos)
+    f_r, out_r, n_r = _plain(cfg, params, tokens, len(ids), steps, kp, kd, sampling)
+    f_s, out_s, n_s = _spec(cfg, params, tokens, ids, len(ids), steps, kp, sampling)
+    assert int(np.asarray(n_r)[0]) < steps  # EOS actually truncated
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_s))
+    assert int(n_r[0]) == int(n_s[0])
+
+
+def test_speculative_limit_exact():
+    """The traced limit cuts emission mid-window without overshoot."""
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1, max_seq_len=256)
+    ids = ([7, 11, 13, 17] * 6)[:20]
+    params, tokens, sampling, kp, kd = _setup(cfg, ids)
+    for steps in (1, 3, 5):
+        f_r, out_r, n_r = _plain(cfg, params, tokens, len(ids), steps, kp, kd, sampling)
+        f_s, out_s, n_s = _spec(cfg, params, tokens, ids, len(ids), steps, kp, sampling)
+        np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_s))
+        assert int(n_s[0]) == int(n_r[0]) <= steps
+
+
+def test_engine_speculative_flag():
+    engine = create_engine(
+        get_model_config("test-llama-tiny", max_seq_len=256),
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64), max_seq_len=256),
+    )
+    p = "repeat repeat repeat repeat repeat"
+    r_plain = engine.generate(p, max_tokens=8, greedy=True, chat=False)
+    r_spec = engine.generate(p, max_tokens=8, greedy=True, chat=False,
+                             speculative=True)
+    assert r_spec["status"] == "success", r_spec
+    assert r_spec.get("speculative") is True
+    assert r_spec["response"] == r_plain["response"]
+    # non-greedy ignores the flag
+    r_sampled = engine.generate(p, max_tokens=4, chat=False, speculative=True,
+                                seed=3)
+    assert r_sampled["status"] == "success"
+    assert "speculative" not in r_sampled
