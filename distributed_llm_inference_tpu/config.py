@@ -35,6 +35,16 @@ class ModelConfig:
     max_seq_len: int = 2048
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # RoPE frequency scaling (Llama-3.1/3.2-style "llama3" rope_scaling):
+    # HF applies it to the inverse frequencies unconditionally — including
+    # positions below the original context — so checkpoints trained with it
+    # produce wrong logits at EVERY position unless it is reproduced.
+    # None = plain RoPE.
+    rope_scaling: Optional[str] = None  # None | "llama3"
+    rope_scaling_factor: float = 8.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_len: int = 8192
     # Sliding-window attention (Mistral-style): a query attends only the
     # last `attn_window` positions. None = full causal.
     attn_window: Optional[int] = None
@@ -69,6 +79,10 @@ class ModelConfig:
             raise ValueError(f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}")
         if self.quant not in (None, "int8"):
             raise ValueError(f"quant must be None or 'int8', got {self.quant!r}")
+        if self.rope_scaling not in (None, "llama3"):
+            raise ValueError(
+                f"rope_scaling must be None or 'llama3', got {self.rope_scaling!r}"
+            )
         if self.arch == "gpt2" and self.n_kv_heads != self.n_heads:
             raise ValueError(
                 f"gpt2 is MHA: n_kv_heads ({self.n_kv_heads}) must equal "

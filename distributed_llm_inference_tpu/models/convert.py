@@ -71,6 +71,28 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
     window = getattr(hf_cfg, "sliding_window", None)
     if mt == "qwen2" and not getattr(hf_cfg, "use_sliding_window", False):
         window = None
+    # Llama-3.1/3.2 "llama3" rope_scaling: affects frequencies at every
+    # position, so silently ignoring it would convert a checkpoint into one
+    # that produces wrong logits everywhere. Unsupported types fail loudly.
+    rs = getattr(hf_cfg, "rope_scaling", None) or {}
+    rs_type = rs.get("rope_type", rs.get("type")) if isinstance(rs, dict) else None
+    rope_kw = {}
+    if rs_type in (None, "default"):
+        pass
+    elif rs_type == "llama3":
+        rope_kw = dict(
+            rope_scaling="llama3",
+            rope_scaling_factor=float(rs.get("factor", 8.0)),
+            rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            rope_original_max_len=int(
+                rs.get("original_max_position_embeddings", 8192)
+            ),
+        )
+    else:
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_type!r} (supported: llama3)"
+        )
     return ModelConfig(
         name=name,
         arch="llama",
@@ -86,6 +108,7 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         max_seq_len=hf_cfg.max_position_embeddings,
         norm_eps=hf_cfg.rms_norm_eps,
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        **rope_kw,
         # Mistral-style sliding window (HF: None/absent = full causal)
         attn_window=window,
         # Qwen2-style q/k/v biases: Qwen2 has them unconditionally; Llama

@@ -256,7 +256,14 @@ def forward_layers(
     T = x.shape[1]
     S = cache["k"].shape[3]
     positions = pos + jnp.arange(T, dtype=jnp.int32)
-    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_cos_sin(
+        positions, cfg.head_dim, cfg.rope_theta,
+        scaling=cfg.rope_scaling,
+        scaling_factor=cfg.rope_scaling_factor,
+        low_freq_factor=cfg.rope_low_freq_factor,
+        high_freq_factor=cfg.rope_high_freq_factor,
+        original_max_len=cfg.rope_original_max_len,
+    )
     if valid_start is None:
         mask = causal_mask(pos, T, S, cfg.attn_window)
     else:

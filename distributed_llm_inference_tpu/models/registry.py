@@ -51,6 +51,27 @@ register(ModelConfig(
     n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
     rope_theta=500000.0, eos_token_id=128001, bos_token_id=128000,
 ))
+# Llama-3.1/3.2: "llama3" rope_scaling stretches the 8192-token training
+# context to the checkpoints' 131072 max positions; the engine's
+# EngineConfig.max_seq_len still bounds the actual KV-cache allocation.
+register(ModelConfig(
+    name="llama3.1-8b", arch="llama", vocab_size=128256, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336, max_seq_len=131072,
+    rope_theta=500000.0, rope_scaling="llama3", rope_scaling_factor=8.0,
+    eos_token_id=128001, bos_token_id=128000,
+))
+register(ModelConfig(
+    name="llama3.2-1b", arch="llama", vocab_size=128256, dim=2048,
+    n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192, max_seq_len=131072,
+    rope_theta=500000.0, rope_scaling="llama3", rope_scaling_factor=32.0,
+    tie_embeddings=True, eos_token_id=128001, bos_token_id=128000,
+))
+register(ModelConfig(
+    name="llama3.2-3b", arch="llama", vocab_size=128256, dim=3072,
+    n_layers=28, n_heads=24, n_kv_heads=8, ffn_dim=8192, max_seq_len=131072,
+    rope_theta=500000.0, rope_scaling="llama3", rope_scaling_factor=32.0,
+    tie_embeddings=True, eos_token_id=128001, bos_token_id=128000,
+))
 
 # --- Mistral family (llama arch + sliding-window attention) ---------------
 register(ModelConfig(

@@ -14,17 +14,59 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def llama3_scaled_inv_freq(
+    inv_freq: jnp.ndarray,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_len: int,
+) -> jnp.ndarray:
+    """Llama-3.1/3.2 "llama3" rope_scaling applied to the inverse frequencies.
+
+    Matches transformers' `_compute_llama3_parameters`: wavelengths longer
+    than original_max_len/low_freq_factor are slowed by `factor`, wavelengths
+    shorter than original_max_len/high_freq_factor are kept, and the band in
+    between interpolates smoothly. HF applies this unconditionally (not only
+    past the original context), so parity requires it at every position.
+    """
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_freq_wavelen = original_max_len / low_freq_factor
+    high_freq_wavelen = original_max_len / high_freq_factor
+    smooth = (original_max_len / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_freq_wavelen, inv_freq / factor, smoothed)
+    return jnp.where(wavelen < high_freq_wavelen, inv_freq, scaled)
+
+
 def rope_cos_sin(
-    positions: jnp.ndarray, head_dim: int, theta: float = 10000.0
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float = 10000.0,
+    *,
+    scaling: str | None = None,
+    scaling_factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_len: int = 8192,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for integer positions.
 
     positions: [...] int array. Returns (cos, sin), each [..., head_dim],
     computed in float32 (HF computes RoPE tables in fp32 even for bf16 models).
+    scaling="llama3" reproduces Llama-3.1/3.2 frequency scaling.
     """
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling == "llama3":
+        inv_freq = llama3_scaled_inv_freq(
+            inv_freq, scaling_factor, low_freq_factor, high_freq_factor,
+            original_max_len,
+        )
+    elif scaling is not None:
+        raise ValueError(f"unsupported rope scaling {scaling!r}")
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., head_dim/2]
     angles = jnp.concatenate([angles, angles], axis=-1)  # [..., head_dim]
     return jnp.cos(angles), jnp.sin(angles)

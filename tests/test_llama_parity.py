@@ -58,6 +58,64 @@ def test_logits_match_hf(n_kv_heads):
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_llama3_rope_scaling_logits_match_hf():
+    """Llama-3.1/3.2 checkpoints ship "llama3" rope_scaling that HF applies
+    to the RoPE frequencies at EVERY position; the converter must pick it up
+    and the model must reproduce it or real 3.2 weights decode garbage."""
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=500000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 32.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 64,
+        },
+        tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(cfg_hf)
+    hf.eval()
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    assert cfg.rope_scaling == "llama3" and cfg.rope_scaling_factor == 32.0
+    assert cfg.rope_original_max_len == 64 and cfg.tie_embeddings
+
+    rng = np.random.default_rng(7)
+    # long enough that positions span all three scaling bands of the
+    # original_max_position_embeddings=64 wavelength cutoffs
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 96), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=1, max_seq=128)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_rope_scaling_rejected():
+    """Non-llama3 scaling types must fail loudly at conversion, not silently
+    produce a model with wrong frequencies."""
+    from distributed_llm_inference_tpu.models.convert import config_from_hf
+
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+    )
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(cfg_hf)
+
+
 def test_qwen2_logits_match_hf():
     """Qwen2 family = llama arch + q/k/v biases + tied option; parity vs a
     tiny-random HF Qwen2ForCausalLM validates the bias path end to end."""
