@@ -1,0 +1,400 @@
+"""Continuous (in-flight) batching: slot-based decode with mid-flight admission.
+
+The serving ladder so far:
+  * reference: one request at a time, batch dim hardcoded to 1
+    (/root/reference/orchestration.py:98,144);
+  * serving/queue.py: dispatch-time coalescing — a burst becomes one ragged
+    fleet, but the fleet drains to completion before the next group starts,
+    so a long generation head-of-line blocks everything behind it.
+
+Here a fixed fleet of `n_slots` KV-cache rows decodes in lock-step
+(engine/generate.py decode_slots — per-row positions, per-slot sampling
+params), and a new request is admitted the moment any slot frees: its
+prompt prefills on a batch=1 scratch cache (reusing the engine's bucketed /
+chunked prefill machinery) and splices into the free row (insert_slot)
+while the other slots keep decoding. Decode runs in chunks of `chunk_steps`
+with ONE device->host fetch per chunk, and the next chunk is launched
+BEFORE the previous chunk's tokens are fetched (lag-1 pipelining), so the
+TPU queue never drains on host round-trips — on the tunneled single-chip
+setup the fetch RTT fully overlaps compute.
+
+Attribution discipline: each launched chunk snapshots the slot->request
+assignment. A chunk in flight when a slot is freed and re-admitted would
+otherwise credit the old tenant's (masked, pad) emissions to the new one.
+
+Single-device llama-family only: slots mode needs raw params (a plain jit,
+not the pipeline's shard_map) and relative positions. Seeded / debug /
+speculative requests fall back to the solo engine — their contracts
+(deterministic RNG stream, single-stream prefill logits, draft verification)
+are per-request, not per-fleet.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import get_logger
+from . import generate as G
+from .chat import format_chat_prompt
+
+log = get_logger("continuous")
+
+
+class _Request:
+    __slots__ = (
+        "prompt", "kwargs", "done", "result", "t_start", "ttft",
+        "first_id", "tokens", "slot", "enqueued", "budget",
+    )
+
+    def __init__(self, prompt: str, kwargs: dict):
+        self.prompt = prompt
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result: Optional[dict] = None
+        self.enqueued = time.time()
+        self.t_start = self.enqueued
+        self.ttft: float = 0.0
+        self.first_id: Optional[int] = None
+        self.tokens: list[int] = []
+        self.slot: Optional[int] = None
+        self.budget: int = 0
+
+
+class ContinuousEngine:
+    """In-flight batching front end over an InferenceEngine's model/backend.
+
+    submit() blocks until the request's envelope is ready (same response
+    schema as InferenceEngine.generate, plus "continuous": true and the
+    admission depth it shared the fleet with).
+    """
+
+    def __init__(
+        self,
+        engine: Any,
+        n_slots: int = 8,
+        chunk_steps: int = 16,
+        max_queue: int = 64,
+    ):
+        cfg = engine.cfg
+        if cfg.arch != "llama":
+            raise ValueError(
+                f"continuous batching is llama-family only (per-row positions "
+                f"need relative RoPE); model arch is {cfg.arch!r}"
+            )
+        if not getattr(engine.backend, "supports_slots", False):
+            raise ValueError(
+                f"backend {engine.backend.name!r} does not support slot "
+                f"decode; continuous batching needs the single-device backend"
+            )
+        self.engine = engine
+        self.cfg = cfg
+        self.backend = engine.backend
+        self.n_slots = int(n_slots)
+        self.chunk_steps = int(chunk_steps)
+        self.max_queue = int(max_queue)
+
+        self.cache = self.backend.init_cache(self.n_slots, cfg.max_seq_len)
+        self.state, self.sparams = G.init_slots(self.n_slots)
+        self._scratch = self.backend.init_cache(1, cfg.max_seq_len)
+        self._assignment: list[Optional[_Request]] = [None] * self.n_slots
+
+        self._cv = threading.Condition()
+        self._queue: list[_Request] = []
+        self._closed = False
+        self._key = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
+        # observability
+        self.admitted = 0
+        self.completed = 0
+        self.peak_occupancy = 0
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="continuous-engine"
+        )
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt: str, **kwargs) -> dict:
+        # contracts slots cannot honor run solo on the wrapped engine
+        if (
+            kwargs.get("seed") is not None
+            or kwargs.get("debug")
+            or kwargs.get("speculative")
+        ):
+            return self.engine.generate(prompt, **kwargs)
+        req = _Request(prompt, kwargs)
+        with self._cv:
+            if self._closed:
+                return {
+                    "error": "Error: server shutting down", "status": "failed",
+                    "error_type": "overloaded",
+                }
+            if len(self._queue) >= self.max_queue:
+                log.warning("queue_full", depth=len(self._queue))
+                return {
+                    "error": f"Error: request queue full ({self.max_queue})",
+                    "status": "failed",
+                    "error_type": "overloaded",
+                }
+            self._queue.append(req)
+            self._cv.notify_all()
+        req.done.wait()
+        return req.result
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+        fail = {
+            "error": "Error: server shutting down", "status": "failed",
+            "error_type": "overloaded",
+        }
+        with self._cv:
+            pending = self._queue[:]
+            self._queue.clear()
+        for req in pending + [r for r in self._assignment if r is not None]:
+            if req.result is None:
+                req.result = dict(fail)
+            req.done.set()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "slots": self.n_slots,
+                "occupied": sum(r is not None for r in self._assignment),
+                "queued": len(self._queue),
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "peak_occupancy": self.peak_occupancy,
+                "chunk_steps": self.chunk_steps,
+            }
+
+    # -- worker thread -------------------------------------------------------
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except Exception as e:  # noqa: BLE001 - a dead worker must not hang clients
+            log.error("continuous_loop_died", exc_info=True, error=str(e))
+            fail = {"error": f"Error: {e}", "status": "failed"}
+            with self._cv:
+                self._closed = True
+                pending = self._queue[:]
+                self._queue.clear()
+                running = [r for r in self._assignment if r is not None]
+                self._assignment = [None] * self.n_slots
+            for req in pending + running:
+                if req.result is None:
+                    req.result = dict(fail)
+                req.done.set()
+
+    def _loop_inner(self):
+        prev = None  # (packed chunk results dev array, assignment snapshot)
+        while True:
+            with self._cv:
+                while (
+                    not self._queue
+                    and not any(self._assignment)
+                    and prev is None
+                    and not self._closed
+                ):
+                    self._cv.wait()
+                if self._closed:
+                    return
+                queue_head = bool(self._queue)
+            if queue_head:
+                self._admit()
+            cur = None
+            if any(r is not None for r in self._assignment):
+                emitted, mask, self.state, self.cache = G.decode_slots(
+                    self.cfg, self.backend.params, self.state, self.cache,
+                    self._next_key(), self.sparams,
+                    num_steps=self.chunk_steps,
+                )
+                packed = G.pack_chunk(emitted, mask, self.state.active)
+                cur = (packed, list(self._assignment))
+            if prev is not None:
+                self._process(prev)
+            prev = cur
+
+    def _admit(self):
+        """Prefill + splice every queued request a free slot can take.
+
+        The whole admission wave's first tokens come back in ONE stacked
+        fetch at the end (the EOS/budget decision already happened on
+        device inside insert_slot) — per-request blocking fetches would pay
+        the tunnel RTT once per admission.
+        """
+        wave = []  # (req, first_dev [1]) admitted this round
+        while True:
+            with self._cv:
+                if not self._queue:
+                    break
+                free = [b for b, r in enumerate(self._assignment) if r is None]
+                if not free:
+                    break
+                req = self._queue.pop(0)
+            try:
+                first_dev = self._admit_one(req, free[0])
+                if first_dev is not None:  # None: failed fast (e.g. queued
+                    wave.append((req, first_dev))  # past deadline), result set
+            except ValueError as e:
+                log.warning("invalid_request", error=str(e))
+                req.result = {
+                    "error": f"Error: {e}", "status": "failed",
+                    "error_type": "invalid_request",
+                }
+                req.done.set()
+            except Exception as e:  # noqa: BLE001 - must unblock the caller
+                log.error("admit_failed", exc_info=True, error=str(e))
+                req.result = {"error": f"Error: {e}", "status": "failed"}
+                req.done.set()
+        if not wave:
+            return
+        firsts = np.asarray(jnp.concatenate([f for _, f in wave]))
+        now = time.time()
+        for (req, _), first_id in zip(wave, firsts):
+            req.first_id = int(first_id)
+            req.ttft = now - req.t_start
+            # mirror insert_slot's on-device budget: EOS-first or a
+            # one-token cap means the slot was armed inactive
+            if req.first_id == self.cfg.eos_token_id or req.budget == 0:
+                self._finalize(req)
+
+    def _admit_one(self, req: _Request, slot: int):
+        eng, cfg = self.engine, self.cfg
+        deadline = eng.engine_cfg.request_deadline_s
+        if deadline and time.time() - req.enqueued > deadline:
+            req.result = {
+                "error": f"Error: request exceeded the {deadline:g}s deadline "
+                "while queued",
+                "status": "failed",
+                "error_type": "timeout",
+            }
+            req.done.set()
+            return
+        k = req.kwargs
+        text = (
+            format_chat_prompt(req.prompt, arch=cfg.arch)
+            if k.get("chat", True) else req.prompt
+        )
+        ids = eng.tokenizer.encode(text)
+        prompt_len = len(ids)
+        plan = eng._plan_ingest(prompt_len, 0, eng._buckets())
+        if plan is None:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the serving capacity "
+                f"(max_seq_len {cfg.max_seq_len})"
+            )
+        max_tokens, _ = eng._clamp_decode(prompt_len, int(k.get("max_tokens", 20)))
+        sampling = G.default_sampling(
+            k.get("temperature", 0.7), k.get("top_k", 50),
+            k.get("top_p", 0.9), k.get("greedy", False),
+        )
+        key = self._next_key()
+        scratch = self._scratch
+        self._scratch = None
+        try:
+            # shared ingest sequence (engine/engine.py): extend chunks +
+            # final bucket-padded prefill — same machinery as the solo path
+            first, _, scratch = eng._ingest(ids, 0, plan, scratch, key, sampling)
+            # prefill token is emitted token #0 (unless EOS — break-before-
+            # append); the EOS check happens inside insert_slot on device
+            req.budget = max_tokens - 1
+            self.cache, self.state, self.sparams = G.insert_slot(
+                self.cache, scratch, self.state, self.sparams, slot,
+                first[0], jnp.int32(prompt_len), jnp.int32(max_tokens),
+                jnp.int32(cfg.eos_token_id),
+                sampling.temperature, sampling.top_k, sampling.top_p,
+                sampling.greedy,
+            )
+            self._scratch = scratch
+        finally:
+            if self._scratch is None:
+                # a failed extend/prefill may have consumed (donated) the
+                # scratch buffer mid-sequence; a permanently-None scratch
+                # would fail every later admission — reallocate
+                self._scratch = self.backend.init_cache(1, cfg.max_seq_len)
+        req.slot = slot
+        with self._cv:
+            self._assignment[slot] = req
+            self.admitted += 1
+            eng.request_count += 1
+            occ = sum(r is not None for r in self._assignment)
+            self.peak_occupancy = max(self.peak_occupancy, occ)
+        log.info(
+            "admitted", slot=slot, prompt_len=prompt_len,
+            budget=req.budget, occupancy=occ,
+        )
+        return first  # [1] device array; the wave fetches these together
+
+    def _process(self, chunk):
+        """Fetch one decode chunk's packed results and distribute/finalize."""
+        packed_dev, snapshot = chunk
+        packed = np.asarray(packed_dev)  # [2K+1, B] — the ONE fetch per chunk
+        K = self.chunk_steps
+        emitted = packed[:K]
+        mask = packed[K : 2 * K].astype(bool)
+        active = packed[2 * K].astype(bool)
+        deadline = self.engine.engine_cfg.request_deadline_s
+        now = time.time()
+        for b, req in enumerate(snapshot):
+            if req is None or req.done.is_set():
+                continue  # freed/killed tenant's masked leftovers
+            req.tokens.extend(int(t) for t in emitted[mask[:, b], b])
+            if self._assignment[b] is req and not active[b]:
+                self._finalize(req)
+            elif deadline and now - req.t_start > deadline:
+                # in-flight overrun: kill the slot, fail the request; the
+                # fleet keeps decoding for everyone else
+                self.state = G.kill_slot(self.state, b)
+                log.error("request_deadline_exceeded", slot=b, deadline_s=deadline)
+                req.result = {
+                    "error": f"Error: request exceeded the {deadline:g}s deadline",
+                    "status": "failed",
+                    "error_type": "timeout",
+                }
+                self._release(req)
+
+    def _finalize(self, req: _Request):
+        cfg = self.cfg
+        gen_ids = (
+            [req.first_id] if req.first_id != cfg.eos_token_id else []
+        ) + req.tokens
+        response = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
+        elapsed = time.time() - req.t_start
+        n = len(gen_ids)
+        tps = n / elapsed if elapsed > 0 else 0.0
+        self.engine._record_sample(req.ttft, tps, n)
+        req.result = {
+            "prompt": req.prompt,
+            "response": response,
+            "status": "success",
+            "time_taken": f"{elapsed:.2f}s",
+            "tokens_generated": n,
+            "tokens_per_sec": f"{tps:.2f}",
+            "ttft_s": round(req.ttft, 4),
+            "backend": "continuous",
+            "continuous": True,
+        }
+        log.info(
+            "completed", slot=req.slot, tokens=n, elapsed_s=round(elapsed, 3),
+            tokens_per_sec=round(tps, 2),
+        )
+        self._release(req)
+
+    def _release(self, req: _Request):
+        with self._cv:
+            if req.slot is not None and self._assignment[req.slot] is req:
+                self._assignment[req.slot] = None
+            self.completed += 1
+            self._cv.notify_all()
+        req.done.set()

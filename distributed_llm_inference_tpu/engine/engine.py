@@ -92,6 +92,10 @@ class SingleDeviceBackend:
 
     # greedy prompt-lookup speculative decode (engine opts in per request)
     supports_speculative = True
+    # slot decode for continuous batching (engine/continuous.py): needs raw
+    # params under a plain jit — the SPMD backends' shard_map programs
+    # can't host the per-row-position fleet
+    supports_slots = True
 
     def decode_speculative(self, first_token, cache, hist, hist_len, limit,
                            *, max_steps, draft_len):
@@ -333,6 +337,33 @@ class InferenceEngine:
             return None
         return n_full, rem, fitting[0], chunk
 
+    def _ingest(self, ids, p0, plan, cache, key, sampling):
+        """Feed ids[p0:] into `cache` per a `_plan_ingest` plan: n_full
+        full-chunk extend() calls, then the final bucket-padded sampling
+        chunk (prefill at offset 0, prefill_at otherwise). Shared by the
+        solo engine and the continuous engine's admission path — one copy
+        of the ingest sequence to fix. Returns (first, logits, cache)."""
+        n_full, rem, bucket, chunk = plan
+        pad = self.cfg.pad_token_id
+        for c in range(n_full):
+            chunk_tokens = jnp.asarray(
+                [ids[p0 + c * chunk : p0 + (c + 1) * chunk]], jnp.int32
+            )
+            cache = self.backend.extend(
+                chunk_tokens, jnp.int32(p0 + c * chunk), cache
+            )
+        tail_start = p0 + n_full * chunk
+        tokens = jnp.asarray(
+            [ids[tail_start:] + [pad] * (bucket - rem)], jnp.int32
+        )
+        if tail_start == 0:
+            return self.backend.prefill(
+                tokens, jnp.int32(len(ids)), cache, key, sampling
+            )
+        return self.backend.prefill_at(
+            tokens, jnp.int32(tail_start), jnp.int32(rem), cache, key, sampling
+        )
+
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False,
@@ -397,7 +428,6 @@ class InferenceEngine:
             headroom=SPEC_DRAFT_LEN if use_spec else 0,
         )
 
-        pad = cfg.pad_token_id
         sampling = G.default_sampling(temperature, top_k, top_p, greedy)
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
@@ -406,25 +436,9 @@ class InferenceEngine:
         self._cache = None  # donated below; restored from the decode result
         if entry is not None:
             cache = self._prefix.splice(entry, cache, p0)
-        for c in range(n_full):
-            chunk_tokens = jnp.asarray(
-                [ids[p0 + c * chunk : p0 + (c + 1) * chunk]], jnp.int32
-            )
-            cache = self.backend.extend(
-                chunk_tokens, jnp.int32(p0 + c * chunk), cache
-            )
-        tail_start = p0 + n_full * chunk
-        tail = ids[tail_start:]
-        tokens = jnp.asarray([tail + [pad] * (bucket - rem)], jnp.int32)
-        if tail_start == 0:
-            first, logits, cache = self.backend.prefill(
-                tokens, jnp.int32(prompt_len), cache, key_pre, sampling
-            )
-        else:
-            first, logits, cache = self.backend.prefill_at(
-                tokens, jnp.int32(tail_start), jnp.int32(rem), cache,
-                key_pre, sampling,
-            )
+        first, logits, cache = self._ingest(
+            ids, p0, plan, cache, key_pre, sampling
+        )
         if self._prefix is not None:
             self._prefix.store(ids, prompt_len, cache)
         first = jax.block_until_ready(first)

@@ -174,6 +174,201 @@ def decode(
     return out, n_gen, cache
 
 
+# -- continuous batching (slot decode) ---------------------------------------
+#
+# JetStream-style in-flight batching: a fixed fleet of B cache slots decodes
+# in lock-step, and new requests join a FREE slot mid-flight (prefilled on a
+# scratch cache, spliced in) instead of waiting for the whole batch to
+# finish. Each slot row sits at its own sequence position, so the forward
+# runs with a per-row `pos` vector (models/llama.forward_layers slots mode).
+# The reference serves strictly one request at a time
+# (/root/reference/orchestration.py:98,144); dispatch-time coalescing
+# (serving/queue.py) batches a burst but still drains it to completion —
+# this removes that head-of-line blocking.
+
+
+class SlotParams(NamedTuple):
+    """Per-slot sampling knobs, all [B]-shaped (broadcast row-wise through
+    sample_token, so slots with different temperatures/top-k/top-p/greedy
+    decode together in one program)."""
+
+    temperature: jnp.ndarray  # f32 [B]
+    top_k: jnp.ndarray  # i32 [B]
+    top_p: jnp.ndarray  # f32 [B]
+    greedy: jnp.ndarray  # bool [B]
+
+
+class SlotState(NamedTuple):
+    """Device-side per-slot decode state.
+
+    token: last emitted token (its K/V not yet written); pad when inactive.
+    pos: cache position where `token`'s K/V lands on the next forward —
+         exactly plain decode's start_pos contract.
+    active: slot is mid-generation.
+    remaining: tokens this slot may still emit (admission sets
+         max_tokens - 1: the prefill token was #0, like decode's limit).
+    """
+
+    token: jnp.ndarray  # i32 [B]
+    pos: jnp.ndarray  # i32 [B]
+    active: jnp.ndarray  # bool [B]
+    remaining: jnp.ndarray  # i32 [B]
+
+
+def init_slots(n_slots: int) -> tuple[SlotState, SlotParams]:
+    z = jnp.zeros((n_slots,), jnp.int32)
+    return (
+        SlotState(z, z, jnp.zeros((n_slots,), bool), z),
+        SlotParams(
+            jnp.ones((n_slots,), jnp.float32),
+            z,
+            jnp.ones((n_slots,), jnp.float32),
+            jnp.ones((n_slots,), bool),
+        ),
+    )
+
+
+# NOTE: only `cache` is donated in the slot programs. The host keeps live
+# references into the returned SlotState across chunk launches (lag-1
+# pipelining reads state.active from the PREVIOUS chunk after the next one
+# has been launched) — donating state would invalidate those buffers. The
+# state arrays are a few hundred bytes; the cache is the only buffer worth
+# updating in place.
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_steps"), donate_argnames=("cache",)
+)
+def decode_slots(
+    cfg: ModelConfig,
+    params,
+    state: SlotState,
+    cache,
+    key,
+    sparams: SlotParams,
+    *,
+    num_steps: int,
+):
+    """Advance every slot `num_steps` tokens (inactive slots ride along,
+    masked). One compiled program per (n_slots, num_steps).
+
+    Inactive rows still forward their pad token and write K/V at their
+    (frozen) pos — garbage confined to their own cache row, overwritten
+    before it can ever be attended (write-then-attend ordering inside the
+    layer), exactly the padded-prefill argument. Gating them out would save
+    nothing: the batch dimension is fixed.
+
+    Returns (emitted [num_steps, B], emit_mask [num_steps, B] bool — True
+    where a real token was emitted, the host's only token-vs-pad oracle —
+    state, cache).
+    """
+    pad = jnp.int32(cfg.pad_token_id)
+    eos = jnp.int32(cfg.eos_token_id)
+
+    def body(carry, sub):
+        state, cache = carry
+        logits, cache = _forward_step(
+            cfg, params, state.token[:, None], cache, state.pos
+        )
+        nxt = sample_token(
+            sub,
+            logits,
+            sparams.temperature[:, None],
+            sparams.top_k[:, None],
+            sparams.top_p[:, None],
+            sparams.greedy,
+        )
+        # break-before-append EOS semantics (orchestration.py:181-186)
+        can_emit = state.active & (nxt != eos) & (state.remaining > 0)
+        emit = jnp.where(can_emit, nxt, pad)
+        new = SlotState(
+            token=jnp.where(can_emit, nxt, pad),
+            pos=state.pos + state.active.astype(jnp.int32),
+            active=can_emit & (state.remaining > 1),
+            remaining=state.remaining - can_emit.astype(jnp.int32),
+        )
+        return (new, cache), (emit, can_emit)
+
+    subs = jax.random.split(key, num_steps)
+    (state, cache), (emitted, emit_mask) = jax.lax.scan(
+        body, (state, cache), subs
+    )
+    return emitted, emit_mask, state, cache
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def insert_slot(
+    cache,
+    scratch,
+    state: SlotState,
+    sparams: SlotParams,
+    slot,
+    first_token,
+    prompt_len,
+    max_tokens,
+    eos_id,
+    temperature,
+    top_k,
+    top_p,
+    greedy,
+):
+    """Splice a freshly prefilled scratch cache (batch=1, same max_seq) into
+    slot row `slot` and arm its state. The whole scratch row is copied —
+    one compiled program for every prompt length; the copy is one
+    HBM-contiguous row (~tens of MB, microseconds at HBM bandwidth) and
+    stale high positions are never attended.
+
+    The decode budget (max_tokens - 1: the prefill token is emitted token
+    #0) and the EOS-on-first check are computed ON DEVICE, so admission
+    never blocks on fetching the first token — the host batches those
+    fetches across a whole admission wave (one round trip, not one per
+    request; the tunnel RTT dominates the loop otherwise).
+    """
+    slot = jnp.int32(slot)
+    budget = jnp.where(
+        first_token == eos_id, jnp.int32(0), jnp.maximum(max_tokens - 1, 0)
+    )
+
+    def splice(big, small):
+        start = (jnp.int32(0), slot) + (jnp.int32(0),) * (big.ndim - 2)
+        return jax.lax.dynamic_update_slice(big, small, start)
+
+    cache = jax.tree.map(splice, cache, scratch)
+    state = SlotState(
+        token=state.token.at[slot].set(first_token),
+        pos=state.pos.at[slot].set(prompt_len),
+        active=state.active.at[slot].set(budget > 0),
+        remaining=state.remaining.at[slot].set(budget),
+    )
+    sparams = SlotParams(
+        temperature=sparams.temperature.at[slot].set(temperature),
+        top_k=sparams.top_k.at[slot].set(top_k),
+        top_p=sparams.top_p.at[slot].set(top_p),
+        greedy=sparams.greedy.at[slot].set(greedy),
+    )
+    return cache, state, sparams
+
+
+@jax.jit
+def kill_slot(state: SlotState, slot):
+    """Force-deactivate a slot (per-request deadline overrun)."""
+    return state._replace(active=state.active.at[jnp.int32(slot)].set(False))
+
+
+@jax.jit
+def pack_chunk(emitted, emit_mask, active):
+    """Pack one decode chunk's host-bound results into a single int32 array
+    [2K+1, B] (emitted / mask / final active), so the per-chunk
+    device->host cost is ONE transfer — on a tunneled backend each fetch
+    pays the full RTT, which would otherwise triple the loop's overhead."""
+    return jnp.concatenate(
+        [
+            emitted,
+            emit_mask.astype(jnp.int32),
+            active.astype(jnp.int32)[None, :],
+        ],
+        axis=0,
+    )
+
+
 def pick_bucket(buckets: tuple, n: int) -> int:
     """Smallest bucket >= n (compile-once-per-bucket shape discipline)."""
     for b in buckets:

@@ -33,7 +33,14 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..ops.attention import attend, causal_mask, ragged_causal_mask, update_kv_cache
+from ..ops.attention import (
+    attend,
+    causal_mask,
+    ragged_causal_mask,
+    slot_causal_mask,
+    update_kv_cache,
+    update_kv_cache_slots,
+)
 from ..ops.flash_attention import flash_attend
 from ..ops.norms import rms_norm
 from ..ops.quant import matmul as mm
@@ -110,7 +117,15 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     topology without forking the block: parallel/context.py substitutes
     ring attention (prefill) and context-parallel merge (decode) here.
     Returns (attn [B,T,H,Dh], cache_k, cache_v).
+
+    pos may be a PER-ROW [B] vector (continuous batching: each slot at its
+    own position) — the cache write becomes a vmapped per-row update and
+    attention uses the XLA path (the Pallas kernel's grid offsets assume a
+    shared scalar position).
     """
+    if pos.ndim == 1:
+        new_k, new_v = update_kv_cache_slots(cache_k, cache_v, k, v, pos)
+        return attend(q, new_k, new_v, mask), new_k, new_v
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     if cfg.attn_impl == "pallas":
         attn = flash_attend(
@@ -248,14 +263,20 @@ def forward_layers(
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
 
-    x: [B, T, D]; cache k/v: [L_slice, B, KV, S, Dh]; pos: scalar int32.
+    x: [B, T, D]; cache k/v: [L_slice, B, KV, S, Dh]; pos: scalar int32 OR
+    a per-row [B] int32 vector (continuous batching — each slot row at its
+    own sequence position; RoPE tables and the causal mask go per-row).
     Returns (x, new_cache). attn_hook: see decoder_layer.
     valid_start: optional [B] int32 — first REAL slot per row for ragged
     left-padded batches (slots before it are pad and never attended).
     """
     T = x.shape[1]
     S = cache["k"].shape[3]
-    positions = pos + jnp.arange(T, dtype=jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 1:
+        positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+    else:
+        positions = pos + jnp.arange(T, dtype=jnp.int32)
     cos, sin = rope_cos_sin(
         positions, cfg.head_dim, cfg.rope_theta,
         scaling=cfg.rope_scaling,
@@ -264,7 +285,9 @@ def forward_layers(
         high_freq_factor=cfg.rope_high_freq_factor,
         original_max_len=cfg.rope_original_max_len,
     )
-    if valid_start is None:
+    if pos.ndim == 1:
+        mask = slot_causal_mask(pos, T, S, cfg.attn_window)
+    elif valid_start is None:
         mask = causal_mask(pos, T, S, cfg.attn_window)
     else:
         mask = ragged_causal_mask(pos, T, S, valid_start, cfg.attn_window)

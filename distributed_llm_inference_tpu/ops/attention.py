@@ -79,6 +79,49 @@ def causal_mask(
     return mask
 
 
+def slot_causal_mask(
+    pos: jnp.ndarray, chunk_len: int, max_seq: int, window=None
+) -> jnp.ndarray:
+    """[B, T, S] mask for PER-ROW query offsets (continuous batching).
+
+    Each slot row b decodes at its own absolute position pos[b]+t — slots
+    admitted at different times have different lengths, so there is no
+    shared position frame to left-pad into. Row b's query at pos[b]+t may
+    attend cache slots 0..pos[b]+t; stale K/V beyond a slot's position
+    (from a longer previous tenant) sits strictly above it and is never
+    attended before decode overwrites it — the same argument as padded
+    prefill.
+    """
+    q_pos = pos[:, None] + jnp.arange(chunk_len, dtype=jnp.int32)[None, :]  # [B, T]
+    kv_pos = jnp.arange(max_seq, dtype=jnp.int32)  # [S]
+    mask = kv_pos[None, None, :] <= q_pos[:, :, None]
+    if window is not None:
+        mask &= kv_pos[None, None, :] > q_pos[:, :, None] - window
+    return mask
+
+
+def update_kv_cache_slots(
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    pos: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row cache write at per-row offsets pos [B] (continuous batching:
+    every slot is at its own sequence position). vmapped
+    `dynamic_update_slice` over the batch axis — same clamp caveat as
+    `update_kv_cache`, enforced per slot by the continuous engine."""
+    k_new = k_new.transpose(0, 2, 1, 3)  # [B, KV, T, Dh]
+    v_new = v_new.transpose(0, 2, 1, 3)
+
+    def row(ck, kn, p):
+        return jax.lax.dynamic_update_slice(ck, kn, (jnp.int32(0), p, jnp.int32(0)))
+
+    cache_k = jax.vmap(row)(cache_k, k_new, pos)
+    cache_v = jax.vmap(row)(cache_v, v_new, pos)
+    return cache_k, cache_v
+
+
 def ragged_causal_mask(
     pos: jnp.ndarray, chunk_len: int, max_seq: int, valid_start: jnp.ndarray,
     window=None,

@@ -130,7 +130,7 @@ class _Profiler:
 
 
 def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
-                 queue=None):
+                 queue=None, continuous=None):
     profiler = profiler or _Profiler()
 
     class Handler(BaseHTTPRequestHandler):
@@ -183,7 +183,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 results["detail"] = stages
                 self._send(200, results)
             elif path == "/stats":
-                self._send(200, engine.stats())
+                s = engine.stats()
+                if continuous is not None:
+                    s["continuous"] = continuous.stats()
+                self._send(200, s)
             else:
                 self._send(404, {"error": f"no route {path}"})
 
@@ -254,7 +257,13 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     kwargs["speculative"] = _parse_bool(
                         data.get("speculative", False), "speculative"
                     )
-                    if queue is not None:
+                    if continuous is not None:
+                        # in-flight batching (engine/continuous.py): joins a
+                        # free KV slot mid-decode; bounded admission queue
+                        # sheds with 429; seeded/debug/speculative requests
+                        # fall back to the solo engine inside submit()
+                        result = continuous.submit(prompt, **kwargs)
+                    elif queue is not None:
                         # bounded backpressure + concurrent-singles
                         # coalescing (serving/queue.py); full -> 429
                         result = queue.submit(prompt, **kwargs)
@@ -287,11 +296,14 @@ class InferenceServer:
     tests, serve_forever() for the CLI."""
 
     def __init__(self, engine, host: str = "0.0.0.0", port: int = 5000,
-                 max_tokens_cap: int = 30, queue=None):
+                 max_tokens_cap: int = 30, queue=None, continuous=None):
         self.engine = engine
         self.queue = queue
+        self.continuous = continuous
         self.httpd = ThreadingHTTPServer(
-            (host, port), make_handler(engine, max_tokens_cap, queue=queue)
+            (host, port),
+            make_handler(engine, max_tokens_cap, queue=queue,
+                         continuous=continuous),
         )
         self.port = self.httpd.server_address[1]
 
@@ -316,6 +328,8 @@ class InferenceServer:
         self.httpd.server_close()
         if self.queue is not None:
             self.queue.close()
+        if self.continuous is not None:
+            self.continuous.close()
 
 
 def main(argv: Optional[list] = None):
@@ -357,6 +371,17 @@ def main(argv: Optional[list] = None):
     ap.add_argument(
         "--queue-wait-ms", type=float, default=5.0,
         help="coalescing window before a fleet is cut",
+    )
+    ap.add_argument(
+        "--continuous", type=int, default=0, metavar="SLOTS",
+        help="continuous (in-flight) batching: a fleet of SLOTS KV-cache "
+             "rows decodes in lock-step and new requests join free slots "
+             "mid-flight (single-device llama family; 0 = disabled; "
+             "mutually exclusive with --queue)",
+    )
+    ap.add_argument(
+        "--continuous-chunk", type=int, default=16,
+        help="decode steps per device round-trip in continuous mode",
     )
     ap.add_argument(
         "--prefix-cache", type=int, default=0, metavar="N",
@@ -414,7 +439,19 @@ def main(argv: Optional[list] = None):
             ) from e
         print(f"✅ warm: {stats['programs']} programs in {stats['seconds']}s")
     queue = None
-    if args.queue > 0:
+    continuous = None
+    if args.continuous > 0 and args.queue > 0:
+        raise SystemExit(
+            "--continuous and --queue are mutually exclusive: in-flight "
+            "batching already provides bounded admission + batching"
+        )
+    if args.continuous > 0:
+        from ..engine.continuous import ContinuousEngine
+
+        continuous = ContinuousEngine(
+            engine, n_slots=args.continuous, chunk_steps=args.continuous_chunk,
+        )
+    elif args.queue > 0:
         from .queue import BatchingQueue
 
         queue = BatchingQueue(
@@ -422,7 +459,8 @@ def main(argv: Optional[list] = None):
             max_wait_ms=args.queue_wait_ms,
         )
     InferenceServer(
-        engine, args.host, args.port, args.max_tokens_cap, queue=queue
+        engine, args.host, args.port, args.max_tokens_cap, queue=queue,
+        continuous=continuous,
     ).serve_forever()
 
 
