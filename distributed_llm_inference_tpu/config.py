@@ -48,6 +48,29 @@ class ModelConfig:
     # Sliding-window attention (Mistral-style): a query attends only the
     # last `attn_window` positions. None = full causal.
     attn_window: Optional[int] = None
+    # Which layers use the sliding window: "all" (Mistral) or "even"
+    # (Gemma-2: even-indexed layers slide, odd attend fully — the stacked
+    # layer params carry a per-layer window_flag so pipeline stages keep
+    # their own slice's pattern).
+    attn_window_pattern: str = "all"
+    # Gemma-family knobs (all default off => plain Llama semantics):
+    # explicit head_dim (Gemma-7B: 16 heads x 256 != dim 3072)
+    head_dim_override: Optional[int] = None
+    # RMSNorm multiplies by (1 + weight) (HF GemmaRMSNorm)
+    norm_unit_offset: bool = False
+    # MLP activation on the gate projection
+    act: str = "silu"  # "silu" | "gelu_tanh"
+    # scale embeddings by sqrt(dim) after lookup (GemmaModel normalizer)
+    embed_scale: bool = False
+    # Gemma-2 sandwich norms: post-attention and post-FFN RMSNorms applied
+    # to each branch output before its residual add
+    post_norms: bool = False
+    # Gemma-2 logit softcapping: x -> cap * tanh(x / cap)
+    attn_softcap: Optional[float] = None
+    final_softcap: Optional[float] = None
+    # Gemma-2 query_pre_attn_scalar: attention scores scale by its -0.5
+    # power instead of head_dim**-0.5 (None = head_dim**-0.5)
+    query_scale_override: Optional[float] = None
     # Biases on the q/k/v projections (Qwen2-style; llama family only —
     # gpt2 always has full biases).
     attn_qkv_bias: bool = False
@@ -73,10 +96,41 @@ class ModelConfig:
     eos_token_id: int = 2
     bos_token_id: int = 1
     pad_token_id: int = 0
+    # Additional stop tokens beyond eos_token_id (e.g. Gemma-it's
+    # <end_of_turn> id 107 — instruct checkpoints end their turn with it
+    # and rarely emit <eos> mid-chat). Every decode loop stops on any of
+    # them; the comparison unrolls statically (the tuple is tiny).
+    stop_token_ids: tuple = ()
+    # Chat prompt template (engine/chat.py): None derives from arch
+    # (llama -> "tinyllama" Zephyr format, gpt2 -> passthrough);
+    # "gemma" = <start_of_turn> turns.
+    chat_template: Optional[str] = None
 
     def __post_init__(self):
         if self.attn_impl not in ("xla", "pallas"):
             raise ValueError(f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}")
+        if self.act not in ("silu", "gelu_tanh"):
+            raise ValueError(f"act must be 'silu' or 'gelu_tanh', got {self.act!r}")
+        if self.chat_template not in (None, "tinyllama", "gemma", "none"):
+            raise ValueError(
+                f"chat_template must be None, 'tinyllama', 'gemma', or "
+                f"'none', got {self.chat_template!r}"
+            )
+        if self.attn_window_pattern not in ("all", "even"):
+            raise ValueError(
+                f"attn_window_pattern must be 'all' or 'even', got "
+                f"{self.attn_window_pattern!r}"
+            )
+        if self.attn_impl == "pallas" and (
+            self.attn_softcap is not None
+            or self.query_scale_override is not None
+            or (self.attn_window is not None and self.attn_window_pattern != "all")
+        ):
+            raise ValueError(
+                "attn_impl='pallas' does not support attention softcapping, "
+                "query-scale overrides, or per-layer window patterns "
+                "(Gemma-2); use attn_impl='xla'"
+            )
         if self.quant not in (None, "int8"):
             raise ValueError(f"quant must be None or 'int8', got {self.quant!r}")
         if self.rope_scaling not in (None, "llama3"):
@@ -104,7 +158,19 @@ class ModelConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.dim // self.n_heads
+        return self.head_dim_override or self.dim // self.n_heads
+
+    @property
+    def all_stop_ids(self) -> tuple:
+        """eos + extra stop tokens, for host-side stop checks."""
+        return (self.eos_token_id,) + tuple(self.stop_token_ids)
+
+    @property
+    def query_scale(self) -> float:
+        """Attention score scale (Gemma-2 overrides head_dim**-0.5 with
+        query_pre_attn_scalar**-0.5)."""
+        base = self.query_scale_override or self.head_dim
+        return float(base) ** -0.5
 
     @property
     def jnp_dtype(self):

@@ -9,10 +9,25 @@ from __future__ import annotations
 TINYLLAMA_SYSTEM = "You are a helpful assistant."
 
 
-def format_chat_prompt(user_message: str, system: str = TINYLLAMA_SYSTEM, arch: str = "llama") -> str:
+def format_chat_prompt(
+    user_message: str, system: str = TINYLLAMA_SYSTEM, arch: str = "llama",
+    template: str = None,
+) -> str:
     """TinyLlama chat format — identical layout to the reference's
     format_chat_prompt (orchestration.py:66). GPT-2 has no chat format;
-    the raw prompt passes through."""
-    if arch == "gpt2":
+    the raw prompt passes through. template overrides the arch-derived
+    default ("tinyllama" | "gemma" | "none"; cfg.chat_template)."""
+    if template is None:
+        template = "none" if arch == "gpt2" else "tinyllama"
+    if template == "none":
         return user_message
+    if template == "gemma":
+        # Gemma instruction format (no system turn in gemma's template;
+        # the system text folds into the user turn like HF does)
+        msg = f"{system}\n\n{user_message}" if system else user_message
+        return f"<start_of_turn>user\n{msg}<end_of_turn>\n<start_of_turn>model\n"
+    if template != "tinyllama":
+        # fail loudly: a typo'd template would silently produce the Zephyr
+        # prompt and garbage completions from a non-TinyLlama checkpoint
+        raise ValueError(f"unknown chat template {template!r}")
     return f"<|system|>\n{system}</s>\n<|user|>\n{user_message}</s>\n<|assistant|>\n"

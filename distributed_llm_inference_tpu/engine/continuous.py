@@ -264,9 +264,9 @@ class ContinuousEngine:
         for (req, _), first_id in zip(wave, firsts):
             req.first_id = int(first_id)
             req.ttft = now - req.t_start
-            # mirror insert_slot's on-device budget: EOS-first or a
+            # mirror insert_slot's on-device budget: stop-token-first or a
             # one-token cap means the slot was armed inactive
-            if req.first_id == self.cfg.eos_token_id or req.budget == 0:
+            if req.first_id in self.cfg.all_stop_ids or req.budget == 0:
                 self._finalize(req)
 
     def _admit_one(self, req: _Request, slot: int):
@@ -283,7 +283,9 @@ class ContinuousEngine:
             return
         k = req.kwargs
         text = (
-            format_chat_prompt(req.prompt, arch=cfg.arch)
+            format_chat_prompt(
+                req.prompt, arch=cfg.arch, template=cfg.chat_template
+            )
             if k.get("chat", True) else req.prompt
         )
         ids = eng.tokenizer.encode(text)
@@ -310,9 +312,8 @@ class ContinuousEngine:
             # append); the EOS check happens inside insert_slot on device
             req.budget = max_tokens - 1
             self.cache, self.state, self.sparams = G.insert_slot(
-                self.cache, scratch, self.state, self.sparams, slot,
+                cfg, self.cache, scratch, self.state, self.sparams, slot,
                 first[0], jnp.int32(prompt_len), jnp.int32(max_tokens),
-                jnp.int32(cfg.eos_token_id),
                 sampling.temperature, sampling.top_k, sampling.top_p,
                 sampling.greedy,
             )
@@ -367,7 +368,7 @@ class ContinuousEngine:
     def _finalize(self, req: _Request):
         cfg = self.cfg
         gen_ids = (
-            [req.first_id] if req.first_id != cfg.eos_token_id else []
+            [req.first_id] if req.first_id not in cfg.all_stop_ids else []
         ) + req.tokens
         response = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
         elapsed = time.time() - req.t_start

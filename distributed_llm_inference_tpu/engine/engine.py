@@ -243,9 +243,10 @@ class InferenceEngine:
         return bucket, max_tokens, decode_bucket
 
     def _row_tokens(self, first_id: int, row_out, n: int) -> list:
-        """Assemble one row's emitted ids (EOS-as-first excluded, matching
-        the reference's break-before-append, orchestration.py:181-186)."""
-        head = [first_id] if first_id != self.cfg.eos_token_id else []
+        """Assemble one row's emitted ids (stop-token-as-first excluded,
+        matching the reference's break-before-append,
+        orchestration.py:181-186)."""
+        head = [first_id] if first_id not in self.cfg.all_stop_ids else []
         return head + [int(t) for t in list(row_out[:n])]
 
     def _record_sample(self, ttft: float, per_stream_tps: float, tokens: int):
@@ -370,7 +371,10 @@ class InferenceEngine:
     ):
         cfg = self.cfg
         self.request_count += 1
-        text = format_chat_prompt(prompt, arch=cfg.arch) if chat else prompt
+        text = (
+            format_chat_prompt(prompt, arch=cfg.arch, template=cfg.chat_template)
+            if chat else prompt
+        )
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
 
@@ -692,7 +696,9 @@ class InferenceEngine:
                 f"split the request"
             )
         texts = [
-            format_chat_prompt(p, arch=cfg.arch) if chat else p for p in prompts
+            format_chat_prompt(p, arch=cfg.arch, template=cfg.chat_template)
+            if chat else p
+            for p in prompts
         ]
         ids = [self.tokenizer.encode(t) for t in texts]
         plens = [len(i) for i in ids]

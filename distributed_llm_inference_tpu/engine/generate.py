@@ -49,6 +49,17 @@ def default_sampling(temperature=0.7, top_k=50, top_p=0.9, greedy=False) -> Samp
     )
 
 
+def stop_mask(cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
+    """True where a token is a stop token (eos OR any cfg.stop_token_ids,
+    e.g. Gemma-it's <end_of_turn> — instruct checkpoints end their turn
+    with it and rarely emit <eos> mid-chat). cfg is static under jit, so
+    the comparisons unroll to a handful of fused equals."""
+    m = tokens == jnp.int32(cfg.eos_token_id)
+    for t in cfg.stop_token_ids:
+        m = m | (tokens == jnp.int32(t))
+    return m
+
+
 def _forward_step(cfg, params, tokens, cache, pos, valid_start=None):
     """One chunk through the stack; logits only at the final chunk position."""
     x = M.embed(cfg, params, tokens, pos)
@@ -137,9 +148,8 @@ def decode(
     # inflate n_gen past the buffer
     limit = jnp.minimum(limit, jnp.int32(max_steps))
     pad = jnp.int32(cfg.pad_token_id)
-    eos = jnp.int32(cfg.eos_token_id)
     out0 = jnp.full((B, max_steps), pad, jnp.int32)
-    finished0 = first_token == eos
+    finished0 = stop_mask(cfg, first_token)
 
     def cond(c):
         step, _, _, _, _, finished, _, _ = c
@@ -152,7 +162,7 @@ def decode(
         )
         key, sub = jax.random.split(key)
         nxt = sample_token(sub, logits, *sampling)
-        is_eos = nxt == eos
+        is_eos = stop_mask(cfg, nxt)
         newly_finished = finished | is_eos
         emit = jnp.where(newly_finished, pad, nxt)
         out = jax.lax.dynamic_update_slice(out, emit[:, None], (jnp.int32(0), step))
@@ -261,7 +271,6 @@ def decode_slots(
     state, cache).
     """
     pad = jnp.int32(cfg.pad_token_id)
-    eos = jnp.int32(cfg.eos_token_id)
 
     def body(carry, sub):
         state, cache = carry
@@ -277,7 +286,7 @@ def decode_slots(
             sparams.greedy,
         )
         # break-before-append EOS semantics (orchestration.py:181-186)
-        can_emit = state.active & (nxt != eos) & (state.remaining > 0)
+        can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
         emit = jnp.where(can_emit, nxt, pad)
         new = SlotState(
             token=jnp.where(can_emit, nxt, pad),
@@ -294,8 +303,9 @@ def decode_slots(
     return emitted, emit_mask, state, cache
 
 
-@functools.partial(jax.jit, donate_argnames=("cache",))
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def insert_slot(
+    cfg: ModelConfig,
     cache,
     scratch,
     state: SlotState,
@@ -304,7 +314,6 @@ def insert_slot(
     first_token,
     prompt_len,
     max_tokens,
-    eos_id,
     temperature,
     top_k,
     top_p,
@@ -324,7 +333,7 @@ def insert_slot(
     """
     slot = jnp.int32(slot)
     budget = jnp.where(
-        first_token == eos_id, jnp.int32(0), jnp.maximum(max_tokens - 1, 0)
+        stop_mask(cfg, first_token), jnp.int32(0), jnp.maximum(max_tokens - 1, 0)
     )
 
     def splice(big, small):
@@ -428,13 +437,12 @@ def decode_speculative(
     G = draft_len
     H = hist.shape[1]
     pad = jnp.int32(cfg.pad_token_id)
-    eos = jnp.int32(cfg.eos_token_id)
     # out gets G+1 extra columns of scratch: each iteration writes its full
     # (1+G)-token window at the emit offset; rejected tails are overwritten
     # by later iterations and the scratch margin is sliced off at the end
     out0 = jnp.full((1, max_steps + G + 1), pad, jnp.int32)
     limit = jnp.minimum(limit, jnp.int32(max_steps))
-    finished0 = (first_token[0] == eos) | (limit <= 0)
+    finished0 = stop_mask(cfg, first_token[0]) | (limit <= 0)
 
     def hist_at(h, i):
         return jax.lax.dynamic_slice(
@@ -480,7 +488,7 @@ def decode_speculative(
         n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
         j = jnp.arange(G + 1, dtype=jnp.int32)
         valid = j <= n_acc
-        cum_eos = jnp.cumsum((window == eos).astype(jnp.int32)) > 0
+        cum_eos = jnp.cumsum(stop_mask(cfg, window).astype(jnp.int32)) > 0
         emit_ok = valid & ~cum_eos  # break BEFORE appending EOS
         room = limit - n_gen
         n_emit = jnp.minimum(jnp.sum(emit_ok.astype(jnp.int32)), room)

@@ -71,6 +71,31 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
     window = getattr(hf_cfg, "sliding_window", None)
     if mt == "qwen2" and not getattr(hf_cfg, "use_sliding_window", False):
         window = None
+    # Gemma / Gemma-2 (llama-family variants): unit-offset RMSNorm, GeGLU,
+    # sqrt(dim)-scaled embeddings, explicit head_dim, tied embeddings;
+    # Gemma-2 adds sandwich norms, logit softcaps, query_pre_attn_scalar,
+    # and sliding window on even-indexed layers only.
+    gemma_kw = {}
+    if mt in ("gemma", "gemma2"):
+        gemma_kw = dict(
+            norm_unit_offset=True,
+            act="gelu_tanh",
+            embed_scale=True,
+            head_dim_override=getattr(hf_cfg, "head_dim", None),
+            chat_template="gemma",
+        )
+        if mt == "gemma2":
+            gemma_kw.update(
+                post_norms=True,
+                attn_softcap=getattr(hf_cfg, "attn_logit_softcapping", None),
+                final_softcap=getattr(hf_cfg, "final_logit_softcapping", None),
+                query_scale_override=getattr(
+                    hf_cfg, "query_pre_attn_scalar", None
+                ),
+                attn_window_pattern="even",
+            )
+        else:
+            window = None  # gemma-1 is full-causal everywhere
     # Llama-3.1/3.2 "llama3" rope_scaling: affects frequencies at every
     # position, so silently ignoring it would convert a checkpoint into one
     # that produces wrong logits everywhere. Unsupported types fail loudly.
@@ -111,15 +136,29 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         **rope_kw,
         # Mistral-style sliding window (HF: None/absent = full causal)
         attn_window=window,
+        **gemma_kw,
         # Qwen2-style q/k/v biases: Qwen2 has them unconditionally; Llama
         # exposes the optional `attention_bias` flag
         attn_qkv_bias=bool(getattr(hf_cfg, "attention_bias", False)) or mt == "qwen2",
         tie_embeddings=getattr(hf_cfg, "tie_word_embeddings", False),
         dtype=dtype,
-        eos_token_id=hf_cfg.eos_token_id if hf_cfg.eos_token_id is not None else 2,
+        # HF eos_token_id may be a LIST (Llama-3.1's [128001,128008,128009],
+        # gemma-it's [1,107]): the first is the primary eos, the rest become
+        # extra stop tokens so chat turns actually terminate
+        eos_token_id=_eos_list(hf_cfg)[0],
+        stop_token_ids=tuple(_eos_list(hf_cfg)[1:]),
         bos_token_id=hf_cfg.bos_token_id if hf_cfg.bos_token_id is not None else 1,
         pad_token_id=hf_cfg.pad_token_id if hf_cfg.pad_token_id is not None else 0,
     )
+
+
+def _eos_list(hf_cfg) -> list:
+    e = hf_cfg.eos_token_id
+    if e is None:
+        return [2]
+    if isinstance(e, (list, tuple)):
+        return list(e) if e else [2]
+    return [e]
 
 
 def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dict:
@@ -141,7 +180,15 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
         "embed": jnp.asarray(p("model.embed_tokens.weight"), dtype=dt),
         "layers": {
             "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
-            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", False),
+            # Gemma-2 renames the MLP pre-norm: post_attention_layernorm
+            # becomes the ATTENTION post-norm and pre_feedforward_layernorm
+            # is the MLP pre-norm (HF Gemma2DecoderLayer)
+            "mlp_norm": stack(
+                "model.layers.{}.pre_feedforward_layernorm.weight"
+                if cfg.post_norms
+                else "model.layers.{}.post_attention_layernorm.weight",
+                False,
+            ),
             "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
             "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
             "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
@@ -149,6 +196,18 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
         },
         "final_norm": jnp.asarray(p("model.norm.weight"), dtype=dt),
     }
+    if cfg.post_norms:
+        params["layers"]["attn_post_norm"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight", False
+        )
+        params["layers"]["mlp_post_norm"] = stack(
+            "model.layers.{}.post_feedforward_layernorm.weight", False
+        )
+    from .llama import make_window_flags
+
+    wf = make_window_flags(cfg)
+    if wf is not None:
+        params["layers"]["window_flag"] = wf
     if cfg.n_experts:
         # Mixtral MoE: per-expert SwiGLU (w1=gate, w3=up, w2=down) + router
         def stack_experts(w_name: str) -> jnp.ndarray:

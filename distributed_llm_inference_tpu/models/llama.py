@@ -62,18 +62,26 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
 
     s = D ** -0.5
+    # unit-offset norms (Gemma) multiply by (1 + w): neutral init is 0
+    norm_init = jnp.zeros if cfg.norm_unit_offset else jnp.ones
     params = {
         "embed": normal(ks[0], (V, D), 0.02),
         "layers": {
-            "attn_norm": jnp.ones((L, D), dt),
-            "mlp_norm": jnp.ones((L, D), dt),
+            "attn_norm": norm_init((L, D), dt),
+            "mlp_norm": norm_init((L, D), dt),
             "wq": normal(ks[1], (L, D, H * Dh), s),
             "wk": normal(ks[2], (L, D, KV * Dh), s),
             "wv": normal(ks[3], (L, D, KV * Dh), s),
             "wo": normal(ks[4], (L, H * Dh, D), s),
         },
-        "final_norm": jnp.ones((D,), dt),
+        "final_norm": norm_init((D,), dt),
     }
+    if cfg.post_norms:  # Gemma-2 sandwich norms
+        params["layers"]["attn_post_norm"] = norm_init((L, D), dt)
+        params["layers"]["mlp_post_norm"] = norm_init((L, D), dt)
+    wf = make_window_flags(cfg)
+    if wf is not None:
+        params["layers"]["window_flag"] = wf
     if cfg.n_experts:  # Mixtral-style MoE FFN: expert bank + router
         E = cfg.n_experts
         params["layers"].update(
@@ -95,6 +103,18 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(ks[8], (D, V), s)
     return params
+
+
+def make_window_flags(cfg: ModelConfig) -> Optional[jnp.ndarray]:
+    """[L] per-layer sliding-window flag for alternating attention
+    (Gemma-2: even-indexed layers slide, HF `not bool(layer_idx % 2)`), or
+    None when the pattern is uniform. Single source of truth for
+    init_params AND the converter — the stacked flag travels with a
+    pipeline stage's layer slice."""
+    if cfg.attn_window is None or cfg.attn_window_pattern != "even":
+        return None
+    L = cfg.n_layers
+    return (jnp.arange(L, dtype=jnp.int32) % 2 == 0).astype(jnp.float32)
 
 
 def init_kv_cache(
@@ -125,14 +145,21 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     """
     if pos.ndim == 1:
         new_k, new_v = update_kv_cache_slots(cache_k, cache_v, k, v, pos)
-        return attend(q, new_k, new_v, mask), new_k, new_v
+        attn = attend(
+            q, new_k, new_v, mask,
+            scale=cfg.query_scale, softcap=cfg.attn_softcap,
+        )
+        return attn, new_k, new_v
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     if cfg.attn_impl == "pallas":
         attn = flash_attend(
             q, new_k, new_v, pos, valid_start, window=cfg.attn_window
         )
     else:
-        attn = attend(q, new_k, new_v, mask)
+        attn = attend(
+            q, new_k, new_v, mask,
+            scale=cfg.query_scale, softcap=cfg.attn_softcap,
+        )
     return attn, new_k, new_v
 
 
@@ -216,8 +243,15 @@ def decoder_layer(
     Dh = cfg.head_dim  # invariant under tp (heads shard, head_dim doesn't)
     H = lp["wq"].shape[-1] // Dh
     KV = lp["wk"].shape[-1] // Dh
+    uo = cfg.norm_unit_offset
 
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    if isinstance(mask, tuple):
+        # Gemma-2 alternating attention: (full, windowed) masks built once
+        # per chunk; this layer's stacked window_flag picks its own
+        mask_full, mask_win = mask
+        mask = jnp.where(lp["window_flag"] > 0, mask_win, mask_full)
+
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps, unit_offset=uo)
     # mm: plain array or int8 QTensor (ops/quant.py) transparently
     q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
     if cfg.attn_qkv_bias:  # Qwen2-style (biases tp-shard with their columns)
@@ -234,18 +268,28 @@ def decoder_layer(
     attn_out = mm(attn.reshape(B, T, H * Dh), lp["wo"])
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
+    if cfg.post_norms:  # Gemma-2: norm the branch output before the residual
+        attn_out = rms_norm(attn_out, lp["attn_post_norm"], cfg.norm_eps, unit_offset=uo)
     x = x + attn_out
 
-    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps, unit_offset=uo)
     if cfg.n_experts:
         mlp_out = moe_ffn(cfg, lp, h, ep_axis)  # psums over ep internally
     else:
-        gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        act = jax.nn.silu if cfg.act == "silu" else _gelu_tanh
+        gate = act(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
         mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
         if tp_axis is not None:
             mlp_out = jax.lax.psum(mlp_out, tp_axis)
+    if cfg.post_norms:
+        mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"], cfg.norm_eps, unit_offset=uo)
     x = x + mlp_out
     return x, new_k, new_v
+
+
+def _gelu_tanh(x):
+    """gelu_pytorch_tanh (Gemma's hidden activation)."""
+    return jax.nn.gelu(x, approximate=True)
 
 
 def forward_layers(
@@ -285,12 +329,19 @@ def forward_layers(
         high_freq_factor=cfg.rope_high_freq_factor,
         original_max_len=cfg.rope_original_max_len,
     )
-    if pos.ndim == 1:
-        mask = slot_causal_mask(pos, T, S, cfg.attn_window)
-    elif valid_start is None:
-        mask = causal_mask(pos, T, S, cfg.attn_window)
+    def make_mask(window):
+        if pos.ndim == 1:
+            return slot_causal_mask(pos, T, S, window)
+        if valid_start is None:
+            return causal_mask(pos, T, S, window)
+        return ragged_causal_mask(pos, T, S, valid_start, window)
+
+    if cfg.attn_window is not None and cfg.attn_window_pattern == "even":
+        # Gemma-2 alternating attention: both masks built once; each layer
+        # selects by its stacked window_flag (decoder_layer)
+        mask = (make_mask(None), make_mask(cfg.attn_window))
     else:
-        mask = ragged_causal_mask(pos, T, S, valid_start, cfg.attn_window)
+        mask = make_mask(cfg.attn_window)
 
     def body(carry, xs):
         xc = carry
@@ -308,17 +359,27 @@ def forward_layers(
 def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, pos=0) -> jnp.ndarray:
     """Token embedding lookup: [B, T] -> [B, T, D]
     (reference orchestration.py:111). `pos` is accepted for interface parity
-    with gpt2.embed (learned positions); RoPE models ignore it here."""
-    return params["embed"][tokens]
+    with gpt2.embed (learned positions); RoPE models ignore it here.
+    Gemma scales by sqrt(dim) in the activation dtype (HF normalizer)."""
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, x.dtype)
+    return x
 
 
 def unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
     """Final RMSNorm + LM head: [B, T, D] -> [B, T, V] logits
-    (reference orchestration.py:140-141)."""
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    (reference orchestration.py:140-141). Gemma-2 softcaps the final
+    logits: cap * tanh(logits / cap)."""
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps,
+                 unit_offset=cfg.norm_unit_offset)
     if cfg.tie_embeddings:
-        return (x @ params["embed"].T).astype(jnp.float32)
-    return mm(x, params["lm_head"]).astype(jnp.float32)
+        logits = (x @ params["embed"].T).astype(jnp.float32)
+    else:
+        logits = mm(x, params["lm_head"]).astype(jnp.float32)
+    if cfg.final_softcap is not None:
+        logits = cfg.final_softcap * jnp.tanh(logits / cfg.final_softcap)
+    return logits
 
 
 def forward(

@@ -108,6 +108,49 @@ register(ModelConfig(
     eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
 ))
 
+# --- Gemma family (llama arch + unit-offset norms / GeGLU / embed scale) --
+register(ModelConfig(
+    name="gemma-2b", arch="llama", vocab_size=256000, dim=2048,
+    n_layers=18, n_heads=8, n_kv_heads=1, ffn_dim=16384, max_seq_len=8192,
+    norm_eps=1e-6, rope_theta=10000.0, head_dim_override=256,
+    norm_unit_offset=True, act="gelu_tanh", embed_scale=True,
+    tie_embeddings=True, chat_template="gemma",
+    eos_token_id=1, stop_token_ids=(107,),  # <end_of_turn> (gemma-it)
+    bos_token_id=2, pad_token_id=0,
+))
+register(ModelConfig(
+    name="gemma-7b", arch="llama", vocab_size=256000, dim=3072,
+    n_layers=28, n_heads=16, n_kv_heads=16, ffn_dim=24576, max_seq_len=8192,
+    norm_eps=1e-6, rope_theta=10000.0, head_dim_override=256,
+    norm_unit_offset=True, act="gelu_tanh", embed_scale=True,
+    tie_embeddings=True, chat_template="gemma",
+    eos_token_id=1, stop_token_ids=(107,),  # <end_of_turn> (gemma-it)
+    bos_token_id=2, pad_token_id=0,
+))
+# Gemma-2: sandwich norms, logit softcaps, alternating sliding window
+register(ModelConfig(
+    name="gemma2-2b", arch="llama", vocab_size=256000, dim=2304,
+    n_layers=26, n_heads=8, n_kv_heads=4, ffn_dim=9216, max_seq_len=8192,
+    norm_eps=1e-6, rope_theta=10000.0, head_dim_override=256,
+    norm_unit_offset=True, act="gelu_tanh", embed_scale=True,
+    post_norms=True, attn_softcap=50.0, final_softcap=30.0,
+    query_scale_override=256.0, attn_window=4096, attn_window_pattern="even",
+    tie_embeddings=True, chat_template="gemma",
+    eos_token_id=1, stop_token_ids=(107,),  # <end_of_turn> (gemma-it)
+    bos_token_id=2, pad_token_id=0,
+))
+register(ModelConfig(
+    name="gemma2-9b", arch="llama", vocab_size=256000, dim=3584,
+    n_layers=42, n_heads=16, n_kv_heads=8, ffn_dim=14336, max_seq_len=8192,
+    norm_eps=1e-6, rope_theta=10000.0, head_dim_override=256,
+    norm_unit_offset=True, act="gelu_tanh", embed_scale=True,
+    post_norms=True, attn_softcap=50.0, final_softcap=30.0,
+    query_scale_override=256.0, attn_window=4096, attn_window_pattern="even",
+    tie_embeddings=True, chat_template="gemma",
+    eos_token_id=1, stop_token_ids=(107,),  # <end_of_turn> (gemma-it)
+    bos_token_id=2, pad_token_id=0,
+))
+
 # --- GPT-2 family ----------------------------------------------------------
 register(ModelConfig(
     name="gpt2-small", arch="gpt2", vocab_size=50257, dim=768,
@@ -133,6 +176,15 @@ register(ModelConfig(
     n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=96, max_seq_len=128,
     n_experts=4, n_experts_per_tok=2,
     eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="test-gemma2-tiny", arch="llama", vocab_size=256, dim=64,
+    n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+    norm_eps=1e-6, head_dim_override=24, norm_unit_offset=True,
+    act="gelu_tanh", embed_scale=True, post_norms=True,
+    attn_softcap=50.0, final_softcap=30.0, query_scale_override=24.0,
+    attn_window=32, attn_window_pattern="even", tie_embeddings=True,
+    chat_template="gemma", eos_token_id=1, bos_token_id=2, pad_token_id=0,
 ))
 register(ModelConfig(
     name="test-gpt2-tiny", arch="gpt2", vocab_size=256, dim=64,

@@ -141,11 +141,16 @@ def attend(
     cache_k: jnp.ndarray,
     cache_v: jnp.ndarray,
     mask: jnp.ndarray,
+    scale=None,
+    softcap=None,
 ) -> jnp.ndarray:
     """Grouped-query attention over the (already updated) cache.
 
     mask: [T, S] (shared) or [B, T, S] (per-row, ragged left-padded batch).
     Softmax in fp32; output cast back to q.dtype. Returns [B, T, H, Dh].
+    scale: score scale (None = head_dim**-0.5; Gemma-2 overrides).
+    softcap: Gemma-2 attention logit softcapping, cap*tanh(scores/cap),
+    applied BEFORE masking (HF Gemma2Attention order).
     """
     B, T, H, Dh = q.shape
     KV = cache_k.shape[1]
@@ -153,10 +158,13 @@ def attend(
     # [B, T, KV, group, Dh] so each kv head serves its query group without
     # materializing repeated K/V (XLA keeps this as a batched matmul).
     qg = q.reshape(B, T, KV, group, Dh)
-    scale = Dh ** -0.5
+    if scale is None:
+        scale = Dh ** -0.5
     scores = jnp.einsum(
         "btkgd,bksd->bkgts", qg.astype(jnp.float32), cache_k.astype(jnp.float32)
     ) * scale  # [B, KV, group, T, S]
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     neg = jnp.finfo(jnp.float32).min
     bmask = mask[:, None, None, :, :] if mask.ndim == 3 else mask[None, None, None, :, :]
     scores = jnp.where(bmask, scores, neg)

@@ -14,13 +14,22 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
-    """RMSNorm: x / rms(x) * weight, variance in fp32."""
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+    unit_offset: bool = False,
+) -> jnp.ndarray:
+    """RMSNorm: x / rms(x) * weight, variance in fp32.
+
+    unit_offset=True multiplies by (1 + weight) instead (HF GemmaRMSNorm —
+    the checkpoint stores w with neutral value 0, not 1)."""
     orig_dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     xf = xf * jax.lax.rsqrt(var + eps)
-    return (xf * weight.astype(jnp.float32)).astype(orig_dtype)
+    w = weight.astype(jnp.float32)
+    if unit_offset:
+        w = 1.0 + w
+    return (xf * w).astype(orig_dtype)
 
 
 def layer_norm(

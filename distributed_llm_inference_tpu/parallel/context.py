@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import ModelConfig
+from ..engine.generate import stop_mask
 from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
@@ -66,6 +67,12 @@ class ContextParallelBackend(SPMDBackendBase):
                 "sliding-window attention does not compose with context "
                 "parallelism yet: ring_attend/cp_decode_attend compute full "
                 "causal attention (fail loudly, not silently wrong)"
+            )
+        if cfg.attn_softcap is not None or cfg.query_scale_override is not None:
+            raise NotImplementedError(
+                "Gemma-2 attention softcapping / query-scale overrides are "
+                "not wired into ring_attend/cp_decode_attend (fail loudly, "
+                "not silently wrong)"
             )
         if int(mesh.shape[AXIS_PP]) != 1:
             raise ValueError("ContextParallelBackend needs pp == 1 (no layer sharding)")
@@ -182,9 +189,8 @@ class ContextParallelBackend(SPMDBackendBase):
             Sc = cache["k"].shape[3]
             B = first_token.shape[0]
             pad = jnp.int32(cfg.pad_token_id)
-            eos = jnp.int32(cfg.eos_token_id)
             out0 = jnp.full((B, max_steps), pad, jnp.int32)
-            finished0 = first_token == eos
+            finished0 = stop_mask(cfg, first_token)
 
             def cond(c):
                 step, _, _, _, _, _, _, _, finished, _, _ = c
@@ -222,7 +228,7 @@ class ContextParallelBackend(SPMDBackendBase):
                 nxt = sample_token(sub, logits, *sampling)
                 # overflow (every shard full): token was not stored, so this
                 # step's attention missed it — discard and stop, don't emit
-                newly = finished | (nxt == eos) | overflow
+                newly = finished | stop_mask(cfg, nxt) | overflow
                 emit = jnp.where(newly, pad, nxt)
                 out = jax.lax.dynamic_update_slice(
                     out, emit[:, None], (jnp.int32(0), step)

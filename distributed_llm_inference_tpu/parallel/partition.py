@@ -32,6 +32,11 @@ from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 _LLAMA_LAYER_SPECS = {
     "attn_norm": P(AXIS_PP),
     "mlp_norm": P(AXIS_PP),
+    # Gemma-2 sandwich norms + per-layer sliding-window flag: stacked on
+    # the layer axis like everything else
+    "attn_post_norm": P(AXIS_PP),
+    "mlp_post_norm": P(AXIS_PP),
+    "window_flag": P(AXIS_PP),
     "wq": P(AXIS_PP, None, AXIS_TP),
     "wk": P(AXIS_PP, None, AXIS_TP),
     "wv": P(AXIS_PP, None, AXIS_TP),

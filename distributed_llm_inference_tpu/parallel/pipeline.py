@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import ModelConfig
-from ..engine.generate import SamplingParams
+from ..engine.generate import SamplingParams, stop_mask
 from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
@@ -314,9 +314,8 @@ class PipelineBackend(SPMDBackendBase):
             key = self._dp_key(key)
             B = first_token.shape[0]
             pad = jnp.int32(cfg.pad_token_id)
-            eos = jnp.int32(cfg.eos_token_id)
             out0 = jnp.full((B, max_steps), pad, jnp.int32)
-            finished0 = first_token == eos
+            finished0 = stop_mask(cfg, first_token)
 
             def cond(c):
                 step, _, _, _, _, finished, _, _ = c
@@ -338,7 +337,7 @@ class PipelineBackend(SPMDBackendBase):
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 key, sub = jax.random.split(key)
                 nxt = sample_token(sub, logits, *sampling)
-                is_eos = nxt == eos
+                is_eos = stop_mask(cfg, nxt)
                 newly = finished | is_eos
                 emit = jnp.where(newly, pad, nxt)
                 out = jax.lax.dynamic_update_slice(

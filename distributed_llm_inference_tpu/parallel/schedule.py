@@ -47,6 +47,7 @@ from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP
 from .partition import cache_spec, init_sharded_cache
+from ..engine.generate import stop_mask
 from .pipeline import SPMDBackendBase, _ring_perm
 from .vocab import embed_sharded, unembed_sharded
 
@@ -220,7 +221,6 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         cfg, S, Mb = self.cfg, self.pp, self.n_microbatches
         perm = _ring_perm(S)
         pad = jnp.int32(cfg.pad_token_id)
-        eos = jnp.int32(cfg.eos_token_id)
 
         def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
             s = jax.lax.axis_index(AXIS_PP)
@@ -230,7 +230,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
             D = shared["embed"].shape[-1]
             dt = cfg.jnp_dtype
 
-            finished0 = (first_token == eos).reshape(Mb, b_m)
+            finished0 = stop_mask(cfg, first_token).reshape(Mb, b_m)
             cur0 = jnp.where(finished0, pad, first_token.reshape(Mb, b_m))
             done0 = jnp.all(finished0, axis=1) | (limit <= 0)
 
@@ -263,7 +263,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 )
                 tok, _ = self._stage0_sample(shared, s, kk, buf[:, -1:, :], sampling)
                 fin_m = finished[m_done]
-                newly = fin_m | (tok == eos)
+                newly = fin_m | stop_mask(cfg, tok)
                 emit = jnp.where(newly, pad, tok)
                 # gated per-microbatch state updates (uniform across devices)
                 old_row = jax.lax.dynamic_slice(

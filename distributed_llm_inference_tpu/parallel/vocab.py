@@ -91,6 +91,8 @@ def embed_sharded(cfg: ModelConfig, shared: dict, tokens: jnp.ndarray, pos, pp: 
     x = jnp.where(valid[..., None], x, jnp.zeros((), x.dtype))
     if pp > 1:
         x = jax.lax.psum(x, AXIS_PP)
+    if cfg.embed_scale:  # gemma: sqrt(dim) in the activation dtype
+        x = x * jnp.asarray(cfg.dim ** 0.5, x.dtype)
     if cfg.use_learned_pos:  # gpt2: add (replicated) position rows once
         T = tokens.shape[1]
         positions = jnp.asarray(pos, jnp.int32) + jnp.arange(T, dtype=jnp.int32)
@@ -108,7 +110,8 @@ def unembed_sharded(cfg: ModelConfig, shared: dict, x: jnp.ndarray, pp: int):
     if cfg.arch == "gpt2":
         h = layer_norm(x, shared["final_norm_w"], shared["final_norm_b"], cfg.norm_eps)
     else:
-        h = rms_norm(x, shared["final_norm"], cfg.norm_eps)
+        h = rms_norm(x, shared["final_norm"], cfg.norm_eps,
+                     unit_offset=cfg.norm_unit_offset)
     if cfg.tie_embeddings:
         lg = (h @ shared["embed"].T).astype(jnp.float32)  # [B, T, V_pad/pp]
     else:
@@ -116,4 +119,7 @@ def unembed_sharded(cfg: ModelConfig, shared: dict, x: jnp.ndarray, pp: int):
         lg = qmm(h, shared["lm_head"]).astype(jnp.float32)
     if pp > 1:
         lg = jax.lax.all_gather(lg, AXIS_PP, axis=lg.ndim - 1, tiled=True)
-    return lg[..., : cfg.vocab_size]
+    lg = lg[..., : cfg.vocab_size]
+    if cfg.final_softcap is not None:  # gemma-2
+        lg = cfg.final_softcap * jnp.tanh(lg / cfg.final_softcap)
+    return lg

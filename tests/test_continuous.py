@@ -68,8 +68,8 @@ def test_decode_slots_matches_plain_decode(solo_engine):
     scratch = backend.init_cache(1, cfg.max_seq_len)
     first_b, _, scratch = backend.prefill(tokens, plen, scratch, key, sampling)
     cache_b, state, sparams = G.insert_slot(
-        cache_b, scratch, state, sparams, 2, first_b[0], plen,
-        jnp.int32(13), jnp.int32(cfg.eos_token_id),
+        cfg, cache_b, scratch, state, sparams, 2, first_b[0], plen,
+        jnp.int32(13),
         jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), jnp.bool_(True),
     )
     emitted, mask, state, cache_b = G.decode_slots(
