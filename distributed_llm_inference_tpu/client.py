@@ -89,6 +89,49 @@ class DistributedLLMClient:
                 print(f"\n❌ {result.get('error', 'unknown error')}")
         return result
 
+    def generate_stream(self, prompt: str, max_tokens: int = 20, **kw: Any):
+        """Stream a generation: print deltas as they arrive (NDJSON lines
+        from a --continuous server), return the final envelope."""
+        req = urllib.request.Request(
+            f"{self.base_url}/generate",
+            data=json.dumps(
+                {"prompt": prompt, "max_tokens": max_tokens, "stream": True, **kw}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        final: dict = {}
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                print("\n🤖 ", end="", flush=True)
+                for line in r:
+                    ev = json.loads(line)
+                    if ev.get("done"):
+                        final = ev
+                        break
+                    print(ev.get("delta", ""), end="", flush=True)
+            # failures arrive as a normal done-event over HTTP 200 (queue
+            # full, deadline) — and a dropped connection leaves final empty
+            if final.get("status") == "success":
+                print(
+                    f"\n   ⏱  {final.get('time_taken')} | "
+                    f"{final.get('tokens_generated')} tokens | "
+                    f"{final.get('tokens_per_sec')} tok/s | "
+                    f"TTFT {final.get('ttft_s')}s"
+                )
+            else:
+                print(f"\n❌ {final.get('error', 'stream ended without a result')}")
+        except urllib.error.HTTPError as e:
+            try:
+                final = json.loads(e.read())
+            except Exception:
+                final = {"error": str(e), "status": "failed"}
+            print(f"\n❌ {final.get('error', 'unknown error')}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            final = {"error": f"connection failed: {e}", "status": "failed"}
+            print(f"\n❌ {final['error']}")
+        return final
+
     # -- interactive REPL (Test.py:105-144) ---------------------------------
     def interactive_chat(self):
         print("\n💬 Interactive chat — 'workers', 'health', or 'quit'")
@@ -115,11 +158,18 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--url", default="http://127.0.0.1:5000")
     ap.add_argument("--prompt", default=None, help="one-shot prompt (skips menu)")
     ap.add_argument("--max-tokens", type=int, default=20)
+    ap.add_argument(
+        "--stream", action="store_true",
+        help="stream tokens as they decode (server must run --continuous)",
+    )
     args = ap.parse_args(argv)
 
     client = DistributedLLMClient(args.url)
     if args.prompt is not None:
-        client.generate(args.prompt, max_tokens=args.max_tokens)
+        if args.stream:
+            client.generate_stream(args.prompt, max_tokens=args.max_tokens)
+        else:
+            client.generate(args.prompt, max_tokens=args.max_tokens)
         return
 
     # 3-option menu (Test.py:147-188)

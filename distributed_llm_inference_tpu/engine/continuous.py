@@ -50,9 +50,10 @@ class _Request:
     __slots__ = (
         "prompt", "kwargs", "done", "result", "t_start", "ttft",
         "first_id", "tokens", "slot", "enqueued", "budget",
+        "stream_q", "streamed_text",
     )
 
-    def __init__(self, prompt: str, kwargs: dict):
+    def __init__(self, prompt: str, kwargs: dict, stream_q=None):
         self.prompt = prompt
         self.kwargs = kwargs
         self.done = threading.Event()
@@ -64,6 +65,10 @@ class _Request:
         self.tokens: list[int] = []
         self.slot: Optional[int] = None
         self.budget: int = 0
+        # token streaming (NDJSON serving): events land here as chunks
+        # process; None = non-streaming request
+        self.stream_q = stream_q
+        self.streamed_text = ""  # chars already emitted (BPE-safe deltas)
 
 
 class ContinuousEngine:
@@ -118,15 +123,21 @@ class ContinuousEngine:
         self._thread.start()
 
     # -- client side ---------------------------------------------------------
-    def submit(self, prompt: str, **kwargs) -> dict:
-        # contracts slots cannot honor run solo on the wrapped engine
-        if (
+    @staticmethod
+    def _needs_solo(kwargs: dict) -> bool:
+        """Contracts slots cannot honor (deterministic RNG stream, single-
+        stream prefill logits, draft verification) run solo on the wrapped
+        engine — one condition shared by submit() and stream()."""
+        return (
             kwargs.get("seed") is not None
-            or kwargs.get("debug")
-            or kwargs.get("speculative")
-        ):
-            return self.engine.generate(prompt, **kwargs)
-        req = _Request(prompt, kwargs)
+            or bool(kwargs.get("debug"))
+            or bool(kwargs.get("speculative"))
+        )
+
+    def _enqueue(self, req: _Request) -> Optional[dict]:
+        """Admit a request to the bounded queue. Returns an error envelope
+        (caller delivers it OUTSIDE any lock — a streaming caller yields to
+        a possibly-slow socket write) or None on success."""
         with self._cv:
             if self._closed:
                 return {
@@ -142,8 +153,72 @@ class ContinuousEngine:
                 }
             self._queue.append(req)
             self._cv.notify_all()
+        return None
+
+    def submit(self, prompt: str, **kwargs) -> dict:
+        if self._needs_solo(kwargs):
+            return self.engine.generate(prompt, **kwargs)
+        req = _Request(prompt, kwargs)
+        err = self._enqueue(req)
+        if err is not None:
+            return err
         req.done.wait()
         return req.result
+
+    def stream(self, prompt: str, **kwargs):
+        """Generator of streaming events for one request.
+
+        Yields `{"delta": str, "tokens_so_far": N}` as decode chunks land
+        (first event after prefill, then one per chunk with new tokens) and
+        finally the standard response envelope (with "done": true). The
+        caller iterates on its own thread (e.g. an HTTP handler writing
+        NDJSON lines); the worker thread pushes into a per-request queue.
+
+        Seeded / debug / speculative requests cannot stream (they run solo
+        on the wrapped engine, which decodes entirely on-device) — one
+        final envelope event is yielded instead.
+        """
+        if self._needs_solo(kwargs):
+            out = self.engine.generate(prompt, **kwargs)
+            out["done"] = True
+            yield out
+            return
+        import queue as _queue
+
+        q: _queue.Queue = _queue.Queue()
+        req = _Request(prompt, kwargs, stream_q=q)
+        err = self._enqueue(req)  # error yielded OUTSIDE the engine lock:
+        if err is not None:  # the consumer may block on a slow socket write
+            yield {**err, "done": True}
+            return
+        while True:
+            ev = q.get()
+            yield ev
+            if ev.get("done"):
+                return
+
+    def _stream_tokens(self, req: _Request, final: bool = False):
+        """Push the not-yet-streamed suffix of req's text (worker thread).
+
+        Deltas are computed on the FULL decoded text, and text ending in
+        U+FFFD is held back until more tokens arrive: a multi-byte grapheme
+        whose bytes straddle a chunk boundary decodes to a replacement char
+        now and the real character later AT THE SAME LENGTH, so streaming
+        it would make the joined deltas diverge from the final response.
+        final=True flushes everything (a genuine trailing U+FFFD included)
+        so concat(deltas) == response exactly."""
+        gen_ids = (
+            [req.first_id] if req.first_id not in self.cfg.all_stop_ids else []
+        ) + req.tokens
+        if not gen_ids:
+            return
+        text = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
+        if not final:
+            text = text.rstrip("�")
+        if len(text) > len(req.streamed_text):
+            delta = text[len(req.streamed_text):]
+            req.streamed_text = text
+            req.stream_q.put({"delta": delta, "tokens_so_far": len(gen_ids)})
 
     def close(self):
         with self._cv:
@@ -160,7 +235,7 @@ class ContinuousEngine:
         for req in pending + [r for r in self._assignment if r is not None]:
             if req.result is None:
                 req.result = dict(fail)
-            req.done.set()
+            self._push_final(req)
 
     def stats(self) -> dict:
         with self._cv:
@@ -194,7 +269,7 @@ class ContinuousEngine:
             for req in pending + running:
                 if req.result is None:
                     req.result = dict(fail)
-                req.done.set()
+                self._push_final(req)
 
     def _loop_inner(self):
         prev = None  # (packed chunk results dev array, assignment snapshot)
@@ -252,11 +327,11 @@ class ContinuousEngine:
                     "error": f"Error: {e}", "status": "failed",
                     "error_type": "invalid_request",
                 }
-                req.done.set()
+                self._push_final(req)
             except Exception as e:  # noqa: BLE001 - must unblock the caller
                 log.error("admit_failed", exc_info=True, error=str(e))
                 req.result = {"error": f"Error: {e}", "status": "failed"}
-                req.done.set()
+                self._push_final(req)
         if not wave:
             return
         firsts = np.asarray(jnp.concatenate([f for _, f in wave]))
@@ -268,6 +343,8 @@ class ContinuousEngine:
             # one-token cap means the slot was armed inactive
             if req.first_id in self.cfg.all_stop_ids or req.budget == 0:
                 self._finalize(req)
+            elif req.stream_q is not None:
+                self._stream_tokens(req)  # first token, right after TTFT
 
     def _admit_one(self, req: _Request, slot: int):
         eng, cfg = self.engine, self.cfg
@@ -279,7 +356,7 @@ class ContinuousEngine:
                 "status": "failed",
                 "error_type": "timeout",
             }
-            req.done.set()
+            self._push_final(req)
             return
         k = req.kwargs
         text = (
@@ -350,7 +427,10 @@ class ContinuousEngine:
         for b, req in enumerate(snapshot):
             if req is None or req.done.is_set():
                 continue  # freed/killed tenant's masked leftovers
-            req.tokens.extend(int(t) for t in emitted[mask[:, b], b])
+            new = emitted[mask[:, b], b]
+            req.tokens.extend(int(t) for t in new)
+            if req.stream_q is not None and len(new):
+                self._stream_tokens(req)
             if self._assignment[b] is req and not active[b]:
                 self._finalize(req)
             elif deadline and now - req.t_start > deadline:
@@ -367,6 +447,8 @@ class ContinuousEngine:
 
     def _finalize(self, req: _Request):
         cfg = self.cfg
+        if req.stream_q is not None:
+            self._stream_tokens(req, final=True)  # flush held-back tail
         gen_ids = (
             [req.first_id] if req.first_id not in cfg.all_stop_ids else []
         ) + req.tokens
@@ -398,4 +480,14 @@ class ContinuousEngine:
                 self._assignment[req.slot] = None
             self.completed += 1
             self._cv.notify_all()
+        self._push_final(req)
+
+    def _push_final(self, req: _Request):
+        """Single completion point: streaming clients get the terminal
+        envelope event (done: true) on their queue, then the done flag
+        unblocks submit()."""
+        if req.stream_q is not None and req.result is not None:
+            out = dict(req.result)
+            out["done"] = True
+            req.stream_q.put(out)
         req.done.set()

@@ -77,7 +77,13 @@ def save_params(path: str, cfg: ModelConfig, params: dict) -> None:
 def _read_manifest(path: str) -> tuple[ModelConfig, dict]:
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
-    cfg = ModelConfig(**manifest["config"])
+    raw = manifest["config"]
+    # JSON round-trips tuples as lists; coerce tuple-typed fields back so
+    # the loaded config compares equal to the saved one
+    for k in ("stop_token_ids",):
+        if k in raw and isinstance(raw[k], list):
+            raw[k] = tuple(raw[k])
+    cfg = ModelConfig(**raw)
     return cfg, manifest["leaves"]
 
 

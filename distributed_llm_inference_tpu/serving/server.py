@@ -237,6 +237,29 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     chat=_parse_bool(data.get("chat", True), "chat"),
                     seed=int(seed) if seed is not None else None,
                 )
+                if _parse_bool(data.get("stream", False), "stream"):
+                    # NDJSON token streaming: one {"delta": ...} line per
+                    # decode chunk, final line = the standard envelope with
+                    # "done": true. Requires --continuous (the solo engine
+                    # decodes entirely on-device; there is nothing to
+                    # stream per-token).
+                    if continuous is None or prompts is not None:
+                        self._send(400, {
+                            "error": "streaming requires --continuous and a "
+                            "single 'prompt'",
+                        })
+                        return
+                    kwargs["debug"] = _parse_bool(data.get("debug", False), "debug")
+                    kwargs["speculative"] = _parse_bool(
+                        data.get("speculative", False), "speculative"
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.end_headers()
+                    for ev in continuous.stream(prompt, **kwargs):
+                        self.wfile.write(json.dumps(ev).encode() + b"\n")
+                        self.wfile.flush()
+                    return
                 if prompts is not None:
                     # batched form: "prompts": [...] -> one fleet, N results
                     if not isinstance(prompts, list):

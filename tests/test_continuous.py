@@ -281,6 +281,60 @@ def test_deadline_expired_in_queue_does_not_kill_engine(solo_engine):
         cont.close()
 
 
+def test_stream_deltas_reassemble_full_response(solo_engine):
+    """stream() yields incremental text deltas whose concatenation equals
+    the solo response, with the standard envelope as the final event."""
+    p = PROMPTS[2]
+    solo = solo_engine.generate(p, max_tokens=16, greedy=True, chat=False)
+    cont = ContinuousEngine(solo_engine, n_slots=2, chunk_steps=4)
+    try:
+        events = list(cont.stream(p, max_tokens=16, greedy=True, chat=False))
+        final = events[-1]
+        deltas = [e["delta"] for e in events[:-1]]
+        assert final.get("done") is True
+        assert final["status"] == "success", final
+        assert final["response"] == solo["response"]
+        assert "".join(deltas) == solo["response"]
+        # chunk_steps=4 over 16 tokens: streaming must actually be
+        # incremental, not one blob at the end
+        assert len(deltas) >= 3, deltas
+    finally:
+        cont.close()
+
+
+def test_stream_concurrent_with_submit(solo_engine):
+    """A streaming request and blocking requests share the fleet."""
+    cont = ContinuousEngine(solo_engine, n_slots=2, chunk_steps=4)
+    try:
+        out = {}
+
+        def run_blocking():
+            out["b"] = cont.submit(PROMPTS[0], max_tokens=12, greedy=True, chat=False)
+
+        t = threading.Thread(target=run_blocking)
+        t.start()
+        events = list(
+            cont.stream(PROMPTS[1], max_tokens=12, greedy=True, chat=False)
+        )
+        t.join(timeout=120)
+        assert events[-1]["status"] == "success"
+        assert out["b"]["status"] == "success"
+        solo = solo_engine.generate(PROMPTS[1], max_tokens=12, greedy=True, chat=False)
+        assert events[-1]["response"] == solo["response"]
+    finally:
+        cont.close()
+
+
+def test_stream_seeded_falls_back_single_event(solo_engine):
+    cont = ContinuousEngine(solo_engine, n_slots=1, chunk_steps=4)
+    try:
+        events = list(cont.stream("seeded", max_tokens=5, seed=3, chat=False))
+        assert len(events) == 1
+        assert events[0]["status"] == "success" and events[0]["done"] is True
+    finally:
+        cont.close()
+
+
 def test_over_long_prompt_invalid_request(solo_engine):
     cont = ContinuousEngine(solo_engine, n_slots=1, chunk_steps=4)
     try:
