@@ -50,7 +50,7 @@ class _Request:
     __slots__ = (
         "prompt", "kwargs", "done", "result", "t_start", "ttft",
         "first_id", "tokens", "slot", "enqueued", "budget",
-        "stream_q", "streamed_text",
+        "stream_q", "streamed_text", "record",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None):
@@ -69,6 +69,7 @@ class _Request:
         # process; None = non-streaming request
         self.stream_q = stream_q
         self.streamed_text = ""  # chars already emitted (BPE-safe deltas)
+        self.record = True  # False: warmup traffic, kept out of /stats
 
 
 class ContinuousEngine:
@@ -236,6 +237,32 @@ class ContinuousEngine:
             if req.result is None:
                 req.result = dict(fail)
             self._push_final(req)
+
+    def warmup(self) -> dict:
+        """Compile the slot programs (scratch prefill for the smallest
+        bucket, insert_slot, decode_slots chunk, pack_chunk) by serving one
+        real throwaway request through the fleet. The wrapped engine's
+        warmup() separately covers every prefill bucket — together no
+        client request pays jit latency (p50-TTFT discipline)."""
+        t0 = time.time()
+        req = _Request(
+            "warmup",
+            dict(max_tokens=self.chunk_steps + 2, greedy=True, chat=False),
+        )
+        # compile-only traffic: its multi-second jit TTFT must not land in
+        # /stats (it would skew the very p50 TTFT warmup exists to protect)
+        # nor count as a served request
+        req.record = False
+        err = self._enqueue(req)
+        if err is not None:
+            return {"ok": False, "seconds": 0.0, **err}
+        req.done.wait()
+        out = {
+            "ok": (req.result or {}).get("status") == "success",
+            "seconds": round(time.time() - t0, 2),
+        }
+        log.info("continuous_warmup", **out)
+        return out
 
     def stats(self) -> dict:
         with self._cv:
@@ -405,7 +432,8 @@ class ContinuousEngine:
         with self._cv:
             self._assignment[slot] = req
             self.admitted += 1
-            eng.request_count += 1
+            if req.record:
+                eng.request_count += 1
             occ = sum(r is not None for r in self._assignment)
             self.peak_occupancy = max(self.peak_occupancy, occ)
         log.info(
@@ -456,7 +484,8 @@ class ContinuousEngine:
         elapsed = time.time() - req.t_start
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
-        self.engine._record_sample(req.ttft, tps, n)
+        if req.record:
+            self.engine._record_sample(req.ttft, tps, n)
         req.result = {
             "prompt": req.prompt,
             "response": response,

@@ -96,6 +96,19 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
             )
         else:
             window = None  # gemma-1 is full-causal everywhere
+    elif mt == "phi3":
+        # llama semantics with fused projections (split at load time) and
+        # the <|user|>/<|assistant|>/<|end|> chat format
+        gemma_kw = dict(chat_template="phi3")
+    # Phi-3 instruct ends its turn with <|end|> (32007), but config.json
+    # only carries the scalar eos 32000 (the extra stops live in
+    # generation_config.json, which a weights-only conversion never sees) —
+    # without it generation sails past end-of-turn into hallucinated
+    # follow-on turns. Guarded by vocab size so tiny test configs are
+    # unaffected.
+    extra_stops = tuple(_eos_list(hf_cfg)[1:])
+    if mt == "phi3" and hf_cfg.vocab_size > 32007 and 32007 not in extra_stops:
+        extra_stops += (32007,)
     # Llama-3.1/3.2 "llama3" rope_scaling: affects frequencies at every
     # position, so silently ignoring it would convert a checkpoint into one
     # that produces wrong logits everywhere. Unsupported types fail loudly.
@@ -146,7 +159,7 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         # gemma-it's [1,107]): the first is the primary eos, the rest become
         # extra stop tokens so chat turns actually terminate
         eos_token_id=_eos_list(hf_cfg)[0],
-        stop_token_ids=tuple(_eos_list(hf_cfg)[1:]),
+        stop_token_ids=extra_stops,
         bos_token_id=hf_cfg.bos_token_id if hf_cfg.bos_token_id is not None else 1,
         pad_token_id=hf_cfg.pad_token_id if hf_cfg.pad_token_id is not None else 0,
     )
@@ -176,6 +189,19 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
         arr = np.stack([m.T if transpose else m for m in mats], axis=0)
         return jnp.asarray(arr, dtype=dt)
 
+    # Phi-3 fuses q/k/v into qkv_proj [(H+2KV)*Dh, D] and gate/up into
+    # gate_up_proj [2F, D]; split them into the canonical stacked leaves so
+    # every downstream consumer (tp sharding, quant, pipeline slicing) sees
+    # one layout.
+    fused_qkv = "model.layers.0.self_attn.qkv_proj.weight" in sd
+    fused_gate_up = "model.layers.0.mlp.gate_up_proj.weight" in sd
+    H, KV, Dh, F = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.ffn_dim
+
+    def stack_rows(fmt: str, lo: int, hi: int) -> jnp.ndarray:
+        """Stack rows [lo:hi) of a fused [out, in] projection, transposed."""
+        mats = [p(fmt.format(i))[lo:hi].T for i in range(L)]
+        return jnp.asarray(np.stack(mats, axis=0), dtype=dt)
+
     params = {
         "embed": jnp.asarray(p("model.embed_tokens.weight"), dtype=dt),
         "layers": {
@@ -189,13 +215,19 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
                 else "model.layers.{}.post_attention_layernorm.weight",
                 False,
             ),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
         },
         "final_norm": jnp.asarray(p("model.norm.weight"), dtype=dt),
     }
+    if fused_qkv:
+        qkv = "model.layers.{}.self_attn.qkv_proj.weight"
+        params["layers"]["wq"] = stack_rows(qkv, 0, H * Dh)
+        params["layers"]["wk"] = stack_rows(qkv, H * Dh, (H + KV) * Dh)
+        params["layers"]["wv"] = stack_rows(qkv, (H + KV) * Dh, (H + 2 * KV) * Dh)
+    else:
+        params["layers"]["wq"] = stack("model.layers.{}.self_attn.q_proj.weight", True)
+        params["layers"]["wk"] = stack("model.layers.{}.self_attn.k_proj.weight", True)
+        params["layers"]["wv"] = stack("model.layers.{}.self_attn.v_proj.weight", True)
     if cfg.post_norms:
         params["layers"]["attn_post_norm"] = stack(
             "model.layers.{}.post_attention_layernorm.weight", False
@@ -231,6 +263,13 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
             w_gate=stack_experts("w1"),
             w_up=stack_experts("w3"),
             w_down=stack_experts("w2"),
+        )
+    elif fused_gate_up:
+        gu = "model.layers.{}.mlp.gate_up_proj.weight"
+        params["layers"].update(
+            w_gate=stack_rows(gu, 0, F),
+            w_up=stack_rows(gu, F, 2 * F),
+            w_down=stack("model.layers.{}.mlp.down_proj.weight", True),
         )
     else:
         params["layers"].update(

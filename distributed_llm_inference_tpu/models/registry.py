@@ -151,6 +151,16 @@ register(ModelConfig(
     bos_token_id=2, pad_token_id=0,
 ))
 
+# --- Phi-3 family (llama arch; HF fuses qkv / gate_up, split at convert) --
+register(ModelConfig(
+    name="phi3-mini-4k", arch="llama", vocab_size=32064, dim=3072,
+    n_layers=32, n_heads=32, n_kv_heads=32, ffn_dim=8192, max_seq_len=4096,
+    norm_eps=1e-5, rope_theta=10000.0, attn_window=2047,
+    chat_template="phi3",
+    eos_token_id=32000, stop_token_ids=(32007,),  # <|endoftext|>, <|end|>
+    bos_token_id=1, pad_token_id=32000,
+))
+
 # --- GPT-2 family ----------------------------------------------------------
 register(ModelConfig(
     name="gpt2-small", arch="gpt2", vocab_size=50257, dim=768,

@@ -256,9 +256,17 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     self.send_response(200)
                     self.send_header("Content-Type", "application/x-ndjson")
                     self.end_headers()
-                    for ev in continuous.stream(prompt, **kwargs):
-                        self.wfile.write(json.dumps(ev).encode() + b"\n")
-                        self.wfile.flush()
+                    gen = continuous.stream(prompt, **kwargs)
+                    try:
+                        for ev in gen:
+                            self.wfile.write(json.dumps(ev).encode() + b"\n")
+                            self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError):
+                        # client went away mid-stream: drop quietly (the
+                        # slot keeps decoding to its bounded budget; its
+                        # remaining events drain into the request queue
+                        # and are garbage-collected with it)
+                        gen.close()
                     return
                 if prompts is not None:
                     # batched form: "prompts": [...] -> one fleet, N results
@@ -424,7 +432,20 @@ def main(argv: Optional[list] = None):
         help="pre-compile every (prefill, decode) bucket before serving "
              "(first requests then never pay jit latency)",
     )
+    ap.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory: server restarts "
+             "(and --warmup) reuse compiled programs instead of recompiling "
+             "from scratch",
+    )
     args = ap.parse_args(argv)
+
+    if args.compile_cache:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        # cache even fast-to-compile programs: restart latency is the point
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     if args.coordinator or args.num_processes is not None or args.process_id is not None:
         from ..parallel.mesh import multihost_initialize
@@ -474,6 +495,9 @@ def main(argv: Optional[list] = None):
         continuous = ContinuousEngine(
             engine, n_slots=args.continuous, chunk_steps=args.continuous_chunk,
         )
+        if args.warmup:
+            w = continuous.warmup()
+            print(f"✅ continuous warm in {w['seconds']}s")
     elif args.queue > 0:
         from .queue import BatchingQueue
 

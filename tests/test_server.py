@@ -3,6 +3,7 @@ flow — health → workers → generate — against a locally served engine, ov
 real HTTP."""
 
 import json
+import urllib.error
 import urllib.request
 
 import pytest
@@ -188,3 +189,64 @@ def test_profiler_start_stop(served, tmp_path):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req, timeout=10)
     assert ei.value.code == 400
+
+
+# -- streaming over HTTP (engine/continuous.py + NDJSON serving) ------------
+@pytest.fixture(scope="module")
+def served_continuous():
+    from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+
+    engine = create_engine(
+        "test-llama-tiny",
+        engine_cfg=EngineConfig(prefill_buckets=(64, 128)),
+    )
+    cont = ContinuousEngine(engine, n_slots=2, chunk_steps=4)
+    server = InferenceServer(
+        engine, host="127.0.0.1", port=0, continuous=cont
+    )
+    server.start()
+    yield server
+    server.shutdown()
+
+
+def test_stream_over_http_ndjson(served_continuous):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served_continuous.port}/generate",
+        data=json.dumps(
+            {"prompt": "stream http", "max_tokens": 12, "greedy": True,
+             "stream": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    events = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers.get("Content-Type") == "application/x-ndjson"
+        for line in r:
+            events.append(json.loads(line))
+    final = events[-1]
+    assert final["done"] is True and final["status"] == "success"
+    assert "".join(e["delta"] for e in events[:-1]) == final["response"]
+    assert len(events) >= 2
+
+
+def test_stream_requires_continuous(served):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{served.port}/generate",
+        data=json.dumps({"prompt": "x", "stream": True}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "expected HTTP 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        assert "continuous" in json.loads(e.read())["error"]
+
+
+def test_nonstream_generate_on_continuous_server(served_continuous):
+    c = DistributedLLMClient(f"http://127.0.0.1:{served_continuous.port}")
+    r = c.generate("plain request", max_tokens=6, verbose=False, greedy=True)
+    assert r["status"] == "success"
+    assert r.get("continuous") is True
