@@ -296,8 +296,6 @@ def run_benchmark():
     cont_tok_s = None
     if time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
         try:
-            import threading as _threading
-
             from distributed_llm_inference_tpu.engine.continuous import (
                 ContinuousEngine,
             )
@@ -314,7 +312,7 @@ def run_benchmark():
                 ]
                 cont.submit(prompts[0], **kw)  # warm slot programs
                 done_tokens = [0]
-                lock = _threading.Lock()
+                lock = threading.Lock()
                 it = iter(prompts)
 
                 def client():
@@ -330,7 +328,7 @@ def run_benchmark():
 
                 t0 = time.perf_counter()
                 threads = [
-                    _threading.Thread(target=client) for _ in range(8)
+                    threading.Thread(target=client) for _ in range(8)
                 ]
                 for t in threads:
                     t.start()

@@ -27,8 +27,9 @@ def format_chat_prompt(
         msg = f"{system}\n\n{user_message}" if system else user_message
         return f"<start_of_turn>user\n{msg}<end_of_turn>\n<start_of_turn>model\n"
     if template == "phi3":
-        msg = f"{system}\n\n{user_message}" if system else user_message
-        return f"<|user|>\n{msg}<|end|>\n<|assistant|>\n"
+        # Phi-3 instruct HAS a native system role (unlike gemma)
+        sys_turn = f"<|system|>\n{system}<|end|>\n" if system else ""
+        return f"{sys_turn}<|user|>\n{user_message}<|end|>\n<|assistant|>\n"
     if template != "tinyllama":
         # fail loudly: a typo'd template would silently produce the Zephyr
         # prompt and garbage completions from a non-TinyLlama checkpoint

@@ -22,11 +22,15 @@ Attribution discipline: each launched chunk snapshots the slot->request
 assignment. A chunk in flight when a slot is freed and re-admitted would
 otherwise credit the old tenant's (masked, pad) emissions to the new one.
 
-Single-device llama-family only: slots mode needs raw params (a plain jit,
-not the pipeline's shard_map) and relative positions. Seeded / debug /
-speculative requests fall back to the solo engine — their contracts
-(deterministic RNG stream, single-stream prefill logits, draft verification)
-are per-request, not per-fleet.
+Backends: the single-device backend runs the fleet as a plain jit
+(engine/generate.decode_slots); the pp PipelineBackend runs the same fleet
+inside its shard_map ring (parallel/pipeline._build_decode_slots — each
+step is S gated microsteps, dp must be 1). Llama AND gpt2 families: slots
+need no left-padding (every slot starts at position 0), so gpt2's learned
+absolute positions stay exact — the one batching mode gpt2 supports.
+Seeded / debug / speculative requests fall back to the solo engine — their
+contracts (deterministic RNG stream, single-stream prefill logits, draft
+verification) are per-request, not per-fleet.
 """
 
 from __future__ import annotations
@@ -88,15 +92,16 @@ class ContinuousEngine:
         max_queue: int = 64,
     ):
         cfg = engine.cfg
-        if cfg.arch != "llama":
+        if cfg.arch not in ("llama", "gpt2"):
             raise ValueError(
-                f"continuous batching is llama-family only (per-row positions "
-                f"need relative RoPE); model arch is {cfg.arch!r}"
+                f"continuous batching supports the llama and gpt2 families; "
+                f"model arch is {cfg.arch!r}"
             )
         if not getattr(engine.backend, "supports_slots", False):
             raise ValueError(
                 f"backend {engine.backend.name!r} does not support slot "
-                f"decode; continuous batching needs the single-device backend"
+                f"decode; continuous batching runs on the single-device "
+                f"backend or a pp pipeline mesh with dp == 1"
             )
         self.engine = engine
         self.cfg = cfg
@@ -316,9 +321,8 @@ class ContinuousEngine:
                 self._admit()
             cur = None
             if any(r is not None for r in self._assignment):
-                emitted, mask, self.state, self.cache = G.decode_slots(
-                    self.cfg, self.backend.params, self.state, self.cache,
-                    self._next_key(), self.sparams,
+                emitted, mask, self.state, self.cache = self.backend.decode_slots(
+                    self.state, self.cache, self._next_key(), self.sparams,
                     num_steps=self.chunk_steps,
                 )
                 packed = G.pack_chunk(emitted, mask, self.state.active)

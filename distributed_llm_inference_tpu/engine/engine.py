@@ -92,10 +92,15 @@ class SingleDeviceBackend:
 
     # greedy prompt-lookup speculative decode (engine opts in per request)
     supports_speculative = True
-    # slot decode for continuous batching (engine/continuous.py): needs raw
-    # params under a plain jit — the SPMD backends' shard_map programs
-    # can't host the per-row-position fleet
+    # slot decode for continuous batching (engine/continuous.py);
+    # PipelineBackend provides a shard_map equivalent
     supports_slots = True
+
+    def decode_slots(self, state, cache, key, sparams, *, num_steps):
+        return G.decode_slots(
+            self.cfg, self.params, state, cache, key, sparams,
+            num_steps=num_steps,
+        )
 
     def decode_speculative(self, first_token, cache, hist, hist_len, limit,
                            *, max_steps, draft_len):

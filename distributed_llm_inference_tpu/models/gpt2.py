@@ -23,7 +23,13 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..ops.attention import attend, causal_mask, update_kv_cache
+from ..ops.attention import (
+    attend,
+    causal_mask,
+    slot_causal_mask,
+    update_kv_cache,
+    update_kv_cache_slots,
+)
 from ..ops.flash_attention import flash_attend
 from ..ops.norms import layer_norm
 
@@ -102,11 +108,17 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
     k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, H, Dh)
     v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
 
-    new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    if cfg.attn_impl == "pallas":
-        attn = flash_attend(q, new_k, new_v, pos)
-    else:
+    if pos.ndim == 1:  # continuous-batching slots: per-row positions
+        new_k, new_v = update_kv_cache_slots(
+            cache_k, cache_v, k, v, pos, gate=update_gate
+        )
         attn = attend(q, new_k, new_v, mask)
+    else:
+        new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
+        if cfg.attn_impl == "pallas":
+            attn = flash_attend(q, new_k, new_v, pos)
+        else:
+            attn = attend(q, new_k, new_v, mask)
     attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
@@ -121,10 +133,17 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
 
 
 def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
-    """Scan the stacked GPT-2 blocks over a chunk (any contiguous slice)."""
+    """Scan the stacked GPT-2 blocks over a chunk (any contiguous slice).
+    pos: scalar chunk offset, or a per-row [B] vector (continuous-batching
+    slots — GPT-2 CAN slot-batch: unlike ragged left-padding, every slot
+    starts at position 0, so learned absolute positions stay exact)."""
     T = x.shape[1]
     S = cache["k"].shape[3]
-    mask = causal_mask(pos, T, S)
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 1:
+        mask = slot_causal_mask(pos, T, S)
+    else:
+        mask = causal_mask(pos, T, S)
 
     def body(carry, xs):
         xc = carry
@@ -138,9 +157,14 @@ def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
 
 
 def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, pos=0) -> jnp.ndarray:
-    """Token + learned position embeddings. pos: chunk offset (scalar)."""
+    """Token + learned position embeddings. pos: chunk offset (scalar), or
+    a per-row [B] vector (slots mode: each row at its own position)."""
     T = tokens.shape[1]
-    positions = jnp.asarray(pos, jnp.int32) + jnp.arange(T, dtype=jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 1:
+        positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]
+        return params["embed"][tokens] + params["pos_embed"][positions]
+    positions = pos + jnp.arange(T, dtype=jnp.int32)
     return params["embed"][tokens] + params["pos_embed"][positions][None, :, :]
 
 

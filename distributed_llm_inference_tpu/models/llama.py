@@ -144,7 +144,9 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     shared scalar position).
     """
     if pos.ndim == 1:
-        new_k, new_v = update_kv_cache_slots(cache_k, cache_v, k, v, pos)
+        new_k, new_v = update_kv_cache_slots(
+            cache_k, cache_v, k, v, pos, gate=update_gate
+        )
         attn = attend(
             q, new_k, new_v, mask,
             scale=cfg.query_scale, softcap=cfg.attn_softcap,

@@ -106,15 +106,24 @@ def update_kv_cache_slots(
     k_new: jnp.ndarray,
     v_new: jnp.ndarray,
     pos: jnp.ndarray,
+    gate=None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-row cache write at per-row offsets pos [B] (continuous batching:
     every slot is at its own sequence position). vmapped
     `dynamic_update_slice` over the batch axis — same clamp caveat as
-    `update_kv_cache`, enforced per slot by the continuous engine."""
+    `update_kv_cache`, enforced per slot by the continuous engine.
+
+    gate: optional traced bool (shared across rows) — when False the write
+    is a no-op, selected over the written slices only. The pipeline slots
+    program needs it: stages execute speculatively on microsteps where
+    they don't own the fleet's buffer."""
     k_new = k_new.transpose(0, 2, 1, 3)  # [B, KV, T, Dh]
     v_new = v_new.transpose(0, 2, 1, 3)
 
     def row(ck, kn, p):
+        if gate is not None:
+            old = jax.lax.dynamic_slice(ck, (jnp.int32(0), p, jnp.int32(0)), kn.shape)
+            kn = jnp.where(gate, kn, old)
         return jax.lax.dynamic_update_slice(ck, kn, (jnp.int32(0), p, jnp.int32(0)))
 
     cache_k = jax.vmap(row)(cache_k, k_new, pos)

@@ -299,6 +299,80 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
+    # -- continuous batching (slot decode) over the pp ring -----------------
+    @property
+    def supports_slots(self) -> bool:
+        """Slot decode (engine/continuous.py) on the pipeline mesh: the
+        fleet's B rows are SLOTS, not data shards, so the host's slot
+        bookkeeping requires dp == 1 (tp/ep replicate the batch and
+        compose fine). Both families: gpt2's learned positions are exact
+        in slots mode — every slot starts at position 0 (no left-pad)."""
+        return self.dp == 1 and self.cfg.arch in ("llama", "gpt2")
+
+    def decode_slots(self, state, cache, key, sparams, *, num_steps):
+        fn = self._programs.get(("slots", num_steps))
+        if fn is None:
+            fn = self._build_decode_slots(num_steps)
+            self._programs[("slots", num_steps)] = fn
+        return fn(self.shared, self.layers, state, cache, key, sparams)
+
+    def _build_decode_slots(self, num_steps: int):
+        """shard_map slot-decode chunk: same per-row-position fleet as
+        engine/generate.decode_slots, but each step's forward is S ring
+        microsteps over the pp stages (cache writes gated per microstep,
+        exactly like plain pipeline decode). Sampling keys/params are
+        replicated, so every device computes identical tokens and state —
+        the host reads one copy."""
+        cfg, S = self.cfg, self.pp
+
+        def body(shared, layers, state, cache, key, sparams):
+            pad = jnp.int32(cfg.pad_token_id)
+
+            def step(carry, sub):
+                state, cache = carry
+                x = embed_sharded(cfg, shared, state.token[:, None], state.pos, S)
+                buf, cache = self._microstep_loop(layers, x, cache, state.pos)
+                s = jax.lax.axis_index(AXIS_PP)
+                last = jax.lax.psum(
+                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
+                    AXIS_PP,
+                )
+                logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
+                nxt = sample_token(
+                    sub, logits,
+                    sparams.temperature[:, None], sparams.top_k[:, None],
+                    sparams.top_p[:, None], sparams.greedy,
+                )
+                can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
+                emit = jnp.where(can_emit, nxt, pad)
+                new = state._replace(
+                    token=jnp.where(can_emit, nxt, pad),
+                    pos=state.pos + state.active.astype(jnp.int32),
+                    active=can_emit & (state.remaining > 1),
+                    remaining=state.remaining - can_emit.astype(jnp.int32),
+                )
+                return (new, cache), (emit, can_emit)
+
+            subs = jax.random.split(key, num_steps)
+            (state, cache), (emitted, emit_mask) = jax.lax.scan(
+                step, (state, cache), subs
+            )
+            return emitted, emit_mask, state, cache
+
+        from ..engine.generate import SlotParams, SlotState as _SS
+
+        state_specs = _SS(P(), P(), P(), P())
+        sparam_specs = SlotParams(P(), P(), P(), P())
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, state_specs,
+                cache_spec(), P(), sparam_specs,
+            ),
+            out_specs=(P(), P(), state_specs, cache_spec()),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
     def _build_decode(self, max_steps: int):
         return self._build_decode_any(max_steps, ragged=False)
 

@@ -95,8 +95,13 @@ def embed_sharded(cfg: ModelConfig, shared: dict, tokens: jnp.ndarray, pos, pp: 
         x = x * jnp.asarray(cfg.dim ** 0.5, x.dtype)
     if cfg.use_learned_pos:  # gpt2: add (replicated) position rows once
         T = tokens.shape[1]
-        positions = jnp.asarray(pos, jnp.int32) + jnp.arange(T, dtype=jnp.int32)
-        x = x + shared["pos_embed"][positions][None, :, :]
+        pos = jnp.asarray(pos, jnp.int32)
+        if pos.ndim == 1:  # slots mode: per-row positions
+            positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+            x = x + shared["pos_embed"][positions]
+        else:
+            positions = pos + jnp.arange(T, dtype=jnp.int32)
+            x = x + shared["pos_embed"][positions][None, :, :]
     return x
 
 

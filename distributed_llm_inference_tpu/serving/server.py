@@ -407,8 +407,8 @@ def main(argv: Optional[list] = None):
         "--continuous", type=int, default=0, metavar="SLOTS",
         help="continuous (in-flight) batching: a fleet of SLOTS KV-cache "
              "rows decodes in lock-step and new requests join free slots "
-             "mid-flight (single-device llama family; 0 = disabled; "
-             "mutually exclusive with --queue)",
+             "mid-flight (llama + gpt2 families; single chip or a pp mesh "
+             "with dp=1; 0 = disabled; mutually exclusive with --queue)",
     )
     ap.add_argument(
         "--continuous-chunk", type=int, default=16,
@@ -497,6 +497,11 @@ def main(argv: Optional[list] = None):
         )
         if args.warmup:
             w = continuous.warmup()
+            if not w["ok"]:
+                raise SystemExit(
+                    f"--warmup failed on the continuous engine: {w}\n"
+                    f"fix the configuration or start without --warmup"
+                )
             print(f"✅ continuous warm in {w['seconds']}s")
     elif args.queue > 0:
         from .queue import BatchingQueue

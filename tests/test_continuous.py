@@ -223,10 +223,10 @@ def test_seeded_request_falls_back_solo(solo_engine):
 
 
 def test_rejects_unsupported_configs(solo_engine):
-    cfg = get_model_config("test-gpt2-tiny")
-    eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
-    with pytest.raises(ValueError, match="llama-family"):
-        ContinuousEngine(eng)
+    eng0 = object.__new__(InferenceEngine)
+    eng0.cfg = solo_engine.cfg.replace(arch="t5")  # unsupported arch
+    with pytest.raises(ValueError, match="families"):
+        ContinuousEngine(eng0)
 
     class NoSlots:
         name = "fake"
@@ -237,6 +237,42 @@ def test_rejects_unsupported_configs(solo_engine):
     eng2.backend = NoSlots()
     with pytest.raises(ValueError, match="slot"):
         ContinuousEngine(eng2)
+
+
+def test_gpt2_continuous_matches_solo():
+    """GPT-2 CAN slot-batch (unlike ragged left-padding: every slot starts
+    at position 0, so learned absolute positions stay exact): staggered
+    concurrent requests match solo greedy runs."""
+    cfg = get_model_config("test-gpt2-tiny")
+    eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64)))
+    solo = {
+        p: eng.generate(p, max_tokens=8, greedy=True, chat=False)
+        for p in PROMPTS[:3]
+    }
+    cont = ContinuousEngine(eng, n_slots=2, chunk_steps=4)
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def run(p, delay):
+            time.sleep(delay)
+            r = cont.submit(p, max_tokens=8, greedy=True, chat=False)
+            with lock:
+                results[p] = r
+
+        threads = [
+            threading.Thread(target=run, args=(p, 0.05 * i))
+            for i, p in enumerate(PROMPTS[:3])
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        for p in PROMPTS[:3]:
+            assert results[p]["status"] == "success", results[p]
+            assert results[p]["response"] == solo[p]["response"], p
+    finally:
+        cont.close()
 
 
 def test_deadline_expired_in_queue_does_not_kill_engine(solo_engine):
@@ -333,6 +369,67 @@ def test_stream_seeded_falls_back_single_event(solo_engine):
         assert events[0]["status"] == "success" and events[0]["done"] is True
     finally:
         cont.close()
+
+
+def test_pipeline_continuous_matches_solo(solo_engine, eight_devices):
+    """In-flight batching over a pp=2 pipeline mesh: staggered concurrent
+    requests through the shard_map slot fleet match their solo
+    single-device greedy runs exactly."""
+    from distributed_llm_inference_tpu import MeshConfig
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = solo_engine.cfg
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=1), jax.devices())
+    pb = PipelineBackend(cfg, solo_engine.backend.params, mesh)
+    assert pb.supports_slots
+    eng = InferenceEngine(
+        cfg, backend=pb, engine_cfg=EngineConfig(prefill_buckets=(32, 64))
+    )
+    solo = {
+        p: solo_engine.generate(p, max_tokens=8, greedy=True, chat=False)
+        for p in PROMPTS[:3]
+    }
+    cont = ContinuousEngine(eng, n_slots=2, chunk_steps=4)
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def run(p, delay):
+            time.sleep(delay)
+            r = cont.submit(p, max_tokens=8, greedy=True, chat=False)
+            with lock:
+                results[p] = r
+
+        threads = [
+            threading.Thread(target=run, args=(p, 0.1 * i))
+            for i, p in enumerate(PROMPTS[:3])
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for p in PROMPTS[:3]:
+            assert results[p]["status"] == "success", results[p]
+            assert results[p]["response"] == solo[p]["response"], p
+    finally:
+        cont.close()
+
+
+def test_pipeline_continuous_rejects_dp(solo_engine, eight_devices):
+    from distributed_llm_inference_tpu import MeshConfig
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = solo_engine.cfg
+    mesh = build_mesh(MeshConfig(dp=2, pp=2, tp=1), jax.devices())
+    pb = PipelineBackend(cfg, solo_engine.backend.params, mesh)
+    assert not pb.supports_slots
+    eng = InferenceEngine(
+        cfg, backend=pb, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+    with pytest.raises(ValueError, match="slot"):
+        ContinuousEngine(eng)
 
 
 def test_over_long_prompt_invalid_request(solo_engine):

@@ -71,7 +71,11 @@ def test_phi3_preset_and_chat():
     from distributed_llm_inference_tpu.engine.chat import format_chat_prompt
 
     t = format_chat_prompt("hi", arch="llama", template="phi3")
-    assert t.startswith("<|user|>") and t.endswith("<|assistant|>\n")
+    # native <|system|> role (HF Phi-3 chat template has a system turn)
+    assert t.startswith("<|system|>\n") and "<|user|>\nhi<|end|>" in t
+    assert t.endswith("<|assistant|>\n")
+    t2 = format_chat_prompt("hi", system="", arch="llama", template="phi3")
+    assert t2.startswith("<|user|>")
 
 
 def test_phi3_engine_smoke():
