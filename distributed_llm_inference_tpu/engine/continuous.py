@@ -54,7 +54,8 @@ class _Request:
     __slots__ = (
         "prompt", "kwargs", "done", "result", "t_start", "ttft",
         "first_id", "tokens", "slot", "enqueued", "budget",
-        "stream_q", "streamed_text", "record",
+        "stream_q", "streamed_text", "record", "prefix_hit_tokens",
+        "cancelled",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None):
@@ -74,6 +75,8 @@ class _Request:
         self.stream_q = stream_q
         self.streamed_text = ""  # chars already emitted (BPE-safe deltas)
         self.record = True  # False: warmup traffic, kept out of /stats
+        self.prefix_hit_tokens = 0  # prompt tokens served from the prefix cache
+        self.cancelled = False  # client went away; free the slot early
 
 
 class ContinuousEngine:
@@ -114,6 +117,21 @@ class ContinuousEngine:
         self.state, self.sparams = G.init_slots(self.n_slots)
         self._scratch = self.backend.init_cache(1, cfg.max_seq_len)
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
+        # Own PrefixCache instance (engine/prefix.py), NOT shared with the
+        # solo engine's: the solo path touches its cache under the engine
+        # lock while this worker thread runs lock-free — separate instances
+        # cost duplicate snapshots at worst, never a race.
+        self._prefix = None
+        if engine.engine_cfg.prefix_cache_entries > 0:
+            from .prefix import PrefixCache
+
+            if PrefixCache.compatible(self._scratch):
+                self._prefix = PrefixCache(
+                    engine.engine_cfg.prefix_cache_entries,
+                    engine.engine_cfg.prefix_chunk,
+                )
+            else:
+                log.info("prefix_cache_disabled", reason="cache layout")
 
         self._cv = threading.Condition()
         self._queue: list[_Request] = []
@@ -197,11 +215,32 @@ class ContinuousEngine:
         if err is not None:  # the consumer may block on a slow socket write
             yield {**err, "done": True}
             return
-        while True:
-            ev = q.get()
-            yield ev
-            if ev.get("done"):
+        try:
+            while True:
+                ev = q.get()
+                yield ev
+                if ev.get("done"):
+                    return
+        finally:
+            # consumer abandoned the generator mid-stream (client socket
+            # dropped, handler called close()): cancel so the slot frees
+            # for queued requests instead of decoding to its full budget
+            if not req.done.is_set():
+                self.cancel(req)
+
+    def cancel(self, req: _Request):
+        """Cancel a request: dequeue it if still waiting, or flag it for
+        the worker to kill its slot at the next chunk boundary."""
+        with self._cv:
+            if req in self._queue:
+                self._queue.remove(req)
+                req.result = {
+                    "error": "Error: request cancelled", "status": "failed",
+                    "error_type": "cancelled",
+                }
+                self._push_final(req)
                 return
+            req.cancelled = True
 
     def _stream_tokens(self, req: _Request, final: bool = False):
         """Push the not-yet-streamed suffix of req's text (worker thread).
@@ -271,7 +310,7 @@ class ContinuousEngine:
 
     def stats(self) -> dict:
         with self._cv:
-            return {
+            out = {
                 "slots": self.n_slots,
                 "occupied": sum(r is not None for r in self._assignment),
                 "queued": len(self._queue),
@@ -280,6 +319,9 @@ class ContinuousEngine:
                 "peak_occupancy": self.peak_occupancy,
                 "chunk_steps": self.chunk_steps,
             }
+        if self._prefix is not None:
+            out["prefix_cache"] = self._prefix.stats()
+        return out
 
     # -- worker thread -------------------------------------------------------
     def _next_key(self):
@@ -398,7 +440,9 @@ class ContinuousEngine:
         )
         ids = eng.tokenizer.encode(text)
         prompt_len = len(ids)
-        plan = eng._plan_ingest(prompt_len, 0, eng._buckets())
+        # prefix-cache lookup + ingest plan: the solo engine's shared
+        # helper (one copy of the lookup/cold-fallback/mark discipline)
+        p0, entry, plan = eng._prefix_plan(self._prefix, ids)
         if plan is None:
             raise ValueError(
                 f"prompt length {prompt_len} exceeds the serving capacity "
@@ -412,10 +456,13 @@ class ContinuousEngine:
         key = self._next_key()
         scratch = self._scratch
         self._scratch = None
+        req.prefix_hit_tokens = p0
         try:
-            # shared ingest sequence (engine/engine.py): extend chunks +
-            # final bucket-padded prefill — same machinery as the solo path
-            first, _, scratch = eng._ingest(ids, 0, plan, scratch, key, sampling)
+            # shared splice/ingest/store sequence (engine/engine.py) —
+            # same machinery, same ordering as the solo path
+            first, _, scratch = eng._ingest_with_prefix(
+                self._prefix, ids, p0, entry, plan, scratch, key, sampling
+            )
             # prefill token is emitted token #0 (unless EOS — break-before-
             # append); the EOS check happens inside insert_slot on device
             req.budget = max_tokens - 1
@@ -465,6 +512,17 @@ class ContinuousEngine:
                 self._stream_tokens(req)
             if self._assignment[b] is req and not active[b]:
                 self._finalize(req)
+            elif req.cancelled and self._assignment[b] is req:
+                # client gone: kill the slot so the fleet admits the next
+                # queued request instead of decoding to the dead request's
+                # full budget
+                self.state = G.kill_slot(self.state, b)
+                log.info("request_cancelled", slot=b)
+                req.result = {
+                    "error": "Error: request cancelled", "status": "failed",
+                    "error_type": "cancelled",
+                }
+                self._release(req)
             elif deadline and now - req.t_start > deadline:
                 # in-flight overrun: kill the slot, fail the request; the
                 # fleet keeps decoding for everyone else
@@ -501,6 +559,8 @@ class ContinuousEngine:
             "backend": "continuous",
             "continuous": True,
         }
+        if req.prefix_hit_tokens:
+            req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
         log.info(
             "completed", slot=req.slot, tokens=n, elapsed_s=round(elapsed, 3),
             tokens_per_sec=round(tps, 2),

@@ -370,6 +370,39 @@ class InferenceEngine:
             tokens, jnp.int32(tail_start), jnp.int32(rem), cache, key, sampling
         )
 
+    def _prefix_plan(self, prefix, ids: list):
+        """Prefix-cache lookup + ingest planning, ONE copy for the solo and
+        continuous paths: lookup -> plan the tail -> cold fallback when no
+        tail plan fits -> mark hit/miss on the PLANNED outcome (a lookup
+        hit that fell back cold is a miss). Returns (p0, entry, plan);
+        prefix may be None (plain cold plan)."""
+        buckets = self._buckets()
+        prompt_len = len(ids)
+        p0, entry, pkey = 0, None, None
+        if prefix is not None:
+            p0, entry, pkey = prefix.lookup(ids)
+        plan = self._plan_ingest(prompt_len, p0, buckets)
+        if plan is None and p0:
+            p0, entry = 0, None
+            plan = self._plan_ingest(prompt_len, 0, buckets)
+        if prefix is not None:
+            prefix.mark(pkey, hit=bool(p0) and plan is not None)
+        return p0, entry, plan
+
+    def _ingest_with_prefix(
+        self, prefix, ids, p0, entry, plan, cache, key, sampling
+    ):
+        """Splice a prefix hit, run the shared ingest sequence, store the
+        (now complete) prompt KV back into the prefix cache. The
+        splice-before-ingest / store-after-ingest ordering is correctness-
+        critical (the stored snapshot must cover the whole prompt)."""
+        if entry is not None:
+            cache = prefix.splice(entry, cache, p0)
+        first, logits, cache = self._ingest(ids, p0, plan, cache, key, sampling)
+        if prefix is not None:
+            prefix.store(ids, len(ids), cache)
+        return first, logits, cache
+
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False,
@@ -393,19 +426,8 @@ class InferenceEngine:
             log.info("prefix_cache_disabled", reason="cache layout")
             self._prefix = None
 
-        # prefix-cache lookup: reuse the KV of a stored prompt prefix and
-        # ingest only the tail (engine/prefix.py)
-        p0, entry, pkey = 0, None, None
-        if self._prefix is not None:
-            p0, entry, pkey = self._prefix.lookup(ids)
-        plan = self._plan_ingest(prompt_len, p0, buckets)
-        if plan is None and p0:
-            p0, entry = 0, None  # no fitting tail plan: fall back to cold
-            plan = self._plan_ingest(prompt_len, 0, buckets)
-        if self._prefix is not None:
-            # counted on the PLANNED outcome: a lookup hit that had to fall
-            # back to cold is a miss, not a hit
-            self._prefix.mark(pkey, hit=bool(p0) and plan is not None)
+        # prefix-cache lookup + ingest plan (shared helper; engine/prefix.py)
+        p0, entry, plan = self._prefix_plan(self._prefix, ids)
         if plan is None:
             if prompt_len > cfg.max_seq_len - 2:
                 raise ValueError(
@@ -443,13 +465,9 @@ class InferenceEngine:
 
         cache = self._cache
         self._cache = None  # donated below; restored from the decode result
-        if entry is not None:
-            cache = self._prefix.splice(entry, cache, p0)
-        first, logits, cache = self._ingest(
-            ids, p0, plan, cache, key_pre, sampling
+        first, logits, cache = self._ingest_with_prefix(
+            self._prefix, ids, p0, entry, plan, cache, key_pre, sampling
         )
-        if self._prefix is not None:
-            self._prefix.store(ids, prompt_len, cache)
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
 

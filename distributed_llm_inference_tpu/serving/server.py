@@ -262,10 +262,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                             self.wfile.write(json.dumps(ev).encode() + b"\n")
                             self.wfile.flush()
                     except (BrokenPipeError, ConnectionResetError):
-                        # client went away mid-stream: drop quietly (the
-                        # slot keeps decoding to its bounded budget; its
-                        # remaining events drain into the request queue
-                        # and are garbage-collected with it)
+                        # client went away mid-stream: closing the
+                        # generator cancels the request — the engine kills
+                        # its slot at the next chunk boundary so the fleet
+                        # serves queued work instead of a dead socket
                         gen.close()
                     return
                 if prompts is not None:

@@ -432,6 +432,82 @@ def test_pipeline_continuous_rejects_dp(solo_engine, eight_devices):
         ContinuousEngine(eng)
 
 
+def test_continuous_prefix_cache_reuse(solo_engine):
+    """A re-served shared prompt hits the continuous engine's own prefix
+    cache (prefill only the tail) and still emits exactly the solo tokens."""
+    cfg = solo_engine.cfg
+    eng = InferenceEngine(
+        cfg,
+        backend=solo_engine.backend,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=4, prefix_chunk=16
+        ),
+    )
+    prompt = "shared prefix prompt with plenty of tokens to cross a chunk"
+    solo = solo_engine.generate(prompt, max_tokens=8, greedy=True, chat=False)
+    cont = ContinuousEngine(eng, n_slots=2, chunk_steps=4)
+    try:
+        r1 = cont.submit(prompt, max_tokens=8, greedy=True, chat=False)
+        assert r1["status"] == "success"
+        assert r1["response"] == solo["response"]
+        r2 = cont.submit(prompt, max_tokens=8, greedy=True, chat=False)
+        assert r2["status"] == "success"
+        assert r2["response"] == solo["response"]
+        assert r2.get("prefix_cached_tokens", 0) >= 16  # tail-only prefill
+        s = cont.stats()
+        assert s["prefix_cache"]["hits"] >= 1
+    finally:
+        cont.close()
+
+
+def test_stream_abandon_cancels_slot(solo_engine):
+    """Closing a streaming generator mid-flight cancels the request: its
+    slot frees early and the fleet keeps serving."""
+    cont = ContinuousEngine(solo_engine, n_slots=1, chunk_steps=2, max_queue=8)
+    try:
+        gen = cont.stream(PROMPTS[2], max_tokens=64, greedy=True, chat=False)
+        first_ev = next(gen)
+        assert "delta" in first_ev
+        gen.close()  # abandon: engine must cancel, not decode 64 tokens
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if cont.stats()["occupied"] == 0:
+                break
+            time.sleep(0.2)
+        assert cont.stats()["occupied"] == 0, "cancelled slot never freed"
+        # fleet still serves
+        r = cont.submit("after cancel", max_tokens=3, greedy=True, chat=False)
+        assert r["status"] == "success"
+    finally:
+        cont.close()
+
+
+def test_cancel_while_queued(solo_engine):
+    """cancel() on a still-queued request dequeues it immediately with a
+    cancelled envelope."""
+    cont = ContinuousEngine(solo_engine, n_slots=1, chunk_steps=2, max_queue=8)
+    try:
+        # occupy the single slot
+        blocker = threading.Thread(
+            target=lambda: cont.submit(
+                PROMPTS[0], max_tokens=32, greedy=True, chat=False
+            )
+        )
+        blocker.start()
+        time.sleep(0.3)
+        from distributed_llm_inference_tpu.engine.continuous import _Request
+
+        req = _Request("queued victim", dict(max_tokens=4, greedy=True, chat=False))
+        err = cont._enqueue(req)
+        assert err is None
+        cont.cancel(req)
+        assert req.done.is_set()
+        assert req.result["error_type"] == "cancelled"
+        blocker.join(timeout=120)
+    finally:
+        cont.close()
+
+
 def test_over_long_prompt_invalid_request(solo_engine):
     cont = ContinuousEngine(solo_engine, n_slots=1, chunk_steps=4)
     try:
