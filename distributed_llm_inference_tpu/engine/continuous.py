@@ -114,7 +114,7 @@ class ContinuousEngine:
         self.max_queue = int(max_queue)
 
         self.cache = self.backend.init_cache(self.n_slots, cfg.max_seq_len)
-        self.state, self.sparams = G.init_slots(self.n_slots)
+        self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
         self._scratch = self.backend.init_cache(1, cfg.max_seq_len)
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
         # Own PrefixCache instance (engine/prefix.py), NOT shared with the
@@ -452,25 +452,39 @@ class ContinuousEngine:
         sampling = G.default_sampling(
             k.get("temperature", 0.7), k.get("top_k", 50),
             k.get("top_p", 0.9), k.get("greedy", False),
+            k.get("min_p", 0.0), k.get("repetition_penalty", 1.0),
         )
         key = self._next_key()
         scratch = self._scratch
         self._scratch = None
         req.prefix_hit_tokens = p0
+        # repetition-penalty state: the prompt's token-id set, host-built.
+        # The fleet always carries presence (a 1.0 penalty is an exact
+        # no-op in the sampler), but the prefill's first-token sample only
+        # gets it when the penalty is on — keeping the default prefill
+        # program identical to the solo path's.
+        rp = float(k.get("repetition_penalty", 1.0))
+        presence = eng._presence_rows([ids]) if rp != 1.0 else None
         try:
             # shared splice/ingest/store sequence (engine/engine.py) —
             # same machinery, same ordering as the solo path
             first, _, scratch = eng._ingest_with_prefix(
-                self._prefix, ids, p0, entry, plan, scratch, key, sampling
+                self._prefix, ids, p0, entry, plan, scratch, key, sampling,
+                presence=presence,
             )
             # prefill token is emitted token #0 (unless EOS — break-before-
             # append); the EOS check happens inside insert_slot on device
             req.budget = max_tokens - 1
+            presence_row = (
+                presence[0] if presence is not None
+                else jnp.zeros((cfg.vocab_size,), bool)
+            )
             self.cache, self.state, self.sparams = G.insert_slot(
                 cfg, self.cache, scratch, self.state, self.sparams, slot,
                 first[0], jnp.int32(prompt_len), jnp.int32(max_tokens),
                 sampling.temperature, sampling.top_k, sampling.top_p,
-                sampling.greedy,
+                sampling.greedy, sampling.min_p, sampling.rep_penalty,
+                presence_row,
             )
             self._scratch = scratch
         finally:

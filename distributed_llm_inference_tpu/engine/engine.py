@@ -62,13 +62,16 @@ class SingleDeviceBackend:
     def init_cache(self, batch: int, max_seq: int):
         return M.init_kv_cache(self.cfg, batch, max_seq=max_seq)
 
-    def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
+    def prefill(self, tokens, prompt_len, cache, key, sampling,
+                valid_start=None, presence=None):
         # pos always passed as a traced array so ordinary prefill, warmup,
         # and the chunked final chunk all share one compiled program per
-        # bucket shape
+        # bucket shape. presence [B, V] (repetition-penalty token set) is
+        # None on the default path — penalized requests trace their own
+        # program variant, the reference-parity path stays untouched.
         return G.prefill(
             self.cfg, self.params, tokens, prompt_len, cache, key, sampling,
-            valid_start, jnp.int32(0),
+            valid_start, jnp.int32(0), presence,
         )
 
     # chunked prefill (prompts longer than the largest bucket); the engine
@@ -77,21 +80,24 @@ class SingleDeviceBackend:
     def extend(self, tokens, pos, cache):
         return G.extend(self.cfg, self.params, tokens, pos, cache)
 
-    def prefill_at(self, tokens, pos, valid_len, cache, key, sampling):
+    def prefill_at(self, tokens, pos, valid_len, cache, key, sampling,
+                   presence=None):
         return G.prefill(
             self.cfg, self.params, tokens, valid_len, cache, key, sampling,
-            None, pos,
+            None, pos, presence,
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, *, max_steps):
+               valid_start=None, presence=None, *, max_steps):
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
-            sampling, valid_start, max_steps=max_steps,
+            sampling, valid_start, presence, max_steps=max_steps,
         )
 
     # greedy prompt-lookup speculative decode (engine opts in per request)
     supports_speculative = True
+    # HF-parity repetition penalty (presence-tracked decode variants)
+    supports_presence = True
     # slot decode for continuous batching (engine/continuous.py);
     # PipelineBackend provides a shard_map equivalent
     supports_slots = True
@@ -275,6 +281,8 @@ class InferenceEngine:
         seed: Optional[int] = None,
         debug: bool = False,
         speculative: bool = False,
+        min_p: float = 0.0,
+        repetition_penalty: float = 1.0,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -286,6 +294,10 @@ class InferenceEngine:
         repetitive text; every emitted token is still an argmax — exact
         vs plain greedy in fp32, while bf16 may resolve numerical
         near-ties differently); ignored otherwise.
+        min_p / repetition_penalty: HF-parity sampling extensions
+        (MinPLogitsWarper / RepetitionPenaltyLogitsProcessor; 0.0 / 1.0 =
+        off). A repetition penalty disables speculation: it changes the
+        argmax the draft verification compares against.
         """
         t_start = time.time()
 
@@ -293,7 +305,8 @@ class InferenceEngine:
             with self._lock:
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-                    seed, t_start, debug, speculative,
+                    seed, t_start, debug, speculative, min_p,
+                    repetition_penalty,
                 )
 
         try:
@@ -343,12 +356,14 @@ class InferenceEngine:
             return None
         return n_full, rem, fitting[0], chunk
 
-    def _ingest(self, ids, p0, plan, cache, key, sampling):
+    def _ingest(self, ids, p0, plan, cache, key, sampling, presence=None):
         """Feed ids[p0:] into `cache` per a `_plan_ingest` plan: n_full
         full-chunk extend() calls, then the final bucket-padded sampling
         chunk (prefill at offset 0, prefill_at otherwise). Shared by the
         solo engine and the continuous engine's admission path — one copy
-        of the ingest sequence to fix. Returns (first, logits, cache)."""
+        of the ingest sequence to fix. Returns (first, logits, cache).
+        presence: optional [1, V] repetition-penalty token set for the
+        first-token sample."""
         n_full, rem, bucket, chunk = plan
         pad = self.cfg.pad_token_id
         for c in range(n_full):
@@ -364,10 +379,12 @@ class InferenceEngine:
         )
         if tail_start == 0:
             return self.backend.prefill(
-                tokens, jnp.int32(len(ids)), cache, key, sampling
+                tokens, jnp.int32(len(ids)), cache, key, sampling,
+                presence=presence,
             )
         return self.backend.prefill_at(
-            tokens, jnp.int32(tail_start), jnp.int32(rem), cache, key, sampling
+            tokens, jnp.int32(tail_start), jnp.int32(rem), cache, key,
+            sampling, presence=presence,
         )
 
     def _prefix_plan(self, prefix, ids: list):
@@ -390,7 +407,8 @@ class InferenceEngine:
         return p0, entry, plan
 
     def _ingest_with_prefix(
-        self, prefix, ids, p0, entry, plan, cache, key, sampling
+        self, prefix, ids, p0, entry, plan, cache, key, sampling,
+        presence=None,
     ):
         """Splice a prefix hit, run the shared ingest sequence, store the
         (now complete) prompt KV back into the prefix cache. The
@@ -398,14 +416,28 @@ class InferenceEngine:
         critical (the stored snapshot must cover the whole prompt)."""
         if entry is not None:
             cache = prefix.splice(entry, cache, p0)
-        first, logits, cache = self._ingest(ids, p0, plan, cache, key, sampling)
+        first, logits, cache = self._ingest(
+            ids, p0, plan, cache, key, sampling, presence=presence
+        )
         if prefix is not None:
             prefix.store(ids, len(ids), cache)
         return first, logits, cache
 
+    def _presence_rows(self, rows: list) -> jnp.ndarray:
+        """[len(rows), V] bool: each row's token-id set, built host-side in
+        numpy (the full prompt is already a host list — no device pass
+        needed, and chunked prefill / prefix-cache hits see every token)."""
+        import numpy as np
+
+        out = np.zeros((len(rows), self.cfg.vocab_size), bool)
+        for b, ids in enumerate(rows):
+            out[b, np.asarray(ids, dtype=np.int64)] = True
+        return jnp.asarray(out)
+
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-        seed, t_start, debug=False, speculative=False,
+        seed, t_start, debug=False, speculative=False, min_p=0.0,
+        repetition_penalty=1.0,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -452,6 +484,9 @@ class InferenceEngine:
         use_spec = (
             speculative
             and greedy
+            # a repetition penalty changes the argmax the draft
+            # verification compares against — plain decode instead
+            and repetition_penalty == 1.0
             and getattr(self.backend, "supports_speculative", False)
         )
         max_tokens, decode_bucket = self._clamp_decode(
@@ -459,14 +494,31 @@ class InferenceEngine:
             headroom=SPEC_DRAFT_LEN if use_spec else 0,
         )
 
-        sampling = G.default_sampling(temperature, top_k, top_p, greedy)
+        sampling = G.default_sampling(
+            temperature, top_k, top_p, greedy, min_p, repetition_penalty
+        )
+        # presence (repetition-penalty token set): only materialized when
+        # the penalty is on, so the reference-parity path keeps its exact
+        # compiled programs
+        if repetition_penalty != 1.0 and not getattr(
+            self.backend, "supports_presence", False
+        ):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support "
+                f"repetition_penalty; serve penalized requests on the "
+                f"single-device or pipeline backend"
+            )
+        presence = (
+            self._presence_rows([ids]) if repetition_penalty != 1.0 else None
+        )
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
 
         cache = self._cache
         self._cache = None  # donated below; restored from the decode result
         first, logits, cache = self._ingest_with_prefix(
-            self._prefix, ids, p0, entry, plan, cache, key_pre, sampling
+            self._prefix, ids, p0, entry, plan, cache, key_pre, sampling,
+            presence=presence,
         )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
@@ -484,9 +536,11 @@ class InferenceEngine:
                 draft_len=SPEC_DRAFT_LEN,
             )
         else:
+            if presence is not None:
+                presence = G.presence_update(presence, first.reshape(1))
             out, n_gen, cache = self.backend.decode(
                 first, cache, jnp.int32(prompt_len), jnp.int32(max_tokens - 1),
-                key_dec, sampling, max_steps=decode_bucket,
+                key_dec, sampling, presence=presence, max_steps=decode_bucket,
             )
         out = jax.block_until_ready(out)
         self._cache = cache
@@ -605,6 +659,25 @@ class InferenceEngine:
                     max_steps=db,
                 )
                 n += 1
+            if getattr(self.backend, "supports_presence", False):
+                # repetition-penalty (presence) program variants — 'no
+                # request pays jit latency' covers penalized requests too.
+                # Single-stream only: batched penalized programs compile on
+                # first use (rarer path; the grid would double warmup).
+                pres1 = jnp.zeros((1, self.cfg.vocab_size), bool)
+                for bucket in buckets:
+                    tokens = jnp.full((1, bucket), pad, jnp.int32)
+                    first, _, cache = self.backend.prefill(
+                        tokens, jnp.int32(1), cache, key, sampling,
+                        presence=pres1,
+                    )
+                    n += 1
+                for db in decode_buckets:
+                    _, _, cache = self.backend.decode(
+                        first, cache, jnp.int32(1), jnp.int32(0), key,
+                        sampling, presence=pres1, max_steps=db,
+                    )
+                    n += 1
             if getattr(self.backend, "supports_speculative", False):
                 # speculative programs too — 'no request pays jit latency'
                 # includes speculative=true requests
@@ -663,6 +736,8 @@ class InferenceEngine:
         greedy: bool = False,
         chat: bool = True,
         seed: Optional[int] = None,
+        min_p: float = 0.0,
+        repetition_penalty: float = 1.0,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).
 
@@ -681,7 +756,7 @@ class InferenceEngine:
             with self._lock:
                 return self._generate_batch_locked(
                     prompts, max_tokens, temperature, top_k, top_p, greedy,
-                    chat, seed, t_start,
+                    chat, seed, t_start, min_p, repetition_penalty,
                 )
 
         try:
@@ -696,7 +771,7 @@ class InferenceEngine:
 
     def _generate_batch_locked(
         self, prompts, max_tokens, temperature, top_k, top_p, greedy, chat,
-        seed, t_start
+        seed, t_start, min_p=0.0, repetition_penalty=1.0,
     ):
         cfg = self.cfg
         if not prompts or not all(isinstance(p, str) and p for p in prompts):
@@ -740,7 +815,20 @@ class InferenceEngine:
             jnp.int32,
         )
         valid_start = jnp.asarray([bucket - n for n in row_lens], jnp.int32)
-        sampling = G.default_sampling(temperature, top_k, top_p, greedy)
+        sampling = G.default_sampling(
+            temperature, top_k, top_p, greedy, min_p, repetition_penalty
+        )
+        if repetition_penalty != 1.0 and not getattr(
+            self.backend, "supports_presence", False
+        ):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support "
+                f"repetition_penalty; serve penalized requests on the "
+                f"single-device or pipeline backend"
+            )
+        presence = (
+            self._presence_rows(rows) if repetition_penalty != 1.0 else None
+        )
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
 
@@ -750,7 +838,8 @@ class InferenceEngine:
         if cache is None:
             cache = self.backend.init_cache(Bb, cfg.max_seq_len)
         first, logits, cache = self.backend.prefill(
-            tokens, jnp.int32(bucket), cache, key_pre, sampling, valid_start
+            tokens, jnp.int32(bucket), cache, key_pre, sampling, valid_start,
+            presence=presence,
         )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
@@ -760,9 +849,11 @@ class InferenceEngine:
         # real rows are done
         if Bb > B:
             first = first.at[B:].set(cfg.eos_token_id)
+        if presence is not None:
+            presence = G.presence_update(presence, first)
         out, n_gen, cache = self.backend.decode(
             first, cache, jnp.int32(bucket), jnp.int32(max_tokens - 1),
-            key_dec, sampling, valid_start, max_steps=decode_bucket,
+            key_dec, sampling, valid_start, presence, max_steps=decode_bucket,
         )
         out = jax.block_until_ready(out)
         # keep at most ONE batch cache (the bucket just used): an entry per

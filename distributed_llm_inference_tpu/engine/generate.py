@@ -35,18 +35,40 @@ from ..ops.sampling import sample_token
 
 
 class SamplingParams(NamedTuple):
-    """Traced sampling knobs (one compiled program serves all values)."""
+    """Traced sampling knobs (one compiled program serves all values).
+
+    Field order matches ops/sampling.sample_token's positional tail, so
+    `sample_token(key, logits, *sampling, presence)` is the universal call.
+    min_p / rep_penalty are HF-parity extensions (MinPLogitsWarper /
+    RepetitionPenaltyLogitsProcessor); their disabled values (0.0 / 1.0)
+    reproduce the reference's exact stack.
+    """
 
     temperature: jnp.ndarray  # f32 scalar
     top_k: jnp.ndarray  # i32 scalar, <=0 disables
     top_p: jnp.ndarray  # f32 scalar, >=1 disables
     greedy: jnp.ndarray  # bool scalar
+    min_p: jnp.ndarray  # f32 scalar, <=0 disables
+    rep_penalty: jnp.ndarray  # f32 scalar, 1.0 disables
 
 
-def default_sampling(temperature=0.7, top_k=50, top_p=0.9, greedy=False) -> SamplingParams:
+def default_sampling(
+    temperature=0.7, top_k=50, top_p=0.9, greedy=False, min_p=0.0,
+    rep_penalty=1.0,
+) -> SamplingParams:
     return SamplingParams(
-        jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p), jnp.bool_(greedy)
+        jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
+        jnp.bool_(greedy), jnp.float32(min_p), jnp.float32(rep_penalty),
     )
+
+
+def presence_update(presence: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mark tokens [B] as seen in presence [B, V] (repetition penalty
+    state). One [B, V] compare-or per decode step — trivia next to the
+    forward."""
+    V = presence.shape[-1]
+    hit = jnp.arange(V, dtype=jnp.int32)[None, :] == tokens[:, None]
+    return presence | hit
 
 
 def stop_mask(cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -73,7 +95,7 @@ def _forward_step(cfg, params, tokens, cache, pos, valid_start=None):
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill(
     cfg: ModelConfig, params, tokens, prompt_len, cache, key,
-    sampling: SamplingParams, valid_start=None, pos=None,
+    sampling: SamplingParams, valid_start=None, pos=None, presence=None,
 ):
     """Run the padded prompt (or final chunked-prefill chunk), sample the
     first token.
@@ -97,7 +119,10 @@ def prefill(
     # for dynamic_slice; prompt_len >= 1 by the engine's contract)
     last = jax.lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)  # [B,1,D]
     logits = M.unembed(cfg, params, last)[:, 0, :]
-    first = sample_token(key, logits, *sampling)
+    # presence [B, V]: the prompt's token-id set (host-built from the FULL
+    # id list, so chunked prefill and prefix-cache hits see every token) —
+    # feeds the HF-parity repetition penalty; None = penalty off
+    first = sample_token(key, logits, *sampling, presence=presence)
     return first, logits, cache
 
 
@@ -128,6 +153,7 @@ def decode(
     key,
     sampling: SamplingParams,
     valid_start=None,
+    presence=None,
     *,
     max_steps: int,
 ):
@@ -150,25 +176,34 @@ def decode(
     pad = jnp.int32(cfg.pad_token_id)
     out0 = jnp.full((B, max_steps), pad, jnp.int32)
     finished0 = stop_mask(cfg, first_token)
+    # presence [B, V]: repetition-penalty state (prompt + emitted so far,
+    # first_token marked by the caller); None = penalty off, carried as a
+    # dummy so the loop structure stays static
+    use_presence = presence is not None
+    pres0 = presence if use_presence else jnp.zeros((B, 1), jnp.bool_)
 
     def cond(c):
-        step, _, _, _, _, finished, _, _ = c
+        step, _, _, _, _, finished, _, _, _ = c
         return (step < limit) & ~jnp.all(finished)
 
     def body(c):
-        step, token, pos, cache, key, finished, out, n_gen = c
+        step, token, pos, cache, key, finished, out, n_gen, pres = c
         logits, cache = _forward_step(
             cfg, params, token[:, None], cache, pos, valid_start
         )
         key, sub = jax.random.split(key)
-        nxt = sample_token(sub, logits, *sampling)
+        nxt = sample_token(
+            sub, logits, *sampling, presence=pres if use_presence else None
+        )
+        if use_presence:
+            pres = presence_update(pres, nxt)
         is_eos = stop_mask(cfg, nxt)
         newly_finished = finished | is_eos
         emit = jnp.where(newly_finished, pad, nxt)
         out = jax.lax.dynamic_update_slice(out, emit[:, None], (jnp.int32(0), step))
         n_gen = n_gen + (~newly_finished).astype(jnp.int32)
         token = jnp.where(newly_finished, pad, nxt)
-        return step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen
+        return step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen, pres
 
     init = (
         jnp.int32(0),
@@ -179,8 +214,9 @@ def decode(
         finished0,
         out0,
         jnp.zeros((B,), jnp.int32),
+        pres0,
     )
-    _, _, _, cache, _, _, out, n_gen = jax.lax.while_loop(cond, body, init)
+    _, _, _, cache, _, _, out, n_gen, _ = jax.lax.while_loop(cond, body, init)
     return out, n_gen, cache
 
 
@@ -199,13 +235,15 @@ def decode(
 
 class SlotParams(NamedTuple):
     """Per-slot sampling knobs, all [B]-shaped (broadcast row-wise through
-    sample_token, so slots with different temperatures/top-k/top-p/greedy
-    decode together in one program)."""
+    sample_token, so slots with different temperatures/top-k/top-p/greedy/
+    min-p/repetition-penalty decode together in one program)."""
 
     temperature: jnp.ndarray  # f32 [B]
     top_k: jnp.ndarray  # i32 [B]
     top_p: jnp.ndarray  # f32 [B]
     greedy: jnp.ndarray  # bool [B]
+    min_p: jnp.ndarray  # f32 [B]
+    rep_penalty: jnp.ndarray  # f32 [B]
 
 
 class SlotState(NamedTuple):
@@ -217,23 +255,31 @@ class SlotState(NamedTuple):
     active: slot is mid-generation.
     remaining: tokens this slot may still emit (admission sets
          max_tokens - 1: the prefill token was #0, like decode's limit).
+    presence: [B, V] seen-token set per slot (repetition-penalty state:
+         prompt + emitted; armed by insert_slot, updated every step).
     """
 
     token: jnp.ndarray  # i32 [B]
     pos: jnp.ndarray  # i32 [B]
     active: jnp.ndarray  # bool [B]
     remaining: jnp.ndarray  # i32 [B]
+    presence: jnp.ndarray  # bool [B, V]
 
 
-def init_slots(n_slots: int) -> tuple[SlotState, SlotParams]:
+def init_slots(n_slots: int, vocab_size: int) -> tuple[SlotState, SlotParams]:
     z = jnp.zeros((n_slots,), jnp.int32)
     return (
-        SlotState(z, z, jnp.zeros((n_slots,), bool), z),
+        SlotState(
+            z, z, jnp.zeros((n_slots,), bool), z,
+            jnp.zeros((n_slots, vocab_size), bool),
+        ),
         SlotParams(
             jnp.ones((n_slots,), jnp.float32),
             z,
             jnp.ones((n_slots,), jnp.float32),
             jnp.ones((n_slots,), bool),
+            jnp.zeros((n_slots,), jnp.float32),
+            jnp.ones((n_slots,), jnp.float32),
         ),
     )
 
@@ -284,6 +330,9 @@ def decode_slots(
             sparams.top_k[:, None],
             sparams.top_p[:, None],
             sparams.greedy,
+            sparams.min_p[:, None],
+            sparams.rep_penalty[:, None],
+            state.presence,
         )
         # break-before-append EOS semantics (orchestration.py:181-186)
         can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
@@ -293,6 +342,7 @@ def decode_slots(
             pos=state.pos + state.active.astype(jnp.int32),
             active=can_emit & (state.remaining > 1),
             remaining=state.remaining - can_emit.astype(jnp.int32),
+            presence=presence_update(state.presence, nxt),
         )
         return (new, cache), (emit, can_emit)
 
@@ -318,6 +368,9 @@ def insert_slot(
     top_k,
     top_p,
     greedy,
+    min_p,
+    rep_penalty,
+    presence_row,
 ):
     """Splice a freshly prefilled scratch cache (batch=1, same max_seq) into
     slot row `slot` and arm its state. The whole scratch row is copied —
@@ -341,17 +394,25 @@ def insert_slot(
         return jax.lax.dynamic_update_slice(big, small, start)
 
     cache = jax.tree.map(splice, cache, scratch)
+    # presence_row [V]: the prompt's token-id set + the first token
+    # (host-built) — the slot's repetition-penalty state
+    presence_row = presence_row | (
+        jnp.arange(state.presence.shape[-1], dtype=jnp.int32) == first_token
+    )
     state = SlotState(
         token=state.token.at[slot].set(first_token),
         pos=state.pos.at[slot].set(prompt_len),
         active=state.active.at[slot].set(budget > 0),
         remaining=state.remaining.at[slot].set(budget),
+        presence=state.presence.at[slot].set(presence_row),
     )
     sparams = SlotParams(
         temperature=sparams.temperature.at[slot].set(temperature),
         top_k=sparams.top_k.at[slot].set(top_k),
         top_p=sparams.top_p.at[slot].set(top_p),
         greedy=sparams.greedy.at[slot].set(greedy),
+        min_p=sparams.min_p.at[slot].set(min_p),
+        rep_penalty=sparams.rep_penalty.at[slot].set(rep_penalty),
     )
     return cache, state, sparams
 

@@ -61,6 +61,30 @@ def top_p_filter(logits: jnp.ndarray, p: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(p >= 1.0, logits, filtered)
 
 
+def apply_repetition_penalty(
+    logits: jnp.ndarray, presence: jnp.ndarray, penalty: jnp.ndarray
+) -> jnp.ndarray:
+    """HF RepetitionPenaltyLogitsProcessor semantics: for every token
+    already present in the context (prompt + generated so far), positive
+    logits divide by the penalty and negative logits multiply by it.
+    penalty <= 0 or == 1 disables; presence: [..., V] bool."""
+    p = jnp.asarray(penalty, logits.dtype)
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    out = jnp.where(presence, penalized, logits)
+    return jnp.where((p <= 0) | (p == 1.0), logits, out)
+
+
+def min_p_filter(logits: jnp.ndarray, min_p: jnp.ndarray) -> jnp.ndarray:
+    """HF MinPLogitsWarper: drop tokens whose probability is below
+    min_p * max_prob (a dynamic floor that adapts to the model's
+    confidence). min_p <= 0 disables. Applied AFTER temperature, like HF's
+    warper ordering."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+    filtered = jnp.where(probs < floor, NEG_INF, logits)
+    return jnp.where(min_p <= 0.0, logits, filtered)
+
+
 def sample_token(
     key: jax.Array,
     logits: jnp.ndarray,
@@ -68,19 +92,31 @@ def sample_token(
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
     greedy: jnp.ndarray,
+    min_p: jnp.ndarray = None,
+    rep_penalty: jnp.ndarray = None,
+    presence: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Full sampling stack -> int32 token ids, shape logits.shape[:-1].
 
     greedy is a traced bool: argmax bypass (the BASELINE configs use greedy
-    decode; the reference always samples).
+    decode; the reference always samples). Greedy applies the repetition
+    penalty BEFORE the argmax (HF processor ordering) but ignores the
+    warpers (temperature/top-k/top-p/min-p), matching HF do_sample=False.
+
+    min_p / rep_penalty+presence are optional HF-parity extensions
+    (MinPLogitsWarper / RepetitionPenaltyLogitsProcessor); None or their
+    disabled values (0 / 1.0) reproduce the reference's exact stack.
 
     Hot-path note: this runs inside the decode `lax.scan` every token, so
     top-k and top-p share ONE descending sort (the standalone filters above
     are the unfused behavioral spec used by tests); the draw happens in
     sorted order and maps back through the sort permutation — equivalent to
     top_p_filter(top_k_filter(.)) + categorical, with 1 sort instead of 3.
+    min-p piggybacks on the same sorted probs (max prob = rank-0 prob).
     """
     logits = logits.astype(jnp.float32)
+    if rep_penalty is not None and presence is not None:
+        logits = apply_repetition_penalty(logits, presence, rep_penalty)
     scaled = apply_temperature(logits, temperature)
     vocab = scaled.shape[-1]
 
@@ -96,13 +132,24 @@ def sample_token(
     over = cum > top_p
     keep_p = ~jnp.concatenate([jnp.zeros_like(over[..., :1]), over[..., :-1]], axis=-1)
     keep_p = jnp.where(top_p >= 1.0, True, keep_p)
+    keep = keep_k & keep_p
+    if min_p is not None:
+        # sorted descending: rank 0 holds max prob. HF's warper order is
+        # temperature -> top_k -> top_p -> min_p (transformers 4.57
+        # _get_logits_processor); intersecting the keep-masks here is
+        # token-identical because min_p's ratio test is invariant under
+        # the earlier filters' renormalization and its keep set is a
+        # prefix of the sorted ranks
+        keep_m = probs >= min_p * probs[..., :1]
+        keep &= jnp.where(min_p <= 0.0, True, keep_m)
 
-    sorted_filtered = jnp.where(keep_k & keep_p, sorted_logits, NEG_INF)
+    sorted_filtered = jnp.where(keep, sorted_logits, NEG_INF)
     draw = jax.random.categorical(key, sorted_filtered, axis=-1)  # rank index
     sampled = jnp.take_along_axis(sort_idx, draw[..., None], axis=-1)[..., 0]
     # greedy uses a true argmax (first index on ties, like torch/np), NOT
     # sort_idx[..., 0]: the reversed stable ascending argsort would break
-    # ties toward the LAST index.
+    # ties toward the LAST index. Argmax of the PENALIZED logits: HF
+    # applies processors (repetition penalty) in greedy mode too.
     argmax = jnp.argmax(logits, axis=-1)
     return jnp.where(greedy, argmax, sampled).astype(jnp.int32)
 

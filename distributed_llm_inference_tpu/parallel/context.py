@@ -111,13 +111,17 @@ class ContextParallelBackend(SPMDBackendBase):
 
         return make()
 
-    def prefill(self, tokens, prompt_len, cache, key, sampling):
+    def prefill(self, tokens, prompt_len, cache, key, sampling,
+                valid_start=None, presence=None):
         if tokens.shape[1] % self.sp:
             raise ValueError(
                 f"prefill bucket {tokens.shape[1]} not divisible by sp={self.sp}; "
                 f"pick prefill_buckets that are multiples of the ring size"
             )
-        return super().prefill(tokens, prompt_len, cache, key, sampling)
+        # base class rejects valid_start/presence loudly (not wired here)
+        return super().prefill(
+            tokens, prompt_len, cache, key, sampling, valid_start, presence
+        )
 
     # -- prefill -------------------------------------------------------------
     def _build_prefill(self):
@@ -181,7 +185,13 @@ class ContextParallelBackend(SPMDBackendBase):
         return jax.jit(shmapped, donate_argnums=(4,))
 
     # -- decode --------------------------------------------------------------
-    def _build_decode(self, max_steps: int):
+    def _build_decode(self, max_steps: int, with_presence: bool = False):
+        if with_presence:
+            raise NotImplementedError(
+                f"{self.name} does not support repetition-penalty presence "
+                f"(serve penalized requests on the pipeline or single-device "
+                f"backend)"
+            )
         cfg, sp = self.cfg, self.sp
 
         def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import ModelConfig
-from ..engine.generate import SamplingParams, stop_mask
+from ..engine.generate import SamplingParams, presence_update, stop_mask
 from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
@@ -60,6 +60,10 @@ class SPMDBackendBase:
     """
 
     name = "spmd-base"
+    # HF-parity repetition penalty: subclasses whose builders accept the
+    # presence variants set this True (PipelineBackend); others reject
+    # loudly at build time
+    supports_presence = False
 
     def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
         self.cfg = cfg
@@ -87,38 +91,49 @@ class SPMDBackendBase:
     def init_cache(self, batch: int, max_seq: int):
         return init_sharded_cache(self.cfg, self.mesh, batch, max_seq)
 
-    def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
+    def prefill(self, tokens, prompt_len, cache, key, sampling,
+                valid_start=None, presence=None):
         if valid_start is not None:
             raise NotImplementedError(
                 f"{self.name} does not support ragged (valid_start) batches"
+            )
+        if presence is not None:
+            raise NotImplementedError(
+                f"{self.name} does not support repetition-penalty presence"
             )
         return self._prefill(
             self.shared, self.layers, tokens, prompt_len, cache, key, sampling
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, *, max_steps):
+               valid_start=None, presence=None, *, max_steps):
+        """One dispatch for every subclass: programs are keyed by
+        (max_steps, ragged, presence); builders that don't support a
+        variant raise NotImplementedError at build time (loud, not
+        silently wrong)."""
         ragged = valid_start is not None
-        fn = self._decode_cache.get((max_steps, ragged))
+        pres = presence is not None
+        fn = self._decode_cache.get((max_steps, ragged, pres))
         if fn is None:
             fn = (
-                self._build_decode_ragged(max_steps)
+                self._build_decode_ragged(max_steps, with_presence=pres)
                 if ragged
-                else self._build_decode(max_steps)
+                else self._build_decode(max_steps, with_presence=pres)
             )
-            self._decode_cache[(max_steps, ragged)] = fn
+            self._decode_cache[(max_steps, ragged, pres)] = fn
         # clamp: limit > max_steps would walk dynamic_update_slice off the
         # end of `out` (the start index clamps, corrupting the last column)
         # and inflate n_gen past the buffer
         limit = jnp.minimum(jnp.int32(limit), jnp.int32(max_steps))
+        args = [
+            self.shared, self.layers, first_token, cache, start_pos, limit,
+            key, sampling,
+        ]
         if ragged:
-            return fn(
-                self.shared, self.layers, first_token, cache, start_pos, limit,
-                key, sampling, valid_start,
-            )
-        return fn(
-            self.shared, self.layers, first_token, cache, start_pos, limit, key, sampling
-        )
+            args.append(valid_start)
+        if pres:
+            args.append(presence)
+        return fn(*args)
 
     def health(self) -> list[dict]:
         """Per-stage liveness — the reference's /workers sweep polls each
@@ -156,10 +171,10 @@ class SPMDBackendBase:
     def _build_prefill(self):
         raise NotImplementedError
 
-    def _build_decode(self, max_steps: int):
+    def _build_decode(self, max_steps: int, with_presence: bool = False):
         raise NotImplementedError
 
-    def _build_decode_ragged(self, max_steps: int):
+    def _build_decode_ragged(self, max_steps: int, with_presence: bool = False):
         raise NotImplementedError(
             f"{self.name} does not support ragged (valid_start) batches"
         )
@@ -183,6 +198,7 @@ class PipelineBackend(SPMDBackendBase):
     # Ragged left-padded batches thread valid_start through the llama-family
     # mask; the engine checks arch before requesting them.
     supports_ragged = True
+    supports_presence = True
 
     # -- compiled programs --------------------------------------------------
     def _microstep_loop(self, layers, x, cache, pos, valid_start=None):
@@ -216,43 +232,52 @@ class PipelineBackend(SPMDBackendBase):
             self._programs["extend"] = fn
         return fn(self.shared, self.layers, tokens, pos, cache)
 
-    def prefill_at(self, tokens, pos, valid_len, cache, key, sampling):
+    def prefill_at(self, tokens, pos, valid_len, cache, key, sampling,
+                   presence=None):
         """Final chunked-prefill chunk at traced offset `pos`; samples the
         first token off position pos + valid_len - 1."""
-        return self._prefill_any(tokens, pos, valid_len, cache, key, sampling, None)
-
-    def prefill(self, tokens, prompt_len, cache, key, sampling, valid_start=None):
         return self._prefill_any(
-            tokens, jnp.int32(0), prompt_len, cache, key, sampling, valid_start
+            tokens, pos, valid_len, cache, key, sampling, None, presence
         )
 
-    def _prefill_any(self, tokens, pos, valid_len, cache, key, sampling, valid_start):
+    def prefill(self, tokens, prompt_len, cache, key, sampling,
+                valid_start=None, presence=None):
+        return self._prefill_any(
+            tokens, jnp.int32(0), prompt_len, cache, key, sampling,
+            valid_start, presence,
+        )
+
+    def _prefill_any(self, tokens, pos, valid_len, cache, key, sampling,
+                     valid_start, presence=None):
         ragged = valid_start is not None
-        fn = self._programs.get(("prefill", ragged))
+        pres = presence is not None
+        fn = self._programs.get(("prefill", ragged, pres))
         if fn is None:
-            fn = self._build_prefill_pos(ragged)
-            self._programs[("prefill", ragged)] = fn
+            fn = self._build_prefill_pos(ragged, pres)
+            self._programs[("prefill", ragged, pres)] = fn
+        args = [self.shared, self.layers, tokens, pos, valid_len, cache, key, sampling]
         if ragged:
-            return fn(
-                self.shared, self.layers, tokens, pos, valid_len, cache, key,
-                sampling, valid_start,
-            )
-        return fn(self.shared, self.layers, tokens, pos, valid_len, cache, key, sampling)
+            args.append(valid_start)
+        if pres:
+            args.append(presence)
+        return fn(*args)
 
     def _build_prefill(self):
         # base-class hook: the pos=0 non-ragged program, via the shared
         # builder (prefill()/prefill_at() both route through _prefill_any)
-        fn = self._build_prefill_pos(False)
-        self._programs[("prefill", False)] = fn
+        fn = self._build_prefill_pos(False, False)
+        self._programs[("prefill", False, False)] = fn
         return lambda shared, layers, tokens, prompt_len, cache, key, sampling: fn(
             shared, layers, tokens, jnp.int32(0), prompt_len, cache, key, sampling
         )
 
-    def _build_prefill_pos(self, ragged: bool):
+    def _build_prefill_pos(self, ragged: bool, with_presence: bool = False):
         cfg, S = self.cfg, self.pp
 
         def body(shared, layers, tokens, pos, valid_len, cache, key, sampling,
-                 valid_start=None):
+                 *extra):
+            valid_start = extra[0] if ragged else None
+            presence = extra[-1] if with_presence else None
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             x = embed_sharded(cfg, shared, tokens, pos, S)
@@ -265,7 +290,7 @@ class PipelineBackend(SPMDBackendBase):
                 jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
             )
             logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
-            first = sample_token(key, logits, *sampling)
+            first = sample_token(key, logits, *sampling, presence=presence)
             return first, logits, cache
 
         specs = [
@@ -273,6 +298,8 @@ class PipelineBackend(SPMDBackendBase):
             cache_spec(), P(), P(),
         ]
         if ragged:
+            specs.append(P(AXIS_DP))
+        if with_presence:
             specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,
@@ -342,6 +369,8 @@ class PipelineBackend(SPMDBackendBase):
                     sub, logits,
                     sparams.temperature[:, None], sparams.top_k[:, None],
                     sparams.top_p[:, None], sparams.greedy,
+                    sparams.min_p[:, None], sparams.rep_penalty[:, None],
+                    state.presence,
                 )
                 can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
                 emit = jnp.where(can_emit, nxt, pad)
@@ -350,6 +379,7 @@ class PipelineBackend(SPMDBackendBase):
                     pos=state.pos + state.active.astype(jnp.int32),
                     active=can_emit & (state.remaining > 1),
                     remaining=state.remaining - can_emit.astype(jnp.int32),
+                    presence=presence_update(state.presence, nxt),
                 )
                 return (new, cache), (emit, can_emit)
 
@@ -361,8 +391,8 @@ class PipelineBackend(SPMDBackendBase):
 
         from ..engine.generate import SlotParams, SlotState as _SS
 
-        state_specs = _SS(P(), P(), P(), P())
-        sparam_specs = SlotParams(P(), P(), P(), P())
+        state_specs = _SS(P(), P(), P(), P(), P())
+        sparam_specs = SlotParams(P(), P(), P(), P(), P(), P())
         shmapped = self._shard(
             body,
             in_specs=(
@@ -373,30 +403,40 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped, donate_argnums=(3,))
 
-    def _build_decode(self, max_steps: int):
-        return self._build_decode_any(max_steps, ragged=False)
+    def _build_decode(self, max_steps: int, with_presence: bool = False):
+        return self._build_decode_any(
+            max_steps, ragged=False, with_presence=with_presence
+        )
 
-    def _build_decode_ragged(self, max_steps: int):
-        return self._build_decode_any(max_steps, ragged=True)
+    def _build_decode_ragged(self, max_steps: int, with_presence: bool = False):
+        return self._build_decode_any(
+            max_steps, ragged=True, with_presence=with_presence
+        )
 
-    def _build_decode_any(self, max_steps: int, *, ragged: bool):
+    def _build_decode_any(self, max_steps: int, *, ragged: bool,
+                          with_presence: bool = False):
         cfg, S = self.cfg, self.pp
 
         def body(shared, layers, first_token, cache, start_pos, limit, key,
-                 sampling, valid_start=None):
+                 sampling, *extra):
+            valid_start = extra[0] if ragged else None
+            presence0 = extra[-1] if with_presence else None
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             B = first_token.shape[0]
             pad = jnp.int32(cfg.pad_token_id)
             out0 = jnp.full((B, max_steps), pad, jnp.int32)
             finished0 = stop_mask(cfg, first_token)
+            pres0 = (
+                presence0 if with_presence else jnp.zeros((B, 1), jnp.bool_)
+            )
 
             def cond(c):
-                step, _, _, _, _, finished, _, _ = c
+                step, _, _, _, _, finished, _, _, _ = c
                 return (step < limit) & ~jnp.all(finished)
 
             def step_fn(c):
-                step, token, pos, cache, key, finished, out, n_gen = c
+                step, token, pos, cache, key, finished, out, n_gen, pres = c
                 x = embed_sharded(cfg, shared, token[:, None], pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos, valid_start)
                 # broadcast stage 0's real [B, 1, D] output (a masked psum
@@ -410,7 +450,12 @@ class PipelineBackend(SPMDBackendBase):
                 )
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 key, sub = jax.random.split(key)
-                nxt = sample_token(sub, logits, *sampling)
+                nxt = sample_token(
+                    sub, logits, *sampling,
+                    presence=pres if with_presence else None,
+                )
+                if with_presence:
+                    pres = presence_update(pres, nxt)
                 is_eos = stop_mask(cfg, nxt)
                 newly = finished | is_eos
                 emit = jnp.where(newly, pad, nxt)
@@ -419,7 +464,7 @@ class PipelineBackend(SPMDBackendBase):
                 )
                 n_gen = n_gen + (~newly).astype(jnp.int32)
                 token = jnp.where(newly, pad, nxt)
-                return step + 1, token, pos + 1, cache, key, newly, out, n_gen
+                return step + 1, token, pos + 1, cache, key, newly, out, n_gen, pres
 
             init = (
                 jnp.int32(0),
@@ -430,8 +475,11 @@ class PipelineBackend(SPMDBackendBase):
                 finished0,
                 out0,
                 jnp.zeros((B,), jnp.int32),
+                pres0,
             )
-            _, _, _, cache, _, _, out, n_gen = jax.lax.while_loop(cond, step_fn, init)
+            _, _, _, cache, _, _, out, n_gen, _ = jax.lax.while_loop(
+                cond, step_fn, init
+            )
             return out, n_gen, cache
 
         specs = [
@@ -439,6 +487,8 @@ class PipelineBackend(SPMDBackendBase):
             P(), P(), P(), P(),
         ]
         if ragged:
+            specs.append(P(AXIS_DP))
+        if with_presence:
             specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,

@@ -217,7 +217,12 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         return jax.jit(shmapped, donate_argnums=(4,))
 
     # -- decode -------------------------------------------------------------
-    def _build_decode(self, max_steps: int):
+    def _build_decode(self, max_steps: int, with_presence: bool = False):
+        if with_presence:
+            raise NotImplementedError(
+                f"{self.name} does not support repetition-penalty presence "
+                f"(serve penalized requests on the plain pipeline backend)"
+            )
         cfg, S, Mb = self.cfg, self.pp, self.n_microbatches
         perm = _ring_perm(S)
         pad = jnp.int32(cfg.pad_token_id)

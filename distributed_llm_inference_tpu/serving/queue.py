@@ -59,6 +59,7 @@ class _Pending:
         return (
             k.get("max_tokens"), k.get("temperature"), k.get("top_k"),
             k.get("top_p"), k.get("greedy"), k.get("chat"),
+            k.get("min_p", 0.0), k.get("repetition_penalty", 1.0),
         )
 
 

@@ -236,6 +236,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     greedy=_parse_bool(data.get("greedy", False), "greedy"),
                     chat=_parse_bool(data.get("chat", True), "chat"),
                     seed=int(seed) if seed is not None else None,
+                    # HF-parity extensions (0.0 / 1.0 = off)
+                    min_p=float(data.get("min_p", 0.0)),
+                    repetition_penalty=float(
+                        data.get("repetition_penalty", 1.0)
+                    ),
                 )
                 if _parse_bool(data.get("stream", False), "stream"):
                     # NDJSON token streaming: one {"delta": ...} line per

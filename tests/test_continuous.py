@@ -64,13 +64,15 @@ def test_decode_slots_matches_plain_decode(solo_engine):
 
     # slots: same prefill spliced into slot 2 of a 4-slot fleet
     cache_b = backend.init_cache(4, cfg.max_seq_len)
-    state, sparams = G.init_slots(4)
+    state, sparams = G.init_slots(4, cfg.vocab_size)
     scratch = backend.init_cache(1, cfg.max_seq_len)
     first_b, _, scratch = backend.prefill(tokens, plen, scratch, key, sampling)
     cache_b, state, sparams = G.insert_slot(
         cfg, cache_b, scratch, state, sparams, 2, first_b[0], plen,
         jnp.int32(13),
         jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), jnp.bool_(True),
+        jnp.float32(0.0), jnp.float32(1.0),
+        jnp.zeros((cfg.vocab_size,), bool),
     )
     emitted, mask, state, cache_b = G.decode_slots(
         cfg, backend.params, state, cache_b, key, sparams, num_steps=14

@@ -1,0 +1,192 @@
+"""HF-parity tests for the sampling extensions: repetition penalty + min-p.
+
+The HF logits processors (RepetitionPenaltyLogitsProcessor, MinPLogitsWarper)
+are the behavioral spec, checked directly on logits; then end-to-end greedy
+generation with a repetition penalty is checked token-for-token against HF
+`generate` on a tiny-random llama — across the solo, pipeline, and
+continuous-slots paths.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from distributed_llm_inference_tpu import EngineConfig, MeshConfig, get_model_config
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.models.convert import params_from_hf_model
+from distributed_llm_inference_tpu.ops.sampling import (
+    apply_repetition_penalty,
+    min_p_filter,
+    sample_token,
+)
+
+
+def test_repetition_penalty_matches_hf_processor():
+    from transformers import RepetitionPenaltyLogitsProcessor
+
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(2, 64)).astype(np.float32)
+    input_ids = np.array([[3, 7, 7, 12], [1, 2, 3, 4]], dtype=np.int64)
+    proc = RepetitionPenaltyLogitsProcessor(penalty=1.7)
+    want = proc(torch.from_numpy(input_ids), torch.from_numpy(logits)).numpy()
+
+    presence = np.zeros((2, 64), bool)
+    for b in range(2):
+        presence[b, input_ids[b]] = True
+    got = apply_repetition_penalty(
+        jnp.asarray(logits), jnp.asarray(presence), jnp.float32(1.7)
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6, atol=1e-6)
+
+
+def test_min_p_matches_hf_warper():
+    from transformers import MinPLogitsWarper
+
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(3, 64)).astype(np.float32) * 3
+    warper = MinPLogitsWarper(min_p=0.2)
+    want = warper(None, torch.from_numpy(logits)).numpy()
+    got = np.asarray(min_p_filter(jnp.asarray(logits), jnp.float32(0.2)))
+    # both mark removed tokens with a large negative; compare the KEEP masks
+    # and the surviving values
+    np.testing.assert_array_equal(np.isfinite(want) & (want > -1e30),
+                                  got > -1e30)
+    keep = got > -1e30
+    np.testing.assert_allclose(got[keep], logits[keep])
+
+
+def test_min_p_in_fused_sampler_restricts_support():
+    """With a sharp distribution and min_p, only the dominant tokens can be
+    drawn (the fused sampler's keep-mask matches the spec filter)."""
+    logits = jnp.asarray([[10.0, 9.9, 0.0, -5.0] + [-20.0] * 60], jnp.float32)
+    draws = set()
+    for i in range(50):
+        t = sample_token(
+            jax.random.PRNGKey(i), logits,
+            jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
+            jnp.bool_(False), jnp.float32(0.5), None, None,
+        )
+        draws.add(int(t[0]))
+    assert draws <= {0, 1}, draws
+
+
+def _tiny_hf_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _hf_greedy_penalized(hf, ids, n_new, penalty):
+    out = hf.generate(
+        torch.tensor([ids]), max_new_tokens=n_new, do_sample=False,
+        repetition_penalty=penalty, use_cache=True,
+        pad_token_id=0,
+    )
+    return [int(t) for t in out[0][len(ids):]]
+
+
+@pytest.fixture(scope="module")
+def penalized_setup():
+    hf = _tiny_hf_llama()
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    cfg = cfg.replace(eos_token_id=-1)  # force full-length generation
+    rng = np.random.default_rng(5)
+    ids = [int(t) for t in rng.integers(3, 250, size=12)]
+    want = _hf_greedy_penalized(hf, ids, 10, 1.8)
+    return cfg, params, ids, want
+
+
+def _engine_tokens(engine, ids, want_len, **kw):
+    prompt = "".join(chr(min(i, 110)) for i in ids)  # placeholder; use ids directly
+
+    # bypass the tokenizer: encode() must produce exactly `ids`
+    class FixedTok:
+        def encode(self, text):
+            return list(ids)
+
+        def decode(self, toks, skip_special_tokens=True):
+            return " ".join(str(t) for t in toks)
+
+    engine.tokenizer = FixedTok()
+    r = engine.generate(
+        prompt, max_tokens=want_len, greedy=True, chat=False,
+        repetition_penalty=1.8, **kw,
+    )
+    assert r["status"] == "success", r
+    return [int(t) for t in r["response"].split()]
+
+
+def test_greedy_repetition_penalty_matches_hf_generate(penalized_setup):
+    cfg, params, ids, want = penalized_setup
+    eng = InferenceEngine(
+        cfg, params=params, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+    got = _engine_tokens(eng, ids, len(want))
+    assert got == want
+
+
+def test_pipeline_repetition_penalty_matches_hf(penalized_setup, eight_devices):
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg, params, ids, want = penalized_setup
+    mesh = build_mesh(MeshConfig(dp=1, pp=3, tp=1), eight_devices)
+    eng = InferenceEngine(
+        cfg, backend=PipelineBackend(cfg, params, mesh),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    got = _engine_tokens(eng, ids, len(want))
+    assert got == want
+
+
+def test_continuous_repetition_penalty_matches_hf(penalized_setup):
+    cfg, params, ids, want = penalized_setup
+    eng = InferenceEngine(
+        cfg, params=params, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+
+    class FixedTok:
+        def encode(self, text):
+            return list(ids)
+
+        def decode(self, toks, skip_special_tokens=True):
+            return " ".join(str(t) for t in toks)
+
+    eng.tokenizer = FixedTok()
+    cont = ContinuousEngine(eng, n_slots=2, chunk_steps=4)
+    try:
+        r = cont.submit(
+            "x", max_tokens=len(want), greedy=True, chat=False,
+            repetition_penalty=1.8,
+        )
+        assert r["status"] == "success", r
+        got = [int(t) for t in r["response"].split()]
+        assert got == want
+    finally:
+        cont.close()
+
+
+def test_penalty_disables_speculation(penalized_setup):
+    """speculative=true with a repetition penalty falls back to plain
+    decode (the penalty changes the argmax the draft verifies against) —
+    and still matches HF."""
+    cfg, params, ids, want = penalized_setup
+    eng = InferenceEngine(
+        cfg, params=params, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+    got = _engine_tokens(eng, ids, len(want), speculative=True)
+    assert got == want
