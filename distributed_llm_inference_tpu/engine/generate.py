@@ -316,34 +316,12 @@ def decode_slots(
     where a real token was emitted, the host's only token-vs-pad oracle —
     state, cache).
     """
-    pad = jnp.int32(cfg.pad_token_id)
-
     def body(carry, sub):
         state, cache = carry
         logits, cache = _forward_step(
             cfg, params, state.token[:, None], cache, state.pos
         )
-        nxt = sample_token(
-            sub,
-            logits,
-            sparams.temperature[:, None],
-            sparams.top_k[:, None],
-            sparams.top_p[:, None],
-            sparams.greedy,
-            sparams.min_p[:, None],
-            sparams.rep_penalty[:, None],
-            state.presence,
-        )
-        # break-before-append EOS semantics (orchestration.py:181-186)
-        can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
-        emit = jnp.where(can_emit, nxt, pad)
-        new = SlotState(
-            token=jnp.where(can_emit, nxt, pad),
-            pos=state.pos + state.active.astype(jnp.int32),
-            active=can_emit & (state.remaining > 1),
-            remaining=state.remaining - can_emit.astype(jnp.int32),
-            presence=presence_update(state.presence, nxt),
-        )
+        new, emit, can_emit = slot_step(cfg, state, sparams, logits, sub)
         return (new, cache), (emit, can_emit)
 
     subs = jax.random.split(key, num_steps)
@@ -351,6 +329,37 @@ def decode_slots(
         body, (state, cache), subs
     )
     return emitted, emit_mask, state, cache
+
+
+def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
+              logits, key):
+    """ONE copy of the per-step slot sampling/bookkeeping — the single-chip
+    decode_slots scan and the pipeline's shard_map slots program both call
+    this, so the cross-backend token-parity guarantee can't drift.
+    Returns (new_state, emit [B], can_emit [B])."""
+    pad = jnp.int32(cfg.pad_token_id)
+    nxt = sample_token(
+        key,
+        logits,
+        sparams.temperature[:, None],
+        sparams.top_k[:, None],
+        sparams.top_p[:, None],
+        sparams.greedy,
+        sparams.min_p[:, None],
+        sparams.rep_penalty[:, None],
+        state.presence,
+    )
+    # break-before-append EOS semantics (orchestration.py:181-186)
+    can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
+    emit = jnp.where(can_emit, nxt, pad)
+    new = SlotState(
+        token=jnp.where(can_emit, nxt, pad),
+        pos=state.pos + state.active.astype(jnp.int32),
+        active=can_emit & (state.remaining > 1),
+        remaining=state.remaining - can_emit.astype(jnp.int32),
+        presence=presence_update(state.presence, nxt),
+    )
+    return new, emit, can_emit
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))

@@ -37,7 +37,13 @@ from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
 from .pipeline import SPMDBackendBase
-from .ring import cp_decode_attend, cp_kv_write, cp_select_slot, ring_attend
+from .ring import (
+    cp_decode_attend,
+    cp_kv_write,
+    cp_select_slot,
+    ring_attend,
+    ulysses_attend,
+)
 
 # pos_ids/fill carry a leading dp axis: each dp ring decodes independently
 # (its while_loop may exit at a different step), so its slot bookkeeping
@@ -56,7 +62,13 @@ class ContextParallelBackend(SPMDBackendBase):
 
     name = "context-parallel"
 
-    def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
+    def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh,
+                 sp_strategy: str = "ring"):
+        if sp_strategy not in ("ring", "ulysses"):
+            raise ValueError(
+                f"sp_strategy must be 'ring' or 'ulysses', got {sp_strategy!r}"
+            )
+        self.sp_strategy = sp_strategy
         if cfg.arch != "llama":
             raise NotImplementedError(
                 f"context parallelism is wired for the llama family (attn_hook "
@@ -79,6 +91,14 @@ class ContextParallelBackend(SPMDBackendBase):
         self.sp = int(mesh.shape[AXIS_SP])
         if self.sp < 2:
             raise ValueError("ContextParallelBackend needs sp >= 2")
+        if sp_strategy == "ulysses" and (
+            cfg.n_heads % self.sp or cfg.n_kv_heads % self.sp
+        ):
+            raise ValueError(
+                f"ulysses scatters heads over sp={self.sp}: needs n_heads "
+                f"({cfg.n_heads}) and n_kv_heads ({cfg.n_kv_heads}) "
+                f"divisible by sp (use sp_strategy='ring')"
+            )
         super().__init__(cfg, params, mesh)
         self.n_stages = self.sp  # /workers reports context shards
 
@@ -127,8 +147,12 @@ class ContextParallelBackend(SPMDBackendBase):
     def _build_prefill(self):
         cfg = self.cfg
 
+        prefill_attend = (
+            ulysses_attend if self.sp_strategy == "ulysses" else ring_attend
+        )
+
         def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None):
-            attn = ring_attend(q, k, v, AXIS_SP)
+            attn = prefill_attend(q, k, v, AXIS_SP)
             zero = jnp.int32(0)
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
             vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)

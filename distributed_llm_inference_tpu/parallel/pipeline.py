@@ -351,10 +351,9 @@ class PipelineBackend(SPMDBackendBase):
         replicated, so every device computes identical tokens and state —
         the host reads one copy."""
         cfg, S = self.cfg, self.pp
+        from ..engine.generate import slot_step
 
         def body(shared, layers, state, cache, key, sparams):
-            pad = jnp.int32(cfg.pad_token_id)
-
             def step(carry, sub):
                 state, cache = carry
                 x = embed_sharded(cfg, shared, state.token[:, None], state.pos, S)
@@ -365,22 +364,9 @@ class PipelineBackend(SPMDBackendBase):
                     AXIS_PP,
                 )
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
-                nxt = sample_token(
-                    sub, logits,
-                    sparams.temperature[:, None], sparams.top_k[:, None],
-                    sparams.top_p[:, None], sparams.greedy,
-                    sparams.min_p[:, None], sparams.rep_penalty[:, None],
-                    state.presence,
-                )
-                can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
-                emit = jnp.where(can_emit, nxt, pad)
-                new = state._replace(
-                    token=jnp.where(can_emit, nxt, pad),
-                    pos=state.pos + state.active.astype(jnp.int32),
-                    active=can_emit & (state.remaining > 1),
-                    remaining=state.remaining - can_emit.astype(jnp.int32),
-                    presence=presence_update(state.presence, nxt),
-                )
+                # shared per-step sampling/bookkeeping (engine/generate.py):
+                # the cross-backend token-parity guarantee lives in ONE place
+                new, emit, can_emit = slot_step(cfg, state, sparams, logits, sub)
                 return (new, cache), (emit, can_emit)
 
             subs = jax.random.split(key, num_steps)

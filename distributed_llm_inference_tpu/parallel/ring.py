@@ -108,6 +108,76 @@ def ring_attend(
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Tc, H, Dh).astype(q.dtype)
 
 
+def ulysses_attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str = AXIS_SP,
+) -> jnp.ndarray:
+    """Ulysses-style (DeepSpeed) sequence parallelism: two all-to-alls
+    instead of a ring.
+
+    Input is sequence-sharded like ring_attend (device i holds positions
+    [i*Tc, (i+1)*Tc)). One `all_to_all` re-shards from sequence to HEADS —
+    every device then holds the FULL sequence for H/sp of the heads — local
+    full causal attention runs with no per-step collective, and a second
+    all_to_all restores the sequence sharding. Versus the ring: 2 fat a2a
+    hops instead of sp-1 thin ppermute hops, and plain (unrolled-free)
+    attention in between — typically wins when sp is large or the chunk is
+    small enough that ring step overhead dominates.
+
+    Requires n_heads % sp == 0 AND n_kv_heads % sp == 0 (kv heads scatter
+    too). q [B,Tc,H,Dh], k/v [B,Tc,KV,Dh] -> [B,Tc,H,Dh].
+    """
+    sp = jax.lax.psum(1, axis_name)
+    B, Tc, H, Dh = q.shape
+    # seq -> heads: split the head axis sp ways, concat chunks on the
+    # sequence axis (tiled a2a concatenates in ring order, so positions
+    # stay globally ordered)
+    qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    T = qh.shape[1]  # full sequence
+    Hl, KVl = qh.shape[2], kh.shape[2]
+    G = Hl // KVl
+    scale = Dh**-0.5
+
+    # Local full-sequence attention in KEY BLOCKS with an online-softmax
+    # accumulator — an unblocked [T, T] score matrix would peak sp x ring's
+    # attention memory on exactly the long contexts the sp axis exists
+    # for; blocked at Tc keys, the peak is Hl x T x Tc scores, the same
+    # H·T²/sp² as one ring step.
+    qg = (qh.astype(jnp.float32) * scale).reshape(B, T, KVl, G, Dh)
+    q_pos = jnp.arange(T, dtype=jnp.int32)
+
+    def block(s, carry):
+        m, l, acc = carry
+        kc = jax.lax.dynamic_slice_in_dim(kh, s * Tc, Tc, axis=1)
+        vc = jax.lax.dynamic_slice_in_dim(vh, s * Tc, Tc, axis=1)
+        kv_pos = s * Tc + jnp.arange(Tc, dtype=jnp.int32)
+        mask = kv_pos[None, :] <= q_pos[:, None]  # [T, Tc]
+        scores = _gqa_scores(qg, kc)  # [B,KVl,G,T,Tc]
+        scores = jnp.where(mask[None, None, None], scores, _NEG)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(mask[None, None, None], p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bkgts,bskd->bkgtd", p, vc.astype(jnp.float32)
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((B, KVl, G, T, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, KVl, G, T, 1), jnp.float32)
+    a0 = jnp.zeros((B, KVl, G, T, Dh), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, sp, block, (m0, l0, a0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l).transpose(0, 3, 1, 2, 4).reshape(B, T, Hl, Dh).astype(q.dtype)
+    # heads -> seq: inverse a2a
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
 def cp_decode_attend(
     q: jnp.ndarray,
     cache_k: jnp.ndarray,

@@ -30,6 +30,7 @@ def create_backend(
     dtype: Optional[str] = None,
     quant: Optional[str] = None,
     seed: int = 0,
+    sp_strategy: str = "ring",
 ):
     """Build a compute backend alone (no engine/tokenizer around it).
 
@@ -79,7 +80,9 @@ def create_backend(
         )
     if mesh_cfg.sp > 1:
         mesh = build_mesh(mesh_cfg)
-        return cfg, ContextParallelBackend(cfg, params, mesh)
+        return cfg, ContextParallelBackend(
+            cfg, params, mesh, sp_strategy=sp_strategy
+        )
     if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1 or mesh_cfg.ep > 1:
         mesh = build_mesh(mesh_cfg)
         return cfg, PipelineBackend(cfg, params, mesh)
@@ -96,6 +99,7 @@ def create_engine(
     quant: Optional[str] = None,
     tokenizer: Any = None,
     seed: int = 0,
+    sp_strategy: str = "ring",
 ) -> InferenceEngine:
     """Build an engine; pp>1 selects the SPMD pipeline backend.
 
@@ -113,7 +117,7 @@ def create_engine(
         )
     cfg, backend = create_backend(
         model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, quant=quant,
-        seed=seed,
+        seed=seed, sp_strategy=sp_strategy,
     )
     return InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed

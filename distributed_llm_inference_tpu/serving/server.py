@@ -379,6 +379,12 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
     ap.add_argument("--sp", type=int, default=1, help="context-parallel ring size")
+    ap.add_argument(
+        "--sp-strategy", default="ring", choices=["ring", "ulysses"],
+        help="long-context prefill strategy over the sp axis: 'ring' "
+             "(K/V rotate via ppermute) or 'ulysses' (two all-to-alls "
+             "re-shard sequence<->heads; needs heads divisible by sp)",
+    )
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--ep", type=int, default=1, help="expert-parallel width (MoE)")
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
@@ -472,6 +478,7 @@ def main(argv: Optional[list] = None):
         dtype=args.dtype,
         quant=args.quant,
         seed=args.seed,
+        sp_strategy=args.sp_strategy,
     )
     if args.warmup:
         print("⏳ warming up (compiling all bucket shapes)...")

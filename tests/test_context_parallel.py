@@ -135,3 +135,44 @@ def test_cp_prefill_heavy_shard_does_not_overflow():
     )
     assert got[2].tolist() == ref[2].tolist()
     assert got[3].tolist() == ref[3].tolist()
+
+
+@pytest.mark.parametrize("sp,plen", [(2, 13), (4, 9)])
+def test_ulysses_matches_single_device(sp, plen):
+    """Ulysses (all-to-all head-scatter) prefill == single device: same
+    greedy decode tokens, same first-token logits. test-llama-tiny has
+    n_kv_heads=2, so sp=4 uses an MHA variant (kv heads must scatter)."""
+    cfg = get_model_config("test-llama-tiny")
+    if cfg.n_kv_heads % sp:
+        cfg = cfg.replace(n_kv_heads=cfg.n_heads)  # MHA so heads split
+    params = M.init_params(cfg, jax.random.PRNGKey(2))
+    bucket, steps, max_seq = 16, 6, 48
+    rng = np.random.default_rng(3)
+    ids = rng.integers(3, cfg.vocab_size, size=(1, plen))
+    tokens = jnp.asarray(
+        np.pad(ids, ((0, 0), (0, bucket - plen)), constant_values=cfg.pad_token_id),
+        jnp.int32,
+    )
+
+    ref_first, ref_logits, ref_out, ref_n = _run(
+        SingleDeviceBackend(cfg, params), cfg, tokens, plen, steps, max_seq
+    )
+    mesh = build_mesh(MeshConfig(sp=sp), jax.devices())
+    upb = ContextParallelBackend(cfg, params, mesh, sp_strategy="ulysses")
+    got_first, got_logits, got_out, got_n = _run(
+        upb, cfg, tokens, plen, steps, max_seq
+    )
+    np.testing.assert_allclose(got_logits, ref_logits, rtol=2e-4, atol=2e-5)
+    assert int(got_first[0]) == int(ref_first[0])
+    np.testing.assert_array_equal(got_out, ref_out)
+    np.testing.assert_array_equal(got_n, ref_n)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    cfg = get_model_config("test-llama-tiny")  # n_kv_heads=2
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(sp=4), jax.devices())
+    with pytest.raises(ValueError, match="ulysses"):
+        ContextParallelBackend(cfg, params, mesh, sp_strategy="ulysses")
+    with pytest.raises(ValueError, match="sp_strategy"):
+        ContextParallelBackend(cfg, params, mesh, sp_strategy="spiral")
