@@ -91,13 +91,19 @@ class ContextParallelBackend(SPMDBackendBase):
         self.sp = int(mesh.shape[AXIS_SP])
         if self.sp < 2:
             raise ValueError("ContextParallelBackend needs sp >= 2")
+        # tp already shards the head axis: the all_to_all splits the LOCAL
+        # head count, so the divisibility check must be tp-aware or a
+        # passing global check would crash later with an opaque trace error
+        tp = int(mesh.shape.get(AXIS_TP, 1))
         if sp_strategy == "ulysses" and (
-            cfg.n_heads % self.sp or cfg.n_kv_heads % self.sp
+            (cfg.n_heads // tp) % self.sp or (cfg.n_kv_heads // tp) % self.sp
         ):
             raise ValueError(
-                f"ulysses scatters heads over sp={self.sp}: needs n_heads "
-                f"({cfg.n_heads}) and n_kv_heads ({cfg.n_kv_heads}) "
-                f"divisible by sp (use sp_strategy='ring')"
+                f"ulysses scatters heads over sp={self.sp}: needs the LOCAL "
+                f"head counts (n_heads {cfg.n_heads} / tp {tp} = "
+                f"{cfg.n_heads // tp}, n_kv_heads {cfg.n_kv_heads} / tp {tp} "
+                f"= {cfg.n_kv_heads // tp}) divisible by sp "
+                f"(use sp_strategy='ring')"
             )
         super().__init__(cfg, params, mesh)
         self.n_stages = self.sp  # /workers reports context shards

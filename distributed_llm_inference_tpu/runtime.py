@@ -83,6 +83,13 @@ def create_backend(
         return cfg, ContextParallelBackend(
             cfg, params, mesh, sp_strategy=sp_strategy
         )
+    if sp_strategy != "ring":
+        # fail loudly: --sp-strategy ulysses without --sp > 1 would
+        # otherwise silently run with no sequence parallelism at all
+        raise ValueError(
+            f"sp_strategy={sp_strategy!r} needs a context-parallel mesh "
+            f"(sp > 1); got sp={mesh_cfg.sp}"
+        )
     if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1 or mesh_cfg.ep > 1:
         mesh = build_mesh(mesh_cfg)
         return cfg, PipelineBackend(cfg, params, mesh)

@@ -176,3 +176,20 @@ def test_ulysses_rejects_indivisible_heads():
         ContextParallelBackend(cfg, params, mesh, sp_strategy="ulysses")
     with pytest.raises(ValueError, match="sp_strategy"):
         ContextParallelBackend(cfg, params, mesh, sp_strategy="spiral")
+
+
+def test_ulysses_tp_aware_guard_and_runtime_gate():
+    """tp shards the head axis, so the ulysses divisibility check must use
+    LOCAL head counts; and --sp-strategy without sp>1 fails loudly."""
+    from distributed_llm_inference_tpu.runtime import create_backend
+
+    cfg = get_model_config("test-llama-tiny").replace(n_kv_heads=4)  # MHA
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    # 4 heads / tp=2 = 2 local heads, sp=4 > 2 -> loud ValueError
+    mesh = build_mesh(MeshConfig(sp=4, tp=2), jax.devices())
+    with pytest.raises(ValueError, match="LOCAL"):
+        ContextParallelBackend(cfg, params, mesh, sp_strategy="ulysses")
+
+    with pytest.raises(ValueError, match="sp > 1"):
+        create_backend(cfg, mesh_cfg=MeshConfig(), sp_strategy="ulysses",
+                       params=params)

@@ -196,3 +196,71 @@ def test_gpt2_file_roundtrip(tmp_path):
         cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
     )
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma2_file_roundtrip(tmp_path):
+    """Gemma-2 checkpoint through FILES: config.json carries head_dim,
+    softcaps, query_pre_attn_scalar, sliding_window, hidden_activation —
+    the _JsonConfig attribute view + config_from_hf must pick them all up
+    and the loaded params must match the in-memory conversion's logits."""
+    cfg_hf = transformers.Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128, rms_norm_eps=1e-6,
+        hidden_activation="gelu_pytorch_tanh", query_pre_attn_scalar=24,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        sliding_window=16, attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    hf = transformers.Gemma2ForCausalLM(cfg_hf)
+    hf.eval()
+    d = str(tmp_path / "gemma2")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.post_norms and cfg.attn_softcap == 50.0
+    assert cfg.head_dim == 24 and cfg.attn_window == 16
+    assert cfg.attn_window_pattern == "even" and cfg.norm_unit_offset
+    assert "window_flag" in params["layers"]
+
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 33), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=1, max_seq=64)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_phi3_file_roundtrip(tmp_path):
+    """Phi-3 checkpoint through FILES: fused qkv_proj / gate_up_proj split
+    at load, <|end|> stop id added for the big-vocab real model path
+    (vocab here is tiny so no stop id is injected)."""
+    cfg_hf = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, pad_token_id=0, eos_token_id=2,
+        bos_token_id=1, attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    hf = transformers.Phi3ForCausalLM(cfg_hf)
+    hf.eval()
+    d = str(tmp_path / "phi3")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.chat_template == "phi3"
+    assert params["layers"]["wq"].shape[-1] == cfg.n_heads * cfg.head_dim
+    assert cfg.stop_token_ids == ()  # tiny vocab: no 32007 injection
+
+    rng = np.random.default_rng(14)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 21), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
