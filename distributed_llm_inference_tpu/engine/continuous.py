@@ -242,7 +242,7 @@ class ContinuousEngine:
                 return
             req.cancelled = True
 
-    def _stream_tokens(self, req: _Request, final: bool = False):
+    def _stream_tokens(self, req: _Request, final: bool = False, pre=None):
         """Push the not-yet-streamed suffix of req's text (worker thread).
 
         Deltas are computed on the FULL decoded text, and text ending in
@@ -251,15 +251,22 @@ class ContinuousEngine:
         now and the real character later AT THE SAME LENGTH, so streaming
         it would make the joined deltas diverge from the final response.
         final=True flushes everything (a genuine trailing U+FFFD included)
-        so concat(deltas) == response exactly."""
-        gen_ids = (
-            [req.first_id] if req.first_id not in self.cfg.all_stop_ids else []
-        ) + req.tokens
+        so concat(deltas) == response exactly. Text past a textual stop
+        sequence is never streamed — and because a stop string may SPAN a
+        chunk boundary, the last max(len(stop))-1 characters are held back
+        until the next chunk resolves them (vLLM-style hold-back); the
+        final flush emits exactly up to the truncation.
+        pre: optional (gen_ids, text, hit) from the caller's _gen_text —
+        avoids re-decoding the full sequence per chunk."""
+        gen_ids, text, _ = pre if pre is not None else self._gen_text(req)
         if not gen_ids:
             return
-        text = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
         if not final:
             text = text.rstrip("�")
+            stop = req.kwargs.get("stop") or ()
+            hold = max((len(s) for s in stop if s), default=0) - 1
+            if hold > 0:
+                text = text[: max(len(req.streamed_text), len(text) - hold)]
         if len(text) > len(req.streamed_text):
             delta = text[len(req.streamed_text):]
             req.streamed_text = text
@@ -522,7 +529,21 @@ class ContinuousEngine:
                 continue  # freed/killed tenant's masked leftovers
             new = emitted[mask[:, b], b]
             req.tokens.extend(int(t) for t in new)
-            if req.stream_q is not None and len(new):
+            if len(new) and req.kwargs.get("stop"):
+                gen = self._gen_text(req)  # ONE full decode per chunk
+                if gen[2]:
+                    # a textual stop sequence fired: kill the slot NOW —
+                    # the fleet serves queued work instead of decoding
+                    # text the client will never see (solo truncates
+                    # post-hoc; the chunk boundary makes early
+                    # termination actually save here)
+                    if self._assignment[b] is req:
+                        self.state = G.kill_slot(self.state, b)
+                    self._finalize(req, pre=gen)
+                    continue
+                if req.stream_q is not None:
+                    self._stream_tokens(req, pre=gen)
+            elif req.stream_q is not None and len(new):
                 self._stream_tokens(req)
             if self._assignment[b] is req and not active[b]:
                 self._finalize(req)
@@ -549,14 +570,25 @@ class ContinuousEngine:
                 }
                 self._release(req)
 
-    def _finalize(self, req: _Request):
-        cfg = self.cfg
-        if req.stream_q is not None:
-            self._stream_tokens(req, final=True)  # flush held-back tail
+    def _gen_text(self, req: _Request) -> tuple:
+        """(full decoded text, stop-truncated text, stop hit) for req."""
         gen_ids = (
-            [req.first_id] if req.first_id not in cfg.all_stop_ids else []
+            [req.first_id] if req.first_id not in self.cfg.all_stop_ids else []
         ) + req.tokens
-        response = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
+        text = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
+        cut, hit = self.engine._truncate_at_stop(
+            text, req.kwargs.get("stop")
+        )
+        return gen_ids, cut, hit
+
+    def _finalize(self, req: _Request, pre=None):
+        gen_ids, response, stopped = (
+            pre if pre is not None else self._gen_text(req)
+        )
+        if req.stream_q is not None:
+            # flush the held-back tail (U+FFFD / stop hold-back), exactly
+            # up to the truncation
+            self._stream_tokens(req, final=True, pre=(gen_ids, response, stopped))
         elapsed = time.time() - req.t_start
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
@@ -575,6 +607,8 @@ class ContinuousEngine:
         }
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
+        if stopped:
+            req.result["stopped"] = True  # a textual stop sequence fired
         log.info(
             "completed", slot=req.slot, tokens=n, elapsed_s=round(elapsed, 3),
             tokens_per_sec=round(tps, 2),

@@ -260,6 +260,22 @@ class InferenceEngine:
         head = [first_id] if first_id not in self.cfg.all_stop_ids else []
         return head + [int(t) for t in list(row_out[:n])]
 
+    @staticmethod
+    def _truncate_at_stop(text: str, stop) -> tuple:
+        """Cut `text` at the EARLIEST occurrence of any stop string
+        (OpenAI-style "stop" sequences — the stop text itself is excluded,
+        matching the stop-token break-before-append discipline). Returns
+        (text, hit: bool)."""
+        if not stop:
+            return text, False
+        cut = min(
+            (i for i in (text.find(s) for s in stop if s) if i >= 0),
+            default=-1,
+        )
+        if cut < 0:
+            return text, False
+        return text[:cut], True
+
     def _record_sample(self, ttft: float, per_stream_tps: float, tokens: int):
         """Per-STREAM throughput sample (batch requests divide by B), so
         /stats percentiles stay comparable to the single-stream metric."""
@@ -283,6 +299,7 @@ class InferenceEngine:
         speculative: bool = False,
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
+        stop: Optional[list] = None,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -306,7 +323,7 @@ class InferenceEngine:
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
-                    repetition_penalty,
+                    repetition_penalty, stop,
                 )
 
         try:
@@ -437,7 +454,7 @@ class InferenceEngine:
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False, min_p=0.0,
-        repetition_penalty=1.0,
+        repetition_penalty=1.0, stop=None,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -547,6 +564,7 @@ class InferenceEngine:
 
         gen_ids = self._row_tokens(int(first[0]), out[0], int(n_gen[0]))
         response = self.tokenizer.decode(gen_ids, skip_special_tokens=True)
+        response, stopped = self._truncate_at_stop(response, stop)
 
         top_predictions = None
         if debug and logits.shape[-1] > 0:  # 1F1B may return 0-width logits
@@ -584,6 +602,8 @@ class InferenceEngine:
         }
         if p0:
             result["prefix_cached_tokens"] = p0
+        if stopped:
+            result["stopped"] = True  # a textual stop sequence fired
         if use_spec:
             result["speculative"] = True
         if top_predictions is not None:
@@ -738,6 +758,7 @@ class InferenceEngine:
         seed: Optional[int] = None,
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
+        stop: Optional[list] = None,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).
 
@@ -756,7 +777,7 @@ class InferenceEngine:
             with self._lock:
                 return self._generate_batch_locked(
                     prompts, max_tokens, temperature, top_k, top_p, greedy,
-                    chat, seed, t_start, min_p, repetition_penalty,
+                    chat, seed, t_start, min_p, repetition_penalty, stop,
                 )
 
         try:
@@ -771,7 +792,7 @@ class InferenceEngine:
 
     def _generate_batch_locked(
         self, prompts, max_tokens, temperature, top_k, top_p, greedy, chat,
-        seed, t_start, min_p=0.0, repetition_penalty=1.0,
+        seed, t_start, min_p=0.0, repetition_penalty=1.0, stop=None,
     ):
         cfg = self.cfg
         if not prompts or not all(isinstance(p, str) and p for p in prompts):
@@ -867,14 +888,17 @@ class InferenceEngine:
         for b in range(B):  # dummy pad rows [B, Bb) sliced off here
             row = self._row_tokens(int(first[b]), out[b], int(n_gen[b]))
             total_tokens += len(row)
-            results.append(
-                {
-                    "prompt": prompts[b],
-                    "response": self.tokenizer.decode(row, skip_special_tokens=True),
-                    "tokens_generated": len(row),
-                    "status": "success",
-                }
-            )
+            text = self.tokenizer.decode(row, skip_special_tokens=True)
+            text, row_stopped = self._truncate_at_stop(text, stop)
+            entry = {
+                "prompt": prompts[b],
+                "response": text,
+                "tokens_generated": len(row),
+                "status": "success",
+            }
+            if row_stopped:
+                entry["stopped"] = True
+            results.append(entry)
         elapsed = time.time() - t_start
         tps = total_tokens / elapsed if elapsed > 0 else 0.0
         self._record_sample(ttft, tps / B, total_tokens)

@@ -46,6 +46,14 @@ def create_backend(
         cfg = cfg.replace(dtype=dtype)
     if quant is not None:
         cfg = cfg.replace(quant=quant)
+    if sp_strategy != "ring" and mesh_cfg.sp <= 1:
+        # fail loudly BEFORE any backend branch (including microbatches):
+        # --sp-strategy ulysses without --sp > 1 would otherwise silently
+        # run with no sequence parallelism at all
+        raise ValueError(
+            f"sp_strategy={sp_strategy!r} needs a context-parallel mesh "
+            f"(sp > 1); got sp={mesh_cfg.sp}"
+        )
     if mesh_cfg.sp > 1 and (mesh_cfg.pp > 1 or microbatches > 1 or mesh_cfg.ep > 1):
         # checked before params init (the expensive step) and before the
         # microbatch branch, which would otherwise claim the sp-wide mesh
@@ -82,13 +90,6 @@ def create_backend(
         mesh = build_mesh(mesh_cfg)
         return cfg, ContextParallelBackend(
             cfg, params, mesh, sp_strategy=sp_strategy
-        )
-    if sp_strategy != "ring":
-        # fail loudly: --sp-strategy ulysses without --sp > 1 would
-        # otherwise silently run with no sequence parallelism at all
-        raise ValueError(
-            f"sp_strategy={sp_strategy!r} needs a context-parallel mesh "
-            f"(sp > 1); got sp={mesh_cfg.sp}"
         )
     if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1 or mesh_cfg.ep > 1:
         mesh = build_mesh(mesh_cfg)

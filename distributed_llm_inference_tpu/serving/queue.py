@@ -60,6 +60,7 @@ class _Pending:
             k.get("max_tokens"), k.get("temperature"), k.get("top_k"),
             k.get("top_p"), k.get("greedy"), k.get("chat"),
             k.get("min_p", 0.0), k.get("repetition_penalty", 1.0),
+            tuple(k.get("stop") or ()),
         )
 
 
@@ -270,6 +271,7 @@ class BatchingQueue:
                     "prompt": row["prompt"],
                     "response": row["response"],
                     "status": row["status"],
+                    **({"stopped": True} if row.get("stopped") else {}),
                     "time_taken": batch["time_taken"],
                     "tokens_generated": n,
                     "tokens_per_sec": f"{(n / elapsed if elapsed > 0 else 0.0):.2f}",

@@ -242,6 +242,18 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         data.get("repetition_penalty", 1.0)
                     ),
                 )
+                raw_stop = data.get("stop")
+                if raw_stop is not None:
+                    # OpenAI-style textual stop sequences: one string or a
+                    # list of strings
+                    if isinstance(raw_stop, str):
+                        raw_stop = [raw_stop]
+                    if not (
+                        isinstance(raw_stop, list)
+                        and all(isinstance(s, str) for s in raw_stop)
+                    ):
+                        raise ValueError("stop must be a string or list of strings")
+                    kwargs["stop"] = raw_stop
                 if _parse_bool(data.get("stream", False), "stream"):
                     # NDJSON token streaming: one {"delta": ...} line per
                     # decode chunk, final line = the standard envelope with

@@ -1,0 +1,99 @@
+"""Textual stop sequences ("stop": [...]): OpenAI-style truncation on the
+solo, batched, and continuous paths — with EARLY slot termination in
+continuous mode (the fleet stops decoding for a request whose stop string
+already fired)."""
+
+import threading
+import time
+
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def eng():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64)))
+
+
+def _pick_stop(engine, prompt, n=12):
+    """Find a substring the model actually generates, to use as a stop."""
+    full = engine.generate(prompt, max_tokens=n, greedy=True, chat=False)
+    text = full["response"]
+    assert len(text) >= 3, text
+    mid = len(text) // 2
+    return full, text[mid : mid + 2], text[:mid].find(text[mid : mid + 2])
+
+
+def test_solo_stop_truncates(eng):
+    full, stop_s, earlier = _pick_stop(eng, "stop solo prompt")
+    r = eng.generate(
+        "stop solo prompt", max_tokens=12, greedy=True, chat=False,
+        stop=[stop_s],
+    )
+    assert r["status"] == "success"
+    assert r["stopped"] is True
+    assert stop_s not in r["response"]
+    assert full["response"].startswith(r["response"])
+
+
+def test_batched_stop_truncates(eng):
+    full, stop_s, _ = _pick_stop(eng, "stop batch prompt")
+    r = eng.generate_batch(
+        ["stop batch prompt", "other prompt"], max_tokens=12, greedy=True,
+        chat=False, stop=[stop_s],
+    )
+    assert r["status"] == "success"
+    row = r["results"][0]
+    assert row.get("stopped") is True
+    assert stop_s not in row["response"]
+
+
+def test_continuous_stop_frees_slot_early(eng):
+    """A stop hit kills the slot at the chunk boundary: the request
+    finishes well before its token budget and the fleet keeps serving."""
+    full, stop_s, _ = _pick_stop(eng, "stop cont prompt")
+    cont = ContinuousEngine(eng, n_slots=1, chunk_steps=2)
+    try:
+        r = cont.submit(
+            "stop cont prompt", max_tokens=64, greedy=True, chat=False,
+            stop=[stop_s],
+        )
+        assert r["status"] == "success", r
+        assert r["stopped"] is True
+        assert stop_s not in r["response"]
+        # early termination: far fewer tokens than the 64 budget
+        assert r["tokens_generated"] < 40
+        r2 = cont.submit("after stop", max_tokens=3, greedy=True, chat=False)
+        assert r2["status"] == "success"
+    finally:
+        cont.close()
+
+
+def test_stream_never_crosses_stop(eng):
+    full, stop_s, _ = _pick_stop(eng, "stop stream prompt")
+    cont = ContinuousEngine(eng, n_slots=1, chunk_steps=2)
+    try:
+        events = list(
+            cont.stream(
+                "stop stream prompt", max_tokens=32, greedy=True, chat=False,
+                stop=[stop_s],
+            )
+        )
+        final = events[-1]
+        assert final["status"] == "success" and final.get("stopped") is True
+        joined = "".join(e["delta"] for e in events[:-1])
+        assert joined == final["response"]
+        assert stop_s not in joined
+    finally:
+        cont.close()
+
+
+def test_no_stop_unchanged(eng):
+    a = eng.generate("plain", max_tokens=6, greedy=True, chat=False)
+    b = eng.generate("plain", max_tokens=6, greedy=True, chat=False, stop=[])
+    assert a["response"] == b["response"]
+    assert "stopped" not in b
