@@ -150,12 +150,14 @@ class ContinuousEngine:
     @staticmethod
     def _needs_solo(kwargs: dict) -> bool:
         """Contracts slots cannot honor (deterministic RNG stream, single-
-        stream prefill logits, draft verification) run solo on the wrapped
-        engine — one condition shared by submit() and stream()."""
+        stream prefill logits, draft verification, per-token logprob
+        buffers) run solo on the wrapped engine — one condition shared by
+        submit() and stream()."""
         return (
             kwargs.get("seed") is not None
             or bool(kwargs.get("debug"))
             or bool(kwargs.get("speculative"))
+            or bool(kwargs.get("logprobs"))
         )
 
     def _enqueue(self, req: _Request) -> Optional[dict]:
@@ -529,6 +531,7 @@ class ContinuousEngine:
                 continue  # freed/killed tenant's masked leftovers
             new = emitted[mask[:, b], b]
             req.tokens.extend(int(t) for t in new)
+            gen = None
             if len(new) and req.kwargs.get("stop"):
                 gen = self._gen_text(req)  # ONE full decode per chunk
                 if gen[2]:
@@ -546,7 +549,7 @@ class ContinuousEngine:
             elif req.stream_q is not None and len(new):
                 self._stream_tokens(req)
             if self._assignment[b] is req and not active[b]:
-                self._finalize(req)
+                self._finalize(req, pre=gen)  # reuse this chunk's decode
             elif req.cancelled and self._assignment[b] is req:
                 # client gone: kill the slot so the fleet admits the next
                 # queued request instead of decoding to the dead request's

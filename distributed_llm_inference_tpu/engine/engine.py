@@ -88,16 +88,20 @@ class SingleDeviceBackend:
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, presence=None, *, max_steps):
+               valid_start=None, presence=None, *, max_steps,
+               with_logprobs=False):
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
             sampling, valid_start, presence, max_steps=max_steps,
+            with_logprobs=with_logprobs,
         )
 
     # greedy prompt-lookup speculative decode (engine opts in per request)
     supports_speculative = True
     # HF-parity repetition penalty (presence-tracked decode variants)
     supports_presence = True
+    # per-token logprobs (decode program variant with a logprob buffer)
+    supports_logprobs = True
     # slot decode for continuous batching (engine/continuous.py);
     # PipelineBackend provides a shard_map equivalent
     supports_slots = True
@@ -300,6 +304,7 @@ class InferenceEngine:
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
         stop: Optional[list] = None,
+        logprobs: bool = False,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -323,7 +328,7 @@ class InferenceEngine:
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
-                    repetition_penalty, stop,
+                    repetition_penalty, stop, logprobs,
                 )
 
         try:
@@ -454,7 +459,7 @@ class InferenceEngine:
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False, min_p=0.0,
-        repetition_penalty=1.0, stop=None,
+        repetition_penalty=1.0, stop=None, logprobs=False,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -498,12 +503,20 @@ class InferenceEngine:
                 f"{buckets[-1] if buckets else 0}"
             )
         n_full, rem, bucket, chunk = plan
+        if logprobs and not getattr(self.backend, "supports_logprobs", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support per-token "
+                f"logprobs; serve logprobs requests on the single-device "
+                f"backend"
+            )
         use_spec = (
             speculative
             and greedy
             # a repetition penalty changes the argmax the draft
-            # verification compares against — plain decode instead
+            # verification compares against — plain decode instead; and
+            # the speculative loop records no per-step logprobs
             and repetition_penalty == 1.0
+            and not logprobs
             and getattr(self.backend, "supports_speculative", False)
         )
         max_tokens, decode_bucket = self._clamp_decode(
@@ -555,16 +568,44 @@ class InferenceEngine:
         else:
             if presence is not None:
                 presence = G.presence_update(presence, first.reshape(1))
-            out, n_gen, cache = self.backend.decode(
-                first, cache, jnp.int32(prompt_len), jnp.int32(max_tokens - 1),
-                key_dec, sampling, presence=presence, max_steps=decode_bucket,
-            )
+            step_lps = None
+            if logprobs:
+                out, n_gen, cache, step_lps = self.backend.decode(
+                    first, cache, jnp.int32(prompt_len),
+                    jnp.int32(max_tokens - 1), key_dec, sampling,
+                    presence=presence, max_steps=decode_bucket,
+                    with_logprobs=True,
+                )
+            else:
+                out, n_gen, cache = self.backend.decode(
+                    first, cache, jnp.int32(prompt_len),
+                    jnp.int32(max_tokens - 1), key_dec, sampling,
+                    presence=presence, max_steps=decode_bucket,
+                )
         out = jax.block_until_ready(out)
         self._cache = cache
 
         gen_ids = self._row_tokens(int(first[0]), out[0], int(n_gen[0]))
         response = self.tokenizer.decode(gen_ids, skip_special_tokens=True)
         response, stopped = self._truncate_at_stop(response, stop)
+
+        token_logprobs = None
+        if logprobs:
+            # first token: log_softmax of the prefill logits (raw model
+            # distribution, OpenAI convention); decode steps recorded by
+            # the with_logprobs decode variant. Covers every GENERATED
+            # token (textual stop truncation cuts text, not this list).
+            import numpy as np
+
+            token_logprobs = []
+            if int(first[0]) not in self.cfg.all_stop_ids:
+                lp0 = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+                token_logprobs.append(round(float(lp0[int(first[0])]), 6))
+            if step_lps is not None:
+                token_logprobs += [
+                    round(float(x), 6)
+                    for x in np.asarray(step_lps[0][: int(n_gen[0])])
+                ]
 
         top_predictions = None
         if debug and logits.shape[-1] > 0:  # 1F1B may return 0-width logits
@@ -604,6 +645,8 @@ class InferenceEngine:
             result["prefix_cached_tokens"] = p0
         if stopped:
             result["stopped"] = True  # a textual stop sequence fired
+        if token_logprobs is not None:
+            result["token_logprobs"] = token_logprobs
         if use_spec:
             result["speculative"] = True
         if top_predictions is not None:
@@ -696,6 +739,15 @@ class InferenceEngine:
                     _, _, cache = self.backend.decode(
                         first, cache, jnp.int32(1), jnp.int32(0), key,
                         sampling, presence=pres1, max_steps=db,
+                    )
+                    n += 1
+            if getattr(self.backend, "supports_logprobs", False):
+                # the with_logprobs decode variant compiles separately
+                # (static flag adds a logprob buffer to the loop carry)
+                for db in decode_buckets:
+                    _, _, cache, _ = self.backend.decode(
+                        first, cache, jnp.int32(1), jnp.int32(0), key,
+                        sampling, max_steps=db, with_logprobs=True,
                     )
                     n += 1
             if getattr(self.backend, "supports_speculative", False):

@@ -141,7 +141,9 @@ def extend(cfg: ModelConfig, params, tokens, pos, cache):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "max_steps"), donate_argnames=("cache",)
+    jax.jit,
+    static_argnames=("cfg", "max_steps", "with_logprobs"),
+    donate_argnames=("cache",),
 )
 def decode(
     cfg: ModelConfig,
@@ -156,6 +158,7 @@ def decode(
     presence=None,
     *,
     max_steps: int,
+    with_logprobs: bool = False,
 ):
     """Early-exit decode loop after prefill.
 
@@ -166,7 +169,11 @@ def decode(
 
     Returns (tokens [B, max_steps] — pad-masked after EOS, EOS excluded,
     matching the reference's break-before-append at orchestration.py:181-186
-    — and n_gen [B] counting tokens emitted by THIS loop).
+    — and n_gen [B] counting tokens emitted by THIS loop). With
+    with_logprobs=True a 4th output [B, max_steps] f32 carries each
+    emitted token's log-probability under the RAW model distribution
+    (log_softmax of the step logits — before temperature/filters, the
+    OpenAI-logprobs convention).
     """
     B = first_token.shape[0]
     # clamp: limit > max_steps would walk dynamic_update_slice off the end
@@ -182,12 +189,14 @@ def decode(
     use_presence = presence is not None
     pres0 = presence if use_presence else jnp.zeros((B, 1), jnp.bool_)
 
+    lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
+
     def cond(c):
-        step, _, _, _, _, finished, _, _, _ = c
+        step, _, _, _, _, finished, _, _, _, _ = c
         return (step < limit) & ~jnp.all(finished)
 
     def body(c):
-        step, token, pos, cache, key, finished, out, n_gen, pres = c
+        step, token, pos, cache, key, finished, out, n_gen, pres, lps = c
         logits, cache = _forward_step(
             cfg, params, token[:, None], cache, pos, valid_start
         )
@@ -201,9 +210,16 @@ def decode(
         newly_finished = finished | is_eos
         emit = jnp.where(newly_finished, pad, nxt)
         out = jax.lax.dynamic_update_slice(out, emit[:, None], (jnp.int32(0), step))
+        if with_logprobs:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)
+            lps = jax.lax.dynamic_update_slice(lps, tok_lp, (jnp.int32(0), step))
         n_gen = n_gen + (~newly_finished).astype(jnp.int32)
         token = jnp.where(newly_finished, pad, nxt)
-        return step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen, pres
+        return (
+            step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen,
+            pres, lps,
+        )
 
     init = (
         jnp.int32(0),
@@ -215,8 +231,11 @@ def decode(
         out0,
         jnp.zeros((B,), jnp.int32),
         pres0,
+        lp0,
     )
-    _, _, _, cache, _, _, out, n_gen, _ = jax.lax.while_loop(cond, body, init)
+    _, _, _, cache, _, _, out, n_gen, _, lps = jax.lax.while_loop(cond, body, init)
+    if with_logprobs:
+        return out, n_gen, cache, lps
     return out, n_gen, cache
 
 

@@ -54,7 +54,13 @@ class _Pending:
         # solo (their determinism contract is the solo RNG stream); debug
         # requests run solo (top_predictions needs the single-stream
         # prefill logits)
-        if self.is_batch or k.get("seed") is not None or k.get("debug"):
+        # logprobs requests run solo too: a coalesced fleet has no
+        # per-token logprob buffer, so batching would silently drop the
+        # requested data
+        if (
+            self.is_batch or k.get("seed") is not None or k.get("debug")
+            or k.get("logprobs")
+        ):
             return None
         return (
             k.get("max_tokens"), k.get("temperature"), k.get("top_k"),
@@ -238,8 +244,12 @@ class BatchingQueue:
             kwargs.pop("seed", None)
             kwargs.pop("debug", None)
             # a coalesced greedy fleet already produces the exact tokens a
-            # speculative solo run would; the flag just doesn't apply
+            # speculative solo run would; the flag just doesn't apply.
+            # logprobs=False (the server sets it unconditionally) is
+            # likewise not a generate_batch parameter — logprobs=True
+            # requests never coalesce (coalesce_key).
             kwargs.pop("speculative", None)
+            kwargs.pop("logprobs", None)
             t0 = time.time()
             batch = self.engine.generate_batch(
                 [p.prompt for p in group], **kwargs

@@ -270,6 +270,9 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     kwargs["speculative"] = _parse_bool(
                         data.get("speculative", False), "speculative"
                     )
+                    kwargs["logprobs"] = _parse_bool(
+                        data.get("logprobs", False), "logprobs"
+                    )
                     self.send_response(200)
                     self.send_header("Content-Type", "application/x-ndjson")
                     self.end_headers()
@@ -304,6 +307,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     # differently)
                     kwargs["speculative"] = _parse_bool(
                         data.get("speculative", False), "speculative"
+                    )
+                    # logprobs=true: per-generated-token log-probabilities
+                    # (raw model distribution; single-device backend)
+                    kwargs["logprobs"] = _parse_bool(
+                        data.get("logprobs", False), "logprobs"
                     )
                     if continuous is not None:
                         # in-flight batching (engine/continuous.py): joins a

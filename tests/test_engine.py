@@ -156,8 +156,8 @@ def test_warmup_compiles_and_requests_stay_fast():
     stats = engine.warmup(decode_buckets=(16,), batch_buckets=())
     # 2 prefill buckets + 1 chunked-prefill extend + 1 decode bucket
     # + presence (repetition-penalty) variants: 2 prefill + 1 decode
-    # + 1 speculative decode bucket
-    assert stats["programs"] == 8
+    # + 1 logprobs decode variant + 1 speculative decode bucket
+    assert stats["programs"] == 9
     t0 = _time.time()
     r = engine.generate("hi", max_tokens=3, greedy=True, chat=False)
     assert r["status"] == "success"
@@ -179,9 +179,9 @@ def test_warmup_covers_batched_programs():
     )
     stats = engine.warmup(decode_buckets=(16,), batch_buckets=(2,))
     # singles: 1 prefill + 1 extend + 1 decode + presence variants
-    # (1 prefill + 1 decode) + 1 speculative decode;
+    # (1 prefill + 1 decode) + 1 logprobs decode + 1 speculative decode;
     # batch-2: 1 prefill + 1 decode
-    assert stats["programs"] == 8
+    assert stats["programs"] == 9
     assert 2 in engine._batch_caches  # warm reusable cache left behind
 
     # the warmed engine's batched request must not trace/compile anything

@@ -240,3 +240,49 @@ def test_queue_over_http_429():
         assert 200 in codes, codes
     finally:
         server.shutdown()
+
+
+def test_coalesced_fleet_tolerates_server_kwargs():
+    """Regression: the server sets logprobs/speculative/debug on every
+    request; a coalesced fleet must drop the non-batch kwargs instead of
+    crashing generate_batch with a TypeError — and logprobs=True requests
+    must never coalesce (a fleet has no per-token logprob buffer)."""
+    import threading
+
+    from distributed_llm_inference_tpu import EngineConfig, get_model_config
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.serving.queue import BatchingQueue, _Pending
+
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64)))
+    q = BatchingQueue(eng, max_queue=8, max_batch=4, max_wait_ms=60.0)
+    try:
+        kwargs = dict(
+            max_tokens=5, temperature=0.7, top_k=50, top_p=0.9,
+            greedy=True, chat=False, seed=None, min_p=0.0,
+            repetition_penalty=1.0, debug=False, speculative=False,
+            logprobs=False,
+        )
+        outs = []
+        lock = threading.Lock()
+
+        def run(p):
+            r = q.submit(p, **dict(kwargs))
+            with lock:
+                outs.append(r)
+
+        threads = [
+            threading.Thread(target=run, args=(f"fleet {i}",)) for i in range(3)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert len(outs) == 3
+        for r in outs:
+            assert r["status"] == "success", r
+        # logprobs=True never coalesces
+        p = _Pending("x", dict(kwargs, logprobs=True))
+        assert p.coalesce_key() is None
+    finally:
+        q.close()
