@@ -55,7 +55,7 @@ class _Request:
         "prompt", "kwargs", "done", "result", "t_start", "ttft",
         "first_id", "tokens", "slot", "enqueued", "budget",
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
-        "cancelled",
+        "cancelled", "prompt_tokens",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None):
@@ -77,6 +77,7 @@ class _Request:
         self.record = True  # False: warmup traffic, kept out of /stats
         self.prefix_hit_tokens = 0  # prompt tokens served from the prefix cache
         self.cancelled = False  # client went away; free the slot early
+        self.prompt_tokens = 0  # set at admission (tokenized prompt length)
 
 
 class ContinuousEngine:
@@ -449,6 +450,7 @@ class ContinuousEngine:
         )
         ids = eng.tokenizer.encode(text)
         prompt_len = len(ids)
+        req.prompt_tokens = prompt_len
         # prefix-cache lookup + ingest plan: the solo engine's shared
         # helper (one copy of the lookup/cold-fallback/mark discipline)
         p0, entry, plan = eng._prefix_plan(self._prefix, ids)
@@ -603,10 +605,16 @@ class ContinuousEngine:
             "status": "success",
             "time_taken": f"{elapsed:.2f}s",
             "tokens_generated": n,
+            "prompt_tokens": req.prompt_tokens,
             "tokens_per_sec": f"{tps:.2f}",
             "ttft_s": round(req.ttft, 4),
             "backend": "continuous",
             "continuous": True,
+            # budget counts decode steps after the first token, so the
+            # generated-token budget is budget + 1 (clamped, see _admit)
+            "finish_reason": (
+                "stop" if stopped or n < req.budget + 1 else "length"
+            ),
         }
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens

@@ -590,6 +590,7 @@ class InferenceEngine:
         response, stopped = self._truncate_at_stop(response, stop)
 
         token_logprobs = None
+        token_strings = None
         if logprobs:
             # first token: log_softmax of the prefill logits (raw model
             # distribution, OpenAI convention); decode steps recorded by
@@ -606,6 +607,14 @@ class InferenceEngine:
                     round(float(x), 6)
                     for x in np.asarray(step_lps[0][: int(n_gen[0])])
                 ]
+            # per-position token text alongside the logprobs (OpenAI's
+            # logprobs objects carry both); zip-truncated defensively —
+            # gen_ids excludes a terminal EOS exactly when its logprob
+            # entry was skipped above
+            token_strings = [
+                self.tokenizer.decode([t])
+                for t, _ in zip(gen_ids, token_logprobs)
+            ]
 
         top_predictions = None
         if debug and logits.shape[-1] > 0:  # 1F1B may return 0-width logits
@@ -637,9 +646,16 @@ class InferenceEngine:
             "status": "success",
             "time_taken": f"{elapsed:.2f}s",
             "tokens_generated": n,
+            "prompt_tokens": prompt_len,
             "tokens_per_sec": f"{tps:.2f}",
             "ttft_s": round(ttft, 4),
             "backend": self.backend.name,
+            # why generation ended, judged against the CLAMPED budget (the
+            # requested max_tokens may have been lowered near max_seq_len —
+            # the serving edge cannot reconstruct that)
+            "finish_reason": (
+                "stop" if stopped or n < max_tokens else "length"
+            ),
         }
         if p0:
             result["prefix_cached_tokens"] = p0
@@ -647,6 +663,7 @@ class InferenceEngine:
             result["stopped"] = True  # a textual stop sequence fired
         if token_logprobs is not None:
             result["token_logprobs"] = token_logprobs
+            result["token_strings"] = token_strings
         if use_spec:
             result["speculative"] = True
         if top_predictions is not None:
@@ -946,7 +963,12 @@ class InferenceEngine:
                 "prompt": prompts[b],
                 "response": text,
                 "tokens_generated": len(row),
+                "prompt_tokens": plens[b],
                 "status": "success",
+                "finish_reason": (
+                    "stop" if row_stopped or len(row) < max_tokens
+                    else "length"
+                ),
             }
             if row_stopped:
                 entry["stopped"] = True

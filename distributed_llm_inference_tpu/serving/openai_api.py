@@ -1,0 +1,360 @@
+"""OpenAI-compatible serving surface: /v1/completions, /v1/chat/completions,
+/v1/models.
+
+Beyond-reference feature (the reference only serves its own ad-hoc
+/generate schema, /root/reference/orchestration.py:331-356): any
+OpenAI-SDK client can point its `base_url` at this server. This module is
+pure translation — OpenAI request JSON -> engine kwargs, engine envelope ->
+OpenAI response JSON (including SSE streaming chunks); it owns no model or
+engine state, so the serving edge stays a single source of truth.
+
+Mapping notes:
+  * OpenAI has no top-k; the engine's top_k=0 disables that filter (the
+    temperature/top_p semantics match the reference's sampling stack).
+  * temperature == 0 means deterministic in OpenAI terms -> greedy argmax.
+  * /v1/completions is raw continuation (no chat template);
+    /v1/chat/completions renders the message list through the model
+    family's template (engine/chat.format_chat_messages).
+  * Unsupported OpenAI params (n>1, best_of>1, echo, suffix, logit_bias,
+    nonzero frequency/presence penalties) are rejected with a 400 error
+    object rather than silently ignored — silent acceptance would change
+    sampling semantics behind the client's back.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Optional
+
+from ..engine.chat import format_chat_messages
+
+# clients may omit max_tokens entirely; OpenAI's completions default
+DEFAULT_MAX_TOKENS = 16
+
+
+class OpenAIError(ValueError):
+    """Carries an OpenAI-schema error body + HTTP status."""
+
+    def __init__(self, message: str, status: int = 400,
+                 err_type: str = "invalid_request_error",
+                 param: Optional[str] = None):
+        super().__init__(message)
+        self.status = status
+        self.body = {
+            "error": {
+                "message": message,
+                "type": err_type,
+                "param": param,
+                "code": None,
+            }
+        }
+
+
+def error_for_envelope(result: dict) -> "OpenAIError":
+    """Engine failure envelope -> OpenAI error object (same status codes as
+    the native /generate route)."""
+    et = result.get("error_type")
+    msg = result.get("error", "internal error")
+    if et == "invalid_request":
+        return OpenAIError(msg)
+    if et == "timeout":
+        return OpenAIError(msg, status=503, err_type="timeout_error")
+    if et == "overloaded":
+        return OpenAIError(msg, status=429, err_type="overloaded_error")
+    return OpenAIError(msg, status=500, err_type="server_error")
+
+
+def _reject_unsupported(data: dict, *, chat: bool):
+    def as_num(name, default, cast):
+        v = data.get(name)
+        if v is None:
+            return default
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            raise OpenAIError(
+                f"{name} must be a number, got {v!r}", param=name
+            ) from None
+
+    if as_num("n", 1, int) != 1:
+        raise OpenAIError("n > 1 is not supported", param="n")
+    if not chat and as_num("best_of", 1, int) != 1:
+        raise OpenAIError("best_of > 1 is not supported", param="best_of")
+    if not chat and data.get("echo"):
+        raise OpenAIError("echo is not supported", param="echo")
+    if not chat and data.get("suffix"):
+        raise OpenAIError("suffix is not supported", param="suffix")
+    if data.get("logit_bias"):
+        raise OpenAIError("logit_bias is not supported", param="logit_bias")
+    for p in ("frequency_penalty", "presence_penalty"):
+        if as_num(p, 0.0, float) != 0.0:
+            raise OpenAIError(
+                f"{p} is not supported (use repetition_penalty, an "
+                f"HF-semantics extension this server does support)", param=p,
+            )
+
+
+def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
+    """Shared OpenAI -> engine parameter translation. default_max: budget
+    when the client omits max_tokens (legacy completions default is 16;
+    chat defaults to the server cap — OpenAI's chat default is 'up to the
+    context limit', and 16-token chat replies surprise every SDK user)."""
+    if default_max is None:
+        default_max = DEFAULT_MAX_TOKENS
+    try:
+        # explicit nulls fall through to the next source (clients migrating
+        # to max_completion_tokens often send "max_tokens": null alongside)
+        max_tokens = data.get("max_tokens")
+        if max_tokens is None:
+            max_tokens = data.get("max_completion_tokens")
+        max_tokens = default_max if max_tokens is None else int(max_tokens)
+        t = data.get("temperature")
+        temperature = 1.0 if t is None else float(t)  # OpenAI: null = default
+        tp = data.get("top_p")
+        top_p = 1.0 if tp is None else float(tp)
+        seed = data.get("seed")
+        seed = int(seed) if seed is not None else None
+        rep = float(data.get("repetition_penalty", 1.0))  # extension
+        min_p = float(data.get("min_p", 0.0))  # extension
+    except (TypeError, ValueError) as e:
+        raise OpenAIError(f"bad parameter: {e}") from None
+    if temperature < 0:
+        raise OpenAIError("temperature must be >= 0", param="temperature")
+    kwargs = dict(
+        max_tokens=min(max_tokens, cap),
+        temperature=temperature if temperature > 0 else 1.0,
+        top_k=0,  # OpenAI has no top-k filter
+        top_p=top_p,
+        greedy=temperature == 0.0,
+        chat=False,  # chat routes pre-render the template themselves
+        seed=int(seed) if seed is not None else None,
+        min_p=min_p,
+        repetition_penalty=rep,
+    )
+    stop = data.get("stop")
+    if stop is not None:
+        if isinstance(stop, str):
+            stop = [stop]
+        if not (isinstance(stop, list) and all(isinstance(s, str) for s in stop)):
+            raise OpenAIError("stop must be a string or list of strings",
+                              param="stop")
+        if stop:
+            kwargs["stop"] = stop
+    return kwargs
+
+
+def parse_completion(data: dict, cap: int):
+    """POST /v1/completions body -> (prompts: list[str], kwargs, meta)."""
+    _reject_unsupported(data, chat=False)
+    prompt = data.get("prompt")
+    if prompt is None:
+        raise OpenAIError("you must provide a prompt", param="prompt")
+    prompts = [prompt] if isinstance(prompt, str) else prompt
+    if not (isinstance(prompts, list) and prompts
+            and all(isinstance(p, str) and p for p in prompts)):
+        raise OpenAIError(
+            "prompt must be a non-empty string or list of non-empty strings",
+            param="prompt",
+        )
+    kwargs = _common_kwargs(data, cap)
+    meta = {"stream": bool(data.get("stream", False))}
+    lp = data.get("logprobs")
+    if lp is not None and lp is not False:
+        # legacy completions logprobs is an int (top-N); only the chosen
+        # tokens' logprobs are produced here (top_logprobs omitted) — and
+        # logprobs: 0 still means "return the chosen tokens' logprobs"
+        if meta["stream"]:
+            raise OpenAIError(
+                "logprobs are not available on streamed responses",
+                param="logprobs",
+            )
+        kwargs["logprobs"] = True
+    return prompts, kwargs, meta
+
+
+def parse_chat(data: dict, arch: str, template: Optional[str], cap: int):
+    """POST /v1/chat/completions body -> (raw_prompt, kwargs, meta)."""
+    _reject_unsupported(data, chat=True)
+    messages = data.get("messages")
+    if not (isinstance(messages, list) and messages
+            and all(isinstance(m, dict) for m in messages)):
+        raise OpenAIError("messages must be a non-empty list of objects",
+                          param="messages")
+    try:
+        prompt = format_chat_messages(messages, arch=arch, template=template)
+    except ValueError as e:
+        raise OpenAIError(str(e), param="messages") from None
+    kwargs = _common_kwargs(data, cap, default_max=cap)
+    meta = {"stream": bool(data.get("stream", False))}
+    if data.get("top_logprobs"):
+        # alternatives-per-position are not produced; silent empty lists
+        # would masquerade as "no alternatives existed"
+        raise OpenAIError("top_logprobs is not supported",
+                          param="top_logprobs")
+    if data.get("logprobs"):
+        if meta["stream"]:
+            raise OpenAIError(
+                "logprobs are not available on streamed responses",
+                param="logprobs",
+            )
+        kwargs["logprobs"] = True
+    return prompt, kwargs, meta
+
+
+def _finish_reason(entry: dict, requested_max: int) -> str:
+    # the engine reports why generation ended (judged against its CLAMPED
+    # budget, which this layer cannot reconstruct); the request-shaped
+    # fallback covers older envelopes without the key
+    fr = entry.get("finish_reason")
+    if fr in ("stop", "length"):
+        return fr
+    if entry.get("stopped"):
+        return "stop"
+    return "length" if entry.get("tokens_generated", 0) >= requested_max else "stop"
+
+
+def _usage(entries: list) -> dict:
+    pt = sum(e.get("prompt_tokens", 0) for e in entries)
+    ct = sum(e.get("tokens_generated", 0) for e in entries)
+    return {"prompt_tokens": pt, "completion_tokens": ct,
+            "total_tokens": pt + ct}
+
+
+def _logprobs_obj(entry: dict) -> Optional[dict]:
+    lps = entry.get("token_logprobs")
+    if lps is None:
+        return None
+    return {"token_logprobs": lps,
+            "tokens": entry.get("token_strings"),
+            "top_logprobs": None,
+            "text_offset": None}
+
+
+def completion_response(entries: list, model: str, kwargs: dict) -> dict:
+    """Engine success envelope(s) -> one text_completion response."""
+    choices = []
+    for i, e in enumerate(entries):
+        c = {
+            "index": i,
+            "text": e.get("response", ""),
+            "finish_reason": _finish_reason(e, kwargs["max_tokens"]),
+        }
+        lp = _logprobs_obj(e)
+        if lp is not None:
+            c["logprobs"] = lp
+        choices.append(c)
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": _usage(entries),
+    }
+
+
+def chat_response(entry: dict, model: str, kwargs: dict) -> dict:
+    choice = {
+        "index": 0,
+        "message": {"role": "assistant", "content": entry.get("response", "")},
+        "finish_reason": _finish_reason(entry, kwargs["max_tokens"]),
+    }
+    lp = _logprobs_obj(entry)
+    if lp is not None:
+        # chat schema nests token logprobs under content
+        toks = lp["tokens"] or [""] * len(lp["token_logprobs"] or [])
+        choice["logprobs"] = {
+            "content": [
+                {"token": t, "logprob": x, "top_logprobs": []}
+                for t, x in zip(toks, lp["token_logprobs"] or [])
+            ]
+        }
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [choice],
+        "usage": _usage([entry]),
+    }
+
+
+def models_response(model: str, created: int) -> dict:
+    return {
+        "object": "list",
+        "data": [{
+            "id": model,
+            "object": "model",
+            "created": created,
+            "owned_by": "distributed_llm_inference_tpu",
+        }],
+    }
+
+
+# -- SSE streaming ----------------------------------------------------------
+
+
+def sse(obj: Any) -> bytes:
+    return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def stream_events(events, model: str, kwargs: dict, chat: bool):
+    """Adapt the continuous engine's NDJSON event stream ({"delta": ...}*,
+    then the final envelope with done: true) into OpenAI SSE chunk dicts.
+
+    Yields (bytes, final_envelope_or_None); the caller writes the bytes and
+    can inspect the final envelope for error status. A failed request
+    yields an OpenAI error payload as the terminal SSE event (the HTTP 200
+    is already on the wire — OpenAI streams report late errors in-band).
+    """
+    rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+           else f"cmpl-{uuid.uuid4().hex[:24]}")
+    obj = "chat.completion.chunk" if chat else "text_completion"
+    created = int(time.time())
+
+    def chunk(delta_text: Optional[str], finish: Optional[str]) -> dict:
+        if chat:
+            delta = {} if delta_text is None else {"content": delta_text}
+            choice = {"index": 0, "delta": delta, "finish_reason": finish}
+        else:
+            choice = {"index": 0, "text": delta_text or "",
+                      "finish_reason": finish}
+        return {"id": rid, "object": obj, "created": created, "model": model,
+                "choices": [choice]}
+
+    if chat:
+        yield sse(chunk(None, None) | {
+            "choices": [{"index": 0, "delta": {"role": "assistant"},
+                         "finish_reason": None}],
+        }), None
+    final = None
+    streamed = ""
+    for ev in events:
+        if ev.get("done"):
+            final = ev
+            break
+        d = ev.get("delta")
+        if d:
+            streamed += d
+            yield sse(chunk(d, None)), None
+    if final is None or final.get("status") != "success":
+        err = error_for_envelope(final or {"error": "stream ended early"})
+        yield sse(err.body), final
+        yield SSE_DONE, final
+        return
+    # a request the continuous engine served via its solo fallback (seeded /
+    # logprobs / speculative) emits no per-chunk deltas — only the final
+    # envelope carries text. Flush whatever the deltas didn't cover so the
+    # client always receives the full completion.
+    response = final.get("response", "")
+    if response.startswith(streamed) and len(response) > len(streamed):
+        yield sse(chunk(response[len(streamed):], None)), None
+    out = chunk(None, _finish_reason(final, kwargs["max_tokens"]))
+    out["usage"] = _usage([final])
+    yield sse(out), final
+    yield SSE_DONE, final

@@ -284,6 +284,9 @@ class BatchingQueue:
                     **({"stopped": True} if row.get("stopped") else {}),
                     "time_taken": batch["time_taken"],
                     "tokens_generated": n,
+                    "prompt_tokens": row.get("prompt_tokens", 0),
+                    **({"finish_reason": row["finish_reason"]}
+                       if "finish_reason" in row else {}),
                     "tokens_per_sec": f"{(n / elapsed if elapsed > 0 else 0.0):.2f}",
                     "ttft_s": batch["ttft_s"],
                     "backend": batch["backend"],

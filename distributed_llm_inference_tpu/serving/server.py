@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -131,7 +132,10 @@ class _Profiler:
 
 def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
                  queue=None, continuous=None):
+    from . import openai_api as oai
+
     profiler = profiler or _Profiler()
+    started_at = int(time.time())
 
     class Handler(BaseHTTPRequestHandler):
         # quiet default request logging; serving logs are structured
@@ -187,6 +191,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 if continuous is not None:
                     s["continuous"] = continuous.stats()
                 self._send(200, s)
+            elif path == "/v1/models":
+                self._send(
+                    200, oai.models_response(engine.cfg.name, started_at)
+                )
             else:
                 self._send(404, {"error": f"no route {path}"})
 
@@ -199,8 +207,109 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 self._send(400, {"error": "invalid JSON body"})
                 return None
 
+        # -- OpenAI-compatible surface (serving/openai_api.py) -----------
+
+        def _run_single(self, prompt: str, kwargs: dict) -> dict:
+            """One prompt through the same dispatch ladder as /generate:
+            continuous fleet > bounded queue > bare engine."""
+            if continuous is not None:
+                return continuous.submit(prompt, **kwargs)
+            if queue is not None:
+                return queue.submit(prompt, **kwargs)
+            return engine.generate(prompt, **kwargs)
+
+        def _openai_stream(self, prompt: str, kwargs: dict, chat: bool):
+            """SSE streaming: real per-chunk deltas on a --continuous
+            server, single-chunk emulation otherwise (still valid SSE, so
+            OpenAI-SDK streaming clients work against any server config)."""
+            if continuous is not None:
+                events = continuous.stream(prompt, **kwargs)
+            else:
+                def _one_shot():
+                    result = self._run_single(prompt, kwargs)
+                    if result.get("status") == "success":
+                        yield {"delta": result.get("response", "")}
+                    yield {**result, "done": True}
+
+                events = _one_shot()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            try:
+                for payload, _final in oai.stream_events(
+                    events, engine.cfg.name, kwargs, chat=chat
+                ):
+                    self.wfile.write(payload)
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                if hasattr(events, "close"):
+                    events.close()  # cancel: frees the decode slot
+
+        def _openai(self, path: str, data: dict):
+            chat = path == "/v1/chat/completions"
+            try:
+                if chat:
+                    prompt, kwargs, meta = oai.parse_chat(
+                        data, engine.cfg.arch, engine.cfg.chat_template,
+                        max_tokens_cap,
+                    )
+                    prompts = [prompt]
+                else:
+                    prompts, kwargs, meta = oai.parse_completion(
+                        data, max_tokens_cap
+                    )
+                if meta["stream"]:
+                    if len(prompts) != 1:
+                        raise oai.OpenAIError(
+                            "streaming requires a single prompt", param="stream"
+                        )
+                    self._openai_stream(prompts[0], kwargs, chat=chat)
+                    return
+                if len(prompts) == 1:
+                    result = self._run_single(prompts[0], kwargs)
+                    if result.get("status") != "success":
+                        raise oai.error_for_envelope(result)
+                    entries = [result]
+                else:
+                    if kwargs.get("logprobs"):
+                        raise oai.OpenAIError(
+                            "logprobs requires a single string prompt",
+                            param="logprobs",
+                        )
+                    batch = (
+                        queue.submit_batch(prompts, **kwargs)
+                        if queue is not None
+                        else engine.generate_batch(prompts, **kwargs)
+                    )
+                    if batch.get("status") != "success":
+                        raise oai.error_for_envelope(batch)
+                    entries = batch["results"]
+            except oai.OpenAIError as e:
+                self._send(e.status, e.body)
+                return
+            except (TypeError, ValueError) as e:
+                # defense in depth: any param-shape error that escaped the
+                # parsers still answers 400, never a dropped connection
+                self._send(400, oai.OpenAIError(f"bad parameter: {e}").body)
+                return
+            if chat:
+                self._send(
+                    200, oai.chat_response(entries[0], engine.cfg.name, kwargs)
+                )
+            else:
+                self._send(
+                    200,
+                    oai.completion_response(entries, engine.cfg.name, kwargs),
+                )
+
         def do_POST(self):
             path = self.path.split("?")[0].rstrip("/")
+            if path in ("/v1/completions", "/v1/chat/completions"):
+                data = self._read_json()
+                if data is not None:
+                    self._openai(path, data)
+                return
             if path == "/profiler/start":
                 data = self._read_json()
                 if data is None:

@@ -1,0 +1,406 @@
+"""OpenAI-compatible API surface (serving/openai_api.py): /v1/models,
+/v1/completions (single + batched prompts + SSE streaming),
+/v1/chat/completions (multi-turn templating + SSE streaming), OpenAI error
+objects, and usage accounting — all over real HTTP against a served tiny
+model. Beyond-reference feature: the reference serves only its own ad-hoc
+/generate schema (/root/reference/orchestration.py:331-356)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, MeshConfig, create_engine
+from distributed_llm_inference_tpu.engine.chat import (
+    format_chat_messages,
+    format_chat_prompt,
+)
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def served():
+    engine = create_engine(
+        "test-llama-tiny",
+        mesh_cfg=MeshConfig(),
+        engine_cfg=EngineConfig(prefill_buckets=(64, 128)),
+    )
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    server.start()
+    yield server
+    server.shutdown()
+
+
+def _post(server, path, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_raw(server, path, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def test_models_route(served):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{served.port}/v1/models", timeout=10
+    ) as r:
+        out = json.loads(r.read())
+    assert out["object"] == "list"
+    assert out["data"][0]["id"] == "test-llama-tiny"
+    assert out["data"][0]["object"] == "model"
+
+
+def test_completions_basic(served):
+    out = _post(served, "/v1/completions", {
+        "model": "test-llama-tiny",
+        "prompt": "hello world",
+        "max_tokens": 6,
+        "temperature": 0,
+    })
+    assert out["object"] == "text_completion"
+    assert out["id"].startswith("cmpl-")
+    assert len(out["choices"]) == 1
+    c = out["choices"][0]
+    assert c["index"] == 0
+    assert isinstance(c["text"], str)
+    assert c["finish_reason"] in ("stop", "length")
+    u = out["usage"]
+    assert u["prompt_tokens"] > 0
+    assert u["total_tokens"] == u["prompt_tokens"] + u["completion_tokens"]
+    assert u["completion_tokens"] <= 6
+
+
+def test_completions_greedy_matches_engine(served):
+    """temperature=0 must be the engine's greedy argmax path, raw
+    continuation (no chat template)."""
+    out = _post(served, "/v1/completions", {
+        "prompt": "the quick brown", "max_tokens": 5, "temperature": 0,
+    })
+    ref = served.engine.generate(
+        "the quick brown", max_tokens=5, greedy=True, chat=False,
+    )
+    assert out["choices"][0]["text"] == ref["response"]
+
+
+def test_completions_batched_prompt_list(served):
+    out = _post(served, "/v1/completions", {
+        "prompt": ["alpha beta", "gamma delta epsilon"],
+        "max_tokens": 4,
+        "temperature": 0,
+    })
+    assert [c["index"] for c in out["choices"]] == [0, 1]
+    assert out["usage"]["prompt_tokens"] > 0
+    # batched greedy rows must equal solo greedy rows (ragged-batch parity)
+    for prompt, choice in zip(["alpha beta", "gamma delta epsilon"],
+                              out["choices"]):
+        ref = served.engine.generate(
+            prompt, max_tokens=4, greedy=True, chat=False
+        )
+        assert choice["text"] == ref["response"]
+
+
+def test_completions_finish_reason_length(served):
+    out = _post(served, "/v1/completions", {
+        "prompt": "a b c", "max_tokens": 3, "temperature": 0,
+    })
+    c = out["choices"][0]
+    if out["usage"]["completion_tokens"] == 3:
+        assert c["finish_reason"] == "length"
+
+
+def test_completions_stop_sequence(served):
+    # stop="" is ignored; a stop that fires reports finish_reason "stop"
+    base = _post(served, "/v1/completions", {
+        "prompt": "x y", "max_tokens": 8, "temperature": 0,
+    })["choices"][0]["text"]
+    if len(base) > 2:
+        needle = base[1]
+        out = _post(served, "/v1/completions", {
+            "prompt": "x y", "max_tokens": 8, "temperature": 0,
+            "stop": needle,
+        })
+        c = out["choices"][0]
+        assert needle not in c["text"]
+        assert c["finish_reason"] == "stop"
+
+
+def test_completions_logprobs(served):
+    out = _post(served, "/v1/completions", {
+        "prompt": "hello", "max_tokens": 4, "temperature": 0, "logprobs": 1,
+    })
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == out["usage"]["completion_tokens"]
+    assert all(x <= 0.0 for x in lp["token_logprobs"])
+
+
+def test_completions_seeded_sampling_reproducible(served):
+    body = {"prompt": "seed test", "max_tokens": 6, "temperature": 0.9,
+            "seed": 123}
+    a = _post(served, "/v1/completions", body)
+    b = _post(served, "/v1/completions", body)
+    assert a["choices"][0]["text"] == b["choices"][0]["text"]
+
+
+def test_completions_errors(served):
+    for body, param in [
+        ({"max_tokens": 4}, "prompt"),
+        ({"prompt": "x", "n": 3}, "n"),
+        ({"prompt": "x", "n": "junk"}, "n"),
+        ({"prompt": "x", "best_of": 2}, "best_of"),
+        ({"prompt": "x", "logit_bias": {"5": 10}}, "logit_bias"),
+        ({"prompt": "x", "frequency_penalty": 0.5}, "frequency_penalty"),
+        ({"prompt": "x", "frequency_penalty": "y"}, "frequency_penalty"),
+        ({"prompt": "x", "temperature": -1}, "temperature"),
+        ({"prompt": "x", "stop": 5}, "stop"),
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(served, "/v1/completions", body)
+        assert ei.value.code == 400
+        err = json.loads(ei.value.read())["error"]
+        assert err["type"] == "invalid_request_error"
+        assert err["param"] == param
+
+
+def test_completions_sse_stream(served):
+    with _post_raw(served, "/v1/completions", {
+        "prompt": "stream me", "max_tokens": 5, "temperature": 0,
+        "stream": True,
+    }) as r:
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = r.read().decode()
+    events = [json.loads(line[len("data: "):])
+              for line in raw.strip().split("\n\n")
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    assert raw.strip().endswith("data: [DONE]")
+    assert all(e["object"] == "text_completion" for e in events)
+    # exactly one terminal chunk, carrying finish_reason + usage
+    finals = [e for e in events if e["choices"][0]["finish_reason"]]
+    assert len(finals) == 1
+    assert finals[0]["usage"]["completion_tokens"] <= 5
+    text = "".join(e["choices"][0]["text"] for e in events)
+    ref = served.engine.generate(
+        "stream me", max_tokens=5, greedy=True, chat=False
+    )
+    assert text == ref["response"]
+
+
+def test_chat_completions_basic(served):
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [
+            {"role": "system", "content": "Be terse."},
+            {"role": "user", "content": "hi there"},
+        ],
+        "max_tokens": 6,
+        "temperature": 0,
+    })
+    assert out["object"] == "chat.completion"
+    assert out["id"].startswith("chatcmpl-")
+    msg = out["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+    assert out["usage"]["prompt_tokens"] > 0
+
+
+def test_chat_completions_template_parity(served):
+    """The chat route must render the model family's template: its greedy
+    output == engine.generate(chat=True) on the same single user turn."""
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "what is up"}],
+        "max_tokens": 5,
+        "temperature": 0,
+    })
+    ref = served.engine.generate(
+        "what is up", max_tokens=5, greedy=True, chat=True
+    )
+    assert out["choices"][0]["message"]["content"] == ref["response"]
+
+
+def test_chat_completions_sse_stream(served):
+    with _post_raw(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "stream chat"}],
+        "max_tokens": 5, "temperature": 0, "stream": True,
+    }) as r:
+        raw = r.read().decode()
+    events = [json.loads(line[len("data: "):])
+              for line in raw.strip().split("\n\n")
+              if line.startswith("data: ") and line != "data: [DONE]"]
+    assert raw.strip().endswith("data: [DONE]")
+    assert all(e["object"] == "chat.completion.chunk" for e in events)
+    # first chunk announces the assistant role (OpenAI convention)
+    assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+    finals = [e for e in events if e["choices"][0]["finish_reason"]]
+    assert len(finals) == 1
+    text = "".join(
+        e["choices"][0]["delta"].get("content", "") for e in events
+    )
+    ref = served.engine.generate(
+        "stream chat", max_tokens=5, greedy=True, chat=True
+    )
+    assert text == ref["response"]
+
+
+def test_chat_completions_bad_messages(served):
+    for msgs in [
+        [],
+        [{"role": "user", "content": "a"}, {"role": "system", "content": "b"}],
+        [{"role": "assistant", "content": "only assistant"}],
+        [{"role": "tool", "content": "x"}, {"role": "user", "content": "y"}],
+    ]:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(served, "/v1/chat/completions",
+                  {"messages": msgs, "max_tokens": 4})
+        assert ei.value.code == 400
+
+
+# -- multi-turn template rendering (pure functions) -------------------------
+
+
+def test_format_chat_messages_single_turn_parity():
+    """One user turn through the messages renderer == format_chat_prompt,
+    byte-identical, for every template."""
+    for arch, template in [("llama", None), ("llama", "tinyllama"),
+                           ("gpt2", None), ("llama", "gemma"),
+                           ("llama", "phi3")]:
+        a = format_chat_messages(
+            [{"role": "user", "content": "hello"}], arch=arch,
+            template=template,
+        )
+        b = format_chat_prompt("hello", arch=arch, template=template)
+        assert a == b, (arch, template)
+
+
+def test_format_chat_messages_multi_turn():
+    msgs = [
+        {"role": "system", "content": "sys"},
+        {"role": "user", "content": "q1"},
+        {"role": "assistant", "content": "a1"},
+        {"role": "user", "content": "q2"},
+    ]
+    z = format_chat_messages(msgs, arch="llama", template="tinyllama")
+    assert z == ("<|system|>\nsys</s>\n<|user|>\nq1</s>\n"
+                 "<|assistant|>\na1</s>\n<|user|>\nq2</s>\n<|assistant|>\n")
+    g = format_chat_messages(msgs, arch="llama", template="gemma")
+    assert g == ("<start_of_turn>user\nsys\n\nq1<end_of_turn>\n"
+                 "<start_of_turn>model\na1<end_of_turn>\n"
+                 "<start_of_turn>user\nq2<end_of_turn>\n"
+                 "<start_of_turn>model\n")
+    p = format_chat_messages(msgs, arch="llama", template="phi3")
+    assert p == ("<|system|>\nsys<|end|>\n<|user|>\nq1<|end|>\n"
+                 "<|assistant|>\na1<|end|>\n<|user|>\nq2<|end|>\n"
+                 "<|assistant|>\n")
+    n = format_chat_messages(msgs, arch="gpt2")
+    assert n == "sys\nq1\na1\nq2"
+
+
+def test_stream_logprobs_and_top_logprobs_rejected(served):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(served, "/v1/completions", {
+            "prompt": "x", "stream": True, "logprobs": 1,
+        })
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(served, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "logprobs": True, "top_logprobs": 5,
+        })
+    assert ei.value.code == 400
+    assert json.loads(ei.value.read())["error"]["param"] == "top_logprobs"
+
+
+def test_chat_logprobs_token_strings(served):
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0, "logprobs": True,
+    })
+    content = out["choices"][0]["logprobs"]["content"]
+    assert len(content) == out["usage"]["completion_tokens"]
+    for c in content:
+        assert isinstance(c["token"], str)
+        assert c["logprob"] <= 0.0
+
+
+def test_engine_reports_finish_reason(served):
+    eng = served.engine
+    r = eng.generate("a b c d", max_tokens=3, greedy=True, chat=False)
+    assert r["finish_reason"] in ("stop", "length")
+    if r["tokens_generated"] == 3:
+        assert r["finish_reason"] == "length"
+    # a fired textual stop is always finish_reason "stop"
+    base = eng.generate("a b c d", max_tokens=8, greedy=True, chat=False)
+    if len(base["response"]) > 2:
+        r2 = eng.generate(
+            "a b c d", max_tokens=8, greedy=True, chat=False,
+            stop=[base["response"][1]],
+        )
+        assert r2["finish_reason"] == "stop"
+
+
+def test_completions_null_max_tokens_falls_through(served):
+    """Clients migrating to max_completion_tokens often null the old key."""
+    out = _post(served, "/v1/completions", {
+        "prompt": "hello", "max_tokens": None, "max_completion_tokens": 7,
+        "temperature": 0,
+    })
+    assert out["usage"]["completion_tokens"] <= 7
+    # and logprobs: 0 is "chosen tokens' logprobs, 0 alternatives" — not off
+    out = _post(served, "/v1/completions", {
+        "prompt": "hello", "max_tokens": 3, "temperature": 0, "logprobs": 0,
+    })
+    assert "logprobs" in out["choices"][0]
+
+
+def test_stream_events_flushes_solo_fallback_text():
+    """A continuous-engine solo fallback (seeded/logprobs requests) yields
+    only the final envelope, no deltas — the SSE adapter must still deliver
+    the full completion text."""
+    from distributed_llm_inference_tpu.serving.openai_api import stream_events
+
+    events = iter([
+        {"response": "full text", "status": "success", "tokens_generated": 2,
+         "prompt_tokens": 3, "done": True},
+    ])
+    payloads = [p for p, _ in stream_events(
+        events, "m", {"max_tokens": 8}, chat=False
+    )]
+    text = "".join(
+        json.loads(p[len(b"data: "):].decode())["choices"][0]["text"]
+        for p in payloads
+        if p.startswith(b"data: {")
+    )
+    assert text == "full text"
+
+
+def test_format_chat_messages_gemma_system_folds_into_user_turn():
+    """An assistant-first history must not swallow the system text into a
+    model turn — it folds into the first USER turn."""
+    out = format_chat_messages(
+        [{"role": "system", "content": "sys"},
+         {"role": "assistant", "content": "greeting"},
+         {"role": "user", "content": "q"}],
+        arch="llama", template="gemma",
+    )
+    assert out == ("<start_of_turn>model\ngreeting<end_of_turn>\n"
+                   "<start_of_turn>user\nsys\n\nq<end_of_turn>\n"
+                   "<start_of_turn>model\n")
+
+
+def test_format_chat_messages_must_end_with_user():
+    with pytest.raises(ValueError):
+        format_chat_messages(
+            [{"role": "user", "content": "q"},
+             {"role": "assistant", "content": "a"}],
+            arch="llama",
+        )
