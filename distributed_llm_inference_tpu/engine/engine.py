@@ -119,6 +119,19 @@ class SingleDeviceBackend:
             max_steps=max_steps, draft_len=draft_len,
         )
 
+    # two-model (draft) speculative decode — engine.set_draft() wires the
+    # draft model in; the combined verify program runs both models
+    supports_draft = True
+
+    def decode_draft_speculative(self, dcfg, dparams, first_token, cache,
+                                 dcache, start_pos, limit, *, max_steps,
+                                 draft_len):
+        return G.decode_draft_speculative(
+            self.cfg, self.params, dcfg, dparams, first_token, cache,
+            dcache, start_pos, limit, max_steps=max_steps,
+            draft_len=draft_len,
+        )
+
     def health(self) -> list[dict]:
         """Per-device health: a timed device probe, the in-process analogue
         of the reference's 5s-timeout /workers sweep
@@ -180,6 +193,34 @@ class InferenceEngine:
                 )
             else:
                 log.info("prefix_cache_disabled", reason="backend lacks prefill_at")
+        # Two-model speculative decoding (set_draft): (dcfg, dparams) of a
+        # smaller same-tokenizer model + its reusable donated KV cache
+        self._draft = None
+        self._draft_cache = None
+
+    def set_draft(self, dcfg: ModelConfig, dparams: Any = None,
+                  seed: int = 1):
+        """Attach a draft model for two-model speculative decoding.
+
+        The draft must share the target's tokenizer/vocab (token ids are
+        compared against the target's argmax); only the single-device
+        backend runs the combined verify program.
+        """
+        if dparams is None:
+            dparams = M.init_params(dcfg, jax.random.PRNGKey(seed))
+        if dcfg.vocab_size != self.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {dcfg.vocab_size} != target vocab "
+                f"{self.cfg.vocab_size}; draft and target must share a "
+                f"tokenizer"
+            )
+        if not getattr(self.backend, "supports_draft", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support draft-model "
+                f"speculation; serve on the single-device backend"
+            )
+        self._draft = (dcfg, dparams)
+        self._draft_cache = None
 
     # -- helpers ------------------------------------------------------------
     def _next_key(self):
@@ -445,6 +486,34 @@ class InferenceEngine:
             prefix.store(ids, len(ids), cache)
         return first, logits, cache
 
+    def _draft_ingest(self, ids: list, dcache):
+        """Prefill the whole prompt into the DRAFT model's cache (two-model
+        speculation): same chunk plan as the main ingest, driven directly
+        through engine/generate (single-device semantics; no prefix cache
+        — correctness over draft-side TTFT). The draft's sampled first
+        token is discarded; only its KV matters."""
+        dcfg, dparams = self._draft
+        plan = self._plan_ingest(len(ids), 0, self._buckets())
+        if plan is None:  # main path already accepted this prompt
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds draft ingest capacity"
+            )
+        n_full, rem, bucket, chunk = plan
+        pad = dcfg.pad_token_id
+        for c in range(n_full):
+            t = jnp.asarray([ids[c * chunk : (c + 1) * chunk]], jnp.int32)
+            dcache = G.extend(dcfg, dparams, t, jnp.int32(c * chunk), dcache)
+        tail_start = n_full * chunk
+        tokens = jnp.asarray(
+            [ids[tail_start:] + [pad] * (bucket - rem)], jnp.int32
+        )
+        _, _, dcache = G.prefill(
+            dcfg, dparams, tokens, jnp.int32(rem), dcache,
+            jax.random.PRNGKey(0), G.default_sampling(greedy=True), None,
+            jnp.int32(tail_start), None,
+        )
+        return dcache
+
     def _presence_rows(self, rows: list) -> jnp.ndarray:
         """[len(rows), V] bool: each row's token-id set, built host-side in
         numpy (the full prompt is already a host list — no device pass
@@ -509,7 +578,7 @@ class InferenceEngine:
                 f"logprobs; serve logprobs requests on the single-device "
                 f"backend"
             )
-        use_spec = (
+        spec_ok = (
             speculative
             and greedy
             # a repetition penalty changes the argmax the draft
@@ -517,11 +586,22 @@ class InferenceEngine:
             # the speculative loop records no per-step logprobs
             and repetition_penalty == 1.0
             and not logprobs
+        )
+        # draft-model speculation wins over prompt-lookup when a draft is
+        # attached (helps arbitrary text, not just self-repeating text)
+        use_draft = (
+            spec_ok
+            and self._draft is not None
+            and getattr(self.backend, "supports_draft", False)
+        )
+        use_spec = (
+            spec_ok
+            and not use_draft
             and getattr(self.backend, "supports_speculative", False)
         )
         max_tokens, decode_bucket = self._clamp_decode(
             prompt_len, max_tokens,
-            headroom=SPEC_DRAFT_LEN if use_spec else 0,
+            headroom=SPEC_DRAFT_LEN if (use_spec or use_draft) else 0,
         )
 
         sampling = G.default_sampling(
@@ -553,7 +633,20 @@ class InferenceEngine:
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
 
-        if use_spec:
+        if use_draft:
+            dcfg, dparams = self._draft
+            dcache = self._draft_cache
+            self._draft_cache = None
+            if dcache is None:
+                dcache = M.init_kv_cache(dcfg, 1, max_seq=cfg.max_seq_len)
+            dcache = self._draft_ingest(ids, dcache)
+            out, n_gen, cache, dcache = self.backend.decode_draft_speculative(
+                dcfg, dparams, first, cache, dcache, jnp.int32(prompt_len),
+                jnp.int32(max_tokens - 1), max_steps=decode_bucket,
+                draft_len=SPEC_DRAFT_LEN,
+            )
+            self._draft_cache = dcache
+        elif use_spec:
             # H is static per model so the program compiles once
             H = cfg.max_seq_len + SPEC_DRAFT_LEN + 2
             hist = jnp.zeros((1, H), jnp.int32)
@@ -664,8 +757,10 @@ class InferenceEngine:
         if token_logprobs is not None:
             result["token_logprobs"] = token_logprobs
             result["token_strings"] = token_strings
-        if use_spec:
+        if use_spec or use_draft:
             result["speculative"] = True
+        if use_draft:
+            result["draft_model"] = self._draft[0].name
         if top_predictions is not None:
             result["top_predictions"] = top_predictions
         return result

@@ -619,3 +619,113 @@ def decode_speculative(
     )
     _, _, _, _, cache, out, n_gen, _ = jax.lax.while_loop(cond, body, init)
     return out[:, :max_steps], n_gen[None], cache
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "dcfg", "max_steps", "draft_len"),
+    donate_argnames=("cache", "dcache"),
+)
+def decode_draft_speculative(
+    cfg: ModelConfig,
+    params,
+    dcfg: ModelConfig,
+    dparams,
+    first_token,
+    cache,
+    dcache,
+    start_pos,
+    limit,
+    *,
+    max_steps: int,
+    draft_len: int = 4,
+):
+    """Greedy decode verified against a separate (smaller) DRAFT model.
+
+    Classic two-model speculative decoding, greedy-acceptance flavor:
+    each iteration the draft model autoregressively proposes `draft_len`
+    tokens (cheap — small model), the target runs ONE forward over
+    [current, draft] (costing ~one normal HBM-bound step, same argument
+    as `decode_speculative`), and the longest draft prefix matching the
+    target's own argmax is emitted plus the target's correction token.
+    Every emitted token is the target's argmax given the accepted
+    context — exact vs plain greedy in fp32; bf16 near-ties may resolve
+    differently (chunked-vs-tokenwise class of divergence). Unlike
+    prompt-lookup (which only wins on self-repeating text), a competent
+    draft model accelerates ARBITRARY text at the cost of holding its
+    weights in HBM.
+
+    KV discipline (both caches hold history < the last emitted token's
+    position on loop entry — the prompt must be prefilled into BOTH):
+      * draft: the proposal scan runs draft_len+1 steps from `cur`,
+        writing draft K/V at pos..pos+G — one step more than it proposes,
+        so a full-accept-plus-bonus iteration leaves no unwritten hole at
+        pos+G for the next iteration to attend through.
+      * target: the verify forward writes K/V for [cur, draft] at
+        pos..pos+G. Rejected-slot staleness is overwritten before it is
+        ever attended (same argument as decode_speculative).
+
+    Greedy only, B=1. Returns (out [1, max_steps], n_gen [1], cache,
+    dcache).
+    """
+    G = draft_len
+    pad = jnp.int32(cfg.pad_token_id)
+    out0 = jnp.full((1, max_steps + G + 1), pad, jnp.int32)
+    limit = jnp.minimum(limit, jnp.int32(max_steps))
+    finished0 = stop_mask(cfg, first_token[0]) | (limit <= 0)
+
+    def cond(c):
+        _, _, _, _, _, n_gen, finished = c
+        return (n_gen < limit) & ~finished
+
+    def body(c):
+        cur, pos, cache, dcache, out, n_gen, finished = c
+
+        # --- draft chain: G+1 greedy steps from `cur` (the +1 writes
+        # d_{G-1}'s K/V so a full accept leaves no cache hole; its
+        # proposal is discarded)
+        def dstep(carry, _):
+            tok, p, dc = carry
+            x = M.embed(dcfg, dparams, tok[None, None], p)
+            x, dc = M.forward_layers(dcfg, dparams["layers"], x, dc, p)
+            nxt = jnp.argmax(M.unembed(dcfg, dparams, x)[0, 0]).astype(jnp.int32)
+            return (nxt, p + 1, dc), nxt
+
+        (_, _, dcache), proposals = jax.lax.scan(
+            dstep, (cur, pos, dcache), None, length=G + 1
+        )
+        draft = proposals[:G]
+
+        # --- one target forward over [current, draft] at pos
+        tokens_in = jnp.concatenate([cur[None], draft])[None, :]  # [1, 1+G]
+        x = M.embed(cfg, params, tokens_in, pos)
+        x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+        window = jnp.argmax(M.unembed(cfg, params, x)[0], axis=-1).astype(
+            jnp.int32
+        )  # [1+G]
+
+        # --- accept matched prefix + correction (identical emit logic to
+        # decode_speculative)
+        match = draft == window[:G]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+        j = jnp.arange(G + 1, dtype=jnp.int32)
+        valid = j <= n_acc
+        cum_eos = jnp.cumsum(stop_mask(cfg, window).astype(jnp.int32)) > 0
+        emit_ok = valid & ~cum_eos
+        room = limit - n_gen
+        n_emit = jnp.minimum(jnp.sum(emit_ok.astype(jnp.int32)), room)
+        emit_ok = emit_ok & (j < n_emit)
+        saw_eos = jnp.any(valid & cum_eos)
+
+        out = jax.lax.dynamic_update_slice(
+            out, jnp.where(emit_ok, window, pad)[None, :], (jnp.int32(0), n_gen)
+        )
+        cur2 = window[jnp.maximum(n_emit - 1, 0)]
+        finished2 = saw_eos | (n_emit <= 0)
+        return (cur2, pos + n_emit, cache, dcache, out, n_gen + n_emit,
+                finished2)
+
+    init = (first_token[0], start_pos, cache, dcache, out0, jnp.int32(0),
+            finished0)
+    _, _, cache, dcache, out, n_gen, _ = jax.lax.while_loop(cond, body, init)
+    return out[:, :max_steps], n_gen[None], cache, dcache

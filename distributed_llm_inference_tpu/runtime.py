@@ -108,11 +108,16 @@ def create_engine(
     tokenizer: Any = None,
     seed: int = 0,
     sp_strategy: str = "ring",
+    draft_model: Optional[str | ModelConfig] = None,
+    draft_params: Any = None,
 ) -> InferenceEngine:
     """Build an engine; pp>1 selects the SPMD pipeline backend.
 
     params=None random-initializes (offline bring-up / benchmarks);
     pass a converted HF pytree (models/convert.py) for real weights.
+    draft_model attaches a smaller same-tokenizer model for two-model
+    speculative decoding ("speculative": true greedy requests verify the
+    draft's proposals instead of prompt-lookup n-grams).
     """
     if mesh_cfg.dp > 1:
         # the serving engine decodes batch=1, which cannot shard over dp
@@ -127,6 +132,15 @@ def create_engine(
         model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, quant=quant,
         seed=seed, sp_strategy=sp_strategy,
     )
-    return InferenceEngine(
+    engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed
     )
+    if draft_model is not None:
+        dcfg = (
+            get_model_config(draft_model)
+            if isinstance(draft_model, str) else draft_model
+        )
+        if dtype is not None:
+            dcfg = dcfg.replace(dtype=dtype)
+        engine.set_draft(dcfg, draft_params, seed=seed + 1)
+    return engine

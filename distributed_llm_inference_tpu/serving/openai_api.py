@@ -122,6 +122,10 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
         raise OpenAIError(f"bad parameter: {e}") from None
     if temperature < 0:
         raise OpenAIError("temperature must be >= 0", param="temperature")
+    if max_tokens < 1:
+        # OpenAI rejects a zero/negative budget; the engine would silently
+        # re-clamp it to 1 and bill a token the client asked not to pay for
+        raise OpenAIError("max_tokens must be >= 1", param="max_tokens")
     kwargs = dict(
         max_tokens=min(max_tokens, cap),
         temperature=temperature if temperature > 0 else 1.0,

@@ -1,0 +1,175 @@
+"""Two-model (draft) speculative decoding
+(engine/generate.decode_draft_speculative + engine.set_draft).
+
+Correctness bar: identical to plain greedy decode in this suite's fp32
+CPU environment — every emitted token is the TARGET's argmax given the
+accepted context; the draft model only changes how many land per target
+forward. draft == target must accept everything (draft_len tokens per
+verify, plus bonus when partial). The reference has no analogue (no
+speculation, no KV cache at all — /root/reference/Worker1.py:132-134);
+this is a beyond-parity TPU feature: batch-1 decode is HBM-bound, so a
+T=1+g verify forward costs ~one normal step.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_llm_inference_tpu import EngineConfig, create_engine
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.models.registry import get_model_config
+
+MAX_SEQ = 256
+
+
+def _greedy_reference(cfg, params, tokens, plen, steps, key):
+    sampling = G.default_sampling(greedy=True)
+    cache = M.init_kv_cache(cfg, 1, max_seq=MAX_SEQ)
+    first, _, cache = G.prefill(
+        cfg, params, tokens, jnp.int32(plen), cache, key, sampling
+    )
+    out, n, _ = G.decode(
+        cfg, params, first, cache, jnp.int32(plen), jnp.int32(steps),
+        key, sampling, max_steps=steps,
+    )
+    return first, out, n
+
+
+def _draft_spec(cfg, params, dcfg, dparams, tokens, plen, steps, key,
+                draft_len=4):
+    sampling = G.default_sampling(greedy=True)
+    cache = M.init_kv_cache(cfg, 1, max_seq=MAX_SEQ)
+    first, _, cache = G.prefill(
+        cfg, params, tokens, jnp.int32(plen), cache, key, sampling
+    )
+    dcache = M.init_kv_cache(dcfg, 1, max_seq=MAX_SEQ)
+    _, _, dcache = G.prefill(
+        dcfg, dparams, tokens, jnp.int32(plen), dcache, key, sampling
+    )
+    out, n, _, _ = G.decode_draft_speculative(
+        cfg, params, dcfg, dparams, first, cache, dcache,
+        jnp.int32(plen), jnp.int32(steps), max_steps=steps,
+        draft_len=draft_len,
+    )
+    return first, out, n
+
+
+def _ids(cfg, plen, seed=0, bucket=32):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, cfg.vocab_size, size=plen).tolist()
+    tokens = jnp.asarray(
+        [ids + [cfg.pad_token_id] * (bucket - plen)], jnp.int32
+    )
+    return ids, tokens
+
+
+@pytest.mark.parametrize("draft_len", [2, 4])
+def test_weak_draft_matches_plain_greedy(draft_len):
+    """A DIFFERENT draft model (other init seed — mostly-rejected
+    proposals) must still emit exactly the target's greedy tokens."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = M.init_params(cfg, jax.random.PRNGKey(7))
+    ids, tokens = _ids(cfg, 11)
+    key = jax.random.PRNGKey(1)
+    steps = 24
+    _, ref_out, ref_n = _greedy_reference(cfg, params, tokens, 11, steps, key)
+    _, out, n = _draft_spec(
+        cfg, params, cfg, dparams, tokens, 11, steps, key, draft_len
+    )
+    assert int(n[0]) == int(ref_n[0])
+    np.testing.assert_array_equal(
+        np.asarray(out[0][: int(n[0])]), np.asarray(ref_out[0][: int(ref_n[0])])
+    )
+
+
+def test_perfect_draft_accepts_everything():
+    """draft == target: every verify accepts the full draft (+ bonus when
+    partial), so the loop runs ~steps/draft_len iterations — observable as
+    identical output with full acceptance."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    ids, tokens = _ids(cfg, 9)
+    key = jax.random.PRNGKey(1)
+    steps = 20
+    _, ref_out, ref_n = _greedy_reference(cfg, params, tokens, 9, steps, key)
+    _, out, n = _draft_spec(cfg, params, cfg, params, tokens, 9, steps, key)
+    assert int(n[0]) == int(ref_n[0])
+    np.testing.assert_array_equal(
+        np.asarray(out[0][: int(n[0])]), np.asarray(ref_out[0][: int(ref_n[0])])
+    )
+
+
+def test_draft_smaller_model():
+    """A genuinely smaller draft (fewer layers/heads, same vocab) — the
+    production shape — still produces the target's exact greedy tokens."""
+    cfg = get_model_config("test-llama-tiny")
+    dcfg = cfg.replace(n_layers=1, name="draft-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    dparams = M.init_params(dcfg, jax.random.PRNGKey(3))
+    ids, tokens = _ids(cfg, 10)
+    key = jax.random.PRNGKey(1)
+    steps = 16
+    _, ref_out, ref_n = _greedy_reference(cfg, params, tokens, 10, steps, key)
+    _, out, n = _draft_spec(cfg, params, dcfg, dparams, tokens, 10, steps, key)
+    assert int(n[0]) == int(ref_n[0])
+    np.testing.assert_array_equal(
+        np.asarray(out[0][: int(n[0])]), np.asarray(ref_out[0][: int(ref_n[0])])
+    )
+
+
+def test_engine_draft_end_to_end():
+    """create_engine(draft_model=...) serves speculative requests through
+    the draft path (envelope says so) and matches the plain greedy text,
+    including across repeated requests (draft cache reuse) and a chunked
+    prompt (draft-side extend ingest)."""
+    dcfg = get_model_config("test-llama-tiny").replace(
+        n_layers=1, name="draft-tiny"
+    )
+    engine = create_engine(
+        "test-llama-tiny",
+        engine_cfg=EngineConfig(prefill_buckets=(16, 32)),
+        draft_model=dcfg,
+    )
+    # second prompt: ~41 tokens > the 32-token bucket -> chunked ingest on
+    # both the target and draft caches (within max_seq_len 128)
+    for prompt in ["hello tiny world", "a b c d e f g h i j " * 2]:
+        plain = engine.generate(
+            prompt, max_tokens=12, greedy=True, chat=False
+        )
+        spec = engine.generate(
+            prompt, max_tokens=12, greedy=True, chat=False, speculative=True
+        )
+        assert spec["status"] == "success"
+        assert spec["speculative"] is True
+        assert spec["draft_model"] == "draft-tiny"
+        assert spec["response"] == plain["response"], prompt
+        assert spec["tokens_generated"] == plain["tokens_generated"]
+
+
+def test_engine_draft_vocab_mismatch_rejected():
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(cfg)
+    with pytest.raises(ValueError, match="vocab"):
+        eng.set_draft(cfg.replace(vocab_size=cfg.vocab_size + 7))
+
+
+def test_draft_stops_at_eos():
+    """EOS inside an accepted window ends generation before the budget."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    ids, tokens = _ids(cfg, 8, seed=5)
+    key = jax.random.PRNGKey(2)
+    steps = 48
+    _, ref_out, ref_n = _greedy_reference(cfg, params, tokens, 8, steps, key)
+    _, out, n = _draft_spec(cfg, params, cfg, params, tokens, 8, steps, key)
+    assert int(n[0]) == int(ref_n[0])
+    # whatever the reference emitted (EOS-stopped or budget-stopped),
+    # the speculative run emitted the same
+    np.testing.assert_array_equal(
+        np.asarray(out[0][: int(n[0])]), np.asarray(ref_out[0][: int(ref_n[0])])
+    )

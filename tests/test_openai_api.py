@@ -164,6 +164,7 @@ def test_completions_errors(served):
         ({"prompt": "x", "frequency_penalty": 0.5}, "frequency_penalty"),
         ({"prompt": "x", "frequency_penalty": "y"}, "frequency_penalty"),
         ({"prompt": "x", "temperature": -1}, "temperature"),
+        ({"prompt": "x", "max_tokens": 0}, "max_tokens"),
         ({"prompt": "x", "stop": 5}, "stop"),
     ]:
         with pytest.raises(urllib.error.HTTPError) as ei:
