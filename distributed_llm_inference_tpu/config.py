@@ -85,10 +85,11 @@ class ModelConfig:
     use_learned_pos: bool = False
     dtype: str = "float32"  # parameter / activation dtype: "float32" | "bfloat16"
     # Weight-only quantization of the matmul weights (ops/quant.py):
-    # None | "int8". Halves decode's HBM bytes/token (the batch-1 decode
-    # bound; ~1.6x measured on v5e). Llama family; works on the single
-    # device AND the SPMD mesh backends (QTensor leaves shard like their
-    # weights).
+    # None | "int8" | "int4". int8 halves decode's HBM bytes/token (the
+    # batch-1 decode bound; ~1.6x measured on v5e); int4 halves them
+    # again (packed nibbles, group-wise scales). Llama family; works on
+    # the single device AND the SPMD mesh backends (quantized leaves
+    # shard like their weights).
     quant: Optional[str] = None
     # Attention implementation: "xla" (einsum + full mask, fused by XLA) or
     # "pallas" (flash kernel, ops/flash_attention.py; interpret-mode on CPU).
@@ -131,8 +132,10 @@ class ModelConfig:
                 "query-scale overrides, or per-layer window patterns "
                 "(Gemma-2); use attn_impl='xla'"
             )
-        if self.quant not in (None, "int8"):
-            raise ValueError(f"quant must be None or 'int8', got {self.quant!r}")
+        if self.quant not in (None, "int8", "int4"):
+            raise ValueError(
+                f"quant must be None, 'int8', or 'int4', got {self.quant!r}"
+            )
         if self.rope_scaling not in (None, "llama3"):
             raise ValueError(
                 f"rope_scaling must be None or 'llama3', got {self.rope_scaling!r}"

@@ -35,6 +35,7 @@ verification) are per-request, not per-fleet.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Optional
@@ -94,6 +95,7 @@ class ContinuousEngine:
         n_slots: int = 8,
         chunk_steps: int = 16,
         max_queue: int = 64,
+        chunk_lag: int = 2,
     ):
         cfg = engine.cfg
         if cfg.arch not in ("llama", "gpt2"):
@@ -113,6 +115,14 @@ class ContinuousEngine:
         self.n_slots = int(n_slots)
         self.chunk_steps = int(chunk_steps)
         self.max_queue = int(max_queue)
+        # How many decode chunks may be in flight on the device before the
+        # worker blocks on the oldest chunk's fetch. 1 = classic lag-1
+        # (fetch N-1 overlaps compute N). Higher absorbs a fetch RTT
+        # LARGER than a chunk's compute (e.g. a tunneled TPU: ~70 ms RTT
+        # vs ~45 ms of chunk compute would idle the device every chunk at
+        # lag-1) at the cost of noticing EOS/stop/cancel up to `lag`
+        # chunks late — bounded compute waste, never wrong output.
+        self.chunk_lag = max(1, int(chunk_lag))
 
         self.cache = self.backend.init_cache(self.n_slots, cfg.max_seq_len)
         self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
@@ -356,13 +366,20 @@ class ContinuousEngine:
                 self._push_final(req)
 
     def _loop_inner(self):
-        prev = None  # (packed chunk results dev array, assignment snapshot)
+        # In-flight decode chunks, oldest first: (packed results dev array,
+        # assignment snapshot). Launch up to chunk_lag chunks before
+        # blocking on the oldest fetch — state/cache chain device-side
+        # between launches (no fetch needed to launch the next chunk), so
+        # the device stays fed even when the fetch RTT exceeds a chunk's
+        # compute. Admission (insert_slot) and kill (kill_slot) mutate the
+        # FUTURE-most state, which is exactly the one the next launch uses.
+        inflight: collections.deque = collections.deque()
         while True:
             with self._cv:
                 while (
                     not self._queue
                     and not any(self._assignment)
-                    and prev is None
+                    and not inflight
                     and not self._closed
                 ):
                     self._cv.wait()
@@ -371,17 +388,24 @@ class ContinuousEngine:
                 queue_head = bool(self._queue)
             if queue_head:
                 self._admit()
-            cur = None
+            launched = False
             if any(r is not None for r in self._assignment):
                 emitted, mask, self.state, self.cache = self.backend.decode_slots(
                     self.state, self.cache, self._next_key(), self.sparams,
                     num_steps=self.chunk_steps,
                 )
                 packed = G.pack_chunk(emitted, mask, self.state.active)
-                cur = (packed, list(self._assignment))
-            if prev is not None:
-                self._process(prev)
-            prev = cur
+                inflight.append((packed, list(self._assignment)))
+                launched = True
+            # Block on the oldest chunk when MORE than chunk_lag chunks
+            # are unprocessed (so chunk_lag=1 keeps one outstanding after
+            # draining — the classic fetch-N-1-overlaps-compute-N) — or
+            # when nothing launched (all slots looked idle to the host:
+            # drain so finished requests finalize and new work can wake us)
+            while inflight and (len(inflight) > self.chunk_lag
+                                or not launched):
+                self._process(inflight.popleft())
+                launched = True  # drain one per wakeup once non-empty
 
     def _admit(self):
         """Prefill + splice every queued request a free slot can take.

@@ -419,21 +419,24 @@ class InferenceEngine:
             return None
         return n_full, rem, fitting[0], chunk
 
-    def _ingest(self, ids, p0, plan, cache, key, sampling, presence=None):
+    def _ingest(self, ids, p0, plan, cache, key, sampling, presence=None,
+                backend=None):
         """Feed ids[p0:] into `cache` per a `_plan_ingest` plan: n_full
         full-chunk extend() calls, then the final bucket-padded sampling
         chunk (prefill at offset 0, prefill_at otherwise). Shared by the
-        solo engine and the continuous engine's admission path — one copy
-        of the ingest sequence to fix. Returns (first, logits, cache).
+        solo engine, the continuous engine's admission path, AND the
+        draft model's prompt ingest (backend override) — one copy of the
+        ingest sequence to fix. Returns (first, logits, cache).
         presence: optional [1, V] repetition-penalty token set for the
         first-token sample."""
+        be = backend if backend is not None else self.backend
         n_full, rem, bucket, chunk = plan
         pad = self.cfg.pad_token_id
         for c in range(n_full):
             chunk_tokens = jnp.asarray(
                 [ids[p0 + c * chunk : p0 + (c + 1) * chunk]], jnp.int32
             )
-            cache = self.backend.extend(
+            cache = be.extend(
                 chunk_tokens, jnp.int32(p0 + c * chunk), cache
             )
         tail_start = p0 + n_full * chunk
@@ -441,11 +444,11 @@ class InferenceEngine:
             [ids[tail_start:] + [pad] * (bucket - rem)], jnp.int32
         )
         if tail_start == 0:
-            return self.backend.prefill(
+            return be.prefill(
                 tokens, jnp.int32(len(ids)), cache, key, sampling,
                 presence=presence,
             )
-        return self.backend.prefill_at(
+        return be.prefill_at(
             tokens, jnp.int32(tail_start), jnp.int32(rem), cache, key,
             sampling, presence=presence,
         )
@@ -488,29 +491,21 @@ class InferenceEngine:
 
     def _draft_ingest(self, ids: list, dcache):
         """Prefill the whole prompt into the DRAFT model's cache (two-model
-        speculation): same chunk plan as the main ingest, driven directly
-        through engine/generate (single-device semantics; no prefix cache
-        — correctness over draft-side TTFT). The draft's sampled first
-        token is discarded; only its KV matters."""
+        speculation): the SAME _ingest sequence as the target, driven
+        through a single-device backend view over (dcfg, dparams) — one
+        ingest copy to fix. No prefix cache (correctness over draft-side
+        TTFT); the draft's sampled first token is discarded, only its KV
+        matters."""
         dcfg, dparams = self._draft
         plan = self._plan_ingest(len(ids), 0, self._buckets())
         if plan is None:  # main path already accepted this prompt
             raise ValueError(
                 f"prompt length {len(ids)} exceeds draft ingest capacity"
             )
-        n_full, rem, bucket, chunk = plan
-        pad = dcfg.pad_token_id
-        for c in range(n_full):
-            t = jnp.asarray([ids[c * chunk : (c + 1) * chunk]], jnp.int32)
-            dcache = G.extend(dcfg, dparams, t, jnp.int32(c * chunk), dcache)
-        tail_start = n_full * chunk
-        tokens = jnp.asarray(
-            [ids[tail_start:] + [pad] * (bucket - rem)], jnp.int32
-        )
-        _, _, dcache = G.prefill(
-            dcfg, dparams, tokens, jnp.int32(rem), dcache,
-            jax.random.PRNGKey(0), G.default_sampling(greedy=True), None,
-            jnp.int32(tail_start), None,
+        _, _, dcache = self._ingest(
+            ids, 0, plan, dcache, jax.random.PRNGKey(0),
+            G.default_sampling(greedy=True),
+            backend=SingleDeviceBackend(dcfg, dparams),
         )
         return dcache
 
@@ -862,7 +857,37 @@ class InferenceEngine:
                         sampling, max_steps=db, with_logprobs=True,
                     )
                     n += 1
-            if getattr(self.backend, "supports_speculative", False):
+            if self._draft is not None and getattr(
+                self.backend, "supports_draft", False
+            ):
+                # speculative requests route to the DRAFT path when a
+                # draft is attached — warm ITS programs (ingest per
+                # bucket + the chunked-extend variant + the combined
+                # verify loop per decode bucket); the prompt-lookup
+                # program would be dead weight
+                dcfg, dparams = self._draft
+                dcache = self._draft_cache
+                self._draft_cache = None
+                if dcache is None:
+                    dcache = M.init_kv_cache(
+                        dcfg, 1, max_seq=self.cfg.max_seq_len
+                    )
+                for bucket in buckets:
+                    dcache = self._draft_ingest([pad] * bucket, dcache)
+                    n += 1
+                chunked_len = buckets[-1] + 1
+                if self._plan_ingest(chunked_len, 0, buckets) is not None:
+                    dcache = self._draft_ingest([pad] * chunked_len, dcache)
+                    n += 1
+                for db in decode_buckets:
+                    _, _, cache, dcache = self.backend.decode_draft_speculative(
+                        dcfg, dparams, first, cache, dcache, jnp.int32(1),
+                        jnp.int32(0), max_steps=db,
+                        draft_len=SPEC_DRAFT_LEN,
+                    )
+                    n += 1
+                self._draft_cache = dcache
+            elif getattr(self.backend, "supports_speculative", False):
                 # speculative programs too — 'no request pays jit latency'
                 # includes speculative=true requests
                 H = self.cfg.max_seq_len + SPEC_DRAFT_LEN + 2

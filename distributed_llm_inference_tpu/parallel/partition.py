@@ -161,8 +161,12 @@ def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
     Quantized leaves (ops/quant.QTensor) get a QTensor-of-specs: the int8
     weight q [L, in, out] keeps the weight's spec, and its per-output-
     channel scale s [L, out] drops the contraction axis — so scales shard
-    with their columns under tp and replicate for row-sharded weights."""
-    from ..ops.quant import QTensor
+    with their columns under tp and replicate for row-sharded weights.
+    int4 leaves (Q4Tensor) split the contraction axis into (groups, g/2):
+    an in-axis shard moves to the GROUP axis (q [L, G, g/2, out],
+    s [L, G, out]), so row-sharded int4 weights shard whole groups and
+    each device keeps its groups' scales."""
+    from ..ops.quant import Q4Tensor, QTensor
 
     specs = dict(_FAMILY_LAYER_SPECS[cfg.arch])
     if cfg.n_experts:
@@ -175,6 +179,12 @@ def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
         base = specs[k]
         if isinstance(v, QTensor):
             out[k] = QTensor(base, P(base[0], base[2]))
+        elif isinstance(v, Q4Tensor):
+            out[k] = Q4Tensor(
+                P(base[0], base[1], None, base[2]),
+                P(base[0], base[1], base[2]),
+                v.g,
+            )
         else:
             out[k] = base
     return out
@@ -186,7 +196,7 @@ def shared_specs(shared: dict) -> dict:
     for a Llama-3-8B-class model); norms / position rows replicate."""
     from .vocab import VOCAB_SHARDED
 
-    from ..ops.quant import QTensor
+    from ..ops.quant import Q4Tensor, QTensor
 
     specs = {}
     for k, v in shared.items():
@@ -197,6 +207,11 @@ def shared_specs(shared: dict) -> dict:
             if isinstance(v, QTensor):
                 # lm_head [D, V]: scale s [V] shards with the vocab columns
                 spec = QTensor(spec, P(AXIS_PP))
+            elif isinstance(v, Q4Tensor):
+                # lm_head q [G, g/2, V], s [G, V]
+                spec = Q4Tensor(
+                    P(axes[0], None, axes[1]), P(axes[0], axes[1]), v.g
+                )
             specs[k] = spec
         else:
             specs[k] = P()

@@ -51,10 +51,10 @@ def padded_vocab(vocab_size: int, pp: int) -> int:
 def pad_vocab(cfg: ModelConfig, shared: dict, pp: int) -> dict:
     """Zero-pad the vocab dim of embed/lm_head to a multiple of pp.
 
-    A quantized lm_head (ops/quant.QTensor) pads both the int8 columns
-    (zeros) and their scales (zeros) — pad logits come out 0 and are
-    sliced off after the gather either way."""
-    from ..ops.quant import QTensor
+    A quantized lm_head (ops/quant.QTensor / Q4Tensor) pads both the int
+    columns (zeros) and their scales (zeros) — pad logits come out 0 and
+    are sliced off after the gather either way."""
+    from ..ops.quant import Q4Tensor, QTensor
 
     V_pad = padded_vocab(cfg.vocab_size, pp)
     if V_pad == cfg.vocab_size:
@@ -69,6 +69,15 @@ def pad_vocab(cfg: ModelConfig, shared: dict, pp: int) -> dict:
             qpad = [(0, 0)] * x.q.ndim
             qpad[axis] = (0, n)
             out[name] = QTensor(jnp.pad(x.q, qpad), jnp.pad(x.s, [(0, n)]))
+        elif isinstance(x, Q4Tensor):
+            # lm_head q [G, g/2, V], s [G, V]: vocab is the LAST axis of
+            # both — the packed nibble axis is untouched
+            n = V_pad - x.q.shape[-1]
+            out[name] = Q4Tensor(
+                jnp.pad(x.q, [(0, 0), (0, 0), (0, n)]),
+                jnp.pad(x.s, [(0, 0), (0, n)]),
+                x.g,
+            )
         else:
             pad = [(0, 0)] * x.ndim
             pad[axis] = (0, V_pad - x.shape[axis])

@@ -525,9 +525,10 @@ def main(argv: Optional[list] = None):
              "on text the draft predicts well; single-device backend)",
     )
     ap.add_argument(
-        "--quant", default=None, choices=[None, "int8"],
+        "--quant", default=None, choices=[None, "int8", "int4"],
         help="weight-only quantization: int8 halves decode HBM bytes/token "
-             "(~1.6x measured decode speedup on v5e; llama family)",
+             "(~1.6x measured decode speedup on v5e; llama family); int4 "
+             "halves them again (packed nibbles, group-wise scales)",
     )
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)
@@ -560,6 +561,12 @@ def main(argv: Optional[list] = None):
     ap.add_argument(
         "--continuous-chunk", type=int, default=16,
         help="decode steps per device round-trip in continuous mode",
+    )
+    ap.add_argument(
+        "--continuous-lag", type=int, default=2,
+        help="decode chunks in flight before blocking on the oldest "
+             "fetch (>1 hides a device-fetch RTT larger than a chunk's "
+             "compute; EOS/stop noticed up to LAG chunks late)",
     )
     ap.add_argument(
         "--prefix-cache", type=int, default=0, metavar="N",
@@ -643,6 +650,7 @@ def main(argv: Optional[list] = None):
 
         continuous = ContinuousEngine(
             engine, n_slots=args.continuous, chunk_steps=args.continuous_chunk,
+            chunk_lag=args.continuous_lag,
         )
         if args.warmup:
             w = continuous.warmup()

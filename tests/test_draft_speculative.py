@@ -149,6 +149,29 @@ def test_engine_draft_end_to_end():
         assert spec["tokens_generated"] == plain["tokens_generated"]
 
 
+def test_engine_draft_warmup_covers_draft_path():
+    """warmup() on a draft-attached engine compiles the draft ingest +
+    combined verify programs (the ones speculative requests actually
+    run), and the engine serves correctly right after."""
+    dcfg = get_model_config("test-llama-tiny").replace(
+        n_layers=1, name="draft-tiny"
+    )
+    engine = create_engine(
+        "test-llama-tiny",
+        engine_cfg=EngineConfig(prefill_buckets=(16, 32)),
+        draft_model=dcfg,
+    )
+    stats = engine.warmup(decode_buckets=(16,))
+    assert stats["programs"] > 0
+    spec = engine.generate(
+        "after warm", max_tokens=6, greedy=True, chat=False, speculative=True
+    )
+    assert spec["status"] == "success"
+    assert spec["draft_model"] == "draft-tiny"
+    plain = engine.generate("after warm", max_tokens=6, greedy=True, chat=False)
+    assert spec["response"] == plain["response"]
+
+
 def test_engine_draft_vocab_mismatch_rejected():
     from distributed_llm_inference_tpu.engine.engine import InferenceEngine
 

@@ -147,3 +147,143 @@ def test_quant_rejects_gpt2():
     cfg = get_model_config("test-gpt2-tiny", quant="int8")
     with pytest.raises(NotImplementedError, match="llama"):
         create_engine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
+
+
+# -- int4 (packed nibbles, group-wise scales) -------------------------------
+
+
+def test_int4_pack_roundtrip():
+    """Packing then unpacking recovers the exact int4 code values."""
+    from distributed_llm_inference_tpu.ops.quant import (
+        Q4Tensor, _unpack_int4, dequantize_tensor4, quantize_tensor4,
+    )
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 24)), jnp.float32)
+    t = quantize_tensor4(w, group=16)
+    assert t.q.dtype == jnp.int8
+    assert t.q.shape == (4, 8, 24)  # [G=64/16, g/2, out]
+    assert t.s.shape == (4, 24)
+    codes = np.asarray(_unpack_int4(t.q))
+    assert codes.min() >= -7 and codes.max() <= 7
+    # reconstruction: |err| <= scale/2 per element within each group
+    back = np.asarray(dequantize_tensor4(t)).reshape(4, 16, 24)
+    want = np.asarray(w).reshape(4, 16, 24)
+    bound = np.asarray(t.s)[:, None, :] / 2 + 1e-7
+    assert np.all(np.abs(back - want) <= bound)
+
+
+def test_int4_matmul_matches_dequantized_reference():
+    from distributed_llm_inference_tpu.ops.quant import (
+        dequantize_tensor4, matmul, quantize_tensor4,
+    )
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((32, 24)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((2, 32)), jnp.float32)
+    t = quantize_tensor4(w, group=8)
+    got = matmul(x, t)
+    want = x @ dequantize_tensor4(t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int4_odd_group_falls_back_to_single_group():
+    from distributed_llm_inference_tpu.ops.quant import quantize_tensor4
+
+    w = jnp.ones((20, 8), jnp.float32)  # 20 % 64 != 0 -> one group of 20
+    t = quantize_tensor4(w, group=64)
+    assert t.g == 20 and t.q.shape == (1, 10, 8)
+
+
+def test_int4_params_forward_close_to_full_precision():
+    from distributed_llm_inference_tpu.ops.quant import Q4Tensor
+
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(cfg, params, mode="int4")
+    assert isinstance(qp["layers"]["wq"], Q4Tensor)
+    assert isinstance(qp["lm_head"], Q4Tensor)
+    tokens = jnp.asarray([[5, 9, 13, 2, 7, 11]], jnp.int32)
+    cache = M.init_kv_cache(cfg, 1, max_seq=32)
+    full, _ = M.forward(cfg, params, tokens, cache, jnp.int32(0))
+    cache = M.init_kv_cache(cfg, 1, max_seq=32)
+    quant, _ = M.forward(cfg, qp, tokens, cache, jnp.int32(0))
+    # group-wise int4 on RANDOM gaussian weights is quantization's worst
+    # case (no outlier structure; a tiny random model's logits are near-
+    # chaotic in its weights — measured ~0.23-0.35 rel err across group
+    # sizes 8-64 here, where real checkpoints track far tighter). The
+    # exactness of the int4 algebra itself is pinned by the
+    # pack-roundtrip and matmul-vs-dequantized tests above; this test
+    # only guards against gross wiring bugs (wrong scales, nibble-order
+    # swaps blow the error to O(1) x logit scale).
+    err = np.abs(np.asarray(full - quant))
+    scale = np.abs(np.asarray(full)).max()
+    assert err.max() / scale < 0.5, err.max() / scale
+
+
+def test_int4_engine_end_to_end():
+    cfg = get_model_config("test-llama-tiny", quant="int4")
+    engine = create_engine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
+    r = engine.generate("hello int4", max_tokens=5, greedy=True, chat=False)
+    assert r["status"] == "success", r
+    assert r["tokens_generated"] >= 1
+
+
+def test_int4_pipeline_matches_int4_single_device(eight_devices):
+    """int4 on a pp=2 x tp=2 mesh decodes bit-exactly what int4 on one
+    device decodes (Q4Tensor leaves shard: groups over tp-in, out over
+    tp-out, layers over pp; vocab padding handles the packed head)."""
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    # group=16: dim 64 -> 4 groups, divisible by tp=2 (row shards move to
+    # the group axis; real-model dims give dozens of groups at default 64)
+    qp = quantize_params(cfg, params, mode="int4", group=16)
+
+    ids = [5, 9, 13, 21, 8]
+    bucket, steps = 16, 6
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(3))
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, logits_s, cache_s = G.prefill(cfg, qp, tokens, plen, cache_s, kp, sampling)
+    out_s, n_s, _ = G.decode(
+        cfg, qp, f_s, cache_s, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    mesh = build_mesh(MeshConfig(dp=1, pp=2, tp=2), eight_devices)
+    pb = PipelineBackend(cfg, qp, mesh)
+    cache_p = pb.init_cache(1, 64)
+    f_p, logits_p, cache_p = pb.prefill(tokens, plen, cache_p, kp, sampling)
+    out_p, n_p, _ = pb.decode(
+        f_p, cache_p, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=1e-4, atol=1e-5
+    )
+    assert int(f_p[0]) == int(f_s[0])
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
+
+
+def test_int4_pallas_kernel_matches_reference():
+    """The Pallas VMEM-unpack kernel (decode hot path on TPU; interpret
+    mode here) computes exactly x @ dequant(w) for kernel-eligible
+    shapes, including R=1 (decode) and R=8 (slot fleet)."""
+    from distributed_llm_inference_tpu.ops.quant import (
+        dequantize_tensor4, q4_matmul_rows, quantize_tensor4,
+    )
+
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.standard_normal((256, 384)), jnp.float32)
+    t = quantize_tensor4(w, group=64)
+    for R in (1, 3, 8):
+        x = jnp.asarray(rng.standard_normal((R, 256)), jnp.float32)
+        got = q4_matmul_rows(x, t, interpret=True)
+        want = x @ dequantize_tensor4(t)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
