@@ -169,6 +169,9 @@ class ContinuousEngine:
             or bool(kwargs.get("debug"))
             or bool(kwargs.get("speculative"))
             or bool(kwargs.get("logprobs"))
+            # slots share one sampling program; a per-request [V] bias
+            # isn't in the slot params
+            or bool(kwargs.get("logit_bias"))
         )
 
     def _enqueue(self, req: _Request) -> Optional[dict]:

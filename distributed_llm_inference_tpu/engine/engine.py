@@ -63,15 +63,16 @@ class SingleDeviceBackend:
         return M.init_kv_cache(self.cfg, batch, max_seq=max_seq)
 
     def prefill(self, tokens, prompt_len, cache, key, sampling,
-                valid_start=None, presence=None):
+                valid_start=None, presence=None, bias=None):
         # pos always passed as a traced array so ordinary prefill, warmup,
         # and the chunked final chunk all share one compiled program per
-        # bucket shape. presence [B, V] (repetition-penalty token set) is
-        # None on the default path — penalized requests trace their own
-        # program variant, the reference-parity path stays untouched.
+        # bucket shape. presence [B, V] (repetition-penalty token set) and
+        # bias [V] (OpenAI logit_bias) are None on the default path —
+        # such requests trace their own program variant, the
+        # reference-parity path stays untouched.
         return G.prefill(
             self.cfg, self.params, tokens, prompt_len, cache, key, sampling,
-            valid_start, jnp.int32(0), presence,
+            valid_start, jnp.int32(0), presence, bias,
         )
 
     # chunked prefill (prompts longer than the largest bucket); the engine
@@ -81,20 +82,23 @@ class SingleDeviceBackend:
         return G.extend(self.cfg, self.params, tokens, pos, cache)
 
     def prefill_at(self, tokens, pos, valid_len, cache, key, sampling,
-                   presence=None):
+                   presence=None, bias=None):
         return G.prefill(
             self.cfg, self.params, tokens, valid_len, cache, key, sampling,
-            None, pos, presence,
+            None, pos, presence, bias,
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, presence=None, *, max_steps,
+               valid_start=None, presence=None, bias=None, *, max_steps,
                with_logprobs=False):
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
-            sampling, valid_start, presence, max_steps=max_steps,
+            sampling, valid_start, presence, bias, max_steps=max_steps,
             with_logprobs=with_logprobs,
         )
+
+    # OpenAI logit_bias ([V] added to raw logits each sample)
+    supports_bias = True
 
     # greedy prompt-lookup speculative decode (engine opts in per request)
     supports_speculative = True
@@ -346,6 +350,7 @@ class InferenceEngine:
         repetition_penalty: float = 1.0,
         stop: Optional[list] = None,
         logprobs: bool = False,
+        logit_bias: Optional[dict] = None,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -361,6 +366,10 @@ class InferenceEngine:
         (MinPLogitsWarper / RepetitionPenaltyLogitsProcessor; 0.0 / 1.0 =
         off). A repetition penalty disables speculation: it changes the
         argmax the draft verification compares against.
+        logit_bias: {token_id: bias} added to the raw logits at every
+        sample (OpenAI semantics; -100/+100 ban/force). Also disables
+        speculation (it changes the verify argmax), and reported
+        token_logprobs stay the RAW model distribution.
         """
         t_start = time.time()
 
@@ -369,7 +378,7 @@ class InferenceEngine:
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
-                    repetition_penalty, stop, logprobs,
+                    repetition_penalty, stop, logprobs, logit_bias,
                 )
 
         try:
@@ -420,7 +429,7 @@ class InferenceEngine:
         return n_full, rem, fitting[0], chunk
 
     def _ingest(self, ids, p0, plan, cache, key, sampling, presence=None,
-                backend=None):
+                bias=None, backend=None):
         """Feed ids[p0:] into `cache` per a `_plan_ingest` plan: n_full
         full-chunk extend() calls, then the final bucket-padded sampling
         chunk (prefill at offset 0, prefill_at otherwise). Shared by the
@@ -443,14 +452,19 @@ class InferenceEngine:
         tokens = jnp.asarray(
             [ids[tail_start:] + [pad] * (bucket - rem)], jnp.int32
         )
+        # bias passed only when set: backends without logit_bias support
+        # (no `bias` kwarg) still serve the default path — non-None is
+        # already rejected upstream by the supports_bias gate
+        kw = {"presence": presence}
+        if bias is not None:
+            kw["bias"] = bias
         if tail_start == 0:
             return be.prefill(
-                tokens, jnp.int32(len(ids)), cache, key, sampling,
-                presence=presence,
+                tokens, jnp.int32(len(ids)), cache, key, sampling, **kw
             )
         return be.prefill_at(
             tokens, jnp.int32(tail_start), jnp.int32(rem), cache, key,
-            sampling, presence=presence,
+            sampling, **kw,
         )
 
     def _prefix_plan(self, prefix, ids: list):
@@ -474,7 +488,7 @@ class InferenceEngine:
 
     def _ingest_with_prefix(
         self, prefix, ids, p0, entry, plan, cache, key, sampling,
-        presence=None,
+        presence=None, bias=None,
     ):
         """Splice a prefix hit, run the shared ingest sequence, store the
         (now complete) prompt KV back into the prefix cache. The
@@ -483,7 +497,7 @@ class InferenceEngine:
         if entry is not None:
             cache = prefix.splice(entry, cache, p0)
         first, logits, cache = self._ingest(
-            ids, p0, plan, cache, key, sampling, presence=presence
+            ids, p0, plan, cache, key, sampling, presence=presence, bias=bias
         )
         if prefix is not None:
             prefix.store(ids, len(ids), cache)
@@ -509,6 +523,32 @@ class InferenceEngine:
         )
         return dcache
 
+    def _bias_array(self, logit_bias):
+        """{token_id: bias} -> dense [V] f32 on validated ids, or None.
+
+        Dense because the sampler adds it to the logits row every step
+        (a scatter of a handful of floats — the [V] array is tiny next
+        to one decode step's weight traffic)."""
+        if not logit_bias:
+            return None
+        if not getattr(self.backend, "supports_bias", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support logit_bias; "
+                f"serve biased requests on the single-device backend"
+            )
+        import numpy as np
+
+        b = np.zeros((self.cfg.vocab_size,), np.float32)
+        for tid, v in logit_bias.items():
+            t = int(tid)
+            if not 0 <= t < self.cfg.vocab_size:
+                raise ValueError(
+                    f"logit_bias token id {t} outside vocab "
+                    f"[0, {self.cfg.vocab_size})"
+                )
+            b[t] = float(v)
+        return jnp.asarray(b)
+
     def _presence_rows(self, rows: list) -> jnp.ndarray:
         """[len(rows), V] bool: each row's token-id set, built host-side in
         numpy (the full prompt is already a host list — no device pass
@@ -523,10 +563,11 @@ class InferenceEngine:
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False, min_p=0.0,
-        repetition_penalty=1.0, stop=None, logprobs=False,
+        repetition_penalty=1.0, stop=None, logprobs=False, logit_bias=None,
     ):
         cfg = self.cfg
         self.request_count += 1
+        bias = self._bias_array(logit_bias)
         text = (
             format_chat_prompt(prompt, arch=cfg.arch, template=cfg.chat_template)
             if chat else prompt
@@ -576,10 +617,11 @@ class InferenceEngine:
         spec_ok = (
             speculative
             and greedy
-            # a repetition penalty changes the argmax the draft
-            # verification compares against — plain decode instead; and
-            # the speculative loop records no per-step logprobs
+            # a repetition penalty or logit bias changes the argmax the
+            # draft verification compares against — plain decode instead;
+            # and the speculative loop records no per-step logprobs
             and repetition_penalty == 1.0
+            and bias is None
             and not logprobs
         )
         # draft-model speculation wins over prompt-lookup when a draft is
@@ -623,7 +665,7 @@ class InferenceEngine:
         self._cache = None  # donated below; restored from the decode result
         first, logits, cache = self._ingest_with_prefix(
             self._prefix, ids, p0, entry, plan, cache, key_pre, sampling,
-            presence=presence,
+            presence=presence, bias=bias,
         )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
@@ -657,18 +699,20 @@ class InferenceEngine:
             if presence is not None:
                 presence = G.presence_update(presence, first.reshape(1))
             step_lps = None
+            dkw = {"presence": presence}
+            if bias is not None:  # backends without the kwarg stay untouched
+                dkw["bias"] = bias
             if logprobs:
                 out, n_gen, cache, step_lps = self.backend.decode(
                     first, cache, jnp.int32(prompt_len),
                     jnp.int32(max_tokens - 1), key_dec, sampling,
-                    presence=presence, max_steps=decode_bucket,
-                    with_logprobs=True,
+                    max_steps=decode_bucket, with_logprobs=True, **dkw,
                 )
             else:
                 out, n_gen, cache = self.backend.decode(
                     first, cache, jnp.int32(prompt_len),
                     jnp.int32(max_tokens - 1), key_dec, sampling,
-                    presence=presence, max_steps=decode_bucket,
+                    max_steps=decode_bucket, **dkw,
                 )
         out = jax.block_until_ready(out)
         self._cache = cache

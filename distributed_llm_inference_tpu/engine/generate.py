@@ -96,6 +96,7 @@ def _forward_step(cfg, params, tokens, cache, pos, valid_start=None):
 def prefill(
     cfg: ModelConfig, params, tokens, prompt_len, cache, key,
     sampling: SamplingParams, valid_start=None, pos=None, presence=None,
+    bias=None,
 ):
     """Run the padded prompt (or final chunked-prefill chunk), sample the
     first token.
@@ -122,7 +123,8 @@ def prefill(
     # presence [B, V]: the prompt's token-id set (host-built from the FULL
     # id list, so chunked prefill and prefix-cache hits see every token) —
     # feeds the HF-parity repetition penalty; None = penalty off
-    first = sample_token(key, logits, *sampling, presence=presence)
+    # bias [V] or [B, V]: OpenAI logit_bias added to raw logits (None = off)
+    first = sample_token(key, logits, *sampling, presence=presence, bias=bias)
     return first, logits, cache
 
 
@@ -156,6 +158,7 @@ def decode(
     sampling: SamplingParams,
     valid_start=None,
     presence=None,
+    bias=None,
     *,
     max_steps: int,
     with_logprobs: bool = False,
@@ -202,7 +205,8 @@ def decode(
         )
         key, sub = jax.random.split(key)
         nxt = sample_token(
-            sub, logits, *sampling, presence=pres if use_presence else None
+            sub, logits, *sampling, presence=pres if use_presence else None,
+            bias=bias,
         )
         if use_presence:
             pres = presence_update(pres, nxt)

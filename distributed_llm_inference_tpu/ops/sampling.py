@@ -95,6 +95,7 @@ def sample_token(
     min_p: jnp.ndarray = None,
     rep_penalty: jnp.ndarray = None,
     presence: jnp.ndarray = None,
+    bias: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Full sampling stack -> int32 token ids, shape logits.shape[:-1].
 
@@ -115,6 +116,11 @@ def sample_token(
     min-p piggybacks on the same sorted probs (max prob = rank-0 prob).
     """
     logits = logits.astype(jnp.float32)
+    if bias is not None:
+        # OpenAI logit_bias semantics: added to the RAW logits before any
+        # warper; -100/+100 effectively ban/force a token. Applies to the
+        # greedy argmax too (the ban must hold under temperature 0).
+        logits = logits + bias.astype(jnp.float32)
     if rep_penalty is not None and presence is not None:
         logits = apply_repetition_penalty(logits, presence, rep_penalty)
     scaled = apply_temperature(logits, temperature)

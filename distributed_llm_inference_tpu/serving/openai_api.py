@@ -78,22 +78,22 @@ def _reject_unsupported(data: dict, *, chat: bool):
                 f"{name} must be a number, got {v!r}", param=name
             ) from None
 
-    if as_num("n", 1, int) != 1:
-        raise OpenAIError("n > 1 is not supported", param="n")
+    n = as_num("n", 1, int)
+    if not 1 <= n <= 16:
+        raise OpenAIError("n must be between 1 and 16", param="n")
     if not chat and as_num("best_of", 1, int) != 1:
         raise OpenAIError("best_of > 1 is not supported", param="best_of")
     if not chat and data.get("echo"):
         raise OpenAIError("echo is not supported", param="echo")
     if not chat and data.get("suffix"):
         raise OpenAIError("suffix is not supported", param="suffix")
-    if data.get("logit_bias"):
-        raise OpenAIError("logit_bias is not supported", param="logit_bias")
     for p in ("frequency_penalty", "presence_penalty"):
         if as_num(p, 0.0, float) != 0.0:
             raise OpenAIError(
                 f"{p} is not supported (use repetition_penalty, an "
                 f"HF-semantics extension this server does support)", param=p,
             )
+    return n
 
 
 def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
@@ -146,12 +146,41 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
                               param="stop")
         if stop:
             kwargs["stop"] = stop
+    lb = data.get("logit_bias")
+    if lb:
+        if not isinstance(lb, dict):
+            raise OpenAIError("logit_bias must be an object of "
+                              "token_id -> bias", param="logit_bias")
+        try:
+            lb = {int(k): float(v) for k, v in lb.items()}
+        except (TypeError, ValueError):
+            raise OpenAIError("logit_bias keys must be token ids and "
+                              "values numbers", param="logit_bias") from None
+        if any(not -100.0 <= v <= 100.0 for v in lb.values()):
+            raise OpenAIError("logit_bias values must be in [-100, 100]",
+                              param="logit_bias")
+        kwargs["logit_bias"] = lb
     return kwargs
+
+
+def _check_n(n: int, prompts: list, kwargs: dict, stream: bool):
+    """n > 1 serves as a ragged fleet of the same prompt — combinations
+    the fleet cannot honor are rejected rather than silently degraded."""
+    if n == 1:
+        return
+    if len(prompts) > 1:
+        raise OpenAIError("n > 1 requires a single prompt", param="n")
+    if stream:
+        raise OpenAIError("n > 1 cannot be streamed", param="n")
+    if kwargs.get("logprobs"):
+        raise OpenAIError("n > 1 with logprobs is not supported", param="n")
+    if kwargs.get("logit_bias"):
+        raise OpenAIError("n > 1 with logit_bias is not supported", param="n")
 
 
 def parse_completion(data: dict, cap: int):
     """POST /v1/completions body -> (prompts: list[str], kwargs, meta)."""
-    _reject_unsupported(data, chat=False)
+    n = _reject_unsupported(data, chat=False)
     prompt = data.get("prompt")
     if prompt is None:
         raise OpenAIError("you must provide a prompt", param="prompt")
@@ -163,7 +192,7 @@ def parse_completion(data: dict, cap: int):
             param="prompt",
         )
     kwargs = _common_kwargs(data, cap)
-    meta = {"stream": bool(data.get("stream", False))}
+    meta = {"stream": bool(data.get("stream", False)), "n": n}
     lp = data.get("logprobs")
     if lp is not None and lp is not False:
         # legacy completions logprobs is an int (top-N); only the chosen
@@ -175,12 +204,13 @@ def parse_completion(data: dict, cap: int):
                 param="logprobs",
             )
         kwargs["logprobs"] = True
+    _check_n(n, prompts, kwargs, meta["stream"])
     return prompts, kwargs, meta
 
 
 def parse_chat(data: dict, arch: str, template: Optional[str], cap: int):
     """POST /v1/chat/completions body -> (raw_prompt, kwargs, meta)."""
-    _reject_unsupported(data, chat=True)
+    n = _reject_unsupported(data, chat=True)
     messages = data.get("messages")
     if not (isinstance(messages, list) and messages
             and all(isinstance(m, dict) for m in messages)):
@@ -191,7 +221,7 @@ def parse_chat(data: dict, arch: str, template: Optional[str], cap: int):
     except ValueError as e:
         raise OpenAIError(str(e), param="messages") from None
     kwargs = _common_kwargs(data, cap, default_max=cap)
-    meta = {"stream": bool(data.get("stream", False))}
+    meta = {"stream": bool(data.get("stream", False)), "n": n}
     if data.get("top_logprobs"):
         # alternatives-per-position are not produced; silent empty lists
         # would masquerade as "no alternatives existed"
@@ -204,6 +234,7 @@ def parse_chat(data: dict, arch: str, template: Optional[str], cap: int):
                 param="logprobs",
             )
         kwargs["logprobs"] = True
+    _check_n(n, [prompt], kwargs, meta["stream"])
     return prompt, kwargs, meta
 
 
@@ -219,8 +250,12 @@ def _finish_reason(entry: dict, requested_max: int) -> str:
     return "length" if entry.get("tokens_generated", 0) >= requested_max else "stop"
 
 
-def _usage(entries: list) -> dict:
-    pt = sum(e.get("prompt_tokens", 0) for e in entries)
+def _usage(entries: list, prompt_once: bool = False) -> dict:
+    # prompt_once: n>1 choices share one prompt — OpenAI bills it once
+    if prompt_once and entries:
+        pt = entries[0].get("prompt_tokens", 0)
+    else:
+        pt = sum(e.get("prompt_tokens", 0) for e in entries)
     ct = sum(e.get("tokens_generated", 0) for e in entries)
     return {"prompt_tokens": pt, "completion_tokens": ct,
             "total_tokens": pt + ct}
@@ -236,7 +271,8 @@ def _logprobs_obj(entry: dict) -> Optional[dict]:
             "text_offset": None}
 
 
-def completion_response(entries: list, model: str, kwargs: dict) -> dict:
+def completion_response(entries: list, model: str, kwargs: dict,
+                        prompt_once: bool = False) -> dict:
     """Engine success envelope(s) -> one text_completion response."""
     choices = []
     for i, e in enumerate(entries):
@@ -255,33 +291,38 @@ def completion_response(entries: list, model: str, kwargs: dict) -> dict:
         "created": int(time.time()),
         "model": model,
         "choices": choices,
-        "usage": _usage(entries),
+        "usage": _usage(entries, prompt_once),
     }
 
 
-def chat_response(entry: dict, model: str, kwargs: dict) -> dict:
-    choice = {
-        "index": 0,
-        "message": {"role": "assistant", "content": entry.get("response", "")},
-        "finish_reason": _finish_reason(entry, kwargs["max_tokens"]),
-    }
-    lp = _logprobs_obj(entry)
-    if lp is not None:
-        # chat schema nests token logprobs under content
-        toks = lp["tokens"] or [""] * len(lp["token_logprobs"] or [])
-        choice["logprobs"] = {
-            "content": [
-                {"token": t, "logprob": x, "top_logprobs": []}
-                for t, x in zip(toks, lp["token_logprobs"] or [])
-            ]
+def chat_response(entries: list, model: str, kwargs: dict,
+                  prompt_once: bool = False) -> dict:
+    choices = []
+    for i, entry in enumerate(entries):
+        choice = {
+            "index": i,
+            "message": {"role": "assistant",
+                        "content": entry.get("response", "")},
+            "finish_reason": _finish_reason(entry, kwargs["max_tokens"]),
         }
+        lp = _logprobs_obj(entry)
+        if lp is not None:
+            # chat schema nests token logprobs under content
+            toks = lp["tokens"] or [""] * len(lp["token_logprobs"] or [])
+            choice["logprobs"] = {
+                "content": [
+                    {"token": t, "logprob": x, "top_logprobs": []}
+                    for t, x in zip(toks, lp["token_logprobs"] or [])
+                ]
+            }
+        choices.append(choice)
     return {
         "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
         "object": "chat.completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [choice],
-        "usage": _usage([entry]),
+        "choices": choices,
+        "usage": _usage(entries, prompt_once),
     }
 
 

@@ -60,6 +60,8 @@ class _Pending:
         if (
             self.is_batch or k.get("seed") is not None or k.get("debug")
             or k.get("logprobs")
+            # generate_batch has no logit_bias seam; biased requests solo
+            or k.get("logit_bias")
         ):
             return None
         return (

@@ -266,6 +266,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         )
                     self._openai_stream(prompts[0], kwargs, chat=chat)
                     return
+                n = meta.get("n", 1)
+                if n > 1:
+                    # n choices = one ragged fleet of the same prompt
+                    # (categorical draws are independent per row)
+                    prompts = prompts * n
                 if len(prompts) == 1:
                     result = self._run_single(prompts[0], kwargs)
                     if result.get("status") != "success":
@@ -293,14 +298,18 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 # parsers still answers 400, never a dropped connection
                 self._send(400, oai.OpenAIError(f"bad parameter: {e}").body)
                 return
+            prompt_once = meta.get("n", 1) > 1
             if chat:
                 self._send(
-                    200, oai.chat_response(entries[0], engine.cfg.name, kwargs)
+                    200,
+                    oai.chat_response(entries, engine.cfg.name, kwargs,
+                                      prompt_once=prompt_once),
                 )
             else:
                 self._send(
                     200,
-                    oai.completion_response(entries, engine.cfg.name, kwargs),
+                    oai.completion_response(entries, engine.cfg.name, kwargs,
+                                            prompt_once=prompt_once),
                 )
 
         def do_POST(self):
@@ -351,6 +360,16 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         data.get("repetition_penalty", 1.0)
                     ),
                 )
+                raw_bias = data.get("logit_bias")
+                if raw_bias is not None:
+                    # {token_id: bias} added to the raw logits every sample
+                    # (OpenAI semantics; the engine validates ids/backend)
+                    if not isinstance(raw_bias, dict):
+                        raise ValueError("logit_bias must be an object of "
+                                         "token_id -> bias")
+                    kwargs["logit_bias"] = {
+                        int(k): float(v) for k, v in raw_bias.items()
+                    }
                 raw_stop = data.get("stop")
                 if raw_stop is not None:
                     # OpenAI-style textual stop sequences: one string or a
@@ -401,6 +420,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     # batched form: "prompts": [...] -> one fleet, N results
                     if not isinstance(prompts, list):
                         raise ValueError("prompts must be a list of strings")
+                    if kwargs.get("logit_bias"):
+                        raise ValueError(
+                            "logit_bias requires a single 'prompt'"
+                        )
                     if queue is not None:
                         # same bounded backpressure as singles; full -> 429
                         result = queue.submit_batch(prompts, **kwargs)

@@ -157,10 +157,13 @@ def test_completions_seeded_sampling_reproducible(served):
 def test_completions_errors(served):
     for body, param in [
         ({"max_tokens": 4}, "prompt"),
-        ({"prompt": "x", "n": 3}, "n"),
+        ({"prompt": "x", "n": 99}, "n"),
         ({"prompt": "x", "n": "junk"}, "n"),
+        ({"prompt": ["a", "b"], "n": 2}, "n"),
+        ({"prompt": "x", "n": 2, "stream": True}, "n"),
         ({"prompt": "x", "best_of": 2}, "best_of"),
-        ({"prompt": "x", "logit_bias": {"5": 10}}, "logit_bias"),
+        ({"prompt": "x", "logit_bias": {"5": 500}}, "logit_bias"),
+        ({"prompt": "x", "logit_bias": {"x": "y"}}, "logit_bias"),
         ({"prompt": "x", "frequency_penalty": 0.5}, "frequency_penalty"),
         ({"prompt": "x", "frequency_penalty": "y"}, "frequency_penalty"),
         ({"prompt": "x", "temperature": -1}, "temperature"),
@@ -304,6 +307,63 @@ def test_format_chat_messages_multi_turn():
                  "<|assistant|>\n")
     n = format_chat_messages(msgs, arch="gpt2")
     assert n == "sys\nq1\na1\nq2"
+
+
+def test_completions_n_choices(served):
+    out = _post(served, "/v1/completions", {
+        "prompt": "pick some words", "max_tokens": 4, "n": 3,
+        "temperature": 0.9,
+    })
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    # prompt billed ONCE for n choices (OpenAI semantics)
+    one = _post(served, "/v1/completions", {
+        "prompt": "pick some words", "max_tokens": 4, "temperature": 0.9,
+    })
+    assert out["usage"]["prompt_tokens"] == one["usage"]["prompt_tokens"]
+    assert out["usage"]["completion_tokens"] <= 12
+
+
+def test_chat_completions_n_choices(served):
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hello"}],
+        "max_tokens": 3, "n": 2, "temperature": 0.8,
+    })
+    assert len(out["choices"]) == 2
+    assert all(c["message"]["role"] == "assistant" for c in out["choices"])
+
+
+def test_logit_bias_forces_and_bans(served):
+    """+100 on one token forces it at every step under greedy; banning the
+    natural first choice changes the output (OpenAI logit_bias semantics)."""
+    eng = served.engine
+    forced = 17
+    out = _post(served, "/v1/completions", {
+        "prompt": "bias me", "max_tokens": 4, "temperature": 0,
+        "logit_bias": {str(forced): 100},
+    })
+    ids = eng.tokenizer.encode(out["choices"][0]["text"])
+    # every generated token is the forced one (decoded text re-encodes to
+    # it; compare via the engine to dodge tokenizer round-trip quirks)
+    r = eng.generate("bias me", max_tokens=4, greedy=True, chat=False,
+                     logit_bias={forced: 100.0})
+    assert r["status"] == "success"
+    assert out["choices"][0]["text"] == r["response"]
+
+    base = eng.generate("ban test", max_tokens=1, greedy=True, chat=False)
+    first_id = eng.tokenizer.encode(base["response"])
+    if len(first_id) == 1:  # ban the natural argmax -> different token
+        banned = eng.generate(
+            "ban test", max_tokens=1, greedy=True, chat=False,
+            logit_bias={first_id[0]: -100.0},
+        )
+        assert banned["response"] != base["response"]
+
+
+def test_logit_bias_engine_validation(served):
+    r = served.engine.generate("x", max_tokens=2, greedy=True, chat=False,
+                               logit_bias={10**9: 5.0})
+    assert r["status"] == "failed"
+    assert r["error_type"] == "invalid_request"
 
 
 def test_stream_logprobs_and_top_logprobs_rejected(served):
