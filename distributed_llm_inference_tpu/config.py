@@ -74,6 +74,8 @@ class ModelConfig:
     # Biases on the q/k/v projections (Qwen2-style; llama family only —
     # gpt2 always has full biases).
     attn_qkv_bias: bool = False
+    # Qwen3: per-head RMSNorm on q and k (weight [head_dim]) before RoPE
+    use_qk_norm: bool = False
     # Sparse mixture-of-experts FFN (Mixtral-style): n_experts == 0 means a
     # dense SwiGLU MLP; > 0 replaces it with a top-k routed expert bank
     # (models/llama.moe_ffn). Expert weights stack an E axis and shard

@@ -100,6 +100,13 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         # llama semantics with fused projections (split at load time) and
         # the <|user|>/<|assistant|>/<|end|> chat format
         gemma_kw = dict(chat_template="phi3")
+    elif mt == "qwen3":
+        # Qwen3: per-head q/k RMSNorm before RoPE, explicit head_dim
+        # (often != dim/n_heads), NO qkv biases (dropped from Qwen2)
+        gemma_kw = dict(
+            use_qk_norm=True,
+            head_dim_override=getattr(hf_cfg, "head_dim", None),
+        )
     # Phi-3 instruct ends its turn with <|end|> (32007), but config.json
     # only carries the scalar eos 32000 (the extra stops live in
     # generation_config.json, which a weights-only conversion never sees) —
@@ -286,6 +293,19 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
         raise ValueError(
             "checkpoint has q/k/v projection biases but cfg.attn_qkv_bias is "
             "False — converting would silently drop them"
+        )
+    if cfg.use_qk_norm:
+        # Qwen3 per-head q/k norms, [Dh] each, stacked over layers
+        params["layers"]["q_norm"] = stack(
+            "model.layers.{}.self_attn.q_norm.weight", False
+        )
+        params["layers"]["k_norm"] = stack(
+            "model.layers.{}.self_attn.k_norm.weight", False
+        )
+    elif "model.layers.0.self_attn.q_norm.weight" in sd:
+        raise ValueError(
+            "checkpoint has q/k norms but cfg.use_qk_norm is False — "
+            "converting would silently drop them"
         )
     if not cfg.tie_embeddings:
         params["lm_head"] = jnp.asarray(p("lm_head.weight").T, dtype=dt)

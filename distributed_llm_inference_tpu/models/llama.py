@@ -100,6 +100,9 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         params["layers"]["bq"] = jnp.zeros((L, H * Dh), dt)
         params["layers"]["bk"] = jnp.zeros((L, KV * Dh), dt)
         params["layers"]["bv"] = jnp.zeros((L, KV * Dh), dt)
+    if cfg.use_qk_norm:  # Qwen3: per-head q/k RMSNorm weights [Dh]
+        params["layers"]["q_norm"] = jnp.ones((L, Dh), dt)
+        params["layers"]["k_norm"] = jnp.ones((L, Dh), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(ks[8], (D, V), s)
     return params
@@ -261,6 +264,12 @@ def decoder_layer(
     q = q.reshape(B, T, H, Dh)
     k = k.reshape(B, T, KV, Dh)
     v = v.reshape(B, T, KV, Dh)
+    if cfg.use_qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim on q and k, BEFORE RoPE
+        # (HF Qwen3Attention: q_norm/k_norm on the reshaped heads);
+        # weights [Dh] broadcast over the head axis, invariant under tp
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps)
     q, k = apply_rope(q, k, cos, sin)
 
     hook = attn_hook or default_attn_hook

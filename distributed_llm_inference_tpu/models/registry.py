@@ -108,6 +108,23 @@ register(ModelConfig(
     eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
 ))
 
+# --- Qwen3 (llama arch + per-head q/k RMSNorm, explicit head_dim, no
+# qkv biases) — HF transformers models/qwen3 ---
+register(ModelConfig(
+    name="qwen3-0.6b", arch="llama", vocab_size=151936, dim=1024,
+    n_layers=28, n_heads=16, n_kv_heads=8, ffn_dim=3072, max_seq_len=40960,
+    norm_eps=1e-6, rope_theta=1000000.0, head_dim_override=128,
+    use_qk_norm=True, tie_embeddings=True,
+    eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
+))
+register(ModelConfig(
+    name="qwen3-8b", arch="llama", vocab_size=151936, dim=4096,
+    n_layers=36, n_heads=32, n_kv_heads=8, ffn_dim=12288, max_seq_len=40960,
+    norm_eps=1e-6, rope_theta=1000000.0, head_dim_override=128,
+    use_qk_norm=True,
+    eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
+))
+
 # --- Gemma family (llama arch + unit-offset norms / GeGLU / embed scale) --
 register(ModelConfig(
     name="gemma-2b", arch="llama", vocab_size=256000, dim=2048,
@@ -180,6 +197,12 @@ register(ModelConfig(
     name="test-llama-tiny", arch="llama", vocab_size=256, dim=64,
     n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
     eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="test-qwen3-tiny", arch="llama", vocab_size=256, dim=64,
+    n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+    norm_eps=1e-6, head_dim_override=24, use_qk_norm=True,
+    tie_embeddings=True, eos_token_id=2, bos_token_id=1,
 ))
 register(ModelConfig(
     name="test-moe-tiny", arch="llama", vocab_size=256, dim=64,

@@ -44,6 +44,10 @@ _LLAMA_LAYER_SPECS = {
     "bq": P(AXIS_PP, AXIS_TP),
     "bk": P(AXIS_PP, AXIS_TP),
     "bv": P(AXIS_PP, AXIS_TP),
+    # Qwen3 per-head q/k norms [L, Dh]: head_dim is tp-invariant (heads
+    # shard, head_dim doesn't) -> replicate over tp
+    "q_norm": P(AXIS_PP, None),
+    "k_norm": P(AXIS_PP, None),
     "wo": P(AXIS_PP, AXIS_TP, None),
     "w_gate": P(AXIS_PP, None, AXIS_TP),
     "w_up": P(AXIS_PP, None, AXIS_TP),

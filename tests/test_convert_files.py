@@ -264,3 +264,36 @@ def test_phi3_file_roundtrip(tmp_path):
         cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
     )
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen3_file_roundtrip(tmp_path):
+    """Qwen3 checkpoint through FILES: config.json carries head_dim and
+    the model ships per-head q/k norms — the loader must stack them and
+    the logits must match HF."""
+    cfg_hf = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=1000000.0, pad_token_id=0, eos_token_id=2,
+        bos_token_id=1, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(17)
+    hf = transformers.Qwen3ForCausalLM(cfg_hf)
+    hf.eval()
+    d = str(tmp_path / "qwen3")
+    hf.save_pretrained(d, safe_serialization=True)
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.use_qk_norm and cfg.head_dim == 24
+    assert params["layers"]["q_norm"].shape == (3, 24)
+
+    rng = np.random.default_rng(18)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 17), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
