@@ -172,6 +172,8 @@ class ContinuousEngine:
             # slots share one sampling program; a per-request [V] bias
             # isn't in the slot params
             or bool(kwargs.get("logit_bias"))
+            # beam search is its own batched program
+            or int(kwargs.get("num_beams", 1) or 1) > 1
         )
 
     def _enqueue(self, req: _Request) -> Optional[dict]:

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import EngineConfig, ModelConfig
 from ..models import api as M
@@ -99,6 +100,17 @@ class SingleDeviceBackend:
 
     # OpenAI logit_bias ([V] added to raw logits each sample)
     supports_bias = True
+    # deterministic beam search (HF generate(num_beams=N) semantics);
+    # the KV cache reorders by parent beam with a batched gather
+    supports_beam = True
+
+    def decode_beam(self, logits0, cache, start_pos, limit, length_penalty,
+                    *, max_steps, num_beams, early_stopping):
+        return G.decode_beam(
+            self.cfg, self.params, logits0, cache, start_pos, limit,
+            length_penalty, max_steps=max_steps, num_beams=num_beams,
+            early_stopping=early_stopping,
+        )
 
     # greedy prompt-lookup speculative decode (engine opts in per request)
     supports_speculative = True
@@ -351,6 +363,9 @@ class InferenceEngine:
         stop: Optional[list] = None,
         logprobs: bool = False,
         logit_bias: Optional[dict] = None,
+        num_beams: int = 1,
+        length_penalty: float = 1.0,
+        early_stopping: bool = False,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -370,11 +385,20 @@ class InferenceEngine:
         sample (OpenAI semantics; -100/+100 ban/force). Also disables
         speculation (it changes the verify argmax), and reported
         token_logprobs stay the RAW model distribution.
+        num_beams > 1: deterministic beam search (HF generate(num_beams=N,
+        do_sample=False) semantics; length_penalty / early_stopping as in
+        HF). Sampling params / speculation / logprobs / bias are ignored
+        on the beam path — it is a pure max-score search.
         """
         t_start = time.time()
 
         def locked():
             with self._lock:
+                if num_beams > 1:
+                    return self._beam_locked(
+                        prompt, max_tokens, num_beams, length_penalty,
+                        early_stopping, chat, t_start, stop,
+                    )
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
@@ -522,6 +546,98 @@ class InferenceEngine:
             backend=SingleDeviceBackend(dcfg, dparams),
         )
         return dcache
+
+    def _beam_locked(self, prompt, max_tokens, num_beams, length_penalty,
+                     early_stopping, chat, t_start, stop):
+        """Deterministic beam search (engine side): tile the prompt to
+        [num_beams] rows, one batched prefill, then G.decode_beam. The
+        beam cache reuses the batched-cache pool (keyed by row count,
+        exactly like generate_batch's buckets)."""
+        cfg = self.cfg
+        self.request_count += 1
+        if not getattr(self.backend, "supports_beam", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support beam "
+                f"search; serve num_beams > 1 on the single-device backend"
+            )
+        if not 2 <= num_beams <= 16:
+            raise ValueError("num_beams must be between 2 and 16")
+        text = (
+            format_chat_prompt(prompt, arch=cfg.arch, template=cfg.chat_template)
+            if chat else prompt
+        )
+        ids = self.tokenizer.encode(text)
+        prompt_len = len(ids)
+        buckets = self._buckets()
+        if not buckets or prompt_len > buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds max prefill bucket "
+                f"{buckets[-1] if buckets else 0} (beam search prefills in "
+                f"one bucket)"
+            )
+        bucket = G.pick_bucket(buckets, prompt_len)
+        max_tokens, decode_bucket = self._clamp_decode(prompt_len, max_tokens)
+        pad = cfg.pad_token_id
+        row = ids + [pad] * (bucket - prompt_len)
+        tokens = jnp.asarray([row] * num_beams, jnp.int32)
+        cache = self._batch_caches.pop(num_beams, None)
+        if cache is None:
+            cache = self.backend.init_cache(num_beams, cfg.max_seq_len)
+        sampling = G.default_sampling(greedy=True)
+        _, logits, cache = self.backend.prefill(
+            tokens, jnp.int32(prompt_len), cache, jax.random.PRNGKey(0),
+            sampling,
+        )
+        ttft = time.time() - t_start
+        out, n_gen, scores, cache = self.backend.decode_beam(
+            logits, cache, jnp.int32(prompt_len), jnp.int32(max_tokens),
+            jnp.float32(length_penalty), max_steps=decode_bucket,
+            num_beams=num_beams, early_stopping=early_stopping,
+        )
+        out = jax.block_until_ready(out)
+        self._batch_caches.clear()
+        self._batch_caches[num_beams] = cache
+
+        beams = []
+        for b in range(num_beams):
+            n = int(n_gen[b])
+            txt = self.tokenizer.decode(
+                [int(t) for t in np.asarray(out[b][:n])],
+                skip_special_tokens=True,
+            )
+            txt, b_stopped = self._truncate_at_stop(txt, stop)
+            beams.append({
+                "text": txt, "score": round(float(scores[b]), 6),
+                "tokens": n, "stopped": b_stopped,
+            })
+        best = beams[0]
+        elapsed = time.time() - t_start
+        n = best["tokens"]
+        tps = n / elapsed if elapsed > 0 else 0.0
+        self._record_sample(ttft, tps, n)
+        log.info(
+            "beam_request", model=cfg.name, backend=self.backend.name,
+            num_beams=num_beams, tokens=n, elapsed_s=round(elapsed, 3),
+        )
+        result = {
+            "prompt": prompt,
+            "response": best["text"],
+            "status": "success",
+            "time_taken": f"{elapsed:.2f}s",
+            "tokens_generated": n,
+            "prompt_tokens": prompt_len,
+            "tokens_per_sec": f"{tps:.2f}",
+            "ttft_s": round(ttft, 4),
+            "backend": self.backend.name,
+            "num_beams": num_beams,
+            "beams": beams,
+            "finish_reason": (
+                "stop" if best["stopped"] or n < max_tokens else "length"
+            ),
+        }
+        if best["stopped"]:
+            result["stopped"] = True
+        return result
 
     def _bias_array(self, logit_bias):
         """{token_id: bias} -> dense [V] f32 on validated ids, or None.

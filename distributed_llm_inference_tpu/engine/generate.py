@@ -625,6 +625,166 @@ def decode_speculative(
     return out[:, :max_steps], n_gen[None], cache
 
 
+NEG_INF_F32 = jnp.float32(-1e9)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("cfg", "max_steps", "num_beams", "early_stopping"),
+    donate_argnames=("cache",),
+)
+def decode_beam(
+    cfg: ModelConfig,
+    params,
+    logits0,
+    cache,
+    start_pos,
+    limit,
+    length_penalty,
+    *,
+    max_steps: int,
+    num_beams: int,
+    early_stopping: bool = False,
+):
+    """Deterministic beam search after a BATCHED prefill (HF
+    `generate(num_beams=N, do_sample=False)` semantics — the reference
+    only samples, /root/reference/orchestration.py:168; this is
+    beyond-parity HF-generate completeness).
+
+    logits0: [num_beams, V] prefill logits (identical rows — the engine
+    tiles the prompt); cache: [L, num_beams, ...] prefilled (identical
+    rows). The first expansion takes the top num_beams DISTINCT tokens of
+    row 0; each later step expands every alive beam by the full vocab,
+    keeps the top num_beams alive continuations (EOS candidates retire
+    into a finished set scored sum_logprobs / len**length_penalty, HF
+    BeamSearchScorer), and reorders the KV cache by parent beam with a
+    batched gather. early_stopping=True stops once num_beams hypotheses
+    finished; False keeps going while an alive beam could still beat the
+    worst finished score (HF's is_done bound with best_sum_logprobs /
+    cur_len**length_penalty).
+
+    Returns (tokens [num_beams, max_steps] — the FINAL beams, best
+    first, pad-masked after EOS (EOS excluded), n_gen [num_beams],
+    scores [num_beams], cache).
+    """
+    nb = num_beams
+    V = logits0.shape[-1]
+    pad = jnp.int32(cfg.pad_token_id)
+    limit = jnp.minimum(limit, jnp.int32(max_steps))
+
+    lp0 = jax.nn.log_softmax(logits0[0].astype(jnp.float32))  # [V]
+    # mask stop tokens at the seed step like HF (a 1-token hypothesis from
+    # the prompt's immediate EOS): still allow it as a finished candidate
+    seed_scores, seed_tokens = jax.lax.top_k(lp0, nb)
+
+    out0 = jnp.full((nb, max_steps), pad, jnp.int32)
+    alive_out = out0.at[:, 0].set(seed_tokens)
+    alive_scores = seed_scores  # sum of logprobs per alive beam
+    alive_len = jnp.full((nb,), 1, jnp.int32)
+
+    fin_out = out0
+    fin_scores = jnp.full((nb,), NEG_INF_F32)
+    fin_len = jnp.zeros((nb,), jnp.int32)
+
+    # seed beams that ARE stop tokens retire immediately
+    seed_stop = stop_mask(cfg, seed_tokens)
+    pen1 = jnp.float32(1.0) ** length_penalty
+    fin_scores = jnp.where(seed_stop, seed_scores / pen1, fin_scores)
+    # finished hypotheses exclude the EOS token itself (reference
+    # break-before-append, orchestration.py:181-186): length 0 text
+    alive_scores = jnp.where(seed_stop, NEG_INF_F32, alive_scores)
+    order = jnp.argsort(-fin_scores)
+    fin_scores = fin_scores[order]
+    fin_out = fin_out[order]
+    fin_len = fin_len[order]
+
+    def cond(c):
+        (step, _, alive_scores, _, _, fin_scores, _, _, _) = c
+        if early_stopping:
+            more = jnp.any(fin_scores <= NEG_INF_F32 / 2)
+        else:
+            # an alive beam could still beat the worst finished hypothesis
+            # (HF is_done: best alive sum_logprobs / cur_len**penalty)
+            best_alive = jnp.max(alive_scores) / (
+                jnp.maximum(step.astype(jnp.float32), 1.0) ** length_penalty
+            )
+            more = jnp.min(fin_scores) < best_alive
+        return (step < limit) & more & jnp.any(alive_scores > NEG_INF_F32 / 2)
+
+    def body(c):
+        (step, alive_out, alive_scores, alive_len, cache, fin_scores,
+         fin_out, fin_len, pos) = c
+        last = jnp.take_along_axis(alive_out, (alive_len - 1)[:, None], axis=1)
+        logits, cache = _forward_step(cfg, params, last, cache, pos)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))  # [nb, V]
+        cand = alive_scores[:, None] + lp  # [nb, V]
+
+        flat = cand.reshape(nb * V)
+        # 2*nb candidates guarantee nb non-stop continuations survive
+        top_scores, top_idx = jax.lax.top_k(flat, 2 * nb)
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
+        is_stop = stop_mask(cfg, token)
+
+        # candidate sequences: parent's prefix + token (token NOT written
+        # for finished hypotheses — EOS excluded from the text)
+        cand_out = alive_out[parent]
+        cand_len = alive_len[parent]
+        write_col = jnp.clip(cand_len, 0, max_steps - 1)
+        ext_out = jax.vmap(
+            lambda row, col, t: row.at[col].set(t)
+        )(cand_out, write_col, token)
+
+        # finished pool: existing nb + new stop candidates, keep best nb
+        new_fin_scores = jnp.where(
+            is_stop,
+            top_scores / (cand_len.astype(jnp.float32) ** length_penalty),
+            NEG_INF_F32,
+        )
+        pool_scores = jnp.concatenate([fin_scores, new_fin_scores])
+        pool_out = jnp.concatenate([fin_out, cand_out])
+        pool_len = jnp.concatenate([fin_len, cand_len])
+        keep = jnp.argsort(-pool_scores)[:nb]
+        fin_scores, fin_out, fin_len = (
+            pool_scores[keep], pool_out[keep], pool_len[keep]
+        )
+
+        # alive pool: best nb non-stop candidates
+        alive_rank_score = jnp.where(is_stop, NEG_INF_F32, top_scores)
+        keep_a = jnp.argsort(-alive_rank_score)[:nb]
+        alive_scores = alive_rank_score[keep_a]
+        alive_out = ext_out[keep_a]
+        alive_len = cand_len[keep_a] + 1
+        parents = parent[keep_a]
+        # reorder every KV leaf by parent beam (batch axis 1)
+        cache = jax.tree.map(
+            lambda x: jnp.take(x, parents, axis=1), cache
+        )
+        return (step + 1, alive_out, alive_scores, alive_len, cache,
+                fin_scores, fin_out, fin_len, pos + 1)
+
+    init = (jnp.int32(1), alive_out, alive_scores, alive_len, cache,
+            fin_scores, fin_out, fin_len, start_pos)
+    (step, alive_out, alive_scores, alive_len, cache, fin_scores, fin_out,
+     fin_len, _) = jax.lax.while_loop(cond, body, init)
+
+    # merge: unfinished alive beams count as length-`alive_len` hypotheses
+    # (budget exhausted, HF's final add of running beams)
+    alive_final = alive_scores / (
+        jnp.maximum(alive_len.astype(jnp.float32), 1.0) ** length_penalty
+    )
+    all_scores = jnp.concatenate([fin_scores, alive_final])
+    all_out = jnp.concatenate([fin_out, alive_out])
+    all_len = jnp.concatenate([fin_len, alive_len])
+    best = jnp.argsort(-all_scores)[:nb]
+    out = all_out[best]
+    n_gen = all_len[best]
+    # pad-mask beyond each hypothesis' length
+    col = jnp.arange(max_steps, dtype=jnp.int32)[None, :]
+    out = jnp.where(col < n_gen[:, None], out, pad)
+    return out, n_gen, all_scores[best], cache
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "dcfg", "max_steps", "draft_len"),

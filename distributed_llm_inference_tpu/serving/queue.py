@@ -62,6 +62,8 @@ class _Pending:
             or k.get("logprobs")
             # generate_batch has no logit_bias seam; biased requests solo
             or k.get("logit_bias")
+            # beam search is its own batched program; runs solo
+            or int(k.get("num_beams", 1) or 1) > 1
         ):
             return None
         return (

@@ -360,6 +360,17 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         data.get("repetition_penalty", 1.0)
                     ),
                 )
+                nbeams = data.get("num_beams")
+                if nbeams is not None and int(nbeams) > 1:
+                    # deterministic beam search (HF num_beams semantics);
+                    # beam requests run solo (pure max-score search)
+                    kwargs["num_beams"] = int(nbeams)
+                    kwargs["length_penalty"] = float(
+                        data.get("length_penalty", 1.0)
+                    )
+                    kwargs["early_stopping"] = _parse_bool(
+                        data.get("early_stopping", False), "early_stopping"
+                    )
                 raw_bias = data.get("logit_bias")
                 if raw_bias is not None:
                     # {token_id: bias} added to the raw logits every sample
@@ -423,6 +434,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     if kwargs.get("logit_bias"):
                         raise ValueError(
                             "logit_bias requires a single 'prompt'"
+                        )
+                    if kwargs.get("num_beams", 1) > 1:
+                        raise ValueError(
+                            "num_beams requires a single 'prompt'"
                         )
                     if queue is not None:
                         # same bounded backpressure as singles; full -> 429
