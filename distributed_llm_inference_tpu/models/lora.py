@@ -1,0 +1,164 @@
+"""LoRA adapter loading + merge-at-load.
+
+Serves a PEFT-format adapter directory (`adapter_config.json` +
+`adapter_model.safetensors`) on top of a converted base checkpoint by
+merging the low-rank deltas into the stacked weights ONCE at load:
+
+    W' = W + (lora_alpha / r) * B @ A          (per layer, per module)
+
+Merging (rather than keeping A/B live at runtime) is the TPU-friendly
+serving shape here: decode is HBM-bound on the DENSE weight bytes either
+way, a merged checkpoint runs every existing program (quantization,
+pipeline sharding, speculation) unchanged, and there is no per-step
+low-rank matmul overhead. Multi-adapter hot-swap batching is a possible
+later extension; the reference has no adapter story at all (full
+fine-tuned checkpoints only, /root/reference/Worker1.py:60).
+
+PEFT tensor naming (peft >= 0.5 `save_pretrained`):
+    base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight  [r, in]
+    base_model.model.model.layers.{i}.self_attn.q_proj.lora_B.weight  [out, r]
+Our stacked leaves store W.T relative to HF ([in, out]), so the merged
+delta is (scale * B @ A).T.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..utils.logging import get_logger
+
+log = get_logger("lora")
+
+# PEFT target_modules name -> our stacked leaf
+_MODULE_TO_LEAF = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def load_lora_adapter(path: str) -> tuple[dict, dict]:
+    """Read a PEFT adapter dir -> (adapter_config, {tensor_name: np.ndarray})."""
+    from .convert import load_safetensors_file
+
+    cfg_path = os.path.join(path, "adapter_config.json")
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(
+            f"{path} has no adapter_config.json (expected a PEFT-format "
+            f"adapter directory)"
+        )
+    with open(cfg_path) as f:
+        acfg = json.load(f)
+    tensor_path = os.path.join(path, "adapter_model.safetensors")
+    if not os.path.exists(tensor_path):
+        raise FileNotFoundError(f"{path} has no adapter_model.safetensors")
+    return acfg, load_safetensors_file(tensor_path)
+
+
+def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
+    """Merge a PEFT LoRA adapter into converted stacked params.
+
+    Runs BEFORE quantization/sharding (the merged dense weights then flow
+    through every existing path). Raises on adapters that target modules
+    this layout doesn't carry, on rank/shape mismatches, and on already-
+    quantized params (merge order matters: quantizing first would merge
+    into nothing).
+    """
+    from ..ops.quant import Q4Tensor, QTensor
+
+    if cfg.arch != "llama":
+        raise ValueError(
+            f"LoRA merging is wired for the llama family; got {cfg.arch!r}"
+        )
+    acfg, tensors = load_lora_adapter(adapter_path)
+    r = int(acfg["r"])
+    scale = float(acfg.get("lora_alpha", r)) / r
+    L = cfg.n_layers
+
+    layers = dict(params["layers"])
+    prefixes = (
+        "base_model.model.model.layers.{}.self_attn.{}",
+        "base_model.model.model.layers.{}.mlp.{}",
+    )
+    merged_modules = set()
+    for module, leaf in _MODULE_TO_LEAF.items():
+        a_name = b_name = None
+        for pref in prefixes:
+            cand_a = pref.format(0, module) + ".lora_A.weight"
+            if cand_a in tensors:
+                a_name = pref + ".lora_A.weight"
+                b_name = pref + ".lora_B.weight"
+                break
+        if a_name is None:
+            continue
+        if leaf not in layers:
+            raise ValueError(
+                f"adapter targets {module} but params have no {leaf!r} leaf"
+            )
+        w = layers[leaf]
+        if isinstance(w, (QTensor, Q4Tensor)):
+            raise ValueError(
+                "params are already quantized — merge the LoRA adapter "
+                "BEFORE quantization (create_engine does this when both "
+                "are requested)"
+            )
+        deltas = []
+        for i in range(L):
+            a = tensors.get(a_name.format(i, module))
+            b = tensors.get(b_name.format(i, module))
+            if a is None or b is None:
+                raise ValueError(
+                    f"adapter is missing {module} lora_A/lora_B for layer "
+                    f"{i} (partial-layer adapters are not supported)"
+                )
+            if a.shape[0] != r or b.shape[1] != r:
+                raise ValueError(
+                    f"layer {i} {module}: rank mismatch (adapter_config r="
+                    f"{r}, tensors {a.shape} / {b.shape})"
+                )
+            # W' = W + scale * (B @ A); stacked leaves hold W.T [in, out]
+            delta = (
+                scale
+                * b.astype(np.float32) @ a.astype(np.float32)
+            ).T
+            deltas.append(delta)
+        stacked = jnp.asarray(np.stack(deltas, axis=0), w.dtype)
+        if stacked.shape != w.shape:
+            raise ValueError(
+                f"{leaf}: adapter delta shape {stacked.shape} != weight "
+                f"shape {w.shape}"
+            )
+        layers[leaf] = (w.astype(jnp.float32) + stacked.astype(jnp.float32)).astype(w.dtype)
+        merged_modules.add(module)
+    if not merged_modules:
+        raise ValueError(
+            f"adapter at {adapter_path} targets none of the supported "
+            f"modules {sorted(_MODULE_TO_LEAF)}"
+        )
+    unknown = {
+        n for n in tensors
+        if not any(f".{m}.lora_" in n for m in merged_modules)
+        and "lora_" in n
+    }
+    if unknown:
+        raise ValueError(
+            f"adapter has tensors for unsupported targets, e.g. "
+            f"{sorted(unknown)[:3]} — merging would silently drop them"
+        )
+    log.info(
+        "lora_merged", adapter=adapter_path, r=r, scale=scale,
+        modules=sorted(merged_modules),
+    )
+    out = dict(params)
+    out["layers"] = layers
+    return out

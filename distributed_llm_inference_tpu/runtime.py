@@ -31,6 +31,7 @@ def create_backend(
     quant: Optional[str] = None,
     seed: int = 0,
     sp_strategy: str = "ring",
+    lora: Optional[str] = None,
 ):
     """Build a compute backend alone (no engine/tokenizer around it).
 
@@ -71,6 +72,13 @@ def create_backend(
         )
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
+    if lora is not None:
+        # merge BEFORE quantization: the low-rank delta lands in the
+        # dense weights, then every downstream path (quant/sharding/
+        # speculation) sees one ordinary checkpoint
+        from .models.lora import merge_lora
+
+        params = merge_lora(cfg, params, lora)
     if cfg.quant is not None:
         from .ops.quant import quantize_params
 
@@ -110,6 +118,7 @@ def create_engine(
     sp_strategy: str = "ring",
     draft_model: Optional[str | ModelConfig] = None,
     draft_params: Any = None,
+    lora: Optional[str] = None,
 ) -> InferenceEngine:
     """Build an engine; pp>1 selects the SPMD pipeline backend.
 
@@ -130,7 +139,7 @@ def create_engine(
         )
     cfg, backend = create_backend(
         model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, quant=quant,
-        seed=seed, sp_strategy=sp_strategy,
+        seed=seed, sp_strategy=sp_strategy, lora=lora,
     )
     engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed

@@ -556,6 +556,11 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--ep", type=int, default=1, help="expert-parallel width (MoE)")
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
     ap.add_argument(
+        "--lora", default=None, metavar="DIR",
+        help="PEFT-format LoRA adapter directory to merge into the base "
+             "weights at load (W + alpha/r * BA; before quantization)",
+    )
+    ap.add_argument(
         "--draft-model", default=None, metavar="NAME",
         help="attach a smaller same-tokenizer model as a speculative "
              "draft: greedy requests with \"speculative\": true verify "
@@ -661,6 +666,7 @@ def main(argv: Optional[list] = None):
         seed=args.seed,
         sp_strategy=args.sp_strategy,
         draft_model=args.draft_model,
+        lora=args.lora,
     )
     if args.warmup:
         print("⏳ warming up (compiling all bucket shapes)...")
