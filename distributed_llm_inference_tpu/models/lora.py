@@ -82,7 +82,38 @@ def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
         )
     acfg, tensors = load_lora_adapter(adapter_path)
     r = int(acfg["r"])
-    scale = float(acfg.get("lora_alpha", r)) / r
+    # PEFT variants that change the merge MATH (not just naming) must be
+    # rejected, not approximated — a silently-wrong merged model is the
+    # worst failure mode a weights loader can have
+    if acfg.get("use_dora"):
+        raise ValueError(
+            "DoRA adapters (use_dora=true) are not supported: the "
+            "magnitude normalization changes the merge math"
+        )
+    if acfg.get("alpha_pattern"):
+        raise ValueError(
+            "per-module alpha_pattern adapters are not supported"
+        )
+    if acfg.get("layers_to_transform") is not None:
+        raise ValueError(
+            "layers_to_transform adapters (partial-layer) are not supported"
+        )
+    if acfg.get("modules_to_save"):
+        raise ValueError(
+            f"adapter carries fully fine-tuned modules_to_save="
+            f"{acfg['modules_to_save']} — merging only the LoRA deltas "
+            f"would silently drop them"
+        )
+    if acfg.get("bias", "none") != "none":
+        raise ValueError(
+            f"bias={acfg['bias']!r} adapters are not supported (trained "
+            f"bias tensors would be dropped)"
+        )
+    if acfg.get("use_rslora"):
+        # rank-stabilized LoRA: scale = alpha / sqrt(r)
+        scale = float(acfg.get("lora_alpha", r)) / (r ** 0.5)
+    else:
+        scale = float(acfg.get("lora_alpha", r)) / r
     L = cfg.n_layers
 
     layers = dict(params["layers"])
@@ -92,10 +123,15 @@ def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
     )
     merged_modules = set()
     for module, leaf in _MODULE_TO_LEAF.items():
+        # detect the module by ANY layer's tensor (a layers_to_transform
+        # adapter that slipped past the config check still gets the
+        # accurate partial-layer error below, not "unsupported target")
         a_name = b_name = None
         for pref in prefixes:
-            cand_a = pref.format(0, module) + ".lora_A.weight"
-            if cand_a in tensors:
+            if any(
+                pref.format(i, module) + ".lora_A.weight" in tensors
+                for i in range(L)
+            ):
                 a_name = pref + ".lora_A.weight"
                 b_name = pref + ".lora_B.weight"
                 break
@@ -145,15 +181,19 @@ def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
             f"adapter at {adapter_path} targets none of the supported "
             f"modules {sorted(_MODULE_TO_LEAF)}"
         )
+    # ANY tensor not consumed by the merge is an error — fine-tuned heads,
+    # bias terms, magnitude vectors, unsupported targets alike
     unknown = {
         n for n in tensors
-        if not any(f".{m}.lora_" in n for m in merged_modules)
-        and "lora_" in n
+        if not any(
+            f".{m}.lora_A." in n or f".{m}.lora_B." in n
+            for m in merged_modules
+        )
     }
     if unknown:
         raise ValueError(
-            f"adapter has tensors for unsupported targets, e.g. "
-            f"{sorted(unknown)[:3]} — merging would silently drop them"
+            f"adapter has tensors the merge would silently drop, e.g. "
+            f"{sorted(unknown)[:3]}"
         )
     log.info(
         "lora_merged", adapter=adapter_path, r=r, scale=scale,

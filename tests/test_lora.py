@@ -112,6 +112,65 @@ def test_merge_rejects_quantized_params(adapter):
         merge_lora(cfg, qp, adir)
 
 
+def test_rslora_scale_matches_hf(tmp_path):
+    """use_rslora adapters scale by alpha/sqrt(r); the merge must match
+    HF's own rsLoRA merge, not be off by sqrt(r)."""
+    base = _tiny_hf()
+    lcfg = peft.LoraConfig(
+        r=4, lora_alpha=8, use_rslora=True, target_modules=["q_proj"],
+        lora_dropout=0.0, task_type="CAUSAL_LM",
+    )
+    pm = peft.get_peft_model(_tiny_hf(), lcfg)
+    torch.manual_seed(9)
+    with torch.no_grad():
+        for name, p in pm.named_parameters():
+            if "lora_" in name:
+                p.copy_(torch.randn_like(p) * 0.1)
+    d = str(tmp_path / "rslora")
+    pm.save_pretrained(d)
+    merged_hf = pm.merge_and_unload()
+    merged_hf.eval()
+
+    cfg, params = params_from_hf_model(base, dtype="float32")
+    merged = merge_lora(cfg, params, d)
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 11), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = merged_hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=1, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, merged, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_merge_rejects_math_changing_variants(adapter, tmp_path):
+    """DoRA / modules_to_save / partial-layer configs must be rejected
+    loudly — a silently-wrong merged model is the worst failure mode."""
+    import json as _json
+    import shutil
+
+    base, _, adir = adapter
+    cfg, params = params_from_hf_model(base, dtype="float32")
+    for patch, msg in [
+        ({"use_dora": True}, "DoRA"),
+        ({"modules_to_save": ["lm_head"]}, "modules_to_save"),
+        ({"layers_to_transform": [1]}, "layers_to_transform"),
+        ({"bias": "lora_only"}, "bias"),
+        ({"alpha_pattern": {"q_proj": 32}}, "alpha_pattern"),
+    ]:
+        d = str(tmp_path / f"patched_{msg}")
+        shutil.copytree(adir, d)
+        with open(f"{d}/adapter_config.json") as f:
+            acfg = _json.load(f)
+        acfg.update(patch)
+        with open(f"{d}/adapter_config.json", "w") as f:
+            _json.dump(acfg, f)
+        with pytest.raises(ValueError, match=msg):
+            merge_lora(cfg, params, d)
+
+
 def test_merge_rejects_missing_adapter(tmp_path):
     cfg_dir = str(tmp_path / "nope")
     from distributed_llm_inference_tpu.models.registry import get_model_config
