@@ -211,6 +211,9 @@ def run_benchmark():
         fetch(f)
 
     ttft = max(min(_timed(prefill_once)[0] for _ in range(3)) - rtt, 0.0)
+    # prefill is the COMPUTE-bound phase (decode is HBM-bound): its MFU
+    # judges how well the big batched matmuls land on the MXU
+    prefill_tok_s = PROMPT_LEN / ttft if ttft > 0 else None
 
     # decode throughput: K chained decode calls (donated cache threaded
     # through), one scalar fetch at the end. One timing helper serves the
@@ -270,6 +273,8 @@ def run_benchmark():
         "mfu": round(mfu, 5) if mfu is not None else None,
         "hbm_util": round(hbm_util, 4) if hbm_util is not None else None,
     }
+    if peak and prefill_tok_s:
+        result["prefill_mfu"] = round(2.0 * n_params * prefill_tok_s / peak, 4)
     _PARTIAL["result"] = result
 
     # batched decode: 8 identical streams through the raw backend decode
