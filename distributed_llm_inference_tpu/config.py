@@ -76,6 +76,9 @@ class ModelConfig:
     attn_qkv_bias: bool = False
     # Qwen3: per-head RMSNorm on q and k (weight [head_dim]) before RoPE
     use_qk_norm: bool = False
+    # MoE router: renormalize the top-k probabilities to sum 1 (Mixtral
+    # always does; Qwen3-MoE gates it on norm_topk_prob)
+    moe_renormalize: bool = True
     # Sparse mixture-of-experts FFN (Mixtral-style): n_experts == 0 means a
     # dense SwiGLU MLP; > 0 replaces it with a top-k routed expert bank
     # (models/llama.moe_ffn). Expert weights stack an E axis and shard

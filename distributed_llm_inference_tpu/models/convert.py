@@ -107,6 +107,22 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
             use_qk_norm=True,
             head_dim_override=getattr(hf_cfg, "head_dim", None),
         )
+    elif mt == "qwen3_moe":
+        # Qwen3-MoE: qwen3 attention + a Mixtral-shaped expert bank with
+        # its own intermediate size and an optional top-k renormalization
+        if getattr(hf_cfg, "mlp_only_layers", None) or getattr(
+            hf_cfg, "decoder_sparse_step", 1
+        ) != 1:
+            raise ValueError(
+                "qwen3_moe checkpoints with dense layers (mlp_only_layers "
+                "/ decoder_sparse_step != 1) are not supported: the "
+                "stacked-layer scan assumes a uniform layer shape"
+            )
+        gemma_kw = dict(
+            use_qk_norm=True,
+            head_dim_override=getattr(hf_cfg, "head_dim", None),
+            moe_renormalize=bool(getattr(hf_cfg, "norm_topk_prob", False)),
+        )
     # Phi-3 instruct ends its turn with <|end|> (32007), but config.json
     # only carries the scalar eos 32000 (the extra stops live in
     # generation_config.json, which a weights-only conversion never sees) —
@@ -138,18 +154,27 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         raise ValueError(
             f"unsupported rope_scaling type {rs_type!r} (supported: llama3)"
         )
+    # expert count: Mixtral names it num_local_experts, Qwen3-MoE
+    # num_experts; experts may use their own intermediate size
+    n_experts = (
+        getattr(hf_cfg, "num_local_experts", None)
+        or (getattr(hf_cfg, "num_experts", None) if mt == "qwen3_moe" else None)
+        or 0
+    )
+    ffn_dim = hf_cfg.intermediate_size
+    if mt == "qwen3_moe":
+        ffn_dim = hf_cfg.moe_intermediate_size
     return ModelConfig(
         name=name,
         arch="llama",
-        # Mixtral-style sparse MoE (num_local_experts absent on dense cfgs)
-        n_experts=getattr(hf_cfg, "num_local_experts", None) or 0,
+        n_experts=n_experts,
         n_experts_per_tok=getattr(hf_cfg, "num_experts_per_tok", None) or 2,
         vocab_size=hf_cfg.vocab_size,
         dim=hf_cfg.hidden_size,
         n_layers=hf_cfg.num_hidden_layers,
         n_heads=hf_cfg.num_attention_heads,
         n_kv_heads=getattr(hf_cfg, "num_key_value_heads", hf_cfg.num_attention_heads),
-        ffn_dim=hf_cfg.intermediate_size,
+        ffn_dim=ffn_dim,
         max_seq_len=hf_cfg.max_position_embeddings,
         norm_eps=hf_cfg.rms_norm_eps,
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
@@ -248,14 +273,24 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
     if wf is not None:
         params["layers"]["window_flag"] = wf
     if cfg.n_experts:
-        # Mixtral MoE: per-expert SwiGLU (w1=gate, w3=up, w2=down) + router
-        def stack_experts(w_name: str) -> jnp.ndarray:
+        # Sparse-MoE expert bank + router. Two namings for the same
+        # structure: Mixtral (block_sparse_moe, w1=gate/w3=up/w2=down) and
+        # Qwen3-MoE (mlp.experts.E.gate_proj/up_proj/down_proj, mlp.gate)
+        if "model.layers.0.block_sparse_moe.gate.weight" in sd:
+            moe_pref = "model.layers.{}.block_sparse_moe"
+            names = {"gate": "w1", "up": "w3", "down": "w2"}
+        else:
+            moe_pref = "model.layers.{}.mlp"
+            names = {"gate": "gate_proj", "up": "up_proj", "down": "down_proj"}
+
+        def stack_experts(role: str) -> jnp.ndarray:
+            w_name = names[role]
             mats = [
                 np.stack(
                     [
                         p(
-                            f"model.layers.{i}.block_sparse_moe.experts."
-                            f"{e}.{w_name}.weight"
+                            f"{moe_pref.format(i)}.experts.{e}."
+                            f"{w_name}.weight"
                         ).T
                         for e in range(cfg.n_experts)
                     ],
@@ -266,10 +301,10 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
             return jnp.asarray(np.stack(mats, axis=0), dtype=dt)
 
         params["layers"].update(
-            w_router=stack("model.layers.{}.block_sparse_moe.gate.weight", True),
-            w_gate=stack_experts("w1"),
-            w_up=stack_experts("w3"),
-            w_down=stack_experts("w2"),
+            w_router=stack(moe_pref + ".gate.weight", True),
+            w_gate=stack_experts("gate"),
+            w_up=stack_experts("up"),
+            w_down=stack_experts("down"),
         )
     elif fused_gate_up:
         gu = "model.layers.{}.mlp.gate_up_proj.weight"

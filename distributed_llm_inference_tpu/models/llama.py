@@ -192,7 +192,8 @@ def moe_ffn(
     logits = (h @ lp["w_router"]).astype(jnp.float32)  # [B, T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     topw, topi = jax.lax.top_k(probs, k)
-    topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+    if cfg.moe_renormalize:  # Qwen3-MoE: only with norm_topk_prob
+        topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
     weights = jnp.sum(
         jax.nn.one_hot(topi, E, dtype=jnp.float32) * topw[..., None], axis=-2
     )  # [B, T, E]: renormalized weight per expert, 0 for unselected

@@ -118,6 +118,14 @@ register(ModelConfig(
     eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
 ))
 register(ModelConfig(
+    name="qwen3-30b-a3b", arch="llama", vocab_size=151936, dim=2048,
+    n_layers=48, n_heads=32, n_kv_heads=4, ffn_dim=768, max_seq_len=40960,
+    norm_eps=1e-6, rope_theta=1000000.0, head_dim_override=128,
+    use_qk_norm=True, n_experts=128, n_experts_per_tok=8,
+    moe_renormalize=True,
+    eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
+))
+register(ModelConfig(
     name="qwen3-8b", arch="llama", vocab_size=151936, dim=4096,
     n_layers=36, n_heads=32, n_kv_heads=8, ffn_dim=12288, max_seq_len=40960,
     norm_eps=1e-6, rope_theta=1000000.0, head_dim_override=128,

@@ -154,3 +154,62 @@ def test_qwen3_presets():
     assert not cfg.attn_qkv_bias
     tiny = get_model_config("test-qwen3-tiny")
     assert tiny.use_qk_norm and tiny.head_dim == 24
+
+
+# -- Qwen3-MoE (qwen3 attention + Mixtral-shaped expert bank) ---------------
+
+
+def _tiny_hf_qwen3_moe(norm_topk=True):
+    pytest.importorskip("transformers.models.qwen3_moe")
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=24,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=norm_topk,
+        max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=1000000.0, pad_token_id=0, eos_token_id=2,
+        bos_token_id=1, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(21)
+    model = transformers.Qwen3MoeForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.mark.parametrize("norm_topk", [True, False])
+def test_qwen3_moe_logits_match_hf(norm_topk):
+    """Qwen3-MoE parity incl. BOTH router normalizations (norm_topk_prob
+    is the only difference from the Mixtral block)."""
+    hf = _tiny_hf_qwen3_moe(norm_topk)
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    assert cfg.use_qk_norm and cfg.n_experts == 4
+    assert cfg.moe_renormalize is norm_topk
+    assert cfg.ffn_dim == 48  # experts use moe_intermediate_size
+    assert params["layers"]["w_gate"].shape == (3, 4, 64, 48)
+
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 15), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_qwen3_moe_rejects_partial_dense():
+    pytest.importorskip("transformers.models.qwen3_moe")
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2,
+        mlp_only_layers=[0],  # mixed dense/sparse stack
+    )
+    from distributed_llm_inference_tpu.models.convert import config_from_hf
+
+    with pytest.raises(ValueError, match="mlp_only_layers"):
+        config_from_hf(cfg)
