@@ -117,10 +117,13 @@ class ModelConfig:
             raise ValueError(f"attn_impl must be 'xla' or 'pallas', got {self.attn_impl!r}")
         if self.act not in ("silu", "gelu_tanh"):
             raise ValueError(f"act must be 'silu' or 'gelu_tanh', got {self.act!r}")
-        if self.chat_template not in (None, "tinyllama", "gemma", "phi3", "none"):
+        # "hf": render chat through the serving tokenizer's own jinja
+        # template (requires an HF tokenizer with one; the engine checks)
+        if self.chat_template not in (None, "tinyllama", "gemma", "phi3",
+                                      "none", "hf"):
             raise ValueError(
                 f"chat_template must be None, 'tinyllama', 'gemma', 'phi3', "
-                f"or 'none', got {self.chat_template!r}"
+                f"'none', or 'hf', got {self.chat_template!r}"
             )
         if self.attn_window_pattern not in ("all", "even"):
             raise ValueError(

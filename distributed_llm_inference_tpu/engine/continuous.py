@@ -46,7 +46,6 @@ import numpy as np
 
 from ..utils.logging import get_logger
 from . import generate as G
-from .chat import format_chat_prompt
 
 log = get_logger("continuous")
 
@@ -472,9 +471,7 @@ class ContinuousEngine:
             return
         k = req.kwargs
         text = (
-            format_chat_prompt(
-                req.prompt, arch=cfg.arch, template=cfg.chat_template
-            )
+            eng.render_chat(req.prompt)
             if k.get("chat", True) else req.prompt
         )
         ids = eng.tokenizer.encode(text)

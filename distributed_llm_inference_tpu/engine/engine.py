@@ -33,7 +33,6 @@ from ..models import api as M
 from ..utils.logging import get_logger
 from ..utils.tokenizer import load_tokenizer
 from . import generate as G
-from .chat import format_chat_prompt
 from .prefix import PrefixCache
 
 log = get_logger("engine")
@@ -562,10 +561,7 @@ class InferenceEngine:
             )
         if not 2 <= num_beams <= 16:
             raise ValueError("num_beams must be between 2 and 16")
-        text = (
-            format_chat_prompt(prompt, arch=cfg.arch, template=cfg.chat_template)
-            if chat else prompt
-        )
+        text = self.render_chat(prompt) if chat else prompt
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
         buckets = self._buckets()
@@ -639,6 +635,31 @@ class InferenceEngine:
             result["stopped"] = True
         return result
 
+    def render_chat(self, prompt_or_messages) -> str:
+        """Chat-format a user prompt string (or a full OpenAI-style
+        message list) with the model's template. ONE copy of the
+        template dispatch for the solo / batch / beam / continuous /
+        OpenAI paths. cfg.chat_template == "hf" renders through the
+        serving tokenizer's own jinja template (the one the checkpoint
+        shipped with) — requires an HF tokenizer carrying one."""
+        from .chat import format_chat_messages
+
+        messages = (
+            [{"role": "user", "content": prompt_or_messages}]
+            if isinstance(prompt_or_messages, str)
+            else prompt_or_messages
+        )
+        if self.cfg.chat_template == "hf":
+            if not getattr(self.tokenizer, "has_chat_template", False):
+                raise ValueError(
+                    "chat_template='hf' needs an HF tokenizer with a chat "
+                    "template; the serving tokenizer has none"
+                )
+            return self.tokenizer.apply_chat_template(messages)
+        return format_chat_messages(
+            messages, arch=self.cfg.arch, template=self.cfg.chat_template
+        )
+
     def _bias_array(self, logit_bias):
         """{token_id: bias} -> dense [V] f32 on validated ids, or None.
 
@@ -684,10 +705,7 @@ class InferenceEngine:
         cfg = self.cfg
         self.request_count += 1
         bias = self._bias_array(logit_bias)
-        text = (
-            format_chat_prompt(prompt, arch=cfg.arch, template=cfg.chat_template)
-            if chat else prompt
-        )
+        text = self.render_chat(prompt) if chat else prompt
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
 
@@ -1163,11 +1181,7 @@ class InferenceEngine:
                 f"batch size {B} exceeds the maximum {BATCH_BUCKETS[-1]}; "
                 f"split the request"
             )
-        texts = [
-            format_chat_prompt(p, arch=cfg.arch, template=cfg.chat_template)
-            if chat else p
-            for p in prompts
-        ]
+        texts = [self.render_chat(p) if chat else p for p in prompts]
         ids = [self.tokenizer.encode(t) for t in texts]
         plens = [len(i) for i in ids]
         bucket, max_tokens, decode_bucket = self._plan(max(plens), max_tokens)

@@ -28,8 +28,6 @@ import time
 import uuid
 from typing import Any, Optional
 
-from ..engine.chat import format_chat_messages
-
 # clients may omit max_tokens entirely; OpenAI's completions default
 DEFAULT_MAX_TOKENS = 16
 
@@ -208,8 +206,12 @@ def parse_completion(data: dict, cap: int):
     return prompts, kwargs, meta
 
 
-def parse_chat(data: dict, arch: str, template: Optional[str], cap: int):
-    """POST /v1/chat/completions body -> (raw_prompt, kwargs, meta)."""
+def parse_chat(data: dict, render, cap: int):
+    """POST /v1/chat/completions body -> (raw_prompt, kwargs, meta).
+
+    render: message-list -> prompt string (the engine's render_chat, so
+    cfg.chat_template — including "hf" jinja templates — applies here
+    identically to the native route)."""
     n = _reject_unsupported(data, chat=True)
     messages = data.get("messages")
     if not (isinstance(messages, list) and messages
@@ -217,7 +219,7 @@ def parse_chat(data: dict, arch: str, template: Optional[str], cap: int):
         raise OpenAIError("messages must be a non-empty list of objects",
                           param="messages")
     try:
-        prompt = format_chat_messages(messages, arch=arch, template=template)
+        prompt = render(messages)
     except ValueError as e:
         raise OpenAIError(str(e), param="messages") from None
     kwargs = _common_kwargs(data, cap, default_max=cap)

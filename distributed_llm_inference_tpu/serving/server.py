@@ -251,8 +251,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             try:
                 if chat:
                     prompt, kwargs, meta = oai.parse_chat(
-                        data, engine.cfg.arch, engine.cfg.chat_template,
-                        max_tokens_cap,
+                        data, engine.render_chat, max_tokens_cap,
                     )
                     prompts = [prompt]
                 else:

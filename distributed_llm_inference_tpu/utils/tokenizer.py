@@ -66,6 +66,18 @@ class HFTokenizer:
     def decode(self, ids, skip_special_tokens: bool = True) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
 
+    @property
+    def has_chat_template(self) -> bool:
+        return bool(getattr(self._tok, "chat_template", None))
+
+    def apply_chat_template(self, messages: list) -> str:
+        """Render [{role, content}, ...] through the tokenizer's own jinja
+        chat template (the one the checkpoint shipped with), ending with
+        the assistant generation header."""
+        return self._tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True
+        )
+
 
 def load_tokenizer(
     name_or_path: Optional[str] = None,
