@@ -40,11 +40,15 @@ class ModelConfig:
     # positions below the original context — so checkpoints trained with it
     # produce wrong logits at EVERY position unless it is reproduced.
     # None = plain RoPE.
-    rope_scaling: Optional[str] = None  # None | "llama3"
+    rope_scaling: Optional[str] = None  # None | "llama3" | "linear"
     rope_scaling_factor: float = 8.0
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max_len: int = 8192
+    # Gemma-3 dual RoPE: sliding-window layers use their own (local)
+    # theta with NO scaling; full-attention layers use rope_theta (+ any
+    # rope_scaling). None = one table for every layer.
+    rope_local_theta: Optional[float] = None
     # Sliding-window attention (Mistral-style): a query attends only the
     # last `attn_window` positions. None = full causal.
     attn_window: Optional[int] = None
@@ -53,6 +57,10 @@ class ModelConfig:
     # layer params carry a per-layer window_flag so pipeline stages keep
     # their own slice's pattern).
     attn_window_pattern: str = "all"
+    # Explicit per-layer pattern (Gemma-3's 5 sliding : 1 full): tuple of
+    # n_layers ints, 1 = sliding-window layer, 0 = full attention.
+    # Overrides attn_window_pattern when set.
+    attn_window_layer_types: Optional[tuple] = None
     # Gemma-family knobs (all default off => plain Llama semantics):
     # explicit head_dim (Gemma-7B: 16 heads x 256 != dim 3072)
     head_dim_override: Optional[int] = None
@@ -134,6 +142,7 @@ class ModelConfig:
             self.attn_softcap is not None
             or self.query_scale_override is not None
             or (self.attn_window is not None and self.attn_window_pattern != "all")
+            or self.attn_window_layer_types is not None
         ):
             raise ValueError(
                 "attn_impl='pallas' does not support attention softcapping, "
@@ -144,9 +153,31 @@ class ModelConfig:
             raise ValueError(
                 f"quant must be None, 'int8', or 'int4', got {self.quant!r}"
             )
-        if self.rope_scaling not in (None, "llama3"):
+        if self.rope_scaling not in (None, "llama3", "linear"):
             raise ValueError(
-                f"rope_scaling must be None or 'llama3', got {self.rope_scaling!r}"
+                f"rope_scaling must be None, 'llama3', or 'linear', got "
+                f"{self.rope_scaling!r}"
+            )
+        if self.attn_window_layer_types is not None:
+            if len(self.attn_window_layer_types) != self.n_layers:
+                raise ValueError(
+                    f"attn_window_layer_types has "
+                    f"{len(self.attn_window_layer_types)} entries for "
+                    f"{self.n_layers} layers"
+                )
+            if self.attn_window is None:
+                raise ValueError(
+                    "attn_window_layer_types needs attn_window set"
+                )
+        if self.rope_local_theta is not None and (
+            self.attn_window is None
+            or (self.attn_window_pattern == "all"
+                and self.attn_window_layer_types is None)
+        ):
+            raise ValueError(
+                "rope_local_theta needs a per-layer window pattern "
+                "(attn_window_layer_types or attn_window_pattern='even') — "
+                "with one table per layer kind there must be two kinds"
             )
         if self.arch == "gpt2" and self.n_kv_heads != self.n_heads:
             raise ValueError(

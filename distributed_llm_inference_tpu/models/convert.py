@@ -107,6 +107,47 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
             use_qk_norm=True,
             head_dim_override=getattr(hf_cfg, "head_dim", None),
         )
+    elif mt in ("gemma3_text", "gemma3"):
+        if mt == "gemma3" or not hasattr(hf_cfg, "num_hidden_layers"):
+            raise ValueError(
+                "multimodal gemma3 checkpoints are not supported; convert "
+                "the text model (model_type gemma3_text)"
+            )
+        # Gemma-3 text: gemma-2 bones (unit norms, GeGLU, embed scale,
+        # sandwich norms, query scale) MINUS softcaps, PLUS unit-offset
+        # qk-norm, an explicit 5-sliding:1-full layer pattern, and dual
+        # RoPE (local theta on sliding layers; optional linear scaling on
+        # the global table)
+        layer_types = tuple(
+            1 if t == "sliding_attention" else 0
+            for t in getattr(hf_cfg, "layer_types", ())
+        ) or None
+        rs = getattr(hf_cfg, "rope_scaling", None)
+        g3_rope = {}
+        if isinstance(rs, dict) and rs:
+            if rs.get("rope_type", rs.get("type")) != "linear":
+                raise ValueError(
+                    f"gemma3 rope_scaling {rs!r} unsupported (linear only)"
+                )
+            g3_rope = dict(
+                rope_scaling="linear",
+                rope_scaling_factor=float(rs.get("factor", 8.0)),
+            )
+        gemma_kw = dict(
+            norm_unit_offset=True,
+            act="gelu_tanh",
+            embed_scale=True,
+            post_norms=True,
+            use_qk_norm=True,
+            head_dim_override=getattr(hf_cfg, "head_dim", None),
+            query_scale_override=getattr(
+                hf_cfg, "query_pre_attn_scalar", None
+            ),
+            attn_window_layer_types=layer_types,
+            rope_local_theta=getattr(hf_cfg, "rope_local_base_freq", None),
+            chat_template="gemma",
+            **g3_rope,
+        )
     elif mt == "qwen3_moe":
         # Qwen3-MoE: qwen3 attention + a Mixtral-shaped expert bank with
         # its own intermediate size and an optional top-k renormalization
@@ -138,6 +179,8 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
     rs = getattr(hf_cfg, "rope_scaling", None) or {}
     rs_type = rs.get("rope_type", rs.get("type")) if isinstance(rs, dict) else None
     rope_kw = {}
+    if mt in ("gemma3_text", "gemma3"):
+        rs_type = None  # gemma3 parsed its (linear) scaling above
     if rs_type in (None, "default"):
         pass
     elif rs_type == "llama3":

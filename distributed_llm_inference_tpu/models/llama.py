@@ -100,21 +100,26 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         params["layers"]["bq"] = jnp.zeros((L, H * Dh), dt)
         params["layers"]["bk"] = jnp.zeros((L, KV * Dh), dt)
         params["layers"]["bv"] = jnp.zeros((L, KV * Dh), dt)
-    if cfg.use_qk_norm:  # Qwen3: per-head q/k RMSNorm weights [Dh]
-        params["layers"]["q_norm"] = jnp.ones((L, Dh), dt)
-        params["layers"]["k_norm"] = jnp.ones((L, Dh), dt)
+    if cfg.use_qk_norm:  # Qwen3/Gemma-3: per-head q/k RMSNorm weights [Dh]
+        params["layers"]["q_norm"] = norm_init((L, Dh), dt)
+        params["layers"]["k_norm"] = norm_init((L, Dh), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(ks[8], (D, V), s)
     return params
 
 
 def make_window_flags(cfg: ModelConfig) -> Optional[jnp.ndarray]:
-    """[L] per-layer sliding-window flag for alternating attention
-    (Gemma-2: even-indexed layers slide, HF `not bool(layer_idx % 2)`), or
-    None when the pattern is uniform. Single source of truth for
-    init_params AND the converter — the stacked flag travels with a
-    pipeline stage's layer slice."""
-    if cfg.attn_window is None or cfg.attn_window_pattern != "even":
+    """[L] per-layer sliding-window flag for mixed attention patterns
+    (Gemma-2: even-indexed layers slide, HF `not bool(layer_idx % 2)`;
+    Gemma-3: an explicit layer_types list — 5 sliding : 1 full), or None
+    when the pattern is uniform. Single source of truth for init_params
+    AND the converter — the stacked flag travels with a pipeline stage's
+    layer slice."""
+    if cfg.attn_window is None:
+        return None
+    if cfg.attn_window_layer_types is not None:
+        return jnp.asarray(cfg.attn_window_layer_types, jnp.float32)
+    if cfg.attn_window_pattern != "even":
         return None
     L = cfg.n_layers
     return (jnp.arange(L, dtype=jnp.int32) % 2 == 0).astype(jnp.float32)
@@ -266,11 +271,18 @@ def decoder_layer(
     k = k.reshape(B, T, KV, Dh)
     v = v.reshape(B, T, KV, Dh)
     if cfg.use_qk_norm:
-        # Qwen3: per-head RMSNorm over head_dim on q and k, BEFORE RoPE
-        # (HF Qwen3Attention: q_norm/k_norm on the reshaped heads);
-        # weights [Dh] broadcast over the head axis, invariant under tp
-        q = rms_norm(q, lp["q_norm"], cfg.norm_eps)
-        k = rms_norm(k, lp["k_norm"], cfg.norm_eps)
+        # Qwen3/Gemma-3: per-head RMSNorm over head_dim on q and k,
+        # BEFORE RoPE (HF Qwen3Attention / Gemma3Attention); weights [Dh]
+        # broadcast over the head axis, invariant under tp. Gemma-3's
+        # norm is the unit-offset (1 + w) flavor like its other norms.
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps, unit_offset=uo)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps, unit_offset=uo)
+    if isinstance(cos, tuple):
+        # Gemma-3 dual RoPE: sliding layers use the local table
+        cos_full, cos_local = cos
+        sin_full, sin_local = sin
+        cos = jnp.where(lp["window_flag"] > 0, cos_local, cos_full)
+        sin = jnp.where(lp["window_flag"] > 0, sin_local, sin_full)
     q, k = apply_rope(q, k, cos, sin)
 
     hook = attn_hook or default_attn_hook
@@ -341,6 +353,15 @@ def forward_layers(
         high_freq_factor=cfg.rope_high_freq_factor,
         original_max_len=cfg.rope_original_max_len,
     )
+    if cfg.rope_local_theta is not None:
+        # Gemma-3: sliding layers rotate with their own UNSCALED local
+        # theta; both tables built once, each layer selects by its
+        # window_flag (decoder_layer)
+        cos_l, sin_l = rope_cos_sin(
+            positions, cfg.head_dim, cfg.rope_local_theta
+        )
+        cos, sin = (cos, cos_l), (sin, sin_l)
+
     def make_mask(window):
         if pos.ndim == 1:
             return slot_causal_mask(pos, T, S, window)
@@ -348,8 +369,12 @@ def forward_layers(
             return causal_mask(pos, T, S, window)
         return ragged_causal_mask(pos, T, S, valid_start, window)
 
-    if cfg.attn_window is not None and cfg.attn_window_pattern == "even":
-        # Gemma-2 alternating attention: both masks built once; each layer
+    mixed_pattern = cfg.attn_window is not None and (
+        cfg.attn_window_pattern == "even"
+        or cfg.attn_window_layer_types is not None
+    )
+    if mixed_pattern:
+        # Gemma-2/3 mixed attention: both masks built once; each layer
         # selects by its stacked window_flag (decoder_layer)
         mask = (make_mask(None), make_mask(cfg.attn_window))
     else:

@@ -133,6 +133,23 @@ register(ModelConfig(
     eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
 ))
 
+# --- Gemma-3 (gemma-2 bones minus softcaps, plus unit-offset qk-norm,
+# 5-sliding:1-full layer pattern, dual local/global RoPE) ---
+register(ModelConfig(
+    name="gemma3-1b", arch="llama", vocab_size=262144, dim=1152,
+    n_layers=26, n_heads=4, n_kv_heads=1, ffn_dim=6912, max_seq_len=32768,
+    norm_eps=1e-6, rope_theta=1000000.0, rope_local_theta=10000.0,
+    head_dim_override=256, norm_unit_offset=True, act="gelu_tanh",
+    embed_scale=True, post_norms=True, use_qk_norm=True,
+    query_scale_override=256.0, attn_window=512,
+    attn_window_layer_types=tuple(
+        1 if (i % 6) != 5 else 0 for i in range(26)
+    ),
+    tie_embeddings=True, chat_template="gemma",
+    eos_token_id=1, stop_token_ids=(106,),  # <end_of_turn>
+    bos_token_id=2, pad_token_id=0,
+))
+
 # --- Gemma family (llama arch + unit-offset norms / GeGLU / embed scale) --
 register(ModelConfig(
     name="gemma-2b", arch="llama", vocab_size=256000, dim=2048,
@@ -211,6 +228,17 @@ register(ModelConfig(
     n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
     norm_eps=1e-6, head_dim_override=24, use_qk_norm=True,
     tie_embeddings=True, eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="test-gemma3-tiny", arch="llama", vocab_size=256, dim=64,
+    n_layers=6, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+    norm_eps=1e-6, rope_theta=1000000.0, rope_local_theta=10000.0,
+    head_dim_override=24, norm_unit_offset=True, act="gelu_tanh",
+    embed_scale=True, post_norms=True, use_qk_norm=True,
+    query_scale_override=24.0, attn_window=32,
+    attn_window_layer_types=(1, 1, 1, 1, 1, 0),
+    tie_embeddings=True, chat_template="gemma",
+    eos_token_id=1, bos_token_id=2, pad_token_id=0,
 ))
 register(ModelConfig(
     name="test-moe-tiny", arch="llama", vocab_size=256, dim=64,

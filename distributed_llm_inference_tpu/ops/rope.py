@@ -65,6 +65,10 @@ def rope_cos_sin(
             inv_freq, scaling_factor, low_freq_factor, high_freq_factor,
             original_max_len,
         )
+    elif scaling == "linear":
+        # HF "linear" rope_scaling (Gemma-3 global layers): every
+        # frequency divides by the factor at every position
+        inv_freq = inv_freq / scaling_factor
     elif scaling is not None:
         raise ValueError(f"unsupported rope scaling {scaling!r}")
     angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., head_dim/2]
