@@ -118,10 +118,30 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
         # qk-norm, an explicit 5-sliding:1-full layer pattern, and dual
         # RoPE (local theta on sliding layers; optional linear scaling on
         # the global table)
+        raw_types = tuple(getattr(hf_cfg, "layer_types", ()) or ())
+        unknown_types = set(raw_types) - {
+            "sliding_attention", "full_attention"
+        }
+        if unknown_types:
+            raise ValueError(
+                f"gemma3 layer_types has unsupported entries "
+                f"{sorted(unknown_types)} — converting would silently "
+                f"treat them as full attention"
+            )
         layer_types = tuple(
-            1 if t == "sliding_attention" else 0
-            for t in getattr(hf_cfg, "layer_types", ())
+            1 if t == "sliding_attention" else 0 for t in raw_types
         ) or None
+        if layer_types is None:
+            # released gemma-3 config.json files carry the pattern as
+            # sliding_window_pattern=p (every p-th layer full) instead of
+            # an explicit layer_types list; Gemma3TextConfig derives one
+            # in __init__ but the raw-JSON checkpoint path does not
+            p_every = getattr(hf_cfg, "sliding_window_pattern", None)
+            if p_every:
+                layer_types = tuple(
+                    1 if (i + 1) % int(p_every) else 0
+                    for i in range(hf_cfg.num_hidden_layers)
+                )
         rs = getattr(hf_cfg, "rope_scaling", None)
         g3_rope = {}
         if isinstance(rs, dict) and rs:

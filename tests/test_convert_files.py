@@ -297,3 +297,50 @@ def test_qwen3_file_roundtrip(tmp_path):
         cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
     )
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma3_file_roundtrip_with_sliding_window_pattern(tmp_path):
+    """Released gemma-3 config.json files encode the 5:1 pattern as
+    sliding_window_pattern (no layer_types list) — the raw-JSON checkpoint
+    path must derive the pattern and still match HF logits."""
+    pytest.importorskip("transformers.models.gemma3")
+    cfg_hf = transformers.Gemma3TextConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=128, rms_norm_eps=1e-6,
+        rope_theta=1000000.0, rope_local_base_freq=10000.0,
+        sliding_window=16, query_pre_attn_scalar=24,
+        pad_token_id=0, eos_token_id=1, bos_token_id=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(41)
+    hf = transformers.Gemma3ForCausalLM(cfg_hf)
+    hf.eval()
+    d = str(tmp_path / "gemma3")
+    hf.save_pretrained(d, safe_serialization=True)
+    # rewrite config.json the way the Hub releases ship it
+    import os
+
+    cfg_path = os.path.join(d, "config.json")
+    with open(cfg_path) as f:
+        raw = json.load(f)
+    raw.pop("layer_types", None)
+    raw["sliding_window_pattern"] = 6
+    raw["model_type"] = "gemma3_text"
+    with open(cfg_path, "w") as f:
+        json.dump(raw, f)
+
+    cfg, params = load_hf_checkpoint(d, dtype="float32")
+    assert cfg.attn_window_layer_types == (1, 1, 1, 1, 1, 0)
+    assert cfg.rope_local_theta == 10000.0 and cfg.use_qk_norm
+
+    rng = np.random.default_rng(42)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 29), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=1, max_seq=64)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               rtol=3e-4, atol=3e-4)
