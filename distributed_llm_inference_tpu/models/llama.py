@@ -43,6 +43,7 @@ from ..ops.attention import (
 )
 from ..ops.flash_attention import flash_attend
 from ..ops.norms import rms_norm
+from ..ops.quant import expert_einsum as eem
 from ..ops.quant import matmul as mm
 from ..ops.rope import apply_rope, rope_cos_sin
 
@@ -207,11 +208,12 @@ def moe_ffn(
     if ep_axis is not None:
         lo = jax.lax.axis_index(ep_axis) * E_loc
         weights = jax.lax.dynamic_slice_in_dim(weights, lo, E_loc, axis=-1)
+    # eem: dense array or int8 QTensor expert bank (ops/quant.expert_einsum)
     gate = jax.nn.silu(
-        jnp.einsum("btd,edf->btef", h, lp["w_gate"]).astype(jnp.float32)
+        eem("btd,edf->btef", h, lp["w_gate"]).astype(jnp.float32)
     ).astype(h.dtype)
-    up = jnp.einsum("btd,edf->btef", h, lp["w_up"])
-    down = jnp.einsum("btef,efd->bted", gate * up, lp["w_down"])
+    up = eem("btd,edf->btef", h, lp["w_up"])
+    down = eem("btef,efd->bted", gate * up, lp["w_down"])
     out = jnp.einsum("bted,bte->btd", down, weights)
     if ep_axis is not None:
         out = jax.lax.psum(out, ep_axis)

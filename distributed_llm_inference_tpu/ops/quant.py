@@ -285,6 +285,20 @@ def matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+def expert_einsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """einsum over an expert bank for a dense array or an int8 QTensor.
+
+    Works for any spec whose OUTPUT keeps the scale axes — the per-
+    (expert, out-channel) scale s [..., E, out] multiplies the result
+    elementwise, which commutes with the contraction:
+      'btd,edf->btef' (gate/up: out [b,t,e,f] * s[e,f])
+      'btef,efd->bted' (down:   out [b,t,e,d] * s[e,d])
+    """
+    if isinstance(w, QTensor):
+        return jnp.einsum(spec, x, w.q.astype(x.dtype)) * w.s.astype(x.dtype)
+    return jnp.einsum(spec, x, w)
+
+
 def quantize_params(cfg: ModelConfig, params: dict, mode: str = None,
                     group: int = 64) -> dict:
     """Quantize the llama-family matmul weights of a params pytree.
@@ -312,15 +326,17 @@ def quantize_params(cfg: ModelConfig, params: dict, mode: str = None,
     out = dict(params)
     layers = dict(params["layers"])
     for k in _LLAMA_QUANT_KEYS:
-        # MoE expert banks ([L, E, in, out], 4-D) stay dense for now —
-        # the moe_ffn einsum path has no QTensor seam; attention weights
-        # still quantize on MoE models (partial quant is valid)
-        if (
-            k in layers
-            and not isinstance(layers[k], (QTensor, Q4Tensor))
-            and layers[k].ndim == 3
-        ):
+        if k not in layers or isinstance(layers[k], (QTensor, Q4Tensor)):
+            continue
+        if layers[k].ndim == 3:
             layers[k] = qfn(layers[k])
+        elif layers[k].ndim == 4 and mode == "int8":
+            # MoE expert bank [L, E, in, out]: per-(expert, out-channel)
+            # int8 scales ride the moe_ffn einsums (ops/quant.expert_einsum
+            # — the elementwise scale commutes with the contraction).
+            # int4 experts stay dense: the grouped-contraction layout has
+            # no einsum seam yet.
+            layers[k] = quantize_tensor(layers[k])
     out["layers"] = layers
     if "lm_head" in params and not isinstance(
         params["lm_head"], (QTensor, Q4Tensor)

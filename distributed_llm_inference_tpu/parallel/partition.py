@@ -182,7 +182,10 @@ def layer_specs(cfg: ModelConfig, layers: dict) -> dict:
     for k, v in layers.items():
         base = specs[k]
         if isinstance(v, QTensor):
-            out[k] = QTensor(base, P(base[0], base[2]))
+            if len(base) == 4:  # MoE expert bank [L, E, in, out]
+                out[k] = QTensor(base, P(base[0], base[1], base[3]))
+            else:
+                out[k] = QTensor(base, P(base[0], base[2]))
         elif isinstance(v, Q4Tensor):
             out[k] = Q4Tensor(
                 P(base[0], base[1], None, base[2]),

@@ -287,3 +287,82 @@ def test_int4_pallas_kernel_matches_reference():
         want = x @ dequantize_tensor4(t)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
+
+
+# -- int8 MoE expert banks --------------------------------------------------
+
+
+def test_int8_moe_expert_banks_quantize_and_track():
+    """MoE models quantize their expert banks too (per-(expert,
+    out-channel) scales riding the moe_ffn einsums); logits stay close
+    and the structure is QTensor end to end."""
+    cfg = get_model_config("test-moe-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(cfg, params, mode="int8")
+    assert isinstance(qp["layers"]["w_gate"], QTensor)
+    assert qp["layers"]["w_gate"].q.shape == (4, 4, 64, 96)
+    assert qp["layers"]["w_gate"].s.shape == (4, 4, 96)
+    assert not isinstance(qp["layers"]["w_router"], QTensor)  # tiny; dense
+
+    tokens = jnp.asarray([[5, 9, 13, 2, 7]], jnp.int32)
+    cache = M.init_kv_cache(cfg, 1, max_seq=32)
+    full, _ = M.forward(cfg, params, tokens, cache, jnp.int32(0))
+    cache = M.init_kv_cache(cfg, 1, max_seq=32)
+    quant, _ = M.forward(cfg, qp, tokens, cache, jnp.int32(0))
+    # random-weight MoE amplifies quantization error (an expert's shifted
+    # output feeds a near-uniform random router downstream); the exact
+    # algebra is pinned by the einsum-vs-dequant check below
+    err = np.abs(np.asarray(full - quant))
+    scale = np.abs(np.asarray(full)).max()
+    assert err.max() / scale < 0.2, err.max() / scale
+    # exactness of the scaled einsum itself (no quantization error in
+    # the seam): expert_einsum(q) == einsum(dequant(q))
+    from distributed_llm_inference_tpu.ops.quant import (
+        dequantize_tensor, expert_einsum,
+    )
+
+    w = qp["layers"]["w_gate"]
+    h = jnp.asarray(np.random.default_rng(1).standard_normal((1, 3, 64)),
+                    jnp.float32)
+    got = expert_einsum("btd,edf->btef", h, QTensor(w.q[0], w.s[0]))
+    want = jnp.einsum(
+        "btd,edf->btef", h, dequantize_tensor(QTensor(w.q[0], w.s[0]))
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int8_moe_pipeline_ep_matches_single_device(eight_devices):
+    """Quantized expert banks shard over pp x ep bit-exactly (QTensor
+    scale specs follow the 4-D bank layout)."""
+    from distributed_llm_inference_tpu.parallel.mesh import build_mesh
+    from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
+
+    cfg = get_model_config("test-moe-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    qp = quantize_params(cfg, params, mode="int8")
+
+    ids = [5, 9, 13, 21, 8]
+    bucket, steps = 16, 5
+    tokens = jnp.asarray([ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32)
+    plen = jnp.int32(len(ids))
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(3))
+
+    cache_s = M.init_kv_cache(cfg, 1, max_seq=64)
+    f_s, logits_s, cache_s = G.prefill(cfg, qp, tokens, plen, cache_s, kp, sampling)
+    out_s, n_s, _ = G.decode(
+        cfg, qp, f_s, cache_s, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+
+    mesh = build_mesh(MeshConfig(pp=2, ep=2), eight_devices)
+    pb = PipelineBackend(cfg, qp, mesh)
+    cache_p = pb.init_cache(1, 64)
+    f_p, logits_p, cache_p = pb.prefill(tokens, plen, cache_p, kp, sampling)
+    out_p, n_p, _ = pb.decode(
+        f_p, cache_p, plen, jnp.int32(steps), kd, sampling, max_steps=steps
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_p), np.asarray(logits_s), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
