@@ -99,6 +99,11 @@ class SingleDeviceBackend:
 
     # OpenAI logit_bias ([V] added to raw logits each sample)
     supports_bias = True
+    # teacher-forced scoring (OpenAI echo+logprobs / lm-eval loglikelihood)
+    supports_score = True
+
+    def score(self, tokens, cache):
+        return G.score_tokens(self.cfg, self.params, tokens, cache)
     # deterministic beam search (HF generate(num_beams=N) semantics);
     # the KV cache reorders by parent beam with a batched gather
     supports_beam = True
@@ -634,6 +639,66 @@ class InferenceEngine:
         if best["stopped"]:
             result["stopped"] = True
         return result
+
+    def score(self, prompt: str) -> dict:
+        """Teacher-forced per-token log-probabilities of `prompt` itself
+        (no generation): the OpenAI echo+logprobs+max_tokens=0 pattern
+        that evaluation harnesses use for loglikelihood scoring."""
+        t_start = time.time()
+
+        def locked():
+            with self._lock:
+                return self._score_locked(prompt, t_start)
+
+        try:
+            return self._with_deadline(locked, "score")
+        except ValueError as e:
+            log.warning("invalid_request", error=str(e))
+            return {"error": f"Error: {e}", "status": "failed",
+                    "error_type": "invalid_request"}
+        except Exception as e:  # noqa: BLE001 - envelope discipline
+            log.error("score_failed", exc_info=True, error=str(e))
+            return {"error": f"Error: {e}", "status": "failed"}
+
+    def _score_locked(self, prompt: str, t_start: float) -> dict:
+        cfg = self.cfg
+        self.request_count += 1
+        if not getattr(self.backend, "supports_score", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support scoring; "
+                f"serve echo/logprobs scoring on the single-device backend"
+            )
+        ids = self.tokenizer.encode(prompt)
+        if len(ids) < 2:
+            raise ValueError("scoring needs at least 2 tokens")
+        buckets = self._buckets()
+        if not buckets or len(ids) > buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(ids)} exceeds max prefill bucket "
+                f"{buckets[-1] if buckets else 0} (scoring runs one forward)"
+            )
+        bucket = G.pick_bucket(buckets, len(ids))
+        tokens = jnp.asarray(
+            [ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32
+        )
+        cache = self._cache or self.backend.init_cache(1, cfg.max_seq_len)
+        self._cache = None  # donated scratch; restored below
+        token_lp, cache = self.backend.score(tokens, cache)
+        token_lp = jax.block_until_ready(token_lp)
+        self._cache = cache
+        lps = [round(float(x), 6) for x in np.asarray(token_lp[0][: len(ids) - 1])]
+        elapsed = time.time() - t_start
+        return {
+            "prompt": prompt,
+            "status": "success",
+            "prompt_tokens": len(ids),
+            # OpenAI convention: the first token has no conditional
+            "token_logprobs": [None] + lps,
+            "token_strings": [self.tokenizer.decode([t]) for t in ids],
+            "logprob_sum": round(sum(lps), 6),
+            "time_taken": f"{elapsed:.2f}s",
+            "backend": self.backend.name,
+        }
 
     def render_chat(self, prompt_or_messages) -> str:
         """Chat-format a user prompt string (or a full OpenAI-style

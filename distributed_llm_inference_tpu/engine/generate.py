@@ -628,6 +628,25 @@ def decode_speculative(
 NEG_INF_F32 = jnp.float32(-1e9)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def score_tokens(cfg: ModelConfig, params, tokens, cache):
+    """Teacher-forced scoring: ONE forward over the padded sequence,
+    log-probability of every token given its prefix (the lm-eval /
+    OpenAI echo+logprobs loglikelihood pattern — the reference can only
+    sample, orchestration.py:168).
+
+    tokens [B, T_bucket] right-padded. Returns (token_lp [B, T-1] — entry
+    t is log p(tokens[t+1] | tokens[:t+1]), junk beyond the real length
+    (caller slices) — and the cache, which is donated scratch here)."""
+    logits, cache = M.forward(cfg, params, tokens, cache, jnp.int32(0))
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    token_lp = jnp.take_along_axis(
+        lp[:, :-1, :], tgt[..., None], axis=-1
+    )[..., 0]
+    return token_lp, cache
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "max_steps", "num_beams", "early_stopping"),

@@ -82,7 +82,16 @@ def _reject_unsupported(data: dict, *, chat: bool):
     if not chat and as_num("best_of", 1, int) != 1:
         raise OpenAIError("best_of > 1 is not supported", param="best_of")
     if not chat and data.get("echo"):
-        raise OpenAIError("echo is not supported", param="echo")
+        # echo is supported ONLY in the scoring form (echo + logprobs +
+        # max_tokens 0 — the lm-eval loglikelihood pattern); parse_completion
+        # validates the combination
+        if data.get("logprobs") is None or (
+            int(data.get("max_tokens") or 0) != 0
+        ):
+            raise OpenAIError(
+                "echo is only supported for scoring: echo=true with "
+                "logprobs set and max_tokens=0", param="echo",
+            )
     if not chat and data.get("suffix"):
         raise OpenAIError("suffix is not supported", param="suffix")
     for p in ("frequency_penalty", "presence_penalty"):
@@ -189,8 +198,16 @@ def parse_completion(data: dict, cap: int):
             "prompt must be a non-empty string or list of non-empty strings",
             param="prompt",
         )
+    meta = {"stream": bool(data.get("stream", False)), "n": n,
+            "echo_score": bool(data.get("echo"))}
+    if meta["echo_score"]:
+        if meta["stream"] or n != 1 or len(prompts) != 1:
+            raise OpenAIError(
+                "echo scoring takes a single prompt, n=1, no streaming",
+                param="echo",
+            )
+        return prompts, {"max_tokens": 0}, meta
     kwargs = _common_kwargs(data, cap)
-    meta = {"stream": bool(data.get("stream", False)), "n": n}
     lp = data.get("logprobs")
     if lp is not None and lp is not False:
         # legacy completions logprobs is an int (top-N); only the chosen
@@ -325,6 +342,34 @@ def chat_response(entries: list, model: str, kwargs: dict,
         "model": model,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
+    }
+
+
+def echo_score_response(result: dict, model: str) -> dict:
+    """engine.score envelope -> OpenAI echoed text_completion (the
+    loglikelihood-scoring reply: text = the prompt, logprobs over every
+    prompt token, first entry None)."""
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "text": result["prompt"],
+            "finish_reason": "length",
+            "logprobs": {
+                "tokens": result["token_strings"],
+                "token_logprobs": result["token_logprobs"],
+                "top_logprobs": None,
+                "text_offset": None,
+            },
+        }],
+        "usage": {
+            "prompt_tokens": result["prompt_tokens"],
+            "completion_tokens": 0,
+            "total_tokens": result["prompt_tokens"],
+        },
     }
 
 

@@ -258,6 +258,16 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     prompts, kwargs, meta = oai.parse_completion(
                         data, max_tokens_cap
                     )
+                if meta.get("echo_score"):
+                    # echo + logprobs + max_tokens=0: teacher-forced
+                    # scoring of the prompt itself (lm-eval pattern)
+                    result = engine.score(prompts[0])
+                    if result.get("status") != "success":
+                        raise oai.error_for_envelope(result)
+                    self._send(200, oai.echo_score_response(
+                        result, engine.cfg.name
+                    ))
+                    return
                 if meta["stream"]:
                     if len(prompts) != 1:
                         raise oai.OpenAIError(

@@ -1,0 +1,122 @@
+"""Teacher-forced scoring (engine.score / OpenAI echo+logprobs+max_tokens=0
+— the lm-eval loglikelihood pattern). Parity target: HF log_softmax over
+the same forward."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.models.convert import params_from_hf_model
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+
+
+def _tiny_hf():
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        pad_token_id=0, eos_token_id=2, bos_token_id=1,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def served():
+    hf = _tiny_hf()
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    engine = InferenceEngine(
+        cfg, params=params, engine_cfg=EngineConfig(prefill_buckets=(32, 64))
+    )
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    server.start()
+    yield hf, server
+    server.shutdown()
+
+
+def test_score_matches_hf_teacher_forcing(served):
+    hf, server = served
+    eng = server.engine
+    prompt = "score this exact text"
+    r = eng.score(prompt)
+    assert r["status"] == "success", r
+    ids = eng.tokenizer.encode(prompt)
+    assert r["prompt_tokens"] == len(ids)
+    assert r["token_logprobs"][0] is None
+    assert len(r["token_logprobs"]) == len(ids)
+    assert len(r["token_strings"]) == len(ids)
+
+    with torch.no_grad():
+        logits = hf(torch.tensor([ids])).logits[0]
+    lp = torch.log_softmax(logits.float(), dim=-1)
+    want = [float(lp[t, ids[t + 1]]) for t in range(len(ids) - 1)]
+    got = r["token_logprobs"][1:]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(r["logprob_sum"], sum(want), rtol=2e-4,
+                               atol=2e-3)
+
+
+def test_openai_echo_scoring_route(served):
+    _, server = served
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/v1/completions",
+        data=json.dumps({
+            "prompt": "echo me", "echo": True, "logprobs": 0,
+            "max_tokens": 0,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        out = json.loads(r.read())
+    c = out["choices"][0]
+    assert c["text"] == "echo me"
+    assert c["logprobs"]["token_logprobs"][0] is None
+    assert all(x <= 0.0 for x in c["logprobs"]["token_logprobs"][1:])
+    assert out["usage"]["completion_tokens"] == 0
+    assert out["usage"]["prompt_tokens"] == len(
+        server.engine.tokenizer.encode("echo me")
+    )
+    # the scored ids match an engine-level score call
+    ref = server.engine.score("echo me")
+    assert c["logprobs"]["token_logprobs"][1:] == ref["token_logprobs"][1:]
+
+
+def test_openai_echo_without_scoring_form_rejected(served):
+    _, server = served
+    for body in [
+        {"prompt": "x", "echo": True, "max_tokens": 5},           # generates
+        {"prompt": "x", "echo": True, "max_tokens": 0},           # no logprobs
+        {"prompt": "x", "echo": True, "logprobs": 0, "max_tokens": 0,
+         "stream": True},
+    ]:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+
+
+def test_score_rejects_too_short():
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
+    r = eng.score("")
+    assert r["status"] == "failed"
+    assert r["error_type"] == "invalid_request"
