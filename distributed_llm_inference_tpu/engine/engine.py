@@ -102,8 +102,10 @@ class SingleDeviceBackend:
     # teacher-forced scoring (OpenAI echo+logprobs / lm-eval loglikelihood)
     supports_score = True
 
-    def score(self, tokens, cache):
-        return G.score_tokens(self.cfg, self.params, tokens, cache)
+    def score_chunk(self, tokens, pos, cache, *, top_n=0):
+        return G.score_chunk(
+            self.cfg, self.params, tokens, pos, cache, top_n=top_n
+        )
     # deterministic beam search (HF generate(num_beams=N) semantics);
     # the KV cache reorders by parent beam with a batched gather
     supports_beam = True
@@ -640,15 +642,17 @@ class InferenceEngine:
             result["stopped"] = True
         return result
 
-    def score(self, prompt: str) -> dict:
+    def score(self, prompt: str, top_n: int = 0) -> dict:
         """Teacher-forced per-token log-probabilities of `prompt` itself
         (no generation): the OpenAI echo+logprobs+max_tokens=0 pattern
-        that evaluation harnesses use for loglikelihood scoring."""
+        that evaluation harnesses use for loglikelihood scoring. top_n
+        (0..5): also return each position's top-N alternatives (lm-eval
+        reads them for its is_greedy check)."""
         t_start = time.time()
 
         def locked():
             with self._lock:
-                return self._score_locked(prompt, t_start)
+                return self._score_locked(prompt, int(top_n), t_start)
 
         try:
             return self._with_deadline(locked, "score")
@@ -660,7 +664,7 @@ class InferenceEngine:
             log.error("score_failed", exc_info=True, error=str(e))
             return {"error": f"Error: {e}", "status": "failed"}
 
-    def _score_locked(self, prompt: str, t_start: float) -> dict:
+    def _score_locked(self, prompt: str, top_n: int, t_start: float) -> dict:
         cfg = self.cfg
         self.request_count += 1
         if not getattr(self.backend, "supports_score", False):
@@ -668,27 +672,87 @@ class InferenceEngine:
                 f"backend {self.backend.name!r} does not support scoring; "
                 f"serve echo/logprobs scoring on the single-device backend"
             )
+        if not 0 <= top_n <= 5:
+            raise ValueError("top_n must be between 0 and 5")
         ids = self.tokenizer.encode(prompt)
         if len(ids) < 2:
             raise ValueError("scoring needs at least 2 tokens")
         buckets = self._buckets()
-        if not buckets or len(ids) > buckets[-1]:
+        if not buckets or len(ids) > cfg.max_seq_len:
             raise ValueError(
-                f"prompt length {len(ids)} exceeds max prefill bucket "
-                f"{buckets[-1] if buckets else 0} (scoring runs one forward)"
+                f"prompt length {len(ids)} exceeds max_seq_len "
+                f"{cfg.max_seq_len}"
             )
-        bucket = G.pick_bucket(buckets, len(ids))
-        tokens = jnp.asarray(
-            [ids + [cfg.pad_token_id] * (bucket - len(ids))], jnp.int32
-        )
+        # chunk plan, mirroring chunked prefill: full chunks of the
+        # largest bucket, then a padded final bucket; the KV cache chains
+        # the chunks and each chunk's LAST distribution scores the next
+        # chunk's first token across the boundary
+        chunk = buckets[-1]
+        n_full = max(0, (len(ids) - 1) // chunk)
+        rem = len(ids) - n_full * chunk
+        fitting = [b for b in buckets if b >= rem]
+        if not fitting or n_full * chunk + fitting[0] > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(ids)} cannot be chunk-scored within "
+                f"max_seq_len {cfg.max_seq_len}"
+            )
+        bucket = fitting[0]
+
         cache = self._cache or self.backend.init_cache(1, cfg.max_seq_len)
         self._cache = None  # donated scratch; restored below
-        token_lp, cache = self.backend.score(tokens, cache)
-        token_lp = jax.block_until_ready(token_lp)
+        pad = cfg.pad_token_id
+        lps: list = []
+        tops: list = []
+        prev_last = None  # np [V]: last distribution of the previous chunk
+
+        def _top_dict(values, ids_):
+            # distinct token ids can decode to the SAME string (byte-level
+            # tokenizers); keep the best (first, descending) logprob per
+            # string — the OpenAI dict format can't carry both
+            d: dict = {}
+            for v, i in zip(values, ids_):
+                s = self.tokenizer.decode([int(i)])
+                if s not in d:
+                    d[s] = round(float(v), 6)
+            return d
+
+        def _boundary(tok: int):
+            # score a chunk's first token from the PREVIOUS chunk's last
+            # position (host-side: one [V] row per chunk)
+            lps.append(float(prev_last[tok]))
+            if top_n:
+                idx = np.argpartition(-prev_last, top_n - 1)[:top_n]
+                idx = idx[np.argsort(-prev_last[idx])]
+                tops.append(_top_dict(prev_last[idx], idx))
+
+        for c in range(n_full + 1):
+            if c < n_full:
+                rows = ids[c * chunk : (c + 1) * chunk]
+                toks = jnp.asarray([rows], jnp.int32)
+            else:
+                rows = ids[n_full * chunk :]
+                toks = jnp.asarray(
+                    [rows + [pad] * (bucket - rem)], jnp.int32
+                )
+            within, top_v, top_i, last_lp, cache = self.backend.score_chunk(
+                toks, jnp.int32(c * chunk), cache, top_n=top_n
+            )
+            within = np.asarray(within[0])
+            top_v_np = np.asarray(top_v[0])
+            top_i_np = np.asarray(top_i[0])
+            if c > 0:
+                _boundary(rows[0])
+            valid = (len(rows) if c < n_full else rem) - 1
+            lps.extend(float(x) for x in within[:valid])
+            if top_n:
+                for t in range(valid):
+                    tops.append(_top_dict(top_v_np[t], top_i_np[t]))
+            prev_last = np.asarray(last_lp[0])
         self._cache = cache
-        lps = [round(float(x), 6) for x in np.asarray(token_lp[0][: len(ids) - 1])]
+
+        lps = [round(x, 6) for x in lps]
         elapsed = time.time() - t_start
-        return {
+        result = {
             "prompt": prompt,
             "status": "success",
             "prompt_tokens": len(ids),
@@ -699,6 +763,9 @@ class InferenceEngine:
             "time_taken": f"{elapsed:.2f}s",
             "backend": self.backend.name,
         }
+        if top_n:
+            result["top_logprobs"] = [None] + tops
+        return result
 
     def render_chat(self, prompt_or_messages) -> str:
         """Chat-format a user prompt string (or a full OpenAI-style

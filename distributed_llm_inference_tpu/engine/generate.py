@@ -628,23 +628,38 @@ def decode_speculative(
 NEG_INF_F32 = jnp.float32(-1e9)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def score_tokens(cfg: ModelConfig, params, tokens, cache):
-    """Teacher-forced scoring: ONE forward over the padded sequence,
-    log-probability of every token given its prefix (the lm-eval /
-    OpenAI echo+logprobs loglikelihood pattern — the reference can only
-    sample, orchestration.py:168).
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "top_n"), donate_argnames=("cache",)
+)
+def score_chunk(cfg: ModelConfig, params, tokens, pos, cache, *,
+                top_n: int = 0):
+    """Teacher-forced scoring of one chunk at offset `pos`: the
+    log-probability of every within-chunk token given its prefix (the
+    lm-eval / OpenAI echo+logprobs loglikelihood pattern — the reference
+    can only sample, orchestration.py:168). The engine chains chunks
+    through the KV cache, so sequences up to max_seq_len score with
+    compile-once bucket shapes, exactly like chunked prefill.
 
-    tokens [B, T_bucket] right-padded. Returns (token_lp [B, T-1] — entry
-    t is log p(tokens[t+1] | tokens[:t+1]), junk beyond the real length
-    (caller slices) — and the cache, which is donated scratch here)."""
-    logits, cache = M.forward(cfg, params, tokens, cache, jnp.int32(0))
+    tokens [B, T_chunk] (right-padded only in the FINAL chunk). Returns
+    (within_lp [B, T-1] — entry t is log p(tokens[t+1] | prefix),
+     top_v [B, T-1, top_n], top_i int32 — per-position top-N of the same
+     distributions (empty when top_n == 0),
+     last_lp [B, V] — the LAST position's full distribution, which scores
+     the next chunk's first token across the boundary,
+     cache)."""
+    logits, cache = M.forward(cfg, params, tokens, cache, pos)
     lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     tgt = tokens[:, 1:]
-    token_lp = jnp.take_along_axis(
+    within_lp = jnp.take_along_axis(
         lp[:, :-1, :], tgt[..., None], axis=-1
     )[..., 0]
-    return token_lp, cache
+    if top_n > 0:
+        top_v, top_i = jax.lax.top_k(lp[:, :-1, :], top_n)
+    else:
+        B, Tm1 = within_lp.shape
+        top_v = jnp.zeros((B, Tm1, 0), jnp.float32)
+        top_i = jnp.zeros((B, Tm1, 0), jnp.int32)
+    return within_lp, top_v, top_i, lp[:, -1, :], cache
 
 
 @functools.partial(

@@ -83,14 +83,17 @@ def _reject_unsupported(data: dict, *, chat: bool):
         raise OpenAIError("best_of > 1 is not supported", param="best_of")
     if not chat and data.get("echo"):
         # echo is supported ONLY in the scoring form (echo + logprobs +
-        # max_tokens 0 — the lm-eval loglikelihood pattern); parse_completion
-        # validates the combination
-        if data.get("logprobs") is None or (
-            int(data.get("max_tokens") or 0) != 0
-        ):
+        # an EXPLICIT max_tokens 0 — the lm-eval loglikelihood pattern).
+        # An omitted max_tokens means "generate the default and echo",
+        # which is not supported — reject rather than silently score.
+        lp = data.get("logprobs")
+        mt = as_num("max_tokens", None, int)
+        if mt is None:
+            mt = as_num("max_completion_tokens", None, int)
+        if lp is None or lp is False or mt != 0:
             raise OpenAIError(
                 "echo is only supported for scoring: echo=true with "
-                "logprobs set and max_tokens=0", param="echo",
+                "logprobs set and an explicit max_tokens=0", param="echo",
             )
     if not chat and data.get("suffix"):
         raise OpenAIError("suffix is not supported", param="suffix")
@@ -206,6 +209,10 @@ def parse_completion(data: dict, cap: int):
                 "echo scoring takes a single prompt, n=1, no streaming",
                 param="echo",
             )
+        # legacy logprobs int = top-N alternatives per position (lm-eval
+        # reads them for is_greedy); OpenAI caps N at 5
+        lp = data.get("logprobs")
+        meta["score_top_n"] = min(int(lp), 5) if lp is not True else 0
         return prompts, {"max_tokens": 0}, meta
     kwargs = _common_kwargs(data, cap)
     lp = data.get("logprobs")
@@ -361,7 +368,9 @@ def echo_score_response(result: dict, model: str) -> dict:
             "logprobs": {
                 "tokens": result["token_strings"],
                 "token_logprobs": result["token_logprobs"],
-                "top_logprobs": None,
+                # [None, {token: lp, ...}, ...] when top-N was requested
+                # (lm-eval reads these for is_greedy)
+                "top_logprobs": result.get("top_logprobs"),
                 "text_offset": None,
             },
         }],

@@ -136,6 +136,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
 
     profiler = profiler or _Profiler()
     started_at = int(time.time())
+    # scoring requests bypass the queue/continuous ladder (they are not
+    # generations), so they need their own backpressure: a small bound on
+    # concurrent scorers — overflow sheds with 429 instead of piling
+    # threads on the engine lock
+    score_slots = threading.BoundedSemaphore(4)
 
     class Handler(BaseHTTPRequestHandler):
         # quiet default request logging; serving logs are structured
@@ -261,7 +266,17 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 if meta.get("echo_score"):
                     # echo + logprobs + max_tokens=0: teacher-forced
                     # scoring of the prompt itself (lm-eval pattern)
-                    result = engine.score(prompts[0])
+                    if not score_slots.acquire(blocking=False):
+                        raise oai.OpenAIError(
+                            "too many concurrent scoring requests",
+                            status=429, err_type="overloaded_error",
+                        )
+                    try:
+                        result = engine.score(
+                            prompts[0], top_n=meta.get("score_top_n", 0)
+                        )
+                    finally:
+                        score_slots.release()
                     if result.get("status") != "success":
                         raise oai.error_for_envelope(result)
                     self._send(200, oai.echo_score_response(

@@ -114,6 +114,69 @@ def test_openai_echo_without_scoring_form_rejected(served):
         assert ei.value.code == 400
 
 
+def test_score_chunked_matches_single_forward(served):
+    """A prompt longer than the largest bucket chunk-scores through the
+    KV cache: the stitched logprobs (incl. the chunk-boundary tokens)
+    must equal HF's single-forward teacher forcing."""
+    hf, server = served
+    eng = server.engine
+    # buckets are (32, 64): >64 tokens forces 1 full chunk + padded tail
+    # (max_seq_len of the tiny config is 128)
+    prompt = "chunked scoring wants " * 4
+    r = eng.score(prompt)
+    assert r["status"] == "success", r
+    ids = eng.tokenizer.encode(prompt)
+    assert len(ids) > 64  # actually chunked
+    with torch.no_grad():
+        logits = hf(torch.tensor([ids])).logits[0]
+    lp = torch.log_softmax(logits.float(), dim=-1)
+    want = [float(lp[t, ids[t + 1]]) for t in range(len(ids) - 1)]
+    np.testing.assert_allclose(r["token_logprobs"][1:], want,
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_score_top_n_alternatives(served):
+    hf, server = served
+    eng = server.engine
+    r = eng.score("top n check", top_n=3)
+    assert r["status"] == "success", r
+    tops = r["top_logprobs"]
+    assert tops[0] is None
+    assert len(tops) == r["prompt_tokens"]
+    ids = eng.tokenizer.encode("top n check")
+    with torch.no_grad():
+        logits = hf(torch.tensor([ids])).logits[0]
+    lp = torch.log_softmax(logits.float(), dim=-1)
+    for t, alt in enumerate(tops[1:]):
+        # distinct ids may decode to the same string and collapse (byte
+        # tokenizer) — never more than N, best logprob kept per string
+        assert 1 <= len(alt) <= 3
+        # the top-1 alternative's logprob is the distribution's max
+        want_max = float(lp[t].max())
+        got_max = max(alt.values())
+        np.testing.assert_allclose(got_max, want_max, rtol=3e-4, atol=3e-4)
+        # and every listed logprob >= the scored token's logprob floor
+        assert all(v <= 0.0 for v in alt.values())
+
+
+def test_openai_echo_top_logprobs(served):
+    _, server = served
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/v1/completions",
+        data=json.dumps({
+            "prompt": "echo tops", "echo": True, "logprobs": 2,
+            "max_tokens": 0,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        out = json.loads(r.read())
+    tl = out["choices"][0]["logprobs"]["top_logprobs"]
+    assert tl[0] is None
+    assert all(isinstance(d, dict) and 1 <= len(d) <= 2 for d in tl[1:])
+
+
 def test_score_rejects_too_short():
     cfg = get_model_config("test-llama-tiny")
     eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
