@@ -84,6 +84,12 @@ class ModelConfig:
     attn_qkv_bias: bool = False
     # Qwen3: per-head RMSNorm on q and k (weight [head_dim]) before RoPE
     use_qk_norm: bool = False
+    # qk-norm granularity: "head" (weight [head_dim], Qwen3/Gemma-3) or
+    # "proj" (weight [H*Dh] / [KV*Dh] over the whole projection, OLMo-2)
+    qk_norm_dim: str = "head"
+    # OLMo-2: NO pre-sublayer norms — the residual adds norm(sublayer(x))
+    # (post_norms carries the norms; pre_norms=False skips the input ones)
+    pre_norms: bool = True
     # MoE router: renormalize the top-k probabilities to sum 1 (Mixtral
     # always does; Qwen3-MoE gates it on norm_topk_prob)
     moe_renormalize: bool = True
@@ -132,6 +138,16 @@ class ModelConfig:
             raise ValueError(
                 f"chat_template must be None, 'tinyllama', 'gemma', 'phi3', "
                 f"'none', or 'hf', got {self.chat_template!r}"
+            )
+        if self.qk_norm_dim not in ("head", "proj"):
+            raise ValueError(
+                f"qk_norm_dim must be 'head' or 'proj', got "
+                f"{self.qk_norm_dim!r}"
+            )
+        if not self.pre_norms and not self.post_norms:
+            raise ValueError(
+                "pre_norms=False needs post_norms=True (a block with no "
+                "norms at all matches no supported architecture)"
             )
         if self.attn_window_pattern not in ("all", "even"):
             raise ValueError(

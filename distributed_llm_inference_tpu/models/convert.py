@@ -168,6 +168,15 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
             chat_template="gemma",
             **g3_rope,
         )
+    elif mt == "olmo2":
+        # OLMo-2: NO pre-sublayer norms (the residual adds
+        # norm(sublayer(x))), RMSNorm over the WHOLE q/k projection
+        gemma_kw = dict(
+            pre_norms=False,
+            post_norms=True,
+            use_qk_norm=True,
+            qk_norm_dim="proj",
+        )
     elif mt == "qwen3_moe":
         # Qwen3-MoE: qwen3 attention + a Mixtral-shaped expert bank with
         # its own intermediate size and an optional top-k renormalization
@@ -300,20 +309,23 @@ def llama_params_from_state_dict(sd: Mapping[str, Any], cfg: ModelConfig) -> dic
     params = {
         "embed": jnp.asarray(p("model.embed_tokens.weight"), dtype=dt),
         "layers": {
-            "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
-            # Gemma-2 renames the MLP pre-norm: post_attention_layernorm
-            # becomes the ATTENTION post-norm and pre_feedforward_layernorm
-            # is the MLP pre-norm (HF Gemma2DecoderLayer)
-            "mlp_norm": stack(
-                "model.layers.{}.pre_feedforward_layernorm.weight"
-                if cfg.post_norms
-                else "model.layers.{}.post_attention_layernorm.weight",
-                False,
-            ),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
         },
         "final_norm": jnp.asarray(p("model.norm.weight"), dtype=dt),
     }
+    if cfg.pre_norms:
+        params["layers"]["attn_norm"] = stack(
+            "model.layers.{}.input_layernorm.weight", False
+        )
+        # Gemma-2 renames the MLP pre-norm: post_attention_layernorm
+        # becomes the ATTENTION post-norm and pre_feedforward_layernorm
+        # is the MLP pre-norm (HF Gemma2DecoderLayer)
+        params["layers"]["mlp_norm"] = stack(
+            "model.layers.{}.pre_feedforward_layernorm.weight"
+            if cfg.post_norms
+            else "model.layers.{}.post_attention_layernorm.weight",
+            False,
+        )
     if fused_qkv:
         qkv = "model.layers.{}.self_attn.qkv_proj.weight"
         params["layers"]["wq"] = stack_rows(qkv, 0, H * Dh)

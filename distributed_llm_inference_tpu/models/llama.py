@@ -68,8 +68,6 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     params = {
         "embed": normal(ks[0], (V, D), 0.02),
         "layers": {
-            "attn_norm": norm_init((L, D), dt),
-            "mlp_norm": norm_init((L, D), dt),
             "wq": normal(ks[1], (L, D, H * Dh), s),
             "wk": normal(ks[2], (L, D, KV * Dh), s),
             "wv": normal(ks[3], (L, D, KV * Dh), s),
@@ -77,7 +75,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         },
         "final_norm": norm_init((D,), dt),
     }
-    if cfg.post_norms:  # Gemma-2 sandwich norms
+    if cfg.pre_norms:
+        params["layers"]["attn_norm"] = norm_init((L, D), dt)
+        params["layers"]["mlp_norm"] = norm_init((L, D), dt)
+    if cfg.post_norms:  # Gemma-2 sandwich norms (and OLMo-2's only norms)
         params["layers"]["attn_post_norm"] = norm_init((L, D), dt)
         params["layers"]["mlp_post_norm"] = norm_init((L, D), dt)
     wf = make_window_flags(cfg)
@@ -101,9 +102,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         params["layers"]["bq"] = jnp.zeros((L, H * Dh), dt)
         params["layers"]["bk"] = jnp.zeros((L, KV * Dh), dt)
         params["layers"]["bv"] = jnp.zeros((L, KV * Dh), dt)
-    if cfg.use_qk_norm:  # Qwen3/Gemma-3: per-head q/k RMSNorm weights [Dh]
-        params["layers"]["q_norm"] = norm_init((L, Dh), dt)
-        params["layers"]["k_norm"] = norm_init((L, Dh), dt)
+    if cfg.use_qk_norm:
+        # Qwen3/Gemma-3: per-head [Dh]; OLMo-2 ("proj"): whole projection
+        if cfg.qk_norm_dim == "proj":
+            params["layers"]["q_norm"] = norm_init((L, H * Dh), dt)
+            params["layers"]["k_norm"] = norm_init((L, KV * Dh), dt)
+        else:
+            params["layers"]["q_norm"] = norm_init((L, Dh), dt)
+            params["layers"]["k_norm"] = norm_init((L, Dh), dt)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(ks[8], (D, V), s)
     return params
@@ -264,15 +270,23 @@ def decoder_layer(
         mask_full, mask_win = mask
         mask = jnp.where(lp["window_flag"] > 0, mask_win, mask_full)
 
-    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps, unit_offset=uo)
+    # OLMo-2 (pre_norms=False): the sublayer reads x raw, its OUTPUT is
+    # normed before the residual (post_norms carries those weights)
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps, unit_offset=uo) \
+        if cfg.pre_norms else x
     # mm: plain array or int8 QTensor (ops/quant.py) transparently
     q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
     if cfg.attn_qkv_bias:  # Qwen2-style (biases tp-shard with their columns)
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    if cfg.use_qk_norm and cfg.qk_norm_dim == "proj":
+        # OLMo-2: RMSNorm over the WHOLE projection before the head split
+        # (weights [H*Dh] / [KV*Dh]; tp-sharded with their columns)
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps, unit_offset=uo)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps, unit_offset=uo)
     q = q.reshape(B, T, H, Dh)
     k = k.reshape(B, T, KV, Dh)
     v = v.reshape(B, T, KV, Dh)
-    if cfg.use_qk_norm:
+    if cfg.use_qk_norm and cfg.qk_norm_dim == "head":
         # Qwen3/Gemma-3: per-head RMSNorm over head_dim on q and k,
         # BEFORE RoPE (HF Qwen3Attention / Gemma3Attention); weights [Dh]
         # broadcast over the head axis, invariant under tp. Gemma-3's
@@ -298,7 +312,8 @@ def decoder_layer(
         attn_out = rms_norm(attn_out, lp["attn_post_norm"], cfg.norm_eps, unit_offset=uo)
     x = x + attn_out
 
-    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps, unit_offset=uo)
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps, unit_offset=uo) \
+        if cfg.pre_norms else x
     if cfg.n_experts:
         mlp_out = moe_ffn(cfg, lp, h, ep_axis)  # psums over ep internally
     else:

@@ -133,6 +133,15 @@ register(ModelConfig(
     eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
 ))
 
+# --- OLMo-2 (post-norm residuals, whole-projection qk-norm) ---
+register(ModelConfig(
+    name="olmo2-7b", arch="llama", vocab_size=100352, dim=4096,
+    n_layers=32, n_heads=32, n_kv_heads=32, ffn_dim=11008,
+    max_seq_len=4096, norm_eps=1e-6, rope_theta=500000.0,
+    pre_norms=False, post_norms=True, use_qk_norm=True, qk_norm_dim="proj",
+    eos_token_id=100257, bos_token_id=100257, pad_token_id=100277,
+))
+
 # --- Gemma-3 (gemma-2 bones minus softcaps, plus unit-offset qk-norm,
 # 5-sliding:1-full layer pattern, dual local/global RoPE) ---
 register(ModelConfig(
@@ -228,6 +237,13 @@ register(ModelConfig(
     n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=128, max_seq_len=128,
     norm_eps=1e-6, head_dim_override=24, use_qk_norm=True,
     tie_embeddings=True, eos_token_id=2, bos_token_id=1,
+))
+register(ModelConfig(
+    name="test-olmo2-tiny", arch="llama", vocab_size=256, dim=64,
+    n_layers=4, n_heads=4, n_kv_heads=4, ffn_dim=128, max_seq_len=128,
+    norm_eps=1e-6, rope_theta=500000.0,
+    pre_norms=False, post_norms=True, use_qk_norm=True, qk_norm_dim="proj",
+    eos_token_id=2, bos_token_id=1,
 ))
 register(ModelConfig(
     name="test-gemma3-tiny", arch="llama", vocab_size=256, dim=64,

@@ -94,6 +94,12 @@ def validate_mesh(cfg: ModelConfig, pp: int, tp: int, ep: int = 1) -> None:
         raise ValueError(f"pp={pp} must be in [1, n_layers={cfg.n_layers}]")
     if cfg.n_heads % tp != 0:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    if tp > 1 and cfg.use_qk_norm and cfg.qk_norm_dim == "proj":
+        raise NotImplementedError(
+            "qk_norm_dim='proj' (OLMo-2) does not compose with tp>1: the "
+            "norm's mean-of-squares spans the whole projection, which a "
+            "column shard cannot compute locally"
+        )
     if cfg.n_kv_heads % tp != 0:
         raise ValueError(f"n_kv_heads={cfg.n_kv_heads} not divisible by tp={tp}")
     if cfg.ffn_dim % tp != 0:
