@@ -92,8 +92,14 @@ def _fail_line(error, platform="none", **extra):
 
 
 _PROBE_SRC = """
-import json, sys
+import json, os, sys
 import jax
+# the axon site package PINS jax_platforms at interpreter start, which
+# overrides the JAX_PLATFORMS env var — a pre-backend-init config update
+# is the only thing that wins (same workaround as tests/conftest.py);
+# without it the CPU-fallback probe still touches the wedged tunnel
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_platforms", "cpu")
 d = jax.devices()[0]
 x = jax.numpy.ones((8, 8))
 jax.block_until_ready(x @ x)
@@ -151,6 +157,10 @@ def _timed(fn):
 
 def run_benchmark():
     import jax
+
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # see _PROBE_SRC: the axon site pin overrides the env var
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 

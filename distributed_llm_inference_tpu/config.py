@@ -70,6 +70,15 @@ class ModelConfig:
     act: str = "silu"  # "silu" | "gelu_tanh"
     # scale embeddings by sqrt(dim) after lookup (GemmaModel normalizer)
     embed_scale: bool = False
+    # Granite scalar multipliers (all None = off): embeddings scale by
+    # embed_multiplier; every sublayer output scales by residual_multiplier
+    # before its residual add; attention scores use attn_scale_override as
+    # a DIRECT multiplier (not a head_dim power); logits divide by
+    # logits_divider.
+    embed_multiplier: Optional[float] = None
+    residual_multiplier: Optional[float] = None
+    attn_scale_override: Optional[float] = None
+    logits_divider: Optional[float] = None
     # Gemma-2 sandwich norms: post-attention and post-FFN RMSNorms applied
     # to each branch output before its residual add
     post_norms: bool = False
@@ -226,7 +235,10 @@ class ModelConfig:
     @property
     def query_scale(self) -> float:
         """Attention score scale (Gemma-2 overrides head_dim**-0.5 with
-        query_pre_attn_scalar**-0.5)."""
+        query_pre_attn_scalar**-0.5; Granite's attention_multiplier is a
+        direct multiplier)."""
+        if self.attn_scale_override is not None:
+            return float(self.attn_scale_override)
         base = self.query_scale_override or self.head_dim
         return float(base) ** -0.5
 

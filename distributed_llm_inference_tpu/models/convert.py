@@ -168,6 +168,14 @@ def config_from_hf(hf_cfg: Any, name: str = "converted", dtype: str = "float32")
             chat_template="gemma",
             **g3_rope,
         )
+    elif mt == "granite":
+        # IBM Granite: llama structure + four scalar multipliers
+        gemma_kw = dict(
+            embed_multiplier=float(getattr(hf_cfg, "embedding_multiplier", 1.0)),
+            residual_multiplier=float(getattr(hf_cfg, "residual_multiplier", 1.0)),
+            attn_scale_override=float(getattr(hf_cfg, "attention_multiplier", 1.0)),
+            logits_divider=float(getattr(hf_cfg, "logits_scaling", 1.0)),
+        )
     elif mt == "olmo2":
         # OLMo-2: NO pre-sublayer norms (the residual adds
         # norm(sublayer(x))), RMSNorm over the WHOLE q/k projection

@@ -310,6 +310,8 @@ def decoder_layer(
         attn_out = jax.lax.psum(attn_out, tp_axis)
     if cfg.post_norms:  # Gemma-2: norm the branch output before the residual
         attn_out = rms_norm(attn_out, lp["attn_post_norm"], cfg.norm_eps, unit_offset=uo)
+    if cfg.residual_multiplier is not None:  # Granite
+        attn_out = attn_out * jnp.asarray(cfg.residual_multiplier, attn_out.dtype)
     x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps, unit_offset=uo) \
@@ -324,6 +326,8 @@ def decoder_layer(
             mlp_out = jax.lax.psum(mlp_out, tp_axis)
     if cfg.post_norms:
         mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"], cfg.norm_eps, unit_offset=uo)
+    if cfg.residual_multiplier is not None:  # Granite
+        mlp_out = mlp_out * jnp.asarray(cfg.residual_multiplier, mlp_out.dtype)
     x = x + mlp_out
     return x, new_k, new_v
 
@@ -418,6 +422,8 @@ def embed(cfg: ModelConfig, params: Params, tokens: jnp.ndarray, pos=0) -> jnp.n
     x = params["embed"][tokens]
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.dim ** 0.5, x.dtype)
+    if cfg.embed_multiplier is not None:  # Granite
+        x = x * jnp.asarray(cfg.embed_multiplier, x.dtype)
     return x
 
 
@@ -433,6 +439,8 @@ def unembed(cfg: ModelConfig, params: Params, x: jnp.ndarray) -> jnp.ndarray:
         logits = mm(x, params["lm_head"]).astype(jnp.float32)
     if cfg.final_softcap is not None:
         logits = cfg.final_softcap * jnp.tanh(logits / cfg.final_softcap)
+    if cfg.logits_divider is not None:  # Granite logits_scaling
+        logits = logits / cfg.logits_divider
     return logits
 
 

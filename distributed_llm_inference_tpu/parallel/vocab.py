@@ -102,6 +102,8 @@ def embed_sharded(cfg: ModelConfig, shared: dict, tokens: jnp.ndarray, pos, pp: 
         x = jax.lax.psum(x, AXIS_PP)
     if cfg.embed_scale:  # gemma: sqrt(dim) in the activation dtype
         x = x * jnp.asarray(cfg.dim ** 0.5, x.dtype)
+    if cfg.embed_multiplier is not None:  # granite
+        x = x * jnp.asarray(cfg.embed_multiplier, x.dtype)
     if cfg.use_learned_pos:  # gpt2: add (replicated) position rows once
         T = tokens.shape[1]
         pos = jnp.asarray(pos, jnp.int32)
@@ -136,4 +138,6 @@ def unembed_sharded(cfg: ModelConfig, shared: dict, x: jnp.ndarray, pp: int):
     lg = lg[..., : cfg.vocab_size]
     if cfg.final_softcap is not None:  # gemma-2
         lg = cfg.final_softcap * jnp.tanh(lg / cfg.final_softcap)
+    if cfg.logits_divider is not None:  # granite
+        lg = lg / cfg.logits_divider
     return lg

@@ -144,3 +144,38 @@ def test_olmo2_engine_smoke():
     )
     r = eng.generate("hello olmo", max_tokens=5, greedy=True)
     assert r["status"] == "success", r
+
+
+# -- IBM Granite (llama structure + four scalar multipliers) ----------------
+
+
+def test_granite_logits_match_hf():
+    pytest.importorskip("transformers.models.granite")
+    cfg_hf = transformers.GraniteConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        embedding_multiplier=12.0, residual_multiplier=0.22,
+        attention_multiplier=0.0156, logits_scaling=8.0,
+        pad_token_id=0, eos_token_id=2, bos_token_id=1,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(29)
+    hf = transformers.GraniteForCausalLM(cfg_hf)
+    hf.eval()
+    cfg, params = params_from_hf_model(hf, dtype="float32")
+    assert cfg.embed_multiplier == 12.0
+    assert cfg.residual_multiplier == 0.22
+    assert cfg.attn_scale_override == 0.0156
+    assert cfg.logits_divider == 8.0
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 15), dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf(torch.from_numpy(tokens)).logits.numpy()
+    cache = llama.init_kv_cache(cfg, batch=2, max_seq=32)
+    logits, _ = llama.forward(
+        cfg, params, jnp.asarray(tokens, jnp.int32), cache, jnp.int32(0)
+    )
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               rtol=2e-4, atol=2e-4)
