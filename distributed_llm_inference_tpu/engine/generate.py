@@ -625,7 +625,10 @@ def decode_speculative(
     return out[:, :max_steps], n_gen[None], cache
 
 
-NEG_INF_F32 = jnp.float32(-1e9)
+# plain Python float, NOT jnp.float32(...): materializing a device scalar
+# at module scope would force backend init on IMPORT (hangs `--help` when
+# the TPU tunnel is wedged; observed live)
+NEG_INF_F32 = -1e9
 
 
 @functools.partial(
