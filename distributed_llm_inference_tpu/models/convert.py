@@ -642,6 +642,21 @@ def main(argv=None) -> int:
 
     cfg, params = load_hf_checkpoint(args.src, name=args.name, dtype=args.dtype)
     save_params(args.dst, cfg, params)
+    # carry the tokenizer along: the serving CLI auto-loads tokenizer files
+    # found in --checkpoint DIR (strict), so a converted store serves real
+    # text with no extra flags (the reference couples tokenizer + weights
+    # the same way, /root/reference/orchestration.py:34-39)
+    import shutil
+
+    copied = []
+    for fname in (
+        "tokenizer.json", "tokenizer_config.json", "special_tokens_map.json",
+        "vocab.json", "merges.txt", "tokenizer.model",
+    ):
+        src_f = os.path.join(args.src, fname)
+        if os.path.exists(src_f):
+            shutil.copy2(src_f, os.path.join(args.dst, fname))
+            copied.append(fname)
     import jax
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
@@ -654,6 +669,7 @@ def main(argv=None) -> int:
                 "n_params": int(n_params),
                 "dtype": cfg.dtype,
                 "out": args.dst,
+                "tokenizer_files": copied,
             }
         )
     )

@@ -237,6 +237,21 @@ def cache_spec() -> P:
     return P(AXIS_PP, AXIS_DP, AXIS_TP, None, None)
 
 
+def params_already_placed(params: dict, mesh: Mesh) -> bool:
+    """True when every leaf is a jax.Array already carrying a NamedSharding
+    on (an equal copy of) `mesh` — i.e. the checkpoint was restored with
+    models/checkpoint.load_params_sharded, which pads + places shard-by-
+    shard off mmap. shard_params then skips its pad/device_put pass, whose
+    jnp.take/jnp.pad would re-materialize full-size arrays."""
+    leaves = jax.tree.leaves(params)
+    return bool(leaves) and all(
+        isinstance(leaf, jax.Array)
+        and isinstance(leaf.sharding, NamedSharding)
+        and leaf.sharding.mesh == mesh
+        for leaf in leaves
+    )
+
+
 def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict]:
     """Place (shared, layers) on the mesh (uneven pp splits are padded;
     embed/head vocab dims are padded + sharded over pp)."""
@@ -246,6 +261,8 @@ def shard_params(cfg: ModelConfig, params: dict, mesh: Mesh) -> tuple[dict, dict
     validate_mesh(
         cfg, pp, int(mesh.shape[AXIS_TP]), int(mesh.shape.get(AXIS_EP, 1))
     )
+    if params_already_placed(params, mesh):
+        return split_params(params)
     shared, layers = split_params(params)
     layers = pad_stacked_layers(cfg, layers, pp)
     shared = pad_vocab(cfg, shared, pp)

@@ -1,0 +1,141 @@
+"""Real-weights serving, end to end through the CLI (round-2 review #1).
+
+The reference's core demo is serving actual checkpoint weights over HTTP
+(/root/reference/orchestration.py:34-47 loads TinyLlama, Worker1.py:60-65
+slices it). Here: save a tiny checkpoint store, launch the ACTUAL server
+CLI (`python -m ...serving.server --checkpoint DIR --pp 2`) in a
+subprocess on a 2-device CPU mesh, and verify /generate returns the same
+greedy tokens an in-process engine produces from the same weights.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from distributed_llm_inference_tpu import MeshConfig, create_engine
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.models import checkpoint as ckpt
+from distributed_llm_inference_tpu.models.registry import get_model_config
+
+pytestmark = pytest.mark.slow  # subprocess pays its own jit compile
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait_healthy(port, proc, deadline_s=180):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode(errors="replace")
+            raise AssertionError(f"server exited rc={proc.returncode}:\n{out}")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=5
+            ) as r:
+                if json.loads(r.read())["status"] == "healthy":
+                    return
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.5)
+    raise AssertionError("server never became healthy")
+
+
+def _spawn_server(extra_args, port, n_cpu_devices=2):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_cpu_devices}"
+    return subprocess.Popen(
+        [sys.executable, "-m", "distributed_llm_inference_tpu.serving.server",
+         "--host", "127.0.0.1", "--port", str(port), *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+
+
+def test_serve_checkpoint_cli_pp2(tmp_path):
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(21))
+    store = str(tmp_path / "store")
+    ckpt.save_params(store, cfg, params)
+
+    # expected greedy continuation from the same weights, in-process
+    expected = create_engine(cfg, params=params).generate(
+        "real weights", max_tokens=6, temperature=0.0, seed=0
+    )
+
+    port = _free_port()
+    proc = _spawn_server(["--checkpoint", store, "--pp", "2"], port)
+    try:
+        _wait_healthy(port, proc)
+        r = _post(
+            f"http://127.0.0.1:{port}/generate",
+            {"prompt": "real weights", "max_tokens": 6, "temperature": 0.0,
+             "seed": 0},
+            timeout=120,
+        )
+        assert r["status"] == "success"
+        assert r["response"] == expected["response"]
+        assert r["tokens_generated"] == expected["tokens_generated"]
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_bad_tokenizer_path_fails_loudly(tmp_path):
+    """strict tokenizer loading: a mis-pointed --tokenizer must abort
+    startup, not silently serve byte-garbled text (round-2 weak #6)."""
+    cfg = get_model_config("test-llama-tiny")
+    store = str(tmp_path / "store")
+    ckpt.save_params(store, cfg, M.init_params(cfg, jax.random.PRNGKey(0)))
+    port = _free_port()
+    proc = _spawn_server(
+        ["--checkpoint", store, "--tokenizer", str(tmp_path / "nope")], port,
+        n_cpu_devices=1,
+    )
+    try:
+        rc = proc.wait(timeout=120)
+        assert rc != 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_bad_checkpoint_dir_fails_loudly(tmp_path):
+    port = _free_port()
+    proc = _spawn_server(
+        ["--checkpoint", str(tmp_path / "empty_nothing")], port, n_cpu_devices=1
+    )
+    try:
+        rc = proc.wait(timeout=120)
+        out = proc.stdout.read().decode(errors="replace")
+        assert rc != 0
+        assert "neither a local store" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
