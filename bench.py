@@ -143,19 +143,24 @@ def run_benchmark():
     if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
         # see _PROBE_SRC: the axon site pin overrides the env var
         jax.config.update("jax_platforms", "cpu")
-    # Persistent XLA compile cache: a recovered-tunnel TPU leg (or a
-    # re-run) spends its budget measuring, not recompiling. Failure to
-    # set it (read-only fs, old jax) must never cost the run.
-    try:
-        cache_dir = os.environ.get(
-            "BENCH_COMPILE_CACHE",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache"),
-        )
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception:  # noqa: BLE001 - cache is an optimization only
-        pass
+    elif os.environ.get("BENCH_COMPILE_CACHE") != "off":
+        # Persistent XLA compile cache, TPU leg only: a recovered-tunnel
+        # run spends its budget measuring, not recompiling. NOT used for
+        # the CPU fallback — XLA:CPU AOT entries bake in host machine
+        # features and reload with SIGILL-risk warnings on a feature
+        # mismatch. Failure to set it must never cost the run.
+        try:
+            cache_dir = os.environ.get(
+                "BENCH_COMPILE_CACHE",
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), ".xla_cache"
+                ),
+            )
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        except Exception:  # noqa: BLE001 - cache is an optimization only
+            pass
     import jax.numpy as jnp
     import numpy as np
 

@@ -41,6 +41,22 @@ DECODE_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 # generate_batch pads the row count up to one of these (compile-once per
 # batch bucket, like the prompt/decode buckets)
 BATCH_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def batch_buckets_for(granularity: int) -> tuple:
+    """Batch-bucket ladder for a backend's row-count quantum.
+
+    gran 1 -> BATCH_BUCKETS; gran g > 1 -> (g, 2g, 4g, ...) up past
+    BATCH_BUCKETS[-1], so every batch size the API admits maps to a
+    bucket that warmup() compiled — the request path and warmup MUST
+    share this ladder or --warmup's 'no request pays jit latency'
+    contract breaks for granularities that divide no power of two."""
+    if granularity <= 1:
+        return BATCH_BUCKETS
+    out = [granularity]
+    while out[-1] < BATCH_BUCKETS[-1]:
+        out.append(out[-1] * 2)
+    return tuple(out)
 # prompt-lookup speculation: drafted tokens verified per forward (the KV
 # headroom _clamp_decode reserves past the last emitted token)
 SPEC_DRAFT_LEN = 4
@@ -398,6 +414,16 @@ class InferenceEngine:
         """
         t_start = time.time()
 
+        if getattr(self.backend, "batch_granularity", 1) > 1:
+            # 1F1B fleets decode dp*M rows at a time: a solo request rides
+            # the batched path (the fleet pads itself to the granularity)
+            return self._generate_solo_via_batch(
+                prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
+                seed, min_p, repetition_penalty, stop, t_start,
+                debug=debug, speculative=speculative, logprobs=logprobs,
+                logit_bias=logit_bias, num_beams=num_beams,
+            )
+
         def locked():
             with self._lock:
                 if num_beams > 1:
@@ -422,6 +448,58 @@ class InferenceEngine:
         except Exception as e:  # error envelope (orchestration.py:220-228)
             log.error("generate_failed", exc_info=True, error=str(e))
             return {"error": f"Error: {e}", "status": "failed"}
+
+    def _generate_solo_via_batch(
+        self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
+        seed, min_p, repetition_penalty, stop, t_start, *, debug,
+        speculative, logprobs, logit_bias, num_beams,
+    ):
+        """Solo request on a fleet-granular backend (pipeline-1f1b):
+        delegate to generate_batch([prompt]) — which pads the fleet up to
+        batch_granularity — and re-shape the row result into the solo
+        reference-schema envelope (orchestration.py:211-218)."""
+        unsupported = [
+            name for name, on in (
+                ("debug", debug), ("speculative", speculative),
+                ("logprobs", logprobs), ("logit_bias", logit_bias is not None),
+                ("num_beams", num_beams > 1),
+            ) if on
+        ]
+        if unsupported:
+            msg = (
+                f"{', '.join(unsupported)} not supported on backend "
+                f"{self.backend.name!r}; serve on the single-device or "
+                f"plain pipeline backend"
+            )
+            log.warning("invalid_request", error=msg)
+            return {"error": f"Error: {msg}", "status": "failed",
+                    "error_type": "invalid_request"}
+        batch = self.generate_batch(
+            [prompt], max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, greedy=greedy, chat=chat, seed=seed,
+            min_p=min_p, repetition_penalty=repetition_penalty, stop=stop,
+        )
+        if batch.get("status") != "success":
+            return batch
+        r = batch["results"][0]
+        elapsed = time.time() - t_start
+        n = r["tokens_generated"]
+        tps = n / elapsed if elapsed > 0 else 0.0
+        out = {
+            "prompt": prompt,
+            "response": r["response"],
+            "status": "success",
+            "time_taken": f"{elapsed:.2f}s",
+            "tokens_generated": n,
+            "prompt_tokens": r["prompt_tokens"],
+            "tokens_per_sec": f"{tps:.2f}",
+            "ttft_s": batch.get("ttft_s"),
+            "backend": self.backend.name,
+            "finish_reason": r.get("finish_reason"),
+        }
+        if r.get("stopped"):
+            out["stopped"] = True
+        return out
 
     def _plan_ingest(self, prompt_len: int, p0: int, buckets: tuple):
         """Plan feeding ids[p0:] into the cache at offset p0.
@@ -1099,12 +1177,16 @@ class InferenceEngine:
         """
         t0 = time.time()
         decode_buckets = tuple(decode_buckets or DECODE_BUCKETS)
+        gran = getattr(self.backend, "batch_granularity", 1)
         if batch_buckets is None:
             can_batch = (
                 self.cfg.arch == "llama"
                 and getattr(self.backend, "supports_ragged", False)
             )
-            batch_buckets = BATCH_BUCKETS if can_batch else ()
+            # the SAME ladder the request path picks from — fleet-granular
+            # backends (gran > 1, always llama: create_backend rejects the
+            # rest) warm (g, 2g, ...) instead of the power-of-two buckets
+            batch_buckets = batch_buckets_for(gran) if can_batch else ()
         sampling = G.default_sampling(greedy=True)
         key = jax.random.PRNGKey(0)
         n = 0
@@ -1119,97 +1201,101 @@ class InferenceEngine:
             )
         pad = self.cfg.pad_token_id
         with self._lock:
-            cache = self._cache or self.backend.init_cache(1, self.cfg.max_seq_len)
-            self._cache = None
-            first = None
-            for bucket in buckets:
-                tokens = jnp.full((1, bucket), pad, jnp.int32)
-                first, _, cache = self.backend.prefill(
-                    tokens, jnp.int32(1), cache, key, sampling
-                )
-                n += 1
-            if hasattr(self.backend, "extend"):
-                chunk_tokens = jnp.full((1, buckets[-1]), pad, jnp.int32)
-                cache = self.backend.extend(chunk_tokens, jnp.int32(0), cache)
-                n += 1
-            for db in decode_buckets:
-                # limit=0: compiles the while_loop program, executes 0 steps
-                _, _, cache = self.backend.decode(
-                    first, cache, jnp.int32(1), jnp.int32(0), key, sampling,
-                    max_steps=db,
-                )
-                n += 1
-            if getattr(self.backend, "supports_presence", False):
-                # repetition-penalty (presence) program variants — 'no
-                # request pays jit latency' covers penalized requests too.
-                # Single-stream only: batched penalized programs compile on
-                # first use (rarer path; the grid would double warmup).
-                pres1 = jnp.zeros((1, self.cfg.vocab_size), bool)
+            if gran == 1:
+                # single-stream programs: never used on a fleet-
+                # granular backend (solo requests ride the batched
+                # path there — _generate_solo_via_batch)
+                cache = self._cache or self.backend.init_cache(1, self.cfg.max_seq_len)
+                self._cache = None
+                first = None
                 for bucket in buckets:
                     tokens = jnp.full((1, bucket), pad, jnp.int32)
                     first, _, cache = self.backend.prefill(
-                        tokens, jnp.int32(1), cache, key, sampling,
-                        presence=pres1,
+                        tokens, jnp.int32(1), cache, key, sampling
                     )
                     n += 1
+                if hasattr(self.backend, "extend"):
+                    chunk_tokens = jnp.full((1, buckets[-1]), pad, jnp.int32)
+                    cache = self.backend.extend(chunk_tokens, jnp.int32(0), cache)
+                    n += 1
                 for db in decode_buckets:
+                    # limit=0: compiles the while_loop program, executes 0 steps
                     _, _, cache = self.backend.decode(
-                        first, cache, jnp.int32(1), jnp.int32(0), key,
-                        sampling, presence=pres1, max_steps=db,
+                        first, cache, jnp.int32(1), jnp.int32(0), key, sampling,
+                        max_steps=db,
                     )
                     n += 1
-            if getattr(self.backend, "supports_logprobs", False):
-                # the with_logprobs decode variant compiles separately
-                # (static flag adds a logprob buffer to the loop carry)
-                for db in decode_buckets:
-                    _, _, cache, _ = self.backend.decode(
-                        first, cache, jnp.int32(1), jnp.int32(0), key,
-                        sampling, max_steps=db, with_logprobs=True,
-                    )
-                    n += 1
-            if self._draft is not None and getattr(
-                self.backend, "supports_draft", False
-            ):
-                # speculative requests route to the DRAFT path when a
-                # draft is attached — warm ITS programs (ingest per
-                # bucket + the chunked-extend variant + the combined
-                # verify loop per decode bucket); the prompt-lookup
-                # program would be dead weight
-                dcfg, dparams = self._draft
-                dcache = self._draft_cache
-                self._draft_cache = None
-                if dcache is None:
-                    dcache = M.init_kv_cache(
-                        dcfg, 1, max_seq=self.cfg.max_seq_len
-                    )
-                for bucket in buckets:
-                    dcache = self._draft_ingest([pad] * bucket, dcache)
-                    n += 1
-                chunked_len = buckets[-1] + 1
-                if self._plan_ingest(chunked_len, 0, buckets) is not None:
-                    dcache = self._draft_ingest([pad] * chunked_len, dcache)
-                    n += 1
-                for db in decode_buckets:
-                    _, _, cache, dcache = self.backend.decode_draft_speculative(
-                        dcfg, dparams, first, cache, dcache, jnp.int32(1),
-                        jnp.int32(0), max_steps=db,
-                        draft_len=SPEC_DRAFT_LEN,
-                    )
-                    n += 1
-                self._draft_cache = dcache
-            elif getattr(self.backend, "supports_speculative", False):
-                # speculative programs too — 'no request pays jit latency'
-                # includes speculative=true requests
-                H = self.cfg.max_seq_len + SPEC_DRAFT_LEN + 2
-                hist = jnp.zeros((1, H), jnp.int32)
-                for db in decode_buckets:
-                    _, _, cache = self.backend.decode_speculative(
-                        first, cache, hist, jnp.int32(1), jnp.int32(0),
-                        max_steps=db, draft_len=SPEC_DRAFT_LEN,
-                    )
-                    n += 1
-            jax.block_until_ready(cache)
-            self._cache = cache  # first real request reuses the buffer
+                if getattr(self.backend, "supports_presence", False):
+                    # repetition-penalty (presence) program variants — 'no
+                    # request pays jit latency' covers penalized requests too.
+                    # Single-stream only: batched penalized programs compile on
+                    # first use (rarer path; the grid would double warmup).
+                    pres1 = jnp.zeros((1, self.cfg.vocab_size), bool)
+                    for bucket in buckets:
+                        tokens = jnp.full((1, bucket), pad, jnp.int32)
+                        first, _, cache = self.backend.prefill(
+                            tokens, jnp.int32(1), cache, key, sampling,
+                            presence=pres1,
+                        )
+                        n += 1
+                    for db in decode_buckets:
+                        _, _, cache = self.backend.decode(
+                            first, cache, jnp.int32(1), jnp.int32(0), key,
+                            sampling, presence=pres1, max_steps=db,
+                        )
+                        n += 1
+                if getattr(self.backend, "supports_logprobs", False):
+                    # the with_logprobs decode variant compiles separately
+                    # (static flag adds a logprob buffer to the loop carry)
+                    for db in decode_buckets:
+                        _, _, cache, _ = self.backend.decode(
+                            first, cache, jnp.int32(1), jnp.int32(0), key,
+                            sampling, max_steps=db, with_logprobs=True,
+                        )
+                        n += 1
+                if self._draft is not None and getattr(
+                    self.backend, "supports_draft", False
+                ):
+                    # speculative requests route to the DRAFT path when a
+                    # draft is attached — warm ITS programs (ingest per
+                    # bucket + the chunked-extend variant + the combined
+                    # verify loop per decode bucket); the prompt-lookup
+                    # program would be dead weight
+                    dcfg, dparams = self._draft
+                    dcache = self._draft_cache
+                    self._draft_cache = None
+                    if dcache is None:
+                        dcache = M.init_kv_cache(
+                            dcfg, 1, max_seq=self.cfg.max_seq_len
+                        )
+                    for bucket in buckets:
+                        dcache = self._draft_ingest([pad] * bucket, dcache)
+                        n += 1
+                    chunked_len = buckets[-1] + 1
+                    if self._plan_ingest(chunked_len, 0, buckets) is not None:
+                        dcache = self._draft_ingest([pad] * chunked_len, dcache)
+                        n += 1
+                    for db in decode_buckets:
+                        _, _, cache, dcache = self.backend.decode_draft_speculative(
+                            dcfg, dparams, first, cache, dcache, jnp.int32(1),
+                            jnp.int32(0), max_steps=db,
+                            draft_len=SPEC_DRAFT_LEN,
+                        )
+                        n += 1
+                    self._draft_cache = dcache
+                elif getattr(self.backend, "supports_speculative", False):
+                    # speculative programs too — 'no request pays jit latency'
+                    # includes speculative=true requests
+                    H = self.cfg.max_seq_len + SPEC_DRAFT_LEN + 2
+                    hist = jnp.zeros((1, H), jnp.int32)
+                    for db in decode_buckets:
+                        _, _, cache = self.backend.decode_speculative(
+                            first, cache, hist, jnp.int32(1), jnp.int32(0),
+                            max_steps=db, draft_len=SPEC_DRAFT_LEN,
+                        )
+                        n += 1
+                jax.block_until_ready(cache)
+                self._cache = cache  # first real request reuses the buffer
 
             # batched/ragged programs. Only the LARGEST warmed bucket's
             # cache is retained afterwards: keeping one per bucket would
@@ -1321,8 +1407,10 @@ class InferenceEngine:
         # pad the batch up to a bucketed size so XLA compiles one program
         # per (B-bucket, prefill-bucket, decode-bucket) triple, not per
         # client batch size; dummy rows are single-pad prompts, sliced off
-        # the results below
-        Bb = G.pick_bucket(BATCH_BUCKETS, B)
+        # the results below. Fleet-granular backends (1F1B: rows % dp*M
+        # == 0) use the granularity ladder — the same one warmup compiles.
+        gran = getattr(self.backend, "batch_granularity", 1)
+        Bb = G.pick_bucket(batch_buckets_for(gran), B)
         pad = cfg.pad_token_id
         rows = ids + [[pad]] * (Bb - B)
         row_lens = plens + [1] * (Bb - B)

@@ -651,6 +651,14 @@ def score_chunk(cfg: ModelConfig, params, tokens, pos, cache, *,
      the next chunk's first token across the boundary,
      cache)."""
     logits, cache = M.forward(cfg, params, tokens, cache, pos)
+    return score_post(logits, tokens, top_n) + (cache,)
+
+
+def score_post(logits, tokens, top_n: int):
+    """Shared scoring tail: [B, T, V] teacher-forced logits -> (within_lp,
+    top_v, top_i, last_lp). One implementation for the single-device and
+    pipeline backends (the pipeline computes the same replicated logits
+    from vocab shards — parallel/vocab.unembed_sharded)."""
     lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     tgt = tokens[:, 1:]
     within_lp = jnp.take_along_axis(
@@ -662,7 +670,7 @@ def score_chunk(cfg: ModelConfig, params, tokens, pos, cache, *,
         B, Tm1 = within_lp.shape
         top_v = jnp.zeros((B, Tm1, 0), jnp.float32)
         top_i = jnp.zeros((B, Tm1, 0), jnp.int32)
-    return within_lp, top_v, top_i, lp[:, -1, :], cache
+    return within_lp, top_v, top_i, lp[:, -1, :]
 
 
 @functools.partial(
@@ -703,6 +711,34 @@ def decode_beam(
     Returns (tokens [num_beams, max_steps] — the FINAL beams, best
     first, pad-masked after EOS (EOS excluded), n_gen [num_beams],
     scores [num_beams], cache).
+    """
+    return beam_loop(
+        cfg,
+        lambda last, cache, pos: _forward_step(cfg, params, last, cache, pos),
+        logits0, cache, start_pos, limit, length_penalty,
+        max_steps=max_steps, num_beams=num_beams, early_stopping=early_stopping,
+    )
+
+
+def beam_loop(
+    cfg: ModelConfig,
+    fwd,
+    logits0,
+    cache,
+    start_pos,
+    limit,
+    length_penalty,
+    *,
+    max_steps: int,
+    num_beams: int,
+    early_stopping: bool = False,
+):
+    """Backend-agnostic beam-search loop (the whole algorithm behind
+    `decode_beam`). `fwd(last [nb, 1], cache, pos) -> (logits [nb, V],
+    cache)` abstracts the forward step: single-device `_forward_step`, or
+    the pipeline ring microstep inside a shard_map body
+    (parallel/pipeline.PipelineBackend._build_beam) — ONE implementation,
+    so pp meshes are bit-consistent with the single chip by construction.
     """
     nb = num_beams
     V = logits0.shape[-1]
@@ -752,7 +788,7 @@ def decode_beam(
         (step, alive_out, alive_scores, alive_len, cache, fin_scores,
          fin_out, fin_len, pos) = c
         last = jnp.take_along_axis(alive_out, (alive_len - 1)[:, None], axis=1)
-        logits, cache = _forward_step(cfg, params, last, cache, pos)
+        logits, cache = fwd(last, cache, pos)
         lp = jax.nn.log_softmax(logits.astype(jnp.float32))  # [nb, V]
         cand = alive_scores[:, None] + lp  # [nb, V]
 

@@ -106,21 +106,28 @@ class SPMDBackendBase:
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, presence=None, *, max_steps):
+               valid_start=None, presence=None, bias=None, *, max_steps,
+               with_logprobs=False):
         """One dispatch for every subclass: programs are keyed by
-        (max_steps, ragged, presence); builders that don't support a
-        variant raise NotImplementedError at build time (loud, not
-        silently wrong)."""
+        (max_steps, ragged, presence, bias, logprobs); builders that don't
+        support a variant raise NotImplementedError at build time (loud,
+        not silently wrong)."""
         ragged = valid_start is not None
         pres = presence is not None
-        fn = self._decode_cache.get((max_steps, ragged, pres))
+        wb = bias is not None
+        variant = (max_steps, ragged, pres, wb, with_logprobs)
+        fn = self._decode_cache.get(variant)
         if fn is None:
-            fn = (
-                self._build_decode_ragged(max_steps, with_presence=pres)
-                if ragged
-                else self._build_decode(max_steps, with_presence=pres)
-            )
-            self._decode_cache[(max_steps, ragged, pres)] = fn
+            if wb or with_logprobs:
+                fn = self._build_decode_full(
+                    max_steps, ragged=ragged, with_presence=pres,
+                    with_bias=wb, with_logprobs=with_logprobs,
+                )
+            elif ragged:
+                fn = self._build_decode_ragged(max_steps, with_presence=pres)
+            else:
+                fn = self._build_decode(max_steps, with_presence=pres)
+            self._decode_cache[variant] = fn
         # clamp: limit > max_steps would walk dynamic_update_slice off the
         # end of `out` (the start index clamps, corrupting the last column)
         # and inflate n_gen past the buffer
@@ -133,32 +140,49 @@ class SPMDBackendBase:
             args.append(valid_start)
         if pres:
             args.append(presence)
+        if wb:
+            args.append(bias)
         return fn(*args)
 
     def health(self) -> list[dict]:
         """Per-stage liveness — the reference's /workers sweep polls each
         worker's /health with a 5 s timeout and reports online/offline/
         error (orchestration.py:306-329); here a stage is a mesh slice, so
-        each stage's first device gets a tiny timed device op
-        (utils/probe.py) instead of an HTTP GET."""
+        EVERY device in the stage's (dp, sp, tp) slice gets a tiny timed
+        device op (utils/probe.py) instead of an HTTP GET — a dead
+        non-first device must not report healthy (round-2 review weak #8).
+        All probes run CONCURRENTLY so a fully wedged mesh still answers
+        in ~one probe timeout, not devices x timeout."""
+        from concurrent.futures import ThreadPoolExecutor
+
         from ..config import stage_layer_range
         from ..utils.probe import probe_device
 
         devs = self.mesh.devices  # [dp, pp, sp, tp]
+        stage_devs = [devs[:, s].reshape(-1) for s in range(self.pp)]
+        flat = [d for sd in stage_devs for d in sd]
+        with ThreadPoolExecutor(max_workers=max(1, len(flat))) as ex:
+            flat_probes = list(ex.map(probe_device, flat))
         out = []
+        i = 0
+        rank = {"online": 0, "busy": 1, "error": 2, "offline": 3}
         for s in range(self.pp):
-            stage_devs = devs[:, s].reshape(-1)
-            probe = probe_device(stage_devs[0])
-            out.append(
-                {
-                    "stage": s,
-                    "devices": [str(d) for d in stage_devs],
-                    "layers": list(
-                        range(*stage_layer_range(self.cfg.n_layers, self.pp, s))
-                    ),
-                    **probe,
-                }
-            )
+            probes = flat_probes[i : i + len(stage_devs[s])]
+            i += len(stage_devs[s])
+            worst = max(probes, key=lambda p: rank.get(p.get("status"), 2))
+            stage_line = {
+                "stage": s,
+                "devices": [str(d) for d in stage_devs[s]],
+                "layers": list(
+                    range(*stage_layer_range(self.cfg.n_layers, self.pp, s))
+                ),
+                **worst,
+            }
+            if len(probes) > 1:
+                stage_line["device_status"] = [
+                    p.get("status") for p in probes
+                ]
+            out.append(stage_line)
         return out
 
     def _dp_key(self, key):
@@ -177,6 +201,14 @@ class SPMDBackendBase:
     def _build_decode_ragged(self, max_steps: int, with_presence: bool = False):
         raise NotImplementedError(
             f"{self.name} does not support ragged (valid_start) batches"
+        )
+
+    def _build_decode_full(self, max_steps: int, *, ragged: bool,
+                           with_presence: bool, with_bias: bool,
+                           with_logprobs: bool):
+        raise NotImplementedError(
+            f"{self.name} does not support logit_bias / per-token-logprobs "
+            f"decode variants"
         )
 
 
@@ -233,51 +265,64 @@ class PipelineBackend(SPMDBackendBase):
         return fn(self.shared, self.layers, tokens, pos, cache)
 
     def prefill_at(self, tokens, pos, valid_len, cache, key, sampling,
-                   presence=None):
+                   presence=None, bias=None):
         """Final chunked-prefill chunk at traced offset `pos`; samples the
         first token off position pos + valid_len - 1."""
         return self._prefill_any(
-            tokens, pos, valid_len, cache, key, sampling, None, presence
+            tokens, pos, valid_len, cache, key, sampling, None, presence, bias
         )
 
     def prefill(self, tokens, prompt_len, cache, key, sampling,
-                valid_start=None, presence=None):
+                valid_start=None, presence=None, bias=None):
         return self._prefill_any(
             tokens, jnp.int32(0), prompt_len, cache, key, sampling,
-            valid_start, presence,
+            valid_start, presence, bias,
         )
 
     def _prefill_any(self, tokens, pos, valid_len, cache, key, sampling,
-                     valid_start, presence=None):
+                     valid_start, presence=None, bias=None):
         ragged = valid_start is not None
         pres = presence is not None
-        fn = self._programs.get(("prefill", ragged, pres))
+        wb = bias is not None
+        fn = self._programs.get(("prefill", ragged, pres, wb))
         if fn is None:
-            fn = self._build_prefill_pos(ragged, pres)
-            self._programs[("prefill", ragged, pres)] = fn
+            fn = self._build_prefill_pos(ragged, pres, wb)
+            self._programs[("prefill", ragged, pres, wb)] = fn
         args = [self.shared, self.layers, tokens, pos, valid_len, cache, key, sampling]
         if ragged:
             args.append(valid_start)
         if pres:
             args.append(presence)
+        if wb:
+            args.append(bias)
         return fn(*args)
 
     def _build_prefill(self):
         # base-class hook: the pos=0 non-ragged program, via the shared
         # builder (prefill()/prefill_at() both route through _prefill_any)
         fn = self._build_prefill_pos(False, False)
-        self._programs[("prefill", False, False)] = fn
+        self._programs[("prefill", False, False, False)] = fn
         return lambda shared, layers, tokens, prompt_len, cache, key, sampling: fn(
             shared, layers, tokens, jnp.int32(0), prompt_len, cache, key, sampling
         )
 
-    def _build_prefill_pos(self, ragged: bool, with_presence: bool = False):
+    def _build_prefill_pos(self, ragged: bool, with_presence: bool = False,
+                           with_bias: bool = False):
         cfg, S = self.cfg, self.pp
 
         def body(shared, layers, tokens, pos, valid_len, cache, key, sampling,
                  *extra):
-            valid_start = extra[0] if ragged else None
-            presence = extra[-1] if with_presence else None
+            i = 0
+            valid_start = presence = bias = None
+            if ragged:
+                valid_start = extra[i]
+                i += 1
+            if with_presence:
+                presence = extra[i]
+                i += 1
+            if with_bias:
+                bias = extra[i]
+                i += 1
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             x = embed_sharded(cfg, shared, tokens, pos, S)
@@ -290,7 +335,9 @@ class PipelineBackend(SPMDBackendBase):
                 jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
             )
             logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
-            first = sample_token(key, logits, *sampling, presence=presence)
+            first = sample_token(
+                key, logits, *sampling, presence=presence, bias=bias
+            )
             return first, logits, cache
 
         specs = [
@@ -301,6 +348,8 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(P(AXIS_DP))
         if with_presence:
             specs.append(P(AXIS_DP))
+        if with_bias:
+            specs.append(P())  # [V] bias replicates: logits are replicated
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
@@ -399,14 +448,37 @@ class PipelineBackend(SPMDBackendBase):
             max_steps, ragged=True, with_presence=with_presence
         )
 
+    def _build_decode_full(self, max_steps: int, *, ragged: bool,
+                           with_presence: bool, with_bias: bool,
+                           with_logprobs: bool):
+        # OpenAI logit_bias and per-token logprobs on the pp mesh (round-2
+        # review #3: the full request surface on every topology) — the
+        # logits are replicated after the vocab-shard all_gather, so both
+        # reduce to the same local ops the single-device path runs
+        return self._build_decode_any(
+            max_steps, ragged=ragged, with_presence=with_presence,
+            with_bias=with_bias, with_logprobs=with_logprobs,
+        )
+
     def _build_decode_any(self, max_steps: int, *, ragged: bool,
-                          with_presence: bool = False):
+                          with_presence: bool = False,
+                          with_bias: bool = False,
+                          with_logprobs: bool = False):
         cfg, S = self.cfg, self.pp
 
         def body(shared, layers, first_token, cache, start_pos, limit, key,
                  sampling, *extra):
-            valid_start = extra[0] if ragged else None
-            presence0 = extra[-1] if with_presence else None
+            i = 0
+            valid_start = presence0 = bias = None
+            if ragged:
+                valid_start = extra[i]
+                i += 1
+            if with_presence:
+                presence0 = extra[i]
+                i += 1
+            if with_bias:
+                bias = extra[i]
+                i += 1
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             B = first_token.shape[0]
@@ -416,13 +488,14 @@ class PipelineBackend(SPMDBackendBase):
             pres0 = (
                 presence0 if with_presence else jnp.zeros((B, 1), jnp.bool_)
             )
+            lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
 
             def cond(c):
-                step, _, _, _, _, finished, _, _, _ = c
+                step, _, _, _, _, finished, _, _, _, _ = c
                 return (step < limit) & ~jnp.all(finished)
 
             def step_fn(c):
-                step, token, pos, cache, key, finished, out, n_gen, pres = c
+                step, token, pos, cache, key, finished, out, n_gen, pres, lps = c
                 x = embed_sharded(cfg, shared, token[:, None], pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos, valid_start)
                 # broadcast stage 0's real [B, 1, D] output (a masked psum
@@ -439,6 +512,7 @@ class PipelineBackend(SPMDBackendBase):
                 nxt = sample_token(
                     sub, logits, *sampling,
                     presence=pres if with_presence else None,
+                    bias=bias,
                 )
                 if with_presence:
                     pres = presence_update(pres, nxt)
@@ -448,9 +522,21 @@ class PipelineBackend(SPMDBackendBase):
                 out = jax.lax.dynamic_update_slice(
                     out, emit[:, None], (jnp.int32(0), step)
                 )
+                if with_logprobs:
+                    # raw-distribution logprob of the emitted token (the
+                    # OpenAI convention — before temperature/filters/bias),
+                    # same as engine/generate.decode's variant
+                    logp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32), axis=-1
+                    )
+                    tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)
+                    lps = jax.lax.dynamic_update_slice(
+                        lps, tok_lp, (jnp.int32(0), step)
+                    )
                 n_gen = n_gen + (~newly).astype(jnp.int32)
                 token = jnp.where(newly, pad, nxt)
-                return step + 1, token, pos + 1, cache, key, newly, out, n_gen, pres
+                return (step + 1, token, pos + 1, cache, key, newly, out,
+                        n_gen, pres, lps)
 
             init = (
                 jnp.int32(0),
@@ -462,10 +548,13 @@ class PipelineBackend(SPMDBackendBase):
                 out0,
                 jnp.zeros((B,), jnp.int32),
                 pres0,
+                lp0,
             )
-            _, _, _, cache, _, _, out, n_gen, _ = jax.lax.while_loop(
+            _, _, _, cache, _, _, out, n_gen, _, lps = jax.lax.while_loop(
                 cond, step_fn, init
             )
+            if with_logprobs:
+                return out, n_gen, cache, lps
             return out, n_gen, cache
 
         specs = [
@@ -476,9 +565,119 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(P(AXIS_DP))
         if with_presence:
             specs.append(P(AXIS_DP))
+        if with_bias:
+            specs.append(P())
+        out_specs = [P(AXIS_DP), P(AXIS_DP), cache_spec()]
+        if with_logprobs:
+            out_specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
-            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
+            out_specs=tuple(out_specs),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
+    # -- teacher-forced scoring / beam search over the pp ring --------------
+    # (round-2 review #3: BASELINE configs 3-5 must serve the same request
+    # surface as the single chip — score, logprobs, logit_bias, beams)
+    supports_bias = True
+    supports_logprobs = True
+    supports_score = True
+    supports_beam = True
+
+    def score_chunk(self, tokens, pos, cache, *, top_n=0):
+        fn = self._programs.get(("score", top_n))
+        if fn is None:
+            fn = self._build_score(top_n)
+            self._programs[("score", top_n)] = fn
+        return fn(self.shared, self.layers, tokens, pos, cache)
+
+    def _build_score(self, top_n: int):
+        """Chunked teacher-forced scoring (engine/generate.score_chunk) on
+        the ring: run the chunk through the S microsteps, broadcast the
+        final-stage [B, T, D] activations from stage 0, compute replicated
+        logits from the vocab shards, then the SAME score_post tail as the
+        single-device path — bit-consistent by construction."""
+        cfg, S = self.cfg, self.pp
+        from ..engine.generate import score_post
+
+        def body(shared, layers, tokens, pos, cache):
+            s = jax.lax.axis_index(AXIS_PP)
+            x = embed_sharded(cfg, shared, tokens, pos, S)
+            buf, cache = self._microstep_loop(layers, x, cache, pos)
+            full = jax.lax.psum(
+                jnp.where(s == 0, buf, jnp.zeros((), buf.dtype)), AXIS_PP
+            )
+            logits = unembed_sharded(cfg, shared, full, S)
+            return score_post(logits, tokens, top_n) + (cache,)
+
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
+                cache_spec(),
+            ),
+            out_specs=(
+                P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), cache_spec()
+            ),
+        )
+        return jax.jit(shmapped, donate_argnums=(4,))
+
+    def decode_beam(self, logits0, cache, start_pos, limit, length_penalty,
+                    *, max_steps, num_beams, early_stopping):
+        if self.dp > 1:
+            # beams are one hypothesis set, not data shards: the in-program
+            # top-k / cache reorder spans all rows, which a dp slice of the
+            # batch axis cannot see (serving engines are dp=1 anyway)
+            raise NotImplementedError("beam search needs dp == 1")
+        key_ = ("beam", max_steps, num_beams, early_stopping)
+        fn = self._programs.get(key_)
+        if fn is None:
+            fn = self._build_beam(max_steps, num_beams, early_stopping)
+            self._programs[key_] = fn
+        return fn(
+            self.shared, self.layers, logits0, cache, start_pos,
+            jnp.int32(limit), jnp.float32(length_penalty),
+        )
+
+    def _build_beam(self, max_steps: int, num_beams: int,
+                    early_stopping: bool):
+        """HF-parity beam search on the pp ring: the entire algorithm is
+        engine/generate.beam_loop — only the forward step differs (ring
+        microsteps + masked psum + vocab-shard unembed). The beam
+        bookkeeping runs replicated on every device (identical logits in,
+        identical argsorts out), and each device reorders its own local KV
+        shard by parent beam; dp must be 1 (the engine's serving meshes
+        always are)."""
+        cfg, S = self.cfg, self.pp
+        from ..engine.generate import beam_loop
+
+        def body(shared, layers, logits0, cache, start_pos, limit,
+                 length_penalty):
+            s = jax.lax.axis_index(AXIS_PP)
+
+            def fwd(last, cache, pos):
+                x = embed_sharded(cfg, shared, last, pos, S)
+                buf, cache = self._microstep_loop(layers, x, cache, pos)
+                lastb = jax.lax.psum(
+                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
+                    AXIS_PP,
+                )
+                logits = unembed_sharded(cfg, shared, lastb, S)[:, 0, :]
+                return logits, cache
+
+            return beam_loop(
+                cfg, fwd, logits0, cache, start_pos, limit, length_penalty,
+                max_steps=max_steps, num_beams=num_beams,
+                early_stopping=early_stopping,
+            )
+
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(), cache_spec(),
+                P(), P(), P(),
+            ),
+            out_specs=(P(), P(), P(), cache_spec()),
         )
         return jax.jit(shmapped, donate_argnums=(3,))

@@ -71,6 +71,17 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
     """
 
     name = "pipeline-1f1b"
+    # Ragged left-padded fleets (valid_start) thread through the llama
+    # masks exactly like the plain pipeline — required for the engine's
+    # generate_batch / queue-coalesced serving path (round-2 review #4).
+    supports_ragged = True
+
+    @property
+    def batch_granularity(self) -> int:
+        """Smallest row-count quantum this backend can decode: the engine
+        pads fleets up to a multiple (and routes solo requests through the
+        batched path)."""
+        return self.dp * self.n_microbatches
 
     def __init__(
         self,
@@ -112,12 +123,15 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         ]
 
     # -- schedule pieces ----------------------------------------------------
-    def _stage_apply(self, layers, x, cache, pos_m, m_here, b_m, gate):
+    def _stage_apply(self, layers, x, cache, pos_m, m_here, b_m, gate,
+                     valid_start_m=None):
         """Run the local layer slice on microbatch `m_here`'s rows.
 
         The cache batch dim holds all M microbatches; slice out this
         microbatch's rows, scan the layers over them, write the slice back.
         XLA keeps the slice/update in place on the donated buffer.
+        valid_start_m [b_m]: this microbatch's left-pad boundaries (ragged
+        fleets), threaded into the attention mask like the plain pipeline.
         """
         row0 = m_here * b_m
         ck = jax.lax.dynamic_slice_in_dim(cache["k"], row0, b_m, axis=1)
@@ -125,6 +139,7 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         y, new = M.forward_layers(
             self.cfg, layers, x, {"k": ck, "v": cv}, pos_m,
             update_gate=gate, tp_axis=self.tp_axis, ep_axis=self.ep_axis,
+            valid_start=valid_start_m,
         )
         cache = {
             "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], new["k"], row0, axis=1),
@@ -150,17 +165,44 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         return tok, logits
 
     # -- prefill ------------------------------------------------------------
+    def prefill(self, tokens, prompt_len, cache, key, sampling,
+                valid_start=None, presence=None):
+        if presence is not None:
+            raise NotImplementedError(
+                f"{self.name} does not support repetition-penalty presence "
+                f"(serve penalized requests on the plain pipeline backend)"
+            )
+        if valid_start is None:
+            return self._prefill(
+                self.shared, self.layers, tokens, prompt_len, cache, key,
+                sampling,
+            )
+        fn = self._programs.get("prefill_ragged")
+        if fn is None:
+            fn = self._build_prefill_impl(ragged=True)
+            self._programs["prefill_ragged"] = fn
+        return fn(
+            self.shared, self.layers, tokens, prompt_len, cache, key,
+            sampling, valid_start,
+        )
+
     def _build_prefill(self):
+        return self._build_prefill_impl(ragged=False)
+
+    def _build_prefill_impl(self, *, ragged: bool):
         cfg, S, Mb = self.cfg, self.pp, self.n_microbatches
         perm = _ring_perm(S)
         with_logits = self.return_prefill_logits
 
-        def body(shared, layers, tokens, prompt_len, cache, key, sampling):
+        def body(shared, layers, tokens, prompt_len, cache, key, sampling,
+                 *extra):
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             rows, bucket = tokens.shape
             b_m = rows // Mb
             toks = tokens.reshape(Mb, b_m, bucket)
+            # ragged fleets: per-microbatch left-pad boundaries [Mb, b_m]
+            vs = extra[0].reshape(Mb, b_m) if ragged else None
             D = shared["embed"].shape[-1]
             dt = cfg.jnp_dtype
 
@@ -174,7 +216,8 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 m_here = jnp.mod(t - s, Mb)
                 gate = (t >= s) & (t - s < Mb)
                 y, cache = self._stage_apply(
-                    layers, x, cache, jnp.int32(0), m_here, b_m, gate
+                    layers, x, cache, jnp.int32(0), m_here, b_m, gate,
+                    valid_start_m=None if vs is None else vs[m_here],
                 )
                 buf = jax.lax.ppermute(y, AXIS_PP, perm)
                 # sample: microbatch (t-S+1) finished all stages and just
@@ -206,18 +249,32 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
             _, cache, first, logits = jax.lax.fori_loop(0, Mb + S - 1, micro, init)
             return first.reshape(rows), logits.reshape(rows, V_out), cache
 
+        specs = [
+            self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
+            cache_spec(), P(), P(),
+        ]
+        if ragged:
+            specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
-                cache_spec(), P(), P(),
-            ),
+            in_specs=tuple(specs),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
     # -- decode -------------------------------------------------------------
     def _build_decode(self, max_steps: int, with_presence: bool = False):
+        return self._build_decode_impl(
+            max_steps, with_presence=with_presence, ragged=False
+        )
+
+    def _build_decode_ragged(self, max_steps: int, with_presence: bool = False):
+        return self._build_decode_impl(
+            max_steps, with_presence=with_presence, ragged=True
+        )
+
+    def _build_decode_impl(self, max_steps: int, *, with_presence: bool,
+                           ragged: bool):
         if with_presence:
             raise NotImplementedError(
                 f"{self.name} does not support repetition-penalty presence "
@@ -227,11 +284,13 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
         perm = _ring_perm(S)
         pad = jnp.int32(cfg.pad_token_id)
 
-        def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
+        def body(shared, layers, first_token, cache, start_pos, limit, key,
+                 sampling, *extra):
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             rows = first_token.shape[0]
             b_m = rows // Mb
+            vs = extra[0].reshape(Mb, b_m) if ragged else None
             D = shared["embed"].shape[-1]
             dt = cfg.jnp_dtype
 
@@ -257,7 +316,8 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
                 m_here = jnp.mod(t - s, Mb)
                 gate = (t >= s) & ~done[m_here]
                 y, cache = self._stage_apply(
-                    layers, x, cache, pos[m_here], m_here, b_m, gate
+                    layers, x, cache, pos[m_here], m_here, b_m, gate,
+                    valid_start_m=None if vs is None else vs[m_here],
                 )
                 buf = jax.lax.ppermute(y, AXIS_PP, perm)
                 # sample event: microbatch (t-S+1) completed a ring pass
@@ -312,12 +372,15 @@ class MicrobatchPipelineBackend(SPMDBackendBase):
             _, _, cache, _, _, _, _, _, out, n_gen = c
             return out.reshape(rows, max_steps), n_gen.reshape(rows), cache
 
+        specs = [
+            self._shared_specs, self._layer_specs, P(AXIS_DP), cache_spec(),
+            P(), P(), P(), P(),
+        ]
+        if ragged:
+            specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, P(AXIS_DP), cache_spec(),
-                P(), P(), P(), P(),
-            ),
+            in_specs=tuple(specs),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
         )
         return jax.jit(shmapped, donate_argnums=(3,))

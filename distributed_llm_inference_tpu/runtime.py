@@ -90,6 +90,14 @@ def create_backend(
                 "stage there is no bubble to fill and the round-robin "
                 "schedule would only serialize the batch"
             )
+        if cfg.arch != "llama":
+            # the serving path for microbatched fleets is the ragged
+            # (left-padded) batch path, which needs shift-invariant
+            # positions — reject at build time, not at warmup/request time
+            raise NotImplementedError(
+                f"microbatches > 1 serves ragged llama-family fleets only; "
+                f"got arch={cfg.arch!r}"
+            )
         mesh = build_mesh(mesh_cfg)
         return cfg, MicrobatchPipelineBackend(
             cfg, params, mesh, n_microbatches=microbatches
@@ -110,6 +118,7 @@ def create_engine(
     *,
     mesh_cfg: MeshConfig = MeshConfig(),
     engine_cfg: EngineConfig = EngineConfig(),
+    microbatches: int = 1,
     params: Any = None,
     dtype: Optional[str] = None,
     quant: Optional[str] = None,
@@ -127,6 +136,10 @@ def create_engine(
     draft_model attaches a smaller same-tokenizer model for two-model
     speculative decoding ("speculative": true greedy requests verify the
     draft's proposals instead of prompt-lookup n-grams).
+    microbatches=M > 1 serves the zero-bubble 1F1B schedule (BASELINE
+    config 5) through the engine: fleets decode M microbatches chasing
+    each other around the pp ring, batched requests pad to a multiple of
+    M, and solo requests ride the batched path.
     """
     if mesh_cfg.dp > 1:
         # the serving engine decodes batch=1, which cannot shard over dp
@@ -138,8 +151,9 @@ def create_engine(
             "use create_backend() for dp-sharded / microbatched batched decode"
         )
     cfg, backend = create_backend(
-        model, mesh_cfg=mesh_cfg, params=params, dtype=dtype, quant=quant,
-        seed=seed, sp_strategy=sp_strategy, lora=lora,
+        model, mesh_cfg=mesh_cfg, microbatches=microbatches, params=params,
+        dtype=dtype, quant=quant, seed=seed, sp_strategy=sp_strategy,
+        lora=lora,
     )
     engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed

@@ -661,6 +661,13 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument(
+        "--microbatches", type=int, default=1, metavar="M",
+        help="M > 1 serves the zero-bubble 1F1B schedule (BASELINE config "
+             "5): batched requests split into M microbatches chasing each "
+             "other around the pp ring (needs --pp >= 2 and M >= pp); solo "
+             "requests ride the batched path",
+    )
     ap.add_argument("--sp", type=int, default=1, help="context-parallel ring size")
     ap.add_argument(
         "--sp-strategy", default="ring", choices=["ring", "ulysses"],
@@ -798,6 +805,7 @@ def main(argv: Optional[list] = None):
             request_deadline_s=args.deadline,
             prefix_cache_entries=args.prefix_cache,
         ),
+        microbatches=args.microbatches,
         params=params,
         dtype=dtype,
         quant=args.quant,

@@ -1,0 +1,112 @@
+"""Full request surface on the pp mesh (round-2 review #3): scoring,
+per-token logprobs, logit_bias, and beam search must be BIT-CONSISTENT
+between the single-device backend and a pp=2 pipeline built from the same
+params — the reference served its one feature set on its one topology
+(/root/reference/orchestration.py:144-178); here every topology serves
+everything.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributed_llm_inference_tpu import EngineConfig, MeshConfig, create_engine, get_model_config
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.models import api as M
+
+
+class _NumTok:
+    """Lossless ids<->text ('12 7 9'), so token-exact comparisons survive
+    decode round-trips."""
+
+    def encode(self, text):
+        return [int(t) % 250 + 3 for t in text.split()] or [3]
+
+    def decode(self, toks, skip_special_tokens=True):
+        return " ".join(str(int(t)) for t in toks)
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1)
+    params = M.init_params(cfg, jax.random.PRNGKey(5))
+    ecfg = EngineConfig(prefill_buckets=(32, 64))
+    sd = InferenceEngine(cfg, params=params, tokenizer=_NumTok(), engine_cfg=ecfg)
+    pp = create_engine(
+        cfg, mesh_cfg=MeshConfig(pp=2), params=params, tokenizer=_NumTok(),
+        engine_cfg=ecfg,
+    )
+    return sd, pp
+
+
+PROMPT = "12 44 91 7 33 5"
+
+
+def test_score_bit_consistent(engines):
+    sd, pp = engines
+    a = sd.score(PROMPT, top_n=3)
+    b = pp.score(PROMPT, top_n=3)
+    assert a["status"] == b["status"] == "success"
+    assert a["token_logprobs"][0] is None and b["token_logprobs"][0] is None
+    np.testing.assert_allclose(
+        a["token_logprobs"][1:], b["token_logprobs"][1:], rtol=0, atol=1e-6
+    )
+    for ta, tb in zip(a["top_logprobs"][1:], b["top_logprobs"][1:]):
+        assert list(ta) == list(tb)
+
+
+def test_logprobs_bit_consistent(engines):
+    sd, pp = engines
+    a = sd.generate(PROMPT, max_tokens=6, greedy=True, chat=False, logprobs=True)
+    b = pp.generate(PROMPT, max_tokens=6, greedy=True, chat=False, logprobs=True)
+    assert a["status"] == b["status"] == "success"
+    assert a["response"] == b["response"]
+    np.testing.assert_allclose(
+        a["token_logprobs"], b["token_logprobs"], rtol=0, atol=1e-6
+    )
+
+
+def test_logit_bias_bit_consistent(engines):
+    sd, pp = engines
+    kw = dict(max_tokens=5, greedy=True, chat=False, logit_bias={"17": 100.0})
+    a = sd.generate(PROMPT, **kw)
+    b = pp.generate(PROMPT, **kw)
+    assert a["status"] == b["status"] == "success"
+    assert a["response"] == b["response"]
+    # +100 bias under greedy forces the token every step
+    assert set(a["response"].split()) == {"17"}
+
+
+def test_logit_bias_sampled_consistent(engines):
+    sd, pp = engines
+    kw = dict(max_tokens=6, chat=False, temperature=0.8, seed=11,
+              logit_bias={"29": 4.0, "41": -100.0})
+    a = sd.generate(PROMPT, **kw)
+    b = pp.generate(PROMPT, **kw)
+    assert a["response"] == b["response"]
+    assert "41" not in a["response"].split()
+
+
+def test_beam_search_bit_consistent(engines):
+    sd, pp = engines
+    kw = dict(max_tokens=8, num_beams=3, chat=False)
+    a = sd.generate(PROMPT, **kw)
+    b = pp.generate(PROMPT, **kw)
+    assert a["status"] == b["status"] == "success"
+    assert a["response"] == b["response"]
+    assert len(a["beams"]) == len(b["beams"]) == 3
+    for ba, bb in zip(a["beams"], b["beams"]):
+        assert ba["text"] == bb["text"]
+        np.testing.assert_allclose(ba["score"], bb["score"], atol=1e-5)
+
+
+def test_repetition_penalty_with_bias_pp(engines):
+    """presence (repetition penalty) composes with bias on the pp mesh —
+    the (pres, bias) program variant."""
+    sd, pp = engines
+    kw = dict(max_tokens=6, greedy=True, chat=False,
+              repetition_penalty=1.3, logit_bias={"55": 2.5})
+    a = sd.generate(PROMPT, **kw)
+    b = pp.generate(PROMPT, **kw)
+    assert a["response"] == b["response"]
