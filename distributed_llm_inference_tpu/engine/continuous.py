@@ -95,6 +95,7 @@ class ContinuousEngine:
         chunk_steps: int = 16,
         max_queue: int = 64,
         chunk_lag: int = 2,
+        slot_max_seq: Optional[int] = None,
     ):
         cfg = engine.cfg
         if cfg.arch not in ("llama", "gpt2"):
@@ -123,9 +124,31 @@ class ContinuousEngine:
         # chunks late — bounded compute waste, never wrong output.
         self.chunk_lag = max(1, int(chunk_lag))
 
-        self.cache = self.backend.init_cache(self.n_slots, cfg.max_seq_len)
+        # Per-slot KV budget (round-2 review weak #7): the fleet cache pins
+        # n_slots x slot_max_seq of KV in HBM for the server's lifetime —
+        # at Llama-2-7B/4096/8-slot scale that is ~8.5 GB bf16 BEFORE
+        # weights when sized to the model window. slot_max_seq caps the
+        # slot class: allocation becomes a function of the configured
+        # budget, and admission plans/clamps against it (prompts beyond it
+        # are rejected, decode budgets clamped to fit).
+        self.slot_max_seq = min(
+            int(slot_max_seq or cfg.max_seq_len), cfg.max_seq_len
+        )
+        buckets = engine._buckets()
+        if buckets and self.slot_max_seq < buckets[0]:
+            # the ingest plan needs at least one prefill bucket inside the
+            # slot class — a smaller budget would start a healthy-looking
+            # server that rejects EVERY request
+            raise ValueError(
+                f"slot_max_seq={self.slot_max_seq} is smaller than the "
+                f"smallest prefill bucket {buckets[0]}; raise it or shrink "
+                f"engine_cfg.prefill_buckets"
+            )
+        self.cache = self.backend.init_cache(self.n_slots, self.slot_max_seq)
         self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
-        self._scratch = self.backend.init_cache(1, cfg.max_seq_len)
+        # scratch must match the fleet's max_seq: insert_slot splices the
+        # whole row
+        self._scratch = self.backend.init_cache(1, self.slot_max_seq)
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
         # Own PrefixCache instance (engine/prefix.py), NOT shared with the
         # solo engine's: the solo path touches its cache under the engine
@@ -479,13 +502,18 @@ class ContinuousEngine:
         req.prompt_tokens = prompt_len
         # prefix-cache lookup + ingest plan: the solo engine's shared
         # helper (one copy of the lookup/cold-fallback/mark discipline)
-        p0, entry, plan = eng._prefix_plan(self._prefix, ids)
+        p0, entry, plan = eng._prefix_plan(
+            self._prefix, ids, capacity=self.slot_max_seq
+        )
         if plan is None:
             raise ValueError(
-                f"prompt length {prompt_len} exceeds the serving capacity "
-                f"(max_seq_len {cfg.max_seq_len})"
+                f"prompt length {prompt_len} exceeds the slot capacity "
+                f"(slot_max_seq {self.slot_max_seq})"
             )
-        max_tokens, _ = eng._clamp_decode(prompt_len, int(k.get("max_tokens", 20)))
+        max_tokens, _ = eng._clamp_decode(
+            prompt_len, int(k.get("max_tokens", 20)),
+            capacity=self.slot_max_seq,
+        )
         sampling = G.default_sampling(
             k.get("temperature", 0.7), k.get("top_k", 50),
             k.get("top_p", 0.9), k.get("greedy", False),
@@ -529,7 +557,7 @@ class ContinuousEngine:
                 # a failed extend/prefill may have consumed (donated) the
                 # scratch buffer mid-sequence; a permanently-None scratch
                 # would fail every later admission — reallocate
-                self._scratch = self.backend.init_cache(1, cfg.max_seq_len)
+                self._scratch = self.backend.init_cache(1, self.slot_max_seq)
         req.slot = slot
         with self._cv:
             self._assignment[slot] = req

@@ -235,6 +235,13 @@ class InferenceEngine:
         # smaller same-tokenizer model + its reusable donated KV cache
         self._draft = None
         self._draft_cache = None
+        # Abandoned (deadline-overrun) device calls still running on their
+        # daemon threads: token -> {"what", "since"}. /health flips to
+        # "degraded" while any exists (round-2 review weak #5 — on a flaky
+        # tunnel this is THE failure mode), and the server's optional
+        # --die-on-wedge reaper exits the process off max_wedged_age().
+        self._wedged: dict = {}
+        self._wedged_lock = threading.Lock()
 
     def set_draft(self, dcfg: ModelConfig, dparams: Any = None,
                   seed: int = 1):
@@ -280,18 +287,32 @@ class InferenceEngine:
         if not deadline:
             return fn()
         box: dict = {}
+        token = object()
 
         def run():
             try:
                 box["result"] = fn()
             except BaseException as e:  # re-raised on the caller thread
                 box["exc"] = e
+            finally:
+                # the abandoned call finally drained: /health un-degrades.
+                # box["done"] is flipped under the SAME lock that guards
+                # registration, so a call finishing exactly at the deadline
+                # can never leave a permanent stale entry (Thread.is_alive
+                # cannot arbitrate this — it stays True past this finally)
+                with self._wedged_lock:
+                    box["done"] = True
+                    self._wedged.pop(token, None)
 
+        t_start = time.time()
         t = threading.Thread(target=run, daemon=True, name=f"engine-{what}")
         t.start()
         t.join(deadline)
         if t.is_alive():
             log.error("request_deadline_exceeded", what=what, deadline_s=deadline)
+            with self._wedged_lock:
+                if not box.get("done"):
+                    self._wedged[token] = {"what": what, "since": t_start}
             return {
                 "error": f"Error: request exceeded the {deadline:g}s deadline",
                 "status": "failed",
@@ -301,22 +322,42 @@ class InferenceEngine:
             raise box["exc"]
         return box["result"]
 
+    def wedged_info(self) -> list[dict]:
+        """Abandoned deadline-overrun calls still occupying the device:
+        [{"what", "age_s"}], oldest first. Empty = not wedged."""
+        now = time.time()
+        with self._wedged_lock:
+            entries = [
+                {"what": e["what"], "age_s": round(now - e["since"], 1)}
+                for e in self._wedged.values()
+            ]
+        return sorted(entries, key=lambda e: -e["age_s"])
+
+    def max_wedged_age(self) -> Optional[float]:
+        info = self.wedged_info()
+        return info[0]["age_s"] if info else None
+
     def _buckets(self):
         return tuple(b for b in self.engine_cfg.prefill_buckets if b <= self.cfg.max_seq_len)
 
     def _clamp_decode(
-        self, frame: int, max_tokens: int, headroom: int = 0
+        self, frame: int, max_tokens: int, headroom: int = 0,
+        capacity: Optional[int] = None,
     ) -> tuple[int, int]:
         """Cache-capacity discipline in ONE place: frame + generated (+
         `headroom` scratch slots, e.g. speculative drafts written past the
-        last emitted token) must fit max_seq (update_kv_cache clamps
-        silently out of range — never allow it), also bounded by the
-        largest compiled decode bucket. Returns (max_tokens, decode_bucket)."""
+        last emitted token) must fit the cache capacity (update_kv_cache
+        clamps silently out of range — never allow it), also bounded by the
+        largest compiled decode bucket. capacity defaults to max_seq_len;
+        the continuous engine passes its per-slot budget (a slot class
+        smaller than the model's window). Returns (max_tokens,
+        decode_bucket)."""
+        cap = capacity if capacity is not None else self.cfg.max_seq_len
         max_tokens = max(
             1,
             min(
                 int(max_tokens),
-                self.cfg.max_seq_len - frame - 1 - headroom,
+                cap - frame - 1 - headroom,
                 DECODE_BUCKETS[-1],
             ),
         )
@@ -501,7 +542,8 @@ class InferenceEngine:
             out["stopped"] = True
         return out
 
-    def _plan_ingest(self, prompt_len: int, p0: int, buckets: tuple):
+    def _plan_ingest(self, prompt_len: int, p0: int, buckets: tuple,
+                     capacity: Optional[int] = None):
         """Plan feeding ids[p0:] into the cache at offset p0.
 
         Returns (n_full, rem, bucket, chunk) — n_full full-`chunk`
@@ -509,13 +551,15 @@ class InferenceEngine:
         `rem` valid tokens — or None when this backend/bucket layout
         cannot ingest from that offset (callers retry with p0=0 or
         raise). The final chunk is a PADDED bucket whose pads also write
-        K/V: its end must stay inside max_seq or update_kv_cache's
-        silent clamp would overwrite real prompt slots.
+        K/V: its end must stay inside the cache capacity (default
+        max_seq_len; the continuous engine plans against its per-slot
+        budget) or update_kv_cache's silent clamp would overwrite real
+        prompt slots.
         """
-        cfg = self.cfg
+        cap = capacity if capacity is not None else self.cfg.max_seq_len
         if not buckets:
             return None
-        if prompt_len > cfg.max_seq_len - 2:
+        if prompt_len > cap - 2:
             # capacity guard on EVERY path (not just chunked): a prefix-
             # cache hit with a short tail must reject exactly the prompts
             # the cold path rejects, or acceptance becomes a function of
@@ -530,7 +574,7 @@ class InferenceEngine:
             return None
         fitting = [
             b for b in buckets
-            if b >= rem and p0 + n_full * chunk + b <= cfg.max_seq_len
+            if b >= rem and p0 + n_full * chunk + b <= cap
         ]
         if not fitting:
             return None
@@ -575,7 +619,7 @@ class InferenceEngine:
             sampling, **kw,
         )
 
-    def _prefix_plan(self, prefix, ids: list):
+    def _prefix_plan(self, prefix, ids: list, capacity: Optional[int] = None):
         """Prefix-cache lookup + ingest planning, ONE copy for the solo and
         continuous paths: lookup -> plan the tail -> cold fallback when no
         tail plan fits -> mark hit/miss on the PLANNED outcome (a lookup
@@ -586,10 +630,10 @@ class InferenceEngine:
         p0, entry, pkey = 0, None, None
         if prefix is not None:
             p0, entry, pkey = prefix.lookup(ids)
-        plan = self._plan_ingest(prompt_len, p0, buckets)
+        plan = self._plan_ingest(prompt_len, p0, buckets, capacity)
         if plan is None and p0:
             p0, entry = 0, None
-            plan = self._plan_ingest(prompt_len, 0, buckets)
+            plan = self._plan_ingest(prompt_len, 0, buckets, capacity)
         if prefix is not None:
             prefix.mark(pkey, hit=bool(p0) and plan is not None)
         return p0, entry, plan
@@ -907,6 +951,80 @@ class InferenceEngine:
             out[b, np.asarray(ids, dtype=np.int64)] = True
         return jnp.asarray(out)
 
+    def _decode_textual_stop_chunks(
+        self, first, cache, prompt_len, max_tokens, key_dec, sampling, dkw,
+        logprobs, stop,
+    ):
+        """Bounded-chunk decode when textual `stop` sequences are set
+        (round-2 review weak #4: the post-hoc check decoded the full
+        budget — a 512-token request hitting its stop at token 5 burned
+        507 wasted steps on device).
+
+        Decodes DECODE_BUCKETS[0]-step chunks (a program --warmup already
+        compiled), checks the accumulated text between chunks, and stops
+        the moment a stop sequence appears; the caller's existing
+        _truncate_at_stop does the exact final truncation. Stop-less
+        requests never enter this path, so their device-call count is
+        unchanged. Sampled (non-greedy) requests draw from a per-chunk
+        key stream — deterministic for a fixed seed, but a different
+        stream than the single-call path (greedy output is identical).
+
+        Returns (out [1, N] np.int32, n_gen [1] np.int32, step_lps
+        [1, N] np.float32 or None, cache).
+        """
+        import numpy as np
+
+        chunk_bucket = DECODE_BUCKETS[0]
+        budget = max_tokens - 1  # first token already sampled by prefill
+        collected: list = []
+        lps: list = []
+        token = first
+        pos = int(prompt_len)
+        first_id = int(first[0])
+        finished = first_id in self.cfg.all_stop_ids
+        while budget > 0 and not finished:
+            limit = min(budget, chunk_bucket)
+            key_dec, sub = jax.random.split(key_dec)
+            if logprobs:
+                out_i, n_i, cache, lps_i = self.backend.decode(
+                    token, cache, jnp.int32(pos), jnp.int32(limit), sub,
+                    sampling, max_steps=chunk_bucket, with_logprobs=True,
+                    **dkw,
+                )
+            else:
+                lps_i = None
+                out_i, n_i, cache = self.backend.decode(
+                    token, cache, jnp.int32(pos), jnp.int32(limit), sub,
+                    sampling, max_steps=chunk_bucket, **dkw,
+                )
+            n = int(n_i[0])
+            row = [int(t) for t in np.asarray(out_i[0][:n])]
+            collected += row
+            if lps_i is not None:
+                lps += [float(x) for x in np.asarray(lps_i[0][:n])]
+            if n < limit:  # EOS early-exit inside the chunk
+                finished = True
+                break
+            budget -= n
+            pos += n
+            # presence chunks: mark this chunk's tokens before the next
+            if dkw.get("presence") is not None and row:
+                pres = dkw["presence"]
+                pres = pres.at[0, jnp.asarray(row, jnp.int32)].set(True)
+                dkw = dict(dkw, presence=pres)
+            text = self.tokenizer.decode(
+                ([first_id] if first_id not in self.cfg.all_stop_ids else [])
+                + collected,
+                skip_special_tokens=True,
+            )
+            if any(s in text for s in stop):
+                break
+            token = jnp.asarray([row[-1]], jnp.int32) if row else token
+        out = np.asarray([collected], np.int32)
+        n_gen = np.asarray([len(collected)], np.int32)
+        step_lps = np.asarray([lps], np.float32) if logprobs else None
+        return out, n_gen, step_lps, cache
+
     def _generate_locked(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False, min_p=0.0,
@@ -1046,7 +1164,14 @@ class InferenceEngine:
             dkw = {"presence": presence}
             if bias is not None:  # backends without the kwarg stay untouched
                 dkw["bias"] = bias
-            if logprobs:
+            if stop:
+                # textual stops: decode in bounded chunks and quit at the
+                # first match instead of burning the full budget on device
+                out, n_gen, step_lps, cache = self._decode_textual_stop_chunks(
+                    first, cache, prompt_len, max_tokens, key_dec, sampling,
+                    dkw, logprobs, stop,
+                )
+            elif logprobs:
                 out, n_gen, cache, step_lps = self.backend.decode(
                     first, cache, jnp.int32(prompt_len),
                     jnp.int32(max_tokens - 1), key_dec, sampling,
@@ -1540,7 +1665,7 @@ class InferenceEngine:
 
     # -- health (reference /health + /workers, orchestration.py:297-329) ----
     def health(self) -> dict:
-        return {
+        out = {
             "status": "healthy",
             "model": self.cfg.name,
             "backend": self.backend.name,
@@ -1548,6 +1673,14 @@ class InferenceEngine:
             "requests_served": self.request_count,
             "stats": self.stats(),
         }
+        wedged = self.wedged_info()
+        if wedged:
+            # an abandoned device call is still holding the backend: new
+            # requests will burn their deadline and 503 until it drains —
+            # tell the monitor the truth (and how long it has been stuck)
+            out["status"] = "degraded"
+            out["wedged"] = wedged
+        return out
 
     def workers(self) -> dict:
         stages = self.backend.health()

@@ -704,6 +704,14 @@ def main(argv: Optional[list] = None):
              "timeout envelope (reference: 30s per worker hop)",
     )
     ap.add_argument(
+        "--die-on-wedge", type=float, default=None, metavar="SECONDS",
+        help="exit the process (code 17) once an abandoned deadline-overrun "
+             "device call has been stuck this long — a supervisor restart "
+             "is the only real recovery from a wedged accelerator runtime; "
+             "/health reports \"degraded\" with the stuck age either way "
+             "(needs --deadline)",
+    )
+    ap.add_argument(
         "--queue", type=int, default=0, metavar="N",
         help="bounded request queue of depth N in front of the engine: "
              "concurrent singles coalesce into ragged batched fleets, "
@@ -727,6 +735,14 @@ def main(argv: Optional[list] = None):
     ap.add_argument(
         "--continuous-chunk", type=int, default=16,
         help="decode steps per device round-trip in continuous mode",
+    )
+    ap.add_argument(
+        "--continuous-max-seq", type=int, default=None, metavar="N",
+        help="per-slot KV budget for --continuous (prompt + generated "
+             "tokens per request; default: the model's max_seq_len). The "
+             "fleet pins SLOTS x N of KV in HBM — cap it to what you "
+             "actually serve: 8 slots x 4096 on a 7B-class model is "
+             "~8.5 GB bf16 before weights",
     )
     ap.add_argument(
         "--continuous-lag", type=int, default=2,
@@ -760,6 +776,12 @@ def main(argv: Optional[list] = None):
     )
     args = ap.parse_args(argv)
 
+    if args.die_on_wedge and not args.deadline:
+        # checked BEFORE the (potentially minutes-long) model load
+        raise SystemExit(
+            "--die-on-wedge needs --deadline: wedges are detected by "
+            "deadline-overrun calls that never drain"
+        )
     if args.compile_cache:
         import jax
 
@@ -815,6 +837,23 @@ def main(argv: Optional[list] = None):
         draft_model=args.draft_model,
         lora=args.lora,
     )
+    if args.die_on_wedge:
+
+        def _wedge_reaper():
+            import os as _os
+
+            while True:
+                time.sleep(max(1.0, min(args.die_on_wedge / 4, 10.0)))
+                age = engine.max_wedged_age()
+                if age is not None and age > args.die_on_wedge:
+                    print(
+                        f"💀 wedged device call stuck {age:.0f}s > "
+                        f"--die-on-wedge {args.die_on_wedge:g}s; exiting "
+                        f"for a supervisor restart"
+                    )
+                    _os._exit(17)
+
+        threading.Thread(target=_wedge_reaper, daemon=True).start()
     if args.warmup:
         print("⏳ warming up (compiling all bucket shapes)...")
         try:
@@ -841,7 +880,7 @@ def main(argv: Optional[list] = None):
 
         continuous = ContinuousEngine(
             engine, n_slots=args.continuous, chunk_steps=args.continuous_chunk,
-            chunk_lag=args.continuous_lag,
+            chunk_lag=args.continuous_lag, slot_max_seq=args.continuous_max_seq,
         )
         if args.warmup:
             w = continuous.warmup()

@@ -1,0 +1,58 @@
+"""Worker process for the 2-process jax.distributed integration test
+(tests/test_multihost.py::test_two_process_pipelined_generate).
+
+Each process contributes ONE virtual CPU device; jax.distributed joins
+them into a 2-device global mesh (DCN analogue of the reference's two
+ngrok-wired Colab workers, /root/reference/orchestration.py:22-24). The
+checkpoint is restored with load_params_sharded, so each process mmap-
+reads ONLY its own stage's layer pages — the multi-host loading story
+the serving CLI uses, exercised for real across process boundaries.
+
+Usage: multihost_worker.py <process_id> <coordinator_port> <ckpt_dir>
+Prints one line: RESULT:{json}
+"""
+
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+ckpt_dir = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert jax.device_count() == 2, jax.devices()
+assert len(jax.local_devices()) == 1
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_llm_inference_tpu import MeshConfig, create_engine  # noqa: E402
+from distributed_llm_inference_tpu.models.checkpoint import (  # noqa: E402
+    load_params_sharded,
+)
+from distributed_llm_inference_tpu.parallel.mesh import build_mesh  # noqa: E402
+
+mesh = build_mesh(MeshConfig(pp=2))
+cfg, params = load_params_sharded(ckpt_dir, mesh)
+engine = create_engine(cfg, mesh_cfg=MeshConfig(pp=2), params=params)
+r = engine.generate("multi host hello", max_tokens=5, temperature=0.0, seed=0)
+print(
+    "RESULT:" + json.dumps({
+        "pid": pid,
+        "status": r["status"],
+        "response": r.get("response"),
+        "tokens": r.get("tokens_generated"),
+        "n_devices": jax.device_count(),
+    }),
+    flush=True,
+)

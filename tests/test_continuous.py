@@ -508,3 +508,47 @@ def test_over_long_prompt_invalid_request(solo_engine):
         assert r["error_type"] == "invalid_request"
     finally:
         cont.close()
+
+
+def test_slot_max_seq_bounds_fleet_cache(solo_engine):
+    """Round-2 review weak #7: fleet KV is a function of the configured
+    per-slot budget, not n_slots x model max_seq_len."""
+    cont = ContinuousEngine(solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=64)
+    try:
+        # cache [L, B, KV, S, Dh]: the S axis equals the slot budget
+        assert cont.cache["k"].shape[3] == 64
+        assert cont.cache["k"].shape[1] == 2
+        assert cont._scratch["k"].shape[3] == 64
+        r = cont.submit("short prompt", max_tokens=5, greedy=True, chat=False)
+        assert r["status"] == "success"
+    finally:
+        cont.close()
+
+
+def test_slot_max_seq_rejects_oversized_prompt(solo_engine):
+    cont = ContinuousEngine(solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=40)
+    try:
+        # the only fitting prefill bucket is 32; a prompt over 38 tokens
+        # cannot fit the 40-slot class even though the model window could
+        long_prompt = "x " * 50
+        r = cont.submit(long_prompt, max_tokens=5, greedy=True, chat=False)
+        assert r["status"] == "failed"
+        assert "slot capacity" in r["error"]
+        # and a fitting request still serves
+        ok = cont.submit("fits fine", max_tokens=4, greedy=True, chat=False)
+        assert ok["status"] == "success"
+    finally:
+        cont.close()
+
+
+def test_slot_max_seq_clamps_decode_budget(solo_engine):
+    cont = ContinuousEngine(solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=48)
+    try:
+        # budget clamps to slot_max_seq - prompt_len - 1 (decode writes at
+        # prompt_len.., re-using the padded prefill bucket's junk slots),
+        # far below the requested 400
+        r = cont.submit("a b c", max_tokens=400, greedy=True, chat=False)
+        assert r["status"] == "success"
+        assert r["tokens_generated"] <= 48 - r["prompt_tokens"] - 1
+    finally:
+        cont.close()

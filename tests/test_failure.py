@@ -141,3 +141,33 @@ def test_pipeline_workers_probe(eight_devices):
     for s in w["workers"].values():
         assert s["status"] == "online"
         assert s["probe_ms"] >= 0.0
+
+
+def test_health_degrades_while_wedged_and_recovers():
+    """Round-2 review weak #5 / next-round #7: an abandoned deadline-
+    overrun call flips /health to "degraded" with the stuck age; once the
+    call drains, health returns to "healthy"."""
+    engine = _slow_engine(delay_s=2.5, deadline_s=0.3)
+    assert engine.health()["status"] == "healthy"
+    r = engine.generate("hi", max_tokens=3, greedy=True, chat=False)
+    assert r["status"] == "failed" and r["error_type"] == "timeout"
+    h = engine.health()
+    assert h["status"] == "degraded"
+    assert h["wedged"] and h["wedged"][0]["what"] == "generate"
+    assert h["wedged"][0]["age_s"] >= 0.0
+    # the stuck call eventually drains on its daemon thread
+    deadline = time.time() + 15
+    while time.time() < deadline and engine.health()["status"] != "healthy":
+        time.sleep(0.2)
+    h2 = engine.health()
+    assert h2["status"] == "healthy" and "wedged" not in h2
+
+
+def test_max_wedged_age_tracks_oldest():
+    engine = _slow_engine(delay_s=2.0, deadline_s=0.2)
+    assert engine.max_wedged_age() is None
+    engine.generate("hi", max_tokens=3, greedy=True, chat=False)
+    age = engine.max_wedged_age()
+    assert age is not None and age >= 0.0
+    time.sleep(0.5)
+    assert engine.max_wedged_age() > age
