@@ -366,6 +366,39 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
+    # flash-attention prefill leg: the Pallas kernel (ops/flash_attention)
+    # vs the XLA einsum path at a 1k prompt — prefill is where attention
+    # is quadratic, so this is the kernel's case to win (round-2 review
+    # weak #3: the kernel existed but nothing measured it; the default
+    # stays "xla" unless this leg shows a win). Fully fenced.
+    flash_xla_tok_s = flash_pl_tok_s = None
+    if on_tpu and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        try:
+            FLASH_LEN = 1024
+            long_tokens = jnp.asarray(
+                [[cfg.bos_token_id] + [7] * (FLASH_LEN - 1)], jnp.int32
+            )
+            fplen = jnp.int32(FLASH_LEN)
+
+            def time_prefill(c):
+                def once():
+                    cf = M.init_kv_cache(c, 1, max_seq=FLASH_LEN + 8)
+                    ff, _, cf = G.prefill(
+                        c, params, long_tokens, fplen, cf, kp, sampling
+                    )
+                    fetch(ff)
+
+                once()  # warm/compile
+                t = max(min(_timed(once)[0] for _ in range(3)) - rtt, 1e-9)
+                return FLASH_LEN / t
+
+            flash_xla_tok_s = time_prefill(cfg)
+            flash_pl_tok_s = time_prefill(cfg.replace(attn_impl="pallas"))
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
     # continuous-batching leg (engine/continuous.py): closed-loop client
     # fleet against the real serving engine — slot recycling, mid-flight
     # admission, lag-1 chunk pipelining. Reported as continuous_tok_s.
@@ -429,6 +462,10 @@ def run_benchmark():
             )
     if cont_tok_s is not None:
         result["continuous_tokens_per_sec"] = round(cont_tok_s, 3)
+    if flash_xla_tok_s is not None:
+        result["prefill_xla_1k_tok_s"] = round(flash_xla_tok_s, 1)
+    if flash_pl_tok_s is not None:
+        result["prefill_flash_1k_tok_s"] = round(flash_pl_tok_s, 1)
     if int8_tok_s is not None:
         result["int8_tokens_per_sec"] = round(int8_tok_s, 3)
         if peak_bw:

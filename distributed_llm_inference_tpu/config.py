@@ -318,6 +318,41 @@ class EngineConfig:
     prefix_chunk: int = 64
 
 
+def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":
+    """Apply an --attn-impl request to a model config.
+
+    "xla" / "pallas": explicit (pallas validates its own restrictions in
+    __post_init__ — softcap, query-scale overrides, per-layer window
+    patterns reject loudly). "auto": pick the Pallas flash kernel
+    (ops/flash_attention.py) when it is legal for this model AND the
+    session is actually on a TPU backend — on CPU the kernel runs in
+    interpret mode, orders of magnitude slower than the XLA path, so auto
+    never selects it there. None: keep the config's own setting.
+    """
+    if requested is None:
+        return cfg
+    if requested in ("xla", "pallas"):
+        return cfg.replace(attn_impl=requested)
+    if requested != "auto":
+        raise ValueError(
+            f"attn_impl request must be 'auto', 'xla', or 'pallas'; got "
+            f"{requested!r}"
+        )
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return cfg.replace(attn_impl="xla")
+    try:
+        # __post_init__ owns the capability knowledge: models needing a
+        # feature the kernel doesn't cover (gemma-2 softcap, per-layer
+        # window patterns, query-scale overrides) reject the replace and
+        # fall back to the XLA path. Both llama and gpt2 forwards dispatch
+        # on attn_impl (models/llama.py, models/gpt2.py:118).
+        return cfg.replace(attn_impl="pallas")
+    except ValueError:
+        return cfg.replace(attn_impl="xla")
+
+
 def stage_layer_range(n_layers: int, pp: int, stage: int) -> tuple[int, int]:
     """Contiguous layer range [start, end) owned by `stage`.
 

@@ -248,8 +248,9 @@ class InferenceEngine:
         """Attach a draft model for two-model speculative decoding.
 
         The draft must share the target's tokenizer/vocab (token ids are
-        compared against the target's argmax); only the single-device
-        backend runs the combined verify program.
+        compared against the target's argmax); the single-device backend
+        and the pp pipeline (replicated draft inside the ring) run the
+        combined verify program.
         """
         if dparams is None:
             dparams = M.init_params(dcfg, jax.random.PRNGKey(seed))
@@ -304,7 +305,6 @@ class InferenceEngine:
                     box["done"] = True
                     self._wedged.pop(token, None)
 
-        t_start = time.time()
         t = threading.Thread(target=run, daemon=True, name=f"engine-{what}")
         t.start()
         t.join(deadline)
@@ -312,7 +312,14 @@ class InferenceEngine:
             log.error("request_deadline_exceeded", what=what, deadline_s=deadline)
             with self._wedged_lock:
                 if not box.get("done"):
-                    self._wedged[token] = {"what": what, "since": t_start}
+                    # `since` = the moment of ABANDONMENT (not call start:
+                    # the reported age — and --die-on-wedge's threshold —
+                    # count time stuck PAST the deadline), on the monotonic
+                    # clock (a wall-clock NTP step must never exit(17) a
+                    # healthy process)
+                    self._wedged[token] = {
+                        "what": what, "since": time.monotonic(),
+                    }
             return {
                 "error": f"Error: request exceeded the {deadline:g}s deadline",
                 "status": "failed",
@@ -324,8 +331,9 @@ class InferenceEngine:
 
     def wedged_info(self) -> list[dict]:
         """Abandoned deadline-overrun calls still occupying the device:
-        [{"what", "age_s"}], oldest first. Empty = not wedged."""
-        now = time.time()
+        [{"what", "age_s"}] — age counted from ABANDONMENT (deadline
+        overrun), oldest first. Empty = not wedged."""
+        now = time.monotonic()
         with self._wedged_lock:
             entries = [
                 {"what": e["what"], "age_s": round(now - e["since"], 1)}
@@ -686,7 +694,7 @@ class InferenceEngine:
         if not getattr(self.backend, "supports_beam", False):
             raise ValueError(
                 f"backend {self.backend.name!r} does not support beam "
-                f"search; serve num_beams > 1 on the single-device backend"
+                f"search; serve num_beams > 1 on the single-device or pipeline backend"
             )
         if not 2 <= num_beams <= 16:
             raise ValueError("num_beams must be between 2 and 16")
@@ -792,7 +800,7 @@ class InferenceEngine:
         if not getattr(self.backend, "supports_score", False):
             raise ValueError(
                 f"backend {self.backend.name!r} does not support scoring; "
-                f"serve echo/logprobs scoring on the single-device backend"
+                f"serve echo/logprobs scoring on the single-device or pipeline backend"
             )
         if not 0 <= top_n <= 5:
             raise ValueError("top_n must be between 0 and 5")
@@ -925,7 +933,7 @@ class InferenceEngine:
         if not getattr(self.backend, "supports_bias", False):
             raise ValueError(
                 f"backend {self.backend.name!r} does not support logit_bias; "
-                f"serve biased requests on the single-device backend"
+                f"serve biased requests on the single-device or pipeline backend"
             )
         import numpy as np
 
@@ -960,21 +968,23 @@ class InferenceEngine:
         budget — a 512-token request hitting its stop at token 5 burned
         507 wasted steps on device).
 
-        Decodes DECODE_BUCKETS[0]-step chunks (a program --warmup already
-        compiled), checks the accumulated text between chunks, and stops
-        the moment a stop sequence appears; the caller's existing
-        _truncate_at_stop does the exact final truncation. Stop-less
-        requests never enter this path, so their device-call count is
-        unchanged. Sampled (non-greedy) requests draw from a per-chunk
-        key stream — deterministic for a fixed seed, but a different
-        stream than the single-call path (greedy output is identical).
+        Decodes chunks that ESCALATE up the DECODE_BUCKETS ladder (16, 32,
+        64, ... — every rung a program --warmup already compiled): a stop
+        matching early costs one small chunk, while a stop that never
+        matches costs O(log budget) round-trips instead of budget/16.
+        Checks the accumulated text between chunks and stops the moment a
+        stop sequence appears; the caller's existing _truncate_at_stop
+        does the exact final truncation. Stop-less requests never enter
+        this path, so their device-call count is unchanged. Sampled
+        (non-greedy) requests draw from a per-chunk key stream —
+        deterministic for a fixed seed, but a different stream than the
+        single-call path (greedy output is identical).
 
         Returns (out [1, N] np.int32, n_gen [1] np.int32, step_lps
         [1, N] np.float32 or None, cache).
         """
         import numpy as np
 
-        chunk_bucket = DECODE_BUCKETS[0]
         budget = max_tokens - 1  # first token already sampled by prefill
         collected: list = []
         lps: list = []
@@ -982,7 +992,10 @@ class InferenceEngine:
         pos = int(prompt_len)
         first_id = int(first[0])
         finished = first_id in self.cfg.all_stop_ids
+        rung = 0
         while budget > 0 and not finished:
+            chunk_bucket = DECODE_BUCKETS[min(rung, len(DECODE_BUCKETS) - 1)]
+            rung += 1
             limit = min(budget, chunk_bucket)
             key_dec, sub = jax.random.split(key_dec)
             if logprobs:
@@ -1073,8 +1086,8 @@ class InferenceEngine:
         if logprobs and not getattr(self.backend, "supports_logprobs", False):
             raise ValueError(
                 f"backend {self.backend.name!r} does not support per-token "
-                f"logprobs; serve logprobs requests on the single-device "
-                f"backend"
+                f"logprobs; serve logprobs requests on the single-device or "
+                f"pipeline backend"
             )
         spec_ok = (
             speculative
@@ -1515,7 +1528,7 @@ class InferenceEngine:
         if not getattr(self.backend, "supports_ragged", False):
             raise ValueError(
                 f"backend {self.backend.name!r} does not support ragged "
-                f"batches; serve batches on the single-device backend"
+                f"batches; serve batches on a ragged-capable backend"
             )
         self.request_count += 1
         B = len(prompts)

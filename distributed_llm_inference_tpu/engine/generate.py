@@ -527,6 +527,40 @@ def decode_speculative(
     Greedy only (B=1): speculation verifies argmax, not a sampled draw.
     Returns (out [1, max_steps], n_gen [1], cache).
     """
+
+    def fwd(tokens_in, cache, pos):
+        x = M.embed(cfg, params, tokens_in, pos)
+        x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+        return M.unembed(cfg, params, x), cache
+
+    return spec_loop(
+        cfg, fwd, first_token, cache, hist, hist_len, limit,
+        max_steps=max_steps, draft_len=draft_len,
+    )
+
+
+def spec_loop(
+    cfg: ModelConfig,
+    fwd,
+    first_token,
+    cache,
+    hist,
+    hist_len,
+    limit,
+    *,
+    max_steps: int,
+    draft_len: int = 4,
+):
+    """Backend-agnostic prompt-lookup speculation loop (the whole
+    algorithm behind `decode_speculative`). `fwd(tokens [1, 1+G], cache,
+    pos) -> (logits [1, 1+G, V], cache)` abstracts the verify forward:
+    single-device embed/layers/unembed, or the pipeline's ring microsteps
+    inside a shard_map body (parallel/pipeline.PipelineBackend) — one
+    implementation, so pp speculation is consistent with the single chip
+    by construction. On a pipeline, one verify forward costs the same S
+    microsteps as a single token, so g accepted tokens amortize the
+    batch-1 ring bubble g-fold.
+    """
     G = draft_len
     H = hist.shape[1]
     pad = jnp.int32(cfg.pad_token_id)
@@ -571,9 +605,7 @@ def decode_speculative(
 
         # --- one forward over [current, draft] at pos
         tokens_in = jnp.concatenate([cur[None], draft])[None, :]  # [1, 1+G]
-        x = M.embed(cfg, params, tokens_in, pos)
-        x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
-        logits = M.unembed(cfg, params, x)  # [1, 1+G, V]
+        logits, cache = fwd(tokens_in, cache, pos)  # [1, 1+G, V]
         window = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [1+G]
 
         # --- accept the matched draft prefix + the correction token
@@ -905,6 +937,43 @@ def decode_draft_speculative(
     Greedy only, B=1. Returns (out [1, max_steps], n_gen [1], cache,
     dcache).
     """
+
+    def fwd(tokens_in, cache, pos):
+        x = M.embed(cfg, params, tokens_in, pos)
+        x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
+        return M.unembed(cfg, params, x), cache
+
+    def dfwd(tok_11, dc, p):
+        x = M.embed(dcfg, dparams, tok_11, p)
+        x, dc = M.forward_layers(dcfg, dparams["layers"], x, dc, p)
+        return M.unembed(dcfg, dparams, x), dc
+
+    return draft_spec_loop(
+        cfg, fwd, dfwd, first_token, cache, dcache, start_pos, limit,
+        max_steps=max_steps, draft_len=draft_len,
+    )
+
+
+def draft_spec_loop(
+    cfg: ModelConfig,
+    fwd,
+    dfwd,
+    first_token,
+    cache,
+    dcache,
+    start_pos,
+    limit,
+    *,
+    max_steps: int,
+    draft_len: int = 4,
+):
+    """Backend-agnostic two-model speculation loop (the algorithm behind
+    `decode_draft_speculative`). `fwd(tokens [1, 1+G], cache, pos)` is the
+    TARGET verify forward; `dfwd(tok [1, 1], dcache, pos)` one DRAFT
+    step. The pipeline backend supplies a ring-microstep target forward
+    and a replicated draft (every device runs the small draft redundantly
+    — cheaper than scattering it), so pp meshes serve draft speculation
+    with the same acceptance semantics as the single chip."""
     G = draft_len
     pad = jnp.int32(cfg.pad_token_id)
     out0 = jnp.full((1, max_steps + G + 1), pad, jnp.int32)
@@ -923,9 +992,8 @@ def decode_draft_speculative(
         # proposal is discarded)
         def dstep(carry, _):
             tok, p, dc = carry
-            x = M.embed(dcfg, dparams, tok[None, None], p)
-            x, dc = M.forward_layers(dcfg, dparams["layers"], x, dc, p)
-            nxt = jnp.argmax(M.unembed(dcfg, dparams, x)[0, 0]).astype(jnp.int32)
+            lg, dc = dfwd(tok[None, None], dc, p)
+            nxt = jnp.argmax(lg[0, 0]).astype(jnp.int32)
             return (nxt, p + 1, dc), nxt
 
         (_, _, dcache), proposals = jax.lax.scan(
@@ -935,11 +1003,8 @@ def decode_draft_speculative(
 
         # --- one target forward over [current, draft] at pos
         tokens_in = jnp.concatenate([cur[None], draft])[None, :]  # [1, 1+G]
-        x = M.embed(cfg, params, tokens_in, pos)
-        x, cache = M.forward_layers(cfg, params["layers"], x, cache, pos)
-        window = jnp.argmax(M.unembed(cfg, params, x)[0], axis=-1).astype(
-            jnp.int32
-        )  # [1+G]
+        logits, cache = fwd(tokens_in, cache, pos)
+        window = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)  # [1+G]
 
         # --- accept matched prefix + correction (identical emit logic to
         # decode_speculative)

@@ -623,6 +623,124 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
+    @property
+    def supports_speculative(self) -> bool:
+        """Prompt-lookup speculation on the pp ring: one T=1+g verify
+        forward costs the same S microsteps as a single token, so g
+        accepted tokens amortize the batch-1 ring bubble g-fold — the
+        speculation win is LARGER on a pipeline than on one chip. B=1
+        only, so dp must be 1 (serving engines always are)."""
+        return self.dp == 1
+
+    def decode_speculative(self, first_token, cache, hist, hist_len, limit,
+                           *, max_steps, draft_len):
+        key_ = ("spec", max_steps, draft_len)
+        fn = self._programs.get(key_)
+        if fn is None:
+            fn = self._build_speculative(max_steps, draft_len)
+            self._programs[key_] = fn
+        return fn(
+            self.shared, self.layers, first_token, cache, hist,
+            jnp.int32(hist_len), jnp.int32(limit),
+        )
+
+    def _build_speculative(self, max_steps: int, draft_len: int):
+        """engine/generate.spec_loop inside shard_map: the verify forward
+        is ring microsteps + a masked psum of the [1, 1+G, D] window +
+        vocab-shard logits; the n-gram matching / acceptance bookkeeping
+        runs replicated on every device (identical logits in, identical
+        argmaxes out)."""
+        cfg, S = self.cfg, self.pp
+        from ..engine.generate import spec_loop
+
+        def body(shared, layers, first_token, cache, hist, hist_len, limit):
+            s = jax.lax.axis_index(AXIS_PP)
+
+            def fwd(tokens_in, cache, pos):
+                x = embed_sharded(cfg, shared, tokens_in, pos, S)
+                buf, cache = self._microstep_loop(layers, x, cache, pos)
+                full = jax.lax.psum(
+                    jnp.where(s == 0, buf, jnp.zeros((), buf.dtype)), AXIS_PP
+                )
+                return unembed_sharded(cfg, shared, full, S), cache
+
+            return spec_loop(
+                cfg, fwd, first_token, cache, hist, hist_len, limit,
+                max_steps=max_steps, draft_len=draft_len,
+            )
+
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(), cache_spec(),
+                P(), P(), P(),
+            ),
+            out_specs=(P(), P(), cache_spec()),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
+    @property
+    def supports_draft(self) -> bool:
+        """Two-model draft speculation on the pp ring (dp == 1, B=1)."""
+        return self.dp == 1
+
+    def decode_draft_speculative(self, dcfg, dparams, first_token, cache,
+                                 dcache, start_pos, limit, *, max_steps,
+                                 draft_len):
+        key_ = ("draft", dcfg, max_steps, draft_len)
+        fn = self._programs.get(key_)
+        if fn is None:
+            fn = self._build_draft_speculative(dcfg, max_steps, draft_len)
+            self._programs[key_] = fn
+        return fn(
+            self.shared, self.layers, dparams, first_token, cache, dcache,
+            jnp.int32(start_pos), jnp.int32(limit),
+        )
+
+    def _build_draft_speculative(self, dcfg, max_steps: int, draft_len: int):
+        """engine/generate.draft_spec_loop inside shard_map: the target
+        verify forward is ring microsteps + masked psum + vocab-shard
+        logits; the SMALL draft model runs fully replicated on every
+        device (its params/cache enter with P() specs) — redundant
+        compute, but far cheaper than scattering a model whose point is
+        being tiny, and every device derives identical proposals."""
+        cfg, S = self.cfg, self.pp
+        from ..engine.generate import draft_spec_loop
+
+        def body(shared, layers, dparams, first_token, cache, dcache,
+                 start_pos, limit):
+            s = jax.lax.axis_index(AXIS_PP)
+
+            def fwd(tokens_in, cache, pos):
+                x = embed_sharded(cfg, shared, tokens_in, pos, S)
+                buf, cache = self._microstep_loop(layers, x, cache, pos)
+                full = jax.lax.psum(
+                    jnp.where(s == 0, buf, jnp.zeros((), buf.dtype)), AXIS_PP
+                )
+                return unembed_sharded(cfg, shared, full, S), cache
+
+            def dfwd(tok_11, dc, p):
+                x = M.embed(dcfg, dparams, tok_11, p)
+                x, dc = M.forward_layers(dcfg, dparams["layers"], x, dc, p)
+                return M.unembed(dcfg, dparams, x), dc
+
+            return draft_spec_loop(
+                cfg, fwd, dfwd, first_token, cache, dcache, start_pos,
+                limit, max_steps=max_steps, draft_len=draft_len,
+            )
+
+        # the draft's params/cache are replicated pytrees: a bare P() is a
+        # valid PYTREE PREFIX spec covering every leaf
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(), P(),
+                cache_spec(), P(), P(), P(),
+            ),
+            out_specs=(P(), P(), cache_spec(), P()),
+        )
+        return jax.jit(shmapped, donate_argnums=(4, 5))
+
     def decode_beam(self, logits0, cache, start_pos, limit, length_penalty,
                     *, max_steps, num_beams, early_stopping):
         if self.dp > 1:

@@ -29,6 +29,7 @@ def create_backend(
     params: Any = None,
     dtype: Optional[str] = None,
     quant: Optional[str] = None,
+    attn_impl: Optional[str] = None,
     seed: int = 0,
     sp_strategy: str = "ring",
     lora: Optional[str] = None,
@@ -47,6 +48,10 @@ def create_backend(
         cfg = cfg.replace(dtype=dtype)
     if quant is not None:
         cfg = cfg.replace(quant=quant)
+    if attn_impl is not None:
+        from .config import resolve_attn_impl
+
+        cfg = resolve_attn_impl(cfg, attn_impl)
     if sp_strategy != "ring" and mesh_cfg.sp <= 1:
         # fail loudly BEFORE any backend branch (including microbatches):
         # --sp-strategy ulysses without --sp > 1 would otherwise silently
@@ -122,6 +127,7 @@ def create_engine(
     params: Any = None,
     dtype: Optional[str] = None,
     quant: Optional[str] = None,
+    attn_impl: Optional[str] = None,
     tokenizer: Any = None,
     seed: int = 0,
     sp_strategy: str = "ring",
@@ -152,8 +158,8 @@ def create_engine(
         )
     cfg, backend = create_backend(
         model, mesh_cfg=mesh_cfg, microbatches=microbatches, params=params,
-        dtype=dtype, quant=quant, seed=seed, sp_strategy=sp_strategy,
-        lora=lora,
+        dtype=dtype, quant=quant, attn_impl=attn_impl, seed=seed,
+        sp_strategy=sp_strategy, lora=lora,
     )
     engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed

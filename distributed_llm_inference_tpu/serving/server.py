@@ -679,6 +679,14 @@ def main(argv: Optional[list] = None):
     ap.add_argument("--ep", type=int, default=1, help="expert-parallel width (MoE)")
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
     ap.add_argument(
+        "--attn-impl", default=None, choices=[None, "auto", "xla", "pallas"],
+        help="attention implementation: 'pallas' = the flash kernel "
+             "(ops/flash_attention.py), 'xla' = einsum + mask (XLA fuses "
+             "it), 'auto' = pallas when legal for the model AND running "
+             "on TPU (CPU interpret mode is never auto-selected); default "
+             "keeps the model config's setting (xla)",
+    )
+    ap.add_argument(
         "--lora", default=None, metavar="DIR",
         help="PEFT-format LoRA adapter directory to merge into the base "
              "weights at load (W + alpha/r * BA; before quantization)",
@@ -688,7 +696,8 @@ def main(argv: Optional[list] = None):
         help="attach a smaller same-tokenizer model as a speculative "
              "draft: greedy requests with \"speculative\": true verify "
              "the draft's proposals (several tokens per target forward "
-             "on text the draft predicts well; single-device backend)",
+             "on text the draft predicts well; single chip or a pp mesh "
+             "— the ring runs the draft replicated)",
     )
     ap.add_argument(
         "--quant", default=None, choices=[None, "int8", "int4"],
@@ -831,6 +840,7 @@ def main(argv: Optional[list] = None):
         params=params,
         dtype=dtype,
         quant=args.quant,
+        attn_impl=args.attn_impl,
         tokenizer=tokenizer,
         seed=args.seed,
         sp_strategy=args.sp_strategy,

@@ -110,3 +110,38 @@ def test_repetition_penalty_with_bias_pp(engines):
     a = sd.generate(PROMPT, **kw)
     b = pp.generate(PROMPT, **kw)
     assert a["response"] == b["response"]
+
+
+def test_speculative_pp_matches_plain_greedy(engines):
+    """Prompt-lookup speculation on the pp ring: every emitted token is
+    still the argmax — exact vs plain greedy in fp32, and identical to
+    the single-device speculative path."""
+    sd, pp = engines
+    plain = sd.generate(PROMPT, max_tokens=8, greedy=True, chat=False)
+    a = sd.generate(PROMPT, max_tokens=8, greedy=True, chat=False,
+                    speculative=True)
+    b = pp.generate(PROMPT, max_tokens=8, greedy=True, chat=False,
+                    speculative=True)
+    assert a["response"] == plain["response"]
+    assert b["response"] == plain["response"]
+
+
+def test_draft_speculative_pp_matches_plain_greedy(engines):
+    """Two-model draft speculation on the pp ring (replicated draft)."""
+    import jax as _jax
+
+    from distributed_llm_inference_tpu import get_model_config
+
+    sd, pp = engines
+    dcfg = get_model_config("test-llama-tiny", eos_token_id=-1)
+    dparams = M.init_params(dcfg, _jax.random.PRNGKey(77))
+    pp.set_draft(dcfg, dparams)
+    try:
+        plain = sd.generate(PROMPT, max_tokens=8, greedy=True, chat=False)
+        r = pp.generate(PROMPT, max_tokens=8, greedy=True, chat=False,
+                        speculative=True)
+        assert r["status"] == "success"
+        assert r["response"] == plain["response"]
+    finally:
+        pp._draft = None
+        pp._draft_cache = None
