@@ -221,13 +221,23 @@ def run_benchmark():
     )
     fetch(n_gen)
 
-    # TTFT: one prefill (cache re-init enqueued first), scalar-fetch the token
-    def prefill_once():
-        c = M.init_kv_cache(cfg, 1, max_seq=512)
-        f, _, c = G.prefill(cfg, params, tokens, plen, c, kp, sampling)
+    # TTFT: K back-to-back prefills (each re-initing its cache) ending in
+    # ONE scalar fetch, divided by K — chaining amortizes the tunnel RTT
+    # to 1/K instead of subtracting it raw, which on a ~70 ms-RTT tunnel
+    # swallowed the ~9 ms prefill entirely and reported ttft_s: 0.0.
+    KP = 4 if on_tpu else 1
+
+    def prefill_chain():
+        f = None
+        for _ in range(KP):
+            c = M.init_kv_cache(cfg, 1, max_seq=512)
+            f, _, c = G.prefill(cfg, params, tokens, plen, c, kp, sampling)
         fetch(f)
 
-    ttft = max(min(_timed(prefill_once)[0] for _ in range(3)) - rtt, 0.0)
+    prefill_chain()  # warm (compile already done above; drain queue)
+    ttft = max(
+        (min(_timed(prefill_chain)[0] for _ in range(3)) - rtt) / KP, 0.0
+    )
     # prefill is the COMPUTE-bound phase (decode is HBM-bound): its MFU
     # judges how well the big batched matmuls land on the MXU
     prefill_tok_s = PROMPT_LEN / ttft if ttft > 0 else None
@@ -381,15 +391,24 @@ def run_benchmark():
             fplen = jnp.int32(FLASH_LEN)
 
             def time_prefill(c):
-                def once():
-                    cf = M.init_kv_cache(c, 1, max_seq=FLASH_LEN + 8)
-                    ff, _, cf = G.prefill(
-                        c, params, long_tokens, fplen, cf, kp, sampling
-                    )
+                # K chained prefills, one fetch: RTT amortizes to 1/K
+                # (raw subtraction let RTT jitter swallow the ~10 ms
+                # prefill and report a physically-impossible tok/s)
+                KF = 4
+
+                def run():
+                    ff = None
+                    for _ in range(KF):
+                        cf = M.init_kv_cache(c, 1, max_seq=FLASH_LEN + 8)
+                        ff, _, cf = G.prefill(
+                            c, params, long_tokens, fplen, cf, kp, sampling
+                        )
                     fetch(ff)
 
-                once()  # warm/compile
-                t = max(min(_timed(once)[0] for _ in range(3)) - rtt, 1e-9)
+                run()  # warm/compile
+                t = max(
+                    (min(_timed(run)[0] for _ in range(3)) - rtt) / KF, 1e-9
+                )
                 return FLASH_LEN / t
 
             flash_xla_tok_s = time_prefill(cfg)

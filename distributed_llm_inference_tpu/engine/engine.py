@@ -263,7 +263,7 @@ class InferenceEngine:
         if not getattr(self.backend, "supports_draft", False):
             raise ValueError(
                 f"backend {self.backend.name!r} does not support draft-model "
-                f"speculation; serve on the single-device backend"
+                f"speculation; serve on the single-device or pipeline backend"
             )
         self._draft = (dcfg, dparams)
         self._draft_cache = None
