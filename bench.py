@@ -393,8 +393,9 @@ def run_benchmark():
             def time_prefill(c):
                 # K chained prefills, one fetch: RTT amortizes to 1/K
                 # (raw subtraction let RTT jitter swallow the ~10 ms
-                # prefill and report a physically-impossible tok/s)
-                KF = 4
+                # prefill and report a physically-impossible tok/s).
+                # No chaining off-TPU: there is no tunnel RTT to amortize
+                KF = 4 if on_tpu else 1
 
                 def run():
                     ff = None

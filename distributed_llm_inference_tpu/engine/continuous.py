@@ -49,13 +49,17 @@ from . import generate as G
 
 log = get_logger("continuous")
 
+# _admit_one sentinel: the paged pool has no blocks for this request right
+# now — requeue it (front) and retry after the next release
+_BLOCKED = object()
+
 
 class _Request:
     __slots__ = (
         "prompt", "kwargs", "done", "result", "t_start", "ttft",
         "first_id", "tokens", "slot", "enqueued", "budget",
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
-        "cancelled", "prompt_tokens",
+        "cancelled", "prompt_tokens", "block_ids", "need",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None):
@@ -78,6 +82,8 @@ class _Request:
         self.prefix_hit_tokens = 0  # prompt tokens served from the prefix cache
         self.cancelled = False  # client went away; free the slot early
         self.prompt_tokens = 0  # set at admission (tokenized prompt length)
+        self.block_ids = None  # paged mode: this request's pool blocks
+        self.need = None  # paged mode: blocks required (set on 1st attempt)
 
 
 class ContinuousEngine:
@@ -96,6 +102,8 @@ class ContinuousEngine:
         max_queue: int = 64,
         chunk_lag: int = 2,
         slot_max_seq: Optional[int] = None,
+        kv_pool_blocks: Optional[int] = None,
+        kv_block_size: int = 16,
     ):
         cfg = engine.cfg
         if cfg.arch not in ("llama", "gpt2"):
@@ -144,11 +152,54 @@ class ContinuousEngine:
                 f"smallest prefill bucket {buckets[0]}; raise it or shrink "
                 f"engine_cfg.prefill_buckets"
             )
-        self.cache = self.backend.init_cache(self.n_slots, self.slot_max_seq)
+        # Block-paged KV (engine/paged.py): fleet memory becomes a function
+        # of the POOL (aggregate in-flight tokens), not n_slots x window —
+        # the round-2 "n_slots x max_seq pinned HBM" review item's stretch
+        # goal. Admission allocates blocks, release frees them, and a
+        # request that can't get blocks waits in the queue (backpressure).
+        self.paged = kv_pool_blocks is not None
+        if self.paged:
+            if not getattr(engine.backend, "supports_paged", False):
+                raise ValueError(
+                    f"backend {engine.backend.name!r} does not support "
+                    f"paged KV (llama-family single-device only); drop "
+                    f"kv_pool_blocks or use the dense fleet"
+                )
+            from . import paged as P
+
+            self._P = P
+            self.kv_block_size = int(kv_block_size)
+            if self.kv_block_size < 1:
+                raise ValueError("kv_block_size must be >= 1")
+            # logical blocks per slot; scratch rounds up to a whole number
+            # of blocks so the insert scatter is an exact block reshape
+            self._max_blocks = -(-self.slot_max_seq // self.kv_block_size)
+            self._scratch_seq = self._max_blocks * self.kv_block_size
+            if int(kv_pool_blocks) - 1 < self._max_blocks:
+                raise ValueError(
+                    f"kv_pool_blocks={kv_pool_blocks} cannot hold one "
+                    f"full slot-class request ({self._max_blocks} blocks "
+                    f"of {self.kv_block_size} + the trash block); raise it "
+                    f"or shrink slot_max_seq"
+                )
+            self.cache = self.backend.init_paged_pool(
+                int(kv_pool_blocks), self.kv_block_size
+            )
+            self._alloc = P.BlockAllocator(int(kv_pool_blocks))
+            # host-side block tables; device copy rebuilt lazily on change
+            self._table = np.zeros(
+                (self.n_slots, self._max_blocks), np.int32
+            )
+            self._table_dev = None
+        else:
+            self._scratch_seq = self.slot_max_seq
+            self.cache = self.backend.init_cache(
+                self.n_slots, self.slot_max_seq
+            )
         self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
-        # scratch must match the fleet's max_seq: insert_slot splices the
-        # whole row
-        self._scratch = self.backend.init_cache(1, self.slot_max_seq)
+        # scratch must match the fleet's logical extent: the insert splices
+        # the whole row (dense) / scatters every logical block (paged)
+        self._scratch = self.backend.init_cache(1, self._scratch_seq)
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
         # Own PrefixCache instance (engine/prefix.py), NOT shared with the
         # solo engine's: the solo path touches its cache under the engine
@@ -366,6 +417,12 @@ class ContinuousEngine:
                 "peak_occupancy": self.peak_occupancy,
                 "chunk_steps": self.chunk_steps,
             }
+        if self.paged:
+            out["paged"] = {
+                "block_size": self.kv_block_size,
+                "pool_blocks": self._alloc.n_blocks,
+                "free_blocks": self._alloc.free_blocks,
+            }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
         return out
@@ -417,10 +474,23 @@ class ContinuousEngine:
                 self._admit()
             launched = False
             if any(r is not None for r in self._assignment):
-                emitted, mask, self.state, self.cache = self.backend.decode_slots(
-                    self.state, self.cache, self._next_key(), self.sparams,
-                    num_steps=self.chunk_steps,
-                )
+                if self.paged:
+                    if self._table_dev is None:
+                        self._table_dev = jnp.asarray(self._table)
+                    emitted, mask, self.state, self.cache = (
+                        self.backend.decode_slots_paged(
+                            self.state, self.cache, self._table_dev,
+                            self._next_key(), self.sparams,
+                            num_steps=self.chunk_steps,
+                        )
+                    )
+                else:
+                    emitted, mask, self.state, self.cache = (
+                        self.backend.decode_slots(
+                            self.state, self.cache, self._next_key(),
+                            self.sparams, num_steps=self.chunk_steps,
+                        )
+                    )
                 packed = G.pack_chunk(emitted, mask, self.state.active)
                 inflight.append((packed, list(self._assignment)))
                 launched = True
@@ -450,9 +520,25 @@ class ContinuousEngine:
                 free = [b for b, r in enumerate(self._assignment) if r is None]
                 if not free:
                     break
+                if (
+                    self.paged
+                    and self._queue[0].need is not None
+                    and self._queue[0].need > self._alloc.free_blocks
+                ):
+                    # a prior attempt already sized this request and the
+                    # pool still can't take it — don't re-tokenize/replan
+                    # on every chunk iteration; wait for a release
+                    break
                 req = self._queue.pop(0)
             try:
                 first_dev = self._admit_one(req, free[0])
+                if first_dev is _BLOCKED:
+                    # paged pool exhausted: requeue at the FRONT (FIFO
+                    # fairness) and stop admitting until a release frees
+                    # blocks — the fleet keeps decoding meanwhile
+                    with self._cv:
+                        self._queue.insert(0, req)
+                    break
                 if first_dev is not None:  # None: failed fast (e.g. queued
                     wave.append((req, first_dev))  # past deadline), result set
             except ValueError as e:
@@ -482,6 +568,17 @@ class ContinuousEngine:
 
     def _admit_one(self, req: _Request, slot: int):
         eng, cfg = self.engine, self.cfg
+        if req.cancelled:
+            # a _BLOCKED requeue can carry a request whose client already
+            # went away (stream teardown races the pop) — drop it here
+            # instead of letting it head-of-line-block the queue and then
+            # burn pool blocks + a prefill on a dead request
+            req.result = {
+                "error": "Error: request cancelled", "status": "failed",
+                "error_type": "cancelled",
+            }
+            self._push_final(req)
+            return None
         deadline = eng.engine_cfg.request_deadline_s
         if deadline and time.time() - req.enqueued > deadline:
             req.result = {
@@ -514,6 +611,17 @@ class ContinuousEngine:
             prompt_len, int(k.get("max_tokens", 20)),
             capacity=self.slot_max_seq,
         )
+        table_row = None
+        if self.paged:
+            req.need = self._P.blocks_needed(
+                prompt_len, max_tokens, self.kv_block_size
+            )
+            blk_ids = self._alloc.alloc(req.need)
+            if blk_ids is None:
+                return _BLOCKED  # pool exhausted; caller requeues at front
+            req.block_ids = blk_ids
+            table_row = np.zeros((self._max_blocks,), np.int32)
+            table_row[: len(blk_ids)] = blk_ids  # tail stays at trash
         sampling = G.default_sampling(
             k.get("temperature", 0.7), k.get("top_k", 50),
             k.get("top_p", 0.9), k.get("greedy", False),
@@ -544,20 +652,43 @@ class ContinuousEngine:
                 presence[0] if presence is not None
                 else jnp.zeros((cfg.vocab_size,), bool)
             )
-            self.cache, self.state, self.sparams = G.insert_slot(
-                cfg, self.cache, scratch, self.state, self.sparams, slot,
+            # one arming-argument tuple for both modes (the dense and
+            # paged inserts share generate.arm_slot; sharing the argument
+            # list here keeps the call sites from drifting either)
+            arm = (
                 first[0], jnp.int32(prompt_len), jnp.int32(max_tokens),
                 sampling.temperature, sampling.top_k, sampling.top_p,
                 sampling.greedy, sampling.min_p, sampling.rep_penalty,
                 presence_row,
             )
+            if self.paged:
+                self.cache, self.state, self.sparams = (
+                    self.backend.insert_slot_paged(
+                        self.cache, scratch, self.state, self.sparams, slot,
+                        jnp.asarray(table_row), *arm,
+                    )
+                )
+                self._table[slot] = table_row
+                self._table_dev = None  # rebuilt at the next chunk launch
+            else:
+                self.cache, self.state, self.sparams = G.insert_slot(
+                    cfg, self.cache, scratch, self.state, self.sparams, slot,
+                    *arm,
+                )
             self._scratch = scratch
+        except BaseException:
+            if req.block_ids is not None:
+                # admission died after the block grant (failed prefill,
+                # device error): return the blocks or the pool leaks
+                self._alloc.free(req.block_ids)
+                req.block_ids = None
+            raise
         finally:
             if self._scratch is None:
                 # a failed extend/prefill may have consumed (donated) the
                 # scratch buffer mid-sequence; a permanently-None scratch
                 # would fail every later admission — reallocate
-                self._scratch = self.backend.init_cache(1, self.slot_max_seq)
+                self._scratch = self.backend.init_cache(1, self._scratch_seq)
         req.slot = slot
         with self._cv:
             self._assignment[slot] = req
@@ -681,6 +812,20 @@ class ContinuousEngine:
         self._release(req)
 
     def _release(self, req: _Request):
+        if self.paged and req.block_ids is not None:
+            # Worker-thread-only mutation (like all allocator use). The
+            # freed blocks may be re-granted before in-flight chunks
+            # drain: safe, because device execution is serialized in
+            # launch order and the new tenant's insert scatter overwrites
+            # its whole logical extent before any later decode chunk —
+            # and this slot's table row reverts to trash at the next
+            # table rebuild, so its frozen row can't touch the old
+            # blocks in any chunk launched after this point.
+            self._alloc.free(req.block_ids)
+            req.block_ids = None
+            if req.slot is not None:
+                self._table[req.slot] = 0
+                self._table_dev = None
         with self._cv:
             if req.slot is not None and self._assignment[req.slot] is req:
                 self._assignment[req.slot] = None

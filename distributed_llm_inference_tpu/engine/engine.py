@@ -150,6 +150,35 @@ class SingleDeviceBackend:
             num_steps=num_steps,
         )
 
+    # block-paged KV for the continuous fleet (engine/paged.py): pool +
+    # block tables instead of n_slots x max_seq dense rows. Llama-family
+    # only (the attn_hook seam lives in llama.decoder_layer).
+    @property
+    def supports_paged(self):
+        return self.cfg.arch == "llama"
+
+    def init_paged_pool(self, n_blocks, block_size):
+        from . import paged as P
+
+        return P.init_pool(self.cfg, n_blocks, block_size)
+
+    def insert_slot_paged(self, pool, scratch, state, sparams, slot,
+                          table_row, *args):
+        from . import paged as P
+
+        return P.insert_slot_paged(
+            self.cfg, pool, scratch, state, sparams, slot, table_row, *args
+        )
+
+    def decode_slots_paged(self, state, pool, table, key, sparams, *,
+                           num_steps):
+        from . import paged as P
+
+        return P.decode_slots_paged(
+            self.cfg, self.params, state, pool, table, key, sparams,
+            num_steps=num_steps,
+        )
+
     def decode_speculative(self, first_token, cache, hist, hist_len, limit,
                            *, max_steps, draft_len):
         return G.decode_speculative(

@@ -417,15 +417,29 @@ def insert_slot(
     request; the tunnel RTT dominates the loop otherwise).
     """
     slot = jnp.int32(slot)
-    budget = jnp.where(
-        stop_mask(cfg, first_token), jnp.int32(0), jnp.maximum(max_tokens - 1, 0)
-    )
 
     def splice(big, small):
         start = (jnp.int32(0), slot) + (jnp.int32(0),) * (big.ndim - 2)
         return jax.lax.dynamic_update_slice(big, small, start)
 
     cache = jax.tree.map(splice, cache, scratch)
+    state, sparams = arm_slot(
+        cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
+        temperature, top_k, top_p, greedy, min_p, rep_penalty, presence_row,
+    )
+    return cache, state, sparams
+
+
+def arm_slot(cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
+             temperature, top_k, top_p, greedy, min_p, rep_penalty,
+             presence_row):
+    """Arm slot row `slot`'s decode state + sampling knobs after its prompt
+    K/V landed. ONE copy of the budget / EOS-on-first / presence arming —
+    insert_slot (dense fleet) and engine/paged.insert_slot_paged (block
+    pool) both call this, so the admission semantics can't drift."""
+    budget = jnp.where(
+        stop_mask(cfg, first_token), jnp.int32(0), jnp.maximum(max_tokens - 1, 0)
+    )
     # presence_row [V]: the prompt's token-id set + the first token
     # (host-built) — the slot's repetition-penalty state
     presence_row = presence_row | (
@@ -446,7 +460,7 @@ def insert_slot(
         min_p=sparams.min_p.at[slot].set(min_p),
         rep_penalty=sparams.rep_penalty.at[slot].set(rep_penalty),
     )
-    return cache, state, sparams
+    return state, sparams
 
 
 @jax.jit

@@ -1,0 +1,252 @@
+"""Block-paged KV cache for continuous batching (vLLM-style, TPU-first).
+
+The dense slot fleet (engine/continuous.py) pins `n_slots x slot_max_seq`
+of KV in HBM for the server's lifetime — every slot pays for the worst
+case even when typical requests use a fraction of the window. Here KV
+lives in a shared pool of fixed-size blocks:
+
+    pool k/v [L, n_blocks, KV, block_size, Dh]
+
+and each slot's logical sequence is a *block table* — an int32 row mapping
+logical block j to a physical pool block. Admission allocates exactly
+ceil((prompt_len + max_tokens) / block_size) blocks from a host-side free
+list; release returns them. Fleet memory is a function of the POOL size
+(aggregate tokens actually in flight), not n_slots x window, and the pool
+naturally backpressures: a request that cannot get blocks waits in the
+queue until a running request completes.
+
+TPU/XLA design notes (why this shape, not a translation of vLLM's CUDA
+paged attention):
+  * Static shapes everywhere: every table is a fixed [B, max_blocks]
+    int32 array (unused tail entries point at a reserved TRASH block);
+    the decode program is compiled once per (n_slots, num_steps), exactly
+    like the dense fleet.
+  * The per-step attention GATHERS the slot's blocks into a contiguous
+    [B, KV, max_blocks*bs, Dh] view and runs the stock masked attention.
+    The gather reads the same bytes a dense cache read would, plus one
+    materialization (~+2 x cache-bytes/step of HBM traffic vs dense while
+    weight streaming still dominates at small batch). A fused Pallas
+    paged-attention kernel can replace the hook later without touching
+    the engine - the seam is `decoder_layer(attn_hook=...)`.
+  * Writes are scatters: token K/V lands at
+    pool[table[b, pos_b // bs], :, pos_b % bs] per slot row b. Distinct
+    live slots never share a block, so scatter indices never collide
+    (the shared trash block only ever receives writes from slots whose
+    position has run past their budget — masked garbage, never attended;
+    the same stale-region argument as the dense fleet's).
+
+Paged mode is llama-family only (the hook seam lives in
+models/llama.decoder_layer; gpt2's learned-position block doesn't expose
+it) and single-device only for now — the pp fleet keeps the dense layout,
+whose per-stage shards are what the ring schedule wants anyway.
+
+Reference contrast: /root/reference has no KV cache at all
+(Worker1.py:132-134 — full-sequence recompute per token); this module is
+north-star scope (serving HBM discipline), not parity scope.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from ..models import llama
+from ..ops.attention import attend
+from . import generate as G
+
+TRASH_BLOCK = 0  # reserved pool block: write-only spill for table tails
+
+
+def init_pool(cfg: ModelConfig, n_blocks: int, block_size: int):
+    """Zeroed block pool, stacked on the layer axis like the dense cache.
+    Block 0 is the reserved trash block (never allocated to a slot)."""
+    shape = (
+        cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim
+    )
+    dt = cfg.jnp_dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+class BlockAllocator:
+    """Host-side free list over pool blocks 1..n_blocks-1 (0 is trash).
+
+    Not thread-safe by itself — the continuous engine calls it only from
+    its single worker thread (admission/release), matching the engine's
+    single-owner design.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 2:
+            raise ValueError("pool needs >= 2 blocks (one is the trash block)")
+        self.n_blocks = n_blocks
+        self._free = list(range(1, n_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[list]:
+        """n blocks or None (caller keeps the request queued)."""
+        if n > len(self._free):
+            return None
+        out = self._free[:n]
+        del self._free[:n]
+        return out
+
+    def free(self, ids: list):
+        self._free.extend(ids)
+
+
+def blocks_needed(prompt_len: int, max_tokens: int, block_size: int) -> int:
+    """Physical blocks a request occupies: prompt positions plus decode
+    writes (the last emitted token's K/V is never written, but the frozen
+    inactive row keeps re-writing at its final position — bound by
+    prompt_len + max_tokens)."""
+    return -(-(prompt_len + max_tokens) // block_size)
+
+
+def make_paged_hook(table: jnp.ndarray):
+    """attn_hook for models/llama.decoder_layer over a paged pool.
+
+    table: [B, max_blocks] int32 physical block ids. The hook sees this
+    layer's pool slice (cache_k/v [N, KV, bs, Dh], the layer axis unstacked
+    by forward_layers' scan) and per-row positions pos [B]; the chunk is
+    always T=1 (decode — prefill runs on a contiguous scratch cache and is
+    spliced in by insert_slot_paged).
+    """
+
+    def hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
+             valid_start):
+        del update_gate, valid_start  # single-device decode only
+        B, T, H, Dh = q.shape
+        assert T == 1, "paged hook serves decode steps (T=1) only"
+        bs = cache_k.shape[2]
+        MB = table.shape[1]
+        # Write: token K/V -> pool[table[b, pos_b//bs], :, pos_b%bs].
+        # The lblk clamp is the overrun guard: an inactive slot's frozen
+        # row keeps forwarding its pad token and its pos can sit one past
+        # the budget — the clamped write lands garbage in the slot's OWN
+        # last block at a position only its own (masked, discarded) rows
+        # ever attend. Same argument as the dense fleet's
+        # dynamic_update_slice clamp (ops/attention.update_kv_cache_slots).
+        lblk = jnp.minimum(pos // bs, MB - 1)  # [B]
+        blk = jnp.take_along_axis(table, lblk[:, None], axis=1)[:, 0]  # [B]
+        off = pos % bs
+        new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
+        new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
+        # Gather the whole table -> contiguous per-slot view. Each gathered
+        # slab is a [KV, bs, Dh] contiguous run of HBM; stale content at
+        # logical positions > pos[b] (trash block included) is masked by
+        # the slot causal mask, which forward_layers built to the LOGICAL
+        # length MB*bs via attn_seq_len.
+        gk = new_k[table]  # [B, MB, KV, bs, Dh]
+        gv = new_v[table]
+        gk = gk.transpose(0, 2, 1, 3, 4).reshape(B, cache_k.shape[1], MB * bs, Dh)
+        gv = gv.transpose(0, 2, 1, 3, 4).reshape(B, cache_v.shape[1], MB * bs, Dh)
+        attn = attend(
+            q, gk, gv, mask, scale=cfg.query_scale, softcap=cfg.attn_softcap
+        )
+        return attn, new_k, new_v
+
+    return hook
+
+
+def _forward_step_paged(cfg, params, tokens, pool, table, pos):
+    """One decode step through the stack over the paged pool."""
+    bs = pool["k"].shape[3]
+    MB = table.shape[1]
+    x = llama.embed(cfg, params, tokens, pos)
+    x, pool = llama.forward_layers(
+        cfg, params["layers"], x, pool, pos,
+        attn_hook=make_paged_hook(table), attn_seq_len=MB * bs,
+    )
+    logits = llama.unembed(cfg, params, x[:, -1:, :])
+    return logits[:, 0, :], pool
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_steps"), donate_argnames=("pool",)
+)
+def decode_slots_paged(
+    cfg: ModelConfig,
+    params,
+    state: G.SlotState,
+    pool,
+    table: jnp.ndarray,
+    key,
+    sparams: G.SlotParams,
+    *,
+    num_steps: int,
+):
+    """Paged twin of generate.decode_slots: advance every slot num_steps
+    tokens over the block pool. Same slot_step, same emitted/emit_mask
+    contract — only the cache strategy differs, so cross-mode token parity
+    is structural. The table is a plain (traced) input: admission changes
+    it without recompiling."""
+
+    def body(carry, sub):
+        state, pool = carry
+        logits, pool = _forward_step_paged(
+            cfg, params, state.token[:, None], pool, table, state.pos
+        )
+        new, emit, can_emit = G.slot_step(cfg, state, sparams, logits, sub)
+        return (new, pool), (emit, can_emit)
+
+    subs = jax.random.split(key, num_steps)
+    (state, pool), (emitted, emit_mask) = jax.lax.scan(
+        body, (state, pool), subs
+    )
+    return emitted, emit_mask, state, pool
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def insert_slot_paged(
+    cfg: ModelConfig,
+    pool,
+    scratch,
+    state: G.SlotState,
+    sparams: G.SlotParams,
+    slot,
+    table_row: jnp.ndarray,
+    first_token,
+    prompt_len,
+    max_tokens,
+    temperature,
+    top_k,
+    top_p,
+    greedy,
+    min_p,
+    rep_penalty,
+    presence_row,
+):
+    """Scatter a freshly prefilled CONTIGUOUS scratch cache (batch=1,
+    max_seq = max_blocks*bs) into the slot's pool blocks and arm its state
+    (generate.arm_slot — shared with the dense fleet).
+
+    table_row: [max_blocks] int32 — the slot's physical blocks; tail
+    entries past the allocation point at the trash block, whose colliding
+    writes are write-only garbage (positions there are beyond every
+    owner's budget). One compiled program per prompt bucket is avoided the
+    same way insert_slot does it: the WHOLE scratch row is scattered, and
+    stale high blocks are never attended.
+    """
+    slot = jnp.int32(slot)
+
+    def scatter(pl, sc):
+        # sc [L, 1, KV, S, Dh] -> [L, MB, KV, bs, Dh] block view
+        L, _, KV, S, Dh = sc.shape
+        bs = pl.shape[3]
+        MB = S // bs
+        blocks = sc[:, 0].reshape(L, KV, MB, bs, Dh).transpose(0, 2, 1, 3, 4)
+        return pl.at[:, table_row].set(blocks)
+
+    pool = jax.tree.map(scatter, pool, scratch)
+    state, sparams = G.arm_slot(
+        cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
+        temperature, top_k, top_p, greedy, min_p, rep_penalty, presence_row,
+    )
+    return pool, state, sparams

@@ -348,6 +348,7 @@ def forward_layers(
     attn_hook=None,
     valid_start: Optional[jnp.ndarray] = None,
     ep_axis: Optional[str] = None,
+    attn_seq_len: Optional[int] = None,
 ):
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
@@ -358,9 +359,13 @@ def forward_layers(
     Returns (x, new_cache). attn_hook: see decoder_layer.
     valid_start: optional [B] int32 — first REAL slot per row for ragged
     left-padded batches (slots before it are pad and never attended).
+    attn_seq_len: mask sequence length override — the paged-KV hook
+    (engine/paged.py) attends a GATHERED [B, KV, n_blocks*bs, Dh] view
+    whose logical length is not the cache leaf's seq axis (that axis is
+    the block size there), so masks must be built to the logical length.
     """
     T = x.shape[1]
-    S = cache["k"].shape[3]
+    S = attn_seq_len if attn_seq_len is not None else cache["k"].shape[3]
     pos = jnp.asarray(pos, jnp.int32)
     if pos.ndim == 1:
         positions = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B, T]

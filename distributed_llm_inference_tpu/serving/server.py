@@ -754,6 +754,17 @@ def main(argv: Optional[list] = None):
              "~8.5 GB bf16 before weights",
     )
     ap.add_argument(
+        "--kv-pool-blocks", type=int, default=None, metavar="N",
+        help="block-paged KV for --continuous (llama family, single chip): "
+             "a shared pool of N blocks replaces the dense SLOTS x max-seq "
+             "fleet — HBM is a function of aggregate in-flight tokens and "
+             "admission backpressures on pool exhaustion (engine/paged.py)",
+    )
+    ap.add_argument(
+        "--kv-block-size", type=int, default=16,
+        help="tokens per KV pool block (with --kv-pool-blocks)",
+    )
+    ap.add_argument(
         "--continuous-lag", type=int, default=2,
         help="decode chunks in flight before blocking on the oldest "
              "fetch (>1 hides a device-fetch RTT larger than a chunk's "
@@ -885,12 +896,16 @@ def main(argv: Optional[list] = None):
             "--continuous and --queue are mutually exclusive: in-flight "
             "batching already provides bounded admission + batching"
         )
+    if args.kv_pool_blocks is not None and args.continuous <= 0:
+        raise SystemExit("--kv-pool-blocks requires --continuous")
     if args.continuous > 0:
         from ..engine.continuous import ContinuousEngine
 
         continuous = ContinuousEngine(
             engine, n_slots=args.continuous, chunk_steps=args.continuous_chunk,
             chunk_lag=args.continuous_lag, slot_max_seq=args.continuous_max_seq,
+            kv_pool_blocks=args.kv_pool_blocks,
+            kv_block_size=args.kv_block_size,
         )
         if args.warmup:
             w = continuous.warmup()

@@ -1,0 +1,214 @@
+"""Block-paged KV cache (engine/paged.py) tests.
+
+The bar: paged mode is a MEMORY strategy, not a semantics change — every
+token stream must be bit-identical to the dense fleet's (greedy, fp32),
+while fleet HBM becomes a function of the pool and admission backpressures
+on pool exhaustion instead of over-allocating.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.engine import paged as P
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+
+PROMPTS = [
+    "the quick brown fox",
+    "jumps over",
+    "a lazy dog while the band plays on",
+    "hello",
+]
+
+
+@pytest.fixture(scope="module")
+def solo_engine():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(
+        cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64))
+    )
+
+
+def _submit_all(cont, prompts, **kw):
+    out = [None] * len(prompts)
+
+    def run(i):
+        out[i] = cont.submit(prompts[i], greedy=True, chat=False, **kw)
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(len(prompts))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+def test_allocator():
+    a = P.BlockAllocator(8)  # 7 usable (block 0 is trash)
+    assert a.free_blocks == 7
+    ids = a.alloc(5)
+    assert len(ids) == 5 and 0 not in ids
+    assert a.alloc(3) is None  # only 2 left
+    more = a.alloc(2)
+    assert a.free_blocks == 0
+    a.free(ids)
+    assert a.free_blocks == 5
+    assert sorted(a.alloc(5)) == sorted(ids)
+    a.free(more)
+    with pytest.raises(ValueError):
+        P.BlockAllocator(1)
+
+
+def test_blocks_needed():
+    assert P.blocks_needed(8, 8, 16) == 1
+    assert P.blocks_needed(9, 8, 16) == 2
+    assert P.blocks_needed(16, 16, 16) == 2
+    assert P.blocks_needed(1, 1, 16) == 1
+
+
+def test_decode_slots_paged_matches_dense(solo_engine):
+    """Device-level: one occupied slot decoding over the block pool emits
+    the exact stream the dense fleet emits from the same prefill."""
+    eng = solo_engine
+    cfg = eng.cfg
+    backend = eng.backend
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(7)
+    tokens = jnp.asarray(
+        [[cfg.bos_token_id, 11, 12, 13, 14, 15, 16, 17]], jnp.int32
+    )
+    tokens = jnp.pad(tokens, ((0, 0), (0, 24)), constant_values=cfg.pad_token_id)
+    plen, n_slots, steps = jnp.int32(8), 4, 12
+    bs = 8
+    MB = 4  # logical window 32
+    knobs = (
+        jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), True,
+        jnp.float32(0.0), jnp.float32(1.0),
+        jnp.zeros((cfg.vocab_size,), bool),
+    )
+
+    # dense fleet
+    scratch = backend.init_cache(1, MB * bs)
+    first, _, scratch = backend.prefill(tokens, plen, scratch, key, sampling)
+    state, sparams = G.init_slots(n_slots, cfg.vocab_size)
+    cache = backend.init_cache(n_slots, MB * bs)
+    cache, state, sparams = G.insert_slot(
+        cfg, cache, scratch, state, sparams, 1, first[0], plen,
+        jnp.int32(steps + 1), *knobs,
+    )
+    em_d, mask_d, state_d, _ = G.decode_slots(
+        cfg, backend.params, state, cache, jax.random.PRNGKey(3), sparams,
+        num_steps=steps,
+    )
+
+    # paged pool: same scratch content, scattered into blocks
+    scratch2 = backend.init_cache(1, MB * bs)
+    first2, _, scratch2 = backend.prefill(tokens, plen, scratch2, key, sampling)
+    pool = backend.init_paged_pool(2 * MB + 1, bs)
+    # non-trivial physical placement: out-of-order block ids
+    table = np.zeros((n_slots, MB), np.int32)
+    row = np.asarray([5, 2, 7, 3], np.int32)
+    table[1] = row
+    state2, sparams2 = G.init_slots(n_slots, cfg.vocab_size)
+    pool, state2, sparams2 = backend.insert_slot_paged(
+        pool, scratch2, state2, sparams2, 1, jnp.asarray(row),
+        first2[0], plen, jnp.int32(steps + 1), *knobs,
+    )
+    em_p, mask_p, state_p, _ = backend.decode_slots_paged(
+        state2, pool, jnp.asarray(table), jax.random.PRNGKey(3), sparams2,
+        num_steps=steps,
+    )
+
+    assert int(first[0]) == int(first2[0])
+    np.testing.assert_array_equal(np.asarray(mask_d), np.asarray(mask_p))
+    np.testing.assert_array_equal(
+        np.asarray(em_d)[np.asarray(mask_d)], np.asarray(em_p)[np.asarray(mask_p)]
+    )
+
+
+def test_paged_engine_matches_dense_engine(solo_engine):
+    """End-to-end: the same request mix through a paged fleet and a dense
+    fleet produces identical greedy text."""
+    dense = ContinuousEngine(
+        solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=96
+    )
+    try:
+        want = [
+            dense.submit(p, greedy=True, chat=False, max_tokens=12)
+            for p in PROMPTS
+        ]
+    finally:
+        dense.close()
+    paged = ContinuousEngine(
+        solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=96,
+        kv_pool_blocks=16, kv_block_size=16,
+    )
+    try:
+        got = _submit_all(paged, PROMPTS, max_tokens=12)
+        stats = paged.stats()
+    finally:
+        paged.close()
+    for w, g in zip(want, got):
+        assert w["status"] == g["status"] == "success"
+        assert g["response"] == w["response"]
+        assert g["tokens_generated"] == w["tokens_generated"]
+    assert stats["paged"]["pool_blocks"] == 16
+    # all blocks returned after completion
+    assert stats["paged"]["free_blocks"] == 15
+
+
+def test_pool_backpressure_and_reuse(solo_engine):
+    """A pool too small for all requests at once still serves every one:
+    admission waits for released blocks (no failure, no deadlock), and
+    freed blocks are reused across tenants with correct output."""
+    # slot class 96 tokens -> 6 blocks/slot max; pool of 8 usable blocks
+    # cannot hold two worst-case tenants at once
+    cont = ContinuousEngine(
+        solo_engine, n_slots=4, chunk_steps=4, slot_max_seq=96,
+        kv_pool_blocks=9, kv_block_size=16,
+    )
+    try:
+        solo = [
+            solo_engine.generate(p, greedy=True, chat=False, max_tokens=40)
+            for p in PROMPTS
+        ]
+        got = _submit_all(cont, PROMPTS, max_tokens=40)
+        stats = cont.stats()
+    finally:
+        cont.close()
+    for w, g in zip(solo, got):
+        assert g["status"] == "success"
+        assert g["response"] == w["response"]
+    assert stats["paged"]["free_blocks"] == 8
+
+
+def test_request_exceeding_slot_class_rejected(solo_engine):
+    cont = ContinuousEngine(
+        solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=64,
+        kv_pool_blocks=16, kv_block_size=16,
+    )
+    try:
+        out = cont.submit(
+            " ".join(f"w{i}" for i in range(80)), greedy=True, chat=False,
+            max_tokens=8,
+        )
+    finally:
+        cont.close()
+    assert out["status"] == "failed"
+    assert out["error_type"] == "invalid_request"
+
+
+def test_paged_requires_capable_backend(solo_engine):
+    with pytest.raises(ValueError, match="full slot-class"):
+        ContinuousEngine(
+            solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=96,
+            kv_pool_blocks=4, kv_block_size=16,  # < 6 blocks + trash
+        )
