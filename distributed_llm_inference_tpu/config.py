@@ -166,13 +166,15 @@ class ModelConfig:
         if self.attn_impl == "pallas" and (
             self.attn_softcap is not None
             or self.query_scale_override is not None
+            or self.attn_scale_override is not None
             or (self.attn_window is not None and self.attn_window_pattern != "all")
             or self.attn_window_layer_types is not None
         ):
             raise ValueError(
                 "attn_impl='pallas' does not support attention softcapping, "
-                "query-scale overrides, or per-layer window patterns "
-                "(Gemma-2); use attn_impl='xla'"
+                "query/attention-scale overrides (Gemma-family query "
+                "scaling, Granite attention_multiplier), or per-layer "
+                "window patterns (Gemma-2); use attn_impl='xla'"
             )
         if self.quant not in (None, "int8", "int4"):
             raise ValueError(

@@ -21,13 +21,15 @@ paged attention):
     int32 array (unused tail entries point at a reserved TRASH block);
     the decode program is compiled once per (n_slots, num_steps), exactly
     like the dense fleet.
-  * The per-step attention GATHERS the slot's blocks into a contiguous
-    [B, KV, max_blocks*bs, Dh] view and runs the stock masked attention.
-    The gather reads the same bytes a dense cache read would, plus one
-    materialization (~+2 x cache-bytes/step of HBM traffic vs dense while
-    weight streaming still dominates at small batch). A fused Pallas
-    paged-attention kernel can replace the hook later without touching
-    the engine - the seam is `decoder_layer(attn_hook=...)`.
+  * The per-step attention has two paths. attn_impl="xla": GATHER the
+    slot's blocks into a contiguous [B, KV, max_blocks*bs, Dh] view and
+    run the stock masked attention — the gather reads the same bytes a
+    dense cache read would, plus one materialization (~+2 x
+    cache-bytes/step of HBM traffic vs dense while weight streaming
+    still dominates at small batch). attn_impl="pallas": the fused
+    paged-attention kernel (ops/paged_attention.py) walks the block
+    table directly with an online softmax — one DMA per LIVE block, no
+    materialized view, dead blocks never leave HBM.
   * Writes are scatters: token K/V lands at
     pool[table[b, pos_b // bs], :, pos_b % bs] per slot row b. Distinct
     live slots never share a block, so scatter indices never collide
@@ -138,6 +140,20 @@ def make_paged_hook(table: jnp.ndarray):
         off = pos % bs
         new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
         new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
+        if cfg.attn_impl == "pallas":
+            # Fused Pallas paged attention (ops/paged_attention.py): walks
+            # the table block by block with an online softmax — no
+            # contiguous-view materialization, dead blocks never leave
+            # HBM. Legality (no softcap, no scale override, uniform-or-no
+            # window) is already enforced by ModelConfig.__post_init__,
+            # which is also why deriving the mask from pos + attn_window
+            # in-kernel is exact (the hook's `mask` carries nothing more).
+            from ..ops.paged_attention import paged_flash_attend
+
+            attn = paged_flash_attend(
+                q, new_k, new_v, table, pos, window=cfg.attn_window
+            )
+            return attn, new_k, new_v
         # Gather the whole table -> contiguous per-slot view. Each gathered
         # slab is a [KV, bs, Dh] contiguous run of HBM; stale content at
         # logical positions > pos[b] (trash block included) is masked by

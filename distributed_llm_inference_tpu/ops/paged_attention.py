@@ -1,0 +1,199 @@
+"""Pallas TPU paged-attention decode kernel over the block pool.
+
+Fused replacement for the gather-then-attend path in `engine/paged.py`:
+the XLA path materializes each slot's block table into a contiguous
+[B, KV, MB*bs, Dh] view (one extra HBM write + read of the whole logical
+window per layer per step) and then runs the masked einsum attention over
+it. Here the kernel walks the block table directly — each grid step DMAs
+ONE physical pool block [bs, Dh] into VMEM and folds it into an
+online-softmax (flash) accumulator, so
+
+  * HBM traffic is one read of the slot's LIVE blocks (dead tail blocks
+    and — with a sliding window — dead head blocks repeat their
+    neighbour's index, so Pallas skips the DMA entirely), with no
+    contiguous-view materialization at all;
+  * the pool is never reshaped/transposed: the kernel reads the same
+    [N, KV, bs, Dh] layout the scatter writes.
+
+Contract (matches `engine/paged.make_paged_hook`'s gather path):
+  * decode only — T=1 queries at per-row positions `pos` [B];
+  * mask is derived IN-KERNEL from `pos` and the static `window`:
+    row b attends logical positions max(0, pos_b-window+1) .. pos_b
+    inclusive. `config.ModelConfig.__post_init__` guarantees this is the
+    whole mask whenever attn_impl="pallas" is legal (no softcap, no
+    query-scale override, no per-layer window patterns), which is why the
+    kernel never needs the hook's materialized mask.
+  * GQA is folded into the query-row dimension exactly like
+    ops/flash_attention.py: the score matmul is [group, Dh] x [Dh, bs].
+
+The reference has no analogue at any level — it has no KV cache at all
+(/root/reference/Worker1.py:132-134); block-paged KV + this kernel are
+north-star serving scope (vLLM-class HBM discipline, re-designed for
+XLA's static shapes: the table is a plain traced input, admission never
+recompiles).
+
+On non-TPU backends the kernel runs in interpret mode (CPU test suite);
+numerics match the gather path to fp32 tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # mask fill; avoids inf-inf NaNs
+
+
+def _live_range(pos_b, *, bs: int, MB: int, window):
+    """(first, needed) logical-block bounds for a row at position pos_b:
+    blocks [first, needed) hold at least one attendable position."""
+    needed = jnp.minimum(pl.cdiv(pos_b + 1, bs), MB)
+    needed = jnp.maximum(needed, 1)  # pos < 0 never happens; keep clip sane
+    if window is None:
+        first = jnp.int32(0)
+    else:
+        first = jnp.maximum(pos_b - window + 1, 0) // bs
+        first = jnp.minimum(first, needed - 1)
+    return first, needed
+
+
+def _paged_kernel(
+    table_ref,  # scalar-prefetch [B, MB] int32
+    pos_ref,  # scalar-prefetch [B] int32
+    q_ref,  # [1, 1, 1, group, Dh] VMEM
+    k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block)
+    v_ref,  # [1, 1, bs, Dh] VMEM
+    o_ref,  # [1, 1, 1, group, Dh] VMEM
+    m_ref,  # scratch [group, 1] fp32
+    l_ref,  # scratch [group, 1] fp32
+    acc_ref,  # scratch [group, Dh] fp32
+    *,
+    bs: int,
+    MB: int,
+    group: int,
+    scale: float,
+    window: int | None,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    pos_b = pos_ref[b]
+    Dh = q_ref.shape[-1]
+    first, needed = _live_range(pos_b, bs=bs, MB=MB, window=window)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full((group, 1), _NEG, jnp.float32)
+        l_ref[:] = jnp.zeros((group, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((group, Dh), jnp.float32)
+
+    @pl.when((j >= first) & (j < needed))
+    def _():
+        q = q_ref[0, 0, 0].astype(jnp.float32) * scale  # [group, Dh]
+        ks = k_ref[0, 0].astype(jnp.float32)  # [bs, Dh]
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [group, bs]
+        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
+        mask = kv_pos <= pos_b
+        if window is not None:
+            mask &= kv_pos > pos_b - window
+        s = jnp.where(mask, s, _NEG)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)  # first block: exp(_NEG - _NEG) == 1
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        vs = v_ref[0, 0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == n_j - 1)
+    def _():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row (never in serving)
+        o_ref[0, 0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "window"))
+def paged_flash_attend(
+    q: jnp.ndarray,
+    pool_k: jnp.ndarray,
+    pool_v: jnp.ndarray,
+    table: jnp.ndarray,
+    pos: jnp.ndarray,
+    *,
+    window: int | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Paged GQA decode attention over the (already updated) block pool.
+
+    q [B,1,H,Dh]; pool_k/v [N,KV,bs,Dh] (one layer's pool slice); table
+    [B,MB] int32 physical block ids; pos [B] int32 per-row positions.
+    Returns [B,1,H,Dh] in q.dtype — same contract as the gather path in
+    engine/paged.make_paged_hook with the mask derived from pos/window.
+    """
+    B, T, H, Dh = q.shape
+    assert T == 1, "paged kernel serves decode steps (T=1) only"
+    KV, bs = pool_k.shape[1], pool_k.shape[2]
+    MB = table.shape[1]
+    group = H // KV
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    q5 = q.reshape(B, 1, KV, group, Dh)
+    table = table.astype(jnp.int32)
+    pos = pos.astype(jnp.int32)
+
+    def kv_index(b, kv, j, table_ref, pos_ref):
+        # Clamp dead logical blocks (past the causal frontier, or before
+        # a sliding window) to the nearest live one: the PHYSICAL index
+        # then repeats across consecutive dead steps, Pallas skips the
+        # DMA, and the kernel's pl.when gate skips their compute.
+        first, needed = _live_range(pos_ref[b], bs=bs, MB=MB, window=window)
+        return (table_ref[b, jnp.clip(j, first, needed - 1)], kv, 0, 0)
+
+    kernel = functools.partial(
+        _paged_kernel,
+        bs=bs,
+        MB=MB,
+        group=group,
+        scale=Dh**-0.5,
+        window=window,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, MB),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 1, group, Dh),
+                lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, bs, Dh), kv_index),
+            pl.BlockSpec((1, 1, bs, Dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, group, Dh),
+            lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, KV, group, Dh), q.dtype),
+        interpret=interpret,
+    )(table, pos, q5, pool_k, pool_v)
+    return out.reshape(B, 1, H, Dh)

@@ -129,3 +129,19 @@ def test_model_forward_pallas_ragged_batch():
     fp, lp_, op = run(cfg_x.replace(attn_impl="pallas"))
     np.testing.assert_allclose(lp_, lx, rtol=1e-4, atol=1e-4)
     assert fp.tolist() == fx.tolist() and op.tolist() == ox.tolist()
+
+
+def test_pallas_rejects_scale_overrides():
+    """Every score-scale override must reject attn_impl='pallas' loudly —
+    the kernels hardcode Dh**-0.5 (a Granite attention_multiplier that
+    slipped through would score silently wrong)."""
+    from distributed_llm_inference_tpu import get_model_config
+
+    base = get_model_config("test-llama-tiny")
+    for field, val in [
+        ("attn_softcap", 30.0),
+        ("query_scale_override", 256),
+        ("attn_scale_override", 0.0078125),
+    ]:
+        with pytest.raises(ValueError, match="pallas"):
+            base.replace(attn_impl="pallas", **{field: val})
