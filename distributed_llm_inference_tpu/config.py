@@ -119,6 +119,12 @@ class ModelConfig:
     # the single device AND the SPMD mesh backends (quantized leaves
     # shard like their weights).
     quant: Optional[str] = None
+    # KV-CACHE quantization (ops/kv_quant.py): "int8" stores K/V as int8
+    # with per-(token, head) fp32 scales — half the cache HBM, 2x the
+    # slots/context at the same budget. Llama family, dense caches only
+    # (the paged pool, flash kernels, and prefix snapshots read raw
+    # dtypes and reject the combination).
+    kv_quant: Optional[str] = None
     # Attention implementation: "xla" (einsum + full mask, fused by XLA) or
     # "pallas" (flash kernel, ops/flash_attention.py; interpret-mode on CPU).
     attn_impl: str = "xla"
@@ -179,6 +185,20 @@ class ModelConfig:
         if self.quant not in (None, "int8", "int4"):
             raise ValueError(
                 f"quant must be None, 'int8', or 'int4', got {self.quant!r}"
+            )
+        if self.kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {self.kv_quant!r}"
+            )
+        if self.kv_quant is not None and self.arch != "llama":
+            raise ValueError(
+                "kv_quant is wired for the llama family (the hook seam in "
+                "models/llama.default_attn_hook); gpt2 keeps a raw cache"
+            )
+        if self.kv_quant is not None and self.attn_impl == "pallas":
+            raise ValueError(
+                "kv_quant and attn_impl='pallas' do not compose: the flash "
+                "kernels read raw-dtype cache slabs; use attn_impl='xla'"
             )
         if self.rope_scaling not in (None, "llama3", "linear"):
             raise ValueError(
@@ -277,6 +297,13 @@ class MeshConfig:
     @property
     def n_devices(self) -> int:
         return self.dp * self.pp * self.sp * self.tp * self.ep
+
+    @property
+    def is_trivial(self) -> bool:
+        """True when every axis is 1 — the single-device topology.
+        Feature gates (e.g. kv_quant) key off this instead of
+        re-enumerating the axes, so a new axis cannot drift past them."""
+        return self.n_devices == 1
 
 
 @dataclasses.dataclass(frozen=True)

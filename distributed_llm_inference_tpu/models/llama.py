@@ -42,6 +42,11 @@ from ..ops.attention import (
     update_kv_cache_slots,
 )
 from ..ops.flash_attention import flash_attend
+from ..ops.kv_quant import KVQuant
+from ..ops.kv_quant import dequantize as kv_dequantize
+from ..ops.kv_quant import init_quant_cache
+from ..ops.kv_quant import update_cache as kv_update
+from ..ops.kv_quant import update_cache_slots as kv_update_slots
 from ..ops.norms import rms_norm
 from ..ops.quant import expert_einsum as eem
 from ..ops.quant import matmul as mm
@@ -139,6 +144,10 @@ def init_kv_cache(
     over `pp` exactly like the layer params)."""
     S = max_seq or cfg.max_seq_len
     L = n_layers if n_layers is not None else cfg.n_layers
+    if cfg.kv_quant == "int8":
+        # int8 data + per-(token, head) fp32 scales (ops/kv_quant.py);
+        # same {"k", "v"} dict shape, leaves are KVQuant pytrees
+        return init_quant_cache(L, batch, cfg.n_kv_heads, S, cfg.head_dim)
     shape = (L, batch, cfg.n_kv_heads, S, cfg.head_dim)
     dt = cfg.jnp_dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
@@ -157,7 +166,20 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     own position) — the cache write becomes a vmapped per-row update and
     attention uses the XLA path (the Pallas kernel's grid offsets assume a
     shared scalar position).
+
+    An int8 cache (ops/kv_quant.KVQuant leaves, cfg.kv_quant="int8")
+    dispatches on the leaf type: quantize-on-write, dequantize into the
+    attention matmuls on read. The fleet/solo split is the same.
     """
+    if isinstance(cache_k, KVQuant):
+        upd = kv_update_slots if pos.ndim == 1 else kv_update
+        new_k = upd(cache_k, k, pos, gate=update_gate)
+        new_v = upd(cache_v, v, pos, gate=update_gate)
+        attn = attend(
+            q, kv_dequantize(new_k), kv_dequantize(new_v), mask,
+            scale=cfg.query_scale, softcap=cfg.attn_softcap,
+        )
+        return attn, new_k, new_v
     if pos.ndim == 1:
         new_k, new_v = update_kv_cache_slots(
             cache_k, cache_v, k, v, pos, gate=update_gate

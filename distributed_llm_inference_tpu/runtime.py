@@ -29,6 +29,7 @@ def create_backend(
     params: Any = None,
     dtype: Optional[str] = None,
     quant: Optional[str] = None,
+    kv_quant: Optional[str] = None,
     attn_impl: Optional[str] = None,
     seed: int = 0,
     sp_strategy: str = "ring",
@@ -48,6 +49,18 @@ def create_backend(
         cfg = cfg.replace(dtype=dtype)
     if quant is not None:
         cfg = cfg.replace(quant=quant)
+    if kv_quant is not None:
+        cfg = cfg.replace(kv_quant=kv_quant)
+    if cfg.kv_quant is not None and not (
+        mesh_cfg.is_trivial and microbatches == 1
+    ):
+        # the SPMD backends' hooks (ring attention, gated microstep
+        # writes over shard_map) read raw-dtype cache slabs; checked
+        # before params init like the guards around it
+        raise NotImplementedError(
+            "kv_quant is wired for the single-device backend; "
+            "mesh backends keep raw-dtype caches"
+        )
     if attn_impl is not None:
         from .config import resolve_attn_impl
 
@@ -127,6 +140,7 @@ def create_engine(
     params: Any = None,
     dtype: Optional[str] = None,
     quant: Optional[str] = None,
+    kv_quant: Optional[str] = None,
     attn_impl: Optional[str] = None,
     tokenizer: Any = None,
     seed: int = 0,
@@ -158,8 +172,8 @@ def create_engine(
         )
     cfg, backend = create_backend(
         model, mesh_cfg=mesh_cfg, microbatches=microbatches, params=params,
-        dtype=dtype, quant=quant, attn_impl=attn_impl, seed=seed,
-        sp_strategy=sp_strategy, lora=lora,
+        dtype=dtype, quant=quant, kv_quant=kv_quant, attn_impl=attn_impl,
+        seed=seed, sp_strategy=sp_strategy, lora=lora,
     )
     engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed

@@ -705,6 +705,14 @@ def main(argv: Optional[list] = None):
              "(~1.6x measured decode speedup on v5e; llama family); int4 "
              "halves them again (packed nibbles, group-wise scales)",
     )
+    ap.add_argument(
+        "--kv-quant", default=None, choices=[None, "int8"],
+        help="KV-CACHE quantization: int8 K/V with per-(token, head) "
+             "scales halves cache HBM — 2x the --continuous slots or "
+             "context window at the same budget (llama family, single "
+             "chip, dense caches; excludes --kv-pool-blocks, "
+             "--prefix-cache and --attn-impl pallas)",
+    )
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
@@ -851,6 +859,7 @@ def main(argv: Optional[list] = None):
         params=params,
         dtype=dtype,
         quant=args.quant,
+        kv_quant=args.kv_quant,
         attn_impl=args.attn_impl,
         tokenizer=tokenizer,
         seed=args.seed,
