@@ -164,8 +164,9 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
 
     pos may be a PER-ROW [B] vector (continuous batching: each slot at its
     own position) — the cache write becomes a vmapped per-row update and
-    attention uses the XLA path (the Pallas kernel's grid offsets assume a
-    shared scalar position).
+    decode attention under attn_impl="pallas" runs the per-row flash
+    kernel (ops/paged_attention.flash_attend_slots; the scalar-pos flash
+    kernel's grid offsets assume one shared frontier).
 
     An int8 cache (ops/kv_quant.KVQuant leaves, cfg.kv_quant="int8")
     dispatches on the leaf type: quantize-on-write, dequantize into the
@@ -184,10 +185,21 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
         new_k, new_v = update_kv_cache_slots(
             cache_k, cache_v, k, v, pos, gate=update_gate
         )
-        attn = attend(
-            q, new_k, new_v, mask,
-            scale=cfg.query_scale, softcap=cfg.attn_softcap,
-        )
+        if cfg.attn_impl == "pallas" and q.shape[1] == 1:
+            # Per-row flash decode (ops/paged_attention.flash_attend_slots):
+            # each fleet row reads only its LIVE prefix, where the XLA
+            # path reads the whole B x S cache every step. Same legality
+            # envelope as the scalar-pos kernel (__post_init__).
+            from ..ops.paged_attention import flash_attend_slots
+
+            attn = flash_attend_slots(
+                q, new_k, new_v, pos, window=cfg.attn_window
+            )
+        else:
+            attn = attend(
+                q, new_k, new_v, mask,
+                scale=cfg.query_scale, softcap=cfg.attn_softcap,
+            )
         return attn, new_k, new_v
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
     if cfg.attn_impl == "pallas":

@@ -62,10 +62,10 @@ def _live_range(pos_b, *, bs: int, MB: int, window):
 
 
 def _paged_kernel(
-    table_ref,  # scalar-prefetch [B, MB] int32
+    table_ref,  # scalar-prefetch [B, MB] int32 (unused in the slots variant)
     pos_ref,  # scalar-prefetch [B] int32
     q_ref,  # [1, 1, 1, group, Dh] VMEM
-    k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block)
+    k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block / cache tile)
     v_ref,  # [1, 1, bs, Dh] VMEM
     o_ref,  # [1, 1, 1, group, Dh] VMEM
     m_ref,  # scratch [group, 1] fp32
@@ -77,7 +77,9 @@ def _paged_kernel(
     group: int,
     scale: float,
     window: int | None,
+    S: int | None = None,  # total positions when MB*bs overshoots (slots)
 ):
+    del table_ref  # physical placement is the index maps' concern
     b = pl.program_id(0)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
@@ -95,11 +97,21 @@ def _paged_kernel(
     def _():
         q = q_ref[0, 0, 0].astype(jnp.float32) * scale  # [group, Dh]
         ks = k_ref[0, 0].astype(jnp.float32)  # [bs, Dh]
+        vs = v_ref[0, 0].astype(jnp.float32)
+        if S is not None and S % bs != 0:
+            # ragged final tile (slots variant): BlockSpec pads past S
+            # with whatever memory holds. K-side garbage is harmless (its
+            # scores are where-replaced by _NEG below), but V-side NaNs
+            # would ride through `p @ vs` as 0 * NaN = NaN — zero them.
+            lane = jax.lax.broadcasted_iota(jnp.int32, (bs, Dh), 0)
+            vs = jnp.where(j * bs + lane < S, vs, 0.0)
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [group, bs]
         kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
         mask = kv_pos <= pos_b
+        if S is not None:
+            mask &= kv_pos < S  # ragged final tile (slots variant)
         if window is not None:
             mask &= kv_pos > pos_b - window
         s = jnp.where(mask, s, _NEG)
@@ -110,7 +122,6 @@ def _paged_kernel(
         alpha = jnp.exp(m_prev - m_new)
         m_ref[:] = m_new
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        vs = v_ref[0, 0].astype(jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -196,4 +207,93 @@ def paged_flash_attend(
         out_shape=jax.ShapeDtypeStruct((B, 1, KV, group, Dh), q.dtype),
         interpret=interpret,
     )(table, pos, q5, pool_k, pool_v)
+    return out.reshape(B, 1, H, Dh)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_k", "interpret", "window")
+)
+def flash_attend_slots(
+    q: jnp.ndarray,
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    pos: jnp.ndarray,
+    *,
+    block_k: int = 0,
+    window: int | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Per-row-position flash decode over the DENSE slot-fleet cache.
+
+    The same online-softmax walk as `paged_flash_attend` with the identity
+    layout: the fleet cache is [B, KV, S, Dh] and row b's live prefix is
+    positions 0..pos[b] (ops/attention.slot_causal_mask semantics, the
+    continuous fleet's decode mask). Tiles past each row's causal frontier
+    — or, with a sliding window, before it — clamp to the nearest live
+    tile, so Pallas skips their DMA: HBM traffic per step is each row's
+    LIVE prefix, where the XLA path reads all B*S slots of the fleet
+    cache regardless of occupancy. ops/flash_attention.flash_attend is
+    the shared-scalar-position counterpart (its grid offsets assume one
+    frontier for the whole batch; this kernel's are per-row).
+
+    q [B,1,H,Dh] (decode, T=1); cache_k/v [B,KV,S,Dh]; pos [B] int32.
+    Returns [B,1,H,Dh] in q.dtype.
+    """
+    B, T, H, Dh = q.shape
+    assert T == 1, "slots kernel serves decode steps (T=1) only"
+    KV, S = cache_k.shape[1], cache_k.shape[2]
+    group = H // KV
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_k <= 0:
+        block_k = min(S, 256)
+    MB = pl.cdiv(S, block_k)
+
+    q5 = q.reshape(B, 1, KV, group, Dh)
+    pos = pos.astype(jnp.int32)
+
+    def kv_index(b, kv, j, pos_ref):
+        first, needed = _live_range(
+            pos_ref[b], bs=block_k, MB=MB, window=window
+        )
+        return (b, kv, jnp.clip(j, first, needed - 1), 0)
+
+    kernel = functools.partial(
+        _paged_kernel,
+        None,  # no block table: the cache layout is the identity map
+        bs=block_k,
+        MB=MB,
+        group=group,
+        scale=Dh**-0.5,
+        window=window,
+        S=S,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, KV, MB),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 1, group, Dh),
+                lambda b, kv, j, pos_ref: (b, 0, kv, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, group, Dh),
+            lambda b, kv, j, pos_ref: (b, 0, kv, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, 1, KV, group, Dh), q.dtype),
+        interpret=interpret,
+    )(pos, q5, cache_k, cache_v)
     return out.reshape(B, 1, H, Dh)
