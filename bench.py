@@ -394,8 +394,8 @@ def run_benchmark():
                 # K chained prefills, one fetch: RTT amortizes to 1/K
                 # (raw subtraction let RTT jitter swallow the ~10 ms
                 # prefill and report a physically-impossible tok/s).
-                # No chaining off-TPU: there is no tunnel RTT to amortize
-                KF = 4 if on_tpu else 1
+                # This leg only runs on-TPU (the `on_tpu` fence above).
+                KF = 4
 
                 def run():
                     ff = None
@@ -414,6 +414,53 @@ def run_benchmark():
 
             flash_xla_tok_s = time_prefill(cfg)
             flash_pl_tok_s = time_prefill(cfg.replace(attn_impl="pallas"))
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
+    # fleet-decode leg: 16 slots over a 16k window at position ~1k — the
+    # over-provisioned-window case the per-row flash kernel
+    # (ops/paged_attention.flash_attend_slots) exists for. The XLA path
+    # reads the whole 16 x 16384 bf16 fleet cache every step (~5.9 GB —
+    # needs that much free HBM on top of the 2.2 GB params; dwarfs the
+    # weight stream) regardless of occupancy; the kernel reads each
+    # row's live prefix (~7% of it at these positions). Fully fenced.
+    fleet_xla_tok_s = fleet_pl_tok_s = None
+    if on_tpu and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        try:
+            FB, FS, FPOS, FSTEPS = 16, 16384, 1024, 16
+
+            def time_fleet(c):
+                state, sparams = G.init_slots(FB, c.vocab_size)
+                state = state._replace(
+                    token=jnp.full((FB,), 7, jnp.int32),
+                    pos=jnp.full((FB,), FPOS, jnp.int32),
+                    active=jnp.ones((FB,), bool),
+                    remaining=jnp.full((FB,), 1 << 20, jnp.int32),
+                )
+                st, cf = state, M.init_kv_cache(c, FB, max_seq=FS)
+
+                def run():
+                    # decode_slots donates the cache: thread it (and the
+                    # advancing state) through every chained call
+                    nonlocal st, cf
+                    for _ in range(K):
+                        _, _, st, cf = G.decode_slots(
+                            c, params, st, cf, kd, sparams,
+                            num_steps=FSTEPS,
+                        )
+                    fetch(st.pos)
+
+                run()  # warm/compile
+                t = max(
+                    min(_timed(run)[0] for _ in range(n_reps)) - rtt, 1e-9
+                ) / K
+                del cf
+                return FB * FSTEPS / t
+
+            fleet_xla_tok_s = time_fleet(cfg)
+            fleet_pl_tok_s = time_fleet(cfg.replace(attn_impl="pallas"))
         except Exception:  # noqa: BLE001 - optional leg, never fatal
             import traceback
 
@@ -486,6 +533,10 @@ def run_benchmark():
         result["prefill_xla_1k_tok_s"] = round(flash_xla_tok_s, 1)
     if flash_pl_tok_s is not None:
         result["prefill_flash_1k_tok_s"] = round(flash_pl_tok_s, 1)
+    if fleet_xla_tok_s is not None:
+        result["fleet16_16k_xla_tok_s"] = round(fleet_xla_tok_s, 1)
+    if fleet_pl_tok_s is not None:
+        result["fleet16_16k_flash_tok_s"] = round(fleet_pl_tok_s, 1)
     if int8_tok_s is not None:
         result["int8_tokens_per_sec"] = round(int8_tok_s, 3)
         if peak_bw:

@@ -19,6 +19,10 @@ from distributed_llm_inference_tpu import (
 from distributed_llm_inference_tpu.models import api as M
 from distributed_llm_inference_tpu.serving.server import InferenceServer
 
+# fast-tier exclusion: 1F1B mesh compiles; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 
 class _NumTok:
     def encode(self, text):

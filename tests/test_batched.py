@@ -80,6 +80,7 @@ def test_ragged_batch_matches_individual_runs():
     [MeshConfig(), MeshConfig(dp=1, pp=2, tp=1)],
     ids=["single-device", "pp2"],
 )
+@pytest.mark.slow
 def test_engine_generate_batch(mesh_cfg, eight_devices):
     engine = create_engine(
         "test-llama-tiny",
@@ -103,6 +104,7 @@ def test_engine_generate_batch(mesh_cfg, eight_devices):
     assert single["status"] == "success"
 
 
+@pytest.mark.slow
 def test_pipeline_ragged_batch_matches_single_device(eight_devices):
     """Backend-level bit-exactness: ragged left-padded batch on a pp=2 mesh
     == the same batch on the single-device backend (greedy)."""
@@ -166,6 +168,7 @@ def test_engine_generate_batch_rejects_bad_input():
     assert r["status"] == "failed" and "llama-family" in r["error"]
 
 
+@pytest.mark.slow
 def test_batched_over_http():
     from distributed_llm_inference_tpu.serving.server import InferenceServer
 

@@ -72,6 +72,7 @@ def _hf_beam(hf, prompt_ids, steps, num_beams, length_penalty=1.0,
 
 @pytest.mark.parametrize("num_beams", [2, 4])
 @pytest.mark.parametrize("early_stopping", [True, False])
+@pytest.mark.slow
 def test_beam_matches_hf(num_beams, early_stopping):
     hf = _tiny_hf()
     cfg, params = params_from_hf_model(hf, dtype="float32")
@@ -85,6 +86,7 @@ def test_beam_matches_hf(num_beams, early_stopping):
 
 
 @pytest.mark.parametrize("length_penalty", [0.5, 2.0])
+@pytest.mark.slow
 def test_beam_length_penalty_matches_hf(length_penalty):
     hf = _tiny_hf(seed=3)
     cfg, params = params_from_hf_model(hf, dtype="float32")
@@ -98,6 +100,7 @@ def test_beam_length_penalty_matches_hf(length_penalty):
     assert got == want
 
 
+@pytest.mark.slow
 def test_beam_beats_or_equals_greedy_score():
     """The best beam's sum-logprob must be >= the greedy path's (num_beams
     explores a superset of greedy's single path)."""

@@ -66,6 +66,7 @@ def test_chunked_equals_single_prefill():
     [MeshConfig(), MeshConfig(dp=1, pp=2, tp=1)],
     ids=["single-device", "pp2"],
 )
+@pytest.mark.slow
 def test_engine_chunked_prefill_end_to_end(mesh_cfg, eight_devices):
     """Engine accepts a prompt longer than every bucket and generates —
     identically on a single device and a pp=2 pipeline mesh."""
@@ -94,6 +95,7 @@ def test_engine_chunked_prefill_end_to_end(mesh_cfg, eight_devices):
     assert r["response"] == ref["response"]
 
 
+@pytest.mark.slow
 def test_pipeline_extend_matches_single_device(eight_devices):
     """Backend-level: pp=2 extend + prefill_at chunks == one big single-
     device prefill, bit-exact greedy tokens."""
@@ -153,6 +155,7 @@ def test_engine_still_rejects_over_capacity():
     assert r["status"] == "failed" and r["error_type"] == "invalid_request"
 
 
+@pytest.mark.slow
 def test_chunked_final_bucket_never_overhangs_cache():
     """max_seq not a multiple of the chunk: the final padded bucket must not
     write past max_seq (update_kv_cache would silently clamp and corrupt

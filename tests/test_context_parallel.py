@@ -18,6 +18,10 @@ from distributed_llm_inference_tpu.models.registry import get_model_config
 from distributed_llm_inference_tpu.parallel.context import ContextParallelBackend
 from distributed_llm_inference_tpu.parallel.mesh import build_mesh
 
+# fast-tier exclusion: sp shard_map compiles; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 
 def _run(backend, cfg, tokens, plen, steps, max_seq):
     sampling = G.default_sampling(greedy=True)

@@ -23,6 +23,10 @@ from distributed_llm_inference_tpu.engine.engine import (
 )
 from distributed_llm_inference_tpu.models import llama
 
+# fast-tier exclusion: many fleet-program compiles; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 PROMPTS = [
     "the quick brown fox",
     "jumps over",

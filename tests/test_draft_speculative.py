@@ -21,6 +21,10 @@ from distributed_llm_inference_tpu.engine import generate as G
 from distributed_llm_inference_tpu.models import api as M
 from distributed_llm_inference_tpu.models.registry import get_model_config
 
+# fast-tier exclusion: two-model compiles; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 MAX_SEQ = 256
 
 

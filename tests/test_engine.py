@@ -55,6 +55,7 @@ def test_eos_immediate_stop():
     assert r["response"] == ""
 
 
+@pytest.mark.slow
 def test_no_eos_runs_to_max_tokens():
     """With EOS unreachable (argmax is always 0, eos=5), the loop must emit
     exactly max_tokens tokens."""
@@ -142,6 +143,7 @@ def test_health_and_workers(tiny_engine):
     assert w["total"] == 1 and w["workers"]["stage_0"]["status"] == "online"
 
 
+@pytest.mark.slow
 def test_warmup_compiles_and_requests_stay_fast():
     """warmup() precompiles all bucket programs; a following request works
     and reuses the warmed cache buffer."""

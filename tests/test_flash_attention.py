@@ -23,6 +23,7 @@ from distributed_llm_inference_tpu.ops.flash_attention import flash_attend
         (1, 5, 2, 1, 16, 32, 3),  # 1 kv head (max group fan-in)
     ],
 )
+@pytest.mark.slow
 def test_flash_matches_xla_attend(B, T, H, KV, Dh, S, pos):
     ks = jax.random.split(jax.random.PRNGKey(B * T + H + pos), 3)
     q = jax.random.normal(ks[0], (B, T, H, Dh), jnp.float32)
@@ -49,6 +50,7 @@ def test_flash_block_size_invariance(block_t, block_k):
 
 
 @pytest.mark.parametrize("model", ["test-llama-tiny", "test-gpt2-tiny"])
+@pytest.mark.slow
 def test_model_forward_pallas_equals_xla(model):
     """Full-model logits identical under attn_impl='pallas' vs 'xla'."""
     from distributed_llm_inference_tpu.models import api as M
@@ -74,6 +76,7 @@ def test_model_forward_pallas_equals_xla(model):
     np.testing.assert_allclose(np.asarray(lp2), np.asarray(lx2), rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_ragged_valid_start_matches_masked_attend():
     """Per-row valid_start (left-padded batch) in the kernel == 3D-mask XLA."""
     from distributed_llm_inference_tpu.ops.attention import ragged_causal_mask
@@ -97,6 +100,7 @@ def test_flash_ragged_valid_start_matches_masked_attend():
         )
 
 
+@pytest.mark.slow
 def test_model_forward_pallas_ragged_batch():
     """Batched ragged prefill+decode: pallas == xla end to end."""
     from distributed_llm_inference_tpu.engine import generate as G

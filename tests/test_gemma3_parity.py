@@ -24,6 +24,10 @@ from distributed_llm_inference_tpu.engine.engine import InferenceEngine
 from distributed_llm_inference_tpu.models import llama
 from distributed_llm_inference_tpu.models.convert import params_from_hf_model
 
+# fast-tier exclusion: HF-parity family file; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 
 def _tiny_hf_gemma3(rope_scaling=None, n_layers=6):
     cfg = transformers.Gemma3TextConfig(

@@ -12,6 +12,10 @@ transformers = pytest.importorskip("transformers")
 from distributed_llm_inference_tpu.models import gpt2
 from distributed_llm_inference_tpu.models.convert import params_from_hf_model
 
+# fast-tier exclusion: HF-parity family file; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def hf_and_ours():

@@ -82,6 +82,7 @@ def test_gated_write_is_noop():
     assert not np.array_equal(np.asarray(out2.q), np.asarray(leaf.q))
 
 
+@pytest.mark.slow
 def test_solo_logits_close_and_generation_runs(raw_engine, q_engine):
     """Quantization error is bounded: greedy generation completes and the
     scored logprobs of the SAME continuation stay close to the raw
@@ -101,6 +102,7 @@ def test_solo_logits_close_and_generation_runs(raw_engine, q_engine):
     np.testing.assert_allclose(lp_q, lp_r, atol=0.15)
 
 
+@pytest.mark.slow
 def test_continuous_matches_solo_quantized(q_engine):
     """The quantized fleet is exactly self-consistent with the solo
     quantized path (same values written, same attention) — the dense
@@ -123,6 +125,7 @@ def test_continuous_matches_solo_quantized(q_engine):
         assert g["response"] == w["response"]
 
 
+@pytest.mark.slow
 def test_kv_quant_rejects_illegal_combos(raw_engine):
     cfg = get_model_config("test-llama-tiny")
     with pytest.raises(ValueError, match="kv_quant"):

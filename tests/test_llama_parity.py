@@ -40,6 +40,7 @@ def _tiny_hf_llama(n_kv_heads: int):
 
 
 @pytest.mark.parametrize("n_kv_heads", [4, 2])  # MHA and GQA
+@pytest.mark.slow
 def test_logits_match_hf(n_kv_heads):
     hf = _tiny_hf_llama(n_kv_heads)
     cfg, params = params_from_hf_model(hf, dtype="float32")
@@ -58,6 +59,7 @@ def test_logits_match_hf(n_kv_heads):
     np.testing.assert_allclose(np.asarray(logits), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_llama3_rope_scaling_logits_match_hf():
     """Llama-3.1/3.2 checkpoints ship "llama3" rope_scaling that HF applies
     to the RoPE frequencies at EVERY position; the converter must pick it up
@@ -116,6 +118,7 @@ def test_unsupported_rope_scaling_rejected():
         config_from_hf(cfg_hf)
 
 
+@pytest.mark.slow
 def test_qwen2_logits_match_hf():
     """Qwen2 family = llama arch + q/k/v biases + tied option; parity vs a
     tiny-random HF Qwen2ForCausalLM validates the bias path end to end."""

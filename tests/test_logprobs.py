@@ -34,6 +34,7 @@ def eng():
     )
 
 
+@pytest.mark.slow
 def test_logprobs_match_manual_forward(eng):
     cfg = eng.cfg
     r = eng.generate("12 44 91 7", max_tokens=6, greedy=True, chat=False,
@@ -78,6 +79,7 @@ def test_logprobs_greedy_tokens_are_argmax(eng):
         )
 
 
+@pytest.mark.slow
 def test_logprobs_served_on_pipeline(eng):
     """Round-2 review #3: the pp mesh serves the full request surface —
     logprobs included (bit-consistency vs single-device is covered by
@@ -96,6 +98,7 @@ def test_logprobs_served_on_pipeline(eng):
     assert len(r["token_logprobs"]) == r["tokens_generated"]
 
 
+@pytest.mark.slow
 def test_logprobs_continuous_falls_back_solo(eng):
     from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
 

@@ -65,6 +65,7 @@ def adapter(tmp_path_factory):
     return base, merged, adir
 
 
+@pytest.mark.slow
 def test_merge_matches_hf_merge_and_unload(adapter):
     base, merged_hf, adir = adapter
     cfg, params = params_from_hf_model(base, dtype="float32")
@@ -89,6 +90,7 @@ def test_merge_matches_hf_merge_and_unload(adapter):
     )
 
 
+@pytest.mark.slow
 def test_create_engine_with_lora_and_quant(adapter):
     """--lora composes with --quant: merge first, then quantize the merged
     dense weights."""
@@ -102,6 +104,7 @@ def test_create_engine_with_lora_and_quant(adapter):
     assert r["status"] == "success", r
 
 
+@pytest.mark.slow
 def test_merge_rejects_quantized_params(adapter):
     from distributed_llm_inference_tpu.ops.quant import quantize_params
 
@@ -112,6 +115,7 @@ def test_merge_rejects_quantized_params(adapter):
         merge_lora(cfg, qp, adir)
 
 
+@pytest.mark.slow
 def test_rslora_scale_matches_hf(tmp_path):
     """use_rslora adapters scale by alpha/sqrt(r); the merge must match
     HF's own rsLoRA merge, not be off by sqrt(r)."""
@@ -145,6 +149,7 @@ def test_rslora_scale_matches_hf(tmp_path):
                                rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow
 def test_merge_rejects_math_changing_variants(adapter, tmp_path):
     """DoRA / modules_to_save / partial-layer configs must be rejected
     loudly — a silently-wrong merged model is the worst failure mode."""

@@ -16,6 +16,10 @@ from distributed_llm_inference_tpu.engine import generate as G
 from distributed_llm_inference_tpu.models import api as M
 from distributed_llm_inference_tpu.models.registry import get_model_config
 
+# fast-tier exclusion: MoE forward + ep-mesh compiles; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 
 def test_moe_forward_shapes_and_sparsity():
     cfg = get_model_config("test-moe-tiny")

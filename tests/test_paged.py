@@ -74,6 +74,7 @@ def test_blocks_needed():
     assert P.blocks_needed(1, 1, 16) == 1
 
 
+@pytest.mark.slow
 def test_decode_slots_paged_matches_dense(solo_engine):
     """Device-level: one occupied slot decoding over the block pool emits
     the exact stream the dense fleet emits from the same prefill."""
@@ -134,6 +135,7 @@ def test_decode_slots_paged_matches_dense(solo_engine):
     )
 
 
+@pytest.mark.slow
 def test_paged_engine_matches_dense_engine(solo_engine):
     """End-to-end: the same request mix through a paged fleet and a dense
     fleet produces identical greedy text."""
@@ -165,6 +167,7 @@ def test_paged_engine_matches_dense_engine(solo_engine):
     assert stats["paged"]["free_blocks"] == 15
 
 
+@pytest.mark.slow
 def test_pool_backpressure_and_reuse(solo_engine):
     """A pool too small for all requests at once still serves every one:
     admission waits for released blocks (no failure, no deadlock), and
@@ -190,6 +193,7 @@ def test_pool_backpressure_and_reuse(solo_engine):
     assert stats["paged"]["free_blocks"] == 8
 
 
+@pytest.mark.slow
 def test_request_exceeding_slot_class_rejected(solo_engine):
     cont = ContinuousEngine(
         solo_engine, n_slots=2, chunk_steps=4, slot_max_seq=64,
@@ -206,6 +210,7 @@ def test_request_exceeding_slot_class_rejected(solo_engine):
     assert out["error_type"] == "invalid_request"
 
 
+@pytest.mark.slow
 def test_paged_requires_capable_backend(solo_engine):
     with pytest.raises(ValueError, match="full slot-class"):
         ContinuousEngine(
@@ -234,6 +239,7 @@ def _gather_attend(q, pool_k, pool_v, table, pos, window=None):
 
 
 @pytest.mark.parametrize("window", [None, 21])
+@pytest.mark.slow
 def test_paged_kernel_matches_gather(window):
     """Kernel-level: paged_flash_attend == gather+attend on a scattered
     out-of-order table, per-row positions, GQA grouping."""
@@ -261,6 +267,7 @@ def test_paged_kernel_matches_gather(window):
     )
 
 
+@pytest.mark.slow
 def test_paged_kernel_token_parity(solo_engine):
     """Engine-level: a paged decode with attn_impl='pallas' emits the
     exact token stream the XLA gather path emits (greedy, same params)."""
@@ -330,6 +337,7 @@ def test_slots_kernel_matches_attend(window):
     )
 
 
+@pytest.mark.slow
 def test_slots_kernel_fleet_token_parity(solo_engine):
     """Engine-level: the dense continuous fleet under attn_impl='pallas'
     serves the exact greedy text the XLA fleet serves."""

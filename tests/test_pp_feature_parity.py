@@ -15,6 +15,10 @@ from distributed_llm_inference_tpu import EngineConfig, MeshConfig, create_engin
 from distributed_llm_inference_tpu.engine.engine import InferenceEngine
 from distributed_llm_inference_tpu.models import api as M
 
+# fast-tier exclusion: pp-mesh compiles per feature; run the full suite (plain
+# `pytest`) to include it
+pytestmark = pytest.mark.slow
+
 
 class _NumTok:
     """Lossless ids<->text ('12 7 9'), so token-exact comparisons survive

@@ -47,6 +47,7 @@ def test_hit_reproduces_cold_output_exactly():
     assert stats["hits"] >= 1 and stats["entries"] >= 1
 
 
+@pytest.mark.slow
 def test_identical_prompt_rerun_hits():
     eng = _engine()
     p = SHARED + "same prompt"
@@ -56,6 +57,7 @@ def test_identical_prompt_rerun_hits():
     assert r1["response"] == r2["response"]
 
 
+@pytest.mark.slow
 def test_conversation_prefix_grows():
     """Multi-turn chat: each turn extends the stored prefix, so turn N+1
     reuses turn N's longer snapshot (chained growth)."""
@@ -81,6 +83,7 @@ def test_lru_bound_holds():
     assert eng.stats()["prefix_cache"]["entries"] <= 2
 
 
+@pytest.mark.slow
 def test_prefix_plus_chunked_tail():
     """A cached prefix plus a tail longer than the largest bucket routes
     through extend() chunks from the cached offset."""
@@ -97,6 +100,7 @@ def test_prefix_plus_chunked_tail():
     assert r["response"] == c["response"]
 
 
+@pytest.mark.slow
 def test_prefix_cache_on_pipeline_mesh(eight_devices):
     warm = _engine(mesh_cfg=MeshConfig(dp=1, pp=2, tp=1))
     cold = _engine(prefix_entries=0)
@@ -110,6 +114,7 @@ def test_prefix_cache_on_pipeline_mesh(eight_devices):
     assert r["response"] == c["response"]
 
 
+@pytest.mark.slow
 def test_auto_disable_on_incompatible_cache(eight_devices):
     """The context-parallel backend's slot-tagged cache cannot snapshot/
     splice: the prefix cache must disable itself (checked against the live
@@ -130,6 +135,7 @@ def test_auto_disable_on_incompatible_cache(eight_devices):
     assert "prefix_cache" not in eng.stats()
 
 
+@pytest.mark.slow
 def test_ttft_improves_on_hit():
     """The point of the feature: a hit's TTFT beats the cold TTFT for the
     same prompt (prefill covers only the tail). Generous margin — CI runs

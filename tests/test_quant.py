@@ -60,6 +60,7 @@ def test_quantize_params_structure_and_scan():
     assert logits.shape == (1, 3, cfg.vocab_size)
 
 
+@pytest.mark.slow
 def test_quantized_logits_close_to_full_precision():
     cfg = get_model_config("test-llama-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))
@@ -75,6 +76,7 @@ def test_quantized_logits_close_to_full_precision():
     assert err.max() / scale < 0.05, err.max() / scale
 
 
+@pytest.mark.slow
 def test_engine_end_to_end_with_quant():
     cfg = get_model_config("test-llama-tiny", quant="int8")
     engine = create_engine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
@@ -88,6 +90,7 @@ def test_engine_end_to_end_with_quant():
     [MeshConfig(dp=1, pp=2, tp=1), MeshConfig(dp=1, pp=2, tp=2)],
     ids=["pp2", "pp2tp2"],
 )
+@pytest.mark.slow
 def test_quant_pipeline_matches_quant_single_device(mesh_cfg, eight_devices):
     """SPMD + quant: an int8 pp (x tp) mesh decodes bit-exactly what the
     int8 single-device backend decodes (same quantized weights; the
@@ -133,6 +136,7 @@ def test_quant_pipeline_matches_quant_single_device(mesh_cfg, eight_devices):
 
 
 @pytest.mark.parametrize("pp", [2, 3])  # 3: uneven split + zero-pad + quant
+@pytest.mark.slow
 def test_quant_engine_on_pipeline_mesh(pp, eight_devices):
     cfg = get_model_config("test-llama-tiny", quant="int8")
     engine = create_engine(
@@ -196,6 +200,7 @@ def test_int4_odd_group_falls_back_to_single_group():
     assert t.g == 20 and t.q.shape == (1, 10, 8)
 
 
+@pytest.mark.slow
 def test_int4_params_forward_close_to_full_precision():
     from distributed_llm_inference_tpu.ops.quant import Q4Tensor
 
@@ -222,6 +227,7 @@ def test_int4_params_forward_close_to_full_precision():
     assert err.max() / scale < 0.5, err.max() / scale
 
 
+@pytest.mark.slow
 def test_int4_engine_end_to_end():
     cfg = get_model_config("test-llama-tiny", quant="int4")
     engine = create_engine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
@@ -230,6 +236,7 @@ def test_int4_engine_end_to_end():
     assert r["tokens_generated"] >= 1
 
 
+@pytest.mark.slow
 def test_int4_pipeline_matches_int4_single_device(eight_devices):
     """int4 on a pp=2 x tp=2 mesh decodes bit-exactly what int4 on one
     device decodes (Q4Tensor leaves shard: groups over tp-in, out over
@@ -270,6 +277,7 @@ def test_int4_pipeline_matches_int4_single_device(eight_devices):
     np.testing.assert_array_equal(np.asarray(out_p), np.asarray(out_s))
 
 
+@pytest.mark.slow
 def test_int4_pallas_kernel_matches_reference():
     """The Pallas VMEM-unpack kernel (decode hot path on TPU; interpret
     mode here) computes exactly x @ dequant(w) for kernel-eligible
@@ -292,6 +300,7 @@ def test_int4_pallas_kernel_matches_reference():
 # -- int8 MoE expert banks --------------------------------------------------
 
 
+@pytest.mark.slow
 def test_int8_moe_expert_banks_quantize_and_track():
     """MoE models quantize their expert banks too (per-(expert,
     out-channel) scales riding the moe_ffn einsums); logits stay close
@@ -332,6 +341,7 @@ def test_int8_moe_expert_banks_quantize_and_track():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_int8_moe_pipeline_ep_matches_single_device(eight_devices):
     """Quantized expert banks shard over pp x ep bit-exactly (QTensor
     scale specs follow the 4-D bank layout)."""

@@ -45,6 +45,7 @@ def _fire(queue, prompts, **kwargs):
     return results
 
 
+@pytest.mark.slow
 def test_concurrent_singles_coalesce():
     engine = _engine()
     queue = BatchingQueue(engine, max_queue=16, max_batch=8, max_wait_ms=100)
@@ -64,6 +65,7 @@ def test_concurrent_singles_coalesce():
         queue.close()
 
 
+@pytest.mark.slow
 def test_coalesced_rows_match_solo_generation():
     """A coalesced row's text must equal the same prompt served alone
     (ragged batching is invisible — the engine equivalence bar)."""
@@ -109,6 +111,7 @@ def test_full_queue_sheds_load():
         queue.close()
 
 
+@pytest.mark.slow
 def test_seeded_requests_do_not_coalesce():
     engine = _engine()
     queue = BatchingQueue(engine, max_queue=16, max_batch=8, max_wait_ms=100)
@@ -124,6 +127,7 @@ def test_seeded_requests_do_not_coalesce():
         queue.close()
 
 
+@pytest.mark.slow
 def test_fleet_failure_falls_back_to_solo():
     """One bad request must not fail the innocents it coalesced with: on a
     whole-fleet failure every member retries solo (where e.g. chunked
@@ -146,6 +150,7 @@ def test_fleet_failure_falls_back_to_solo():
         queue.close()
 
 
+@pytest.mark.slow
 def test_client_batch_flows_through_queue():
     engine = _engine()
     queue = BatchingQueue(engine, max_queue=4, max_batch=4, max_wait_ms=0)
@@ -156,6 +161,7 @@ def test_client_batch_flows_through_queue():
         queue.close()
 
 
+@pytest.mark.slow
 def test_queue_wait_counts_against_deadline():
     """--deadline bounds the WHOLE request wall clock: a request whose
     queue wait already blew the deadline gets a timeout envelope at
@@ -197,6 +203,7 @@ def test_max_batch_clamped_to_engine_limit():
         queue.close()
 
 
+@pytest.mark.slow
 def test_queue_over_http_429():
     from distributed_llm_inference_tpu.serving.server import InferenceServer
 
@@ -242,6 +249,7 @@ def test_queue_over_http_429():
         server.shutdown()
 
 
+@pytest.mark.slow
 def test_coalesced_fleet_tolerates_server_kwargs():
     """Regression: the server sets logprobs/speculative/debug on every
     request; a coalesced fleet must drop the non-batch kwargs instead of

@@ -11,7 +11,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from distributed_llm_inference_tpu.ops.attention import attend, causal_mask
 from distributed_llm_inference_tpu.parallel.ring import (
@@ -35,6 +35,7 @@ def _full_attend_ref(q, k, v):
 
 
 @pytest.mark.parametrize("sp,B,S,H,KV,Dh", [(4, 2, 32, 4, 2, 16), (8, 1, 64, 8, 8, 8)])
+@pytest.mark.slow
 def test_ring_attend_matches_full(sp, B, S, H, KV, Dh):
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, S, H, Dh), jnp.float32)
@@ -55,6 +56,7 @@ def test_ring_attend_matches_full(sp, B, S, H, KV, Dh):
 
 
 @pytest.mark.parametrize("T", [1, 3])
+@pytest.mark.slow
 def test_cp_decode_attend_matches_full(T):
     """Scatter a 20-token history across 4 devices in arbitrary slot order;
     CP decode of the next chunk must equal single-device cached attention."""

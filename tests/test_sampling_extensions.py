@@ -130,6 +130,7 @@ def _engine_tokens(engine, ids, want_len, **kw):
     return [int(t) for t in r["response"].split()]
 
 
+@pytest.mark.slow
 def test_greedy_repetition_penalty_matches_hf_generate(penalized_setup):
     cfg, params, ids, want = penalized_setup
     eng = InferenceEngine(
@@ -139,6 +140,7 @@ def test_greedy_repetition_penalty_matches_hf_generate(penalized_setup):
     assert got == want
 
 
+@pytest.mark.slow
 def test_pipeline_repetition_penalty_matches_hf(penalized_setup, eight_devices):
     from distributed_llm_inference_tpu.parallel.mesh import build_mesh
     from distributed_llm_inference_tpu.parallel.pipeline import PipelineBackend
@@ -153,6 +155,7 @@ def test_pipeline_repetition_penalty_matches_hf(penalized_setup, eight_devices):
     assert got == want
 
 
+@pytest.mark.slow
 def test_continuous_repetition_penalty_matches_hf(penalized_setup):
     cfg, params, ids, want = penalized_setup
     eng = InferenceEngine(
@@ -180,6 +183,7 @@ def test_continuous_repetition_penalty_matches_hf(penalized_setup):
         cont.close()
 
 
+@pytest.mark.slow
 def test_penalty_disables_speculation(penalized_setup):
     """speculative=true with a repetition penalty falls back to plain
     decode (the penalty changes the argmax the draft verifies against) —

@@ -32,6 +32,7 @@ def _single_device_reference(cfg, params, tokens, plen, steps, kp, kd, sampling)
 
 
 @pytest.mark.parametrize("pp,mb", [(2, 2), (4, 4), (2, 4)])
+@pytest.mark.slow
 def test_microbatch_prefill_matches_single_device(pp, mb, eight_devices):
     cfg = get_model_config("test-llama-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))
@@ -59,6 +60,7 @@ def test_microbatch_prefill_matches_single_device(pp, mb, eight_devices):
 
 
 @pytest.mark.parametrize("cfg_name", ["test-llama-tiny", "test-gpt2-tiny"])
+@pytest.mark.slow
 def test_microbatch_decode_matches_single_device(cfg_name, eight_devices):
     """Greedy prefill+decode, 2 stages x 2 microbatches, both families."""
     cfg = get_model_config(cfg_name)
@@ -85,6 +87,7 @@ def test_microbatch_decode_matches_single_device(cfg_name, eight_devices):
     np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_s))
 
 
+@pytest.mark.slow
 def test_microbatch_full_mesh_dp_pp_tp(eight_devices):
     """All three mesh axes + microbatching: dp=2 x pp=2 x tp=2, batch=8."""
     cfg = get_model_config("test-llama-tiny")
@@ -111,6 +114,7 @@ def test_microbatch_full_mesh_dp_pp_tp(eight_devices):
     np.testing.assert_array_equal(np.asarray(n_p), np.asarray(n_s))
 
 
+@pytest.mark.slow
 def test_microbatch_eos_early_exit(eight_devices):
     """Per-row EOS finishing + per-microbatch done gating: pick the token
     greedy decode emits mid-stream as the EOS id and check both backends
@@ -160,6 +164,7 @@ def test_create_backend_selects_schedule(eight_devices):
     assert be3.name == "single-device"
 
 
+@pytest.mark.slow
 def test_microbatch_batch_contract(eight_devices):
     cfg = get_model_config("test-llama-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))
@@ -172,6 +177,7 @@ def test_microbatch_batch_contract(eight_devices):
     assert be.health()[0]["microbatches"] == 2
 
 
+@pytest.mark.slow
 def test_microbatch_prefill_default_skips_logits(eight_devices):
     """Serving default: no [Mb, b_m, vocab] accumulator — prefill returns a
     zero-width logits array but bit-identical first tokens."""

@@ -47,6 +47,7 @@ def served():
     server.shutdown()
 
 
+@pytest.mark.slow
 def test_score_matches_hf_teacher_forcing(served):
     hf, server = served
     eng = server.engine
@@ -69,6 +70,7 @@ def test_score_matches_hf_teacher_forcing(served):
                                atol=2e-3)
 
 
+@pytest.mark.slow
 def test_openai_echo_scoring_route(served):
     _, server = served
     req = urllib.request.Request(
@@ -95,6 +97,7 @@ def test_openai_echo_scoring_route(served):
     assert c["logprobs"]["token_logprobs"][1:] == ref["token_logprobs"][1:]
 
 
+@pytest.mark.slow
 def test_openai_echo_without_scoring_form_rejected(served):
     _, server = served
     for body in [
@@ -135,6 +138,7 @@ def test_score_chunked_matches_single_forward(served):
                                rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.slow
 def test_score_top_n_alternatives(served):
     hf, server = served
     eng = server.engine
@@ -159,6 +163,7 @@ def test_score_top_n_alternatives(served):
         assert all(v <= 0.0 for v in alt.values())
 
 
+@pytest.mark.slow
 def test_openai_echo_top_logprobs(served):
     _, server = served
     req = urllib.request.Request(

@@ -66,6 +66,7 @@ def _spec(cfg, params, tokens, ids, plen, steps, kp, sampling, draft_len=4,
     ],
     ids=["repetitive", "random"],
 )
+@pytest.mark.slow
 def test_speculative_bit_identical_to_greedy(ids, draft_len):
     cfg = get_model_config("test-llama-tiny", eos_token_id=-1, max_seq_len=256)
     params, tokens, sampling, kp, kd = _setup(cfg, ids)
@@ -79,6 +80,7 @@ def test_speculative_bit_identical_to_greedy(ids, draft_len):
     assert int(n_r[0]) == int(n_s[0])
 
 
+@pytest.mark.slow
 def test_speculative_eos_truncation_matches():
     cfg0 = get_model_config("test-llama-tiny", eos_token_id=-1, max_seq_len=256)
     ids = ([7, 11, 13, 17] * 6)[:20]
@@ -95,6 +97,7 @@ def test_speculative_eos_truncation_matches():
     assert int(n_r[0]) == int(n_s[0])
 
 
+@pytest.mark.slow
 def test_speculative_limit_exact():
     """The traced limit cuts emission mid-window without overshoot."""
     cfg = get_model_config("test-llama-tiny", eos_token_id=-1, max_seq_len=256)

@@ -47,6 +47,7 @@ def _backend(cfg, params, mesh_cfg, devices, tokens, plen, steps, key, sampling,
         ("test-gpt2-tiny", MeshConfig(dp=1, pp=2, tp=2)),
     ],
 )
+@pytest.mark.slow
 def test_tp_greedy_decode_matches_single_device(cfg_name, mesh, eight_devices):
     cfg = get_model_config(cfg_name)
     params = M.init_params(cfg, jax.random.PRNGKey(0))
@@ -75,6 +76,7 @@ def test_tp_greedy_decode_matches_single_device(cfg_name, mesh, eight_devices):
     assert int(n_t[0]) == int(n_s[0])
 
 
+@pytest.mark.slow
 def test_dp_batched_greedy_decode_matches_single_device(eight_devices):
     """dp=2 batch-sharded decode == single-device batch=2 decode (greedy:
     per-dp-group key folding cannot affect argmax)."""
@@ -115,6 +117,7 @@ def test_validate_mesh_rejects_indivisible():
         validate_mesh(cfg, pp=5, tp=1)
 
 
+@pytest.mark.slow
 def test_dp_cache_requires_divisible_batch(eight_devices):
     cfg = get_model_config("test-llama-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))
