@@ -189,7 +189,10 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
             # Per-row flash decode (ops/paged_attention.flash_attend_slots):
             # each fleet row reads only its LIVE prefix, where the XLA
             # path reads the whole B x S cache every step. Same legality
-            # envelope as the scalar-pos kernel (__post_init__).
+            # envelope as the scalar-pos kernel (__post_init__). Opt-in:
+            # measured ~2x slower than the XLA einsum on v5e at serving
+            # sizes (see _slots_kernel's docstring) — the default stays
+            # "xla"; bench.py's fleet leg tracks the gap.
             from ..ops.paged_attention import flash_attend_slots
 
             attn = flash_attend_slots(

@@ -62,10 +62,10 @@ def _live_range(pos_b, *, bs: int, MB: int, window):
 
 
 def _paged_kernel(
-    table_ref,  # scalar-prefetch [B, MB] int32 (unused in the slots variant)
+    table_ref,  # scalar-prefetch [B, MB] int32
     pos_ref,  # scalar-prefetch [B] int32
     q_ref,  # [1, 1, 1, group, Dh] VMEM
-    k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block / cache tile)
+    k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block)
     v_ref,  # [1, 1, bs, Dh] VMEM
     o_ref,  # [1, 1, 1, group, Dh] VMEM
     m_ref,  # scratch [group, 1] fp32
@@ -77,7 +77,6 @@ def _paged_kernel(
     group: int,
     scale: float,
     window: int | None,
-    S: int | None = None,  # total positions when MB*bs overshoots (slots)
 ):
     del table_ref  # physical placement is the index maps' concern
     b = pl.program_id(0)
@@ -98,20 +97,11 @@ def _paged_kernel(
         q = q_ref[0, 0, 0].astype(jnp.float32) * scale  # [group, Dh]
         ks = k_ref[0, 0].astype(jnp.float32)  # [bs, Dh]
         vs = v_ref[0, 0].astype(jnp.float32)
-        if S is not None and S % bs != 0:
-            # ragged final tile (slots variant): BlockSpec pads past S
-            # with whatever memory holds. K-side garbage is harmless (its
-            # scores are where-replaced by _NEG below), but V-side NaNs
-            # would ride through `p @ vs` as 0 * NaN = NaN — zero them.
-            lane = jax.lax.broadcasted_iota(jnp.int32, (bs, Dh), 0)
-            vs = jnp.where(j * bs + lane < S, vs, 0.0)
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [group, bs]
         kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
         mask = kv_pos <= pos_b
-        if S is not None:
-            mask &= kv_pos < S  # ragged final tile (slots variant)
         if window is not None:
             mask &= kv_pos > pos_b - window
         s = jnp.where(mask, s, _NEG)
@@ -210,6 +200,101 @@ def paged_flash_attend(
     return out.reshape(B, 1, H, Dh)
 
 
+def _slots_kernel(
+    pos_ref,  # scalar-prefetch [B] int32
+    q_ref,  # [1, 1, KV, group, Dh] VMEM
+    k_ref,  # [1, KV, bk, Dh] VMEM (all kv heads, one seq tile)
+    v_ref,  # [1, KV, bk, Dh] VMEM
+    o_ref,  # [1, 1, KV, group, Dh] VMEM
+    m_ref,  # scratch [H, 1] fp32
+    l_ref,  # scratch [H, 1] fp32
+    acc_ref,  # scratch [H, Dh] fp32
+    *,
+    bk: int,
+    KV: int,
+    group: int,
+    S: int,
+    scale: float,
+    window: int | None,
+):
+    """One (batch row, seq tile) step: ALL kv heads in one MXU matmul.
+
+    The per-(b, kv) variant (`_paged_kernel`) issues KV x S/bk programs of
+    [group, bk] work each; this tile folds every kv head — scores are one
+    [H, KV*bk] matmul (rows = all query heads, columns = every kv head's
+    tile) and a block-diagonal mask kills the cross-head terms: 4x the
+    multiplies on paper, but they ride an MXU that was idling, and the
+    program count drops by KV x.
+
+    Measured honestly (v5e, TinyLlama fleet, 8 x 8192 cache at pos 1024):
+    ~11 ms/call vs the XLA einsum's ~4.8 ms — decode attention at serving
+    sizes is dominated by fixed per-call/pipelining overhead, not by the
+    cache bytes this kernel avoids reading, and XLA's fused masked
+    attention amortizes that overhead across the whole batched einsum.
+    That is why NOTHING selects this kernel by default: attn_impl stays
+    "xla" unless explicitly requested, and bench.py's fleet leg records
+    both numbers every round so future kernel work (splash-style
+    multi-tile pipelining) has a regression baseline to beat.
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    pos_b = pos_ref[b]
+    Dh = q_ref.shape[-1]
+    H = KV * group
+    C = KV * bk
+    first, needed = _live_range(pos_b, bs=bk, MB=n_j, window=window)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full((H, 1), _NEG, jnp.float32)
+        l_ref[:] = jnp.zeros((H, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((H, Dh), jnp.float32)
+
+    @pl.when((j >= first) & (j < needed))
+    def _():
+        q = q_ref[0, 0].reshape(H, Dh).astype(jnp.float32) * scale
+        ks = k_ref[0].reshape(C, Dh).astype(jnp.float32)
+        vs = v_ref[0].reshape(C, Dh).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [H, C]
+        row = jax.lax.broadcasted_iota(jnp.int32, (H, C), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (H, C), 1)
+        kv_pos = j * bk + col % bk
+        # block-diagonal: row h (kv head h // group) only sees columns of
+        # its own kv head's tile (col // bk)
+        mask = (row // group == col // bk) & (kv_pos <= pos_b)
+        if S % bk != 0:
+            mask &= kv_pos < S
+            vs = jnp.where(
+                j * bk + jax.lax.broadcasted_iota(jnp.int32, (C, Dh), 0) % bk
+                < S,
+                vs, 0.0,
+            )  # BlockSpec pad garbage would ride 0 * NaN into acc
+        if window is not None:
+            mask &= kv_pos > pos_b - window
+        s = jnp.where(mask, s, _NEG)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == n_j - 1)
+    def _():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (
+            (acc_ref[:] / l).reshape(KV, group, Dh).astype(o_ref.dtype)
+        )
+
+
 @functools.partial(
     jax.jit, static_argnames=("block_k", "interpret", "window")
 )
@@ -236,6 +321,10 @@ def flash_attend_slots(
     the shared-scalar-position counterpart (its grid offsets assume one
     frontier for the whole batch; this kernel's are per-row).
 
+    Opt-in only (attn_impl="pallas"): see `_slots_kernel` — on v5e at
+    serving sizes the XLA einsum is ~2x faster despite reading the whole
+    cache; bench.py's fleet leg tracks the gap each round.
+
     q [B,1,H,Dh] (decode, T=1); cache_k/v [B,KV,S,Dh]; pos [B] int32.
     Returns [B,1,H,Dh] in q.dtype.
     """
@@ -247,47 +336,44 @@ def flash_attend_slots(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if block_k <= 0:
-        block_k = min(S, 256)
+        block_k = min(S, 512)
     MB = pl.cdiv(S, block_k)
 
     q5 = q.reshape(B, 1, KV, group, Dh)
     pos = pos.astype(jnp.int32)
 
-    def kv_index(b, kv, j, pos_ref):
+    def kv_index(b, j, pos_ref):
         first, needed = _live_range(
             pos_ref[b], bs=block_k, MB=MB, window=window
         )
-        return (b, kv, jnp.clip(j, first, needed - 1), 0)
+        return (b, 0, jnp.clip(j, first, needed - 1), 0)
 
     kernel = functools.partial(
-        _paged_kernel,
-        None,  # no block table: the cache layout is the identity map
-        bs=block_k,
-        MB=MB,
+        _slots_kernel,
+        bk=block_k,
+        KV=KV,
         group=group,
+        S=S,
         scale=Dh**-0.5,
         window=window,
-        S=S,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(B, KV, MB),
+        grid=(B, MB),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, 1, group, Dh),
-                lambda b, kv, j, pos_ref: (b, 0, kv, 0, 0),
+                (1, 1, KV, group, Dh), lambda b, j, pos_ref: (b, 0, 0, 0, 0)
             ),
-            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
-            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+            pl.BlockSpec((1, KV, block_k, Dh), kv_index),
+            pl.BlockSpec((1, KV, block_k, Dh), kv_index),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, 1, group, Dh),
-            lambda b, kv, j, pos_ref: (b, 0, kv, 0, 0),
+            (1, 1, KV, group, Dh), lambda b, j, pos_ref: (b, 0, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, 1), jnp.float32),
-            pltpu.VMEM((group, Dh), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, Dh), jnp.float32),
         ],
     )
     out = pl.pallas_call(

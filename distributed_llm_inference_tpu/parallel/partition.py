@@ -231,10 +231,24 @@ def shared_specs(shared: dict) -> dict:
     return specs
 
 
-def cache_spec() -> P:
+def cache_spec(cfg=None):
     """KV cache [L, B, KV, S, Dh]: layers over pp, batch over dp, kv heads
-    over tp."""
-    return P(AXIS_PP, AXIS_DP, AXIS_TP, None, None)
+    over tp. With cfg.kv_quant the cache leaves are KVQuant pytrees
+    (ops/kv_quant.py) whose int8 data keeps the 5-axis spec and whose
+    per-(token, head) scales [L, B, KV, S] drop the head_dim axis — the
+    returned SPEC tree mirrors that structure (a KVQuant holding specs:
+    same treedef trick as the quantized weight specs above), so every
+    shard_map in/out spec and sharding constraint distributes per leaf.
+    cfg=None keeps the raw single-spec form (callers that never see a
+    quantized cache: context/schedule backends, which gate kv_quant off).
+    """
+    p5 = P(AXIS_PP, AXIS_DP, AXIS_TP, None, None)
+    if cfg is None or getattr(cfg, "kv_quant", None) is None:
+        return p5
+    from ..ops.kv_quant import KVQuant
+
+    leaf = KVQuant(p5, P(AXIS_PP, AXIS_DP, AXIS_TP, None))
+    return {"k": leaf, "v": leaf}
 
 
 def params_already_placed(params: dict, mesh: Mesh) -> bool:
@@ -292,13 +306,22 @@ def init_sharded_cache(cfg: ModelConfig, mesh: Mesh, batch: int, max_seq: int):
     if batch % dp != 0:
         raise ValueError(f"batch={batch} not divisible by dp={dp}")
     n_layers = padded_layers_per_stage(cfg.n_layers, pp) * pp
-    sharding = NamedSharding(mesh, cache_spec())
+    spec_tree = cache_spec(cfg)
 
     @jax.jit
     def make():
         cache = M.init_kv_cache(cfg, batch, max_seq=max_seq, n_layers=n_layers)
+        specs = (
+            spec_tree
+            if not isinstance(spec_tree, P)  # per-leaf tree (kv_quant)
+            else jax.tree.map(lambda _: spec_tree, cache)
+        )
         return jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, sharding), cache
+            lambda x, sp: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, sp)
+            ),
+            cache,
+            specs,
         )
 
     return make()

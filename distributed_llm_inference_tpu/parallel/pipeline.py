@@ -342,7 +342,7 @@ class PipelineBackend(SPMDBackendBase):
 
         specs = [
             self._shared_specs, self._layer_specs, P(AXIS_DP), P(), P(),
-            cache_spec(), P(), P(),
+            cache_spec(self.cfg), P(), P(),
         ]
         if ragged:
             specs.append(P(AXIS_DP))
@@ -353,7 +353,7 @@ class PipelineBackend(SPMDBackendBase):
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
-            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec()),
+            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_spec(self.cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(5,))
 
@@ -369,9 +369,9 @@ class PipelineBackend(SPMDBackendBase):
             body,
             in_specs=(
                 self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
-                cache_spec(),
+                cache_spec(self.cfg),
             ),
-            out_specs=cache_spec(),
+            out_specs=cache_spec(self.cfg),
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
@@ -432,9 +432,9 @@ class PipelineBackend(SPMDBackendBase):
             body,
             in_specs=(
                 self._shared_specs, self._layer_specs, state_specs,
-                cache_spec(), P(), sparam_specs,
+                cache_spec(self.cfg), P(), sparam_specs,
             ),
-            out_specs=(P(), P(), state_specs, cache_spec()),
+            out_specs=(P(), P(), state_specs, cache_spec(self.cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(3,))
 
@@ -558,7 +558,7 @@ class PipelineBackend(SPMDBackendBase):
             return out, n_gen, cache
 
         specs = [
-            self._shared_specs, self._layer_specs, P(AXIS_DP), cache_spec(),
+            self._shared_specs, self._layer_specs, P(AXIS_DP), cache_spec(self.cfg),
             P(), P(), P(), P(),
         ]
         if ragged:
@@ -567,7 +567,7 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(P(AXIS_DP))
         if with_bias:
             specs.append(P())
-        out_specs = [P(AXIS_DP), P(AXIS_DP), cache_spec()]
+        out_specs = [P(AXIS_DP), P(AXIS_DP), cache_spec(self.cfg)]
         if with_logprobs:
             out_specs.append(P(AXIS_DP))
         shmapped = self._shard(
@@ -615,10 +615,10 @@ class PipelineBackend(SPMDBackendBase):
             body,
             in_specs=(
                 self._shared_specs, self._layer_specs, P(AXIS_DP), P(),
-                cache_spec(),
+                cache_spec(self.cfg),
             ),
             out_specs=(
-                P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), cache_spec()
+                P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), cache_spec(self.cfg)
             ),
         )
         return jax.jit(shmapped, donate_argnums=(4,))
@@ -672,10 +672,10 @@ class PipelineBackend(SPMDBackendBase):
         shmapped = self._shard(
             body,
             in_specs=(
-                self._shared_specs, self._layer_specs, P(), cache_spec(),
+                self._shared_specs, self._layer_specs, P(), cache_spec(self.cfg),
                 P(), P(), P(),
             ),
-            out_specs=(P(), P(), cache_spec()),
+            out_specs=(P(), P(), cache_spec(self.cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(3,))
 
@@ -735,9 +735,9 @@ class PipelineBackend(SPMDBackendBase):
             body,
             in_specs=(
                 self._shared_specs, self._layer_specs, P(), P(),
-                cache_spec(), P(), P(), P(),
+                cache_spec(self.cfg), P(), P(), P(),
             ),
-            out_specs=(P(), P(), cache_spec(), P()),
+            out_specs=(P(), P(), cache_spec(self.cfg), P()),
         )
         return jax.jit(shmapped, donate_argnums=(4, 5))
 
@@ -793,9 +793,9 @@ class PipelineBackend(SPMDBackendBase):
         shmapped = self._shard(
             body,
             in_specs=(
-                self._shared_specs, self._layer_specs, P(), cache_spec(),
+                self._shared_specs, self._layer_specs, P(), cache_spec(self.cfg),
                 P(), P(), P(),
             ),
-            out_specs=(P(), P(), P(), cache_spec()),
+            out_specs=(P(), P(), P(), cache_spec(self.cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(3,))

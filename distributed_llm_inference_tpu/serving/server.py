@@ -709,9 +709,10 @@ def main(argv: Optional[list] = None):
         "--kv-quant", default=None, choices=[None, "int8"],
         help="KV-CACHE quantization: int8 K/V with per-(token, head) "
              "scales halves cache HBM — 2x the --continuous slots or "
-             "context window at the same budget (llama family, single "
-             "chip, dense caches; excludes --kv-pool-blocks, "
-             "--prefix-cache and --attn-impl pallas)",
+             "context window at the same budget (llama family; single "
+             "chip or a pp/tp/dp pipeline mesh; dense caches — excludes "
+             "--kv-pool-blocks, --prefix-cache, --sp and "
+             "--attn-impl pallas)",
     )
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)

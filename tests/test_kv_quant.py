@@ -137,8 +137,8 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
     from distributed_llm_inference_tpu.runtime import create_backend
     from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
 
-    with pytest.raises(NotImplementedError, match="single-device"):
-        create_backend(cfg, kv_quant="int8", mesh_cfg=MeshConfig(pp=2))
+    with pytest.raises(NotImplementedError, match="raw-dtype"):
+        create_backend(cfg, kv_quant="int8", mesh_cfg=MeshConfig(sp=2))
     qcfg = cfg.replace(kv_quant="int8")
     with pytest.raises(ValueError, match="paged"):
         ContinuousEngine(
@@ -154,3 +154,39 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
                 prefill_buckets=(32,), prefix_cache_entries=2
             ),
         )
+
+
+@pytest.mark.slow
+def test_pp_mesh_kv_quant_matches_single_device(raw_engine, eight_devices):
+    """The pp pipeline serves kv_quant="int8" with the same greedy text as
+    the single-device quantized engine (quantization is per-layer local,
+    so stage placement cannot change the written values) — the
+    one-topology-full-surface property extended to the cache strategy."""
+    from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
+    from distributed_llm_inference_tpu.runtime import create_engine
+
+    qcfg = raw_engine.cfg.replace(kv_quant="int8")
+    solo = InferenceEngine(
+        qcfg, params=raw_engine.backend.params,
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+    )
+    pp = create_engine(
+        qcfg, mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        params=raw_engine.backend.params,
+    )
+    for prompt in PROMPTS[:2]:
+        w = solo.generate(prompt, greedy=True, chat=False, max_tokens=10)
+        g = pp.generate(prompt, greedy=True, chat=False, max_tokens=10)
+        assert g["status"] == "success"
+        assert g["response"] == w["response"]
+
+
+def test_kv_quant_microbatch_still_rejected():
+    # (the sp=2 rejection is asserted in test_kv_quant_rejects_illegal_combos)
+    from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
+    from distributed_llm_inference_tpu.runtime import create_backend
+
+    cfg = get_model_config("test-llama-tiny", kv_quant="int8")
+    with pytest.raises(NotImplementedError, match="raw-dtype"):
+        create_backend(cfg, mesh_cfg=MeshConfig(pp=2), microbatches=2)
