@@ -419,49 +419,56 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
-    # fleet-decode leg: 8 slots over an 8k window at position ~1k — the
-    # over-provisioned-window case the per-row flash kernel
-    # (ops/paged_attention.flash_attend_slots) exists for. The XLA path
-    # reads the whole 8 x 8192 bf16 fleet cache every step (~1.5 GB,
-    # comfortably inside v5e HBM next to the 2.2 GB params even with
-    # XLA's fp32 attention temps — 16 x 16k OOMed) regardless of
-    # occupancy; the kernel reads each row's live prefix (~13% of it at
-    # these positions). Fully fenced.
-    fleet_xla_tok_s = fleet_pl_tok_s = None
+    # fleet-attention leg: the per-row flash decode kernel
+    # (ops/paged_attention.flash_attend_slots) vs the XLA einsum over an
+    # 8-slot 8k-window fleet cache at position ~1k — the
+    # over-provisioned-window case the kernel targets. Driven DIRECTLY
+    # (the serving hook always takes the XLA path for T=1 decode, where
+    # the einsum measured decisively faster); this leg is the regression
+    # baseline future kernel work has to beat. Fully fenced.
+    fleet_xla_ms = fleet_pl_ms = None
     if on_tpu and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
         try:
-            FB, FS, FPOS, FSTEPS = 8, 8192, 1024, 16
+            from distributed_llm_inference_tpu.ops.attention import (
+                attend, slot_causal_mask,
+            )
+            from distributed_llm_inference_tpu.ops.paged_attention import (
+                flash_attend_slots,
+            )
 
-            def time_fleet(c):
-                state, sparams = G.init_slots(FB, c.vocab_size)
-                state = state._replace(
-                    token=jnp.full((FB,), 7, jnp.int32),
-                    pos=jnp.full((FB,), FPOS, jnp.int32),
-                    active=jnp.ones((FB,), bool),
-                    remaining=jnp.full((FB,), 1 << 20, jnp.int32),
-                )
-                st, cf = state, M.init_kv_cache(c, FB, max_seq=FS)
+            FB, FS, FPOS = 8, 8192, 1024
+            fk = jax.random.split(jax.random.PRNGKey(5), 3)
+            fq = jax.random.normal(
+                fk[0], (FB, 1, cfg.n_heads, cfg.head_dim), jnp.bfloat16
+            )
+            fck = jax.random.normal(
+                fk[1], (FB, cfg.n_kv_heads, FS, cfg.head_dim), jnp.bfloat16
+            )
+            fcv = jax.random.normal(
+                fk[2], (FB, cfg.n_kv_heads, FS, cfg.head_dim), jnp.bfloat16
+            )
+            fpos = jnp.full((FB,), FPOS, jnp.int32)
+            fmask = slot_causal_mask(fpos, 1, FS)
 
-                def run():
-                    # decode_slots donates the cache: thread it (and the
-                    # advancing state) through every chained call
-                    nonlocal st, cf
-                    for _ in range(K):
-                        _, _, st, cf = G.decode_slots(
-                            c, params, st, cf, kd, sparams,
-                            num_steps=FSTEPS,
-                        )
-                    fetch(st.pos)
+            # operands are ARGUMENTS, not closure constants — a nullary
+            # jit constant-folds the whole computation into the
+            # executable and times nothing but the fetch
+            att_x = jax.jit(attend)
+            att_p = jax.jit(
+                lambda q_, k_, v_, p_: flash_attend_slots(q_, k_, v_, p_)
+            )
 
-                run()  # warm/compile
-                t = max(
-                    min(_timed(run)[0] for _ in range(n_reps)) - rtt, 1e-9
-                ) / K
-                del cf
-                return FB * FSTEPS / t
+            def time_attn(fn, *args, n=20):
+                fetch(fn(*args))  # warm/compile + drain
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    o = fn(*args)
+                fetch(o)
+                return max(time.perf_counter() - t0 - rtt, 1e-9) / n * 1e3
 
-            fleet_xla_tok_s = time_fleet(cfg)
-            fleet_pl_tok_s = time_fleet(cfg.replace(attn_impl="pallas"))
+            fleet_xla_ms = time_attn(att_x, fq, fck, fcv, fmask)
+            fleet_pl_ms = time_attn(att_p, fq, fck, fcv, fpos)
+            del fck, fcv
         except Exception:  # noqa: BLE001 - optional leg, never fatal
             import traceback
 
@@ -534,10 +541,10 @@ def run_benchmark():
         result["prefill_xla_1k_tok_s"] = round(flash_xla_tok_s, 1)
     if flash_pl_tok_s is not None:
         result["prefill_flash_1k_tok_s"] = round(flash_pl_tok_s, 1)
-    if fleet_xla_tok_s is not None:
-        result["fleet8_8k_xla_tok_s"] = round(fleet_xla_tok_s, 1)
-    if fleet_pl_tok_s is not None:
-        result["fleet8_8k_flash_tok_s"] = round(fleet_pl_tok_s, 1)
+    if fleet_xla_ms is not None:
+        result["fleet_attn_xla_ms"] = round(fleet_xla_ms, 3)
+    if fleet_pl_ms is not None:
+        result["fleet_attn_flash_ms"] = round(fleet_pl_ms, 3)
     if int8_tok_s is not None:
         result["int8_tokens_per_sec"] = round(int8_tok_s, 3)
         if peak_bw:

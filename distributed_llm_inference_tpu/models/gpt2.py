@@ -115,7 +115,9 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
         attn = attend(q, new_k, new_v, mask)
     else:
         new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-        if cfg.attn_impl == "pallas":
+        if cfg.attn_impl == "pallas" and q.shape[1] > 1:
+            # T>1 chunks only — same policy (and measurements) as
+            # llama.default_attn_hook: flash wins prefill, loses decode
             attn = flash_attend(q, new_k, new_v, pos)
         else:
             attn = attend(q, new_k, new_v, mask)

@@ -164,9 +164,13 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
 
     pos may be a PER-ROW [B] vector (continuous batching: each slot at its
     own position) — the cache write becomes a vmapped per-row update and
-    decode attention under attn_impl="pallas" runs the per-row flash
-    kernel (ops/paged_attention.flash_attend_slots; the scalar-pos flash
-    kernel's grid offsets assume one shared frontier).
+    attention uses the XLA path.
+
+    attn_impl="pallas" applies to T>1 chunks only (prefill / chunked
+    ingest / speculative verify — the compute-bound phases where the
+    flash kernel measured 1.5x XLA); every T=1 decode step keeps the XLA
+    einsum, which measured decisively faster (15x on the solo loop, see
+    the inline notes).
 
     An int8 cache (ops/kv_quant.KVQuant leaves, cfg.kv_quant="int8")
     dispatches on the leaf type: quantize-on-write, dequantize into the
@@ -185,27 +189,26 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
         new_k, new_v = update_kv_cache_slots(
             cache_k, cache_v, k, v, pos, gate=update_gate
         )
-        if cfg.attn_impl == "pallas" and q.shape[1] == 1:
-            # Per-row flash decode (ops/paged_attention.flash_attend_slots):
-            # each fleet row reads only its LIVE prefix, where the XLA
-            # path reads the whole B x S cache every step. Same legality
-            # envelope as the scalar-pos kernel (__post_init__). Opt-in:
-            # measured ~2x slower than the XLA einsum on v5e at serving
-            # sizes (see _slots_kernel's docstring) — the default stays
-            # "xla"; bench.py's fleet leg tracks the gap.
-            from ..ops.paged_attention import flash_attend_slots
-
-            attn = flash_attend_slots(
-                q, new_k, new_v, pos, window=cfg.attn_window
-            )
-        else:
-            attn = attend(
-                q, new_k, new_v, mask,
-                scale=cfg.query_scale, softcap=cfg.attn_softcap,
-            )
+        # Always the XLA einsum here, even under attn_impl="pallas":
+        # fleet decode is T=1 and measured FASTER on XLA than the per-row
+        # kernel (ops/paged_attention.flash_attend_slots, v5e: 395 vs
+        # 382 tok/s end to end, ~1.00 vs ~1.08 ms at the attention
+        # level). The kernel stays exported/tested and bench.py's fleet
+        # leg tracks the gap every round.
+        attn = attend(
+            q, new_k, new_v, mask,
+            scale=cfg.query_scale, softcap=cfg.attn_softcap,
+        )
         return attn, new_k, new_v
     new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-    if cfg.attn_impl == "pallas":
+    if cfg.attn_impl == "pallas" and q.shape[1] > 1:
+        # Flash kernel for the COMPUTE-bound chunks only (prefill,
+        # chunked ingest, speculative verify): measured 1.5x the XLA
+        # prefill throughput on v5e at 1k prompts (bench flash leg). At
+        # T=1 the same kernel INSIDE the decode loop measured 15x slower
+        # than the einsum (per-step kernel overhead with no flops to
+        # hide it under), so decode always takes the XLA path — this
+        # gate is what makes "--attn-impl pallas/auto" strictly a win.
         attn = flash_attend(
             q, new_k, new_v, pos, valid_start, window=cfg.attn_window
         )

@@ -226,15 +226,15 @@ def _slots_kernel(
     multiplies on paper, but they ride an MXU that was idling, and the
     program count drops by KV x.
 
-    Measured honestly (v5e, TinyLlama fleet, 8 x 8192 cache at pos 1024):
-    ~11 ms/call vs the XLA einsum's ~4.8 ms — decode attention at serving
-    sizes is dominated by fixed per-call/pipelining overhead, not by the
-    cache bytes this kernel avoids reading, and XLA's fused masked
-    attention amortizes that overhead across the whole batched einsum.
-    That is why NOTHING selects this kernel by default: attn_impl stays
-    "xla" unless explicitly requested, and bench.py's fleet leg records
-    both numbers every round so future kernel work (splash-style
-    multi-tile pipelining) has a regression baseline to beat.
+    Measured on v5e (TinyLlama, 8 x 8192 fleet cache at pos 1024):
+    ~1.08 ms/call vs the XLA einsum's ~1.00 ms at the attention level
+    (bench.py's fleet leg re-measures both every round), and 382 vs 395
+    tok/s inside the full end-to-end fleet decode step — the live-prefix
+    DMA savings do not yet overcome Mosaic pipelining overhead against
+    XLA's fused masked einsum. That is why the serving hook never
+    selects this kernel: decode stays on the XLA path regardless of
+    attn_impl, and this kernel is the baseline future work (splash-style
+    multi-tile pipelining) has to beat.
     """
     b = pl.program_id(0)
     j = pl.program_id(1)
@@ -321,9 +321,9 @@ def flash_attend_slots(
     the shared-scalar-position counterpart (its grid offsets assume one
     frontier for the whole batch; this kernel's are per-row).
 
-    Opt-in only (attn_impl="pallas"): see `_slots_kernel` — on v5e at
-    serving sizes the XLA einsum is ~2x faster despite reading the whole
-    cache; bench.py's fleet leg tracks the gap each round.
+    Not reachable from the serving hook: see `_slots_kernel` — on v5e
+    at serving sizes the XLA einsum still edges it out end to end;
+    bench.py's fleet leg tracks the attention-level gap each round.
 
     q [B,1,H,Dh] (decode, T=1); cache_k/v [B,KV,S,Dh]; pos [B] int32.
     Returns [B,1,H,Dh] in q.dtype.
