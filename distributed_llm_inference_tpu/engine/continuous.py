@@ -632,6 +632,7 @@ class ContinuousEngine:
             k.get("temperature", 0.7), k.get("top_k", 50),
             k.get("top_p", 0.9), k.get("greedy", False),
             k.get("min_p", 0.0), k.get("repetition_penalty", 1.0),
+            k.get("frequency_penalty", 0.0), k.get("presence_penalty", 0.0),
         )
         key = self._next_key()
         scratch = self._scratch
@@ -665,6 +666,7 @@ class ContinuousEngine:
                 first[0], jnp.int32(prompt_len), jnp.int32(max_tokens),
                 sampling.temperature, sampling.top_k, sampling.top_p,
                 sampling.greedy, sampling.min_p, sampling.rep_penalty,
+                sampling.freq_penalty, sampling.pres_penalty,
                 presence_row,
             )
             if self.paged:

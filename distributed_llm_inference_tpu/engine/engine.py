@@ -105,12 +105,12 @@ class SingleDeviceBackend:
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, presence=None, bias=None, *, max_steps,
-               with_logprobs=False):
+               valid_start=None, presence=None, counts=None, bias=None,
+               *, max_steps, with_logprobs=False):
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
-            sampling, valid_start, presence, bias, max_steps=max_steps,
-            with_logprobs=with_logprobs,
+            sampling, valid_start, presence, counts, bias,
+            max_steps=max_steps, with_logprobs=with_logprobs,
         )
 
     # OpenAI logit_bias ([V] added to raw logits each sample)
@@ -138,6 +138,8 @@ class SingleDeviceBackend:
     supports_speculative = True
     # HF-parity repetition penalty (presence-tracked decode variants)
     supports_presence = True
+    # OpenAI frequency/presence penalties (generated-count state)
+    supports_counts = True
     # per-token logprobs (decode program variant with a logprob buffer)
     supports_logprobs = True
     # slot decode for continuous batching (engine/continuous.py);
@@ -466,6 +468,8 @@ class InferenceEngine:
         speculative: bool = False,
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
         stop: Optional[list] = None,
         logprobs: bool = False,
         logit_bias: Optional[dict] = None,
@@ -487,6 +491,11 @@ class InferenceEngine:
         (MinPLogitsWarper / RepetitionPenaltyLogitsProcessor; 0.0 / 1.0 =
         off). A repetition penalty disables speculation: it changes the
         argmax the draft verification compares against.
+        frequency_penalty / presence_penalty: the OpenAI penalties over
+        GENERATED-token counts (logits -= fp*count + pp*(count>0); 0.0 =
+        off, the usual [-2, 2] range accepted). Like the repetition
+        penalty they ride the pre-warper slot, apply to greedy argmax
+        too, and disable speculation.
         logit_bias: {token_id: bias} added to the raw logits at every
         sample (OpenAI semantics; -100/+100 ban/force). Also disables
         speculation (it changes the verify argmax), and reported
@@ -506,6 +515,8 @@ class InferenceEngine:
                 seed, min_p, repetition_penalty, stop, t_start,
                 debug=debug, speculative=speculative, logprobs=logprobs,
                 logit_bias=logit_bias, num_beams=num_beams,
+                frequency_penalty=frequency_penalty,
+                presence_penalty=presence_penalty,
             )
 
         def locked():
@@ -519,6 +530,7 @@ class InferenceEngine:
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
                     repetition_penalty, stop, logprobs, logit_bias,
+                    frequency_penalty, presence_penalty,
                 )
 
         try:
@@ -537,6 +549,7 @@ class InferenceEngine:
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, min_p, repetition_penalty, stop, t_start, *, debug,
         speculative, logprobs, logit_bias, num_beams,
+        frequency_penalty=0.0, presence_penalty=0.0,
     ):
         """Solo request on a fleet-granular backend (pipeline-1f1b):
         delegate to generate_batch([prompt]) — which pads the fleet up to
@@ -547,6 +560,8 @@ class InferenceEngine:
                 ("debug", debug), ("speculative", speculative),
                 ("logprobs", logprobs), ("logit_bias", logit_bias is not None),
                 ("num_beams", num_beams > 1),
+                ("frequency_penalty/presence_penalty",
+                 frequency_penalty != 0.0 or presence_penalty != 0.0),
             ) if on
         ]
         if unsupported:
@@ -1060,6 +1075,11 @@ class InferenceEngine:
                 pres = dkw["presence"]
                 pres = pres.at[0, jnp.asarray(row, jnp.int32)].set(True)
                 dkw = dict(dkw, presence=pres)
+            if dkw.get("counts") is not None and row:
+                # scatter-add accumulates duplicate ids within the chunk
+                cnt = dkw["counts"]
+                cnt = cnt.at[0, jnp.asarray(row, jnp.int32)].add(1)
+                dkw = dict(dkw, counts=cnt)
             text = self.tokenizer.decode(
                 ([first_id] if first_id not in self.cfg.all_stop_ids else [])
                 + collected,
@@ -1077,6 +1097,7 @@ class InferenceEngine:
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False, min_p=0.0,
         repetition_penalty=1.0, stop=None, logprobs=False, logit_bias=None,
+        frequency_penalty=0.0, presence_penalty=0.0,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -1127,10 +1148,13 @@ class InferenceEngine:
         spec_ok = (
             speculative
             and greedy
-            # a repetition penalty or logit bias changes the argmax the
-            # draft verification compares against — plain decode instead;
-            # and the speculative loop records no per-step logprobs
+            # a repetition/OpenAI penalty or logit bias changes the argmax
+            # the draft verification compares against — plain decode
+            # instead; and the speculative loop records no per-step
+            # logprobs
             and repetition_penalty == 1.0
+            and frequency_penalty == 0.0
+            and presence_penalty == 0.0
             and bias is None
             and not logprobs
         )
@@ -1152,7 +1176,8 @@ class InferenceEngine:
         )
 
         sampling = G.default_sampling(
-            temperature, top_k, top_p, greedy, min_p, repetition_penalty
+            temperature, top_k, top_p, greedy, min_p, repetition_penalty,
+            frequency_penalty, presence_penalty,
         )
         # presence (repetition-penalty token set): only materialized when
         # the penalty is on, so the reference-parity path keeps its exact
@@ -1164,6 +1189,13 @@ class InferenceEngine:
                 f"backend {self.backend.name!r} does not support "
                 f"repetition_penalty; serve penalized requests on the "
                 f"single-device or pipeline backend"
+            )
+        oai_pen = frequency_penalty != 0.0 or presence_penalty != 0.0
+        if oai_pen and not getattr(self.backend, "supports_counts", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support "
+                f"frequency_penalty/presence_penalty; serve penalized "
+                f"requests on the single-device or pipeline backend"
             )
         presence = (
             self._presence_rows([ids]) if repetition_penalty != 1.0 else None
@@ -1210,6 +1242,13 @@ class InferenceEngine:
                 presence = G.presence_update(presence, first.reshape(1))
             step_lps = None
             dkw = {"presence": presence}
+            if oai_pen:
+                # OpenAI-penalty state: GENERATED counts only, seeded with
+                # the (generated) first token — prompt tokens excluded
+                dkw["counts"] = G.count_update(
+                    jnp.zeros((1, cfg.vocab_size), jnp.int32),
+                    first.reshape(1),
+                )
             if bias is not None:  # backends without the kwarg stay untouched
                 dkw["bias"] = bias
             if stop:
@@ -1516,6 +1555,8 @@ class InferenceEngine:
         seed: Optional[int] = None,
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
+        frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
         stop: Optional[list] = None,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).
@@ -1536,6 +1577,7 @@ class InferenceEngine:
                 return self._generate_batch_locked(
                     prompts, max_tokens, temperature, top_k, top_p, greedy,
                     chat, seed, t_start, min_p, repetition_penalty, stop,
+                    frequency_penalty, presence_penalty,
                 )
 
         try:
@@ -1551,6 +1593,7 @@ class InferenceEngine:
     def _generate_batch_locked(
         self, prompts, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, min_p=0.0, repetition_penalty=1.0, stop=None,
+        frequency_penalty=0.0, presence_penalty=0.0,
     ):
         cfg = self.cfg
         if not prompts or not all(isinstance(p, str) and p for p in prompts):
@@ -1593,7 +1636,8 @@ class InferenceEngine:
         )
         valid_start = jnp.asarray([bucket - n for n in row_lens], jnp.int32)
         sampling = G.default_sampling(
-            temperature, top_k, top_p, greedy, min_p, repetition_penalty
+            temperature, top_k, top_p, greedy, min_p, repetition_penalty,
+            frequency_penalty, presence_penalty,
         )
         if repetition_penalty != 1.0 and not getattr(
             self.backend, "supports_presence", False
@@ -1602,6 +1646,13 @@ class InferenceEngine:
                 f"backend {self.backend.name!r} does not support "
                 f"repetition_penalty; serve penalized requests on the "
                 f"single-device or pipeline backend"
+            )
+        oai_pen = frequency_penalty != 0.0 or presence_penalty != 0.0
+        if oai_pen and not getattr(self.backend, "supports_counts", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support "
+                f"frequency_penalty/presence_penalty; serve penalized "
+                f"requests on the single-device or pipeline backend"
             )
         presence = (
             self._presence_rows(rows) if repetition_penalty != 1.0 else None
@@ -1628,9 +1679,17 @@ class InferenceEngine:
             first = first.at[B:].set(cfg.eos_token_id)
         if presence is not None:
             presence = G.presence_update(presence, first)
+        counts = None
+        if oai_pen:
+            # generated-count rows seeded with each row's first token
+            # (dummy pad rows got EOS firsts above — they never emit)
+            counts = G.count_update(
+                jnp.zeros((Bb, cfg.vocab_size), jnp.int32), first
+            )
         out, n_gen, cache = self.backend.decode(
             first, cache, jnp.int32(bucket), jnp.int32(max_tokens - 1),
-            key_dec, sampling, valid_start, presence, max_steps=decode_bucket,
+            key_dec, sampling, valid_start, presence, counts,
+            max_steps=decode_bucket,
         )
         out = jax.block_until_ready(out)
         # keep at most ONE batch cache (the bucket just used): an entry per

@@ -50,16 +50,35 @@ class SamplingParams(NamedTuple):
     greedy: jnp.ndarray  # bool scalar
     min_p: jnp.ndarray  # f32 scalar, <=0 disables
     rep_penalty: jnp.ndarray  # f32 scalar, 1.0 disables
+    freq_penalty: jnp.ndarray  # f32 scalar, 0.0 disables (OpenAI)
+    pres_penalty: jnp.ndarray  # f32 scalar, 0.0 disables (OpenAI)
 
 
 def default_sampling(
     temperature=0.7, top_k=50, top_p=0.9, greedy=False, min_p=0.0,
-    rep_penalty=1.0,
+    rep_penalty=1.0, freq_penalty=0.0, pres_penalty=0.0,
 ) -> SamplingParams:
     return SamplingParams(
         jnp.float32(temperature), jnp.int32(top_k), jnp.float32(top_p),
         jnp.bool_(greedy), jnp.float32(min_p), jnp.float32(rep_penalty),
+        jnp.float32(freq_penalty), jnp.float32(pres_penalty),
     )
+
+
+def count_update(
+    counts: jnp.ndarray, tokens: jnp.ndarray, active: jnp.ndarray = None
+) -> jnp.ndarray:
+    """Increment tokens [B]'s generated-count in counts [B, V] (OpenAI
+    frequency/presence-penalty state). active [B]: rows whose emission
+    really happened (finished rows keep forwarding pad; their counts are
+    frozen so a later tenant of the row starts clean arithmetic)."""
+    V = counts.shape[-1]
+    hit = (
+        jnp.arange(V, dtype=jnp.int32)[None, :] == tokens[:, None]
+    ).astype(counts.dtype)
+    if active is not None:
+        hit = hit * active.astype(counts.dtype)[:, None]
+    return counts + hit
 
 
 def presence_update(presence: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
@@ -158,6 +177,7 @@ def decode(
     sampling: SamplingParams,
     valid_start=None,
     presence=None,
+    counts=None,
     bias=None,
     *,
     max_steps: int,
@@ -191,27 +211,34 @@ def decode(
     # dummy so the loop structure stays static
     use_presence = presence is not None
     pres0 = presence if use_presence else jnp.zeros((B, 1), jnp.bool_)
+    # counts [B, V] int32: OpenAI frequency/presence-penalty state over
+    # GENERATED tokens only (first_token counted by the caller); None =
+    # penalties off, carried as a dummy so the loop structure stays static
+    use_counts = counts is not None
+    cnt0 = counts if use_counts else jnp.zeros((B, 1), jnp.int32)
 
     lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
 
     def cond(c):
-        step, _, _, _, _, finished, _, _, _, _ = c
+        step, _, _, _, _, finished, _, _, _, _, _ = c
         return (step < limit) & ~jnp.all(finished)
 
     def body(c):
-        step, token, pos, cache, key, finished, out, n_gen, pres, lps = c
+        step, token, pos, cache, key, finished, out, n_gen, pres, cnt, lps = c
         logits, cache = _forward_step(
             cfg, params, token[:, None], cache, pos, valid_start
         )
         key, sub = jax.random.split(key)
         nxt = sample_token(
             sub, logits, *sampling, presence=pres if use_presence else None,
-            bias=bias,
+            counts=cnt if use_counts else None, bias=bias,
         )
         if use_presence:
             pres = presence_update(pres, nxt)
         is_eos = stop_mask(cfg, nxt)
         newly_finished = finished | is_eos
+        if use_counts:
+            cnt = count_update(cnt, nxt, ~newly_finished)
         emit = jnp.where(newly_finished, pad, nxt)
         out = jax.lax.dynamic_update_slice(out, emit[:, None], (jnp.int32(0), step))
         if with_logprobs:
@@ -222,7 +249,7 @@ def decode(
         token = jnp.where(newly_finished, pad, nxt)
         return (
             step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen,
-            pres, lps,
+            pres, cnt, lps,
         )
 
     init = (
@@ -235,9 +262,12 @@ def decode(
         out0,
         jnp.zeros((B,), jnp.int32),
         pres0,
+        cnt0,
         lp0,
     )
-    _, _, _, cache, _, _, out, n_gen, _, lps = jax.lax.while_loop(cond, body, init)
+    (_, _, _, cache, _, _, out, n_gen, _, _, lps) = jax.lax.while_loop(
+        cond, body, init
+    )
     if with_logprobs:
         return out, n_gen, cache, lps
     return out, n_gen, cache
@@ -267,6 +297,8 @@ class SlotParams(NamedTuple):
     greedy: jnp.ndarray  # bool [B]
     min_p: jnp.ndarray  # f32 [B]
     rep_penalty: jnp.ndarray  # f32 [B]
+    freq_penalty: jnp.ndarray  # f32 [B] (OpenAI frequency_penalty)
+    pres_penalty: jnp.ndarray  # f32 [B] (OpenAI presence_penalty)
 
 
 class SlotState(NamedTuple):
@@ -280,6 +312,9 @@ class SlotState(NamedTuple):
          max_tokens - 1: the prefill token was #0, like decode's limit).
     presence: [B, V] seen-token set per slot (repetition-penalty state:
          prompt + emitted; armed by insert_slot, updated every step).
+    counts: [B, V] generated-token counts per slot (OpenAI frequency/
+         presence-penalty state: emitted only, prompt excluded; armed by
+         insert_slot with the first token, updated every step).
     """
 
     token: jnp.ndarray  # i32 [B]
@@ -287,6 +322,7 @@ class SlotState(NamedTuple):
     active: jnp.ndarray  # bool [B]
     remaining: jnp.ndarray  # i32 [B]
     presence: jnp.ndarray  # bool [B, V]
+    counts: jnp.ndarray  # i32 [B, V]
 
 
 def init_slots(n_slots: int, vocab_size: int) -> tuple[SlotState, SlotParams]:
@@ -295,6 +331,7 @@ def init_slots(n_slots: int, vocab_size: int) -> tuple[SlotState, SlotParams]:
         SlotState(
             z, z, jnp.zeros((n_slots,), bool), z,
             jnp.zeros((n_slots, vocab_size), bool),
+            jnp.zeros((n_slots, vocab_size), jnp.int32),
         ),
         SlotParams(
             jnp.ones((n_slots,), jnp.float32),
@@ -303,6 +340,8 @@ def init_slots(n_slots: int, vocab_size: int) -> tuple[SlotState, SlotParams]:
             jnp.ones((n_slots,), bool),
             jnp.zeros((n_slots,), jnp.float32),
             jnp.ones((n_slots,), jnp.float32),
+            jnp.zeros((n_slots,), jnp.float32),
+            jnp.zeros((n_slots,), jnp.float32),
         ),
     )
 
@@ -370,7 +409,10 @@ def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
         sparams.greedy,
         sparams.min_p[:, None],
         sparams.rep_penalty[:, None],
-        state.presence,
+        sparams.freq_penalty[:, None],
+        sparams.pres_penalty[:, None],
+        presence=state.presence,
+        counts=state.counts,
     )
     # break-before-append EOS semantics (orchestration.py:181-186)
     can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
@@ -381,6 +423,7 @@ def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
         active=can_emit & (state.remaining > 1),
         remaining=state.remaining - can_emit.astype(jnp.int32),
         presence=presence_update(state.presence, nxt),
+        counts=count_update(state.counts, nxt, can_emit),
     )
     return new, emit, can_emit
 
@@ -402,6 +445,8 @@ def insert_slot(
     greedy,
     min_p,
     rep_penalty,
+    freq_penalty,
+    pres_penalty,
     presence_row,
 ):
     """Splice a freshly prefilled scratch cache (batch=1, same max_seq) into
@@ -425,14 +470,15 @@ def insert_slot(
     cache = jax.tree.map(splice, cache, scratch)
     state, sparams = arm_slot(
         cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
-        temperature, top_k, top_p, greedy, min_p, rep_penalty, presence_row,
+        temperature, top_k, top_p, greedy, min_p, rep_penalty,
+        freq_penalty, pres_penalty, presence_row,
     )
     return cache, state, sparams
 
 
 def arm_slot(cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
              temperature, top_k, top_p, greedy, min_p, rep_penalty,
-             presence_row):
+             freq_penalty, pres_penalty, presence_row):
     """Arm slot row `slot`'s decode state + sampling knobs after its prompt
     K/V landed. ONE copy of the budget / EOS-on-first / presence arming —
     insert_slot (dense fleet) and engine/paged.insert_slot_paged (block
@@ -445,12 +491,18 @@ def arm_slot(cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
     presence_row = presence_row | (
         jnp.arange(state.presence.shape[-1], dtype=jnp.int32) == first_token
     )
+    # counts_row [V]: the slot's OpenAI-penalty state starts at just the
+    # first (generated) token — the prompt is excluded by OpenAI semantics
+    counts_row = (
+        jnp.arange(state.counts.shape[-1], dtype=jnp.int32) == first_token
+    ).astype(jnp.int32)
     state = SlotState(
         token=state.token.at[slot].set(first_token),
         pos=state.pos.at[slot].set(prompt_len),
         active=state.active.at[slot].set(budget > 0),
         remaining=state.remaining.at[slot].set(budget),
         presence=state.presence.at[slot].set(presence_row),
+        counts=state.counts.at[slot].set(counts_row),
     )
     sparams = SlotParams(
         temperature=sparams.temperature.at[slot].set(temperature),
@@ -459,6 +511,8 @@ def arm_slot(cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
         greedy=sparams.greedy.at[slot].set(greedy),
         min_p=sparams.min_p.at[slot].set(min_p),
         rep_penalty=sparams.rep_penalty.at[slot].set(rep_penalty),
+        freq_penalty=sparams.freq_penalty.at[slot].set(freq_penalty),
+        pres_penalty=sparams.pres_penalty.at[slot].set(pres_penalty),
     )
     return state, sparams
 

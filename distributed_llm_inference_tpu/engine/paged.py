@@ -237,6 +237,8 @@ def insert_slot_paged(
     greedy,
     min_p,
     rep_penalty,
+    freq_penalty,
+    pres_penalty,
     presence_row,
 ):
     """Scatter a freshly prefilled CONTIGUOUS scratch cache (batch=1,
@@ -263,6 +265,7 @@ def insert_slot_paged(
     pool = jax.tree.map(scatter, pool, scratch)
     state, sparams = G.arm_slot(
         cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
-        temperature, top_k, top_p, greedy, min_p, rep_penalty, presence_row,
+        temperature, top_k, top_p, greedy, min_p, rep_penalty,
+        freq_penalty, pres_penalty, presence_row,
     )
     return pool, state, sparams

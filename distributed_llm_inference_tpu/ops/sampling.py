@@ -74,6 +74,28 @@ def apply_repetition_penalty(
     return jnp.where((p <= 0) | (p == 1.0), logits, out)
 
 
+def apply_oai_penalties(
+    logits: jnp.ndarray,
+    counts: jnp.ndarray,
+    freq_penalty: jnp.ndarray,
+    pres_penalty: jnp.ndarray,
+) -> jnp.ndarray:
+    """OpenAI frequency/presence penalties over GENERATED-token counts:
+
+        logits -= freq_penalty * count + pres_penalty * (count > 0)
+
+    (the OpenAI API reference's published formula; counts cover sampled
+    tokens only, not the prompt — the same only-the-output convention the
+    major open-source OpenAI-compatible servers use, vs the HF repetition
+    penalty's prompt+output membership set). 0.0 disables either term;
+    counts: [..., V] int32."""
+    f = jnp.asarray(freq_penalty, jnp.float32)
+    pr = jnp.asarray(pres_penalty, jnp.float32)
+    c = counts.astype(jnp.float32)
+    out = logits - f * c - pr * (c > 0).astype(jnp.float32)
+    return jnp.where((f == 0.0) & (pr == 0.0), logits, out)
+
+
 def min_p_filter(logits: jnp.ndarray, min_p: jnp.ndarray) -> jnp.ndarray:
     """HF MinPLogitsWarper: drop tokens whose probability is below
     min_p * max_prob (a dynamic floor that adapts to the model's
@@ -94,7 +116,10 @@ def sample_token(
     greedy: jnp.ndarray,
     min_p: jnp.ndarray = None,
     rep_penalty: jnp.ndarray = None,
+    freq_penalty: jnp.ndarray = None,
+    pres_penalty: jnp.ndarray = None,
     presence: jnp.ndarray = None,
+    counts: jnp.ndarray = None,
     bias: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Full sampling stack -> int32 token ids, shape logits.shape[:-1].
@@ -107,6 +132,11 @@ def sample_token(
     min_p / rep_penalty+presence are optional HF-parity extensions
     (MinPLogitsWarper / RepetitionPenaltyLogitsProcessor); None or their
     disabled values (0 / 1.0) reproduce the reference's exact stack.
+    freq_penalty / pres_penalty + counts are the OpenAI penalties
+    (apply_oai_penalties; 0.0 disables). The positional parameter order
+    through pres_penalty matches engine.generate.SamplingParams, so
+    `sample_token(key, logits, *sampling, ...)` stays the universal call;
+    presence/counts/bias are state, passed by keyword.
 
     Hot-path note: this runs inside the decode `lax.scan` every token, so
     top-k and top-p share ONE descending sort (the standalone filters above
@@ -123,6 +153,10 @@ def sample_token(
         logits = logits + bias.astype(jnp.float32)
     if rep_penalty is not None and presence is not None:
         logits = apply_repetition_penalty(logits, presence, rep_penalty)
+    if counts is not None and freq_penalty is not None:
+        # OpenAI penalties ride the same pre-warper slot as the HF
+        # repetition penalty (and apply to the greedy argmax too)
+        logits = apply_oai_penalties(logits, counts, freq_penalty, pres_penalty)
     scaled = apply_temperature(logits, temperature)
     vocab = scaled.shape[-1]
 

@@ -36,7 +36,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import ModelConfig
-from ..engine.generate import SamplingParams, presence_update, stop_mask
+from ..engine.generate import (
+    SamplingParams, count_update, presence_update, stop_mask,
+)
 from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
@@ -106,22 +108,24 @@ class SPMDBackendBase:
         )
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
-               valid_start=None, presence=None, bias=None, *, max_steps,
-               with_logprobs=False):
+               valid_start=None, presence=None, counts=None, bias=None,
+               *, max_steps, with_logprobs=False):
         """One dispatch for every subclass: programs are keyed by
-        (max_steps, ragged, presence, bias, logprobs); builders that don't
-        support a variant raise NotImplementedError at build time (loud,
-        not silently wrong)."""
+        (max_steps, ragged, presence, counts, bias, logprobs); builders
+        that don't support a variant raise NotImplementedError at build
+        time (loud, not silently wrong)."""
         ragged = valid_start is not None
         pres = presence is not None
+        wc = counts is not None
         wb = bias is not None
-        variant = (max_steps, ragged, pres, wb, with_logprobs)
+        variant = (max_steps, ragged, pres, wc, wb, with_logprobs)
         fn = self._decode_cache.get(variant)
         if fn is None:
-            if wb or with_logprobs:
+            if wb or with_logprobs or wc:
                 fn = self._build_decode_full(
                     max_steps, ragged=ragged, with_presence=pres,
-                    with_bias=wb, with_logprobs=with_logprobs,
+                    with_counts=wc, with_bias=wb,
+                    with_logprobs=with_logprobs,
                 )
             elif ragged:
                 fn = self._build_decode_ragged(max_steps, with_presence=pres)
@@ -140,6 +144,8 @@ class SPMDBackendBase:
             args.append(valid_start)
         if pres:
             args.append(presence)
+        if wc:
+            args.append(counts)
         if wb:
             args.append(bias)
         return fn(*args)
@@ -205,7 +211,7 @@ class SPMDBackendBase:
 
     def _build_decode_full(self, max_steps: int, *, ragged: bool,
                            with_presence: bool, with_bias: bool,
-                           with_logprobs: bool):
+                           with_logprobs: bool, with_counts: bool = False):
         raise NotImplementedError(
             f"{self.name} does not support logit_bias / per-token-logprobs "
             f"decode variants"
@@ -231,6 +237,8 @@ class PipelineBackend(SPMDBackendBase):
     # mask; the engine checks arch before requesting them.
     supports_ragged = True
     supports_presence = True
+    # OpenAI frequency/presence penalties (counts-tracked decode variants)
+    supports_counts = True
 
     # -- compiled programs --------------------------------------------------
     def _microstep_loop(self, layers, x, cache, pos, valid_start=None):
@@ -426,8 +434,8 @@ class PipelineBackend(SPMDBackendBase):
 
         from ..engine.generate import SlotParams, SlotState as _SS
 
-        state_specs = _SS(P(), P(), P(), P(), P())
-        sparam_specs = SlotParams(P(), P(), P(), P(), P(), P())
+        state_specs = _SS(P(), P(), P(), P(), P(), P())
+        sparam_specs = SlotParams(P(), P(), P(), P(), P(), P(), P(), P())
         shmapped = self._shard(
             body,
             in_specs=(
@@ -450,18 +458,20 @@ class PipelineBackend(SPMDBackendBase):
 
     def _build_decode_full(self, max_steps: int, *, ragged: bool,
                            with_presence: bool, with_bias: bool,
-                           with_logprobs: bool):
+                           with_logprobs: bool, with_counts: bool = False):
         # OpenAI logit_bias and per-token logprobs on the pp mesh (round-2
         # review #3: the full request surface on every topology) — the
         # logits are replicated after the vocab-shard all_gather, so both
         # reduce to the same local ops the single-device path runs
         return self._build_decode_any(
             max_steps, ragged=ragged, with_presence=with_presence,
-            with_bias=with_bias, with_logprobs=with_logprobs,
+            with_counts=with_counts, with_bias=with_bias,
+            with_logprobs=with_logprobs,
         )
 
     def _build_decode_any(self, max_steps: int, *, ragged: bool,
                           with_presence: bool = False,
+                          with_counts: bool = False,
                           with_bias: bool = False,
                           with_logprobs: bool = False):
         cfg, S = self.cfg, self.pp
@@ -469,12 +479,15 @@ class PipelineBackend(SPMDBackendBase):
         def body(shared, layers, first_token, cache, start_pos, limit, key,
                  sampling, *extra):
             i = 0
-            valid_start = presence0 = bias = None
+            valid_start = presence0 = counts0 = bias = None
             if ragged:
                 valid_start = extra[i]
                 i += 1
             if with_presence:
                 presence0 = extra[i]
+                i += 1
+            if with_counts:
+                counts0 = extra[i]
                 i += 1
             if with_bias:
                 bias = extra[i]
@@ -488,14 +501,16 @@ class PipelineBackend(SPMDBackendBase):
             pres0 = (
                 presence0 if with_presence else jnp.zeros((B, 1), jnp.bool_)
             )
+            cnt0 = counts0 if with_counts else jnp.zeros((B, 1), jnp.int32)
             lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
 
             def cond(c):
-                step, _, _, _, _, finished, _, _, _, _ = c
+                step, _, _, _, _, finished, _, _, _, _, _ = c
                 return (step < limit) & ~jnp.all(finished)
 
             def step_fn(c):
-                step, token, pos, cache, key, finished, out, n_gen, pres, lps = c
+                (step, token, pos, cache, key, finished, out, n_gen, pres,
+                 cnt, lps) = c
                 x = embed_sharded(cfg, shared, token[:, None], pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos, valid_start)
                 # broadcast stage 0's real [B, 1, D] output (a masked psum
@@ -512,12 +527,15 @@ class PipelineBackend(SPMDBackendBase):
                 nxt = sample_token(
                     sub, logits, *sampling,
                     presence=pres if with_presence else None,
+                    counts=cnt if with_counts else None,
                     bias=bias,
                 )
                 if with_presence:
                     pres = presence_update(pres, nxt)
                 is_eos = stop_mask(cfg, nxt)
                 newly = finished | is_eos
+                if with_counts:
+                    cnt = count_update(cnt, nxt, ~newly)
                 emit = jnp.where(newly, pad, nxt)
                 out = jax.lax.dynamic_update_slice(
                     out, emit[:, None], (jnp.int32(0), step)
@@ -536,7 +554,7 @@ class PipelineBackend(SPMDBackendBase):
                 n_gen = n_gen + (~newly).astype(jnp.int32)
                 token = jnp.where(newly, pad, nxt)
                 return (step + 1, token, pos + 1, cache, key, newly, out,
-                        n_gen, pres, lps)
+                        n_gen, pres, cnt, lps)
 
             init = (
                 jnp.int32(0),
@@ -548,9 +566,10 @@ class PipelineBackend(SPMDBackendBase):
                 out0,
                 jnp.zeros((B,), jnp.int32),
                 pres0,
+                cnt0,
                 lp0,
             )
-            _, _, _, cache, _, _, out, n_gen, _, lps = jax.lax.while_loop(
+            (_, _, _, cache, _, _, out, n_gen, _, _, lps) = jax.lax.while_loop(
                 cond, step_fn, init
             )
             if with_logprobs:
@@ -564,6 +583,8 @@ class PipelineBackend(SPMDBackendBase):
         if ragged:
             specs.append(P(AXIS_DP))
         if with_presence:
+            specs.append(P(AXIS_DP))
+        if with_counts:
             specs.append(P(AXIS_DP))
         if with_bias:
             specs.append(P())

@@ -98,10 +98,12 @@ def _reject_unsupported(data: dict, *, chat: bool):
     if not chat and data.get("suffix"):
         raise OpenAIError("suffix is not supported", param="suffix")
     for p in ("frequency_penalty", "presence_penalty"):
-        if as_num(p, 0.0, float) != 0.0:
+        v = as_num(p, 0.0, float)
+        if not -2.0 <= v <= 2.0:
+            # the OpenAI-documented range; values beyond it are almost
+            # always a units mistake (e.g. a repetition_penalty sent here)
             raise OpenAIError(
-                f"{p} is not supported (use repetition_penalty, an "
-                f"HF-semantics extension this server does support)", param=p,
+                f"{p} must be between -2.0 and 2.0", param=p,
             )
     return n
 
@@ -128,6 +130,8 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
         seed = int(seed) if seed is not None else None
         rep = float(data.get("repetition_penalty", 1.0))  # extension
         min_p = float(data.get("min_p", 0.0))  # extension
+        freq = float(data.get("frequency_penalty") or 0.0)
+        pres = float(data.get("presence_penalty") or 0.0)
     except (TypeError, ValueError) as e:
         raise OpenAIError(f"bad parameter: {e}") from None
     if temperature < 0:
@@ -146,6 +150,8 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
         seed=int(seed) if seed is not None else None,
         min_p=min_p,
         repetition_penalty=rep,
+        frequency_penalty=freq,
+        presence_penalty=pres,
     )
     stop = data.get("stop")
     if stop is not None:

@@ -383,6 +383,13 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     repetition_penalty=float(
                         data.get("repetition_penalty", 1.0)
                     ),
+                    # OpenAI penalties over generated-token counts (0 = off)
+                    frequency_penalty=float(
+                        data.get("frequency_penalty", 0.0)
+                    ),
+                    presence_penalty=float(
+                        data.get("presence_penalty", 0.0)
+                    ),
                 )
                 nbeams = data.get("num_beams")
                 if nbeams is not None and int(nbeams) > 1:

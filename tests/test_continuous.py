@@ -76,6 +76,7 @@ def test_decode_slots_matches_plain_decode(solo_engine):
         jnp.int32(13),
         jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), jnp.bool_(True),
         jnp.float32(0.0), jnp.float32(1.0),
+        jnp.float32(0.0), jnp.float32(0.0),
         jnp.zeros((cfg.vocab_size,), bool),
     )
     emitted, mask, state, cache_b = G.decode_slots(

@@ -1,0 +1,175 @@
+"""OpenAI frequency/presence penalties (ops/sampling.apply_oai_penalties).
+
+Semantics under test: logits -= freq_penalty * count + pres_penalty *
+(count > 0), where counts cover GENERATED tokens only (the prompt is
+excluded — OpenAI's published formula; the HF repetition penalty keeps
+its separate prompt+output membership semantics). Applied pre-warper and
+to the greedy argmax, on every topology that serves them (solo,
+continuous fleet, pp mesh), with the same engine surface as every other
+sampling knob.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.ops.sampling import apply_oai_penalties
+
+PROMPT = "the quick brown fox"
+
+
+@pytest.fixture(scope="module")
+def eng():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(
+        cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64))
+    )
+
+
+def test_penalty_formula_exact():
+    logits = jnp.asarray([[2.0, 1.0, 0.0, -1.0], [0.5, 0.5, 0.5, 0.5]])
+    counts = jnp.asarray([[3, 0, 1, 0], [0, 2, 0, 0]], jnp.int32)
+    got = np.asarray(apply_oai_penalties(logits, counts, 0.5, 0.7))
+    want = np.asarray(
+        [[2.0 - 1.5 - 0.7, 1.0, -0.5 - 0.7, -1.0],
+         [0.5, 0.5 - 1.0 - 0.7, 0.5, 0.5]]
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # 0/0 disables exactly (bit-identical logits)
+    off = np.asarray(apply_oai_penalties(logits, counts, 0.0, 0.0))
+    np.testing.assert_array_equal(off, np.asarray(logits))
+    # negative penalties ENCOURAGE repetition (OpenAI allows down to -2)
+    enc = np.asarray(apply_oai_penalties(logits, counts, -0.5, 0.0))
+    assert enc[0, 0] > float(logits[0, 0])
+
+
+def _gen_ids(eng, out):
+    return eng.tokenizer.encode(out["response"]) if out["response"] else []
+
+
+def test_huge_presence_penalty_never_repeats(eng):
+    """With a presence penalty far above any logit gap, greedy decode can
+    never emit the same token twice — the defining property of the
+    penalty, checked on the raw device token ids (solo decode loop)."""
+    from distributed_llm_inference_tpu.engine import generate as G
+
+    cfg, be = eng.cfg, eng.backend
+    ids = eng.tokenizer.encode(PROMPT)
+    plen = len(ids)
+    tokens = jnp.asarray(
+        [ids + [cfg.pad_token_id] * (32 - plen)], jnp.int32
+    )
+    sampling = G.default_sampling(greedy=True, pres_penalty=1000.0)
+    cache = be.init_cache(1, 128)
+    first, _, cache = be.prefill(
+        tokens, jnp.int32(plen), cache, jax.random.PRNGKey(0), sampling
+    )
+    counts = G.count_update(
+        jnp.zeros((1, cfg.vocab_size), jnp.int32), first.reshape(1)
+    )
+    out, n_gen, _ = be.decode(
+        first, cache, jnp.int32(plen), jnp.int32(16),
+        jax.random.PRNGKey(1), sampling, counts=counts, max_steps=16,
+    )
+    stream = [int(first[0])] + [int(t) for t in np.asarray(out[0])[: int(n_gen[0])]]
+    assert len(stream) >= 8  # random-init tiny model should not EOS early
+    assert len(stream) == len(set(stream))
+
+
+def test_penalty_changes_greedy_stream(eng):
+    base = eng.generate(PROMPT, greedy=True, chat=False, max_tokens=12)
+    pen = eng.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=12,
+        frequency_penalty=2.0, presence_penalty=2.0,
+    )
+    assert pen["status"] == "success"
+    assert pen["response"] != base["response"]
+
+
+def test_penalty_disables_speculation(eng):
+    """Speculative verify compares against the UNPENALIZED argmax — the
+    engine must fall back to plain decode, emitting the penalized
+    stream (same gate as repetition_penalty/logit_bias)."""
+    plain = eng.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=12,
+        frequency_penalty=1.5,
+    )
+    spec = eng.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=12,
+        frequency_penalty=1.5, speculative=True,
+    )
+    assert spec["response"] == plain["response"]
+
+
+def test_continuous_matches_solo(eng):
+    want = eng.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=12,
+        frequency_penalty=1.0, presence_penalty=0.5,
+    )
+    cont = ContinuousEngine(eng, n_slots=2, chunk_steps=4, slot_max_seq=96)
+    try:
+        got = cont.submit(
+            PROMPT, greedy=True, chat=False, max_tokens=12,
+            frequency_penalty=1.0, presence_penalty=0.5,
+        )
+    finally:
+        cont.close()
+    assert got["status"] == "success"
+    assert got["response"] == want["response"]
+
+
+def test_batched_matches_solo(eng):
+    want = eng.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=10,
+        frequency_penalty=1.0,
+    )
+    batch = eng.generate_batch(
+        [PROMPT, "hello world"], greedy=True, chat=False, max_tokens=10,
+        frequency_penalty=1.0,
+    )
+    assert batch["status"] == "success"
+    assert batch["results"][0]["response"] == want["response"]
+
+
+@pytest.mark.slow
+def test_pp_mesh_matches_solo(eng, eight_devices):
+    from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
+    from distributed_llm_inference_tpu.runtime import create_engine
+
+    pp = create_engine(
+        eng.cfg, mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        params=eng.backend.params,
+    )
+    want = eng.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=10,
+        frequency_penalty=1.0, presence_penalty=0.5,
+    )
+    got = pp.generate(
+        PROMPT, greedy=True, chat=False, max_tokens=10,
+        frequency_penalty=1.0, presence_penalty=0.5,
+    )
+    assert got["status"] == "success"
+    assert got["response"] == want["response"]
+
+
+def test_openai_route_accepts_and_validates():
+    """/v1/completions accepts in-range penalties and 400s out-of-range
+    ones with the OpenAI error envelope."""
+    from distributed_llm_inference_tpu.serving.openai_api import (
+        OpenAIError, _common_kwargs, _reject_unsupported,
+    )
+
+    data = {"prompt": "x", "frequency_penalty": 1.5, "presence_penalty": -1.0}
+    _reject_unsupported(data, chat=False)
+    kw = _common_kwargs(data, cap=30)
+    assert kw["frequency_penalty"] == 1.5
+    assert kw["presence_penalty"] == -1.0
+    with pytest.raises(OpenAIError, match="between"):
+        _reject_unsupported({"frequency_penalty": 3.0}, chat=False)
+    with pytest.raises(OpenAIError, match="between"):
+        _reject_unsupported({"presence_penalty": -2.5}, chat=False)

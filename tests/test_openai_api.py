@@ -164,8 +164,11 @@ def test_completions_errors(served):
         ({"prompt": "x", "best_of": 2}, "best_of"),
         ({"prompt": "x", "logit_bias": {"5": 500}}, "logit_bias"),
         ({"prompt": "x", "logit_bias": {"x": "y"}}, "logit_bias"),
-        ({"prompt": "x", "frequency_penalty": 0.5}, "frequency_penalty"),
+        # in-range penalties are SUPPORTED now; only out-of-range /
+        # non-numeric values reject (OpenAI's documented [-2, 2])
+        ({"prompt": "x", "frequency_penalty": 2.5}, "frequency_penalty"),
         ({"prompt": "x", "frequency_penalty": "y"}, "frequency_penalty"),
+        ({"prompt": "x", "presence_penalty": -9}, "presence_penalty"),
         ({"prompt": "x", "temperature": -1}, "temperature"),
         ({"prompt": "x", "max_tokens": 0}, "max_tokens"),
         ({"prompt": "x", "stop": 5}, "stop"),

@@ -93,6 +93,7 @@ def test_decode_slots_paged_matches_dense(solo_engine):
     knobs = (
         jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), True,
         jnp.float32(0.0), jnp.float32(1.0),
+        jnp.float32(0.0), jnp.float32(0.0),
         jnp.zeros((cfg.vocab_size,), bool),
     )
 
@@ -288,6 +289,7 @@ def test_paged_kernel_token_parity(solo_engine):
     knobs = (
         jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), True,
         jnp.float32(0.0), jnp.float32(1.0),
+        jnp.float32(0.0), jnp.float32(0.0),
         jnp.zeros((eng_x.cfg.vocab_size,), bool),
     )
     table = np.zeros((n_slots, MB), np.int32)
