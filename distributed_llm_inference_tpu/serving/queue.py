@@ -70,6 +70,9 @@ class _Pending:
             k.get("max_tokens"), k.get("temperature"), k.get("top_k"),
             k.get("top_p"), k.get("greedy"), k.get("chat"),
             k.get("min_p", 0.0), k.get("repetition_penalty", 1.0),
+            # the OpenAI penalties are fleet-shared scalars like the other
+            # sampling knobs: only identical values may share a fleet
+            k.get("frequency_penalty", 0.0), k.get("presence_penalty", 0.0),
             tuple(k.get("stop") or ()),
         )
 

@@ -294,3 +294,15 @@ def test_coalesced_fleet_tolerates_server_kwargs():
         assert p.coalesce_key() is None
     finally:
         q.close()
+
+
+def test_different_penalties_do_not_coalesce():
+    """Requests with different frequency/presence penalties must land in
+    different fleets — the knobs are fleet-shared scalars."""
+    from distributed_llm_inference_tpu.serving.queue import _Pending
+
+    a = _Pending("x", {"greedy": True, "frequency_penalty": 1.0})
+    b = _Pending("y", {"greedy": True, "frequency_penalty": 0.5})
+    c = _Pending("z", {"greedy": True, "frequency_penalty": 1.0})
+    assert a.coalesce_key() != b.coalesce_key()
+    assert a.coalesce_key() == c.coalesce_key()
