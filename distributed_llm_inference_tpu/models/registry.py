@@ -60,6 +60,16 @@ register(ModelConfig(
     rope_theta=500000.0, rope_scaling="llama3", rope_scaling_factor=8.0,
     eos_token_id=128001, bos_token_id=128000,
 ))
+# Llama-3.1-70B: the BASELINE-class large config for pp=8/tp meshes.
+# Llama-3.3-70B is the identical architecture with newer instruct data —
+# derived by replace(name=...) so the equivalence holds by construction.
+_l31_70b = register(ModelConfig(
+    name="llama3.1-70b", arch="llama", vocab_size=128256, dim=8192,
+    n_layers=80, n_heads=64, n_kv_heads=8, ffn_dim=28672, max_seq_len=131072,
+    rope_theta=500000.0, rope_scaling="llama3", rope_scaling_factor=8.0,
+    eos_token_id=128001, bos_token_id=128000,
+))
+register(_l31_70b.replace(name="llama3.3-70b"))
 register(ModelConfig(
     name="llama3.2-1b", arch="llama", vocab_size=128256, dim=2048,
     n_layers=16, n_heads=32, n_kv_heads=8, ffn_dim=8192, max_seq_len=131072,
@@ -94,12 +104,15 @@ register(ModelConfig(
 ))
 
 # --- Qwen2 family (llama arch + q/k/v projection biases) ------------------
-register(ModelConfig(
+_qwen2_7b = register(ModelConfig(
     name="qwen2-7b", arch="llama", vocab_size=152064, dim=3584,
     n_layers=28, n_heads=28, n_kv_heads=4, ffn_dim=18944, max_seq_len=32768,
     norm_eps=1e-6, rope_theta=1000000.0, attn_qkv_bias=True,
     eos_token_id=151645, bos_token_id=151643, pad_token_id=151643,
 ))
+# Qwen2.5-7B: the Qwen2-7B architecture unchanged (same dims, GQA,
+# qkv-bias, 1e6 theta) with refreshed training — derived, not retyped.
+register(_qwen2_7b.replace(name="qwen2.5-7b"))
 register(ModelConfig(
     name="qwen2-0.5b", arch="llama", vocab_size=151936, dim=896,
     n_layers=24, n_heads=14, n_kv_heads=2, ffn_dim=4864, max_seq_len=32768,
