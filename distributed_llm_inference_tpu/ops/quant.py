@@ -223,9 +223,14 @@ def q4_matmul_rows(x2d: jnp.ndarray, w: Q4Tensor, interpret: bool = None):
     """Pallas path for y = x2d @ dequant(w), x2d [R, in].
 
     The XLA einsum formulation of the same algebra materializes the
-    unpacked int8 tensor in HBM (measured SLOWER than int8 on v5e:
-    268 vs 446 tok/s; dequant-then-dot is 62), so the decode hot path
-    unpacks in VMEM instead. Caller guarantees the tiling gates."""
+    unpacked int8 tensor in HBM (measured far slower on v5e; plain
+    dequant-then-dot lands ~62 tok/s end to end), so the decode hot path
+    unpacks in VMEM instead. Honest accounting (chained-call timing,
+    bench.py): int4 decode lands ~330-350 tok/s vs int8's ~450-480 —
+    the R=1 matvec shapes leave the kernel overhead-bound, so int4 is
+    the CAPACITY lever (half int8's weight HBM: 13B-class fits a single
+    v5e) while int8 stays the single-stream speed pick. Caller
+    guarantees the tiling gates."""
     from jax.experimental import pallas as pl
 
     if interpret is None:
