@@ -256,12 +256,6 @@ class InferenceEngine:
         # snapshot/splice (checked against the live buffer later).
         self._prefix = None
         if engine_cfg.prefix_cache_entries > 0:
-            if cfg.kv_quant is not None:
-                raise ValueError(
-                    "kv_quant does not compose with the prefix KV cache "
-                    "(snapshots slice raw-dtype cache slabs); drop one of "
-                    "prefix_cache_entries / kv_quant"
-                )
             if hasattr(self.backend, "prefill_at"):
                 self._prefix = PrefixCache(
                     engine_cfg.prefix_cache_entries, engine_cfg.prefix_chunk

@@ -20,7 +20,8 @@ a snapshot whose own tail diverges still donates its shared head and no
 stale slot is ever attended.
 
 Store discipline: entries are device arrays [L, B=1, KV, P, Dh] (sharded
-like the live cache on SPMD backends), LRU-bounded by entry count; P is
+like the live cache on SPMD backends; int8 KVQuant leaves snapshot their
+scales alongside — same seq axis), LRU-bounded by entry count; P is
 rounded DOWN to a multiple of `chunk` so the slice/splice programs
 compile once per (P, cache) shape. Only backends with the plain
 {"k", "v"} cache layout participate (the context-parallel backend's
@@ -38,23 +39,28 @@ import jax
 import jax.numpy as jnp
 
 
+# Both helpers are tree-mapped so every {"k", "v"} cache layout rides
+# them: raw [L, B, KV, S, Dh] arrays AND int8 KVQuant leaves
+# (ops/kv_quant.py), whose per-(token, head) scales [L, B, KV, S] share
+# the same seq axis 3 — one slice/splice recipe covers both leaves.
+
+
 @functools.partial(jax.jit, static_argnames=("p",))
 def _extract(cache, p: int):
-    return {
-        "k": jax.lax.slice_in_dim(cache["k"], 0, p, axis=3),
-        "v": jax.lax.slice_in_dim(cache["v"], 0, p, axis=3),
-    }
+    return jax.tree.map(
+        lambda x: jax.lax.slice_in_dim(x, 0, p, axis=3), cache
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("p",), donate_argnames=("cache",))
 def _splice(cache, entry, p: int):
-    zeros = (jnp.int32(0),) * 5
-    ek = jax.lax.slice_in_dim(entry["k"], 0, p, axis=3)
-    ev = jax.lax.slice_in_dim(entry["v"], 0, p, axis=3)
-    return {
-        "k": jax.lax.dynamic_update_slice(cache["k"], ek, zeros),
-        "v": jax.lax.dynamic_update_slice(cache["v"], ev, zeros),
-    }
+    def spl(big, small):
+        sl = jax.lax.slice_in_dim(small, 0, p, axis=3)
+        return jax.lax.dynamic_update_slice(
+            big, sl, (jnp.int32(0),) * big.ndim
+        )
+
+    return jax.tree.map(spl, cache, entry)
 
 
 class PrefixCache:

@@ -719,8 +719,8 @@ def main(argv: Optional[list] = None):
         help="KV-CACHE quantization: int8 K/V with per-(token, head) "
              "scales halves cache HBM — 2x the --continuous slots or "
              "context window at the same budget (llama family; single "
-             "chip or a pp/tp/dp pipeline mesh; dense caches — excludes "
-             "--kv-pool-blocks, --prefix-cache, --sp and "
+             "chip or a pp/tp/dp pipeline mesh; dense caches — composes "
+             "with --prefix-cache, excludes --kv-pool-blocks, --sp and "
              "--attn-impl pallas)",
     )
     ap.add_argument("--max-tokens-cap", type=int, default=30)

@@ -147,13 +147,7 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
             n_slots=2, chunk_steps=4, slot_max_seq=64,
             kv_pool_blocks=16, kv_block_size=16,
         )
-    with pytest.raises(ValueError, match="prefix"):
-        InferenceEngine(
-            qcfg, params=raw_engine.backend.params,
-            engine_cfg=EngineConfig(
-                prefill_buckets=(32,), prefix_cache_entries=2
-            ),
-        )
+
 
 
 @pytest.mark.slow
@@ -190,3 +184,26 @@ def test_kv_quant_microbatch_still_rejected():
     cfg = get_model_config("test-llama-tiny", kv_quant="int8")
     with pytest.raises(NotImplementedError, match="raw-dtype"):
         create_backend(cfg, mesh_cfg=MeshConfig(pp=2), microbatches=2)
+
+
+@pytest.mark.slow
+def test_prefix_cache_hit_on_quantized_cache(raw_engine):
+    """The prefix KV cache composes with kv_quant: snapshots slice the
+    int8 data AND the scales (same seq axis), and a hit reproduces the
+    cold quantized output exactly."""
+    qcfg = raw_engine.cfg.replace(kv_quant="int8")
+    eng = InferenceEngine(
+        qcfg, params=raw_engine.backend.params,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=2,
+            prefix_chunk=16,
+        ),
+    )
+    # ~60 byte-tokens: fits the tiny model's 128-slot cache with headroom
+    prompt = " ".join(f"w{i}" for i in range(18))
+    cold = eng.generate(prompt, greedy=True, chat=False, max_tokens=8)
+    assert cold["status"] == "success"
+    hot = eng.generate(prompt, greedy=True, chat=False, max_tokens=8)
+    assert hot["response"] == cold["response"]
+    st = eng._prefix.stats()
+    assert st["hits"] >= 1
