@@ -121,11 +121,11 @@ class ModelConfig:
     quant: Optional[str] = None
     # KV-CACHE quantization (ops/kv_quant.py): "int8" stores K/V as int8
     # with per-(token, head) fp32 scales — half the cache HBM, 2x the
-    # slots/context at the same budget. Llama family, dense caches, on
-    # the single device or a pp/tp/dp pipeline mesh; composes with the
-    # prefix KV cache (snapshots carry the scales). The paged pool,
-    # flash kernels, ring attention, and the 1F1B schedule read raw
-    # dtypes and reject the combination.
+    # slots/context at the same budget. Llama family, on the single
+    # device or a pp/tp/dp pipeline mesh; composes with the prefix KV
+    # cache (snapshots carry the scales) AND the paged block pool
+    # (int8 blocks + scale blocks). The flash kernels, ring attention,
+    # and the 1F1B schedule read raw dtypes and reject the combination.
     kv_quant: Optional[str] = None
     # Attention implementation: "xla" (einsum + full mask, fused by XLA) or
     # "pallas" (flash kernel, ops/flash_attention.py; interpret-mode on CPU).

@@ -159,12 +159,6 @@ class ContinuousEngine:
         # request that can't get blocks waits in the queue (backpressure).
         self.paged = kv_pool_blocks is not None
         if self.paged:
-            if engine.cfg.kv_quant is not None:
-                raise ValueError(
-                    "kv_quant does not compose with the paged pool yet "
-                    "(the pool stores raw-dtype blocks); drop one of "
-                    "kv_pool_blocks / kv_quant"
-                )
             if not getattr(engine.backend, "supports_paged", False):
                 raise ValueError(
                     f"backend {engine.backend.name!r} does not support "

@@ -58,6 +58,9 @@ import jax.numpy as jnp
 from ..config import ModelConfig
 from ..models import llama
 from ..ops.attention import attend
+from ..ops.kv_quant import KVQuant
+from ..ops.kv_quant import dequantize as kv_dequantize
+from ..ops.kv_quant import quantize_chunk
 from . import generate as G
 
 TRASH_BLOCK = 0  # reserved pool block: write-only spill for table tails
@@ -65,10 +68,20 @@ TRASH_BLOCK = 0  # reserved pool block: write-only spill for table tails
 
 def init_pool(cfg: ModelConfig, n_blocks: int, block_size: int):
     """Zeroed block pool, stacked on the layer axis like the dense cache.
-    Block 0 is the reserved trash block (never allocated to a slot)."""
+    Block 0 is the reserved trash block (never allocated to a slot).
+    With cfg.kv_quant the pool leaves are KVQuant pytrees — int8 blocks
+    plus per-(token, head) scales [L, N, KV, bs] — so BOTH HBM levers
+    compose: the pool tracks in-flight tokens AND each token costs half
+    the bytes."""
     shape = (
         cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim
     )
+    if cfg.kv_quant == "int8":
+        sshape = shape[:-1]
+        leaf = lambda: KVQuant(  # noqa: E731 - two identical leaves
+            jnp.zeros(shape, jnp.int8), jnp.zeros(sshape, jnp.float32)
+        )
+        return {"k": leaf(), "v": leaf()}
     dt = cfg.jnp_dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -138,6 +151,38 @@ def make_paged_hook(table: jnp.ndarray):
         lblk = jnp.minimum(pos // bs, MB - 1)  # [B]
         blk = jnp.take_along_axis(table, lblk[:, None], axis=1)[:, 0]  # [B]
         off = pos % bs
+        if isinstance(cache_k, KVQuant):
+            # int8 pool: quantize the token's K/V, scatter data + scale
+            # into the slot's block; the gather below dequantizes per
+            # gathered slab. (attn_impl="pallas" cannot reach here —
+            # config rejects kv_quant + pallas.)
+            qk, sk = quantize_chunk(k)
+            qv, sv = quantize_chunk(v)
+            new_k = KVQuant(
+                cache_k.q.at[blk, :, off, :].set(qk[:, 0]),
+                cache_k.s.at[blk, :, off].set(sk[:, 0]),
+            )
+            new_v = KVQuant(
+                cache_v.q.at[blk, :, off, :].set(qv[:, 0]),
+                cache_v.s.at[blk, :, off].set(sv[:, 0]),
+            )
+            KV_ = cache_k.q.shape[1]
+
+            def gathered(leaf):
+                # dequantize the GATHERED slabs (one recipe with the
+                # dense path: ops/kv_quant.dequantize), then the same
+                # contiguous-view transpose as the raw gather below
+                g = kv_dequantize(KVQuant(leaf.q[table], leaf.s[table]))
+                return g.transpose(0, 2, 1, 3, 4).reshape(
+                    B, KV_, MB * bs, Dh
+                )
+
+            gk, gv = gathered(new_k), gathered(new_v)
+            attn = attend(
+                q, gk, gv, mask,
+                scale=cfg.query_scale, softcap=cfg.attn_softcap,
+            )
+            return attn, new_k, new_v
         new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
         new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
         if cfg.attn_impl == "pallas":
@@ -255,11 +300,20 @@ def insert_slot_paged(
     slot = jnp.int32(slot)
 
     def scatter(pl, sc):
-        # sc [L, 1, KV, S, Dh] -> [L, MB, KV, bs, Dh] block view
-        L, _, KV, S, Dh = sc.shape
+        # sc [L, 1, KV, S, Dh] -> [L, MB, KV, bs, Dh] block view; the
+        # int8 pool's scale leaves ride the same recipe one rank down
+        # ([L, 1, KV, S] -> [L, MB, KV, bs])
         bs = pl.shape[3]
-        MB = S // bs
-        blocks = sc[:, 0].reshape(L, KV, MB, bs, Dh).transpose(0, 2, 1, 3, 4)
+        if sc.ndim == 5:
+            L, _, KV, S, Dh = sc.shape
+            MB = S // bs
+            blocks = (
+                sc[:, 0].reshape(L, KV, MB, bs, Dh).transpose(0, 2, 1, 3, 4)
+            )
+        else:
+            L, _, KV, S = sc.shape
+            MB = S // bs
+            blocks = sc[:, 0].reshape(L, KV, MB, bs).transpose(0, 2, 1, 3)
         return pl.at[:, table_row].set(blocks)
 
     pool = jax.tree.map(scatter, pool, scratch)

@@ -24,11 +24,11 @@ dense hook (models/llama.default_attn_hook) dispatches on the leaf type;
 everything else — scan-over-layers, donation, while_loop carries —
 treats the cache as an opaque pytree.
 
-Scope: llama-family, dense caches (single device, the slot fleet, and
-pp/tp/dp pipeline meshes; the prefix snapshot store composes too — its
-slices carry the scale leaves). The paged pool and the Pallas flash
+Scope: llama-family (single device, the slot fleet — dense OR block-
+paged pool — and pp/tp/dp pipeline meshes; the prefix snapshot store
+composes too, its slices carry the scale leaves). The Pallas flash
 kernels read raw-dtype caches and reject the combination loudly at
-config/engine level. The reference has no KV cache at all
+config level. The reference has no KV cache at all
 (/root/reference/Worker1.py:132-134); this is north-star serving scope.
 """
 

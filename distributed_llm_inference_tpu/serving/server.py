@@ -719,8 +719,9 @@ def main(argv: Optional[list] = None):
         help="KV-CACHE quantization: int8 K/V with per-(token, head) "
              "scales halves cache HBM — 2x the --continuous slots or "
              "context window at the same budget (llama family; single "
-             "chip or a pp/tp/dp pipeline mesh; dense caches — composes "
-             "with --prefix-cache, excludes --kv-pool-blocks, --sp and "
+             "chip or a pp/tp/dp pipeline mesh; composes with "
+             "--prefix-cache and --kv-pool-blocks — an int8 block pool "
+             "stacks both HBM levers; excludes --sp and "
              "--attn-impl pallas)",
     )
     ap.add_argument("--max-tokens-cap", type=int, default=30)

@@ -139,14 +139,6 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
 
     with pytest.raises(NotImplementedError, match="raw-dtype"):
         create_backend(cfg, kv_quant="int8", mesh_cfg=MeshConfig(sp=2))
-    qcfg = cfg.replace(kv_quant="int8")
-    with pytest.raises(ValueError, match="paged"):
-        ContinuousEngine(
-            InferenceEngine(qcfg, params=raw_engine.backend.params,
-                            engine_cfg=EngineConfig(prefill_buckets=(32,))),
-            n_slots=2, chunk_steps=4, slot_max_seq=64,
-            kv_pool_blocks=16, kv_block_size=16,
-        )
 
 
 
@@ -207,3 +199,35 @@ def test_prefix_cache_hit_on_quantized_cache(raw_engine):
     assert hot["response"] == cold["response"]
     st = eng._prefix.stats()
     assert st["hits"] >= 1
+
+
+@pytest.mark.slow
+def test_paged_pool_composes_with_kv_quant(q_engine):
+    """Both HBM levers together: an int8 BLOCK POOL serves the same
+    greedy text as the dense int8 fleet (identical quantized writes, so
+    the parity is exact), and pool accounting still balances."""
+    dense = ContinuousEngine(q_engine, n_slots=2, chunk_steps=4,
+                             slot_max_seq=96)
+    try:
+        want = [
+            dense.submit(p, greedy=True, chat=False, max_tokens=10)
+            for p in PROMPTS
+        ]
+    finally:
+        dense.close()
+    paged = ContinuousEngine(
+        q_engine, n_slots=2, chunk_steps=4, slot_max_seq=96,
+        kv_pool_blocks=16, kv_block_size=16,
+    )
+    try:
+        got = [
+            paged.submit(p, greedy=True, chat=False, max_tokens=10)
+            for p in PROMPTS
+        ]
+        stats = paged.stats()
+    finally:
+        paged.close()
+    for w, g in zip(want, got):
+        assert g["status"] == "success"
+        assert g["response"] == w["response"]
+    assert stats["paged"]["free_blocks"] == 15
