@@ -153,9 +153,8 @@ def make_paged_hook(table: jnp.ndarray):
         off = pos % bs
         if isinstance(cache_k, KVQuant):
             # int8 pool: quantize the token's K/V, scatter data + scale
-            # into the slot's block; the gather below dequantizes per
-            # gathered slab. (attn_impl="pallas" cannot reach here —
-            # config rejects kv_quant + pallas.)
+            # into the slot's block. (attn_impl="pallas" cannot reach
+            # this leaf type — config rejects kv_quant + pallas.)
             qk, sk = quantize_chunk(k)
             qv, sv = quantize_chunk(v)
             new_k = KVQuant(
@@ -166,50 +165,44 @@ def make_paged_hook(table: jnp.ndarray):
                 cache_v.q.at[blk, :, off, :].set(qv[:, 0]),
                 cache_v.s.at[blk, :, off].set(sv[:, 0]),
             )
-            KV_ = cache_k.q.shape[1]
+        else:
+            new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
+            new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
+            if cfg.attn_impl == "pallas":
+                # Fused Pallas paged attention (ops/paged_attention.py):
+                # walks the table block by block with an online softmax —
+                # no contiguous-view materialization, dead blocks never
+                # leave HBM. Legality (no softcap, no scale override,
+                # uniform-or-no window) is already enforced by
+                # ModelConfig.__post_init__, which is also why deriving
+                # the mask from pos + attn_window in-kernel is exact (the
+                # hook's `mask` carries nothing more).
+                from ..ops.paged_attention import paged_flash_attend
 
-            def gathered(leaf):
-                # dequantize the GATHERED slabs (one recipe with the
-                # dense path: ops/kv_quant.dequantize), then the same
-                # contiguous-view transpose as the raw gather below
-                g = kv_dequantize(KVQuant(leaf.q[table], leaf.s[table]))
-                return g.transpose(0, 2, 1, 3, 4).reshape(
-                    B, KV_, MB * bs, Dh
+                attn = paged_flash_attend(
+                    q, new_k, new_v, table, pos, window=cfg.attn_window
                 )
+                return attn, new_k, new_v
 
-            gk, gv = gathered(new_k), gathered(new_v)
-            attn = attend(
-                q, gk, gv, mask,
-                scale=cfg.query_scale, softcap=cfg.attn_softcap,
-            )
-            return attn, new_k, new_v
-        new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
-        new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
-        if cfg.attn_impl == "pallas":
-            # Fused Pallas paged attention (ops/paged_attention.py): walks
-            # the table block by block with an online softmax — no
-            # contiguous-view materialization, dead blocks never leave
-            # HBM. Legality (no softcap, no scale override, uniform-or-no
-            # window) is already enforced by ModelConfig.__post_init__,
-            # which is also why deriving the mask from pos + attn_window
-            # in-kernel is exact (the hook's `mask` carries nothing more).
-            from ..ops.paged_attention import paged_flash_attend
+        # Gather the whole table -> ONE contiguous per-slot view recipe
+        # for both leaf types (int8 slabs dequantize through the dense
+        # path's ops/kv_quant.dequantize; raw slabs gather as-is). Each
+        # gathered slab is a [KV, bs, Dh] contiguous run of HBM; stale
+        # content at logical positions > pos[b] (trash block included) is
+        # masked by the slot causal mask, which forward_layers built to
+        # the LOGICAL length MB*bs via attn_seq_len.
+        KV_ = cache_k.shape[1]
 
-            attn = paged_flash_attend(
-                q, new_k, new_v, table, pos, window=cfg.attn_window
-            )
-            return attn, new_k, new_v
-        # Gather the whole table -> contiguous per-slot view. Each gathered
-        # slab is a [KV, bs, Dh] contiguous run of HBM; stale content at
-        # logical positions > pos[b] (trash block included) is masked by
-        # the slot causal mask, which forward_layers built to the LOGICAL
-        # length MB*bs via attn_seq_len.
-        gk = new_k[table]  # [B, MB, KV, bs, Dh]
-        gv = new_v[table]
-        gk = gk.transpose(0, 2, 1, 3, 4).reshape(B, cache_k.shape[1], MB * bs, Dh)
-        gv = gv.transpose(0, 2, 1, 3, 4).reshape(B, cache_v.shape[1], MB * bs, Dh)
+        def gathered(leaf):
+            g = (
+                kv_dequantize(KVQuant(leaf.q[table], leaf.s[table]))
+                if isinstance(leaf, KVQuant) else leaf[table]
+            )  # [B, MB, KV, bs, Dh]
+            return g.transpose(0, 2, 1, 3, 4).reshape(B, KV_, MB * bs, Dh)
+
         attn = attend(
-            q, gk, gv, mask, scale=cfg.query_scale, softcap=cfg.attn_softcap
+            q, gathered(new_k), gathered(new_v), mask,
+            scale=cfg.query_scale, softcap=cfg.attn_softcap,
         )
         return attn, new_k, new_v
 

@@ -90,6 +90,7 @@ def test_penalty_changes_greedy_stream(eng):
     assert pen["response"] != base["response"]
 
 
+@pytest.mark.slow
 def test_penalty_disables_speculation(eng):
     """Speculative verify compares against the UNPENALIZED argmax — the
     engine must fall back to plain decode, emitting the penalized
@@ -105,6 +106,7 @@ def test_penalty_disables_speculation(eng):
     assert spec["response"] == plain["response"]
 
 
+@pytest.mark.slow
 def test_continuous_matches_solo(eng):
     want = eng.generate(
         PROMPT, greedy=True, chat=False, max_tokens=12,
@@ -122,6 +124,7 @@ def test_continuous_matches_solo(eng):
     assert got["response"] == want["response"]
 
 
+@pytest.mark.slow
 def test_batched_matches_solo(eng):
     want = eng.generate(
         PROMPT, greedy=True, chat=False, max_tokens=10,
