@@ -231,3 +231,41 @@ def test_paged_pool_composes_with_kv_quant(q_engine):
         assert g["status"] == "success"
         assert g["response"] == w["response"]
     assert stats["paged"]["free_blocks"] == 15
+
+
+@pytest.mark.slow
+def test_pp_continuous_fleet_with_kv_quant(raw_engine, q_engine,
+                                           eight_devices):
+    """Continuous batching on a pp mesh with an int8 cache: the fleet's
+    shard_map programs take the quantized leaves through the per-leaf
+    cache specs, and the served text matches the single-chip quantized
+    fleet exactly."""
+    from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
+    from distributed_llm_inference_tpu.runtime import create_engine
+
+    qcfg = q_engine.cfg
+    cont_s = ContinuousEngine(q_engine, n_slots=2, chunk_steps=4,
+                              slot_max_seq=96)
+    try:
+        want = [
+            cont_s.submit(p, greedy=True, chat=False, max_tokens=10)
+            for p in PROMPTS
+        ]
+    finally:
+        cont_s.close()
+    pp = create_engine(
+        qcfg, mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        params=raw_engine.backend.params,
+    )
+    cont_p = ContinuousEngine(pp, n_slots=2, chunk_steps=4, slot_max_seq=96)
+    try:
+        got = [
+            cont_p.submit(p, greedy=True, chat=False, max_tokens=10)
+            for p in PROMPTS
+        ]
+    finally:
+        cont_p.close()
+    for w, g in zip(want, got):
+        assert g["status"] == "success"
+        assert g["response"] == w["response"]
