@@ -43,6 +43,21 @@ def q_engine(raw_engine):
     )
 
 
+@pytest.fixture(scope="module")
+def dense_q_fleet_text(q_engine):
+    """One dense int8-fleet baseline (greedy, max_tokens=10) shared by
+    every parity test — the compile and generations are paid once."""
+    cont = ContinuousEngine(q_engine, n_slots=2, chunk_steps=4,
+                            slot_max_seq=96)
+    try:
+        return [
+            cont.submit(p, greedy=True, chat=False, max_tokens=10)["response"]
+            for p in PROMPTS
+        ]
+    finally:
+        cont.close()
+
+
 def test_quantize_roundtrip_error_bound():
     x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 3, 16), jnp.float32)
     q, s = KQ.quantize_chunk(x)
@@ -202,19 +217,10 @@ def test_prefix_cache_hit_on_quantized_cache(raw_engine):
 
 
 @pytest.mark.slow
-def test_paged_pool_composes_with_kv_quant(q_engine):
+def test_paged_pool_composes_with_kv_quant(q_engine, dense_q_fleet_text):
     """Both HBM levers together: an int8 BLOCK POOL serves the same
     greedy text as the dense int8 fleet (identical quantized writes, so
     the parity is exact), and pool accounting still balances."""
-    dense = ContinuousEngine(q_engine, n_slots=2, chunk_steps=4,
-                             slot_max_seq=96)
-    try:
-        want = [
-            dense.submit(p, greedy=True, chat=False, max_tokens=10)
-            for p in PROMPTS
-        ]
-    finally:
-        dense.close()
     paged = ContinuousEngine(
         q_engine, n_slots=2, chunk_steps=4, slot_max_seq=96,
         kv_pool_blocks=16, kv_block_size=16,
@@ -227,14 +233,15 @@ def test_paged_pool_composes_with_kv_quant(q_engine):
         stats = paged.stats()
     finally:
         paged.close()
-    for w, g in zip(want, got):
+    for w, g in zip(dense_q_fleet_text, got):
         assert g["status"] == "success"
-        assert g["response"] == w["response"]
+        assert g["response"] == w
     assert stats["paged"]["free_blocks"] == 15
 
 
 @pytest.mark.slow
 def test_pp_continuous_fleet_with_kv_quant(raw_engine, q_engine,
+                                           dense_q_fleet_text,
                                            eight_devices):
     """Continuous batching on a pp mesh with an int8 cache: the fleet's
     shard_map programs take the quantized leaves through the per-leaf
@@ -244,15 +251,6 @@ def test_pp_continuous_fleet_with_kv_quant(raw_engine, q_engine,
     from distributed_llm_inference_tpu.runtime import create_engine
 
     qcfg = q_engine.cfg
-    cont_s = ContinuousEngine(q_engine, n_slots=2, chunk_steps=4,
-                              slot_max_seq=96)
-    try:
-        want = [
-            cont_s.submit(p, greedy=True, chat=False, max_tokens=10)
-            for p in PROMPTS
-        ]
-    finally:
-        cont_s.close()
     pp = create_engine(
         qcfg, mesh_cfg=MeshConfig(pp=2),
         engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
@@ -266,6 +264,6 @@ def test_pp_continuous_fleet_with_kv_quant(raw_engine, q_engine,
         ]
     finally:
         cont_p.close()
-    for w, g in zip(want, got):
+    for w, g in zip(dense_q_fleet_text, got):
         assert g["status"] == "success"
-        assert g["response"] == w["response"]
+        assert g["response"] == w
