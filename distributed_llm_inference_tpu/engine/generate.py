@@ -406,7 +406,11 @@ def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
         sparams.temperature[:, None],
         sparams.top_k[:, None],
         sparams.top_p[:, None],
-        sparams.greedy,
+        # OR-ing idle rows into "greedy" keeps the all-greedy sampler
+        # bypass live when a retired slot still carries a previous
+        # sampled tenant's False flag — idle rows' tokens are masked
+        # downstream, so their branch only matters for speed
+        sparams.greedy | ~state.active,
         sparams.min_p[:, None],
         sparams.rep_penalty[:, None],
         sparams.freq_penalty[:, None],

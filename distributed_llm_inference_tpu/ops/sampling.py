@@ -10,8 +10,6 @@ lives inside the decode `lax.scan`.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
@@ -163,43 +161,43 @@ def sample_token(
     use_min_p = min_p is not None
     mp = jnp.float32(0.0) if min_p is None else min_p
     greedy = jnp.asarray(greedy)
-    if greedy.ndim == 0:
-        # SCALAR greedy (solo/batched decode — the slot fleet's per-row
-        # vector keeps the fused where below): the warper pipeline costs
-        # a full-vocab argsort + softmax + cumsum per step, and the
-        # where(greedy, ...) keeps it live even when every step is an
-        # argmax. lax.cond runs only the taken branch, so greedy decode
-        # skips the sampler entirely (~+4% decode throughput on v5e) and
-        # the sampled branch is bit-identical to the fused path.
-        return jax.lax.cond(
-            greedy,
-            lambda k, lg, t, tk, tp, mp_: jnp.argmax(lg, axis=-1).astype(
-                jnp.int32
-            ),
-            functools.partial(_sample_warped, use_min_p),
-            key, logits, temperature, top_k, top_p, mp,
-        )
-    # VECTOR greedy (the slot fleet: per-row flags). All-greedy fleets —
-    # the common production mix — take the argmax-only branch; any mixed
-    # fleet pays the fused pipeline, whose where() resolves per row.
     # greedy uses a true argmax (first index on ties, like torch/np), NOT
     # sort_idx[..., 0]: the reversed stable ascending argsort would break
     # ties toward the LAST index. Argmax of the PENALIZED logits: HF
     # applies processors (repetition penalty) in greedy mode too.
+    all_greedy = greedy if greedy.ndim == 0 else jnp.all(greedy)
+
+    def _argmax_only(k, lg, t, tk, tp, mp_):
+        return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
     def _fused(k, lg, t, tk, tp, mp_):
         sampled = _sample_warped(use_min_p, k, lg, t, tk, tp, mp_)
-        return jnp.where(
-            greedy, jnp.argmax(lg, axis=-1), sampled
-        ).astype(jnp.int32)
-
-    return jax.lax.cond(
-        jnp.all(greedy),
-        lambda k, lg, t, tk, tp, mp_: jnp.argmax(lg, axis=-1).astype(
+        if greedy.ndim == 0:
+            # only reachable with scalar greedy False (the True case took
+            # the argmax branch above/below) — sampled IS the answer
+            return sampled
+        # per-row fleet flags: mixed fleets resolve row-wise
+        return jnp.where(greedy, jnp.argmax(lg, axis=-1), sampled).astype(
             jnp.int32
-        ),
-        _fused,
-        key, logits, temperature, top_k, top_p, mp,
-    )
+        )
+
+    operands = (key, logits, temperature, top_k, top_p, mp)
+    if isinstance(all_greedy, jax.core.Tracer):
+        # Inside jit/scan (every decode hot loop): the warper pipeline
+        # costs a full-vocab argsort + softmax + cumsum per step, and a
+        # where(greedy, ...) would keep it live even when every step is
+        # an argmax. lax.cond runs only the taken branch — greedy decode
+        # skips the sampler entirely (279 -> 321 tok/s solo on v5e; the
+        # slot fleet takes it whenever ALL rows are greedy). The sampled
+        # branch is bit-identical to the fused path.
+        return jax.lax.cond(all_greedy, _argmax_only, _fused, *operands)
+    # Eager call (tests / one-off prefills outside jit): an eager cond
+    # re-traces fresh branch closures every call and XLA recompiles the
+    # whole computation each time (measured 10x test-suite blowup) — a
+    # concrete flag needs a plain Python branch instead.
+    if bool(all_greedy):
+        return _argmax_only(*operands)
+    return _fused(*operands)
 
 
 def _sample_warped(use_min_p: bool, key, logits, temperature, top_k, top_p,
