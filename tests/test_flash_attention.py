@@ -149,3 +149,34 @@ def test_pallas_rejects_scale_overrides():
     ]:
         with pytest.raises(ValueError, match="pallas"):
             base.replace(attn_impl="pallas", **{field: val})
+
+
+@pytest.mark.slow
+def test_pallas_serves_prefill_only_never_decode(monkeypatch):
+    """Regression pin for the T>1 gate: under attn_impl='pallas' the flash
+    kernel must trace into prefill (T=bucket) but NEVER into a T=1 decode
+    step — the kernel inside the decode loop measured 15x slower than the
+    XLA einsum on v5e, so 'auto'/'pallas' must stay prefill-only there."""
+    from distributed_llm_inference_tpu import EngineConfig, get_model_config
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import llama as L
+
+    calls = []
+    orig = L.flash_attend
+
+    def spy(q, *a, **k):
+        calls.append(int(q.shape[1]))
+        return orig(q, *a, **k)
+
+    monkeypatch.setattr(L, "flash_attend", spy)
+    # max_seq_len tweak -> a cfg no other test compiled, so THIS process
+    # traces the programs fresh and the spy actually observes the calls
+    cfg = get_model_config(
+        "test-llama-tiny", attn_impl="pallas", max_seq_len=120
+    )
+    eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))
+    out = eng.generate("the quick brown fox", greedy=True, chat=False,
+                       max_tokens=8)
+    assert out["status"] == "success"
+    assert calls, "prefill under pallas should trace through flash_attend"
+    assert all(t > 1 for t in calls), f"flash traced at T=1 decode: {calls}"
