@@ -303,8 +303,8 @@ class MeshConfig:
     @property
     def is_trivial(self) -> bool:
         """True when every axis is 1 — the single-device topology.
-        Feature gates (e.g. kv_quant) key off this instead of
-        re-enumerating the axes, so a new axis cannot drift past them."""
+        Backend selection (runtime.create_backend) keys off this instead
+        of re-enumerating the axes, so a new axis cannot drift past it."""
         return self.n_devices == 1
 
 

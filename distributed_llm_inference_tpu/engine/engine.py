@@ -497,7 +497,10 @@ class InferenceEngine:
         num_beams > 1: deterministic beam search (HF generate(num_beams=N,
         do_sample=False) semantics; length_penalty / early_stopping as in
         HF). Sampling params / speculation / logprobs / bias are ignored
-        on the beam path — it is a pure max-score search.
+        on the beam path — it is a pure max-score search (HF ignores them
+        the same way) — EXCEPT the OpenAI penalties, which reject loudly:
+        they alter which continuation wins, so dropping them would change
+        results silently rather than fall back to documented semantics.
         """
         t_start = time.time()
 
@@ -512,6 +515,20 @@ class InferenceEngine:
                 frequency_penalty=frequency_penalty,
                 presence_penalty=presence_penalty,
             )
+
+        if num_beams > 1 and (frequency_penalty != 0.0 or presence_penalty != 0.0):
+            # the beam path is a pure max-score search with no per-beam
+            # count tracking: reject loudly instead of silently returning
+            # unpenalized output. (Sampling params / logprobs / bias stay
+            # silently ignored on beams — HF-parity semantics the
+            # docstring documents; the penalties have no such precedent.)
+            msg = (
+                "frequency_penalty/presence_penalty are not supported with "
+                "num_beams > 1; drop the penalties or use sampling"
+            )
+            log.warning("invalid_request", error=msg)
+            return {"error": f"Error: {msg}", "status": "failed",
+                    "error_type": "invalid_request"}
 
         def locked():
             with self._lock:

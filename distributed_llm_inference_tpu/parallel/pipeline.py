@@ -214,7 +214,7 @@ class SPMDBackendBase:
                            with_logprobs: bool, with_counts: bool = False):
         raise NotImplementedError(
             f"{self.name} does not support logit_bias / per-token-logprobs "
-            f"decode variants"
+            f"/ frequency-presence-penalty-counts decode variants"
         )
 
 

@@ -126,7 +126,9 @@ def create_backend(
         return cfg, ContextParallelBackend(
             cfg, params, mesh, sp_strategy=sp_strategy
         )
-    if mesh_cfg.dp > 1 or mesh_cfg.pp > 1 or mesh_cfg.tp > 1 or mesh_cfg.ep > 1:
+    if not mesh_cfg.is_trivial:
+        # sp > 1 already returned above, so a non-trivial mesh here means
+        # dp/pp/tp/ep — the SPMD pipeline's axes
         mesh = build_mesh(mesh_cfg)
         return cfg, PipelineBackend(cfg, params, mesh)
     return cfg, SingleDeviceBackend(cfg, params)

@@ -176,3 +176,18 @@ def test_openai_route_accepts_and_validates():
         _reject_unsupported({"frequency_penalty": 3.0}, chat=False)
     with pytest.raises(OpenAIError, match="between"):
         _reject_unsupported({"presence_penalty": -2.5}, chat=False)
+
+
+def test_beam_plus_penalty_rejected(eng):
+    """num_beams > 1 has no per-beam count tracking: combining it with a
+    nonzero frequency/presence penalty must reject loudly (400 envelope),
+    not silently return unpenalized output (advisor round-3)."""
+    out = eng.generate(PROMPT, max_tokens=4, num_beams=2,
+                       frequency_penalty=0.5)
+    assert out["status"] == "failed"
+    assert out.get("error_type") == "invalid_request"
+    assert "num_beams" in out["error"]
+    out = eng.generate(PROMPT, max_tokens=4, num_beams=2,
+                       presence_penalty=-0.5)
+    assert out["status"] == "failed"
+    assert out.get("error_type") == "invalid_request"
