@@ -82,6 +82,23 @@ def _emit(obj):
 _PARTIAL = {"result": None}
 
 
+def _write_sidecar(result):
+    """Persist the current best result to the sidecar file (atomic rename)
+    so the PARENT can still recover it when this child dies without
+    flushing a line — SIGKILL from the parent's subprocess timeout, a
+    tunnel wedge the watchdog can't preempt, an OOM. Never fatal."""
+    path = os.environ.get("_BENCH_SIDECAR")
+    if not path:
+        return
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(result, f)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 - sidecar is best-effort only
+        pass
+
+
 def _fail_line(error, platform="none", **extra):
     out = {
         "metric": "tinyllama_1.1b_decode_throughput",
@@ -305,6 +322,12 @@ def run_benchmark():
     if peak and prefill_tok_s:
         result["prefill_mfu"] = round(2.0 * n_params * prefill_tok_s / peak, 4)
     _PARTIAL["result"] = result
+    # Land the solo-greedy line THE MOMENT it exists (round-3 review #1):
+    # the final emit below re-prints the enriched result and the consumer
+    # takes the LAST parseable line, so an optional leg wedging the tunnel
+    # afterward costs that leg, never the headline number.
+    _emit(result)
+    _write_sidecar(result)
 
     # batched decode: 8 identical streams through the raw backend decode
     # loop (NOT the engine's generate_batch ragged path — this measures the
@@ -329,6 +352,10 @@ def run_benchmark():
         fetch(n_gen_b)  # warm/compile
         per_stream, cache_b = time_decode(params, first_b, cache_b)
         batch_tok_s = BATCH * per_stream
+        result["batch8_tokens_per_sec"] = round(batch_tok_s, 3)
+        if peak:
+            result["batch8_mfu"] = round(2.0 * n_params * batch_tok_s / peak, 5)
+        _write_sidecar(result)
 
     # int8 weight-only leg (ops/quant.py): same decode, half the HBM
     # bytes/token — the lever that moves the bandwidth roofline itself.
@@ -349,6 +376,13 @@ def run_benchmark():
         fetch(n_gen_q)  # warm/compile
         int8_tok_s, cache_q = time_decode(qparams, first_q, cache_q)
         del qparams, cache_q
+        result["int8_tokens_per_sec"] = round(int8_tok_s, 3)
+        if peak_bw:
+            # int8 streams ~1 byte/param (+0.2% scales)
+            result["int8_hbm_util"] = round(
+                1.0 * n_params * int8_tok_s / peak_bw, 4
+            )
+        _write_sidecar(result)
 
     # int4 leg (packed nibbles + Pallas VMEM-unpack kernel): halves the
     # weight bytes again. Fully fenced — compile/kernel failure must
@@ -371,6 +405,13 @@ def run_benchmark():
             )
             fetch(n_gen_q4)  # warm/compile
             int4_tok_s, cache_q4 = time_decode(q4params, first_q4, cache_q4)
+            result["int4_tokens_per_sec"] = round(int4_tok_s, 3)
+            if peak_bw:
+                # int4 streams ~0.5 byte/param (+ per-group scales)
+                result["int4_hbm_util"] = round(
+                    0.5 * n_params * int4_tok_s / peak_bw, 4
+                )
+            _write_sidecar(result)
         except Exception:  # noqa: BLE001 - optional leg, never fatal
             import traceback
 
@@ -413,7 +454,11 @@ def run_benchmark():
                 return FLASH_LEN / t
 
             flash_xla_tok_s = time_prefill(cfg)
+            result["prefill_xla_1k_tok_s"] = round(flash_xla_tok_s, 1)
+            _write_sidecar(result)
             flash_pl_tok_s = time_prefill(cfg.replace(attn_impl="pallas"))
+            result["prefill_flash_1k_tok_s"] = round(flash_pl_tok_s, 1)
+            _write_sidecar(result)
         except Exception:  # noqa: BLE001 - optional leg, never fatal
             import traceback
 
@@ -467,7 +512,10 @@ def run_benchmark():
                 return max(time.perf_counter() - t0 - rtt, 1e-9) / n * 1e3
 
             fleet_xla_ms = time_attn(att_x, fq, fck, fcv, fmask)
+            result["fleet_attn_xla_ms"] = round(fleet_xla_ms, 3)
             fleet_pl_ms = time_attn(att_p, fq, fck, fcv, fpos)
+            result["fleet_attn_flash_ms"] = round(fleet_pl_ms, 3)
+            _write_sidecar(result)
             del fck, fcv
         except Exception:  # noqa: BLE001 - optional leg, never fatal
             import traceback
@@ -529,36 +577,9 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
-    if batch_tok_s is not None:
-        result["batch8_tokens_per_sec"] = round(batch_tok_s, 3)
-        if peak:
-            result["batch8_mfu"] = round(
-                2.0 * n_params * batch_tok_s / peak, 5
-            )
     if cont_tok_s is not None:
         result["continuous_tokens_per_sec"] = round(cont_tok_s, 3)
-    if flash_xla_tok_s is not None:
-        result["prefill_xla_1k_tok_s"] = round(flash_xla_tok_s, 1)
-    if flash_pl_tok_s is not None:
-        result["prefill_flash_1k_tok_s"] = round(flash_pl_tok_s, 1)
-    if fleet_xla_ms is not None:
-        result["fleet_attn_xla_ms"] = round(fleet_xla_ms, 3)
-    if fleet_pl_ms is not None:
-        result["fleet_attn_flash_ms"] = round(fleet_pl_ms, 3)
-    if int8_tok_s is not None:
-        result["int8_tokens_per_sec"] = round(int8_tok_s, 3)
-        if peak_bw:
-            # int8 streams ~1 byte/param (+0.2% scales)
-            result["int8_hbm_util"] = round(
-                1.0 * n_params * int8_tok_s / peak_bw, 4
-            )
-    if int4_tok_s is not None:
-        result["int4_tokens_per_sec"] = round(int4_tok_s, 3)
-        if peak_bw:
-            # int4 streams ~0.5 byte/param (+ per-group scales)
-            result["int4_hbm_util"] = round(
-                0.5 * n_params * int4_tok_s / peak_bw, 4
-            )
+    _write_sidecar(result)
     _emit(result)
 
 
@@ -579,25 +600,73 @@ def _parse_child_json(proc_stdout):
     return json.loads(emitted) if emitted else None
 
 
+def _read_sidecar(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001 - absent/corrupt sidecar = no result
+        return None
+
+
 def _run_child(env, deadline_s):
-    """Run the bench child to completion; (result_dict_or_None, err)."""
+    """Run the bench child to completion; (result_dict_or_None, err).
+
+    Three recovery layers for a child that dies mid-run (tunnel wedge, the
+    parent's own timeout): the last JSON line it FLUSHED (the primary
+    metric is emitted the moment it exists), the TimeoutExpired exception's
+    partial stdout, and the sidecar file it rewrites after every completed
+    leg. A timed-out child with a solo number therefore still lands a TPU
+    headline instead of "child exceeded Ns"."""
+    import tempfile
+
     env = dict(env)
     env["_BENCH_BACKEND_RESOLVED"] = "1"
     env["_BENCH_DEADLINE_S"] = str(max(30.0, deadline_s - 30.0))
+    # mkstemp, not mktemp: the parent CREATES and owns the file up front,
+    # so no other process can squat the predictable /tmp name between name
+    # generation and the child's first atomic replace
+    fd, sidecar = tempfile.mkstemp(prefix="bench_sidecar_", suffix=".json")
+    os.close(fd)
+    env["_BENCH_SIDECAR"] = sidecar
+    partial_out = ""
+    clean_exit = False
+    timed_out = None
     try:
         proc = subprocess.run(
             [sys.executable, __file__], env=env,
             capture_output=True, text=True, timeout=deadline_s,
         )
-    except subprocess.TimeoutExpired:
-        return None, f"child exceeded {deadline_s:.0f}s"
-    sys.stderr.write(proc.stderr[-4000:])
-    out = _parse_child_json(proc.stdout)
+        partial_out = proc.stdout or ""
+        clean_exit = proc.returncode == 0
+        sys.stderr.write((proc.stderr or "")[-4000:])
+        rc_note = f"child rc={proc.returncode} emitted no JSON line; " \
+                  f"stderr tail: {(proc.stderr or '')[-500:]}"
+    except subprocess.TimeoutExpired as e:
+        # capture_output buffers in-memory: the exception carries whatever
+        # the child flushed before the kill
+        partial_out = (
+            e.stdout.decode() if isinstance(e.stdout, bytes) else e.stdout
+        ) or ""
+        timed_out = f"child exceeded {deadline_s:.0f}s"
+        rc_note = timed_out
+    # Precedence: on a CLEAN exit the final stdout line is the complete
+    # result. On any other outcome the SIDECAR is at least as fresh as
+    # anything stdout held when the child died (it is rewritten after
+    # every completed leg, stdout only at the solo emit + the end), so it
+    # wins — a kill mid-int8-leg must not drop the batch8 number the
+    # sidecar already recorded.
+    if clean_exit:
+        out = _parse_child_json(partial_out) or _read_sidecar(sidecar)
+    else:
+        out = _read_sidecar(sidecar) or _parse_child_json(partial_out)
+    try:
+        os.unlink(sidecar)
+    except OSError:
+        pass
     if out is None:
-        return None, (
-            f"child rc={proc.returncode} emitted no JSON line; "
-            f"stderr tail: {proc.stderr[-500:]}"
-        )
+        return None, rc_note
+    if timed_out:
+        out["child_timed_out"] = True
     return out, None
 
 
@@ -637,7 +706,16 @@ def main():
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        _fail_line(e, platform=os.environ.get("JAX_PLATFORMS") or "unknown")
+        partial = _PARTIAL.get("result")
+        if partial is not None:
+            # an optional leg died AFTER the primary metric landed: the
+            # headline must win over a 0.0 fail line (the consumer takes
+            # the LAST parseable stdout line)
+            partial["leg_error"] = str(e)[-500:]
+            _emit(partial)
+            _write_sidecar(partial)
+        else:
+            _fail_line(e, platform=os.environ.get("JAX_PLATFORMS") or "unknown")
     done.set()
     return 0
 
@@ -718,6 +796,11 @@ def _orchestrate():
     cpu_env["_BENCH_BACKEND_RESOLVED"] = "1"
     cpu_budget = max(60.0, min(600.0, _remaining(margin=120.0)))
     cpu_env["_BENCH_DEADLINE_S"] = str(max(30.0, cpu_budget - 30.0))
+    fd, cpu_sidecar = tempfile.mkstemp(
+        prefix="bench_sidecar_cpu_", suffix=".json"
+    )
+    os.close(fd)
+    cpu_env["_BENCH_SIDECAR"] = cpu_sidecar
     out_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8")
     err_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8")
     child = subprocess.Popen(
@@ -746,7 +829,11 @@ def _orchestrate():
     sys.stderr.write(err_f.read()[-4000:])
     out_f.close()
     err_f.close()
-    cpu_result = _parse_child_json(cpu_out)
+    cpu_result = _parse_child_json(cpu_out) or _read_sidecar(cpu_sidecar)
+    try:
+        os.unlink(cpu_sidecar)
+    except OSError:
+        pass
 
     # post-CPU probe loop: the whole remaining budget (minus one TPU leg)
     # is probe time — but only while a TPU could still appear (a wedged

@@ -504,18 +504,6 @@ class InferenceEngine:
         """
         t_start = time.time()
 
-        if getattr(self.backend, "batch_granularity", 1) > 1:
-            # 1F1B fleets decode dp*M rows at a time: a solo request rides
-            # the batched path (the fleet pads itself to the granularity)
-            return self._generate_solo_via_batch(
-                prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-                seed, min_p, repetition_penalty, stop, t_start,
-                debug=debug, speculative=speculative, logprobs=logprobs,
-                logit_bias=logit_bias, num_beams=num_beams,
-                frequency_penalty=frequency_penalty,
-                presence_penalty=presence_penalty,
-            )
-
         if num_beams > 1 and (frequency_penalty != 0.0 or presence_penalty != 0.0):
             # the beam path is a pure max-score search with no per-beam
             # count tracking: reject loudly instead of silently returning
@@ -555,61 +543,6 @@ class InferenceEngine:
         except Exception as e:  # error envelope (orchestration.py:220-228)
             log.error("generate_failed", exc_info=True, error=str(e))
             return {"error": f"Error: {e}", "status": "failed"}
-
-    def _generate_solo_via_batch(
-        self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
-        seed, min_p, repetition_penalty, stop, t_start, *, debug,
-        speculative, logprobs, logit_bias, num_beams,
-        frequency_penalty=0.0, presence_penalty=0.0,
-    ):
-        """Solo request on a fleet-granular backend (pipeline-1f1b):
-        delegate to generate_batch([prompt]) — which pads the fleet up to
-        batch_granularity — and re-shape the row result into the solo
-        reference-schema envelope (orchestration.py:211-218)."""
-        unsupported = [
-            name for name, on in (
-                ("debug", debug), ("speculative", speculative),
-                ("logprobs", logprobs), ("logit_bias", logit_bias is not None),
-                ("num_beams", num_beams > 1),
-                ("frequency_penalty/presence_penalty",
-                 frequency_penalty != 0.0 or presence_penalty != 0.0),
-            ) if on
-        ]
-        if unsupported:
-            msg = (
-                f"{', '.join(unsupported)} not supported on backend "
-                f"{self.backend.name!r}; serve on the single-device or "
-                f"plain pipeline backend"
-            )
-            log.warning("invalid_request", error=msg)
-            return {"error": f"Error: {msg}", "status": "failed",
-                    "error_type": "invalid_request"}
-        batch = self.generate_batch(
-            [prompt], max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, top_p=top_p, greedy=greedy, chat=chat, seed=seed,
-            min_p=min_p, repetition_penalty=repetition_penalty, stop=stop,
-        )
-        if batch.get("status") != "success":
-            return batch
-        r = batch["results"][0]
-        elapsed = time.time() - t_start
-        n = r["tokens_generated"]
-        tps = n / elapsed if elapsed > 0 else 0.0
-        out = {
-            "prompt": prompt,
-            "response": r["response"],
-            "status": "success",
-            "time_taken": f"{elapsed:.2f}s",
-            "tokens_generated": n,
-            "prompt_tokens": r["prompt_tokens"],
-            "tokens_per_sec": f"{tps:.2f}",
-            "ttft_s": batch.get("ttft_s"),
-            "backend": self.backend.name,
-            "finish_reason": r.get("finish_reason"),
-        }
-        if r.get("stopped"):
-            out["stopped"] = True
-        return out
 
     def _plan_ingest(self, prompt_len: int, p0: int, buckets: tuple,
                      capacity: Optional[int] = None):
@@ -746,10 +679,14 @@ class InferenceEngine:
 
     def _beam_locked(self, prompt, max_tokens, num_beams, length_penalty,
                      early_stopping, chat, t_start, stop):
-        """Deterministic beam search (engine side): tile the prompt to
-        [num_beams] rows, one batched prefill, then G.decode_beam. The
-        beam cache reuses the batched-cache pool (keyed by row count,
-        exactly like generate_batch's buckets)."""
+        """Deterministic beam search (engine side): prefill the prompt
+        ONCE (batch 1), tile the prompt KV and first-position logits to
+        [num_beams] rows, then G.decode_beam. Tiling instead of an
+        [num_beams]-row prefill saves (num_beams-1) prompt forwards AND
+        keeps the logits contract backend-independent — a fleet-granular
+        backend's fleet prefill returns zero-width logits by design, which
+        an [num_beams]-row prefill would hand decode_beam whenever
+        num_beams lands on the fleet granularity."""
         cfg = self.cfg
         self.request_count += 1
         if not getattr(self.backend, "supports_beam", False):
@@ -773,15 +710,22 @@ class InferenceEngine:
         max_tokens, decode_bucket = self._clamp_decode(prompt_len, max_tokens)
         pad = cfg.pad_token_id
         row = ids + [pad] * (bucket - prompt_len)
-        tokens = jnp.asarray([row] * num_beams, jnp.int32)
-        cache = self._batch_caches.pop(num_beams, None)
-        if cache is None:
-            cache = self.backend.init_cache(num_beams, cfg.max_seq_len)
+        tokens = jnp.asarray([row], jnp.int32)
+        cache1 = self._cache or self.backend.init_cache(1, cfg.max_seq_len)
+        self._cache = None  # donated into prefill; restored below
         sampling = G.default_sampling(greedy=True)
-        _, logits, cache = self.backend.prefill(
-            tokens, jnp.int32(prompt_len), cache, jax.random.PRNGKey(0),
+        _, logits, cache1 = self.backend.prefill(
+            tokens, jnp.int32(prompt_len), cache1, jax.random.PRNGKey(0),
             sampling,
         )
+        # every beam starts from the same prompt: tile batch axis 1 of
+        # each cache leaf (KVQuant scale leaves ride the same recipe one
+        # rank down) and the [1, V] first-position logits
+        cache = jax.tree.map(
+            lambda x: jnp.tile(x, (1, num_beams) + (1,) * (x.ndim - 2)),
+            cache1,
+        )
+        logits = jnp.tile(logits, (num_beams, 1))
         ttft = time.time() - t_start
         out, n_gen, scores, cache = self.backend.decode_beam(
             logits, cache, jnp.int32(prompt_len), jnp.int32(max_tokens),
@@ -789,8 +733,7 @@ class InferenceEngine:
             num_beams=num_beams, early_stopping=early_stopping,
         )
         out = jax.block_until_ready(out)
-        self._batch_caches.clear()
-        self._batch_caches[num_beams] = cache
+        self._cache = cache1  # the batch-1 scratch, stale rows masked
 
         beams = []
         for b in range(num_beams):
@@ -1424,101 +1367,100 @@ class InferenceEngine:
             )
         pad = self.cfg.pad_token_id
         with self._lock:
-            if gran == 1:
-                # single-stream programs: never used on a fleet-
-                # granular backend (solo requests ride the batched
-                # path there — _generate_solo_via_batch)
-                cache = self._cache or self.backend.init_cache(1, self.cfg.max_seq_len)
-                self._cache = None
-                first = None
+            # single-stream programs: EVERY backend serves solo requests
+            # batch-1 (fleet-granular backends dispatch solo rows to their
+            # inherited plain-ring programs), so warm them everywhere
+            cache = self._cache or self.backend.init_cache(1, self.cfg.max_seq_len)
+            self._cache = None
+            first = None
+            for bucket in buckets:
+                tokens = jnp.full((1, bucket), pad, jnp.int32)
+                first, _, cache = self.backend.prefill(
+                    tokens, jnp.int32(1), cache, key, sampling
+                )
+                n += 1
+            if hasattr(self.backend, "extend"):
+                chunk_tokens = jnp.full((1, buckets[-1]), pad, jnp.int32)
+                cache = self.backend.extend(chunk_tokens, jnp.int32(0), cache)
+                n += 1
+            for db in decode_buckets:
+                # limit=0: compiles the while_loop program, executes 0 steps
+                _, _, cache = self.backend.decode(
+                    first, cache, jnp.int32(1), jnp.int32(0), key, sampling,
+                    max_steps=db,
+                )
+                n += 1
+            if getattr(self.backend, "supports_presence", False):
+                # repetition-penalty (presence) program variants — 'no
+                # request pays jit latency' covers penalized requests too.
+                # Single-stream only: batched penalized programs compile on
+                # first use (rarer path; the grid would double warmup).
+                pres1 = jnp.zeros((1, self.cfg.vocab_size), bool)
                 for bucket in buckets:
                     tokens = jnp.full((1, bucket), pad, jnp.int32)
                     first, _, cache = self.backend.prefill(
-                        tokens, jnp.int32(1), cache, key, sampling
+                        tokens, jnp.int32(1), cache, key, sampling,
+                        presence=pres1,
                     )
-                    n += 1
-                if hasattr(self.backend, "extend"):
-                    chunk_tokens = jnp.full((1, buckets[-1]), pad, jnp.int32)
-                    cache = self.backend.extend(chunk_tokens, jnp.int32(0), cache)
                     n += 1
                 for db in decode_buckets:
-                    # limit=0: compiles the while_loop program, executes 0 steps
                     _, _, cache = self.backend.decode(
-                        first, cache, jnp.int32(1), jnp.int32(0), key, sampling,
-                        max_steps=db,
+                        first, cache, jnp.int32(1), jnp.int32(0), key,
+                        sampling, presence=pres1, max_steps=db,
                     )
                     n += 1
-                if getattr(self.backend, "supports_presence", False):
-                    # repetition-penalty (presence) program variants — 'no
-                    # request pays jit latency' covers penalized requests too.
-                    # Single-stream only: batched penalized programs compile on
-                    # first use (rarer path; the grid would double warmup).
-                    pres1 = jnp.zeros((1, self.cfg.vocab_size), bool)
-                    for bucket in buckets:
-                        tokens = jnp.full((1, bucket), pad, jnp.int32)
-                        first, _, cache = self.backend.prefill(
-                            tokens, jnp.int32(1), cache, key, sampling,
-                            presence=pres1,
-                        )
-                        n += 1
-                    for db in decode_buckets:
-                        _, _, cache = self.backend.decode(
-                            first, cache, jnp.int32(1), jnp.int32(0), key,
-                            sampling, presence=pres1, max_steps=db,
-                        )
-                        n += 1
-                if getattr(self.backend, "supports_logprobs", False):
-                    # the with_logprobs decode variant compiles separately
-                    # (static flag adds a logprob buffer to the loop carry)
-                    for db in decode_buckets:
-                        _, _, cache, _ = self.backend.decode(
-                            first, cache, jnp.int32(1), jnp.int32(0), key,
-                            sampling, max_steps=db, with_logprobs=True,
-                        )
-                        n += 1
-                if self._draft is not None and getattr(
-                    self.backend, "supports_draft", False
-                ):
-                    # speculative requests route to the DRAFT path when a
-                    # draft is attached — warm ITS programs (ingest per
-                    # bucket + the chunked-extend variant + the combined
-                    # verify loop per decode bucket); the prompt-lookup
-                    # program would be dead weight
-                    dcfg, dparams = self._draft
-                    dcache = self._draft_cache
-                    self._draft_cache = None
-                    if dcache is None:
-                        dcache = M.init_kv_cache(
-                            dcfg, 1, max_seq=self.cfg.max_seq_len
-                        )
-                    for bucket in buckets:
-                        dcache = self._draft_ingest([pad] * bucket, dcache)
-                        n += 1
-                    chunked_len = buckets[-1] + 1
-                    if self._plan_ingest(chunked_len, 0, buckets) is not None:
-                        dcache = self._draft_ingest([pad] * chunked_len, dcache)
-                        n += 1
-                    for db in decode_buckets:
-                        _, _, cache, dcache = self.backend.decode_draft_speculative(
-                            dcfg, dparams, first, cache, dcache, jnp.int32(1),
-                            jnp.int32(0), max_steps=db,
-                            draft_len=SPEC_DRAFT_LEN,
-                        )
-                        n += 1
-                    self._draft_cache = dcache
-                elif getattr(self.backend, "supports_speculative", False):
-                    # speculative programs too — 'no request pays jit latency'
-                    # includes speculative=true requests
-                    H = self.cfg.max_seq_len + SPEC_DRAFT_LEN + 2
-                    hist = jnp.zeros((1, H), jnp.int32)
-                    for db in decode_buckets:
-                        _, _, cache = self.backend.decode_speculative(
-                            first, cache, hist, jnp.int32(1), jnp.int32(0),
-                            max_steps=db, draft_len=SPEC_DRAFT_LEN,
-                        )
-                        n += 1
-                jax.block_until_ready(cache)
-                self._cache = cache  # first real request reuses the buffer
+            if getattr(self.backend, "supports_logprobs", False):
+                # the with_logprobs decode variant compiles separately
+                # (static flag adds a logprob buffer to the loop carry)
+                for db in decode_buckets:
+                    _, _, cache, _ = self.backend.decode(
+                        first, cache, jnp.int32(1), jnp.int32(0), key,
+                        sampling, max_steps=db, with_logprobs=True,
+                    )
+                    n += 1
+            if self._draft is not None and getattr(
+                self.backend, "supports_draft", False
+            ):
+                # speculative requests route to the DRAFT path when a
+                # draft is attached — warm ITS programs (ingest per
+                # bucket + the chunked-extend variant + the combined
+                # verify loop per decode bucket); the prompt-lookup
+                # program would be dead weight
+                dcfg, dparams = self._draft
+                dcache = self._draft_cache
+                self._draft_cache = None
+                if dcache is None:
+                    dcache = M.init_kv_cache(
+                        dcfg, 1, max_seq=self.cfg.max_seq_len
+                    )
+                for bucket in buckets:
+                    dcache = self._draft_ingest([pad] * bucket, dcache)
+                    n += 1
+                chunked_len = buckets[-1] + 1
+                if self._plan_ingest(chunked_len, 0, buckets) is not None:
+                    dcache = self._draft_ingest([pad] * chunked_len, dcache)
+                    n += 1
+                for db in decode_buckets:
+                    _, _, cache, dcache = self.backend.decode_draft_speculative(
+                        dcfg, dparams, first, cache, dcache, jnp.int32(1),
+                        jnp.int32(0), max_steps=db,
+                        draft_len=SPEC_DRAFT_LEN,
+                    )
+                    n += 1
+                self._draft_cache = dcache
+            elif getattr(self.backend, "supports_speculative", False):
+                # speculative programs too — 'no request pays jit latency'
+                # includes speculative=true requests
+                H = self.cfg.max_seq_len + SPEC_DRAFT_LEN + 2
+                hist = jnp.zeros((1, H), jnp.int32)
+                for db in decode_buckets:
+                    _, _, cache = self.backend.decode_speculative(
+                        first, cache, hist, jnp.int32(1), jnp.int32(0),
+                        max_steps=db, draft_len=SPEC_DRAFT_LEN,
+                    )
+                    n += 1
+            jax.block_until_ready(cache)
+            self._cache = cache  # first real request reuses the buffer
 
             # batched/ragged programs. Only the LARGEST warmed bucket's
             # cache is retained afterwards: keeping one per bucket would

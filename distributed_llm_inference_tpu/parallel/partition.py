@@ -240,7 +240,9 @@ def cache_spec(cfg=None):
     same treedef trick as the quantized weight specs above), so every
     shard_map in/out spec and sharding constraint distributes per leaf.
     cfg=None keeps the raw single-spec form (callers that never see a
-    quantized cache: context/schedule backends, which gate kv_quant off).
+    quantized cache — the context backend, which gates kv_quant off; the
+    pipeline AND 1F1B schedule backends pass cfg and serve KVQuant
+    caches).
     """
     p5 = P(AXIS_PP, AXIS_DP, AXIS_TP, None, None)
     if cfg is None or getattr(cfg, "kv_quant", None) is None:

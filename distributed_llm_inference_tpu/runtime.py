@@ -51,16 +51,16 @@ def create_backend(
         cfg = cfg.replace(quant=quant)
     if kv_quant is not None:
         cfg = cfg.replace(kv_quant=kv_quant)
-    if cfg.kv_quant is not None and (mesh_cfg.sp > 1 or microbatches > 1):
-        # the ring-attention hook and the 1F1B schedule read raw-dtype
-        # cache slabs; the plain pp/tp/dp pipeline quantizes fine (its
-        # cache specs distribute per KVQuant leaf — parallel/partition.
-        # cache_spec). Checked before params init like the guards around
-        # it.
+    if cfg.kv_quant is not None and mesh_cfg.sp > 1:
+        # the ring-attention hook reads raw-dtype cache slabs; every other
+        # topology — single device, pp/tp/dp pipeline, microbatched 1F1B —
+        # quantizes fine (cache specs and the 1F1B row slicing distribute
+        # per KVQuant leaf — parallel/partition.cache_spec,
+        # schedule._stage_apply). Checked before params init like the
+        # guards around it.
         raise NotImplementedError(
-            "kv_quant runs on the single device and pp/tp/dp pipeline "
-            "meshes; sp (ring attention) and microbatched 1F1B keep "
-            "raw-dtype caches"
+            "kv_quant runs on the single device and pp/tp/dp/1F1B "
+            "pipeline meshes; sp (ring attention) keeps raw-dtype caches"
         )
     if attn_impl is not None:
         from .config import resolve_attn_impl

@@ -67,7 +67,10 @@ def test_batch8_matches_plain_pipeline_greedy(engines):
         assert ra["tokens_generated"] == rb["tokens_generated"]
 
 
-def test_solo_rides_batched_path(engines):
+def test_solo_serves_on_plain_ring(engines):
+    """Solo requests dispatch to the inherited plain-ring batch-1
+    programs (round-3 review #3) — bit-identical to the plain pipeline,
+    full solo envelope."""
     plain, f1b = engines
     a = plain.generate("11 22 33", max_tokens=5, greedy=True, chat=False)
     b = f1b.generate("11 22 33", max_tokens=5, greedy=True, chat=False)
@@ -79,12 +82,25 @@ def test_solo_rides_batched_path(engines):
         assert k in b
 
 
-def test_solo_unsupported_feature_rejected_cleanly(engines):
-    _, f1b = engines
-    r = f1b.generate("1 2", max_tokens=3, greedy=True, chat=False,
-                     logprobs=True)
-    assert r["status"] == "failed"
-    assert r["error_type"] == "invalid_request"
+def test_solo_full_surface_on_1f1b(engines):
+    """Round-3 review #3's acceptance: logprobs / logit_bias / penalties
+    SERVE on the 1F1B backend now (plain-ring dispatch), identical to
+    the plain pipeline."""
+    plain, f1b = engines
+    kw = dict(max_tokens=4, greedy=True, chat=False)
+    a = plain.generate("1 2", logprobs=True, **kw)
+    b = f1b.generate("1 2", logprobs=True, **kw)
+    assert b["status"] == "success"
+    assert b["response"] == a["response"]
+    assert b["token_logprobs"] == a["token_logprobs"]
+    a = plain.generate("1 2", logit_bias={"17": 100.0}, **kw)
+    b = f1b.generate("1 2", logit_bias={"17": 100.0}, **kw)
+    assert b["response"] == a["response"]
+    assert set(b["response"].split()) == {"17"}
+    a = plain.generate("5 5 5", frequency_penalty=1.5, **kw)
+    b = f1b.generate("5 5 5", frequency_penalty=1.5, **kw)
+    assert b["status"] == "success"
+    assert b["response"] == a["response"]
 
 
 def test_odd_batch_pads_to_granularity(engines):
@@ -124,8 +140,9 @@ def test_http_batch8_on_1f1b(engines):
 
 
 def test_1f1b_warmup(engines):
-    """--warmup on a 1F1B engine compiles only granularity-multiple fleet
-    programs (no batch-1 program exists on this backend)."""
+    """--warmup on a 1F1B engine compiles BOTH the batch-1 plain-ring solo
+    programs (solo requests dispatch there now) and the granularity-
+    multiple fleet programs."""
     _, f1b = engines
     stats = f1b.warmup(decode_buckets=(16,), batch_buckets=(2,))
     assert stats["programs"] > 0

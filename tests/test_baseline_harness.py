@@ -1,6 +1,7 @@
 """Smoke test: the 5-config BASELINE harness stays runnable in CI."""
 
 import json
+import os
 import io
 import contextlib
 import sys
@@ -21,3 +22,43 @@ def test_harness_runs_each_config_shape(capsys):
         assert rec["tokens_per_sec"] > 0
         assert rec["ttft_s"] >= 0
         assert rec["platform"] == "cpu"
+
+
+def test_bench_sidecar_roundtrip(tmp_path, monkeypatch):
+    """bench.py's sidecar is the crash-recovery channel: a result written
+    after each completed leg must read back exactly, atomically replacing
+    the previous state, and a missing/corrupt file must read as None."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    side = tmp_path / "side.json"
+    monkeypatch.setenv("_BENCH_SIDECAR", str(side))
+    r1 = {"metric": "m", "value": 1.0}
+    bench._write_sidecar(r1)
+    assert bench._read_sidecar(str(side)) == r1
+    r2 = dict(r1, value=2.0, extra_leg=3)
+    bench._write_sidecar(r2)
+    assert bench._read_sidecar(str(side)) == r2
+    assert bench._read_sidecar(str(tmp_path / "absent.json")) is None
+    side.write_text("{corrupt")
+    assert bench._read_sidecar(str(side)) is None
+    # unset env: write is a silent no-op (never fatal mid-bench)
+    monkeypatch.delenv("_BENCH_SIDECAR")
+    bench._write_sidecar(r2)
+
+
+def test_bench_child_json_takes_last_line():
+    """The consumer contract: the LAST parseable JSON line wins, so the
+    early solo-greedy emit is superseded by the enriched final line when
+    the child survives, and stands when it does not."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    out = (
+        'WARNING: noise\n'
+        '{"metric": "m", "value": 1.0}\n'
+        'more noise {not json}\n'
+        '{"metric": "m", "value": 2.0, "int8_tokens_per_sec": 5}\n'
+    )
+    assert bench._parse_child_json(out)["value"] == 2.0
+    assert bench._parse_child_json("no json at all") is None

@@ -183,14 +183,36 @@ def test_pp_mesh_kv_quant_matches_single_device(raw_engine, eight_devices):
         assert g["response"] == w["response"]
 
 
-def test_kv_quant_microbatch_still_rejected():
-    # (the sp=2 rejection is asserted in test_kv_quant_rejects_illegal_combos)
+@pytest.mark.slow
+def test_kv_quant_1f1b_fleet_matches_single_device(raw_engine, eight_devices):
+    """kv_quant composes with the microbatched 1F1B schedule now (round-3
+    review #5b): _stage_apply slices the KVQuant leaves per microbatch and
+    the cache specs distribute per leaf — a greedy int8 fleet on the
+    zero-bubble schedule emits the same tokens as the single-device int8
+    engine, row for row."""
     from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
-    from distributed_llm_inference_tpu.runtime import create_backend
+    from distributed_llm_inference_tpu.runtime import create_engine
 
-    cfg = get_model_config("test-llama-tiny", kv_quant="int8")
-    with pytest.raises(NotImplementedError, match="raw-dtype"):
-        create_backend(cfg, mesh_cfg=MeshConfig(pp=2), microbatches=2)
+    qcfg = raw_engine.cfg.replace(kv_quant="int8")
+    pp = create_engine(
+        qcfg, mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        params=raw_engine.backend.params,
+    )
+    f1b = create_engine(
+        qcfg, mesh_cfg=MeshConfig(pp=2), microbatches=2,
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        params=raw_engine.backend.params,
+    )
+    assert f1b.backend.name == "pipeline-1f1b"
+    kw = dict(greedy=True, chat=False, max_tokens=8)
+    want = pp.generate_batch(PROMPTS[:4], **kw)
+    got = f1b.generate_batch(PROMPTS[:4], **kw)
+    assert got["status"] == want["status"] == "success"
+    assert (
+        [r["response"] for r in got["results"]]
+        == [r["response"] for r in want["results"]]
+    )
 
 
 @pytest.mark.slow

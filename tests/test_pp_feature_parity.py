@@ -31,16 +31,22 @@ class _NumTok:
         return " ".join(str(int(t)) for t in toks)
 
 
-@pytest.fixture(scope="module")
-def engines():
+@pytest.fixture(scope="module", params=["pipeline", "pipeline-1f1b"])
+def engines(request):
+    """(single-device, mesh) engine pair — parametrized over the plain pp
+    ring AND the microbatched 1F1B backend (round-3 review #3: the full
+    request surface on config 5's topology too; 1F1B dispatches these
+    solo/variant calls to its inherited plain-ring programs)."""
     cfg = get_model_config("test-llama-tiny", eos_token_id=-1)
     params = M.init_params(cfg, jax.random.PRNGKey(5))
     ecfg = EngineConfig(prefill_buckets=(32, 64))
     sd = InferenceEngine(cfg, params=params, tokenizer=_NumTok(), engine_cfg=ecfg)
+    mb = 2 if request.param == "pipeline-1f1b" else 1
     pp = create_engine(
-        cfg, mesh_cfg=MeshConfig(pp=2), params=params, tokenizer=_NumTok(),
-        engine_cfg=ecfg,
+        cfg, mesh_cfg=MeshConfig(pp=2), microbatches=mb, params=params,
+        tokenizer=_NumTok(), engine_cfg=ecfg,
     )
+    assert pp.backend.name == request.param
     return sd, pp
 
 
@@ -103,6 +109,22 @@ def test_beam_search_bit_consistent(engines):
     for ba, bb in zip(a["beams"], b["beams"]):
         assert ba["text"] == bb["text"]
         np.testing.assert_allclose(ba["score"], bb["score"], atol=1e-5)
+
+
+def test_beam_count_on_fleet_granularity(engines):
+    """num_beams == 2 lands exactly on the 1F1B backend's fleet
+    granularity: the beam prefill must still seed from REAL logits (the
+    engine prefills batch-1 and tiles — an [num_beams]-row prefill on the
+    fleet path returned zero-width logits and crashed decode_beam's
+    top_k; caught driving the HTTP surface, round 4)."""
+    sd, pp = engines
+    kw = dict(max_tokens=6, num_beams=2, chat=False)
+    a = sd.generate(PROMPT, **kw)
+    b = pp.generate(PROMPT, **kw)
+    assert a["status"] == b["status"] == "success"
+    assert a["response"] == b["response"]
+    for ba, bb in zip(a["beams"], b["beams"]):
+        assert ba["text"] == bb["text"]
 
 
 def test_repetition_penalty_with_bias_pp(engines):
