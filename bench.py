@@ -829,7 +829,13 @@ def _orchestrate():
     sys.stderr.write(err_f.read()[-4000:])
     out_f.close()
     err_f.close()
-    cpu_result = _parse_child_json(cpu_out) or _read_sidecar(cpu_sidecar)
+    # same precedence rule as _run_child: a clean exit's final stdout line
+    # is complete; a killed child's sidecar is fresher than whatever it
+    # had flushed (later legs write sidecar-only until the final emit)
+    if child.returncode == 0:
+        cpu_result = _parse_child_json(cpu_out) or _read_sidecar(cpu_sidecar)
+    else:
+        cpu_result = _read_sidecar(cpu_sidecar) or _parse_child_json(cpu_out)
     try:
         os.unlink(cpu_sidecar)
     except OSError:

@@ -162,8 +162,9 @@ class ContinuousEngine:
             if not getattr(engine.backend, "supports_paged", False):
                 raise ValueError(
                     f"backend {engine.backend.name!r} does not support "
-                    f"paged KV (llama-family single-device only); drop "
-                    f"kv_pool_blocks or use the dense fleet"
+                    f"paged KV (llama family, single device or a dp=1 "
+                    f"pp/tp mesh); drop kv_pool_blocks or use the dense "
+                    f"fleet"
                 )
             from . import paged as P
 

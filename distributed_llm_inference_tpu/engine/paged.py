@@ -39,8 +39,11 @@ paged attention):
 
 Paged mode is llama-family only (the hook seam lives in
 models/llama.decoder_layer; gpt2's learned-position block doesn't expose
-it) and single-device only for now — the pp fleet keeps the dense layout,
-whose per-stage shards are what the ring schedule wants anyway.
+it). It runs on the single device AND on dp=1 pp/tp meshes: the pool
+shards its layer axis over pp / kv heads over tp exactly like the dense
+cache (parallel/partition.pool_spec), the scratch→pool scatter is
+layer-local, and ungated ring microsteps redirect their block writes to
+the trash block (parallel/pipeline._build_decode_slots_paged).
 
 Reference contrast: /root/reference has no KV cache at all
 (Worker1.py:132-134 — full-sequence recompute per token); this module is
@@ -66,15 +69,18 @@ from . import generate as G
 TRASH_BLOCK = 0  # reserved pool block: write-only spill for table tails
 
 
-def init_pool(cfg: ModelConfig, n_blocks: int, block_size: int):
+def init_pool(cfg: ModelConfig, n_blocks: int, block_size: int,
+              n_layers: Optional[int] = None):
     """Zeroed block pool, stacked on the layer axis like the dense cache.
     Block 0 is the reserved trash block (never allocated to a slot).
     With cfg.kv_quant the pool leaves are KVQuant pytrees — int8 blocks
     plus per-(token, head) scales [L, N, KV, bs] — so BOTH HBM levers
     compose: the pool tracks in-flight tokens AND each token costs half
-    the bytes."""
+    the bytes. n_layers overrides the layer count (the pp mesh pads the
+    layer axis to ceil(L/pp)*pp, matching the padded stacked layers)."""
     shape = (
-        cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size, cfg.head_dim
+        n_layers or cfg.n_layers, n_blocks, cfg.n_kv_heads, block_size,
+        cfg.head_dim,
     )
     if cfg.kv_quant == "int8":
         sshape = shape[:-1]
@@ -136,7 +142,7 @@ def make_paged_hook(table: jnp.ndarray):
 
     def hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
              valid_start):
-        del update_gate, valid_start  # single-device decode only
+        del valid_start  # slots never left-pad
         B, T, H, Dh = q.shape
         assert T == 1, "paged hook serves decode steps (T=1) only"
         bs = cache_k.shape[2]
@@ -150,6 +156,14 @@ def make_paged_hook(table: jnp.ndarray):
         # dynamic_update_slice clamp (ops/attention.update_kv_cache_slots).
         lblk = jnp.minimum(pos // bs, MB - 1)  # [B]
         blk = jnp.take_along_axis(table, lblk[:, None], axis=1)[:, 0]  # [B]
+        if update_gate is not None:
+            # pp ring: a stage applies its layer shard EVERY microstep but
+            # owns the live buffer on exactly one — ungated microsteps
+            # redirect their scatter to the write-only TRASH block (table
+            # tails only map logical positions past every slot's budget,
+            # so trash content is never attended). Same slice-granularity
+            # discard as the dense pipeline's gated cache writes.
+            blk = jnp.where(update_gate, blk, TRASH_BLOCK)
         off = pos % bs
         if isinstance(cache_k, KVQuant):
             # int8 pool: quantize the token's K/V, scatter data + scale
@@ -207,6 +221,32 @@ def make_paged_hook(table: jnp.ndarray):
         return attn, new_k, new_v
 
     return hook
+
+
+def scatter_scratch(pool, scratch, table_row):
+    """Scatter a CONTIGUOUS batch-1 scratch cache into `table_row`'s pool
+    blocks, leaf by leaf (shared by the single-device insert and the pp
+    backend's shard_map insert — the scatter is layer-local, so it runs
+    unchanged on a layer-sharded pool slice)."""
+
+    def scatter(pl, sc):
+        # sc [L, 1, KV, S, Dh] -> [L, MB, KV, bs, Dh] block view; the
+        # int8 pool's scale leaves ride the same recipe one rank down
+        # ([L, 1, KV, S] -> [L, MB, KV, bs])
+        bs = pl.shape[3]
+        if sc.ndim == 5:
+            L, _, KV, S, Dh = sc.shape
+            MB = S // bs
+            blocks = (
+                sc[:, 0].reshape(L, KV, MB, bs, Dh).transpose(0, 2, 1, 3, 4)
+            )
+        else:
+            L, _, KV, S = sc.shape
+            MB = S // bs
+            blocks = sc[:, 0].reshape(L, KV, MB, bs).transpose(0, 2, 1, 3)
+        return pl.at[:, table_row].set(blocks)
+
+    return jax.tree.map(scatter, pool, scratch)
 
 
 def _forward_step_paged(cfg, params, tokens, pool, table, pos):
@@ -291,25 +331,7 @@ def insert_slot_paged(
     stale high blocks are never attended.
     """
     slot = jnp.int32(slot)
-
-    def scatter(pl, sc):
-        # sc [L, 1, KV, S, Dh] -> [L, MB, KV, bs, Dh] block view; the
-        # int8 pool's scale leaves ride the same recipe one rank down
-        # ([L, 1, KV, S] -> [L, MB, KV, bs])
-        bs = pl.shape[3]
-        if sc.ndim == 5:
-            L, _, KV, S, Dh = sc.shape
-            MB = S // bs
-            blocks = (
-                sc[:, 0].reshape(L, KV, MB, bs, Dh).transpose(0, 2, 1, 3, 4)
-            )
-        else:
-            L, _, KV, S = sc.shape
-            MB = S // bs
-            blocks = sc[:, 0].reshape(L, KV, MB, bs).transpose(0, 2, 1, 3)
-        return pl.at[:, table_row].set(blocks)
-
-    pool = jax.tree.map(scatter, pool, scratch)
+    pool = scatter_scratch(pool, scratch, table_row)
     state, sparams = G.arm_slot(
         cfg, state, sparams, slot, first_token, prompt_len, max_tokens,
         temperature, top_k, top_p, greedy, min_p, rep_penalty,

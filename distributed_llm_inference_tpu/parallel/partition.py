@@ -253,6 +253,46 @@ def cache_spec(cfg=None):
     return {"k": leaf, "v": leaf}
 
 
+def pool_spec(cfg):
+    """Paged-KV block pool [L, N, KV, bs, Dh]: layers over pp, kv heads
+    over tp — the block axis N replicates (every stage holds every block's
+    slice of ITS layers; the table is plain replicated data). KVQuant
+    pools mirror the spec per leaf like cache_spec does (scales
+    [L, N, KV, bs] drop the head_dim axis)."""
+    p5 = P(AXIS_PP, None, AXIS_TP, None, None)
+    if getattr(cfg, "kv_quant", None) is None:
+        return {"k": p5, "v": p5}
+    from ..ops.kv_quant import KVQuant
+
+    leaf = KVQuant(p5, P(AXIS_PP, None, AXIS_TP, None))
+    return {"k": leaf, "v": leaf}
+
+
+def init_sharded_pool(cfg: ModelConfig, mesh: Mesh, n_blocks: int,
+                      block_size: int):
+    """Zeroed paged-KV pool sharded per pool_spec(), allocated shard-local.
+    The layer axis matches the PADDED stacked layers (ceil(L/pp)*pp) for
+    uneven pp splits, exactly like init_sharded_cache."""
+    from ..engine import paged as EP
+
+    pp = int(mesh.shape[AXIS_PP])
+    n_layers = padded_layers_per_stage(cfg.n_layers, pp) * pp
+    spec_tree = pool_spec(cfg)
+
+    @jax.jit
+    def make():
+        pool = EP.init_pool(cfg, n_blocks, block_size, n_layers=n_layers)
+        return jax.tree.map(
+            lambda x, sp: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, sp)
+            ),
+            pool,
+            spec_tree,
+        )
+
+    return make()
+
+
 def params_already_placed(params: dict, mesh: Mesh) -> bool:
     """True when every leaf is a jax.Array already carrying a NamedSharding
     on (an equal copy of) `mesh` — i.e. the checkpoint was restored with

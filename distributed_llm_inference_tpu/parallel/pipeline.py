@@ -52,6 +52,13 @@ def _ring_perm(S: int):
     return [(j, (j + 1) % S) for j in range(S)]
 
 
+def _replicated_specs(nt_cls):
+    """Fully-replicated PartitionSpec tree for a NamedTuple class (slot
+    state/params enter every shard_map whole) — field-count-proof: adding
+    a field to the NamedTuple updates every spec site automatically."""
+    return nt_cls(*([P()] * len(nt_cls._fields)))
+
+
 class SPMDBackendBase:
     """Shared scaffolding for the SPMD mesh backends.
 
@@ -114,24 +121,41 @@ class SPMDBackendBase:
         (max_steps, ragged, presence, counts, bias, logprobs); builders
         that don't support a variant raise NotImplementedError at build
         time (loud, not silently wrong)."""
+        return self._decode_dispatch(
+            self._decode_cache, self._variant_builder, first_token, cache,
+            start_pos, limit, key, sampling, valid_start, presence, counts,
+            bias, max_steps=max_steps, with_logprobs=with_logprobs,
+        )
+
+    def _variant_builder(self, variant):
+        """variant (max_steps, ragged, pres, wc, wb, logprobs) -> compiled
+        program, through the subclass's _build_decode* hooks."""
+        max_steps, ragged, pres, wc, wb, with_logprobs = variant
+        if wb or with_logprobs or wc:
+            return self._build_decode_full(
+                max_steps, ragged=ragged, with_presence=pres,
+                with_counts=wc, with_bias=wb, with_logprobs=with_logprobs,
+            )
+        if ragged:
+            return self._build_decode_ragged(max_steps, with_presence=pres)
+        return self._build_decode(max_steps, with_presence=pres)
+
+    def _decode_dispatch(self, memo, builder, first_token, cache, start_pos,
+                         limit, key, sampling, valid_start, presence, counts,
+                         bias, *, max_steps, with_logprobs):
+        """The ONE copy of the variant->program->args contract (memo key,
+        builder selection, limit clamp, positional extra-arg order) —
+        shared by the base dispatch and the 1F1B backend's plain-ring
+        fallback, which passes its own memo + builder."""
         ragged = valid_start is not None
         pres = presence is not None
         wc = counts is not None
         wb = bias is not None
         variant = (max_steps, ragged, pres, wc, wb, with_logprobs)
-        fn = self._decode_cache.get(variant)
+        fn = memo.get(variant)
         if fn is None:
-            if wb or with_logprobs or wc:
-                fn = self._build_decode_full(
-                    max_steps, ragged=ragged, with_presence=pres,
-                    with_counts=wc, with_bias=wb,
-                    with_logprobs=with_logprobs,
-                )
-            elif ragged:
-                fn = self._build_decode_ragged(max_steps, with_presence=pres)
-            else:
-                fn = self._build_decode(max_steps, with_presence=pres)
-            self._decode_cache[variant] = fn
+            fn = builder(variant)
+            memo[variant] = fn
         # clamp: limit > max_steps would walk dynamic_update_slice off the
         # end of `out` (the start index clamps, corrupting the last column)
         # and inflate n_gen past the buffer
@@ -140,14 +164,11 @@ class SPMDBackendBase:
             self.shared, self.layers, first_token, cache, start_pos, limit,
             key, sampling,
         ]
-        if ragged:
-            args.append(valid_start)
-        if pres:
-            args.append(presence)
-        if wc:
-            args.append(counts)
-        if wb:
-            args.append(bias)
+        for flag, val in (
+            (ragged, valid_start), (pres, presence), (wc, counts), (wb, bias)
+        ):
+            if flag:
+                args.append(val)
         return fn(*args)
 
     def health(self) -> list[dict]:
@@ -167,11 +188,21 @@ class SPMDBackendBase:
         devs = self.mesh.devices  # [dp, pp, sp, tp]
         stage_devs = [devs[:, s].reshape(-1) for s in range(self.pp)]
         flat = [d for sd in stage_devs for d in sd]
+        # multi-process mesh: only THIS process's devices accept probe ops;
+        # other processes' devices report "remote" (their own controller
+        # probes them — a mirrored follower runs this same sweep locally)
+        me = jax.process_index()
+
+        def probe_local(d):
+            if d.process_index != me:
+                return {"status": "remote", "process": d.process_index}
+            return probe_device(d)
+
         with ThreadPoolExecutor(max_workers=max(1, len(flat))) as ex:
-            flat_probes = list(ex.map(probe_device, flat))
+            flat_probes = list(ex.map(probe_local, flat))
         out = []
         i = 0
-        rank = {"online": 0, "busy": 1, "error": 2, "offline": 3}
+        rank = {"online": 0, "remote": 1, "busy": 2, "error": 3, "offline": 4}
         for s in range(self.pp):
             probes = flat_probes[i : i + len(stage_devs[s])]
             i += len(stage_devs[s])
@@ -241,9 +272,13 @@ class PipelineBackend(SPMDBackendBase):
     supports_counts = True
 
     # -- compiled programs --------------------------------------------------
-    def _microstep_loop(self, layers, x, cache, pos, valid_start=None):
+    def _microstep_loop(self, layers, x, cache, pos, valid_start=None,
+                        attn_hook=None, attn_seq_len=None):
         """S microsteps of (apply local stage, ring-shift). Returns the
-        final-stage output (landed on stage 0 by the last shift) + cache."""
+        final-stage output (landed on stage 0 by the last shift) + cache.
+        attn_hook/attn_seq_len thread the paged-pool seam (cache = block
+        pool, hook = engine/paged.make_paged_hook) through the same gated
+        ring — one loop for the dense and paged cache strategies."""
         cfg, S = self.cfg, self.pp
         s = jax.lax.axis_index(AXIS_PP)
         perm = _ring_perm(S)
@@ -254,7 +289,8 @@ class PipelineBackend(SPMDBackendBase):
             y, cache = M.forward_layers(
                 cfg, layers, buf, cache, pos, update_gate=gate,
                 tp_axis=self.tp_axis, valid_start=valid_start,
-                ep_axis=self.ep_axis,
+                ep_axis=self.ep_axis, attn_hook=attn_hook,
+                attn_seq_len=attn_seq_len,
             )
             buf = jax.lax.ppermute(y, AXIS_PP, perm)
             return buf, cache
@@ -432,10 +468,10 @@ class PipelineBackend(SPMDBackendBase):
             )
             return emitted, emit_mask, state, cache
 
-        from ..engine.generate import SlotParams, SlotState as _SS
+        from ..engine.generate import SlotParams, SlotState
 
-        state_specs = _SS(P(), P(), P(), P(), P(), P())
-        sparam_specs = SlotParams(P(), P(), P(), P(), P(), P(), P(), P())
+        state_specs = _replicated_specs(SlotState)
+        sparam_specs = _replicated_specs(SlotParams)
         shmapped = self._shard(
             body,
             in_specs=(
@@ -443,6 +479,126 @@ class PipelineBackend(SPMDBackendBase):
                 cache_spec(self.cfg), P(), sparam_specs,
             ),
             out_specs=(P(), P(), state_specs, cache_spec(self.cfg)),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
+    # -- block-paged KV on the pp ring (round-3 review #2: the flagship
+    # memory feature on the reference's flagship topology) ------------------
+    @property
+    def supports_paged(self) -> bool:
+        """Paged slot decode on the pipeline mesh: same constraints as
+        dense slots (dp == 1 — slot rows are slots, not data shards) plus
+        the llama-family attn_hook seam the pool writes ride."""
+        return self.dp == 1 and self.cfg.arch == "llama"
+
+    def init_paged_pool(self, n_blocks, block_size):
+        from .partition import init_sharded_pool
+
+        return init_sharded_pool(self.cfg, self.mesh, n_blocks, block_size)
+
+    def insert_slot_paged(self, pool, scratch, state, sparams, slot,
+                          table_row, *args):
+        fn = self._programs.get("insert_paged")
+        if fn is None:
+            fn = self._build_insert_paged()
+            self._programs["insert_paged"] = fn
+        return fn(pool, scratch, state, sparams, jnp.int32(slot), table_row,
+                  *args)
+
+    def _build_insert_paged(self):
+        """shard_map twin of engine/paged.insert_slot_paged: the scratch →
+        pool block scatter is LAYER-LOCAL (each stage scatters its own
+        layer shard of the prefilled scratch into its pool slice), and
+        arm_slot runs replicated so every device derives identical slot
+        state."""
+        cfg = self.cfg
+        from ..engine import generate as G
+        from ..engine import paged as EP
+        from .partition import pool_spec
+
+        def body(pool, scratch, state, sparams, slot, table_row,
+                 first_token, prompt_len, max_tokens, temperature, top_k,
+                 top_p, greedy, min_p, rep_penalty, freq_penalty,
+                 pres_penalty, presence_row):
+            pool = EP.scatter_scratch(pool, scratch, table_row)
+            state, sparams = G.arm_slot(
+                cfg, state, sparams, slot, first_token, prompt_len,
+                max_tokens, temperature, top_k, top_p, greedy, min_p,
+                rep_penalty, freq_penalty, pres_penalty, presence_row,
+            )
+            return pool, state, sparams
+
+        from ..engine.generate import SlotParams, SlotState
+
+        state_specs = _replicated_specs(SlotState)
+        sparam_specs = _replicated_specs(SlotParams)
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                pool_spec(cfg), cache_spec(cfg), state_specs, sparam_specs,
+            ) + (P(),) * 14,
+            out_specs=(pool_spec(cfg), state_specs, sparam_specs),
+        )
+        return jax.jit(shmapped, donate_argnums=(0,))
+
+    def decode_slots_paged(self, state, pool, table, key, sparams, *,
+                           num_steps):
+        fn = self._programs.get(("slots_paged", num_steps))
+        if fn is None:
+            fn = self._build_decode_slots_paged(num_steps)
+            self._programs[("slots_paged", num_steps)] = fn
+        return fn(self.shared, self.layers, state, pool, table, key, sparams)
+
+    def _build_decode_slots_paged(self, num_steps: int):
+        """Paged twin of _build_decode_slots: each of the S ring
+        microsteps runs the local layer shard over the slot fleet with the
+        paged attn_hook (engine/paged.make_paged_hook); pool writes are
+        gated per microstep by redirecting ungated scatters to the trash
+        block. Shares slot_step, so cross-backend/cross-mode token parity
+        is structural."""
+        cfg, S = self.cfg, self.pp
+        from ..engine import paged as EP
+        from ..engine.generate import SlotParams, SlotState, slot_step
+        from .partition import pool_spec
+
+        def body(shared, layers, state, pool, table, key, sparams):
+            hook = EP.make_paged_hook(table)
+            bs = pool["k"].shape[3]
+            MB = table.shape[1]
+            s = jax.lax.axis_index(AXIS_PP)
+
+            def step(carry, sub):
+                state, pool = carry
+                x = embed_sharded(
+                    cfg, shared, state.token[:, None], state.pos, S
+                )
+                buf, pool = self._microstep_loop(
+                    layers, x, pool, state.pos, attn_hook=hook,
+                    attn_seq_len=MB * bs,
+                )
+                last = jax.lax.psum(
+                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
+                    AXIS_PP,
+                )
+                logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
+                new, emit, can_emit = slot_step(cfg, state, sparams, logits, sub)
+                return (new, pool), (emit, can_emit)
+
+            subs = jax.random.split(key, num_steps)
+            (state, pool), (emitted, emit_mask) = jax.lax.scan(
+                step, (state, pool), subs
+            )
+            return emitted, emit_mask, state, pool
+
+        state_specs = _replicated_specs(SlotState)
+        sparam_specs = _replicated_specs(SlotParams)
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, state_specs,
+                pool_spec(cfg), P(), P(), sparam_specs,
+            ),
+            out_specs=(P(), P(), state_specs, pool_spec(cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(3,))
 

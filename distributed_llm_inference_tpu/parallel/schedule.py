@@ -46,7 +46,7 @@ from ..config import ModelConfig
 from ..models import api as M
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP
-from .partition import cache_spec, init_sharded_cache
+from .partition import cache_spec
 from ..engine.generate import stop_mask
 from .pipeline import PipelineBackend, _ring_perm
 from .vocab import embed_sharded, unembed_sharded
@@ -309,38 +309,24 @@ class MicrobatchPipelineBackend(PipelineBackend):
                 first_token, cache, start_pos, limit, key, sampling,
                 valid_start=valid_start, max_steps=max_steps,
             )
-        ragged = valid_start is not None
-        pres, wc, wb = (
-            presence is not None, counts is not None, bias is not None
+        return self._decode_dispatch(
+            self._ring_variants, self._ring_builder, first_token, cache,
+            start_pos, limit, key, sampling, valid_start, presence, counts,
+            bias, max_steps=max_steps, with_logprobs=with_logprobs,
         )
-        variant = (max_steps, ragged, pres, wc, wb, with_logprobs)
-        fn = self._ring_variants.get(variant)
-        if fn is None:
-            if wb or with_logprobs or wc:
-                fn = self._build_decode_full(
-                    max_steps, ragged=ragged, with_presence=pres,
-                    with_counts=wc, with_bias=wb,
-                    with_logprobs=with_logprobs,
-                )
-            else:
-                fn = self._build_decode_any(
-                    max_steps, ragged=ragged, with_presence=pres
-                )
-            self._ring_variants[variant] = fn
-        limit = jnp.minimum(jnp.int32(limit), jnp.int32(max_steps))
-        args = [
-            self.shared, self.layers, first_token, cache, start_pos, limit,
-            key, sampling,
-        ]
-        if ragged:
-            args.append(valid_start)
-        if pres:
-            args.append(presence)
-        if wc:
-            args.append(counts)
-        if wb:
-            args.append(bias)
-        return fn(*args)
+
+    def _ring_builder(self, variant):
+        """Plain-ring programs for the non-fleet dispatch — bypasses this
+        class's 1F1B _build_decode/_build_decode_ragged overrides."""
+        max_steps, ragged, pres, wc, wb, with_logprobs = variant
+        if wb or with_logprobs or wc:
+            return self._build_decode_full(
+                max_steps, ragged=ragged, with_presence=pres,
+                with_counts=wc, with_bias=wb, with_logprobs=with_logprobs,
+            )
+        return self._build_decode_any(
+            max_steps, ragged=ragged, with_presence=pres
+        )
 
     def _build_decode(self, max_steps: int, with_presence: bool = False):
         if with_presence:

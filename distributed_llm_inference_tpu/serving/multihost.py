@@ -1,0 +1,135 @@
+"""Multi-process serving: one HTTP front door, mirrored SPMD followers.
+
+The reference's deployment shape is N separate serving machines — an
+orchestrator Flask plus a hand-started Flask per worker, wired by pasted
+ngrok URLs (/root/reference/Worker1.py:248-266, orchestration.py:22-24).
+Under multi-controller JAX the equivalent is: every process runs the SAME
+engine build (each restoring only its own stage's weights off mmap), and
+every compiled program must be launched by every process in the same
+order. So serving becomes a mirroring problem, not an RPC problem:
+
+  * process 0 serves HTTP. Before running any engine method that launches
+    device programs, it broadcasts the (method, args, kwargs) triple to
+    all processes — one fixed-size uint8 collective.
+  * processes > 0 run `follower_loop`: receive a triple, invoke the same
+    engine method with the same arguments, discard the result, repeat.
+    Determinism of the engine surface (tokenizer, bucket planning, key
+    derivation from the request seed / per-process counter) guarantees
+    both sides issue byte-identical program sequences.
+  * a single issue-lock around (broadcast, engine call) on the leader
+    pins the collective launch order: no second request can interleave
+    its broadcast between another request's broadcast and compute.
+
+Scope: the bare engine surface (generate / generate_batch / score).
+`--continuous` and `--queue` are admission layers whose batching depends
+on request ARRIVAL TIMING — inherently different per process — and are
+rejected at startup for multi-process serving.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import jax
+import numpy as np
+
+# Fixed wire size: the payload collective must have the same shape on
+# every process, request content is length-prefixed inside it. 64 KiB
+# covers any request the HTTP edge accepts (prompts are bounded by the
+# prefill buckets long before this).
+_WIRE_BYTES = 64 * 1024
+
+# Engine methods that launch device programs and therefore must be
+# mirrored on every process. Everything else (health, stats, tokenizer
+# helpers) is host/local-device work the leader answers alone.
+MIRRORED_METHODS = ("generate", "generate_batch", "score")
+
+_SHUTDOWN = {"m": "__shutdown__"}
+
+
+def _broadcast_obj(obj, is_source: bool):
+    """Broadcast a JSON-serializable obj from process 0 to all processes.
+
+    One collective of fixed [4 + _WIRE_BYTES] uint8 (4-byte big-endian
+    length prefix). Every process must call this the same number of times
+    in the same order — the leader's issue-lock guarantees it.
+    """
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(4 + _WIRE_BYTES, np.uint8)
+    if is_source:
+        payload = json.dumps(obj).encode()
+        if len(payload) > _WIRE_BYTES:
+            raise ValueError(
+                f"mirrored request of {len(payload)} bytes exceeds the "
+                f"{_WIRE_BYTES}-byte wire buffer"
+            )
+        buf[:4] = np.frombuffer(
+            len(payload).to_bytes(4, "big"), np.uint8
+        )
+        buf[4 : 4 + len(payload)] = np.frombuffer(payload, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    n = int.from_bytes(out[:4].tobytes(), "big")
+    return json.loads(out[4 : 4 + n].tobytes().decode())
+
+
+class MirroredEngine:
+    """Leader-side proxy: broadcast-then-run for the mirrored methods,
+    transparent passthrough for everything else (health, stats, cfg,
+    tokenizer, backend — all host-local)."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        # ONE lock across (broadcast, engine call): the follower issues
+        # [bcast_i, programs_i, bcast_i+1, ...] strictly in order, so the
+        # leader must too — a second thread slipping its broadcast between
+        # another request's broadcast and compute would desynchronize the
+        # collective stream and wedge every process.
+        self._issue_lock = threading.Lock()
+
+    def _mirrored(self, method, args, kwargs):
+        with self._issue_lock:
+            _broadcast_obj(
+                {"m": method, "a": list(args), "kw": kwargs}, is_source=True
+            )
+            return getattr(self._engine, method)(*args, **kwargs)
+
+    def generate(self, *args, **kwargs):
+        return self._mirrored("generate", args, kwargs)
+
+    def generate_batch(self, *args, **kwargs):
+        return self._mirrored("generate_batch", args, kwargs)
+
+    def score(self, *args, **kwargs):
+        return self._mirrored("score", args, kwargs)
+
+    def shutdown_followers(self):
+        """Release the follower loops (idempotent best-effort: call once,
+        right before the leader exits)."""
+        with self._issue_lock:
+            _broadcast_obj(_SHUTDOWN, is_source=True)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def follower_loop(engine, process_id: int):
+    """Processes > 0: mirror every leader request until shutdown.
+
+    Results are discarded — the POINT is the device program launches,
+    which the SPMD mesh needs from every process. Errors that the engine
+    surfaces as error envelopes (validation, deadline) return normally on
+    both sides; anything raised here is fatal by design (a diverged
+    follower cannot safely keep answering collectives).
+    """
+    while True:
+        msg = _broadcast_obj(None, is_source=False)
+        if msg["m"] == _SHUTDOWN["m"]:
+            return
+        if msg["m"] not in MIRRORED_METHODS:
+            raise RuntimeError(
+                f"follower {process_id} received unknown mirrored method "
+                f"{msg['m']!r}"
+            )
+        getattr(engine, msg["m"])(*msg["a"], **msg["kw"])

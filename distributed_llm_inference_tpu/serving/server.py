@@ -774,7 +774,8 @@ def main(argv: Optional[list] = None):
     )
     ap.add_argument(
         "--kv-pool-blocks", type=int, default=None, metavar="N",
-        help="block-paged KV for --continuous (llama family, single chip): "
+        help="block-paged KV for --continuous (llama family, single chip "
+             "or a dp=1 pp/tp mesh — the pool shards layers over pp): "
              "a shared pool of N blocks replaces the dense SLOTS x max-seq "
              "fleet — HBM is a function of aggregate in-flight tokens and "
              "admission backpressures on pool exhaustion (engine/paged.py)",
@@ -835,6 +836,17 @@ def main(argv: Optional[list] = None):
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id,
+        )
+    import jax as _jax
+
+    if _jax.process_count() > 1 and (args.continuous > 0 or args.queue > 0):
+        # checked BEFORE the checkpoint load + warmup (the expensive
+        # steps): batching by request ARRIVAL TIMING cannot mirror
+        # deterministically across processes
+        raise SystemExit(
+            "--continuous/--queue batch by request ARRIVAL TIMING, "
+            "which cannot mirror deterministically across processes; "
+            "multi-process serving drives the bare engine"
         )
     mesh_cfg = MeshConfig(
         dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp, ep=args.ep
@@ -909,6 +921,23 @@ def main(argv: Optional[list] = None):
                 f"--warmup"
             ) from e
         print(f"✅ warm: {stats['programs']} programs in {stats['seconds']}s")
+    if _jax.process_count() > 1:
+        # multi-process SPMD serving (the reference's N-machine shape,
+        # Worker1.py:248-266): every process built the same engine above
+        # (warmup included — identical program sequence; --continuous/
+        # --queue were rejected before the model load); process 0 now
+        # serves HTTP and broadcasts each request so followers mirror the
+        # device program launches (serving/multihost.py).
+        from .multihost import MirroredEngine, follower_loop
+
+        if _jax.process_index() != 0:
+            print(
+                f"🛰  follower {_jax.process_index()}/{_jax.process_count()}"
+                f" mirroring leader requests"
+            )
+            follower_loop(engine, _jax.process_index())
+            return
+        engine = MirroredEngine(engine)
     queue = None
     continuous = None
     if args.continuous > 0 and args.queue > 0:
@@ -942,10 +971,17 @@ def main(argv: Optional[list] = None):
             engine, max_queue=args.queue, max_batch=args.queue_max_batch,
             max_wait_ms=args.queue_wait_ms,
         )
-    InferenceServer(
-        engine, args.host, args.port, args.max_tokens_cap, queue=queue,
-        continuous=continuous,
-    ).serve_forever()
+    try:
+        InferenceServer(
+            engine, args.host, args.port, args.max_tokens_cap, queue=queue,
+            continuous=continuous,
+        ).serve_forever()
+    finally:
+        if hasattr(engine, "shutdown_followers"):
+            # release the follower loops (blocked in the broadcast
+            # collective) so a leader shutdown doesn't strand N-1 hung
+            # processes until the distributed heartbeat reaps them
+            engine.shutdown_followers()
 
 
 if __name__ == "__main__":
