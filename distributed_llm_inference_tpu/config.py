@@ -197,11 +197,6 @@ class ModelConfig:
                 "kv_quant is wired for the llama family (the hook seam in "
                 "models/llama.default_attn_hook); gpt2 keeps a raw cache"
             )
-        if self.kv_quant is not None and self.attn_impl == "pallas":
-            raise ValueError(
-                "kv_quant and attn_impl='pallas' do not compose: the flash "
-                "kernels read raw-dtype cache slabs; use attn_impl='xla'"
-            )
         if self.rope_scaling not in (None, "llama3", "linear"):
             raise ValueError(
                 f"rope_scaling must be None, 'llama3', or 'linear', got "

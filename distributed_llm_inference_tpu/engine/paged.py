@@ -167,8 +167,10 @@ def make_paged_hook(table: jnp.ndarray):
         off = pos % bs
         if isinstance(cache_k, KVQuant):
             # int8 pool: quantize the token's K/V, scatter data + scale
-            # into the slot's block. (attn_impl="pallas" cannot reach
-            # this leaf type — config rejects kv_quant + pallas.)
+            # into the slot's block. The T=1 attention below always takes
+            # the gather path — the fused paged kernel reads raw-dtype
+            # blocks only (the flash PREFILL kernel dequantizes int8 in
+            # its prologue, but table-walk + dequant is future work).
             qk, sk = quantize_chunk(k)
             qv, sv = quantize_chunk(v)
             new_k = KVQuant(

@@ -180,10 +180,19 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
         upd = kv_update_slots if pos.ndim == 1 else kv_update
         new_k = upd(cache_k, k, pos, gate=update_gate)
         new_v = upd(cache_v, v, pos, gate=update_gate)
-        attn = attend(
-            q, kv_dequantize(new_k), kv_dequantize(new_v), mask,
-            scale=cfg.query_scale, softcap=cfg.attn_softcap,
-        )
+        if cfg.attn_impl == "pallas" and pos.ndim == 0 and q.shape[1] > 1:
+            # same T>1-chunks-only gate as the raw-dtype path below; the
+            # kernel dequantizes in its tile prologue, so the int8 cache
+            # streams HALF the bytes the XLA dequant-then-attend path
+            # materializes
+            attn = flash_attend(
+                q, new_k, new_v, pos, valid_start, window=cfg.attn_window
+            )
+        else:
+            attn = attend(
+                q, kv_dequantize(new_k), kv_dequantize(new_v), mask,
+                scale=cfg.query_scale, softcap=cfg.attn_softcap,
+            )
         return attn, new_k, new_v
     if pos.ndim == 1:
         new_k, new_v = update_kv_cache_slots(

@@ -70,11 +70,7 @@ def _flash_kernel(
     q_ref,  # [1, block_t, 1, group, Dh] VMEM
     k_ref,  # [1, 1, block_k, Dh] VMEM
     v_ref,  # [1, 1, block_k, Dh] VMEM
-    o_ref,  # [1, block_t, 1, group, Dh] VMEM
-    m_ref,  # scratch [rows, 1] fp32
-    l_ref,  # scratch [rows, 1] fp32
-    acc_ref,  # scratch [rows, Dh] fp32
-    *,
+    *rest,  # quant: (ks_ref, vs_scale_ref, o_ref, scratch...) else (o_ref, ...)
     T: int,
     S: int,
     block_t: int,
@@ -82,7 +78,17 @@ def _flash_kernel(
     group: int,
     scale: float,
     window: int | None,
+    quant: bool = False,
 ):
+    if quant:
+        # int8 cache (ops/kv_quant): per-(token, head) fp32 scales ride
+        # as two extra [1, 1, block_k] operands; dequant happens in the
+        # tile prologue below — the kernel streams HALF the cache bytes
+        # from HBM and the MXU still sees fp32 tiles.
+        ks_ref, vscale_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vscale_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     pos = pos_ref[0]
     valid_from = vs_ref[pl.program_id(0)]
     qi = pl.program_id(2)
@@ -111,6 +117,8 @@ def _flash_kernel(
         col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 1)
 
         ks = k_ref[0, 0].astype(jnp.float32)  # [block_k, Dh]
+        if quant:
+            ks = ks * ks_ref[0, 0][:, None]  # dequant prologue
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [rows, block_k]
@@ -128,6 +136,8 @@ def _flash_kernel(
         m_ref[:] = m_new
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         vs = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            vs = vs * vscale_ref[0, 0][:, None]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -144,8 +154,8 @@ def _flash_kernel(
 )
 def flash_attend(
     q: jnp.ndarray,
-    cache_k: jnp.ndarray,
-    cache_v: jnp.ndarray,
+    cache_k,
+    cache_v,
     pos: jnp.ndarray,
     valid_start: jnp.ndarray | None = None,
     *,
@@ -156,7 +166,10 @@ def flash_attend(
 ) -> jnp.ndarray:
     """Causal GQA flash attention over the (already updated) cache.
 
-    q [B,T,H,Dh], cache_k/v [B,KV,S,Dh], pos scalar int32 (chunk offset).
+    q [B,T,H,Dh], cache_k/v [B,KV,S,Dh] — or ops/kv_quant.KVQuant leaves
+    (int8 data + per-(token, head) fp32 scales [B,KV,S]), dequantized in
+    the kernel's tile prologue so the int8 cache streams half the HBM
+    bytes. pos scalar int32 (chunk offset).
     valid_start: optional [B] int32 — first real slot per row (ragged
     LEFT-padded batches; earlier slots are never attended). window:
     sliding-window attention width (None = full causal). Returns
@@ -164,6 +177,12 @@ def flash_attend(
     mask derived from `pos` (and `valid_start`/`window`) instead of
     passed in.
     """
+    from .kv_quant import KVQuant
+
+    quant = isinstance(cache_k, KVQuant)
+    if quant:
+        cache_k, k_scale = cache_k.q, cache_k.s
+        cache_v, v_scale = cache_v.q, cache_v.s
     B, T, H, Dh = q.shape
     KV, S = cache_k.shape[1], cache_k.shape[2]
     group = H // KV
@@ -198,6 +217,11 @@ def flash_attend(
         )
         return (b, kv, jnp.clip(j, first, needed - 1), 0)
 
+    def kv_index_3(b, kv, qi, j, pos_ref, vs_ref):
+        # the quant-scale operands [B, KV, S]: same clamped tile walk,
+        # one rank down
+        return kv_index(b, kv, qi, j, pos_ref, vs_ref)[:3]
+
     kernel = functools.partial(
         _flash_kernel,
         T=T,
@@ -207,19 +231,30 @@ def flash_attend(
         group=group,
         scale=Dh**-0.5,
         window=window,
+        quant=quant,
     )
     rows = block_t * group
+    in_specs = [
+        pl.BlockSpec(
+            (1, block_t, 1, group, Dh),
+            lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+        pl.BlockSpec((1, 1, block_k, Dh), kv_index),
+    ]
+    operands = [q5, cache_k, cache_v]
+    if quant:
+        # scale rows [B, KV, S] tile with the SAME clamped kv index map,
+        # one [block_k] strip per tile
+        in_specs += [
+            pl.BlockSpec((1, 1, block_k), kv_index_3),
+            pl.BlockSpec((1, 1, block_k), kv_index_3),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, pl.cdiv(T, block_t), pl.cdiv(S, block_k)),
-        in_specs=[
-            pl.BlockSpec(
-                (1, block_t, 1, group, Dh),
-                lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
-            ),
-            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
-            pl.BlockSpec((1, 1, block_k, Dh), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, block_t, 1, group, Dh),
             lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
@@ -235,5 +270,5 @@ def flash_attend(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, T, KV, group, Dh), q.dtype),
         interpret=interpret,
-    )(pos_arr, valid_start, q5, cache_k, cache_v)
+    )(pos_arr, valid_start, *operands)
     return out.reshape(B, T, H, Dh)

@@ -25,10 +25,12 @@ everything else — scan-over-layers, donation, while_loop carries —
 treats the cache as an opaque pytree.
 
 Scope: llama-family (single device, the slot fleet — dense OR block-
-paged pool — and pp/tp/dp pipeline meshes; the prefix snapshot store
-composes too, its slices carry the scale leaves). The Pallas flash
-kernels read raw-dtype caches and reject the combination loudly at
-config level. The reference has no KV cache at all
+paged pool — and pp/tp/dp/1F1B pipeline meshes; the prefix snapshot
+store composes too, its slices carry the scale leaves). The Pallas
+flash PREFILL kernel dequantizes int8 tiles in its prologue
+(ops/flash_attention.py — half the cache HBM bytes on the quadratic
+phase); only sp (ring attention) and the fused paged/fleet DECODE
+kernels still read raw dtypes. The reference has no KV cache at all
 (/root/reference/Worker1.py:132-134); this is north-star serving scope.
 """
 

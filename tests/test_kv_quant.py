@@ -147,8 +147,9 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
         cfg.replace(kv_quant="fp8")
     with pytest.raises(ValueError, match="llama"):
         get_model_config("test-gpt2-tiny").replace(kv_quant="int8")
-    with pytest.raises(ValueError, match="pallas"):
-        cfg.replace(kv_quant="int8", attn_impl="pallas")
+    # kv_quant + pallas COMPOSES now (the flash kernel dequantizes int8
+    # in its tile prologue) — the replace must succeed
+    assert cfg.replace(kv_quant="int8", attn_impl="pallas").attn_impl == "pallas"
     from distributed_llm_inference_tpu.runtime import create_backend
     from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
 
@@ -289,3 +290,53 @@ def test_pp_continuous_fleet_with_kv_quant(raw_engine, q_engine,
     for w, g in zip(dense_q_fleet_text, got):
         assert g["status"] == "success"
         assert g["response"] == w
+
+
+def test_flash_kernel_dequantizes_int8_cache():
+    """Kernel-level (round-3 review #5a): flash_attend over KVQuant leaves
+    == attend over the dequantized cache — the dequant happens in the
+    kernel's tile prologue, bit-comparable to the XLA dequant path at
+    fp32 tolerance."""
+    from distributed_llm_inference_tpu.ops.attention import attend
+    from distributed_llm_inference_tpu.ops.flash_attention import flash_attend
+    from distributed_llm_inference_tpu.ops.kv_quant import (
+        dequantize, quantize_chunk, KVQuant,
+    )
+    from distributed_llm_inference_tpu.ops.attention import causal_mask
+
+    B, T, H, KV, Dh, S, pos = 2, 5, 4, 2, 16, 32, 7
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, T, H, Dh), jnp.float32)
+    raw_k = jax.random.normal(ks[1], (B, KV, S, Dh), jnp.float32)
+    raw_v = jax.random.normal(ks[2], (B, KV, S, Dh), jnp.float32)
+    qk, sk = quantize_chunk(raw_k.transpose(0, 2, 1, 3))
+    qv, sv = quantize_chunk(raw_v.transpose(0, 2, 1, 3))
+    ck = KVQuant(qk.transpose(0, 2, 1, 3), sk.transpose(0, 2, 1))
+    cv = KVQuant(qv.transpose(0, 2, 1, 3), sv.transpose(0, 2, 1))
+    got = flash_attend(q, ck, cv, jnp.int32(pos), interpret=True)
+    mask = causal_mask(jnp.int32(pos), T, S)
+    want = attend(q, dequantize(ck), dequantize(cv), mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.slow
+def test_pallas_prefill_with_kv_quant_token_parity(raw_engine):
+    """Engine-level: attn_impl='pallas' + kv_quant='int8' serves the SAME
+    greedy tokens as the XLA int8 path (the T>1 prefill chunks run the
+    dequantizing flash kernel; T=1 decode keeps the XLA einsum)."""
+    base = raw_engine.cfg.replace(kv_quant="int8")
+    eng_x = InferenceEngine(
+        base, params=raw_engine.backend.params,
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+    )
+    eng_p = InferenceEngine(
+        base.replace(attn_impl="pallas"), params=raw_engine.backend.params,
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+    )
+    for prompt in PROMPTS[:2]:
+        w = eng_x.generate(prompt, greedy=True, chat=False, max_tokens=8)
+        g = eng_p.generate(prompt, greedy=True, chat=False, max_tokens=8)
+        assert w["status"] == g["status"] == "success"
+        assert g["response"] == w["response"]
