@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Real-weights serving artifact: HF checkpoint -> convert -> serve -> measure.
+
+Round-3 review #4 asked for a real-model-scale proof of the serving path:
+a checkpoint that exists as FILES in the HF format, converted by the
+conversion CLI, mmap-shard-loaded by the server CLI, served over HTTP,
+and measured end to end (warm TTFT + decode tok/s) — BASELINE config 3's
+shape. This environment has zero network egress, so weight VALUES are
+random-initialized; everything else — architecture, file format, the
+convert -> store -> sharded-restore -> serve pipeline, and the
+measurement — is the real path, and decode throughput is weight-value
+independent. The artifact records that provenance explicitly.
+
+Scales:
+  test — CI-sized (64-dim, 3 layers): seconds, exercises every step.
+  1b   — the REAL TinyLlama-1.1B architecture (vocab 32000, hidden 2048,
+         inter 5632, 22 layers, 32 heads / 4 kv): the reference's model.
+  7b   — the REAL Llama-2-7B architecture (vocab 32000, hidden 4096,
+         inter 11008, 32 layers, 32 heads): BASELINE config 3's class.
+         Feasible on TPU; on CPU expect minutes per request.
+
+Usage: python benchmarks/real_weights_serve.py --scale 1b --pp 2 \
+           [--quant int8] [--dtype bfloat16] [--out ARTIFACT.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCALES = {
+    # (vocab, hidden, inter, layers, heads, kv_heads)
+    "test": (256, 64, 128, 3, 4, 2),
+    "1b": (32000, 2048, 5632, 22, 32, 4),
+    "7b": (32000, 4096, 11008, 32, 32, 32),
+}
+
+
+def build_hf_dir(scale: str, dst: str) -> int:
+    """Random-init an HF LlamaForCausalLM of the given architecture and
+    save_pretrained it (safetensors). Returns the parameter count."""
+    import torch
+    import transformers
+
+    vocab, hidden, inter, layers, heads, kv = SCALES[scale]
+    cfg = transformers.LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_hidden_layers=layers,
+        num_attention_heads=heads,
+        num_key_value_heads=kv,
+        max_position_embeddings=2048,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    n_params = sum(p.numel() for p in model.parameters())
+    model.save_pretrained(dst, safe_serialization=True)
+    del model
+    return n_params
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def post(port, payload, timeout=3600):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", choices=sorted(SCALES), default="1b")
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--quant", default=None, choices=[None, "int8", "int4"])
+    ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
+    ap.add_argument("--max-tokens", type=int, default=16)
+    ap.add_argument("--work", default=None, help="scratch dir (default: mkdtemp)")
+    ap.add_argument("--out", default=None, help="artifact JSON path")
+    ap.add_argument("--keep", action="store_true", help="keep the work dir")
+    args = ap.parse_args(argv)
+
+    work = args.work or tempfile.mkdtemp(prefix=f"realweights_{args.scale}_")
+    os.makedirs(work, exist_ok=True)
+    hf_dir = os.path.join(work, "hf")
+    store = os.path.join(work, "store")
+    art: dict = {
+        "artifact": "real_weights_serve",
+        "scale": args.scale,
+        "architecture": dict(
+            zip(("vocab", "hidden", "inter", "layers", "heads", "kv_heads"),
+                SCALES[args.scale])
+        ),
+        "pp": args.pp,
+        "quant": args.quant,
+        "provenance": (
+            "HF-format LlamaForCausalLM checkpoint, RANDOM-initialized "
+            "(zero-egress environment: no downloaded weights exist here); "
+            "architecture matches the named model class exactly, and the "
+            "convert -> store -> mmap-sharded-load -> HTTP-serve pipeline "
+            "is the real-weights path bit for bit. Decode throughput is "
+            "weight-value independent."
+        ),
+    }
+
+    t0 = time.time()
+    if not os.path.exists(os.path.join(hf_dir, "config.json")):
+        print(f"⏳ building HF {args.scale} checkpoint in {hf_dir}")
+        art["n_params"] = build_hf_dir(args.scale, hf_dir)
+    art["hf_build_s"] = round(time.time() - t0, 1)
+
+    t0 = time.time()
+    if not os.path.exists(os.path.join(store, "config.json")):
+        print("⏳ converting with models/convert.py")
+        conv = [
+            sys.executable, "-m", "distributed_llm_inference_tpu.models.convert",
+            "--in", hf_dir, "--out", store,
+        ]
+        if args.dtype:
+            conv += ["--dtype", args.dtype]
+        subprocess.run(conv, check=True, cwd=REPO)
+    art["convert_s"] = round(time.time() - t0, 1)
+    art["store_bytes"] = sum(
+        os.path.getsize(os.path.join(store, f)) for f in os.listdir(store)
+    )
+
+    port = free_port()
+    cmd = [
+        sys.executable, "-m", "distributed_llm_inference_tpu.serving.server",
+        "--checkpoint", store, "--host", "127.0.0.1", "--port", str(port),
+        "--pp", str(args.pp),
+    ]
+    if args.quant:
+        cmd += ["--quant", args.quant]
+    print("⏳ serving:", " ".join(cmd))
+    t_start = time.time()
+    # log FILE, not a pipe: an undrained pipe filling with XLA/server logs
+    # would block the child before /health ever answers
+    srv_log = os.path.join(work, "server.log")
+    log_f = open(srv_log, "w", encoding="utf-8")
+    srv = subprocess.Popen(
+        cmd, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 900
+        while True:
+            if srv.poll() is not None or time.time() > deadline:
+                log_f.flush()
+                with open(srv_log, encoding="utf-8") as f:
+                    out = f.read()
+                why = "died" if srv.poll() is not None else "never came up"
+                raise SystemExit(f"server {why}:\n{out[-3000:]}")
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2
+                ) as r:
+                    h = json.loads(r.read())
+                    if h["status"] in ("healthy", "degraded"):
+                        break
+            except (OSError, ValueError):
+                pass
+            time.sleep(2)
+        art["startup_s"] = round(time.time() - t_start, 1)
+        art["backend"] = h.get("backend")
+
+        prompt = "The quick brown fox jumps over the lazy dog. " * 4
+        kw = dict(prompt=prompt, max_tokens=args.max_tokens, greedy=True,
+                  chat=False)
+        cold = post(port, kw)
+        if cold.get("status") != "success":
+            raise SystemExit(f"cold request failed: {cold}")
+        art["cold_ttft_s"] = cold.get("ttft_s")
+        warm = post(port, kw)
+        art["warm_ttft_s"] = warm.get("ttft_s")
+        art["warm_tokens_per_sec"] = float(warm.get("tokens_per_sec", 0.0))
+        art["tokens_generated"] = warm.get("tokens_generated")
+        art["prompt_tokens"] = warm.get("prompt_tokens")
+        # the SERVER's platform is what matters; read it off /workers
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/workers", timeout=60
+        ) as r:
+            workers = json.loads(r.read())
+        art["stages"] = {
+            k: v for k, v in workers.items() if k != "detail"
+        }
+        art["devices"] = [
+            d for s in workers.get("detail", []) for d in s.get("devices", [])
+        ]
+    finally:
+        srv.kill()
+        try:
+            srv.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        log_f.close()
+        if not args.keep and not args.work:
+            import shutil
+
+            shutil.rmtree(work, ignore_errors=True)
+
+    line = json.dumps(art)
+    print(line)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

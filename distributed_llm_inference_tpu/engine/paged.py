@@ -167,10 +167,7 @@ def make_paged_hook(table: jnp.ndarray):
         off = pos % bs
         if isinstance(cache_k, KVQuant):
             # int8 pool: quantize the token's K/V, scatter data + scale
-            # into the slot's block. The T=1 attention below always takes
-            # the gather path — the fused paged kernel reads raw-dtype
-            # blocks only (the flash PREFILL kernel dequantizes int8 in
-            # its prologue, but table-walk + dequant is future work).
+            # into the slot's block
             qk, sk = quantize_chunk(k)
             qv, sv = quantize_chunk(v)
             new_k = KVQuant(
@@ -184,21 +181,22 @@ def make_paged_hook(table: jnp.ndarray):
         else:
             new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
             new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
-            if cfg.attn_impl == "pallas":
-                # Fused Pallas paged attention (ops/paged_attention.py):
-                # walks the table block by block with an online softmax —
-                # no contiguous-view materialization, dead blocks never
-                # leave HBM. Legality (no softcap, no scale override,
-                # uniform-or-no window) is already enforced by
-                # ModelConfig.__post_init__, which is also why deriving
-                # the mask from pos + attn_window in-kernel is exact (the
-                # hook's `mask` carries nothing more).
-                from ..ops.paged_attention import paged_flash_attend
+        if cfg.attn_impl == "pallas":
+            # Fused Pallas paged attention (ops/paged_attention.py) for
+            # BOTH leaf types: walks the table block by block with an
+            # online softmax — no contiguous-view materialization, dead
+            # blocks never leave HBM; int8 pools dequantize in the block
+            # prologue (half the bytes per live block). Legality (no
+            # softcap, no scale override, uniform-or-no window) is
+            # already enforced by ModelConfig.__post_init__, which is
+            # also why deriving the mask from pos + attn_window in-kernel
+            # is exact (the hook's `mask` carries nothing more).
+            from ..ops.paged_attention import paged_flash_attend
 
-                attn = paged_flash_attend(
-                    q, new_k, new_v, table, pos, window=cfg.attn_window
-                )
-                return attn, new_k, new_v
+            attn = paged_flash_attend(
+                q, new_k, new_v, table, pos, window=cfg.attn_window
+            )
+            return attn, new_k, new_v
 
         # Gather the whole table -> ONE contiguous per-slot view recipe
         # for both leaf types (int8 slabs dequantize through the dense

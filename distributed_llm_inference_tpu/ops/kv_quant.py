@@ -27,11 +27,13 @@ treats the cache as an opaque pytree.
 Scope: llama-family (single device, the slot fleet — dense OR block-
 paged pool — and pp/tp/dp/1F1B pipeline meshes; the prefix snapshot
 store composes too, its slices carry the scale leaves). The Pallas
-flash PREFILL kernel dequantizes int8 tiles in its prologue
-(ops/flash_attention.py — half the cache HBM bytes on the quadratic
-phase); only sp (ring attention) and the fused paged/fleet DECODE
-kernels still read raw dtypes. The reference has no KV cache at all
-(/root/reference/Worker1.py:132-134); this is north-star serving scope.
+flash PREFILL kernel and the fused paged DECODE kernel both dequantize
+int8 tiles/blocks in their prologues (ops/flash_attention.py,
+ops/paged_attention.py — half the cache HBM bytes); only sp (ring
+attention) and the dense fleet kernel (flash_attend_slots, which the
+hook never selects anyway) still read raw dtypes. The reference has no
+KV cache at all (/root/reference/Worker1.py:132-134); this is
+north-star serving scope.
 """
 
 from __future__ import annotations

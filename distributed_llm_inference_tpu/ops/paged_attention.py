@@ -67,18 +67,24 @@ def _paged_kernel(
     q_ref,  # [1, 1, 1, group, Dh] VMEM
     k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block)
     v_ref,  # [1, 1, bs, Dh] VMEM
-    o_ref,  # [1, 1, 1, group, Dh] VMEM
-    m_ref,  # scratch [group, 1] fp32
-    l_ref,  # scratch [group, 1] fp32
-    acc_ref,  # scratch [group, Dh] fp32
-    *,
+    *rest,  # quant: (ks_ref, vscale_ref, o_ref, scratch...) else (o_ref, ...)
     bs: int,
     MB: int,
     group: int,
     scale: float,
     window: int | None,
+    quant: bool = False,
 ):
     del table_ref  # physical placement is the index maps' concern
+    if quant:
+        # int8 pool (ops/kv_quant): per-(token, head) fp32 scales ride as
+        # two extra [1, 1, bs] operands walking the same table; dequant in
+        # the block prologue — the table walk streams the int8 bytes, the
+        # MXU sees fp32
+        ks_ref, vscale_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vscale_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
@@ -97,6 +103,9 @@ def _paged_kernel(
         q = q_ref[0, 0, 0].astype(jnp.float32) * scale  # [group, Dh]
         ks = k_ref[0, 0].astype(jnp.float32)  # [bs, Dh]
         vs = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            ks = ks * ks_ref[0, 0][:, None]
+            vs = vs * vscale_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [group, bs]
@@ -126,8 +135,8 @@ def _paged_kernel(
 @functools.partial(jax.jit, static_argnames=("interpret", "window"))
 def paged_flash_attend(
     q: jnp.ndarray,
-    pool_k: jnp.ndarray,
-    pool_v: jnp.ndarray,
+    pool_k,
+    pool_v,
     table: jnp.ndarray,
     pos: jnp.ndarray,
     *,
@@ -136,11 +145,20 @@ def paged_flash_attend(
 ) -> jnp.ndarray:
     """Paged GQA decode attention over the (already updated) block pool.
 
-    q [B,1,H,Dh]; pool_k/v [N,KV,bs,Dh] (one layer's pool slice); table
-    [B,MB] int32 physical block ids; pos [B] int32 per-row positions.
+    q [B,1,H,Dh]; pool_k/v [N,KV,bs,Dh] (one layer's pool slice) — or
+    ops/kv_quant.KVQuant leaves (int8 blocks + per-(token, head) fp32
+    scales [N,KV,bs]), dequantized in the block prologue so the table
+    walk streams HALF the bytes per live block; table [B,MB] int32
+    physical block ids; pos [B] int32 per-row positions.
     Returns [B,1,H,Dh] in q.dtype — same contract as the gather path in
     engine/paged.make_paged_hook with the mask derived from pos/window.
     """
+    from .kv_quant import KVQuant
+
+    quant = isinstance(pool_k, KVQuant)
+    if quant:
+        pool_k, k_scale = pool_k.q, pool_k.s
+        pool_v, v_scale = pool_v.q, pool_v.s
     B, T, H, Dh = q.shape
     assert T == 1, "paged kernel serves decode steps (T=1) only"
     KV, bs = pool_k.shape[1], pool_k.shape[2]
@@ -162,6 +180,11 @@ def paged_flash_attend(
         first, needed = _live_range(pos_ref[b], bs=bs, MB=MB, window=window)
         return (table_ref[b, jnp.clip(j, first, needed - 1)], kv, 0, 0)
 
+    def kv_index_3(b, kv, j, table_ref, pos_ref):
+        # the quant-scale operands [N, KV, bs]: same table walk, one rank
+        # down
+        return kv_index(b, kv, j, table_ref, pos_ref)[:3]
+
     kernel = functools.partial(
         _paged_kernel,
         bs=bs,
@@ -169,18 +192,27 @@ def paged_flash_attend(
         group=group,
         scale=Dh**-0.5,
         window=window,
+        quant=quant,
     )
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, 1, group, Dh),
+            lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, bs, Dh), kv_index),
+        pl.BlockSpec((1, 1, bs, Dh), kv_index),
+    ]
+    operands = [q5, pool_k, pool_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs), kv_index_3),
+            pl.BlockSpec((1, 1, bs), kv_index_3),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KV, MB),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, 1, group, Dh),
-                lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
-            ),
-            pl.BlockSpec((1, 1, bs, Dh), kv_index),
-            pl.BlockSpec((1, 1, bs, Dh), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, 1, group, Dh),
             lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
@@ -196,7 +228,7 @@ def paged_flash_attend(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, 1, KV, group, Dh), q.dtype),
         interpret=interpret,
-    )(table, pos, q5, pool_k, pool_v)
+    )(table, pos, *operands)
     return out.reshape(B, 1, H, Dh)
 
 

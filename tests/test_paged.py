@@ -506,3 +506,68 @@ def test_pp_paged_uneven_layer_split(eight_devices):
     for w, g in zip(want, got):
         assert w["status"] == g["status"] == "success"
         assert g["response"] == w["response"]
+
+
+@pytest.mark.parametrize("window", [None, 21])
+@pytest.mark.slow
+def test_paged_kernel_dequantizes_int8_pool(window):
+    """Kernel-level: paged_flash_attend over KVQuant pool leaves == the
+    gather path over the dequantized pool — the table walk streams int8
+    and dequantizes per block in the prologue."""
+    from distributed_llm_inference_tpu.ops.kv_quant import (
+        KVQuant, dequantize, quantize_chunk,
+    )
+    from distributed_llm_inference_tpu.ops.paged_attention import (
+        paged_flash_attend,
+    )
+
+    B, H, KV, Dh, bs, MB, N = 3, 8, 2, 16, 8, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q = jax.random.normal(ks[0], (B, 1, H, Dh), jnp.float32)
+    raw_k = jax.random.normal(ks[1], (N, KV, bs, Dh), jnp.float32)
+    raw_v = jax.random.normal(ks[2], (N, KV, bs, Dh), jnp.float32)
+    # quantize_chunk scales over the trailing Dh axis given [..., T, KV, Dh];
+    # pool layout is [N, KV, bs, Dh] -> per-(block, head, slot) scales
+    qk, sk = quantize_chunk(raw_k.transpose(0, 2, 1, 3))
+    qv, sv = quantize_chunk(raw_v.transpose(0, 2, 1, 3))
+    pk = KVQuant(qk.transpose(0, 2, 1, 3), sk.transpose(0, 2, 1))
+    pv = KVQuant(qv.transpose(0, 2, 1, 3), sv.transpose(0, 2, 1))
+    table = jnp.asarray(
+        [[5, 2, 7, 0], [1, 9, 0, 0], [11, 4, 6, 3]], jnp.int32
+    )
+    pos = jnp.asarray([11, 7, MB * bs - 1], jnp.int32)
+    got = paged_flash_attend(
+        q, pk, pv, table, pos, window=window, interpret=True
+    )
+    want = _gather_attend(
+        q, dequantize(pk), dequantize(pv), table, pos, window=window
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.slow
+def test_paged_int8_pallas_token_parity(solo_engine):
+    """Engine-level: an int8 paged fleet under attn_impl='pallas' (the
+    dequantizing table-walk kernel) emits the exact token stream the int8
+    gather path emits."""
+    base = solo_engine.cfg.replace(kv_quant="int8")
+    streams = []
+    for impl in ("xla", "pallas"):
+        eng = InferenceEngine(
+            base.replace(attn_impl=impl), params=solo_engine.backend.params,
+            engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        )
+        cont = ContinuousEngine(
+            eng, n_slots=2, chunk_steps=4, slot_max_seq=96,
+            kv_pool_blocks=16, kv_block_size=16,
+        )
+        try:
+            streams.append([
+                cont.submit(p, greedy=True, chat=False, max_tokens=10)["response"]
+                for p in PROMPTS
+            ])
+        finally:
+            cont.close()
+    assert streams[0] == streams[1]
