@@ -24,16 +24,17 @@ dense hook (models/llama.default_attn_hook) dispatches on the leaf type;
 everything else — scan-over-layers, donation, while_loop carries —
 treats the cache as an opaque pytree.
 
-Scope: llama-family (single device, the slot fleet — dense OR block-
-paged pool — and pp/tp/dp/1F1B pipeline meshes; the prefix snapshot
-store composes too, its slices carry the scale leaves). The Pallas
-flash PREFILL kernel and the fused paged DECODE kernel both dequantize
-int8 tiles/blocks in their prologues (ops/flash_attention.py,
-ops/paged_attention.py — half the cache HBM bytes); only sp (ring
-attention) and the dense fleet kernel (flash_attend_slots, which the
-hook never selects anyway) still read raw dtypes. The reference has no
-KV cache at all (/root/reference/Worker1.py:132-134); this is
-north-star serving scope.
+Scope: llama-family, EVERY topology — single device, the slot fleet
+(dense OR block-paged pool), pp/tp/dp/1F1B pipeline meshes, and sp
+(the ring/cp hooks quantize on write and dequantize their local slot
+sets — parallel/context.py); the prefix snapshot store composes too,
+its slices carry the scale leaves. The Pallas flash PREFILL kernel and
+the fused paged DECODE kernel both dequantize int8 tiles/blocks in
+their prologues (ops/flash_attention.py, ops/paged_attention.py — half
+the cache HBM bytes); only the dense fleet kernel (flash_attend_slots,
+which the hook never selects anyway) still reads raw dtypes. The
+reference has no KV cache at all (/root/reference/Worker1.py:132-134);
+this is north-star serving scope.
 """
 
 from __future__ import annotations

@@ -34,12 +34,16 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import ModelConfig
 from ..engine.generate import stop_mask
 from ..models import api as M
+from ..ops.kv_quant import KVQuant
+from ..ops.kv_quant import dequantize as kv_dequantize
+from ..ops.kv_quant import quantize_chunk
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
 from .pipeline import SPMDBackendBase
 from .ring import (
     cp_decode_attend,
     cp_kv_write,
+    cp_scale_write,
     cp_select_slot,
     ring_attend,
     ulysses_attend,
@@ -51,10 +55,16 @@ from .ring import (
 _AUX_SPEC = P(AXIS_DP, AXIS_SP)
 
 
-def cp_cache_spec() -> P:
+def cp_cache_spec(cfg=None):
     """KV cache [L, B, KV, S, Dh]: batch over dp, kv heads over tp, and —
-    unlike the dense cache_spec() — the SLOT axis over sp."""
-    return P(AXIS_PP, AXIS_DP, AXIS_TP, AXIS_SP, None)
+    unlike the dense cache_spec() — the SLOT axis over sp. With
+    cfg.kv_quant the leaf is a KVQuant-of-specs (int8 data keeps the
+    5-axis spec, the per-(slot, head) scales [L, B, KV, S] drop head_dim)
+    — the same per-leaf distribution trick as partition.cache_spec."""
+    p5 = P(AXIS_PP, AXIS_DP, AXIS_TP, AXIS_SP, None)
+    if cfg is None or getattr(cfg, "kv_quant", None) is None:
+        return p5
+    return KVQuant(p5, P(AXIS_PP, AXIS_DP, AXIS_TP, AXIS_SP))
 
 
 class ContextParallelBackend(SPMDBackendBase):
@@ -118,14 +128,19 @@ class ContextParallelBackend(SPMDBackendBase):
     def init_cache(self, batch: int, max_seq: int):
         cfg, sp, dp = self.cfg, self.sp, self.dp
         Sc = self.local_slots(max_seq)
-        kv_sharding = NamedSharding(self.mesh, cp_cache_spec())
-        aux_sharding = NamedSharding(self.mesh, _AUX_SPEC)
+        mesh = self.mesh
+        spec_tree = {"k": cp_cache_spec(cfg), "v": cp_cache_spec(cfg)}
+        aux_sharding = NamedSharding(mesh, _AUX_SPEC)
 
         @jax.jit
         def make():
             kv = M.init_kv_cache(cfg, batch, max_seq=sp * Sc)
             kv = jax.tree.map(
-                lambda x: jax.lax.with_sharding_constraint(x, kv_sharding), kv
+                lambda x, sp_: jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, sp_)
+                ),
+                kv,
+                spec_tree,
             )
             pos_ids = jax.lax.with_sharding_constraint(
                 jnp.full((dp, sp * Sc), -1, jnp.int32), aux_sharding
@@ -158,8 +173,38 @@ class ContextParallelBackend(SPMDBackendBase):
         )
 
         def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None):
-            attn = prefill_attend(q, k, v, AXIS_SP)
             zero = jnp.int32(0)
+            if isinstance(ck, KVQuant):
+                # int8 cache: store quantized chunks, and attend over the
+                # quantized round-trip — ring_attend/ulysses_attend ship
+                # the int8 chunks + scales over ICI (~4x fewer bytes than
+                # rotating dequantized fp32) and dequantize at use, the
+                # exact values the dense kv_quant path attends (its hook
+                # reads the written cache), so cross-topology numerics
+                # stay consistent
+                qk, sk = quantize_chunk(k)
+                qv, sv = quantize_chunk(v)
+                attn = prefill_attend(
+                    q, qk, qv, AXIS_SP, k_scale=sk, v_scale=sv
+                )
+                ck = KVQuant(
+                    jax.lax.dynamic_update_slice(
+                        ck.q, qk.transpose(0, 2, 1, 3), (zero,) * 4
+                    ),
+                    jax.lax.dynamic_update_slice(
+                        ck.s, sk.transpose(0, 2, 1), (zero,) * 3
+                    ),
+                )
+                cv = KVQuant(
+                    jax.lax.dynamic_update_slice(
+                        cv.q, qv.transpose(0, 2, 1, 3), (zero,) * 4
+                    ),
+                    jax.lax.dynamic_update_slice(
+                        cv.s, sv.transpose(0, 2, 1), (zero,) * 3
+                    ),
+                )
+                return attn, ck, cv
+            attn = prefill_attend(q, k, v, AXIS_SP)
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
             vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
             ck = jax.lax.dynamic_update_slice(ck, kc, (zero, zero, zero, zero))
@@ -199,7 +244,7 @@ class ContextParallelBackend(SPMDBackendBase):
             return first, logits, cache
 
         cache_specs = {
-            "k": cp_cache_spec(), "v": cp_cache_spec(),
+            "k": cp_cache_spec(cfg), "v": cp_cache_spec(cfg),
             "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
         }
         # shared specs name AXIS_PP on the vocab dims, but pp == 1 here so
@@ -254,6 +299,28 @@ class ContextParallelBackend(SPMDBackendBase):
 
                 def cp_hook(cfg_, q, k, v, ck_l, cv_l, pos_, mask, gate,
                             valid_start=None):
+                    if isinstance(ck_l, KVQuant):
+                        # int8 cache: quantize the token, write data +
+                        # scale owner-gated, attend over the locally
+                        # dequantized slot set (the log-sum-exp merge is
+                        # over DEQUANTIZED partials, identical values to
+                        # the dense int8 path's)
+                        qk, sk = quantize_chunk(k)
+                        qv, sv = quantize_chunk(v)
+                        dq, dv_ = cp_kv_write(
+                            ck_l.q, cv_l.q, qk, qv, slot, owner
+                        )
+                        ck_l = KVQuant(
+                            dq, cp_scale_write(ck_l.s, sk, slot, owner)
+                        )
+                        cv_l = KVQuant(
+                            dv_, cp_scale_write(cv_l.s, sv, slot, owner)
+                        )
+                        attn = cp_decode_attend(
+                            q, kv_dequantize(ck_l), kv_dequantize(cv_l),
+                            pids2[0], pos_, AXIS_SP,
+                        )
+                        return attn, ck_l, cv_l
                     ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner)
                     attn = cp_decode_attend(q, ck_l, cv_l, pids2[0], pos_, AXIS_SP)
                     return attn, ck_l, cv_l
@@ -295,7 +362,7 @@ class ContextParallelBackend(SPMDBackendBase):
             return out, n_gen, cache2
 
         cache_specs = {
-            "k": cp_cache_spec(), "v": cp_cache_spec(),
+            "k": cp_cache_spec(cfg), "v": cp_cache_spec(cfg),
             "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
         }
         shmapped = self._shard(

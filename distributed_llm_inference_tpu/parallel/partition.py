@@ -239,10 +239,9 @@ def cache_spec(cfg=None):
     returned SPEC tree mirrors that structure (a KVQuant holding specs:
     same treedef trick as the quantized weight specs above), so every
     shard_map in/out spec and sharding constraint distributes per leaf.
-    cfg=None keeps the raw single-spec form (callers that never see a
-    quantized cache — the context backend, which gates kv_quant off; the
-    pipeline AND 1F1B schedule backends pass cfg and serve KVQuant
-    caches).
+    cfg=None keeps the raw single-spec form (legacy callers; the
+    pipeline and 1F1B backends pass cfg and serve KVQuant caches — the
+    context backend has its own quant-aware cp_cache_spec).
     """
     p5 = P(AXIS_PP, AXIS_DP, AXIS_TP, None, None)
     if cfg is None or getattr(cfg, "kv_quant", None) is None:

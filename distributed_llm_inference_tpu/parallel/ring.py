@@ -50,6 +50,8 @@ def ring_attend(
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str = AXIS_SP,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Causal ring attention on sequence-sharded Q/K/V chunks.
 
@@ -59,6 +61,11 @@ def ring_attend(
     Online-softmax merge keeps only (m, l, acc) between steps.
 
     q [B,Tc,H,Dh], k/v [B,Tc,KV,Dh] (local chunks) -> [B,Tc,H,Dh].
+    k_scale/v_scale [B,Tc,KV] (int8 caches, ops/kv_quant): k/v are int8
+    chunks and the SCALES rotate with them — each ppermute hop ships
+    int8 + one fp32 scale per (token, head) (~4x fewer ICI bytes than
+    rotating the dequantized fp32 chunks), and dequant happens at use,
+    where the scores einsum upcasts to fp32 anyway.
     """
     sp = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -66,17 +73,21 @@ def ring_attend(
     KV = k.shape[2]
     G = H // KV
     scale = Dh**-0.5
+    quant = k_scale is not None
 
     qg = (q.astype(jnp.float32) * scale).reshape(B, Tc, KV, G, Dh)
     q_pos = my * Tc + jnp.arange(Tc, dtype=jnp.int32)  # [Tc]
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def update(s, m, l, acc, kc, vc):
+    def deq(c, s_):
+        return c.astype(jnp.float32) * s_[..., None] if quant else c
+
+    def update(s, m, l, acc, kc, vc, ksc, vsc):
         """Online-softmax update with the chunk held at ring step s."""
         src = (my - s) % sp  # chunk id currently held
         kv_pos = src * Tc + jnp.arange(Tc, dtype=jnp.int32)
         mask = kv_pos[None, :] <= q_pos[:, None]  # [Tc, Tc_k]
-        scores = _gqa_scores(qg, kc)  # [B,KV,G,Tc,Tc]
+        scores = _gqa_scores(qg, deq(kc, ksc))  # [B,KV,G,Tc,Tc]
         scores = jnp.where(mask[None, None, None], scores, _NEG)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
@@ -84,28 +95,34 @@ def ring_attend(
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
-            "bkgts,bskd->bkgtd", p, vc.astype(jnp.float32)
+            "bkgts,bskd->bkgtd", p, deq(vc, vsc).astype(jnp.float32)
         )
         return m_new, l, acc
 
+    # the rotating pytree carries the scales ONLY in quant mode: a dummy
+    # array would come back from ppermute tagged varying-over-sp and
+    # mismatch the loop carry type
     def step(s, carry):
-        m, l, acc, kc, vc = carry
+        m, l, acc, kv_c = carry
         # Rotate FIRST (chunk ids held locally decrease by one per step, so
         # causal work stays contiguous); step 0 runs outside the loop on the
         # resident chunk, so only the sp-1 needed hops are ever sent.
-        kc, vc = jax.lax.ppermute((kc, vc), axis_name, perm)
-        m, l, acc = update(s, m, l, acc, kc, vc)
-        return m, l, acc, kc, vc
+        kv_c = jax.lax.ppermute(kv_c, axis_name, perm)
+        kc, vc, ksc, vsc = kv_c if quant else (*kv_c, None, None)
+        m, l, acc = update(s, m, l, acc, kc, vc, ksc, vsc)
+        return m, l, acc, kv_c
 
     m0 = jnp.full((B, KV, G, Tc, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((B, KV, G, Tc, 1), jnp.float32)
     a0 = jnp.zeros((B, KV, G, Tc, Dh), jnp.float32)
-    m0, l0, a0 = update(0, m0, l0, a0, k, v)
-    m, l, acc, _, _ = jax.lax.fori_loop(1, sp, step, (m0, l0, a0, k, v))
+    m0, l0, a0 = update(0, m0, l0, a0, k, v, k_scale, v_scale)
+    kv_c0 = (k, v, k_scale, v_scale) if quant else (k, v)
+    m, l, acc, _ = jax.lax.fori_loop(1, sp, step, (m0, l0, a0, kv_c0))
 
     l = jnp.where(l == 0.0, 1.0, l)  # only padding rows can be all-masked
     out = acc / l  # [B,KV,G,Tc,Dh]
-    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tc, H, Dh).astype(q.dtype)
+    out_dtype = q.dtype
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Tc, H, Dh).astype(out_dtype)
 
 
 def ulysses_attend(
@@ -113,6 +130,8 @@ def ulysses_attend(
     k: jnp.ndarray,
     v: jnp.ndarray,
     axis_name: str = AXIS_SP,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Ulysses-style (DeepSpeed) sequence parallelism: two all-to-alls
     instead of a ring.
@@ -128,15 +147,28 @@ def ulysses_attend(
 
     Requires n_heads % sp == 0 AND n_kv_heads % sp == 0 (kv heads scatter
     too). q [B,Tc,H,Dh], k/v [B,Tc,KV,Dh] -> [B,Tc,H,Dh].
+    k_scale/v_scale [B,Tc,KV]: int8 chunks + scales ride the a2a (same
+    traffic saving as ring_attend's quantized rotation), dequantized at
+    use after the re-shard.
     """
     sp = jax.lax.psum(1, axis_name)
     B, Tc, H, Dh = q.shape
+    quant = k_scale is not None
     # seq -> heads: split the head axis sp ways, concat chunks on the
     # sequence axis (tiled a2a concatenates in ring order, so positions
     # stay globally ordered)
     qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    if quant:
+        ksh = jax.lax.all_to_all(
+            k_scale, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+        vsh = jax.lax.all_to_all(
+            v_scale, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+        kh = kh.astype(jnp.float32) * ksh[..., None]
+        vh = vh.astype(jnp.float32) * vsh[..., None]
     T = qh.shape[1]  # full sequence
     Hl, KVl = qh.shape[2], kh.shape[2]
     G = Hl // KVl
@@ -276,6 +308,24 @@ def cp_kv_write(
     cache_k = jax.lax.dynamic_update_slice(cache_k, kc, start)
     cache_v = jax.lax.dynamic_update_slice(cache_v, vc, start)
     return cache_k, cache_v
+
+
+def cp_scale_write(
+    cache_s: jnp.ndarray,
+    s_new: jnp.ndarray,
+    slot: jnp.ndarray,
+    owner: jnp.ndarray,
+):
+    """Owner-gated write of one token's quantization SCALE at a local slot
+    — the [B, KV, Sc] companion of cp_kv_write for int8 caches
+    (ops/kv_quant.KVQuant leaves). s_new [B, 1, KV] (chunk layout) ->
+    cache layout [B, KV, Sc]."""
+    sc = s_new.transpose(0, 2, 1)  # [B, KV, 1]
+    zero = jnp.int32(0)
+    start = (zero, zero, slot)
+    old = jax.lax.dynamic_slice(cache_s, start, sc.shape)
+    sc = jnp.where(owner, sc, old)
+    return jax.lax.dynamic_update_slice(cache_s, sc, start)
 
 
 def cp_cache_append(

@@ -51,17 +51,10 @@ def create_backend(
         cfg = cfg.replace(quant=quant)
     if kv_quant is not None:
         cfg = cfg.replace(kv_quant=kv_quant)
-    if cfg.kv_quant is not None and mesh_cfg.sp > 1:
-        # the ring-attention hook reads raw-dtype cache slabs; every other
-        # topology — single device, pp/tp/dp pipeline, microbatched 1F1B —
-        # quantizes fine (cache specs and the 1F1B row slicing distribute
-        # per KVQuant leaf — parallel/partition.cache_spec,
-        # schedule._stage_apply). Checked before params init like the
-        # guards around it.
-        raise NotImplementedError(
-            "kv_quant runs on the single device and pp/tp/dp/1F1B "
-            "pipeline meshes; sp (ring attention) keeps raw-dtype caches"
-        )
+    # kv_quant composes with EVERY topology now: single device, pp/tp/dp
+    # pipeline, 1F1B (per-leaf cache specs + tree-aware row slicing), and
+    # sp (the ring/cp hooks quantize on write and dequantize their local
+    # slot sets — parallel/context.py).
     if attn_impl is not None:
         from .config import resolve_attn_impl
 

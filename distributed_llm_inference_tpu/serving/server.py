@@ -718,11 +718,11 @@ def main(argv: Optional[list] = None):
         "--kv-quant", default=None, choices=[None, "int8"],
         help="KV-CACHE quantization: int8 K/V with per-(token, head) "
              "scales halves cache HBM — 2x the --continuous slots or "
-             "context window at the same budget (llama family; single "
-             "chip or a pp/tp/dp pipeline mesh; composes with "
-             "--prefix-cache and --kv-pool-blocks — an int8 block pool "
-             "stacks both HBM levers; excludes --sp and "
-             "--attn-impl pallas)",
+             "context window at the same budget (llama family; EVERY "
+             "topology: single chip, pp/tp/dp/1F1B meshes, --sp rings; "
+             "composes with --prefix-cache, --kv-pool-blocks — an int8 "
+             "block pool stacks both HBM levers — and --attn-impl "
+             "pallas, whose kernels dequantize in their prologues)",
     )
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)

@@ -150,11 +150,8 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
     # kv_quant + pallas COMPOSES now (the flash kernel dequantizes int8
     # in its tile prologue) — the replace must succeed
     assert cfg.replace(kv_quant="int8", attn_impl="pallas").attn_impl == "pallas"
-    from distributed_llm_inference_tpu.runtime import create_backend
-    from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
-
-    with pytest.raises(NotImplementedError, match="raw-dtype"):
-        create_backend(cfg, kv_quant="int8", mesh_cfg=MeshConfig(sp=2))
+    # (kv_quant now composes with every topology, sp included — the ring
+    # hooks quantize on write; see test_sp_ring_kv_quant_matches_solo)
 
 
 
@@ -339,4 +336,33 @@ def test_pallas_prefill_with_kv_quant_token_parity(raw_engine):
         w = eng_x.generate(prompt, greedy=True, chat=False, max_tokens=8)
         g = eng_p.generate(prompt, greedy=True, chat=False, max_tokens=8)
         assert w["status"] == g["status"] == "success"
+        assert g["response"] == w["response"]
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.slow
+def test_sp_ring_kv_quant_matches_solo(raw_engine, eight_devices, strategy):
+    """kv_quant composes with context parallelism now (the last kv_quant
+    exclusion): the ring prefill stores quantized chunks and attends the
+    dequantized round-trip — the SAME values the solo int8 path attends —
+    and cp decode merges dequantized local partials. Greedy tokens match
+    the solo int8 engine exactly on the test model."""
+    from distributed_llm_inference_tpu.parallel.mesh import MeshConfig
+    from distributed_llm_inference_tpu.runtime import create_engine
+
+    qcfg = raw_engine.cfg.replace(kv_quant="int8")
+    solo = InferenceEngine(
+        qcfg, params=raw_engine.backend.params,
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+    )
+    sp = create_engine(
+        qcfg, mesh_cfg=MeshConfig(sp=2), sp_strategy=strategy,
+        engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        params=raw_engine.backend.params,
+    )
+    assert sp.backend.name == "context-parallel"
+    for prompt in PROMPTS[:2]:
+        w = solo.generate(prompt, greedy=True, chat=False, max_tokens=10)
+        g = sp.generate(prompt, greedy=True, chat=False, max_tokens=10)
+        assert g["status"] == "success"
         assert g["response"] == w["response"]
