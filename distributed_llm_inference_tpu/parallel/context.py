@@ -152,20 +152,52 @@ class ContextParallelBackend(SPMDBackendBase):
 
         return make()
 
+    # repetition-penalty presence, OpenAI penalty counts, logit_bias and
+    # per-token logprobs all serve on the sp ring (round-4: the full solo
+    # request surface on every topology) — the variants are local ops on
+    # the replicated logits, exactly like the pp backend's
+    supports_presence = True
+    supports_counts = True
+    supports_bias = True
+    supports_logprobs = True
+
     def prefill(self, tokens, prompt_len, cache, key, sampling,
-                valid_start=None, presence=None):
+                valid_start=None, presence=None, bias=None):
         if tokens.shape[1] % self.sp:
             raise ValueError(
                 f"prefill bucket {tokens.shape[1]} not divisible by sp={self.sp}; "
                 f"pick prefill_buckets that are multiples of the ring size"
             )
-        # base class rejects valid_start/presence loudly (not wired here)
-        return super().prefill(
-            tokens, prompt_len, cache, key, sampling, valid_start, presence
-        )
+        if valid_start is not None:
+            raise NotImplementedError(
+                f"{self.name} does not support ragged (valid_start) batches: "
+                f"the ring mask is built from contiguous chunk offsets"
+            )
+        pres = presence is not None
+        wb = bias is not None
+        fn = self._programs.get(("prefill", pres, wb))
+        if fn is None:
+            fn = self._build_prefill_impl(with_presence=pres, with_bias=wb)
+            self._programs[("prefill", pres, wb)] = fn
+        args = [self.shared, self.layers, tokens, prompt_len, cache, key,
+                sampling]
+        if pres:
+            args.append(presence)
+        if wb:
+            args.append(bias)
+        return fn(*args)
 
     # -- prefill -------------------------------------------------------------
     def _build_prefill(self):
+        # base-class hook: build the plain program ONCE and seed the memo
+        # prefill() consults, so the base-held self._prefill and the
+        # memo entry are the same compiled object (the pp backend's
+        # pattern)
+        fn = self._build_prefill_impl(with_presence=False, with_bias=False)
+        self._programs[("prefill", False, False)] = fn
+        return fn
+
+    def _build_prefill_impl(self, *, with_presence: bool, with_bias: bool):
         cfg = self.cfg
 
         prefill_attend = (
@@ -211,7 +243,16 @@ class ContextParallelBackend(SPMDBackendBase):
             cv = jax.lax.dynamic_update_slice(cv, vc, (zero, zero, zero, zero))
             return attn, ck, cv
 
-        def body(shared, layers, tokens, prompt_len, cache, key, sampling):
+        def body(shared, layers, tokens, prompt_len, cache, key, sampling,
+                 *extra):
+            i = 0
+            presence = bias = None
+            if with_presence:
+                presence = extra[i]
+                i += 1
+            if with_bias:
+                bias = extra[i]
+                i += 1
             key = self._dp_key(key)
             my = jax.lax.axis_index(AXIS_SP)
             Tc = tokens.shape[1]  # local chunk of the padded bucket
@@ -239,7 +280,9 @@ class ContextParallelBackend(SPMDBackendBase):
             last = jax.lax.dynamic_slice_in_dim(x, jnp.clip(li, 0, Tc - 1), 1, axis=1)
             logits_local = M.unembed(cfg, shared, last)[:, 0, :]
             logits = jax.lax.psum(jnp.where(owner, logits_local, 0.0), AXIS_SP)
-            first = sample_token(key, logits, *sampling)
+            first = sample_token(
+                key, logits, *sampling, presence=presence, bias=bias
+            )
             cache = {"k": kv["k"], "v": kv["v"], "pos_ids": pos_ids, "fill": fill}
             return first, logits, cache
 
@@ -247,43 +290,78 @@ class ContextParallelBackend(SPMDBackendBase):
             "k": cp_cache_spec(cfg), "v": cp_cache_spec(cfg),
             "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
         }
+        specs = [
+            self._shared_specs, self._layer_specs, P(AXIS_DP, AXIS_SP),
+            P(), cache_specs, P(), P(),
+        ]
+        if with_presence:
+            specs.append(P(AXIS_DP))
+        if with_bias:
+            specs.append(P())  # [V] bias replicates: logits are replicated
         # shared specs name AXIS_PP on the vocab dims, but pp == 1 here so
         # each "shard" is the full array and M.embed/M.unembed stay exact
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, P(AXIS_DP, AXIS_SP),
-                P(), cache_specs, P(), P(),
-            ),
+            in_specs=tuple(specs),
             out_specs=(P(AXIS_DP), P(AXIS_DP), cache_specs),
         )
         return jax.jit(shmapped, donate_argnums=(4,))
 
     # -- decode --------------------------------------------------------------
     def _build_decode(self, max_steps: int, with_presence: bool = False):
-        if with_presence:
+        return self._build_decode_any(max_steps, with_presence=with_presence)
+
+    def _build_decode_full(self, max_steps: int, *, ragged: bool,
+                           with_presence: bool, with_bias: bool,
+                           with_logprobs: bool, with_counts: bool = False):
+        if ragged:
             raise NotImplementedError(
-                f"{self.name} does not support repetition-penalty presence "
-                f"(serve penalized requests on the pipeline or single-device "
-                f"backend)"
+                f"{self.name} does not support ragged (valid_start) batches"
             )
+        return self._build_decode_any(
+            max_steps, with_presence=with_presence, with_counts=with_counts,
+            with_bias=with_bias, with_logprobs=with_logprobs,
+        )
+
+    def _build_decode_any(self, max_steps: int, *, with_presence: bool = False,
+                          with_counts: bool = False, with_bias: bool = False,
+                          with_logprobs: bool = False):
+        from ..engine.generate import count_update, presence_update
+
         cfg, sp = self.cfg, self.sp
 
-        def body(shared, layers, first_token, cache, start_pos, limit, key, sampling):
+        def body(shared, layers, first_token, cache, start_pos, limit, key,
+                 sampling, *extra):
+            i = 0
+            presence0 = counts0 = bias = None
+            if with_presence:
+                presence0 = extra[i]
+                i += 1
+            if with_counts:
+                counts0 = extra[i]
+                i += 1
+            if with_bias:
+                bias = extra[i]
+                i += 1
             key = self._dp_key(key)
             Sc = cache["k"].shape[3]
             B = first_token.shape[0]
             pad = jnp.int32(cfg.pad_token_id)
             out0 = jnp.full((B, max_steps), pad, jnp.int32)
             finished0 = stop_mask(cfg, first_token)
+            pres0 = (
+                presence0 if with_presence else jnp.zeros((B, 1), jnp.bool_)
+            )
+            cnt0 = counts0 if with_counts else jnp.zeros((B, 1), jnp.int32)
+            lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
 
             def cond(c):
-                step, _, _, _, _, _, _, _, finished, _, _ = c
+                step, _, _, _, _, _, _, _, finished, _, _ = c[:11]
                 return (step < limit) & ~jnp.all(finished)
 
             def step_fn(c):
                 (step, token, pos, ck, cv, pids, fill, key, finished, out,
-                 n_gen) = c
+                 n_gen, pres, cnt, lps) = c
                 # least-filled shard stores this token (parallel/ring.py:
                 # cp_select_slot rationale — prefill places chunks
                 # contiguously, so pos % sp round-robin would overflow the
@@ -332,18 +410,38 @@ class ContextParallelBackend(SPMDBackendBase):
                 )
                 logits = M.unembed(cfg, shared, x[:, -1:, :])[:, 0, :]
                 key, sub = jax.random.split(key)
-                nxt = sample_token(sub, logits, *sampling)
+                nxt = sample_token(
+                    sub, logits, *sampling,
+                    presence=pres if with_presence else None,
+                    counts=cnt if with_counts else None,
+                    bias=bias,
+                )
+                if with_presence:
+                    pres = presence_update(pres, nxt)
                 # overflow (every shard full): token was not stored, so this
                 # step's attention missed it — discard and stop, don't emit
                 newly = finished | stop_mask(cfg, nxt) | overflow
+                if with_counts:
+                    cnt = count_update(cnt, nxt, ~newly)
                 emit = jnp.where(newly, pad, nxt)
                 out = jax.lax.dynamic_update_slice(
                     out, emit[:, None], (jnp.int32(0), step)
                 )
+                if with_logprobs:
+                    # raw-distribution logprob of the emitted token (the
+                    # OpenAI convention — pre-temperature/filters/bias),
+                    # same as the single-device and pp variants
+                    logp = jax.nn.log_softmax(
+                        logits.astype(jnp.float32), axis=-1
+                    )
+                    tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)
+                    lps = jax.lax.dynamic_update_slice(
+                        lps, tok_lp, (jnp.int32(0), step)
+                    )
                 n_gen = n_gen + (~newly).astype(jnp.int32)
                 fill = fill + owner.astype(jnp.int32)
                 return (step + 1, emit, pos + 1, kv["k"], kv["v"], pids2, fill,
-                        key, newly, out, n_gen)
+                        key, newly, out, n_gen, pres, cnt, lps)
 
             init = (
                 jnp.int32(0),
@@ -354,24 +452,39 @@ class ContextParallelBackend(SPMDBackendBase):
                 finished0,
                 out0,
                 jnp.zeros((B,), jnp.int32),
+                pres0,
+                cnt0,
+                lp0,
             )
-            (_, _, _, ck, cv, pids, fill, _, _, out, n_gen) = jax.lax.while_loop(
-                cond, step_fn, init
+            (_, _, _, ck, cv, pids, fill, _, _, out, n_gen, _, _, lps) = (
+                jax.lax.while_loop(cond, step_fn, init)
             )
             cache2 = {"k": ck, "v": cv, "pos_ids": pids, "fill": fill}
+            if with_logprobs:
+                return out, n_gen, cache2, lps
             return out, n_gen, cache2
 
         cache_specs = {
             "k": cp_cache_spec(cfg), "v": cp_cache_spec(cfg),
             "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
         }
+        specs = [
+            self._shared_specs, self._layer_specs, P(AXIS_DP), cache_specs,
+            P(), P(), P(), P(),
+        ]
+        if with_presence:
+            specs.append(P(AXIS_DP))
+        if with_counts:
+            specs.append(P(AXIS_DP))
+        if with_bias:
+            specs.append(P())
+        out_specs = [P(AXIS_DP), P(AXIS_DP), cache_specs]
+        if with_logprobs:
+            out_specs.append(P(AXIS_DP))
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, P(AXIS_DP), cache_specs,
-                P(), P(), P(), P(),
-            ),
-            out_specs=(P(AXIS_DP), P(AXIS_DP), cache_specs),
+            in_specs=tuple(specs),
+            out_specs=tuple(out_specs),
         )
         return jax.jit(shmapped, donate_argnums=(3,))
 

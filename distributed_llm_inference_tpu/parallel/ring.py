@@ -161,14 +161,15 @@ def ulysses_attend(
     kh = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     if quant:
+        # scales re-shard with their chunks; dequant happens PER KEY BLOCK
+        # inside the loop below — materializing fp32 kh/vh up front would
+        # 4x the K/V residency on exactly the long contexts sp serves
         ksh = jax.lax.all_to_all(
             k_scale, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
         vsh = jax.lax.all_to_all(
             v_scale, axis_name, split_axis=2, concat_axis=1, tiled=True
         )
-        kh = kh.astype(jnp.float32) * ksh[..., None]
-        vh = vh.astype(jnp.float32) * vsh[..., None]
     T = qh.shape[1]  # full sequence
     Hl, KVl = qh.shape[2], kh.shape[2]
     G = Hl // KVl
@@ -186,6 +187,13 @@ def ulysses_attend(
         m, l, acc = carry
         kc = jax.lax.dynamic_slice_in_dim(kh, s * Tc, Tc, axis=1)
         vc = jax.lax.dynamic_slice_in_dim(vh, s * Tc, Tc, axis=1)
+        if quant:
+            kc = kc.astype(jnp.float32) * jax.lax.dynamic_slice_in_dim(
+                ksh, s * Tc, Tc, axis=1
+            )[..., None]
+            vc = vc.astype(jnp.float32) * jax.lax.dynamic_slice_in_dim(
+                vsh, s * Tc, Tc, axis=1
+            )[..., None]
         kv_pos = s * Tc + jnp.arange(Tc, dtype=jnp.int32)
         mask = kv_pos[None, :] <= q_pos[:, None]  # [T, Tc]
         scores = _gqa_scores(qg, kc)  # [B,KVl,G,T,Tc]

@@ -197,3 +197,43 @@ def test_ulysses_tp_aware_guard_and_runtime_gate():
     with pytest.raises(ValueError, match="sp > 1"):
         create_backend(cfg, mesh_cfg=MeshConfig(), sp_strategy="ulysses",
                        params=params)
+
+
+@pytest.mark.slow
+def test_sp_full_solo_surface_matches_single_device(eight_devices):
+    """Round-4: the solo request-surface variants — repetition penalty,
+    OpenAI penalties, logit_bias, per-token logprobs — serve on the sp
+    ring, token-identical to the single-device engine (replicated logits
+    make every variant a local op, same as the pp backend)."""
+    from distributed_llm_inference_tpu import (
+        EngineConfig, MeshConfig, create_engine, get_model_config,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import api as M
+
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1)
+    params = M.init_params(cfg, jax.random.PRNGKey(5))
+    ecfg = EngineConfig(prefill_buckets=(32, 64))
+    sd = InferenceEngine(cfg, params=params, engine_cfg=ecfg)
+    sp = create_engine(
+        cfg, mesh_cfg=MeshConfig(sp=2), params=params, engine_cfg=ecfg,
+    )
+    assert sp.backend.name == "context-parallel"
+    prompt = "the quick brown fox"
+    for kw in (
+        dict(repetition_penalty=1.3),
+        dict(frequency_penalty=1.0, presence_penalty=0.3),
+        dict(logit_bias={"17": 100.0}),
+        dict(logprobs=True),
+        dict(repetition_penalty=1.2, logit_bias={"55": 2.5}),
+    ):
+        a = sd.generate(prompt, max_tokens=6, greedy=True, chat=False, **kw)
+        b = sp.generate(prompt, max_tokens=6, greedy=True, chat=False, **kw)
+        assert a["status"] == b["status"] == "success", (kw, b)
+        assert a["response"] == b["response"], kw
+        if "logprobs" in kw:
+            # merged-softmax reduction order differs from the monolithic
+            # softmax by ~1 ulp; tokens are identical
+            np.testing.assert_allclose(
+                a["token_logprobs"], b["token_logprobs"], atol=1e-5
+            )
