@@ -84,17 +84,20 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"context parallelism is wired for the llama family (attn_hook "
                 f"seam); got arch={cfg.arch!r}"
             )
-        if cfg.attn_window is not None:
+        if cfg.attn_window_layer_types is not None or (
+            cfg.attn_window is not None and cfg.attn_window_pattern != "all"
+        ):
+            # UNIFORM windows (Mistral), softcap and query-scale overrides
+            # (Gemma-2) all thread into ring_attend/cp_decode_attend now;
+            # only PER-LAYER window patterns stay excluded — BOTH spellings
+            # (Gemma-3's layer-type list AND Gemma-2's pattern="even"), the
+            # same condition the pallas legality check uses — because the
+            # hooks build their masks from positions and cannot see which
+            # layer of the scan they serve (fail loudly, not silently wrong)
             raise NotImplementedError(
-                "sliding-window attention does not compose with context "
-                "parallelism yet: ring_attend/cp_decode_attend compute full "
-                "causal attention (fail loudly, not silently wrong)"
-            )
-        if cfg.attn_softcap is not None or cfg.query_scale_override is not None:
-            raise NotImplementedError(
-                "Gemma-2 attention softcapping / query-scale overrides are "
-                "not wired into ring_attend/cp_decode_attend (fail loudly, "
-                "not silently wrong)"
+                "per-layer attention-window patterns (Gemma-2/3 alternating "
+                "layers) do not compose with context parallelism; uniform "
+                "windows, softcap and scale overrides do"
             )
         if int(mesh.shape[AXIS_PP]) != 1:
             raise ValueError("ContextParallelBackend needs pp == 1 (no layer sharding)")
@@ -217,7 +220,9 @@ class ContextParallelBackend(SPMDBackendBase):
                 qk, sk = quantize_chunk(k)
                 qv, sv = quantize_chunk(v)
                 attn = prefill_attend(
-                    q, qk, qv, AXIS_SP, k_scale=sk, v_scale=sv
+                    q, qk, qv, AXIS_SP, k_scale=sk, v_scale=sv,
+                    scale=cfg.query_scale, softcap=cfg.attn_softcap,
+                    window=cfg.attn_window,
                 )
                 ck = KVQuant(
                     jax.lax.dynamic_update_slice(
@@ -236,7 +241,10 @@ class ContextParallelBackend(SPMDBackendBase):
                     ),
                 )
                 return attn, ck, cv
-            attn = prefill_attend(q, k, v, AXIS_SP)
+            attn = prefill_attend(
+                q, k, v, AXIS_SP, scale=cfg.query_scale,
+                softcap=cfg.attn_softcap, window=cfg.attn_window,
+            )
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
             vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
             ck = jax.lax.dynamic_update_slice(ck, kc, (zero, zero, zero, zero))
@@ -397,10 +405,17 @@ class ContextParallelBackend(SPMDBackendBase):
                         attn = cp_decode_attend(
                             q, kv_dequantize(ck_l), kv_dequantize(cv_l),
                             pids2[0], pos_, AXIS_SP,
+                            scale=cfg.query_scale,
+                            softcap=cfg.attn_softcap,
+                            window=cfg.attn_window,
                         )
                         return attn, ck_l, cv_l
                     ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner)
-                    attn = cp_decode_attend(q, ck_l, cv_l, pids2[0], pos_, AXIS_SP)
+                    attn = cp_decode_attend(
+                        q, ck_l, cv_l, pids2[0], pos_, AXIS_SP,
+                        scale=cfg.query_scale, softcap=cfg.attn_softcap,
+                        window=cfg.attn_window,
+                    )
                     return attn, ck_l, cv_l
 
                 x = M.embed(cfg, shared, token[:, None], pos)

@@ -52,6 +52,10 @@ def ring_attend(
     axis_name: str = AXIS_SP,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    *,
+    scale: float | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Causal ring attention on sequence-sharded Q/K/V chunks.
 
@@ -72,7 +76,8 @@ def ring_attend(
     B, Tc, H, Dh = q.shape
     KV = k.shape[2]
     G = H // KV
-    scale = Dh**-0.5
+    if scale is None:
+        scale = Dh**-0.5
     quant = k_scale is not None
 
     qg = (q.astype(jnp.float32) * scale).reshape(B, Tc, KV, G, Dh)
@@ -87,7 +92,11 @@ def ring_attend(
         src = (my - s) % sp  # chunk id currently held
         kv_pos = src * Tc + jnp.arange(Tc, dtype=jnp.int32)
         mask = kv_pos[None, :] <= q_pos[:, None]  # [Tc, Tc_k]
+        if window is not None:  # uniform sliding window (Mistral-style)
+            mask &= kv_pos[None, :] > q_pos[:, None] - window
         scores = _gqa_scores(qg, deq(kc, ksc))  # [B,KV,G,Tc,Tc]
+        if softcap is not None:  # Gemma-2 logit capping, pre-mask (HF order)
+            scores = softcap * jnp.tanh(scores / softcap)
         scores = jnp.where(mask[None, None, None], scores, _NEG)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
@@ -132,6 +141,10 @@ def ulysses_attend(
     axis_name: str = AXIS_SP,
     k_scale: jnp.ndarray | None = None,
     v_scale: jnp.ndarray | None = None,
+    *,
+    scale: float | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Ulysses-style (DeepSpeed) sequence parallelism: two all-to-alls
     instead of a ring.
@@ -173,7 +186,8 @@ def ulysses_attend(
     T = qh.shape[1]  # full sequence
     Hl, KVl = qh.shape[2], kh.shape[2]
     G = Hl // KVl
-    scale = Dh**-0.5
+    if scale is None:
+        scale = Dh**-0.5
 
     # Local full-sequence attention in KEY BLOCKS with an online-softmax
     # accumulator — an unblocked [T, T] score matrix would peak sp x ring's
@@ -196,7 +210,11 @@ def ulysses_attend(
             )[..., None]
         kv_pos = s * Tc + jnp.arange(Tc, dtype=jnp.int32)
         mask = kv_pos[None, :] <= q_pos[:, None]  # [T, Tc]
+        if window is not None:
+            mask &= kv_pos[None, :] > q_pos[:, None] - window
         scores = _gqa_scores(qg, kc)  # [B,KVl,G,T,Tc]
+        if softcap is not None:
+            scores = softcap * jnp.tanh(scores / softcap)
         scores = jnp.where(mask[None, None, None], scores, _NEG)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
@@ -225,6 +243,10 @@ def cp_decode_attend(
     pos_ids: jnp.ndarray,
     pos: jnp.ndarray,
     axis_name: str = AXIS_SP,
+    *,
+    scale: float | None = None,
+    softcap: float | None = None,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Decode attention over a context-sharded KV cache.
 
@@ -239,17 +261,21 @@ def cp_decode_attend(
     B, T, H, Dh = q.shape
     KV, Sc = cache_k.shape[1], cache_k.shape[2]
     G = H // KV
-    scale = Dh**-0.5
+    if scale is None:
+        scale = Dh**-0.5
 
     qg = (q.astype(jnp.float32) * scale).reshape(B, T, KV, G, Dh)
     # A slot participates iff occupied; each query t at absolute position
     # pos+t sees slots with pos_ids <= pos+t (covers T>1 chunked decode).
     q_abs = pos + jnp.arange(T, dtype=jnp.int32)
     mask = (pos_ids >= 0)[None, :] & (pos_ids[None, :] <= q_abs[:, None])  # [T, Sc]
-
+    if window is not None:  # slot tags carry absolute positions: windowing
+        mask &= pos_ids[None, :] > q_abs[:, None] - window
     scores = jnp.einsum(
         "btkgd,bksd->bkgts", qg, cache_k.astype(jnp.float32)
     )
+    if softcap is not None:
+        scores = softcap * jnp.tanh(scores / softcap)
     scores = jnp.where(mask[None, None, None], scores, _NEG)
     m_loc = jnp.max(scores, axis=-1, keepdims=True)  # [B,KV,G,T,1]
     p = jnp.exp(scores - m_loc)

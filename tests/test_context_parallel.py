@@ -237,3 +237,77 @@ def test_sp_full_solo_surface_matches_single_device(eight_devices):
             np.testing.assert_allclose(
                 a["token_logprobs"], b["token_logprobs"], atol=1e-5
             )
+
+
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.slow
+def test_sp_sliding_window_matches_single_device(eight_devices, strategy):
+    """Round-4: uniform sliding-window attention (Mistral-style) composes
+    with context parallelism — the ring/ulysses masks and the cp decode
+    slot mask all window by absolute position. Greedy tokens match the
+    single-device windowed engine exactly."""
+    from distributed_llm_inference_tpu import (
+        EngineConfig, MeshConfig, create_engine, get_model_config,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import api as M
+
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1).replace(
+        attn_window=7
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(6))
+    ecfg = EngineConfig(prefill_buckets=(32, 64))
+    sd = InferenceEngine(cfg, params=params, engine_cfg=ecfg)
+    sp = create_engine(
+        cfg, mesh_cfg=MeshConfig(sp=2), params=params, engine_cfg=ecfg,
+        sp_strategy=strategy,
+    )
+    for prompt in ("the quick brown fox jumps over a dog", "hello there"):
+        a = sd.generate(prompt, max_tokens=10, greedy=True, chat=False)
+        b = sp.generate(prompt, max_tokens=10, greedy=True, chat=False)
+        assert a["status"] == b["status"] == "success"
+        assert a["response"] == b["response"]
+
+
+@pytest.mark.slow
+def test_sp_softcap_and_scale_override_match_single_device(eight_devices):
+    """Gemma-2-style attention softcapping + query-scale override on the
+    sp ring: elementwise pre-mask capping commutes with the log-sum-exp
+    merge, so tokens match single-device exactly."""
+    from distributed_llm_inference_tpu import (
+        EngineConfig, MeshConfig, create_engine, get_model_config,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import api as M
+
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1).replace(
+        attn_softcap=20.0, query_scale_override=8
+    )
+    params = M.init_params(cfg, jax.random.PRNGKey(7))
+    ecfg = EngineConfig(prefill_buckets=(32,))
+    sd = InferenceEngine(cfg, params=params, engine_cfg=ecfg)
+    sp = create_engine(
+        cfg, mesh_cfg=MeshConfig(sp=2), params=params, engine_cfg=ecfg,
+    )
+    a = sd.generate("cap these scores", max_tokens=8, greedy=True, chat=False)
+    b = sp.generate("cap these scores", max_tokens=8, greedy=True, chat=False)
+    assert a["status"] == b["status"] == "success"
+    assert a["response"] == b["response"]
+
+
+def test_sp_per_layer_window_pattern_still_rejected(eight_devices):
+    from distributed_llm_inference_tpu import MeshConfig, get_model_config
+    from distributed_llm_inference_tpu.runtime import create_backend
+
+    cfg = get_model_config("test-gemma3-tiny")
+    assert cfg.attn_window_layer_types is not None
+    with pytest.raises(NotImplementedError, match="per-layer"):
+        create_backend(cfg, mesh_cfg=MeshConfig(sp=2))
+    # Gemma-2's SPELLING of the same pattern (attn_window_pattern="even")
+    # must reject too — caught by review: it previously slipped the guard
+    # and would have served odd (full-attention) layers windowed
+    cfg2 = get_model_config("test-llama-tiny").replace(
+        attn_window=8, attn_window_pattern="even"
+    )
+    with pytest.raises(NotImplementedError, match="per-layer"):
+        create_backend(cfg2, mesh_cfg=MeshConfig(sp=2))
