@@ -190,6 +190,135 @@ class ContextParallelBackend(SPMDBackendBase):
             args.append(bias)
         return fn(*args)
 
+    # -- shared hook ---------------------------------------------------------
+    def _make_ring_hook(self):
+        """The prefill-phase attn_hook: sequence-parallel attention over
+        the chunk (ring or ulysses) + local cache write at slot 0 —
+        quantizing on write for int8 caches, with the quantized chunks +
+        scales riding the collective. Shared by the prefill and scoring
+        programs."""
+        cfg = self.cfg
+        prefill_attend = (
+            ulysses_attend if self.sp_strategy == "ulysses" else ring_attend
+        )
+
+        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None):
+            zero = jnp.int32(0)
+            if isinstance(ck, KVQuant):
+                # int8 cache: store quantized chunks, and attend over the
+                # quantized round-trip — ring_attend/ulysses_attend ship
+                # the int8 chunks + scales over ICI (~4x fewer bytes than
+                # rotating dequantized fp32) and dequantize at use, the
+                # exact values the dense kv_quant path attends (its hook
+                # reads the written cache), so cross-topology numerics
+                # stay consistent
+                qk, sk = quantize_chunk(k)
+                qv, sv = quantize_chunk(v)
+                attn = prefill_attend(
+                    q, qk, qv, AXIS_SP, k_scale=sk, v_scale=sv,
+                    scale=cfg.query_scale, softcap=cfg.attn_softcap,
+                    window=cfg.attn_window,
+                )
+                ck = KVQuant(
+                    jax.lax.dynamic_update_slice(
+                        ck.q, qk.transpose(0, 2, 1, 3), (zero,) * 4
+                    ),
+                    jax.lax.dynamic_update_slice(
+                        ck.s, sk.transpose(0, 2, 1), (zero,) * 3
+                    ),
+                )
+                cv = KVQuant(
+                    jax.lax.dynamic_update_slice(
+                        cv.q, qv.transpose(0, 2, 1, 3), (zero,) * 4
+                    ),
+                    jax.lax.dynamic_update_slice(
+                        cv.s, sv.transpose(0, 2, 1), (zero,) * 3
+                    ),
+                )
+                return attn, ck, cv
+            attn = prefill_attend(
+                q, k, v, AXIS_SP, scale=cfg.query_scale,
+                softcap=cfg.attn_softcap, window=cfg.attn_window,
+            )
+            kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
+            vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
+            ck = jax.lax.dynamic_update_slice(ck, kc, (zero, zero, zero, zero))
+            cv = jax.lax.dynamic_update_slice(cv, vc, (zero, zero, zero, zero))
+            return attn, ck, cv
+
+        return ring_hook
+
+    # -- teacher-forced scoring (OpenAI echo) --------------------------------
+    supports_score = True
+
+    def score_chunk(self, tokens, pos, cache, *, top_n=0):
+        """Single-chunk echo scoring on the ring: the chunk shards over
+        sp, each member computes its local teacher-forced logits, and one
+        tiled all_gather assembles [B, T, V] replicated so score_post
+        (the shared tail) runs identically everywhere. pos must be 0 —
+        the ring hook writes at chunk offsets, not a running offset, so
+        prompts longer than the largest bucket reject loudly."""
+        if int(pos) != 0:
+            raise ValueError(
+                f"{self.name} scores single-bucket prompts only (chunked "
+                f"scoring needs a running cache offset the ring prefill "
+                f"does not expose); raise prefill_buckets or score on a "
+                f"pp/single-chip server"
+            )
+        if tokens.shape[1] % self.sp:
+            raise ValueError(
+                f"score bucket {tokens.shape[1]} not divisible by "
+                f"sp={self.sp}"
+            )
+        fn = self._programs.get(("score", top_n))
+        if fn is None:
+            fn = self._build_score(top_n)
+            self._programs[("score", top_n)] = fn
+        return fn(self.shared, self.layers, tokens, cache)
+
+    def _build_score(self, top_n: int):
+        cfg = self.cfg
+        from ..engine.generate import score_post
+
+        ring_hook = self._make_ring_hook()
+
+        def body(shared, layers, tokens, cache):
+            my = jax.lax.axis_index(AXIS_SP)
+            Tc = tokens.shape[1]
+            chunk_start = my * Tc
+            x = M.embed(cfg, shared, tokens, chunk_start)
+            x, kv = M.forward_layers(
+                cfg, layers, x, {"k": cache["k"], "v": cache["v"]},
+                jnp.asarray(chunk_start, jnp.int32),
+                tp_axis=self.tp_axis, attn_hook=ring_hook,
+            )
+            logits_local = M.unembed(cfg, shared, x)  # [B, Tc, V]
+            logits = jax.lax.all_gather(
+                logits_local, AXIS_SP, axis=1, tiled=True
+            )
+            toks_full = jax.lax.all_gather(tokens, AXIS_SP, axis=1, tiled=True)
+            cache2 = {
+                "k": kv["k"], "v": kv["v"],
+                "pos_ids": cache["pos_ids"], "fill": cache["fill"],
+            }
+            return score_post(logits, toks_full, top_n) + (cache2,)
+
+        cache_specs = {
+            "k": cp_cache_spec(cfg), "v": cp_cache_spec(cfg),
+            "pos_ids": _AUX_SPEC, "fill": _AUX_SPEC,
+        }
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(AXIS_DP, AXIS_SP),
+                cache_specs,
+            ),
+            out_specs=(
+                P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), P(AXIS_DP), cache_specs
+            ),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
     # -- prefill -------------------------------------------------------------
     def _build_prefill(self):
         # base-class hook: build the plain program ONCE and seed the memo
