@@ -92,18 +92,149 @@ def post(port, payload, timeout=3600):
         return json.loads(r.read())
 
 
+def _elapsed_s(resp) -> float:
+    """`time_taken` crosses the API as the reference's human string
+    ("12.34s", orchestration.py:211-218); parse it back to seconds."""
+    return float(str(resp.get("time_taken", "0")).rstrip("s"))
+
+
+def serve_and_measure(work, store, pp, quant, max_tokens, tag="main") -> dict:
+    """Start the server CLI on the store, warm every serving program with a
+    cold request, then measure a warm request — reporting compile overhead
+    (cold TTFT - warm TTFT), warm TTFT (pure prefill compute), end-to-end
+    tok/s, and the STEADY-STATE decode rate tokens/(elapsed - ttft), which
+    is the number comparable to the reference's 0.12-0.2 tok/s
+    (/root/reference/Test.py:61 — its per-request stats are decode-only:
+    there is no prefill/TTFT split to subtract, every token pays the same
+    full-sequence recompute)."""
+    port = free_port()
+    cmd = [
+        sys.executable, "-m", "distributed_llm_inference_tpu.serving.server",
+        "--checkpoint", store, "--host", "127.0.0.1", "--port", str(port),
+        "--pp", str(pp),
+        # raise the reference-compat 30-token default cap: the steady-state
+        # split needs >= 64 decode steps to amortize per-request overhead
+        "--max-tokens-cap", str(max(max_tokens, 30)),
+    ]
+    if quant:
+        cmd += ["--quant", quant]
+    print("⏳ serving:", " ".join(cmd))
+    leg: dict = {"quant": quant}
+    t_start = time.time()
+    # log FILE, not a pipe: an undrained pipe filling with XLA/server logs
+    # would block the child before /health ever answers
+    srv_log = os.path.join(work, f"server_{tag}.log")
+    log_f = open(srv_log, "w", encoding="utf-8")
+    env = dict(os.environ)
+    if pp > 1 and env.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # a pp-mesh on the CPU backend needs pp virtual devices; on TPU
+        # the real chip count is the mesh's problem, not ours
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={pp}"
+        )
+    srv = subprocess.Popen(
+        cmd, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+    try:
+        deadline = time.time() + 900
+        while True:
+            if srv.poll() is not None or time.time() > deadline:
+                log_f.flush()
+                with open(srv_log, encoding="utf-8") as f:
+                    out = f.read()
+                why = "died" if srv.poll() is not None else "never came up"
+                raise SystemExit(f"server {why}:\n{out[-3000:]}")
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2
+                ) as r:
+                    h = json.loads(r.read())
+                    if h["status"] in ("healthy", "degraded"):
+                        break
+            except (OSError, ValueError):
+                pass
+            time.sleep(2)
+        leg["startup_s"] = round(time.time() - t_start, 1)
+        leg["backend"] = h.get("backend")
+
+        prompt = "The quick brown fox jumps over the lazy dog. " * 4
+        kw = dict(prompt=prompt, max_tokens=max_tokens, greedy=True,
+                  chat=False)
+        # cold request: compiles the prefill bucket + decode program for
+        # this (prompt bucket, max_tokens) pair — every program the warm
+        # request will touch
+        cold = post(port, kw)
+        if cold.get("status") != "success":
+            raise SystemExit(f"cold request failed: {cold}")
+        leg["cold_ttft_s"] = cold.get("ttft_s")
+        warm = post(port, kw)
+        if warm.get("status") != "success":
+            raise SystemExit(f"warm request failed: {warm}")
+        leg["warm_ttft_s"] = warm.get("ttft_s")
+        # compile overhead = what the cold request paid that the warm one
+        # didn't (XLA compile + first-touch); warm TTFT is prefill compute
+        leg["compile_overhead_s"] = round(
+            float(cold.get("ttft_s", 0.0)) - float(warm.get("ttft_s", 0.0)), 3
+        )
+        n = int(warm.get("tokens_generated", 0))
+        elapsed = _elapsed_s(warm)
+        decode_s = max(elapsed - float(warm.get("ttft_s", 0.0)), 1e-9)
+        leg["warm_tokens_per_sec"] = float(warm.get("tokens_per_sec", 0.0))
+        leg["steady_tokens_per_sec"] = round(n / decode_s, 3)
+        leg["decode_s"] = round(decode_s, 2)
+        leg["tokens_generated"] = n
+        leg["prompt_tokens"] = warm.get("prompt_tokens")
+        # the SERVER's platform is what matters; read it off /workers
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/workers", timeout=60
+        ) as r:
+            workers = json.loads(r.read())
+        leg["stages"] = {
+            k: v for k, v in workers.items() if k != "detail"
+        }
+        leg["devices"] = [
+            d for s in workers.get("detail", []) for d in s.get("devices", [])
+        ]
+    finally:
+        srv.kill()
+        try:
+            srv.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        log_f.close()
+    return leg
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", choices=sorted(SCALES), default="1b")
     ap.add_argument("--pp", type=int, default=1)
     ap.add_argument("--quant", default=None, choices=[None, "int8", "int4"])
     ap.add_argument("--dtype", default=None, choices=[None, "float32", "bfloat16"])
-    ap.add_argument("--max-tokens", type=int, default=16)
+    # 64+ decode steps: enough to amortize per-request overhead so the
+    # steady-state decode rate is measurable separately from TTFT
+    # (round-4 review #3 — the 8-token artifact read as a regression
+    # because nothing separated compile from steady-state)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument(
+        "--int8", action="store_true",
+        help="add an int8 weight-quant leg (second server on the same store)",
+    )
     ap.add_argument("--work", default=None, help="scratch dir (default: mkdtemp)")
     ap.add_argument("--out", default=None, help="artifact JSON path")
     ap.add_argument("--keep", action="store_true", help="keep the work dir")
     args = ap.parse_args(argv)
 
+    if args.dtype is None and "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        # bf16 matmuls are EMULATED on CPU (per-op fp32 convert): the
+        # round-4 artifact's 0.07 tok/s came from serving the default
+        # bf16 store on a CPU host, ~3x under the fp32 decode rate the
+        # bench measures on the same hardware. On a CPU run convert to
+        # fp32 unless the caller explicitly asked otherwise; on TPU the
+        # bf16 default stands (that's what the MXU wants).
+        args.dtype = "float32"
     work = args.work or tempfile.mkdtemp(prefix=f"realweights_{args.scale}_")
     os.makedirs(work, exist_ok=True)
     hf_dir = os.path.join(work, "hf")
@@ -148,75 +279,21 @@ def main(argv=None) -> int:
         os.path.getsize(os.path.join(store, f)) for f in os.listdir(store)
     )
 
-    port = free_port()
-    cmd = [
-        sys.executable, "-m", "distributed_llm_inference_tpu.serving.server",
-        "--checkpoint", store, "--host", "127.0.0.1", "--port", str(port),
-        "--pp", str(args.pp),
-    ]
-    if args.quant:
-        cmd += ["--quant", args.quant]
-    print("⏳ serving:", " ".join(cmd))
-    t_start = time.time()
-    # log FILE, not a pipe: an undrained pipe filling with XLA/server logs
-    # would block the child before /health ever answers
-    srv_log = os.path.join(work, "server.log")
-    log_f = open(srv_log, "w", encoding="utf-8")
-    srv = subprocess.Popen(
-        cmd, cwd=REPO, stdout=log_f, stderr=subprocess.STDOUT, text=True,
-    )
     try:
-        deadline = time.time() + 900
-        while True:
-            if srv.poll() is not None or time.time() > deadline:
-                log_f.flush()
-                with open(srv_log, encoding="utf-8") as f:
-                    out = f.read()
-                why = "died" if srv.poll() is not None else "never came up"
-                raise SystemExit(f"server {why}:\n{out[-3000:]}")
-            try:
-                with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/health", timeout=2
-                ) as r:
-                    h = json.loads(r.read())
-                    if h["status"] in ("healthy", "degraded"):
-                        break
-            except (OSError, ValueError):
-                pass
-            time.sleep(2)
-        art["startup_s"] = round(time.time() - t_start, 1)
-        art["backend"] = h.get("backend")
-
-        prompt = "The quick brown fox jumps over the lazy dog. " * 4
-        kw = dict(prompt=prompt, max_tokens=args.max_tokens, greedy=True,
-                  chat=False)
-        cold = post(port, kw)
-        if cold.get("status") != "success":
-            raise SystemExit(f"cold request failed: {cold}")
-        art["cold_ttft_s"] = cold.get("ttft_s")
-        warm = post(port, kw)
-        art["warm_ttft_s"] = warm.get("ttft_s")
-        art["warm_tokens_per_sec"] = float(warm.get("tokens_per_sec", 0.0))
-        art["tokens_generated"] = warm.get("tokens_generated")
-        art["prompt_tokens"] = warm.get("prompt_tokens")
-        # the SERVER's platform is what matters; read it off /workers
-        with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/workers", timeout=60
-        ) as r:
-            workers = json.loads(r.read())
-        art["stages"] = {
-            k: v for k, v in workers.items() if k != "detail"
-        }
-        art["devices"] = [
-            d for s in workers.get("detail", []) for d in s.get("devices", [])
-        ]
+        leg = serve_and_measure(
+            work, store, args.pp, args.quant, args.max_tokens, tag="main"
+        )
+        art.update(leg)
+        if args.int8 and not args.quant:
+            # int8 leg: same store, second server with --quant int8 — the
+            # lever that halves weight bytes/token (decode's roofline)
+            art["int8"] = serve_and_measure(
+                work, store, args.pp, "int8", args.max_tokens, tag="int8"
+            )
     finally:
-        srv.kill()
-        try:
-            srv.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            pass
-        log_f.close()
+        # failure path included: a 1b-scale work dir holds several GB of
+        # HF checkpoint + converted store, and build/convert finish
+        # before serving — a failed health wait must not leak it
         if not args.keep and not args.work:
             import shutil
 
