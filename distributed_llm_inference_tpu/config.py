@@ -171,19 +171,12 @@ class ModelConfig:
                 f"attn_window_pattern must be 'all' or 'even', got "
                 f"{self.attn_window_pattern!r}"
             )
-        if self.attn_impl == "pallas" and (
-            self.attn_softcap is not None
-            or self.query_scale_override is not None
-            or self.attn_scale_override is not None
-            or (self.attn_window is not None and self.attn_window_pattern != "all")
-            or self.attn_window_layer_types is not None
-        ):
-            raise ValueError(
-                "attn_impl='pallas' does not support attention softcapping, "
-                "query/attention-scale overrides (Gemma-family query "
-                "scaling, Granite attention_multiplier), or per-layer "
-                "window patterns (Gemma-2); use attn_impl='xla'"
-            )
+        # attn_impl='pallas' is legal for every attention variant now: the
+        # chunk flash kernel (ops/flash_attention.py) takes softcap and
+        # scale overrides as static params and per-layer window patterns
+        # as a traced scalar-prefetch width. The PAGED decode kernel keeps
+        # a narrower surface — engine/paged.make_paged_hook gates it and
+        # falls back to the exact XLA gather path for configs outside it.
         if self.quant not in (None, "int8", "int4"):
             raise ValueError(
                 f"quant must be None, 'int8', or 'int4', got {self.quant!r}"
@@ -192,11 +185,10 @@ class ModelConfig:
             raise ValueError(
                 f"kv_quant must be None or 'int8', got {self.kv_quant!r}"
             )
-        if self.kv_quant is not None and self.arch != "llama":
-            raise ValueError(
-                "kv_quant is wired for the llama family (the hook seam in "
-                "models/llama.default_attn_hook); gpt2 keeps a raw cache"
-            )
+        # kv_quant rides the shared attn_hook seam (models/llama.
+        # default_attn_hook), which BOTH families route through now —
+        # gpt2's block adopted the hook in round 5, so the int8 cache
+        # (and the paged pool) apply to it unchanged.
         if self.rope_scaling not in (None, "llama3", "linear"):
             raise ValueError(
                 f"rope_scaling must be None, 'llama3', or 'linear', got "
@@ -347,13 +339,13 @@ class EngineConfig:
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":
     """Apply an --attn-impl request to a model config.
 
-    "xla" / "pallas": explicit (pallas validates its own restrictions in
-    __post_init__ — softcap, query-scale overrides, per-layer window
-    patterns reject loudly). "auto": pick the Pallas flash kernel
-    (ops/flash_attention.py) when it is legal for this model AND the
-    session is actually on a TPU backend — on CPU the kernel runs in
-    interpret mode, orders of magnitude slower than the XLA path, so auto
-    never selects it there. None: keep the config's own setting.
+    "xla" / "pallas": explicit. "auto": pick the Pallas flash kernel
+    (ops/flash_attention.py) when the session is actually on a TPU
+    backend — the chunk kernel covers every attention variant now
+    (softcap, scale overrides, per-layer window patterns), so legality no
+    longer constrains the choice; on CPU the kernel runs in interpret
+    mode, orders of magnitude slower than the XLA path, so auto never
+    selects it there. None: keep the config's own setting.
     """
     if requested is None:
         return cfg
@@ -369,11 +361,10 @@ def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelCon
     if jax.default_backend() != "tpu":
         return cfg.replace(attn_impl="xla")
     try:
-        # __post_init__ owns the capability knowledge: models needing a
-        # feature the kernel doesn't cover (gemma-2 softcap, per-layer
-        # window patterns, query-scale overrides) reject the replace and
-        # fall back to the XLA path. Both llama and gpt2 forwards dispatch
-        # on attn_impl (models/llama.py, models/gpt2.py:118).
+        # defense in depth: should a future config variant re-introduce a
+        # __post_init__ legality constraint on pallas, auto falls back to
+        # the XLA path instead of crashing. Both llama and gpt2 forwards
+        # dispatch on attn_impl (models/llama.py, models/gpt2.py:118).
         return cfg.replace(attn_impl="pallas")
     except ValueError:
         return cfg.replace(attn_impl="xla")

@@ -37,9 +37,9 @@ paged attention):
     position has run past their budget — masked garbage, never attended;
     the same stale-region argument as the dense fleet's).
 
-Paged mode is llama-family only (the hook seam lives in
-models/llama.decoder_layer; gpt2's learned-position block doesn't expose
-it). It runs on the single device AND on dp=1 pp/tp meshes: the pool
+Paged mode serves BOTH families: the hook seam is shared
+(models/llama.default_attn_hook; gpt2's block routes through it since
+round 5). It runs on the single device AND on dp=1 pp/tp meshes: the pool
 shards its layer axis over pp / kv heads over tp exactly like the dense
 cache (parallel/partition.pool_spec), the scratch→pool scatter is
 layer-local, and ungated ring microsteps redirect their block writes to
@@ -59,7 +59,6 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..models import llama
 from ..ops.attention import attend
 from ..ops.kv_quant import KVQuant
 from ..ops.kv_quant import dequantize as kv_dequantize
@@ -141,8 +140,10 @@ def make_paged_hook(table: jnp.ndarray):
     """
 
     def hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
-             valid_start):
+             valid_start, window_flag=None):
         del valid_start  # slots never left-pad
+        del window_flag  # mask (incl. mixed patterns) resolved per layer
+        # by decoder_layer before the hook; the XLA gather path uses it
         B, T, H, Dh = q.shape
         assert T == 1, "paged hook serves decode steps (T=1) only"
         bs = cache_k.shape[2]
@@ -181,16 +182,25 @@ def make_paged_hook(table: jnp.ndarray):
         else:
             new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
             new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
-        if cfg.attn_impl == "pallas":
+        paged_kernel_legal = (
+            cfg.attn_softcap is None
+            and cfg.query_scale_override is None
+            and cfg.attn_scale_override is None
+            and cfg.attn_window_layer_types is None
+            and (cfg.attn_window is None or cfg.attn_window_pattern == "all")
+        )
+        if cfg.attn_impl == "pallas" and paged_kernel_legal:
             # Fused Pallas paged attention (ops/paged_attention.py) for
             # BOTH leaf types: walks the table block by block with an
             # online softmax — no contiguous-view materialization, dead
             # blocks never leave HBM; int8 pools dequantize in the block
-            # prologue (half the bytes per live block). Legality (no
-            # softcap, no scale override, uniform-or-no window) is
-            # already enforced by ModelConfig.__post_init__, which is
-            # also why deriving the mask from pos + attn_window in-kernel
-            # is exact (the hook's `mask` carries nothing more).
+            # prologue (half the bytes per live block). The legality gate
+            # above (no softcap, no scale override, uniform-or-no window)
+            # used to live in ModelConfig.__post_init__; since the chunk
+            # flash kernel learned those features it is THIS kernel's
+            # alone, and illegal configs take the exact XLA gather path
+            # below instead — deriving the mask from pos + attn_window
+            # in-kernel is exact precisely because the gate passed.
             from ..ops.paged_attention import paged_flash_attend
 
             attn = paged_flash_attend(
@@ -250,15 +260,18 @@ def scatter_scratch(pool, scratch, table_row):
 
 
 def _forward_step_paged(cfg, params, tokens, pool, table, pos):
-    """One decode step through the stack over the paged pool."""
+    """One decode step through the stack over the paged pool (family-
+    dispatched: gpt2 rides the same hook seam)."""
+    from ..models import api as M
+
     bs = pool["k"].shape[3]
     MB = table.shape[1]
-    x = llama.embed(cfg, params, tokens, pos)
-    x, pool = llama.forward_layers(
+    x = M.embed(cfg, params, tokens, pos)
+    x, pool = M.forward_layers(
         cfg, params["layers"], x, pool, pos,
         attn_hook=make_paged_hook(table), attn_seq_len=MB * bs,
     )
-    logits = llama.unembed(cfg, params, x[:, -1:, :])
+    logits = M.unembed(cfg, params, x[:, -1:, :])
     return logits[:, 0, :], pool
 
 

@@ -154,13 +154,20 @@ def init_kv_cache(
 
 
 def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
-                      valid_start=None):
+                      valid_start=None, window_flag=None):
     """Cache write + attention for the dense (whole-cache-per-device) case.
 
     The hook seam lets SPMD backends swap the attention/cache strategy per
     topology without forking the block: parallel/context.py substitutes
     ring attention (prefill) and context-parallel merge (decode) here.
     Returns (attn [B,T,H,Dh], cache_k, cache_v).
+
+    window_flag: this layer's scalar from the stacked per-layer window
+    pattern (Gemma-2/3 alternating layers; None for uniform configs). The
+    XLA paths ignore it — their mask was already selected per layer in
+    decoder_layer — but the flash kernel derives its traced per-layer
+    window width from it (flash_attend's window_dyn scalar-prefetch
+    operand).
 
     pos may be a PER-ROW [B] vector (continuous batching: each slot at its
     own position) — the cache write becomes a vmapped per-row update and
@@ -176,6 +183,22 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     dispatches on the leaf type: quantize-on-write, dequantize into the
     attention matmuls on read. The fleet/solo split is the same.
     """
+    # mixed per-layer window patterns (window_flag only exists for them,
+    # models/llama.make_window_flags): the kernel's width becomes a TRACED
+    # per-layer scalar — windowed layers get cfg.attn_window, full layers
+    # get -1 (= full causal) — so one compiled kernel serves the whole scan
+    def _flash(q_, nk, nv):
+        wd, w = None, cfg.attn_window
+        if window_flag is not None:
+            wd = jnp.where(
+                window_flag > 0, jnp.int32(cfg.attn_window), jnp.int32(-1)
+            )
+            w = None
+        return flash_attend(
+            q_, nk, nv, pos, valid_start, wd, window=w,
+            scale=cfg.query_scale, softcap=cfg.attn_softcap,
+        )
+
     if isinstance(cache_k, KVQuant):
         upd = kv_update_slots if pos.ndim == 1 else kv_update
         new_k = upd(cache_k, k, pos, gate=update_gate)
@@ -185,9 +208,7 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
             # kernel dequantizes in its tile prologue, so the int8 cache
             # streams HALF the bytes the XLA dequant-then-attend path
             # materializes
-            attn = flash_attend(
-                q, new_k, new_v, pos, valid_start, window=cfg.attn_window
-            )
+            attn = _flash(q, new_k, new_v)
         else:
             attn = attend(
                 q, kv_dequantize(new_k), kv_dequantize(new_v), mask,
@@ -218,9 +239,7 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
         # than the einsum (per-step kernel overhead with no flops to
         # hide it under), so decode always takes the XLA path — this
         # gate is what makes "--attn-impl pallas/auto" strictly a win.
-        attn = flash_attend(
-            q, new_k, new_v, pos, valid_start, window=cfg.attn_window
-        )
+        attn = _flash(q, new_k, new_v)
     else:
         attn = attend(
             q, new_k, new_v, mask,
@@ -352,7 +371,8 @@ def decoder_layer(
 
     hook = attn_hook or default_attn_hook
     attn, new_k, new_v = hook(
-        cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate, valid_start
+        cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate, valid_start,
+        lp.get("window_flag"),
     )
     attn_out = mm(attn.reshape(B, T, H * Dh), lp["wo"])
     if tp_axis is not None:

@@ -54,19 +54,21 @@ def _needed_tiles(pos, qi, *, T: int, block_t: int, block_k: int):
     return pl.cdiv(pos + t_hi, block_k)
 
 
-def _first_tile(pos, qi, *, block_t: int, block_k: int, window):
+def _first_tile(pos, qi, *, block_t: int, block_k: int, win):
     """First KV tile any query in tile qi can see: with sliding-window
     attention the tile's EARLIEST query (pos + qi*block_t) bounds it at
-    q_pos - window + 1; full causal starts at 0."""
-    if window is None:
-        return jnp.int32(0)
-    lo = pos + qi * block_t - window + 1
-    return jnp.maximum(lo, 0) // block_k
+    q_pos - win + 1; full causal starts at 0. `win` is a TRACED scalar
+    (the 3rd scalar-prefetch operand): <= 0 means full causal — per-layer
+    window patterns (Gemma-2/3) feed a per-layer value from the scan, so
+    ONE compiled kernel serves windowed and full layers."""
+    lo = pos + qi * block_t - win + 1
+    return jnp.where(win > 0, jnp.maximum(lo, 0) // block_k, 0)
 
 
 def _flash_kernel(
     pos_ref,  # scalar-prefetch [1] int32
     vs_ref,  # scalar-prefetch [B] int32: per-row first valid slot
+    win_ref,  # scalar-prefetch [1] int32: sliding window (<= 0 = full)
     q_ref,  # [1, block_t, 1, group, Dh] VMEM
     k_ref,  # [1, 1, block_k, Dh] VMEM
     v_ref,  # [1, 1, block_k, Dh] VMEM
@@ -77,7 +79,7 @@ def _flash_kernel(
     block_k: int,
     group: int,
     scale: float,
-    window: int | None,
+    softcap: float | None,
     quant: bool = False,
 ):
     if quant:
@@ -91,6 +93,7 @@ def _flash_kernel(
         o_ref, m_ref, l_ref, acc_ref = rest
     pos = pos_ref[0]
     valid_from = vs_ref[pl.program_id(0)]
+    win = win_ref[0]
     qi = pl.program_id(2)
     j = pl.program_id(3)
     n_j = pl.num_programs(3)
@@ -98,7 +101,7 @@ def _flash_kernel(
     Dh = q_ref.shape[-1]
 
     needed = _needed_tiles(pos, qi, T=T, block_t=block_t, block_k=block_k)
-    first_live = _first_tile(pos, qi, block_t=block_t, block_k=block_k, window=window)
+    first_live = _first_tile(pos, qi, block_t=block_t, block_k=block_k, win=win)
 
     @pl.when(j == 0)
     def _():
@@ -122,11 +125,14 @@ def _flash_kernel(
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [rows, block_k]
+        if softcap is not None:  # Gemma-2 logit capping, pre-mask (HF order)
+            s = softcap * jnp.tanh(s / softcap)
         kv_pos = j * block_k + col_ids
         mask = (t_global < T) & (kv_pos <= q_pos) & (kv_pos < S)
         mask &= kv_pos >= valid_from  # left-pad slots (ragged batches)
-        if window is not None:  # sliding-window attention (Mistral-style)
-            mask &= kv_pos > q_pos - window
+        # sliding-window attention (win <= 0 = full causal; per-layer
+        # patterns pass this layer's width)
+        mask &= (win <= 0) | (kv_pos > q_pos - win)
         s = jnp.where(mask, s, _NEG)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -150,7 +156,9 @@ def _flash_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_t", "block_k", "interpret", "window")
+    jax.jit,
+    static_argnames=("block_t", "block_k", "interpret", "window", "scale",
+                     "softcap"),
 )
 def flash_attend(
     q: jnp.ndarray,
@@ -158,11 +166,14 @@ def flash_attend(
     cache_v,
     pos: jnp.ndarray,
     valid_start: jnp.ndarray | None = None,
+    window_dyn: jnp.ndarray | None = None,
     *,
     block_t: int = 0,
     block_k: int = 0,
     interpret: bool | None = None,
     window: int | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
 ) -> jnp.ndarray:
     """Causal GQA flash attention over the (already updated) cache.
 
@@ -172,10 +183,15 @@ def flash_attend(
     bytes. pos scalar int32 (chunk offset).
     valid_start: optional [B] int32 — first real slot per row (ragged
     LEFT-padded batches; earlier slots are never attended). window:
-    sliding-window attention width (None = full causal). Returns
-    [B,T,H,Dh] in q.dtype. Same contract as `attention.attend` with the
-    mask derived from `pos` (and `valid_start`/`window`) instead of
-    passed in.
+    static sliding-window width (None = full causal); window_dyn: TRACED
+    scalar override (<= 0 = full causal) — the window rides the kernel as
+    a scalar-prefetch operand, so per-layer patterns (Gemma-2/3
+    alternating layers) feed each scan step's width through ONE compiled
+    kernel. scale: score scale override (Gemma query scaling, Granite
+    attention_multiplier; None = head_dim**-0.5). softcap: Gemma-2 logit
+    capping. Returns [B,T,H,Dh] in q.dtype. Same contract as
+    `attention.attend` with the mask derived from `pos` (and
+    `valid_start`/window) instead of passed in.
     """
     from .kv_quant import KVQuant
 
@@ -203,24 +219,28 @@ def flash_attend(
     if valid_start is None:
         valid_start = jnp.zeros((B,), jnp.int32)
     valid_start = valid_start.astype(jnp.int32)
+    if window_dyn is None:
+        win_arr = jnp.full((1,), window if window is not None else -1, jnp.int32)
+    else:
+        win_arr = jnp.reshape(window_dyn.astype(jnp.int32), (1,))
 
     nt = _needed_tiles  # close over static tile params in the index maps
 
-    def kv_index(b, kv, qi, j, pos_ref, vs_ref):
+    def kv_index(b, kv, qi, j, pos_ref, vs_ref, win_ref):
         # Clamp dead tiles (past the causal frontier, or — with a sliding
         # window — before the window) to the nearest live one: the block
         # index repeats, so Pallas skips the DMA and dead grid steps cost
         # nothing. The kernel's pl.when gate skips their compute too.
         needed = nt(pos_ref[0], qi, T=T, block_t=block_t, block_k=block_k)
         first = _first_tile(
-            pos_ref[0], qi, block_t=block_t, block_k=block_k, window=window
+            pos_ref[0], qi, block_t=block_t, block_k=block_k, win=win_ref[0]
         )
         return (b, kv, jnp.clip(j, first, needed - 1), 0)
 
-    def kv_index_3(b, kv, qi, j, pos_ref, vs_ref):
+    def kv_index_3(b, kv, qi, j, pos_ref, vs_ref, win_ref):
         # the quant-scale operands [B, KV, S]: same clamped tile walk,
         # one rank down
-        return kv_index(b, kv, qi, j, pos_ref, vs_ref)[:3]
+        return kv_index(b, kv, qi, j, pos_ref, vs_ref, win_ref)[:3]
 
     kernel = functools.partial(
         _flash_kernel,
@@ -229,15 +249,15 @@ def flash_attend(
         block_t=block_t,
         block_k=block_k,
         group=group,
-        scale=Dh**-0.5,
-        window=window,
+        scale=scale if scale is not None else Dh**-0.5,
+        softcap=softcap,
         quant=quant,
     )
     rows = block_t * group
     in_specs = [
         pl.BlockSpec(
             (1, block_t, 1, group, Dh),
-            lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
+            lambda b, kv, qi, j, pos_ref, vs_ref, win_ref: (b, qi, kv, 0, 0),
         ),
         pl.BlockSpec((1, 1, block_k, Dh), kv_index),
         pl.BlockSpec((1, 1, block_k, Dh), kv_index),
@@ -252,12 +272,12 @@ def flash_attend(
         ]
         operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, KV, pl.cdiv(T, block_t), pl.cdiv(S, block_k)),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, block_t, 1, group, Dh),
-            lambda b, kv, qi, j, pos_ref, vs_ref: (b, qi, kv, 0, 0),
+            lambda b, kv, qi, j, pos_ref, vs_ref, win_ref: (b, qi, kv, 0, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((rows, 1), jnp.float32),
@@ -270,5 +290,5 @@ def flash_attend(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, T, KV, group, Dh), q.dtype),
         interpret=interpret,
-    )(pos_arr, valid_start, *operands)
+    )(pos_arr, valid_start, win_arr, *operands)
     return out.reshape(B, T, H, Dh)

@@ -84,21 +84,6 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"context parallelism is wired for the llama family (attn_hook "
                 f"seam); got arch={cfg.arch!r}"
             )
-        if cfg.attn_window_layer_types is not None or (
-            cfg.attn_window is not None and cfg.attn_window_pattern != "all"
-        ):
-            # UNIFORM windows (Mistral), softcap and query-scale overrides
-            # (Gemma-2) all thread into ring_attend/cp_decode_attend now;
-            # only PER-LAYER window patterns stay excluded — BOTH spellings
-            # (Gemma-3's layer-type list AND Gemma-2's pattern="even"), the
-            # same condition the pallas legality check uses — because the
-            # hooks build their masks from positions and cannot see which
-            # layer of the scan they serve (fail loudly, not silently wrong)
-            raise NotImplementedError(
-                "per-layer attention-window patterns (Gemma-2/3 alternating "
-                "layers) do not compose with context parallelism; uniform "
-                "windows, softcap and scale overrides do"
-            )
         if int(mesh.shape[AXIS_PP]) != 1:
             raise ValueError("ContextParallelBackend needs pp == 1 (no layer sharding)")
         self.sp = int(mesh.shape[AXIS_SP])
@@ -163,6 +148,12 @@ class ContextParallelBackend(SPMDBackendBase):
     supports_counts = True
     supports_bias = True
     supports_logprobs = True
+    # Ragged left-padded batches (round-4 review #5): valid_start rides the
+    # ring/ulysses/merge masks as a per-row floor on ABSOLUTE key positions
+    # (parallel/ring.py:_raggedize) — chunk offsets and slot tags are both
+    # absolute, so the queue-coalesced batched serving path shards over sp
+    # like any other batch.
+    supports_ragged = True
 
     def prefill(self, tokens, prompt_len, cache, key, sampling,
                 valid_start=None, presence=None, bias=None):
@@ -171,19 +162,19 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"prefill bucket {tokens.shape[1]} not divisible by sp={self.sp}; "
                 f"pick prefill_buckets that are multiples of the ring size"
             )
-        if valid_start is not None:
-            raise NotImplementedError(
-                f"{self.name} does not support ragged (valid_start) batches: "
-                f"the ring mask is built from contiguous chunk offsets"
-            )
+        ragged = valid_start is not None
         pres = presence is not None
         wb = bias is not None
-        fn = self._programs.get(("prefill", pres, wb))
+        fn = self._programs.get(("prefill", ragged, pres, wb))
         if fn is None:
-            fn = self._build_prefill_impl(with_presence=pres, with_bias=wb)
-            self._programs[("prefill", pres, wb)] = fn
+            fn = self._build_prefill_impl(
+                with_ragged=ragged, with_presence=pres, with_bias=wb
+            )
+            self._programs[("prefill", ragged, pres, wb)] = fn
         args = [self.shared, self.layers, tokens, prompt_len, cache, key,
                 sampling]
+        if ragged:
+            args.append(valid_start)
         if pres:
             args.append(presence)
         if wb:
@@ -191,19 +182,38 @@ class ContextParallelBackend(SPMDBackendBase):
         return fn(*args)
 
     # -- shared hook ---------------------------------------------------------
+    def _layer_window(self, window_flag):
+        """Per-layer effective window for the collective attention masks.
+
+        Uniform configs keep the static cfg.attn_window (None = full).
+        Mixed patterns (Gemma-2/3 — the stacked window_flag leaf exists
+        only for them) resolve to a TRACED per-layer width: windowed
+        layers take cfg.attn_window, full layers take an unreachably
+        large width, which the pure-arithmetic masks in parallel/ring.py
+        treat as no window at all."""
+        cfg = self.cfg
+        if window_flag is None or cfg.attn_window is None:
+            return cfg.attn_window
+        return jnp.where(
+            window_flag > 0, jnp.int32(cfg.attn_window), jnp.int32(1 << 30)
+        )
+
     def _make_ring_hook(self):
         """The prefill-phase attn_hook: sequence-parallel attention over
         the chunk (ring or ulysses) + local cache write at slot 0 —
         quantizing on write for int8 caches, with the quantized chunks +
         scales riding the collective. Shared by the prefill and scoring
-        programs."""
+        programs. valid_start (ragged left-padded batches) flows straight
+        into the collective attention's mask."""
         cfg = self.cfg
         prefill_attend = (
             ulysses_attend if self.sp_strategy == "ulysses" else ring_attend
         )
 
-        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None):
+        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None,
+                      window_flag=None):
             zero = jnp.int32(0)
+            win = self._layer_window(window_flag)
             if isinstance(ck, KVQuant):
                 # int8 cache: store quantized chunks, and attend over the
                 # quantized round-trip — ring_attend/ulysses_attend ship
@@ -217,7 +227,7 @@ class ContextParallelBackend(SPMDBackendBase):
                 attn = prefill_attend(
                     q, qk, qv, AXIS_SP, k_scale=sk, v_scale=sv,
                     scale=cfg.query_scale, softcap=cfg.attn_softcap,
-                    window=cfg.attn_window,
+                    window=win, valid_start=valid_start,
                 )
                 ck = KVQuant(
                     jax.lax.dynamic_update_slice(
@@ -238,7 +248,8 @@ class ContextParallelBackend(SPMDBackendBase):
                 return attn, ck, cv
             attn = prefill_attend(
                 q, k, v, AXIS_SP, scale=cfg.query_scale,
-                softcap=cfg.attn_softcap, window=cfg.attn_window,
+                softcap=cfg.attn_softcap, window=win,
+                valid_start=valid_start,
             )
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
             vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
@@ -325,65 +336,24 @@ class ContextParallelBackend(SPMDBackendBase):
         # prefill() consults, so the base-held self._prefill and the
         # memo entry are the same compiled object (the pp backend's
         # pattern)
-        fn = self._build_prefill_impl(with_presence=False, with_bias=False)
-        self._programs[("prefill", False, False)] = fn
+        fn = self._build_prefill_impl(
+            with_ragged=False, with_presence=False, with_bias=False
+        )
+        self._programs[("prefill", False, False, False)] = fn
         return fn
 
-    def _build_prefill_impl(self, *, with_presence: bool, with_bias: bool):
+    def _build_prefill_impl(self, *, with_ragged: bool = False,
+                            with_presence: bool, with_bias: bool):
         cfg = self.cfg
-
-        prefill_attend = (
-            ulysses_attend if self.sp_strategy == "ulysses" else ring_attend
-        )
-
-        def ring_hook(cfg_, q, k, v, ck, cv, pos, mask, gate, valid_start=None):
-            zero = jnp.int32(0)
-            if isinstance(ck, KVQuant):
-                # int8 cache: store quantized chunks, and attend over the
-                # quantized round-trip — ring_attend/ulysses_attend ship
-                # the int8 chunks + scales over ICI (~4x fewer bytes than
-                # rotating dequantized fp32) and dequantize at use, the
-                # exact values the dense kv_quant path attends (its hook
-                # reads the written cache), so cross-topology numerics
-                # stay consistent
-                qk, sk = quantize_chunk(k)
-                qv, sv = quantize_chunk(v)
-                attn = prefill_attend(
-                    q, qk, qv, AXIS_SP, k_scale=sk, v_scale=sv,
-                    scale=cfg.query_scale, softcap=cfg.attn_softcap,
-                    window=cfg.attn_window,
-                )
-                ck = KVQuant(
-                    jax.lax.dynamic_update_slice(
-                        ck.q, qk.transpose(0, 2, 1, 3), (zero,) * 4
-                    ),
-                    jax.lax.dynamic_update_slice(
-                        ck.s, sk.transpose(0, 2, 1), (zero,) * 3
-                    ),
-                )
-                cv = KVQuant(
-                    jax.lax.dynamic_update_slice(
-                        cv.q, qv.transpose(0, 2, 1, 3), (zero,) * 4
-                    ),
-                    jax.lax.dynamic_update_slice(
-                        cv.s, sv.transpose(0, 2, 1), (zero,) * 3
-                    ),
-                )
-                return attn, ck, cv
-            attn = prefill_attend(
-                q, k, v, AXIS_SP, scale=cfg.query_scale,
-                softcap=cfg.attn_softcap, window=cfg.attn_window,
-            )
-            kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
-            vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
-            ck = jax.lax.dynamic_update_slice(ck, kc, (zero, zero, zero, zero))
-            cv = jax.lax.dynamic_update_slice(cv, vc, (zero, zero, zero, zero))
-            return attn, ck, cv
+        ring_hook = self._make_ring_hook()
 
         def body(shared, layers, tokens, prompt_len, cache, key, sampling,
                  *extra):
             i = 0
-            presence = bias = None
+            valid_start = presence = bias = None
+            if with_ragged:
+                valid_start = extra[i]
+                i += 1
             if with_presence:
                 presence = extra[i]
                 i += 1
@@ -401,10 +371,15 @@ class ContextParallelBackend(SPMDBackendBase):
                 cfg, layers, x, {"k": cache["k"], "v": cache["v"]},
                 jnp.asarray(chunk_start, jnp.int32),
                 tp_axis=self.tp_axis, attn_hook=ring_hook,
+                valid_start=valid_start,
             )
 
             # slot bookkeeping: slots [0,Tc) hold this chunk's positions,
-            # pad positions (>= prompt_len) stay invalid
+            # pad positions (>= prompt_len) stay invalid. Ragged batches
+            # keep their LEFT-pad slots tagged (prompt_len = bucket): the
+            # tags are shared across rows, and per-row pad slots are
+            # masked at attention time by valid_start (parallel/ring.py),
+            # mirroring the dense ragged_causal_mask contract.
             lpos = chunk_start + jnp.arange(Tc, dtype=jnp.int32)
             pos_ids = jnp.full((1, Sc), -1, jnp.int32)
             pos_ids = pos_ids.at[0, :Tc].set(jnp.where(lpos < prompt_len, lpos, -1))
@@ -431,6 +406,8 @@ class ContextParallelBackend(SPMDBackendBase):
             self._shared_specs, self._layer_specs, P(AXIS_DP, AXIS_SP),
             P(), cache_specs, P(), P(),
         ]
+        if with_ragged:
+            specs.append(P(AXIS_DP))  # valid_start [B] shards with the batch
         if with_presence:
             specs.append(P(AXIS_DP))
         if with_bias:
@@ -448,19 +425,22 @@ class ContextParallelBackend(SPMDBackendBase):
     def _build_decode(self, max_steps: int, with_presence: bool = False):
         return self._build_decode_any(max_steps, with_presence=with_presence)
 
+    def _build_decode_ragged(self, max_steps: int, with_presence: bool = False):
+        return self._build_decode_any(
+            max_steps, with_ragged=True, with_presence=with_presence
+        )
+
     def _build_decode_full(self, max_steps: int, *, ragged: bool,
                            with_presence: bool, with_bias: bool,
                            with_logprobs: bool, with_counts: bool = False):
-        if ragged:
-            raise NotImplementedError(
-                f"{self.name} does not support ragged (valid_start) batches"
-            )
         return self._build_decode_any(
-            max_steps, with_presence=with_presence, with_counts=with_counts,
-            with_bias=with_bias, with_logprobs=with_logprobs,
+            max_steps, with_ragged=ragged, with_presence=with_presence,
+            with_counts=with_counts, with_bias=with_bias,
+            with_logprobs=with_logprobs,
         )
 
-    def _build_decode_any(self, max_steps: int, *, with_presence: bool = False,
+    def _build_decode_any(self, max_steps: int, *, with_ragged: bool = False,
+                          with_presence: bool = False,
                           with_counts: bool = False, with_bias: bool = False,
                           with_logprobs: bool = False):
         from ..engine.generate import count_update, presence_update
@@ -470,7 +450,10 @@ class ContextParallelBackend(SPMDBackendBase):
         def body(shared, layers, first_token, cache, start_pos, limit, key,
                  sampling, *extra):
             i = 0
-            presence0 = counts0 = bias = None
+            valid_start = presence0 = counts0 = bias = None
+            if with_ragged:
+                valid_start = extra[i]
+                i += 1
             if with_presence:
                 presence0 = extra[i]
                 i += 1
@@ -513,7 +496,8 @@ class ContextParallelBackend(SPMDBackendBase):
                 pids2 = jax.lax.dynamic_update_slice(pids, new_id, (0, slot))
 
                 def cp_hook(cfg_, q, k, v, ck_l, cv_l, pos_, mask, gate,
-                            valid_start=None):
+                            vs=None, window_flag=None):
+                    win = self._layer_window(window_flag)
                     if isinstance(ck_l, KVQuant):
                         # int8 cache: quantize the token, write data +
                         # scale owner-gated, attend over the locally
@@ -536,14 +520,14 @@ class ContextParallelBackend(SPMDBackendBase):
                             pids2[0], pos_, AXIS_SP,
                             scale=cfg.query_scale,
                             softcap=cfg.attn_softcap,
-                            window=cfg.attn_window,
+                            window=win, valid_start=vs,
                         )
                         return attn, ck_l, cv_l
                     ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner)
                     attn = cp_decode_attend(
                         q, ck_l, cv_l, pids2[0], pos_, AXIS_SP,
                         scale=cfg.query_scale, softcap=cfg.attn_softcap,
-                        window=cfg.attn_window,
+                        window=win, valid_start=vs,
                     )
                     return attn, ck_l, cv_l
 
@@ -551,6 +535,7 @@ class ContextParallelBackend(SPMDBackendBase):
                 x, kv = M.forward_layers(
                     cfg, layers, x, {"k": ck, "v": cv}, pos,
                     tp_axis=self.tp_axis, attn_hook=cp_hook,
+                    valid_start=valid_start,
                 )
                 logits = M.unembed(cfg, shared, x[:, -1:, :])[:, 0, :]
                 key, sub = jax.random.split(key)
@@ -616,6 +601,8 @@ class ContextParallelBackend(SPMDBackendBase):
             self._shared_specs, self._layer_specs, P(AXIS_DP), cache_specs,
             P(), P(), P(), P(),
         ]
+        if with_ragged:
+            specs.append(P(AXIS_DP))  # valid_start [B] shards with the batch
         if with_presence:
             specs.append(P(AXIS_DP))
         if with_counts:

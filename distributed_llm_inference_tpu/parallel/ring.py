@@ -45,6 +45,25 @@ def _gqa_scores(q: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
     )
 
 
+def _bc(mask: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast a [T, Tk] (shared) or [B, T, Tk] (ragged, per-row) mask
+    over score shape [B, KV, G, T, Tk]."""
+    return mask[None, None, None] if mask.ndim == 2 else mask[:, None, None]
+
+
+def _raggedize(mask: jnp.ndarray, kv_pos: jnp.ndarray,
+               valid_start: jnp.ndarray | None) -> jnp.ndarray:
+    """Fold a per-row first-valid-position (left-padded ragged batches,
+    ops/attention.ragged_causal_mask semantics) into a shared [T, Tk]
+    position mask, giving [B, T, Tk]. kv_pos are ABSOLUTE positions, the
+    same coordinate valid_start is expressed in."""
+    if valid_start is None:
+        return mask
+    return mask[None] & (
+        kv_pos[None, None, :] >= valid_start[:, None, None]
+    )
+
+
 def ring_attend(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -56,6 +75,7 @@ def ring_attend(
     scale: float | None = None,
     softcap: float | None = None,
     window: int | None = None,
+    valid_start: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Causal ring attention on sequence-sharded Q/K/V chunks.
 
@@ -70,6 +90,10 @@ def ring_attend(
     int8 + one fp32 scale per (token, head) (~4x fewer ICI bytes than
     rotating the dequantized fp32 chunks), and dequant happens at use,
     where the scores einsum upcasts to fp32 anyway.
+    valid_start [B] int32 (ragged left-padded batches): keys at absolute
+    positions < valid_start[b] are row-b padding and masked out — the
+    mask gains a batch dim, nothing else changes (pad QUERY rows produce
+    all-masked scores and are already guarded by the l==0 floor).
     """
     sp = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -94,13 +118,14 @@ def ring_attend(
         mask = kv_pos[None, :] <= q_pos[:, None]  # [Tc, Tc_k]
         if window is not None:  # uniform sliding window (Mistral-style)
             mask &= kv_pos[None, :] > q_pos[:, None] - window
+        mask = _raggedize(mask, kv_pos, valid_start)
         scores = _gqa_scores(qg, deq(kc, ksc))  # [B,KV,G,Tc,Tc]
         if softcap is not None:  # Gemma-2 logit capping, pre-mask (HF order)
             scores = softcap * jnp.tanh(scores / softcap)
-        scores = jnp.where(mask[None, None, None], scores, _NEG)
+        scores = jnp.where(_bc(mask), scores, _NEG)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
-        p = jnp.where(mask[None, None, None], p, 0.0)
+        p = jnp.where(_bc(mask), p, 0.0)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
@@ -145,6 +170,7 @@ def ulysses_attend(
     scale: float | None = None,
     softcap: float | None = None,
     window: int | None = None,
+    valid_start: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Ulysses-style (DeepSpeed) sequence parallelism: two all-to-alls
     instead of a ring.
@@ -212,13 +238,14 @@ def ulysses_attend(
         mask = kv_pos[None, :] <= q_pos[:, None]  # [T, Tc]
         if window is not None:
             mask &= kv_pos[None, :] > q_pos[:, None] - window
+        mask = _raggedize(mask, kv_pos, valid_start)
         scores = _gqa_scores(qg, kc)  # [B,KVl,G,T,Tc]
         if softcap is not None:
             scores = softcap * jnp.tanh(scores / softcap)
-        scores = jnp.where(mask[None, None, None], scores, _NEG)
+        scores = jnp.where(_bc(mask), scores, _NEG)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
         p = jnp.exp(scores - m_new)
-        p = jnp.where(mask[None, None, None], p, 0.0)
+        p = jnp.where(_bc(mask), p, 0.0)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
@@ -247,6 +274,7 @@ def cp_decode_attend(
     scale: float | None = None,
     softcap: float | None = None,
     window: int | None = None,
+    valid_start: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Decode attention over a context-sharded KV cache.
 
@@ -257,6 +285,9 @@ def cp_decode_attend(
 
     q [B,T,H,Dh] (replicated over sp), cache_k/v [B,KV,Sc,Dh],
     pos_ids [Sc], pos scalar int32 -> [B,T,H,Dh] (replicated over sp).
+    valid_start [B] int32 (ragged left-padded batches): slots tagged with
+    absolute positions < valid_start[b] hold row-b padding and are masked
+    for that row — pos_ids carry exactly the coordinate needed.
     """
     B, T, H, Dh = q.shape
     KV, Sc = cache_k.shape[1], cache_k.shape[2]
@@ -271,15 +302,16 @@ def cp_decode_attend(
     mask = (pos_ids >= 0)[None, :] & (pos_ids[None, :] <= q_abs[:, None])  # [T, Sc]
     if window is not None:  # slot tags carry absolute positions: windowing
         mask &= pos_ids[None, :] > q_abs[:, None] - window
+    mask = _raggedize(mask, pos_ids, valid_start)
     scores = jnp.einsum(
         "btkgd,bksd->bkgts", qg, cache_k.astype(jnp.float32)
     )
     if softcap is not None:
         scores = softcap * jnp.tanh(scores / softcap)
-    scores = jnp.where(mask[None, None, None], scores, _NEG)
+    scores = jnp.where(_bc(mask), scores, _NEG)
     m_loc = jnp.max(scores, axis=-1, keepdims=True)  # [B,KV,G,T,1]
     p = jnp.exp(scores - m_loc)
-    p = jnp.where(mask[None, None, None], p, 0.0)
+    p = jnp.where(_bc(mask), p, 0.0)
     l_loc = jnp.sum(p, axis=-1, keepdims=True)
     acc_loc = jnp.einsum("bkgts,bksd->bkgtd", p, cache_v.astype(jnp.float32))
 

@@ -295,19 +295,107 @@ def test_sp_softcap_and_scale_override_match_single_device(eight_devices):
     assert a["response"] == b["response"]
 
 
-def test_sp_per_layer_window_pattern_still_rejected(eight_devices):
-    from distributed_llm_inference_tpu import MeshConfig, get_model_config
-    from distributed_llm_inference_tpu.runtime import create_backend
-
-    cfg = get_model_config("test-gemma3-tiny")
-    assert cfg.attn_window_layer_types is not None
-    with pytest.raises(NotImplementedError, match="per-layer"):
-        create_backend(cfg, mesh_cfg=MeshConfig(sp=2))
-    # Gemma-2's SPELLING of the same pattern (attn_window_pattern="even")
-    # must reject too — caught by review: it previously slipped the guard
-    # and would have served odd (full-attention) layers windowed
-    cfg2 = get_model_config("test-llama-tiny").replace(
-        attn_window=8, attn_window_pattern="even"
+@pytest.mark.parametrize("name", ["test-gemma2-tiny", "test-gemma3-tiny"])
+def test_sp_per_layer_window_pattern_matches_single_device(
+    eight_devices, name
+):
+    """Round-5: per-layer window patterns — BOTH spellings (Gemma-2's
+    pattern='even', Gemma-3's layer-type list) — compose with context
+    parallelism: each layer's width reaches the ring/merge masks as a
+    traced scalar derived from the stacked window_flag leaf
+    (ContextParallelBackend._layer_window). Greedy tokens must match the
+    single-device path, windows binding (attn_window < prompt)."""
+    cfg = get_model_config(name, eos_token_id=-1).replace(attn_window=4)
+    params = M.init_params(cfg, jax.random.PRNGKey(2))
+    bucket, plen, steps, max_seq = 16, 13, 6, 48
+    rng = np.random.default_rng(9)
+    ids = rng.integers(3, cfg.vocab_size, size=(1, plen))
+    tokens = jnp.asarray(
+        np.pad(ids, ((0, 0), (0, bucket - plen)),
+               constant_values=cfg.pad_token_id),
+        jnp.int32,
     )
-    with pytest.raises(NotImplementedError, match="per-layer"):
-        create_backend(cfg2, mesh_cfg=MeshConfig(sp=2))
+
+    ref = _run(SingleDeviceBackend(cfg, params), cfg, tokens, plen, steps, max_seq)
+    mesh = build_mesh(MeshConfig(sp=2), jax.devices())
+    got = _run(
+        ContextParallelBackend(cfg, params, mesh), cfg, tokens, plen, steps,
+        max_seq,
+    )
+    np.testing.assert_allclose(got[1], ref[1], rtol=1e-4, atol=1e-4)
+    assert got[0].tolist() == ref[0].tolist()
+    assert got[2].tolist() == ref[2].tolist()
+    assert got[3].tolist() == ref[3].tolist()
+
+
+@pytest.mark.parametrize("strategy,sp", [("ring", 4), ("ulysses", 2)])
+def test_sp_ragged_batch_matches_single_device(eight_devices, strategy, sp):
+    """Round-5: ragged (left-padded, per-row valid_start) batches ride the
+    sp backends — valid_start flows through the ring/ulysses prefill masks
+    and the cp decode slot mask as a per-row floor on absolute positions,
+    so the queue-coalesced batched serving path shards over sp."""
+    cfg = get_model_config("test-llama-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    bucket, steps, max_seq = 16, 6, 48
+    row_lens = [9, 16, 12, 5]
+    rng = np.random.default_rng(4)
+    rows = []
+    for n in row_lens:
+        ids = rng.integers(3, cfg.vocab_size, size=n)
+        rows.append(
+            np.concatenate([np.full(bucket - n, cfg.pad_token_id), ids])
+        )
+    tokens = jnp.asarray(np.stack(rows), jnp.int32)
+    valid_start = jnp.asarray([bucket - n for n in row_lens], jnp.int32)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(7))
+
+    def run(backend):
+        cache = backend.init_cache(tokens.shape[0], max_seq)
+        first, logits, cache = backend.prefill(
+            tokens, jnp.int32(bucket), cache, kp, sampling,
+            valid_start=valid_start,
+        )
+        out, n_gen, _ = backend.decode(
+            first, cache, jnp.int32(bucket), jnp.int32(steps), kd, sampling,
+            valid_start, max_steps=steps,
+        )
+        return (np.asarray(first), np.asarray(logits), np.asarray(out),
+                np.asarray(n_gen))
+
+    ref = run(SingleDeviceBackend(cfg, params))
+    mesh = build_mesh(MeshConfig(sp=sp), jax.devices())
+    got = run(ContextParallelBackend(cfg, params, mesh, sp_strategy=strategy))
+    np.testing.assert_allclose(got[1], ref[1], rtol=1e-4, atol=1e-4)
+    assert got[0].tolist() == ref[0].tolist()
+    assert got[2].tolist() == ref[2].tolist()
+    assert got[3].tolist() == ref[3].tolist()
+
+
+def test_sp_generate_batch_matches_single_device(eight_devices):
+    """Engine-level: the queue-coalesced batched path (generate_batch)
+    serves on an sp mesh, row-identical to the single-device engine."""
+    from distributed_llm_inference_tpu import (
+        EngineConfig, MeshConfig, create_engine, get_model_config,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+
+    cfg = get_model_config("test-llama-tiny", eos_token_id=-1)
+    params = M.init_params(cfg, jax.random.PRNGKey(5))
+    ecfg = EngineConfig(prefill_buckets=(32, 64))
+    sd = InferenceEngine(cfg, params=params, engine_cfg=ecfg)
+    sp = create_engine(
+        cfg, mesh_cfg=MeshConfig(sp=2), params=params, engine_cfg=ecfg,
+    )
+    assert sp.backend.name == "context-parallel"
+    prompts = [
+        "the quick brown fox",
+        "hi",
+        "a much longer prompt with several words in it",
+    ]
+    a = sd.generate_batch(prompts, max_tokens=6, greedy=True, chat=False)
+    b = sp.generate_batch(prompts, max_tokens=6, greedy=True, chat=False)
+    assert a["status"] == b["status"] == "success", (a, b)
+    assert [r["response"] for r in a["results"]] == [
+        r["response"] for r in b["results"]
+    ]

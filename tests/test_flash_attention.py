@@ -135,20 +135,71 @@ def test_model_forward_pallas_ragged_batch():
     assert fp.tolist() == fx.tolist() and op.tolist() == ox.tolist()
 
 
-def test_pallas_rejects_scale_overrides():
-    """Every score-scale override must reject attn_impl='pallas' loudly —
-    the kernels hardcode Dh**-0.5 (a Granite attention_multiplier that
-    slipped through would score silently wrong)."""
-    from distributed_llm_inference_tpu import get_model_config
+def test_pallas_scale_softcap_window_dyn_match_xla():
+    """Round-5: the chunk kernel covers score-scale overrides (Gemma query
+    scaling, Granite attention_multiplier), Gemma-2 softcapping, and a
+    TRACED per-layer window width (window_dyn, the scalar-prefetch operand
+    mixed patterns feed from the scan) — each must match the XLA attend
+    exactly, and the dynamic-window spelling must match the static one."""
+    from distributed_llm_inference_tpu.ops.attention import causal_mask
 
-    base = get_model_config("test-llama-tiny")
-    for field, val in [
-        ("attn_softcap", 30.0),
-        ("query_scale_override", 256),
-        ("attn_scale_override", 0.0078125),
+    B, T, H, KV, Dh, S = 2, 8, 4, 2, 8, 24
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, T, H, Dh), jnp.float32)
+    ck = jax.random.normal(k2, (B, KV, S, Dh), jnp.float32)
+    cv = jax.random.normal(k3, (B, KV, S, Dh), jnp.float32)
+    pos = jnp.int32(5)
+    for W, sc, cap in [
+        (3, None, None),       # window only
+        (3, 0.3, 10.0),        # window + scale override + softcap
+        (None, 0.25, 5.0),     # full causal + overrides
     ]:
-        with pytest.raises(ValueError, match="pallas"):
-            base.replace(attn_impl="pallas", **{field: val})
+        ref = np.asarray(attend(
+            q, ck, cv, causal_mask(pos, T, S, W), scale=sc, softcap=cap
+        ))
+        got = np.asarray(flash_attend(
+            q, ck, cv, pos, window=W, scale=sc, softcap=cap, interpret=True
+        ))
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5, err_msg=str((W, sc, cap)))
+        got_dyn = np.asarray(flash_attend(
+            q, ck, cv, pos, None, jnp.int32(W if W else -1),
+            scale=sc, softcap=cap, interpret=True,
+        ))
+        np.testing.assert_allclose(got_dyn, ref, rtol=2e-5, atol=2e-5, err_msg=str((W, sc, cap)))
+
+
+@pytest.mark.parametrize("name", ["test-gemma2-tiny", "test-gemma3-tiny"])
+def test_pallas_mixed_window_models_match_xla(name):
+    """Gemma-2 (softcap + even-pattern windows + query scaling) and
+    Gemma-3 (layer-type windows + dual RoPE) run under attn_impl='pallas':
+    per-layer widths ride the kernel's window_dyn operand, softcap and the
+    scale override are static kernel params. Prefill logits and greedy
+    decode must match the XLA path."""
+    from distributed_llm_inference_tpu import get_model_config
+    from distributed_llm_inference_tpu.engine import generate as G
+    from distributed_llm_inference_tpu.models import api as M
+
+    # window=4 so the sliding layers actually bind inside a 12-token prompt
+    cfg_x = get_model_config(name, eos_token_id=-1).replace(attn_window=4)
+    params = M.init_params(cfg_x, jax.random.PRNGKey(1))
+    tokens = jnp.asarray([[cfg_x.bos_token_id] + [7, 9, 11, 13, 5, 8] * 2], jnp.int32)
+    plen = jnp.int32(tokens.shape[1])
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(2))
+
+    def run(cfg):
+        cache = M.init_kv_cache(cfg, 1, max_seq=32)
+        first, logits, cache = G.prefill(cfg, params, tokens, plen, cache, kp, sampling)
+        out, n, _ = G.decode(
+            cfg, params, first, cache, plen, jnp.int32(4), kd, sampling,
+            max_steps=4,
+        )
+        return np.asarray(first), np.asarray(logits), np.asarray(out)
+
+    fx, lx, ox = run(cfg_x)
+    fp, lp_, op = run(cfg_x.replace(attn_impl="pallas"))
+    np.testing.assert_allclose(lp_, lx, rtol=1e-4, atol=1e-4)
+    assert fp.tolist() == fx.tolist() and op.tolist() == ox.tolist()
 
 
 @pytest.mark.slow
