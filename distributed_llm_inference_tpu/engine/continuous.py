@@ -162,9 +162,9 @@ class ContinuousEngine:
             if not getattr(engine.backend, "supports_paged", False):
                 raise ValueError(
                     f"backend {engine.backend.name!r} does not support "
-                    f"paged KV (llama family, single device or a dp=1 "
-                    f"pp/tp mesh); drop kv_pool_blocks or use the dense "
-                    f"fleet"
+                    f"paged KV (llama/gpt2 family, single device or a "
+                    f"dp=1 pp/tp mesh); drop kv_pool_blocks or use the "
+                    f"dense fleet"
                 )
             from . import paged as P
 

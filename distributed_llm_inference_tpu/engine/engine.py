@@ -153,11 +153,12 @@ class SingleDeviceBackend:
         )
 
     # block-paged KV for the continuous fleet (engine/paged.py): pool +
-    # block tables instead of n_slots x max_seq dense rows. Llama-family
-    # only (the attn_hook seam lives in llama.decoder_layer).
+    # block tables instead of n_slots x max_seq dense rows. Both families
+    # — the attn_hook seam the pool writes ride is shared (gpt2's block
+    # routes through llama.default_attn_hook since round 5).
     @property
     def supports_paged(self):
-        return self.cfg.arch == "llama"
+        return self.cfg.arch in ("llama", "gpt2")
 
     def init_paged_pool(self, n_blocks, block_size):
         from . import paged as P

@@ -33,14 +33,14 @@ def embed(cfg, params, tokens, pos=0):
 def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None,
                    attn_hook=None, valid_start=None, ep_axis=None,
                    attn_seq_len=None):
+    # Both families expose the same seams now: attn_hook (the shared
+    # attention/cache strategy hook — parallel/context.py, the paged
+    # pool), attn_seq_len (paged logical window). valid_start (ragged
+    # left-padding) and ep_axis (MoE) stay llama-only — gpt2's
+    # forward_layers rejects them loudly (learned absolute positions are
+    # not shift-invariant; no MoE blocks).
     if (attn_hook is not None or valid_start is not None
             or ep_axis is not None or attn_seq_len is not None):
-        # llama-family seams (attn_hook: parallel/context.py + the paged
-        # pool; valid_start: ragged left-padded batching; ep_axis: MoE
-        # expert parallelism; attn_seq_len: paged logical window).
-        # gpt2's block exposes none of these — learned absolute positions
-        # aren't shift-invariant, so left-padding is wrong there anyway —
-        # and callers have already checked the arch.
         return family(cfg).forward_layers(
             cfg, layers, x, cache, pos, update_gate, tp_axis, attn_hook,
             valid_start, ep_axis, attn_seq_len=attn_seq_len,

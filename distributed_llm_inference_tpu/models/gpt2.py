@@ -23,15 +23,9 @@ import jax
 import jax.numpy as jnp
 
 from ..config import ModelConfig
-from ..ops.attention import (
-    attend,
-    causal_mask,
-    slot_causal_mask,
-    update_kv_cache,
-    update_kv_cache_slots,
-)
-from ..ops.flash_attention import flash_attend
+from ..ops.attention import causal_mask, slot_causal_mask
 from ..ops.norms import layer_norm
+from ..ops.quant import matmul as mm
 
 Params = dict
 KVCache = dict
@@ -90,8 +84,16 @@ def init_kv_cache(
 
 
 def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
-                  tp_axis=None):
+                  tp_axis=None, attn_hook=None):
     """One GPT-2 block on chunk x [B,T,D] at offset pos.
+
+    Cache write + attention go through the SHARED hook seam
+    (models/llama.default_attn_hook — GPT-2 is MHA, i.e. GQA with
+    group=1, no window/softcap/scale override, so the default hook's
+    behavior is exactly the old inline path), which is what lets the
+    paged pool (engine/paged.make_paged_hook) and the int8 KV cache ride
+    GPT-2 the same way they ride llama. Projections go through ops/quant
+    `mm` so int8/int4 weight-only quantization applies transparently.
 
     Tensor parallelism mirrors models/llama.py: head-sliced qkv shards
     (with their per-output-column biases bq/bk/bv sharded alongside),
@@ -99,48 +101,54 @@ def decoder_layer(cfg, lp, x, cache_k, cache_v, pos, mask, update_gate=None,
     row-projection biases bo/b_proj are replicated and added once, OUTSIDE
     the psum (inside it they'd be added tp times).
     """
+    from .llama import default_attn_hook
+
     B, T, D = x.shape
     Dh = cfg.head_dim
     H = lp["wq"].shape[-1] // Dh
 
     h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
-    q = (h @ lp["wq"] + lp["bq"]).reshape(B, T, H, Dh)
-    k = (h @ lp["wk"] + lp["bk"]).reshape(B, T, H, Dh)
-    v = (h @ lp["wv"] + lp["bv"]).reshape(B, T, H, Dh)
+    q = (mm(h, lp["wq"]) + lp["bq"]).reshape(B, T, H, Dh)
+    k = (mm(h, lp["wk"]) + lp["bk"]).reshape(B, T, H, Dh)
+    v = (mm(h, lp["wv"]) + lp["bv"]).reshape(B, T, H, Dh)
 
-    if pos.ndim == 1:  # continuous-batching slots: per-row positions
-        new_k, new_v = update_kv_cache_slots(
-            cache_k, cache_v, k, v, pos, gate=update_gate
-        )
-        attn = attend(q, new_k, new_v, mask)
-    else:
-        new_k, new_v = update_kv_cache(cache_k, cache_v, k, v, pos, gate=update_gate)
-        if cfg.attn_impl == "pallas" and q.shape[1] > 1:
-            # T>1 chunks only — same policy (and measurements) as
-            # llama.default_attn_hook: flash wins prefill, loses decode
-            attn = flash_attend(q, new_k, new_v, pos)
-        else:
-            attn = attend(q, new_k, new_v, mask)
-    attn_out = attn.reshape(B, T, H * Dh) @ lp["wo"]
+    hook = attn_hook or default_attn_hook
+    attn, new_k, new_v = hook(
+        cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate, None, None
+    )
+    attn_out = mm(attn.reshape(B, T, H * Dh), lp["wo"])
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
     x = x + attn_out + lp["bo"]
 
     h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
-    mlp_out = gelu_new(h @ lp["w_fc"] + lp["b_fc"]) @ lp["w_proj"]
+    mlp_out = mm(gelu_new(mm(h, lp["w_fc"]) + lp["b_fc"]), lp["w_proj"])
     if tp_axis is not None:
         mlp_out = jax.lax.psum(mlp_out, tp_axis)
     x = x + mlp_out + lp["b_proj"]
     return x, new_k, new_v
 
 
-def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
+def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None,
+                   attn_hook=None, valid_start=None, ep_axis=None,
+                   attn_seq_len=None):
     """Scan the stacked GPT-2 blocks over a chunk (any contiguous slice).
     pos: scalar chunk offset, or a per-row [B] vector (continuous-batching
     slots — GPT-2 CAN slot-batch: unlike ragged left-padding, every slot
-    starts at position 0, so learned absolute positions stay exact)."""
+    starts at position 0, so learned absolute positions stay exact).
+    attn_hook: the shared attention/cache seam (paged pool, int8 cache);
+    attn_seq_len: paged logical mask length (see llama.forward_layers).
+    valid_start/ep_axis reject loudly: learned absolute positions are not
+    shift-invariant (no ragged left-padding), and GPT-2 has no MoE."""
+    if valid_start is not None:
+        raise NotImplementedError(
+            "gpt2 does not support ragged (valid_start) batches: learned "
+            "absolute position embeddings are not shift-invariant"
+        )
+    if ep_axis is not None:
+        raise NotImplementedError("gpt2 has no MoE layers (ep_axis)")
     T = x.shape[1]
-    S = cache["k"].shape[3]
+    S = attn_seq_len if attn_seq_len is not None else cache["k"].shape[3]
     pos = jnp.asarray(pos, jnp.int32)
     if pos.ndim == 1:
         mask = slot_causal_mask(pos, T, S)
@@ -151,7 +159,7 @@ def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None):
         xc = carry
         lp, ck, cv = xs
         xc, ck, cv = decoder_layer(cfg, lp, xc, ck, cv, pos, mask, update_gate,
-                                   tp_axis)
+                                   tp_axis, attn_hook)
         return xc, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (layers, cache["k"], cache["v"]))

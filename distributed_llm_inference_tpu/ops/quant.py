@@ -36,12 +36,13 @@ import jax.numpy as jnp
 
 from ..config import ModelConfig
 
-# llama-family stacked matmul weights eligible for quantization, and
-# whether their OUTPUT channels are the last axis (always true here:
-# weights are stored [L, in, out] / [in, out])
-_LLAMA_QUANT_KEYS = (
-    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-)
+# stacked matmul weights eligible for quantization, per family; OUTPUT
+# channels are the last axis for every one (weights are stored
+# [L, in, out] / [in, out]). Biases, norms, and embeddings stay dense.
+_QUANT_KEYS = {
+    "llama": ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"),
+    "gpt2": ("wq", "wk", "wv", "wo", "w_fc", "w_proj"),
+}
 
 
 @jax.tree_util.register_pytree_node_class
@@ -306,7 +307,8 @@ def expert_einsum(spec: str, x: jnp.ndarray, w) -> jnp.ndarray:
 
 def quantize_params(cfg: ModelConfig, params: dict, mode: str = None,
                     group: int = 64) -> dict:
-    """Quantize the llama-family matmul weights of a params pytree.
+    """Quantize the matmul weights of a params pytree (both families —
+    gpt2's projections go through the same quant-aware `mm`).
 
     mode: "int8" (per-output-channel scales) or "int4" (packed nibbles,
     group-wise scales — half the HBM bytes of int8 again); defaults to
@@ -314,10 +316,10 @@ def quantize_params(cfg: ModelConfig, params: dict, mode: str = None,
     and (when untied) the LM head; leaves embed / norms / biases
     untouched. Idempotent on already-quantized leaves.
     """
-    if cfg.arch != "llama":
+    if cfg.arch not in _QUANT_KEYS:
         raise NotImplementedError(
-            f"weight-only quantization is wired for the llama family; "
-            f"got arch={cfg.arch!r}"
+            f"weight-only quantization is wired for "
+            f"{sorted(_QUANT_KEYS)}; got arch={cfg.arch!r}"
         )
     mode = mode or cfg.quant or "int8"
     if mode not in ("int8", "int4"):
@@ -330,7 +332,7 @@ def quantize_params(cfg: ModelConfig, params: dict, mode: str = None,
         qfn = functools.partial(quantize_tensor4, group=group)
     out = dict(params)
     layers = dict(params["layers"])
-    for k in _LLAMA_QUANT_KEYS:
+    for k in _QUANT_KEYS[cfg.arch]:
         if k not in layers or isinstance(layers[k], (QTensor, Q4Tensor)):
             continue
         if layers[k].ndim == 3:

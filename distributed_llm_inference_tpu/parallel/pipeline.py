@@ -487,9 +487,11 @@ class PipelineBackend(SPMDBackendBase):
     @property
     def supports_paged(self) -> bool:
         """Paged slot decode on the pipeline mesh: same constraints as
-        dense slots (dp == 1 — slot rows are slots, not data shards) plus
-        the llama-family attn_hook seam the pool writes ride."""
-        return self.dp == 1 and self.cfg.arch == "llama"
+        dense slots (dp == 1 — slot rows are slots, not data shards).
+        Both families ride the shared attn_hook seam the pool writes use
+        (gpt2's block routes through llama.default_attn_hook since
+        round 5)."""
+        return self.dp == 1 and self.cfg.arch in ("llama", "gpt2")
 
     def init_paged_pool(self, n_blocks, block_size):
         from .partition import init_sharded_pool

@@ -75,13 +75,9 @@ def create_backend(
             "sp (context parallel) does not compose with pp/microbatching/"
             "ep yet: layer scans run whole-model per ring member"
         )
-    if cfg.quant is not None and cfg.arch != "llama":
-        # checked before params init (the expensive step), like the sp/dp
-        # guards around it
-        raise NotImplementedError(
-            f"weight-only quantization is wired for the llama family; "
-            f"got arch={cfg.arch!r}"
-        )
+    # weight quantization covers both families now (gpt2 projections go
+    # through the quant-aware mm — ops/quant._QUANT_KEYS); an unknown arch
+    # still rejects inside quantize_params before any params init cost
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
     if lora is not None:

@@ -145,8 +145,11 @@ def test_kv_quant_rejects_illegal_combos(raw_engine):
     cfg = get_model_config("test-llama-tiny")
     with pytest.raises(ValueError, match="kv_quant"):
         cfg.replace(kv_quant="fp8")
-    with pytest.raises(ValueError, match="llama"):
-        get_model_config("test-gpt2-tiny").replace(kv_quant="int8")
+    # gpt2 + kv_quant COMPOSES since round 5 (the shared attn_hook seam
+    # covers both families) — the replace must succeed
+    assert get_model_config(
+        "test-gpt2-tiny"
+    ).replace(kv_quant="int8").kv_quant == "int8"
     # kv_quant + pallas COMPOSES now (the flash kernel dequantizes int8
     # in its tile prologue) — the replace must succeed
     assert cfg.replace(kv_quant="int8", attn_impl="pallas").attn_impl == "pallas"
@@ -366,3 +369,43 @@ def test_sp_ring_kv_quant_matches_solo(raw_engine, eight_devices, strategy):
         g = sp.generate(prompt, greedy=True, chat=False, max_tokens=10)
         assert g["status"] == "success"
         assert g["response"] == w["response"]
+
+
+@pytest.mark.slow
+def test_gpt2_kv_quant_decode_close_to_raw_cache():
+    """Round-5: gpt2 rides the int8 KV cache through the SHARED attn_hook
+    seam (config.py no longer gates kv_quant to llama). Numeric pin for
+    the family-specific shapes (MHA group=1, both the solo kv_update path
+    and the fleet kv_update_slots path): teacher-forced forward over a
+    quantized cache stays close to the raw cache, and greedy decode + the
+    continuous fleet both serve."""
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import api as M
+
+    cfg = get_model_config("test-gpt2-tiny")
+    params = M.init_params(cfg, jax.random.PRNGKey(3))
+    qcfg = cfg.replace(kv_quant="int8")
+    tokens = jnp.asarray([[5, 9, 13, 17, 21, 25]], jnp.int32)
+    cache_r = M.init_kv_cache(cfg, 1, max_seq=32)
+    cache_q = M.init_kv_cache(qcfg, 1, max_seq=32)
+    assert isinstance(cache_q["k"], KQ.KVQuant)
+    lr, _ = M.forward(cfg, params, tokens, cache_r, jnp.int32(0))
+    lq, _ = M.forward(qcfg, params, tokens, cache_q, jnp.int32(0))
+    pr = np.asarray(jax.nn.log_softmax(lr[0, -1]), np.float64)
+    pq = np.asarray(jax.nn.log_softmax(lq[0, -1]), np.float64)
+    np.testing.assert_allclose(pq, pr, atol=0.15)
+
+    raw = InferenceEngine(cfg, params=params)
+    quant = InferenceEngine(qcfg, params=params)
+    out_r = raw.generate("a quick check", greedy=True, chat=False, max_tokens=8)
+    out_q = quant.generate("a quick check", greedy=True, chat=False, max_tokens=8)
+    assert out_q["status"] == "success"
+    assert out_q["tokens_generated"] == out_r["tokens_generated"]
+    # fleet path (kv_update_slots through the shared hook)
+    cont = ContinuousEngine(quant, n_slots=2, chunk_steps=4, slot_max_seq=96)
+    try:
+        got = cont.submit("a quick check", greedy=True, chat=False, max_tokens=8)
+    finally:
+        cont.close()
+    assert got["status"] == "success"
+    assert got["response"] == out_q["response"]

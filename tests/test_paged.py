@@ -27,9 +27,14 @@ PROMPTS = [
 ]
 
 
-@pytest.fixture(scope="module")
-def solo_engine():
-    cfg = get_model_config("test-llama-tiny")
+@pytest.fixture(
+    scope="module", params=["test-llama-tiny", "test-gpt2-tiny"]
+)
+def solo_engine(request):
+    # BOTH families: the paged pool rides the shared attn_hook seam
+    # (gpt2's block routes through llama.default_attn_hook since round
+    # 5), so every fleet-level test here runs against each
+    cfg = get_model_config(request.param)
     return InferenceEngine(
         cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64))
     )
