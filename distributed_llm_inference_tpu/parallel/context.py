@@ -21,8 +21,12 @@ devices splits the context:
 Engine-compatible (same init_cache/prefill/decode/health interface as
 SingleDeviceBackend / PipelineBackend); the cache pytree additionally
 carries `pos_ids` (absolute position per local slot, -1 = empty) and
-`fill` (per-device slot count). Composes with dp (batch shards) and tp
-(head shards); pp must be 1 — layer scans run whole-model per device.
+`fill` (per-device slot count). Composes with dp (batch shards), tp
+(head shards), and — since round 5 — pp: layers shard over the pipeline
+axis and prefill/decode run the pp backend's gated microstep ring with
+the sequence still sharded over sp (each stage's layer scan runs the
+ring/merge collectives on its local chunk; activations ppermute between
+stages; embed/lm_head take the vocab-sharded pp forms).
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ from ..ops.kv_quant import quantize_chunk
 from ..ops.sampling import sample_token
 from .mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
 from .pipeline import SPMDBackendBase
+from .vocab import embed_sharded, unembed_sharded
 from .ring import (
     cp_decode_attend,
     cp_kv_write,
@@ -53,6 +58,19 @@ from .ring import (
 # (its while_loop may exit at a different step), so its slot bookkeeping
 # diverges and must be dp-sharded, not replicated.
 _AUX_SPEC = P(AXIS_DP, AXIS_SP)
+
+
+def _gated(gate, new, old):
+    """Discard a cache write when this pp microstep isn't the stage's own
+    (the pipeline ring's update_gate contract — None means ungated, i.e.
+    pp == 1). KVQuant leaves gate data + scales together."""
+    if gate is None:
+        return new
+    if isinstance(new, KVQuant):
+        return KVQuant(
+            jnp.where(gate, new.q, old.q), jnp.where(gate, new.s, old.s)
+        )
+    return jnp.where(gate, new, old)
 
 
 def cp_cache_spec(cfg=None):
@@ -84,8 +102,6 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"context parallelism is wired for the llama family (attn_hook "
                 f"seam); got arch={cfg.arch!r}"
             )
-        if int(mesh.shape[AXIS_PP]) != 1:
-            raise ValueError("ContextParallelBackend needs pp == 1 (no layer sharding)")
         self.sp = int(mesh.shape[AXIS_SP])
         if self.sp < 2:
             raise ValueError("ContextParallelBackend needs sp >= 2")
@@ -103,8 +119,26 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"= {cfg.n_kv_heads // tp}) divisible by sp "
                 f"(use sp_strategy='ring')"
             )
+        pp = int(mesh.shape[AXIS_PP])
+        if pp > 1 and cfg.n_layers % pp:
+            # the sp cache builder stacks cfg.n_layers directly; the
+            # padded-layer-slot trick the dense pipeline uses
+            # (parallel/partition.pad_stacked_layers) is not threaded
+            # through the sp cache spec yet — fail loudly, not misaligned
+            raise NotImplementedError(
+                f"sp x pp needs n_layers ({cfg.n_layers}) divisible by "
+                f"pp ({pp}) for now (uneven stage splits pad layer slots, "
+                f"which the context-sharded cache does not model yet)"
+            )
         super().__init__(cfg, params, mesh)
-        self.n_stages = self.sp  # /workers reports context shards
+        # pp > 1 composes now (round-5): layers shard over pp exactly like
+        # the PipelineBackend (SPMDBackendBase.shard_params is mesh-
+        # driven), prefill/decode run the gated microstep ring over pp
+        # with the sp collectives INSIDE each stage's layer scan, and
+        # embed/lm_head switch to the vocab-sharded pp forms. /workers
+        # reports pipeline stages when there are several, context shards
+        # otherwise.
+        self.n_stages = self.pp if self.pp > 1 else self.sp
 
     # -- cache ---------------------------------------------------------------
     def local_slots(self, max_seq: int) -> int:
@@ -229,7 +263,7 @@ class ContextParallelBackend(SPMDBackendBase):
                     scale=cfg.query_scale, softcap=cfg.attn_softcap,
                     window=win, valid_start=valid_start,
                 )
-                ck = KVQuant(
+                ck_new = KVQuant(
                     jax.lax.dynamic_update_slice(
                         ck.q, qk.transpose(0, 2, 1, 3), (zero,) * 4
                     ),
@@ -237,7 +271,7 @@ class ContextParallelBackend(SPMDBackendBase):
                         ck.s, sk.transpose(0, 2, 1), (zero,) * 3
                     ),
                 )
-                cv = KVQuant(
+                cv_new = KVQuant(
                     jax.lax.dynamic_update_slice(
                         cv.q, qv.transpose(0, 2, 1, 3), (zero,) * 4
                     ),
@@ -245,7 +279,7 @@ class ContextParallelBackend(SPMDBackendBase):
                         cv.s, sv.transpose(0, 2, 1), (zero,) * 3
                     ),
                 )
-                return attn, ck, cv
+                return attn, _gated(gate, ck_new, ck), _gated(gate, cv_new, cv)
             attn = prefill_attend(
                 q, k, v, AXIS_SP, scale=cfg.query_scale,
                 softcap=cfg.attn_softcap, window=win,
@@ -253,9 +287,9 @@ class ContextParallelBackend(SPMDBackendBase):
             )
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
             vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
-            ck = jax.lax.dynamic_update_slice(ck, kc, (zero, zero, zero, zero))
-            cv = jax.lax.dynamic_update_slice(cv, vc, (zero, zero, zero, zero))
-            return attn, ck, cv
+            ck_new = jax.lax.dynamic_update_slice(ck, kc, (zero,) * 4)
+            cv_new = jax.lax.dynamic_update_slice(cv, vc, (zero,) * 4)
+            return attn, _gated(gate, ck_new, ck), _gated(gate, cv_new, cv)
 
         return ring_hook
 
@@ -269,6 +303,12 @@ class ContextParallelBackend(SPMDBackendBase):
         (the shared tail) runs identically everywhere. pos must be 0 —
         the ring hook writes at chunk offsets, not a running offset, so
         prompts longer than the largest bucket reject loudly."""
+        if self.pp > 1:
+            raise NotImplementedError(
+                f"{self.name} echo-scoring does not run on sp x pp meshes "
+                f"yet (the score program is whole-model per ring member); "
+                f"score on an sp-only or pp server"
+            )
         if int(pos) != 0:
             raise ValueError(
                 f"{self.name} scores single-bucket prompts only (chunked "
@@ -365,13 +405,22 @@ class ContextParallelBackend(SPMDBackendBase):
             Tc = tokens.shape[1]  # local chunk of the padded bucket
             Sc = cache["k"].shape[3]
             chunk_start = my * Tc
+            pos0 = jnp.asarray(chunk_start, jnp.int32)
+            PP = self.pp
 
-            x = M.embed(cfg, shared, tokens, chunk_start)
-            x, kv = M.forward_layers(
-                cfg, layers, x, {"k": cache["k"], "v": cache["v"]},
-                jnp.asarray(chunk_start, jnp.int32),
-                tp_axis=self.tp_axis, attn_hook=ring_hook,
-                valid_start=valid_start,
+            # embed/lm_head are vocab-sharded over pp (parallel/vocab.py;
+            # no-ops at pp == 1, where the local shard is the full table).
+            # The forward is the pipeline's gated microstep ring
+            # (SPMDBackendBase._microstep_loop) with the SEQUENCE still
+            # sharded over sp: each stage's layer scan runs the
+            # ring/ulysses collectives on its local chunk, the chunk
+            # activations ppermute between stages, and cache writes keep
+            # only the stage's own microstep (the gate threads into the
+            # ring hook's _gated writes). pp == 1 degenerates exactly.
+            x = embed_sharded(cfg, shared, tokens, pos0, PP)
+            kvc = {"k": cache["k"], "v": cache["v"]}
+            x, kv = self._microstep_loop(
+                layers, x, kvc, pos0, valid_start, attn_hook=ring_hook
             )
 
             # slot bookkeeping: slots [0,Tc) hold this chunk's positions,
@@ -385,13 +434,20 @@ class ContextParallelBackend(SPMDBackendBase):
             pos_ids = pos_ids.at[0, :Tc].set(jnp.where(lpos < prompt_len, lpos, -1))
             fill = jnp.clip(prompt_len - chunk_start, 0, Tc)[None, None]
 
-            # logits of the last prompt position live on one ring member;
-            # masked psum broadcasts them (same pattern as the pp backend)
+            # activations of the last prompt position live on ONE ring
+            # member (and, under pp, on stage 0 — the microstep ring's
+            # final shift lands the real output there); a masked psum over
+            # the owning axes broadcasts the [B, 1, D] slice, then the
+            # vocab-sharded unembed computes replicated logits
             li = prompt_len - 1 - chunk_start
             owner = (li >= 0) & (li < Tc)
             last = jax.lax.dynamic_slice_in_dim(x, jnp.clip(li, 0, Tc - 1), 1, axis=1)
-            logits_local = M.unembed(cfg, shared, last)[:, 0, :]
-            logits = jax.lax.psum(jnp.where(owner, logits_local, 0.0), AXIS_SP)
+            sel = owner & (jax.lax.axis_index(AXIS_PP) == 0)
+            last = jax.lax.psum(
+                jnp.where(sel, last, jnp.zeros((), last.dtype)),
+                (AXIS_SP, AXIS_PP),
+            )
+            logits = unembed_sharded(cfg, shared, last, PP)[:, 0, :]
             first = sample_token(
                 key, logits, *sampling, presence=presence, bias=bias
             )
@@ -412,8 +468,10 @@ class ContextParallelBackend(SPMDBackendBase):
             specs.append(P(AXIS_DP))
         if with_bias:
             specs.append(P())  # [V] bias replicates: logits are replicated
-        # shared specs name AXIS_PP on the vocab dims, but pp == 1 here so
-        # each "shard" is the full array and M.embed/M.unembed stay exact
+        # shared specs name AXIS_PP on the vocab dims: the bodies use the
+        # vocab-sharded embed/unembed forms (parallel/vocab.py), which
+        # psum/all_gather over pp when pp > 1 and see the full table as
+        # their "shard" when pp == 1 — exact either way
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
@@ -446,6 +504,7 @@ class ContextParallelBackend(SPMDBackendBase):
         from ..engine.generate import count_update, presence_update
 
         cfg, sp = self.cfg, self.sp
+        PP = self.pp
 
         def body(shared, layers, first_token, cache, start_pos, limit, key,
                  sampling, *extra):
@@ -498,6 +557,9 @@ class ContextParallelBackend(SPMDBackendBase):
                 def cp_hook(cfg_, q, k, v, ck_l, cv_l, pos_, mask, gate,
                             vs=None, window_flag=None):
                     win = self._layer_window(window_flag)
+                    # pp microstep ring: a stage only writes its cache on
+                    # its own microstep (gate); pp == 1 passes gate=None
+                    owner_w = owner if gate is None else (owner & gate)
                     if isinstance(ck_l, KVQuant):
                         # int8 cache: quantize the token, write data +
                         # scale owner-gated, attend over the locally
@@ -507,13 +569,13 @@ class ContextParallelBackend(SPMDBackendBase):
                         qk, sk = quantize_chunk(k)
                         qv, sv = quantize_chunk(v)
                         dq, dv_ = cp_kv_write(
-                            ck_l.q, cv_l.q, qk, qv, slot, owner
+                            ck_l.q, cv_l.q, qk, qv, slot, owner_w
                         )
                         ck_l = KVQuant(
-                            dq, cp_scale_write(ck_l.s, sk, slot, owner)
+                            dq, cp_scale_write(ck_l.s, sk, slot, owner_w)
                         )
                         cv_l = KVQuant(
-                            dv_, cp_scale_write(cv_l.s, sv, slot, owner)
+                            dv_, cp_scale_write(cv_l.s, sv, slot, owner_w)
                         )
                         attn = cp_decode_attend(
                             q, kv_dequantize(ck_l), kv_dequantize(cv_l),
@@ -523,7 +585,7 @@ class ContextParallelBackend(SPMDBackendBase):
                             window=win, valid_start=vs,
                         )
                         return attn, ck_l, cv_l
-                    ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner)
+                    ck_l, cv_l = cp_kv_write(ck_l, cv_l, k, v, slot, owner_w)
                     attn = cp_decode_attend(
                         q, ck_l, cv_l, pids2[0], pos_, AXIS_SP,
                         scale=cfg.query_scale, softcap=cfg.attn_softcap,
@@ -531,13 +593,25 @@ class ContextParallelBackend(SPMDBackendBase):
                     )
                     return attn, ck_l, cv_l
 
-                x = M.embed(cfg, shared, token[:, None], pos)
-                x, kv = M.forward_layers(
-                    cfg, layers, x, {"k": ck, "v": cv}, pos,
-                    tp_axis=self.tp_axis, attn_hook=cp_hook,
-                    valid_start=valid_start,
+                # the shared gated microstep ring (SPMDBackendBase.
+                # _microstep_loop; pp == 1 degenerates exactly): each
+                # stage's layers run the cp log-sum-exp merge over sp,
+                # cache writes keep owner & gate only; the real
+                # final-stage output lands on stage 0 and a masked psum
+                # broadcasts it (no-op at pp == 1)
+                x = embed_sharded(cfg, shared, token[:, None], pos, PP)
+                x, kv = self._microstep_loop(
+                    layers, x, {"k": ck, "v": cv}, pos, valid_start,
+                    attn_hook=cp_hook,
                 )
-                logits = M.unembed(cfg, shared, x[:, -1:, :])[:, 0, :]
+                x = jax.lax.psum(
+                    jnp.where(
+                        jax.lax.axis_index(AXIS_PP) == 0, x,
+                        jnp.zeros((), x.dtype),
+                    ),
+                    AXIS_PP,
+                )
+                logits = unembed_sharded(cfg, shared, x[:, -1:, :], PP)[:, 0, :]
                 key, sub = jax.random.split(key)
                 nxt = sample_token(
                     sub, logits, *sampling,
@@ -622,11 +696,22 @@ class ContextParallelBackend(SPMDBackendBase):
     # -- health --------------------------------------------------------------
     def health(self) -> list[dict]:
         """Context shards instead of pipeline stages: each 'worker' is one
-        ring member holding seq/sp of the KV cache."""
+        ring member holding seq/sp of the KV cache. On an sp x pp mesh
+        the pipeline stages are the workers (each stage's row spans its
+        sp ring members)."""
         from ..utils.probe import probe_device
 
         devs = self.mesh.devices  # [dp, pp, sp, tp]
         out = []
+        if self.pp > 1:
+            # the base sweep already does per-stage all-device concurrent
+            # probing with worst-status aggregation (a dead non-first
+            # device must not report healthy) and multi-process "remote"
+            # handling — reuse it, tagging the composed role
+            out = super().health()
+            for line in out:
+                line["role"] = "pipeline-stage+context-ring"
+            return out
         for s in range(self.sp):
             shard_devs = devs[:, :, s, :].reshape(-1)
             out.append(

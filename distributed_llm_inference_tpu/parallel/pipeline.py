@@ -222,6 +222,35 @@ class SPMDBackendBase:
             out.append(stage_line)
         return out
 
+    # -- the gated microstep ring — shared by every PipelineBackend
+    # program AND the sp x pp composition (parallel/context.py); pp == 1
+    # degenerates exactly (singleton-axis ppermute is a no-op and the
+    # gate is always True) -------------------------------------------------
+    def _microstep_loop(self, layers, x, cache, pos, valid_start=None,
+                        attn_hook=None, attn_seq_len=None):
+        """S microsteps of (apply local stage, ring-shift). Returns the
+        final-stage output (landed on stage 0 by the last shift) + cache.
+        attn_hook/attn_seq_len thread the paged-pool seam (cache = block
+        pool, hook = engine/paged.make_paged_hook) through the same gated
+        ring — one loop for the dense and paged cache strategies."""
+        cfg, S = self.cfg, self.pp
+        s = jax.lax.axis_index(AXIS_PP)
+        perm = _ring_perm(S)
+
+        def micro(i, carry):
+            buf, cache = carry
+            gate = i == s
+            y, cache = M.forward_layers(
+                cfg, layers, buf, cache, pos, update_gate=gate,
+                tp_axis=self.tp_axis, valid_start=valid_start,
+                ep_axis=self.ep_axis, attn_hook=attn_hook,
+                attn_seq_len=attn_seq_len,
+            )
+            buf = jax.lax.ppermute(y, AXIS_PP, perm)
+            return buf, cache
+
+        return jax.lax.fori_loop(0, S, micro, (x, cache))
+
     def _dp_key(self, key):
         """Decorrelate sampling across dp batch shards. dp=1 keeps the key
         untouched so the pipeline stays bit-identical to single-device."""
@@ -270,32 +299,6 @@ class PipelineBackend(SPMDBackendBase):
     supports_presence = True
     # OpenAI frequency/presence penalties (counts-tracked decode variants)
     supports_counts = True
-
-    # -- compiled programs --------------------------------------------------
-    def _microstep_loop(self, layers, x, cache, pos, valid_start=None,
-                        attn_hook=None, attn_seq_len=None):
-        """S microsteps of (apply local stage, ring-shift). Returns the
-        final-stage output (landed on stage 0 by the last shift) + cache.
-        attn_hook/attn_seq_len thread the paged-pool seam (cache = block
-        pool, hook = engine/paged.make_paged_hook) through the same gated
-        ring — one loop for the dense and paged cache strategies."""
-        cfg, S = self.cfg, self.pp
-        s = jax.lax.axis_index(AXIS_PP)
-        perm = _ring_perm(S)
-
-        def micro(i, carry):
-            buf, cache = carry
-            gate = i == s
-            y, cache = M.forward_layers(
-                cfg, layers, buf, cache, pos, update_gate=gate,
-                tp_axis=self.tp_axis, valid_start=valid_start,
-                ep_axis=self.ep_axis, attn_hook=attn_hook,
-                attn_seq_len=attn_seq_len,
-            )
-            buf = jax.lax.ppermute(y, AXIS_PP, perm)
-            return buf, cache
-
-        return jax.lax.fori_loop(0, S, micro, (x, cache))
 
     # -- chunked prefill (engine: prompts beyond the largest bucket) --------
     def extend(self, tokens, pos, cache):

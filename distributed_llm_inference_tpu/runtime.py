@@ -67,13 +67,16 @@ def create_backend(
             f"sp_strategy={sp_strategy!r} needs a context-parallel mesh "
             f"(sp > 1); got sp={mesh_cfg.sp}"
         )
-    if mesh_cfg.sp > 1 and (mesh_cfg.pp > 1 or microbatches > 1 or mesh_cfg.ep > 1):
+    if mesh_cfg.sp > 1 and (microbatches > 1 or mesh_cfg.ep > 1):
         # checked before params init (the expensive step) and before the
         # microbatch branch, which would otherwise claim the sp-wide mesh
-        # and silently replicate all work across it
+        # and silently replicate all work across it. sp x pp composes
+        # since round 5 (the context backend runs the gated microstep
+        # ring over pp with the sequence still sharded over sp).
         raise ValueError(
-            "sp (context parallel) does not compose with pp/microbatching/"
-            "ep yet: layer scans run whole-model per ring member"
+            "sp (context parallel) does not compose with microbatching/"
+            "ep yet: the 1F1B schedule and expert dispatch assume "
+            "whole-sequence activations per stage"
         )
     # weight quantization covers both families now (gpt2 projections go
     # through the quant-aware mm — ops/quant._QUANT_KEYS); an unknown arch
