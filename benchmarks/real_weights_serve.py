@@ -265,7 +265,9 @@ def main(argv=None) -> int:
     art["hf_build_s"] = round(time.time() - t0, 1)
 
     t0 = time.time()
-    if not os.path.exists(os.path.join(store, "config.json")):
+    # the store's marker is manifest.json (models/convert.py writes no
+    # config.json) — the old check re-converted on every --work reuse
+    if not os.path.exists(os.path.join(store, "manifest.json")):
         print("⏳ converting with models/convert.py")
         conv = [
             sys.executable, "-m", "distributed_llm_inference_tpu.models.convert",
