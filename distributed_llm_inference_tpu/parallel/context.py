@@ -294,7 +294,13 @@ class ContextParallelBackend(SPMDBackendBase):
         return ring_hook
 
     # -- teacher-forced scoring (OpenAI echo) --------------------------------
-    supports_score = True
+    @property
+    def supports_score(self) -> bool:
+        """Echo-scoring runs on sp-only meshes; on sp x pp the score
+        program is still whole-model per ring member, so the engine's
+        capability gate rejects it cleanly as invalid_request instead of
+        the call-time NotImplementedError surfacing as a 500."""
+        return self.pp == 1
 
     def score_chunk(self, tokens, pos, cache, *, top_n=0):
         """Single-chunk echo scoring on the ring: the chunk shards over
@@ -558,8 +564,10 @@ class ContextParallelBackend(SPMDBackendBase):
                             vs=None, window_flag=None):
                     win = self._layer_window(window_flag)
                     # pp microstep ring: a stage only writes its cache on
-                    # its own microstep (gate); pp == 1 passes gate=None
-                    owner_w = owner if gate is None else (owner & gate)
+                    # its own microstep. _microstep_loop always supplies
+                    # the traced (i == stage) gate — True everywhere at
+                    # pp == 1 — so the write keeps owner & gate, period.
+                    owner_w = owner & gate
                     if isinstance(ck_l, KVQuant):
                         # int8 cache: quantize the token, write data +
                         # scale owner-gated, attend over the locally

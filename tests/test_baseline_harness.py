@@ -6,7 +6,10 @@ import io
 import contextlib
 import sys
 
+import pytest
 
+
+@pytest.mark.slow  # re-tiered round 5: compiles all five config shapes
 def test_harness_runs_each_config_shape(capsys):
     sys.path.insert(0, "benchmarks")
     from benchmarks.run_baseline_configs import main

@@ -41,6 +41,8 @@ def _greedy_single(cfg, params, ids, steps, max_seq=64):
     return row
 
 
+@pytest.mark.slow  # re-tiered round 5 (fast-tier budget): the per-row
+# equivalence duplicates test_engine_generate_batch's coverage at 4x cost
 def test_ragged_batch_matches_individual_runs():
     cfg = get_model_config("test-llama-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))

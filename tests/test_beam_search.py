@@ -152,6 +152,7 @@ def test_beam_beats_or_equals_greedy_score():
         assert seq_logprob(beam_ids) >= seq_logprob(greedy_ids) - 1e-4
 
 
+@pytest.mark.slow  # re-tiered round 5 (fast-tier budget)
 def test_beam_engine_envelope():
     cfg = get_model_config("test-llama-tiny")
     eng = InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32,)))

@@ -167,6 +167,8 @@ def test_warmup_compiles_and_requests_stay_fast():
     assert _time.time() - t0 < 5.0
 
 
+@pytest.mark.slow  # re-tiered round 5: warmup compiles every batched
+# bucket — by far the heaviest engine test, covered daily by serving tests
 def test_warmup_covers_batched_programs():
     """Round-1 gap: the first batched request on a warmed server must not
     pay a compile — warmup pre-compiles the ragged (batch bucket x prefill

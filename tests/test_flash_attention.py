@@ -135,6 +135,8 @@ def test_model_forward_pallas_ragged_batch():
     assert fp.tolist() == fx.tolist() and op.tolist() == ox.tolist()
 
 
+@pytest.mark.slow  # re-tiered round 5: fast tier budget (4 min); the
+# mixed-window model tests below pin the same kernel features end to end
 def test_pallas_scale_softcap_window_dyn_match_xla():
     """Round-5: the chunk kernel covers score-scale overrides (Gemma query
     scaling, Granite attention_multiplier), Gemma-2 softcapping, and a
@@ -168,6 +170,7 @@ def test_pallas_scale_softcap_window_dyn_match_xla():
         np.testing.assert_allclose(got_dyn, ref, rtol=2e-5, atol=2e-5, err_msg=str((W, sc, cap)))
 
 
+@pytest.mark.slow  # re-tiered round 5: fast-tier budget
 @pytest.mark.parametrize("name", ["test-gemma2-tiny", "test-gemma3-tiny"])
 def test_pallas_mixed_window_models_match_xla(name):
     """Gemma-2 (softcap + even-pattern windows + query scaling) and

@@ -147,6 +147,7 @@ def test_quant_engine_on_pipeline_mesh(pp, eight_devices):
     assert r["status"] == "success", r
 
 
+@pytest.mark.slow  # re-tiered round 5 (fast-tier budget)
 @pytest.mark.parametrize("mode", ["int8", "int4"])
 def test_quant_gpt2_close_to_full_precision(mode):
     """Round-5: weight-only quantization covers gpt2 (projections route
