@@ -97,10 +97,15 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"sp_strategy must be 'ring' or 'ulysses', got {sp_strategy!r}"
             )
         self.sp_strategy = sp_strategy
-        if cfg.arch != "llama":
+        # Both families since round 5: gpt2's block routes through the
+        # shared attn_hook seam, its learned position rows are absolute
+        # (chunk offsets and slot tags are absolute positions, exactly
+        # what the ring/merge masks key on), and the vocab-sharded embed
+        # handles pos_embed. An arch without the seam still rejects.
+        if cfg.arch not in ("llama", "gpt2"):
             raise NotImplementedError(
-                f"context parallelism is wired for the llama family (attn_hook "
-                f"seam); got arch={cfg.arch!r}"
+                f"context parallelism needs the shared attn_hook seam "
+                f"(llama/gpt2 families); got arch={cfg.arch!r}"
             )
         self.sp = int(mesh.shape[AXIS_SP])
         if self.sp < 2:

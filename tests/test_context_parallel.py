@@ -94,14 +94,37 @@ def test_cp_backend_serving_engine():
     assert r["tokens_generated"] <= 5
 
 
-def test_cp_backend_rejects_gpt2_and_trivial_sp():
+@pytest.mark.parametrize("sp,pp", [(2, 1), (2, 2)])
+def test_gpt2_sp_matches_single_device(eight_devices, sp, pp):
+    """Round-5: gpt2 rides context parallelism through the shared
+    attn_hook seam (its learned position rows are absolute — exactly the
+    coordinate the ring/merge masks key on), alone and composed with
+    pp. Greedy tokens match the single-device path."""
     cfg = get_model_config("test-gpt2-tiny")
     params = M.init_params(cfg, jax.random.PRNGKey(0))
-    mesh = build_mesh(MeshConfig(sp=4), jax.devices())
-    with pytest.raises(NotImplementedError):
-        ContextParallelBackend(cfg, params, mesh)
+    bucket, plen, steps, max_seq = 16, 13, 6, 48
+    rng = np.random.default_rng(1)
+    ids = rng.integers(3, 250, size=(1, plen))
+    tokens = jnp.asarray(
+        np.pad(ids, ((0, 0), (0, bucket - plen)),
+               constant_values=cfg.pad_token_id),
+        jnp.int32,
+    )
+    ref = _run(SingleDeviceBackend(cfg, params), cfg, tokens, plen, steps, max_seq)
+    mesh = build_mesh(MeshConfig(sp=sp, pp=pp), jax.devices()[: sp * pp])
+    got = _run(
+        ContextParallelBackend(cfg, params, mesh), cfg, tokens, plen, steps,
+        max_seq,
+    )
+    np.testing.assert_allclose(got[1], ref[1], rtol=1e-4, atol=1e-4)
+    assert got[0].tolist() == ref[0].tolist()
+    assert got[2].tolist() == ref[2].tolist()
+
+
+def test_cp_backend_rejects_trivial_sp_and_bad_bucket():
     llama_cfg = get_model_config("test-llama-tiny")
     llama_params = M.init_params(llama_cfg, jax.random.PRNGKey(0))
+    mesh = build_mesh(MeshConfig(sp=4), jax.devices())
     with pytest.raises(ValueError, match="sp >= 2"):
         ContextParallelBackend(
             llama_cfg, llama_params, build_mesh(MeshConfig(sp=1), jax.devices())
@@ -401,8 +424,15 @@ def test_sp_generate_batch_matches_single_device(eight_devices):
     ]
 
 
-@pytest.mark.parametrize("mesh_kw", [dict(sp=2, pp=2), dict(sp=2, pp=2, tp=2)])
-def test_sp_pp_matches_single_device(eight_devices, mesh_kw):
+@pytest.mark.parametrize(
+    "mesh_kw,strategy",
+    [
+        (dict(sp=2, pp=2), "ring"),
+        (dict(sp=2, pp=2, tp=2), "ring"),
+        (dict(sp=2, pp=2), "ulysses"),
+    ],
+)
+def test_sp_pp_matches_single_device(eight_devices, mesh_kw, strategy):
     """Round-5: sp x pp composes — layers shard over pp (the gated
     microstep ring, activations ppermute between stages) while the
     sequence stays sharded over sp (ring prefill / log-sum-exp merge
@@ -424,8 +454,8 @@ def test_sp_pp_matches_single_device(eight_devices, mesh_kw):
     n_dev = 2 * 2 * mesh_kw.get("tp", 1)
     mesh = build_mesh(MeshConfig(**mesh_kw), jax.devices()[:n_dev])
     got = _run(
-        ContextParallelBackend(cfg, params, mesh), cfg, tokens, plen, steps,
-        max_seq,
+        ContextParallelBackend(cfg, params, mesh, sp_strategy=strategy),
+        cfg, tokens, plen, steps, max_seq,
     )
     np.testing.assert_allclose(got[1], ref[1], rtol=1e-4, atol=1e-4)
     assert got[0].tolist() == ref[0].tolist()
@@ -505,3 +535,43 @@ def test_sp_pp_uneven_layers_reject(eight_devices):
     mesh = build_mesh(MeshConfig(sp=2, pp=2), jax.devices()[:4])
     with pytest.raises(NotImplementedError, match="divisible"):
         ContextParallelBackend(cfg, params, mesh)
+
+
+@pytest.mark.parametrize("name", ["test-llama-tiny", "test-gpt2-tiny"])
+def test_sp_score_matches_single_device(eight_devices, name):
+    """Echo-scoring on the sp ring (both families): per-token logprobs of
+    a teacher-forced prompt match the single-device engine. On sp x pp
+    the capability gate rejects cleanly as invalid_request (the score
+    program is whole-model per ring member), not a 500."""
+    from distributed_llm_inference_tpu import (
+        EngineConfig, create_engine, get_model_config,
+    )
+    from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+    from distributed_llm_inference_tpu.models import api as M_
+
+    cfg = get_model_config(name)
+    params = M_.init_params(cfg, jax.random.PRNGKey(4))
+    ecfg = EngineConfig(prefill_buckets=(32,))
+    sd = InferenceEngine(cfg, params=params, engine_cfg=ecfg)
+    sp = create_engine(
+        cfg, mesh_cfg=MeshConfig(sp=2), params=params, engine_cfg=ecfg,
+    )
+    text = "the quick brown fox jumps"
+    a = sd.score(text)
+    b = sp.score(text)
+    assert a["status"] == b["status"] == "success", (a, b)
+    np.testing.assert_allclose(
+        np.asarray(b["token_logprobs"][1:], np.float64),
+        np.asarray(a["token_logprobs"][1:], np.float64),
+        atol=1e-4,
+    )
+
+    if cfg.arch == "llama":  # composed-mesh gate: one check suffices
+        spp = create_engine(
+            cfg, mesh_cfg=MeshConfig(sp=2, pp=2), params=params,
+            engine_cfg=ecfg,
+        )
+        assert spp.backend.supports_score is False
+        r = spp.score(text)
+        assert r["status"] == "failed"
+        assert r.get("error_type") == "invalid_request", r
