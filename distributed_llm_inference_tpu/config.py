@@ -171,12 +171,11 @@ class ModelConfig:
                 f"attn_window_pattern must be 'all' or 'even', got "
                 f"{self.attn_window_pattern!r}"
             )
-        # attn_impl='pallas' is legal for every attention variant now: the
-        # chunk flash kernel (ops/flash_attention.py) takes softcap and
-        # scale overrides as static params and per-layer window patterns
-        # as a traced scalar-prefetch width. The PAGED decode kernel keeps
-        # a narrower surface — engine/paged.make_paged_hook gates it and
-        # falls back to the exact XLA gather path for configs outside it.
+        # attn_impl='pallas' is legal for every attention variant now:
+        # BOTH kernels (the chunk flash kernel, ops/flash_attention.py,
+        # and the paged decode kernel, ops/paged_attention.py) take
+        # softcap and scale overrides as static params and per-layer
+        # window patterns as a traced scalar-prefetch width.
         if self.quant not in (None, "int8", "int4"):
             raise ValueError(
                 f"quant must be None, 'int8', or 'int4', got {self.quant!r}"

@@ -142,8 +142,9 @@ def make_paged_hook(table: jnp.ndarray):
     def hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
              valid_start, window_flag=None):
         del valid_start  # slots never left-pad
-        del window_flag  # mask (incl. mixed patterns) resolved per layer
-        # by decoder_layer before the hook; the XLA gather path uses it
+        # window_flag (mixed per-layer patterns): the XLA gather path
+        # ignores it — decoder_layer resolved `mask` per layer already —
+        # but the fused kernel derives its traced width from it below
         B, T, H, Dh = q.shape
         assert T == 1, "paged hook serves decode steps (T=1) only"
         bs = cache_k.shape[2]
@@ -182,29 +183,24 @@ def make_paged_hook(table: jnp.ndarray):
         else:
             new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
             new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
-        paged_kernel_legal = (
-            cfg.attn_softcap is None
-            and cfg.query_scale_override is None
-            and cfg.attn_scale_override is None
-            and cfg.attn_window_layer_types is None
-            and (cfg.attn_window is None or cfg.attn_window_pattern == "all")
-        )
-        if cfg.attn_impl == "pallas" and paged_kernel_legal:
+        if cfg.attn_impl == "pallas":
             # Fused Pallas paged attention (ops/paged_attention.py) for
             # BOTH leaf types: walks the table block by block with an
             # online softmax — no contiguous-view materialization, dead
             # blocks never leave HBM; int8 pools dequantize in the block
-            # prologue (half the bytes per live block). The legality gate
-            # above (no softcap, no scale override, uniform-or-no window)
-            # used to live in ModelConfig.__post_init__; since the chunk
-            # flash kernel learned those features it is THIS kernel's
-            # alone, and illegal configs take the exact XLA gather path
-            # below instead — deriving the mask from pos + attn_window
-            # in-kernel is exact precisely because the gate passed.
+            # prologue (half the bytes per live block). The full variant
+            # surface runs fused since round 5: softcap and scale
+            # overrides are static kernel params, and mixed per-layer
+            # window patterns feed this layer's width through the
+            # window_dyn scalar-prefetch operand (window_flag only
+            # exists for mixed configs — models/llama.make_window_flags).
+            from ..models.llama import kernel_window
             from ..ops.paged_attention import paged_flash_attend
 
+            w, wd = kernel_window(cfg, window_flag)
             attn = paged_flash_attend(
-                q, new_k, new_v, table, pos, window=cfg.attn_window
+                q, new_k, new_v, table, pos, wd, window=w,
+                scale=cfg.query_scale, softcap=cfg.attn_softcap,
             )
             return attn, new_k, new_v
 

@@ -137,6 +137,22 @@ def make_window_flags(cfg: ModelConfig) -> Optional[jnp.ndarray]:
     return (jnp.arange(L, dtype=jnp.int32) % 2 == 0).astype(jnp.float32)
 
 
+def kernel_window(cfg: ModelConfig, window_flag):
+    """Resolve this layer's window for the Pallas kernels: (static,
+    traced) where exactly one is live. Uniform configs keep the STATIC
+    cfg.attn_window; mixed patterns (window_flag is the layer's scalar
+    from the stacked make_window_flags leaf, only present for them)
+    yield a TRACED width — this layer's cfg.attn_window when flagged,
+    -1 (= full causal, the kernels' <= 0 sentinel) otherwise. The single
+    source of the flag -> width encoding for BOTH kernel hooks
+    (default_attn_hook's chunk flash and engine/paged's fused decode)."""
+    if window_flag is None:
+        return cfg.attn_window, None
+    return None, jnp.where(
+        window_flag > 0, jnp.int32(cfg.attn_window), jnp.int32(-1)
+    )
+
+
 def init_kv_cache(
     cfg: ModelConfig, batch: int, max_seq: Optional[int] = None, n_layers: Optional[int] = None
 ) -> KVCache:
@@ -183,17 +199,11 @@ def default_attn_hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
     dispatches on the leaf type: quantize-on-write, dequantize into the
     attention matmuls on read. The fleet/solo split is the same.
     """
-    # mixed per-layer window patterns (window_flag only exists for them,
-    # models/llama.make_window_flags): the kernel's width becomes a TRACED
-    # per-layer scalar — windowed layers get cfg.attn_window, full layers
-    # get -1 (= full causal) — so one compiled kernel serves the whole scan
+    # mixed per-layer window patterns (window_flag only exists for them):
+    # the kernel's width becomes a TRACED per-layer scalar via the shared
+    # kernel_window encoding, so one compiled kernel serves the whole scan
     def _flash(q_, nk, nv):
-        wd, w = None, cfg.attn_window
-        if window_flag is not None:
-            wd = jnp.where(
-                window_flag > 0, jnp.int32(cfg.attn_window), jnp.int32(-1)
-            )
-            w = None
+        w, wd = kernel_window(cfg, window_flag)
         return flash_attend(
             q_, nk, nv, pos, valid_start, wd, window=w,
             scale=cfg.query_scale, softcap=cfg.attn_softcap,

@@ -17,12 +17,13 @@ online-softmax (flash) accumulator, so
 
 Contract (matches `engine/paged.make_paged_hook`'s gather path):
   * decode only — T=1 queries at per-row positions `pos` [B];
-  * mask is derived IN-KERNEL from `pos` and the static `window`:
-    row b attends logical positions max(0, pos_b-window+1) .. pos_b
-    inclusive. `config.ModelConfig.__post_init__` guarantees this is the
-    whole mask whenever attn_impl="pallas" is legal (no softcap, no
-    query-scale override, no per-layer window patterns), which is why the
-    kernel never needs the hook's materialized mask.
+  * mask is derived IN-KERNEL from `pos` and the window — static, or a
+    TRACED per-layer width via the `window_dyn` scalar-prefetch operand
+    (Gemma-2/3 alternating patterns): row b attends logical positions
+    max(0, pos_b-win+1) .. pos_b inclusive. Score-scale overrides and
+    Gemma-2 softcapping are static kernel params, so the full attention
+    variant surface runs fused (round 5 — the kernel previously fell
+    back to the gather path for these).
   * GQA is folded into the query-row dimension exactly like
     ops/flash_attention.py: the score matmul is [group, Dh] x [Dh, bs].
 
@@ -48,22 +49,29 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # mask fill; avoids inf-inf NaNs
 
 
-def _live_range(pos_b, *, bs: int, MB: int, window):
+def _live_range(pos_b, *, bs: int, MB: int, win):
     """(first, needed) logical-block bounds for a row at position pos_b:
-    blocks [first, needed) hold at least one attendable position."""
+    blocks [first, needed) hold at least one attendable position. `win`
+    is a TRACED scalar or a static int (None / <= 0 = full causal) —
+    per-layer window patterns (Gemma-2/3) feed each scan step's width
+    through one compiled kernel, same contract as
+    ops/flash_attention._first_tile."""
+    if win is None:
+        win = -1
     needed = jnp.minimum(pl.cdiv(pos_b + 1, bs), MB)
     needed = jnp.maximum(needed, 1)  # pos < 0 never happens; keep clip sane
-    if window is None:
-        first = jnp.int32(0)
-    else:
-        first = jnp.maximum(pos_b - window + 1, 0) // bs
-        first = jnp.minimum(first, needed - 1)
+    first = jnp.where(
+        win > 0,
+        jnp.minimum(jnp.maximum(pos_b - win + 1, 0) // bs, needed - 1),
+        0,
+    )
     return first, needed
 
 
 def _paged_kernel(
     table_ref,  # scalar-prefetch [B, MB] int32
     pos_ref,  # scalar-prefetch [B] int32
+    win_ref,  # scalar-prefetch [1] int32: sliding window (<= 0 = full)
     q_ref,  # [1, 1, 1, group, Dh] VMEM
     k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block)
     v_ref,  # [1, 1, bs, Dh] VMEM
@@ -72,7 +80,7 @@ def _paged_kernel(
     MB: int,
     group: int,
     scale: float,
-    window: int | None,
+    softcap: float | None,
     quant: bool = False,
 ):
     del table_ref  # physical placement is the index maps' concern
@@ -89,8 +97,9 @@ def _paged_kernel(
     j = pl.program_id(2)
     n_j = pl.num_programs(2)
     pos_b = pos_ref[b]
+    win = win_ref[0]
     Dh = q_ref.shape[-1]
-    first, needed = _live_range(pos_b, bs=bs, MB=MB, window=window)
+    first, needed = _live_range(pos_b, bs=bs, MB=MB, win=win)
 
     @pl.when(j == 0)
     def _():
@@ -109,10 +118,11 @@ def _paged_kernel(
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [group, bs]
+        if softcap is not None:  # Gemma-2 logit capping, pre-mask (HF order)
+            s = softcap * jnp.tanh(s / softcap)
         kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
         mask = kv_pos <= pos_b
-        if window is not None:
-            mask &= kv_pos > pos_b - window
+        mask &= (win <= 0) | (kv_pos > pos_b - win)
         s = jnp.where(mask, s, _NEG)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -132,15 +142,20 @@ def _paged_kernel(
         o_ref[0, 0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "window"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "window", "scale", "softcap")
+)
 def paged_flash_attend(
     q: jnp.ndarray,
     pool_k,
     pool_v,
     table: jnp.ndarray,
     pos: jnp.ndarray,
+    window_dyn: jnp.ndarray | None = None,
     *,
     window: int | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Paged GQA decode attention over the (already updated) block pool.
@@ -150,6 +165,11 @@ def paged_flash_attend(
     scales [N,KV,bs]), dequantized in the block prologue so the table
     walk streams HALF the bytes per live block; table [B,MB] int32
     physical block ids; pos [B] int32 per-row positions.
+    window: static sliding-window width (None = full causal);
+    window_dyn: TRACED scalar override (<= 0 = full) riding as a
+    scalar-prefetch operand — per-layer patterns (Gemma-2/3) feed each
+    scan step's width through ONE compiled kernel. scale: score-scale
+    override (None = head_dim**-0.5); softcap: Gemma-2 logit capping.
     Returns [B,1,H,Dh] in q.dtype — same contract as the gather path in
     engine/paged.make_paged_hook with the mask derived from pos/window.
     """
@@ -171,33 +191,39 @@ def paged_flash_attend(
     q5 = q.reshape(B, 1, KV, group, Dh)
     table = table.astype(jnp.int32)
     pos = pos.astype(jnp.int32)
+    if window_dyn is None:
+        win_arr = jnp.full((1,), window if window is not None else -1, jnp.int32)
+    else:
+        win_arr = jnp.reshape(window_dyn.astype(jnp.int32), (1,))
 
-    def kv_index(b, kv, j, table_ref, pos_ref):
+    def kv_index(b, kv, j, table_ref, pos_ref, win_ref):
         # Clamp dead logical blocks (past the causal frontier, or before
         # a sliding window) to the nearest live one: the PHYSICAL index
         # then repeats across consecutive dead steps, Pallas skips the
         # DMA, and the kernel's pl.when gate skips their compute.
-        first, needed = _live_range(pos_ref[b], bs=bs, MB=MB, window=window)
+        first, needed = _live_range(
+            pos_ref[b], bs=bs, MB=MB, win=win_ref[0]
+        )
         return (table_ref[b, jnp.clip(j, first, needed - 1)], kv, 0, 0)
 
-    def kv_index_3(b, kv, j, table_ref, pos_ref):
+    def kv_index_3(b, kv, j, table_ref, pos_ref, win_ref):
         # the quant-scale operands [N, KV, bs]: same table walk, one rank
         # down
-        return kv_index(b, kv, j, table_ref, pos_ref)[:3]
+        return kv_index(b, kv, j, table_ref, pos_ref, win_ref)[:3]
 
     kernel = functools.partial(
         _paged_kernel,
         bs=bs,
         MB=MB,
         group=group,
-        scale=Dh**-0.5,
-        window=window,
+        scale=scale if scale is not None else Dh**-0.5,
+        softcap=softcap,
         quant=quant,
     )
     in_specs = [
         pl.BlockSpec(
             (1, 1, 1, group, Dh),
-            lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
+            lambda b, kv, j, table_ref, pos_ref, win_ref: (b, 0, kv, 0, 0),
         ),
         pl.BlockSpec((1, 1, bs, Dh), kv_index),
         pl.BlockSpec((1, 1, bs, Dh), kv_index),
@@ -210,12 +236,12 @@ def paged_flash_attend(
         ]
         operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B, KV, MB),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, 1, group, Dh),
-            lambda b, kv, j, table_ref, pos_ref: (b, 0, kv, 0, 0),
+            lambda b, kv, j, table_ref, pos_ref, win_ref: (b, 0, kv, 0, 0),
         ),
         scratch_shapes=[
             pltpu.VMEM((group, 1), jnp.float32),
@@ -228,7 +254,7 @@ def paged_flash_attend(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, 1, KV, group, Dh), q.dtype),
         interpret=interpret,
-    )(table, pos, *operands)
+    )(table, pos, win_arr, *operands)
     return out.reshape(B, 1, H, Dh)
 
 
@@ -275,7 +301,7 @@ def _slots_kernel(
     Dh = q_ref.shape[-1]
     H = KV * group
     C = KV * bk
-    first, needed = _live_range(pos_b, bs=bk, MB=n_j, window=window)
+    first, needed = _live_range(pos_b, bs=bk, MB=n_j, win=window)
 
     @pl.when(j == 0)
     def _():
@@ -375,9 +401,7 @@ def flash_attend_slots(
     pos = pos.astype(jnp.int32)
 
     def kv_index(b, j, pos_ref):
-        first, needed = _live_range(
-            pos_ref[b], bs=block_k, MB=MB, window=window
-        )
+        first, needed = _live_range(pos_ref[b], bs=block_k, MB=MB, win=window)
         return (b, 0, jnp.clip(j, first, needed - 1), 0)
 
     kernel = functools.partial(

@@ -576,3 +576,84 @@ def test_paged_int8_pallas_token_parity(solo_engine):
         finally:
             cont.close()
     assert streams[0] == streams[1]
+
+
+@pytest.mark.slow
+def test_paged_kernel_softcap_scale_window_dyn():
+    """Round-5: the paged kernel covers score-scale overrides, Gemma-2
+    softcapping, and a traced per-layer window (window_dyn) — each must
+    match the gather + attend reference, and the dynamic-window spelling
+    must match the static one."""
+    from distributed_llm_inference_tpu.ops.attention import (
+        attend, slot_causal_mask,
+    )
+    from distributed_llm_inference_tpu.ops.paged_attention import (
+        paged_flash_attend,
+    )
+
+    B, H, KV, Dh, bs, MB, N = 3, 8, 2, 16, 8, 4, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    q = jax.random.normal(ks[0], (B, 1, H, Dh), jnp.float32)
+    pool_k = jax.random.normal(ks[1], (N, KV, bs, Dh), jnp.float32)
+    pool_v = jax.random.normal(ks[2], (N, KV, bs, Dh), jnp.float32)
+    table = jnp.asarray(
+        [[5, 2, 7, 0], [1, 9, 0, 0], [11, 4, 6, 3]], jnp.int32
+    )
+    pos = jnp.asarray([11, 7, MB * bs - 1], jnp.int32)
+
+    def gather_ref(window, scale, softcap):
+        gk = pool_k[table].transpose(0, 2, 1, 3, 4).reshape(B, KV, MB * bs, Dh)
+        gv = pool_v[table].transpose(0, 2, 1, 3, 4).reshape(B, KV, MB * bs, Dh)
+        mask = slot_causal_mask(pos, 1, MB * bs, window)
+        return attend(q, gk, gv, mask, scale=scale, softcap=softcap)
+
+    for W, sc, cap in [(13, 0.3, None), (None, 0.25, 5.0), (13, None, 9.0)]:
+        want = np.asarray(gather_ref(W, sc, cap))
+        got = np.asarray(paged_flash_attend(
+            q, pool_k, pool_v, table, pos, window=W, scale=sc, softcap=cap,
+            interpret=True,
+        ))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5,
+                                   err_msg=str((W, sc, cap)))
+        got_dyn = np.asarray(paged_flash_attend(
+            q, pool_k, pool_v, table, pos, jnp.int32(W if W else -1),
+            scale=sc, softcap=cap, interpret=True,
+        ))
+        np.testing.assert_allclose(got_dyn, want, rtol=2e-5, atol=2e-5,
+                                   err_msg=str((W, sc, cap)))
+
+
+@pytest.mark.slow
+def test_paged_pallas_gemma2_fleet_parity():
+    """Engine-level: a gemma-2-style model (softcap + query scaling +
+    per-layer 'even' windows) through a paged fleet under
+    attn_impl='pallas' emits exactly the XLA gather fleet's greedy text —
+    the per-layer width rides the kernel's window_dyn operand."""
+    cfg_x = get_model_config("test-gemma2-tiny", eos_token_id=-1).replace(
+        attn_window=8
+    )
+    params = InferenceEngine(
+        cfg_x, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    ).backend.params
+
+    def run(cfg):
+        eng = InferenceEngine(
+            cfg, params=params, engine_cfg=EngineConfig(prefill_buckets=(32,))
+        )
+        cont = ContinuousEngine(
+            eng, n_slots=2, chunk_steps=4, slot_max_seq=96,
+            kv_pool_blocks=16, kv_block_size=16,
+        )
+        try:
+            return [
+                cont.submit(p, greedy=True, chat=False, max_tokens=10)
+                for p in PROMPTS[:2]
+            ]
+        finally:
+            cont.close()
+
+    want = run(cfg_x)
+    got = run(cfg_x.replace(attn_impl="pallas"))
+    for w, g in zip(want, got):
+        assert w["status"] == g["status"] == "success", (w, g)
+        assert g["response"] == w["response"]
