@@ -101,12 +101,14 @@ def _elapsed_s(resp) -> float:
 def serve_and_measure(work, store, pp, quant, max_tokens, tag="main") -> dict:
     """Start the server CLI on the store, warm every serving program with a
     cold request, then measure a warm request — reporting compile overhead
-    (cold TTFT - warm TTFT), warm TTFT (pure prefill compute), end-to-end
-    tok/s, and the STEADY-STATE decode rate tokens/(elapsed - ttft), which
-    is the number comparable to the reference's 0.12-0.2 tok/s
-    (/root/reference/Test.py:61 — its per-request stats are decode-only:
-    there is no prefill/TTFT split to subtract, every token pays the same
-    full-sequence recompute)."""
+    (cold TTFT - warm TTFT), warm TTFT (pure prefill compute), the
+    STEADY-STATE decode rate tokens/(elapsed - ttft), AND the warm
+    END-TO-END rate tokens/elapsed (prompt pass included). The end-to-end
+    number is the apples-to-apples comparison against the reference's
+    0.12-0.2 tok/s (/root/reference/Test.py:61 measures whole-request
+    wall time including the prompt pass); steady-state isolates the
+    decode roofline. Round-5 advice #3: record both in the artifact so
+    the headline comparison never silently favors this framework."""
     port = free_port()
     cmd = [
         sys.executable, "-m", "distributed_llm_inference_tpu.serving.server",
@@ -182,6 +184,13 @@ def serve_and_measure(work, store, pp, quant, max_tokens, tag="main") -> dict:
         elapsed = _elapsed_s(warm)
         decode_s = max(elapsed - float(warm.get("ttft_s", 0.0)), 1e-9)
         leg["warm_tokens_per_sec"] = float(warm.get("tokens_per_sec", 0.0))
+        # warm END-TO-END tokens/elapsed, prompt pass included — the
+        # number directly comparable to the reference's whole-request
+        # 0.12-0.2 tok/s (its stats cannot split prefill from decode)
+        leg["warm_end_to_end_tokens_per_sec"] = round(
+            n / max(elapsed, 1e-9), 3
+        )
+        leg["warm_elapsed_s"] = round(elapsed, 2)
         leg["steady_tokens_per_sec"] = round(n / decode_s, 3)
         leg["decode_s"] = round(decode_s, 2)
         leg["tokens_generated"] = n

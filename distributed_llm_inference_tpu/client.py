@@ -162,14 +162,29 @@ def main(argv: Optional[list] = None):
         "--stream", action="store_true",
         help="stream tokens as they decode (server must run --continuous)",
     )
+    ap.add_argument(
+        "--json", action="store_true", dest="constrain_json",
+        help="grammar-constrain the output to valid JSON (server-side "
+             "token masking, not prompting)",
+    )
+    ap.add_argument(
+        "--regex", default=None, metavar="PATTERN", dest="constrain_regex",
+        help="grammar-constrain the output to fullmatch PATTERN",
+    )
     args = ap.parse_args(argv)
+
+    kw = {}
+    if args.constrain_regex is not None:
+        kw["constraint"] = {"regex": args.constrain_regex}
+    elif args.constrain_json:
+        kw["constraint"] = {"json_object": True}
 
     client = DistributedLLMClient(args.url)
     if args.prompt is not None:
         if args.stream:
-            client.generate_stream(args.prompt, max_tokens=args.max_tokens)
+            client.generate_stream(args.prompt, max_tokens=args.max_tokens, **kw)
         else:
-            client.generate(args.prompt, max_tokens=args.max_tokens)
+            client.generate(args.prompt, max_tokens=args.max_tokens, **kw)
         return
 
     # 3-option menu (Test.py:147-188)

@@ -333,6 +333,18 @@ class EngineConfig:
     prefix_cache_entries: int = 0
     # Snapshot alignment: prefixes are stored at multiples of this length.
     prefix_chunk: int = 64
+    # Grammar-constraint compiled-artifact LRU (constrain/): how many
+    # distinct constraints keep their (mask, transition) tables — host
+    # numpy + warm device copies — cached per engine. A resident artifact
+    # costs ~num_states x vocab x 5 bytes; eviction only costs a
+    # recompile (host-side, milliseconds-to-seconds), never correctness.
+    constraint_cache_entries: int = 16
+    # State-row capacity of the continuous fleet's COMBINED constraint
+    # table (constrain/fleet.py): constraints whose DFA cannot ever fit
+    # run on the solo engine instead; admission backpressures while the
+    # resident set transiently fills. Memory: 2 tables x capacity x vocab
+    # (bool + int32).
+    constraint_fleet_states: int = 1024
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":
@@ -359,14 +371,10 @@ def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelCon
 
     if jax.default_backend() != "tpu":
         return cfg.replace(attn_impl="xla")
-    try:
-        # defense in depth: should a future config variant re-introduce a
-        # __post_init__ legality constraint on pallas, auto falls back to
-        # the XLA path instead of crashing. Both llama and gpt2 forwards
-        # dispatch on attn_impl (models/llama.py, models/gpt2.py:118).
-        return cfg.replace(attn_impl="pallas")
-    except ValueError:
-        return cfg.replace(attn_impl="xla")
+    # no legality guard needed: __post_init__ accepts pallas for every
+    # attention variant (both kernels take softcap/scale overrides and
+    # per-layer windows), so replace() cannot raise here
+    return cfg.replace(attn_impl="pallas")
 
 
 def stage_layer_range(n_layers: int, pp: int, stage: int) -> tuple[int, int]:

@@ -1,0 +1,117 @@
+"""Per-fleet combined constraint tables for the continuous engine.
+
+The slot fleet decodes in lock-step with ONE pair of (mask, transition)
+tables shared by every row, so slots running DIFFERENT constraints need
+their states to index one combined table. Row 0 is the FREE state (every
+token allowed, self-loop): unconstrained slots simply sit at state 0 and
+the constrained decode program is a uniform two-gather no-op for them.
+Each resident constraint's artifact occupies rows [offset, offset + S) with
+its transitions rebased by +offset; a slot's absolute FSM state is
+offset + local state.
+
+Residency is refcounted by constraint hash: admission `acquire`s (reusing
+a resident entry or appending its rows), release `release`s. Appending
+never moves resident rows — active slots hold absolute indices on device —
+so zero-ref entries are reclaimed lazily: the next acquire that finds NO
+active references resets the whole table. `acquire` returns None when the
+capacity cannot take the artifact right now (same backpressure contract as
+the paged block pool: requeue, retry after a release).
+
+Table capacity is padded up a bucket ladder so the decode program only
+recompiles when the fleet crosses a bucket, not on every admission.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .tables import CompiledConstraint
+
+STATE_BUCKETS = (32, 64, 128, 256, 512, 1024)
+
+
+class FleetConstraintTable:
+    def __init__(self, vocab_size: int, max_states: int = STATE_BUCKETS[-1]):
+        self.vocab_size = int(vocab_size)
+        self.max_states = int(max_states)
+        self._entries: dict = {}  # key -> {"art", "offset", "refs"}
+        self._total = 1  # row 0 = the free state
+        self._np: Optional[tuple] = None  # (mask, trans) padded to bucket
+        self._dev: Optional[tuple] = None
+
+    @property
+    def any_active(self) -> bool:
+        return any(e["refs"] > 0 for e in self._entries.values())
+
+    def fits(self, art: CompiledConstraint) -> bool:
+        """Could `art` EVER be admitted (even into an empty table)? False
+        means route the request to the solo engine instead of queueing it
+        behind a release that will never help."""
+        return 1 + art.num_states <= self.max_states
+
+    def acquire(self, art: CompiledConstraint) -> Optional[int]:
+        """Resident offset for `art` (refcount bumped), or None when the
+        table is full right now (backpressure: retry after a release)."""
+        e = self._entries.get(art.key)
+        if e is not None:
+            e["refs"] += 1
+            return e["offset"]
+        if not self.any_active and self._entries:
+            # no slot references any resident rows: safe to compact
+            self._entries.clear()
+            self._total = 1
+            self._np = self._dev = None
+        if self._total + art.num_states > self.max_states:
+            return None
+        offset = self._total
+        self._entries[art.key] = {"art": art, "offset": offset, "refs": 1}
+        self._total += art.num_states
+        self._np = self._dev = None
+        return offset
+
+    def release(self, key: str):
+        e = self._entries.get(key)
+        if e is not None and e["refs"] > 0:
+            e["refs"] -= 1
+
+    def _bucket(self) -> int:
+        for b in STATE_BUCKETS:
+            if self._total <= b <= self.max_states:
+                return b
+        return self.max_states
+
+    def numpy_tables(self) -> tuple:
+        """(mask [B, V] bool, trans [B, V] int32) padded to the bucket.
+        Padding rows are free rows — unreachable, but a garbage gather
+        through one must never produce NaN logits."""
+        if self._np is None:
+            B = self._bucket()
+            mask = np.ones((B, self.vocab_size), bool)
+            trans = np.zeros((B, self.vocab_size), np.int32)
+            for e in self._entries.values():
+                art, off = e["art"], e["offset"]
+                S = art.num_states
+                mask[off: off + S] = art.mask
+                trans[off: off + S] = art.next_state + off
+                # EOS self-loops were absolute-local; rebase is uniform +off
+            self._np = (mask, trans)
+        return self._np
+
+    def device_tables(self) -> tuple:
+        if self._dev is None:
+            import jax.numpy as jnp
+
+            mask, trans = self.numpy_tables()
+            self._dev = (jnp.asarray(mask), jnp.asarray(trans))
+        return self._dev
+
+    def stats(self) -> dict:
+        return {
+            "resident": len(self._entries),
+            "active": sum(e["refs"] > 0 for e in self._entries.values()),
+            "states": self._total,
+            "bucket": self._bucket(),
+            "max_states": self.max_states,
+        }

@@ -59,7 +59,7 @@ class _Request:
         "prompt", "kwargs", "done", "result", "t_start", "ttft",
         "first_id", "tokens", "slot", "enqueued", "budget",
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
-        "cancelled", "prompt_tokens", "block_ids", "need",
+        "cancelled", "prompt_tokens", "block_ids", "need", "cart",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None):
@@ -84,6 +84,9 @@ class _Request:
         self.prompt_tokens = 0  # set at admission (tokenized prompt length)
         self.block_ids = None  # paged mode: this request's pool blocks
         self.need = None  # paged mode: blocks required (set on 1st attempt)
+        # grammar constraint (constrain/): (CompiledConstraint, fleet-table
+        # row offset) once admitted; None = unconstrained
+        self.cart = None
 
 
 class ContinuousEngine:
@@ -198,6 +201,20 @@ class ContinuousEngine:
                 self.n_slots, self.slot_max_seq
             )
         self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
+        # Grammar-constraint fleet state (constrain/): per-slot FSM rows
+        # into the COMBINED resident table (row 0 = the free state every
+        # unconstrained slot sits at). The table registry is built lazily
+        # on the first constrained admission; while any constrained slot
+        # is active the worker launches the constrained slot program
+        # (decode_slots_constrained — fsm chains device-side between
+        # chunks), otherwise the untouched plain program.
+        self._fsm = jnp.zeros((self.n_slots,), jnp.int32)
+        from ..constrain import FleetConstraintTable
+
+        self._ctable = FleetConstraintTable(
+            cfg.vocab_size,
+            max_states=engine.engine_cfg.constraint_fleet_states,
+        )
         # scratch must match the fleet's logical extent: the insert splices
         # the whole row (dense) / scatters every logical block (paged)
         self._scratch = self.backend.init_cache(1, self._scratch_seq)
@@ -232,13 +249,12 @@ class ContinuousEngine:
         self._thread.start()
 
     # -- client side ---------------------------------------------------------
-    @staticmethod
-    def _needs_solo(kwargs: dict) -> bool:
+    def _needs_solo(self, kwargs: dict) -> bool:
         """Contracts slots cannot honor (deterministic RNG stream, single-
         stream prefill logits, draft verification, per-token logprob
         buffers) run solo on the wrapped engine — one condition shared by
         submit() and stream()."""
-        return (
+        if (
             kwargs.get("seed") is not None
             or bool(kwargs.get("debug"))
             or bool(kwargs.get("speculative"))
@@ -248,7 +264,24 @@ class ContinuousEngine:
             or bool(kwargs.get("logit_bias"))
             # beam search is its own batched program
             or int(kwargs.get("num_beams", 1) or 1) > 1
-        )
+        ):
+            return True
+        if kwargs.get("constraint") is not None:
+            # constrained slots need the constrained slot program (dense
+            # fleet only in this PR — the paged pool falls back) and a
+            # fleet table the DFA can ever fit; otherwise the solo engine
+            # serves the constraint with its own per-request tables
+            if self.paged or not getattr(
+                self.backend, "supports_constrained_slots", False
+            ):
+                return True
+            try:
+                art = self.engine._compile_constraint(kwargs["constraint"])
+            except ValueError:
+                return True  # solo re-raises into the 400 envelope
+            if not self._ctable.fits(art):
+                return True
+        return False
 
     def _enqueue(self, req: _Request) -> Optional[dict]:
         """Admit a request to the bounded queue. Returns an error envelope
@@ -424,6 +457,9 @@ class ContinuousEngine:
                 "pool_blocks": self._alloc.n_blocks,
                 "free_blocks": self._alloc.free_blocks,
             }
+        cstats = self._ctable.stats()
+        if cstats["resident"]:
+            out["constraints"] = cstats
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
         return out
@@ -482,6 +518,19 @@ class ContinuousEngine:
                         self.backend.decode_slots_paged(
                             self.state, self.cache, self._table_dev,
                             self._next_key(), self.sparams,
+                            num_steps=self.chunk_steps,
+                        )
+                    )
+                elif self._ctable.any_active:
+                    # >= 1 constrained tenant: the constrained slot program
+                    # (two extra gathers; free rows make it a no-op for
+                    # unconstrained slots). The fsm chunk output chains
+                    # device-side exactly like state/cache.
+                    cm, ct = self._ctable.device_tables()
+                    emitted, mask, self.state, self.cache, self._fsm = (
+                        self.backend.decode_slots_constrained(
+                            self.state, self.cache, self._next_key(),
+                            self.sparams, self._fsm, cm, ct,
                             num_steps=self.chunk_steps,
                         )
                     )
@@ -564,7 +613,17 @@ class ContinuousEngine:
             # one-token cap means the slot was armed inactive
             if req.first_id in self.cfg.all_stop_ids or req.budget == 0:
                 self._finalize(req)
-            elif req.stream_q is not None:
+                continue
+            if req.cart is not None:
+                # arm the slot's FSM row: fleet-absolute state after the
+                # (bias-masked) first token. Set BEFORE the next chunk
+                # launch, so the constrained program picks it up — same
+                # future-most-state contract as insert_slot.
+                cart, off = req.cart
+                self._fsm = self._fsm.at[req.slot].set(
+                    off + cart.advance(cart.start, req.first_id)
+                )
+            if req.stream_q is not None:
                 self._stream_tokens(req)  # first token, right after TTFT
 
     def _admit_one(self, req: _Request, slot: int):
@@ -623,6 +682,15 @@ class ContinuousEngine:
             req.block_ids = blk_ids
             table_row = np.zeros((self._max_blocks,), np.int32)
             table_row[: len(blk_ids)] = blk_ids  # tail stays at trash
+        if k.get("constraint") is not None:
+            # compiled-artifact reuse by constraint hash (the engine LRU),
+            # then residency in the fleet's combined table; a full table
+            # backpressures exactly like the paged pool
+            cart = eng._compile_constraint(k["constraint"])
+            off = self._ctable.acquire(cart)
+            if off is None:
+                return _BLOCKED  # retry after a release frees rows
+            req.cart = (cart, off)
         sampling = G.default_sampling(
             k.get("temperature", 0.7), k.get("top_k", 50),
             k.get("top_p", 0.9), k.get("greedy", False),
@@ -642,10 +710,16 @@ class ContinuousEngine:
         presence = eng._presence_rows([ids]) if rp != 1.0 else None
         try:
             # shared splice/ingest/store sequence (engine/engine.py) —
-            # same machinery, same ordering as the solo path
+            # same machinery, same ordering as the solo path. A grammar
+            # constraint masks the FIRST token through the bias operand
+            # (engine._constraint_bias), same as solo.
             first, _, scratch = eng._ingest_with_prefix(
                 self._prefix, ids, p0, entry, plan, scratch, key, sampling,
                 presence=presence,
+                bias=(
+                    eng._constraint_bias(req.cart[0], None)
+                    if req.cart is not None else None
+                ),
             )
             # prefill token is emitted token #0 (unless EOS — break-before-
             # append); the EOS check happens inside insert_slot on device
@@ -685,6 +759,10 @@ class ContinuousEngine:
                 # device error): return the blocks or the pool leaks
                 self._alloc.free(req.block_ids)
                 req.block_ids = None
+            if req.cart is not None:
+                # same discipline for the constraint residency refcount
+                self._ctable.release(req.cart[0].key)
+                req.cart = None
             raise
         finally:
             if self._scratch is None:
@@ -806,6 +884,8 @@ class ContinuousEngine:
         }
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
+        if req.cart is not None:
+            req.result["constrained"] = True
         if stopped:
             req.result["stopped"] = True  # a textual stop sequence fired
         log.info(
@@ -815,6 +895,13 @@ class ContinuousEngine:
         self._release(req)
 
     def _release(self, req: _Request):
+        if req.cart is not None:
+            # refcount down; the slot's FSM row back to the free state so
+            # the row is inert under any still-constrained chunk program
+            self._ctable.release(req.cart[0].key)
+            if req.slot is not None:
+                self._fsm = self._fsm.at[jnp.int32(req.slot)].set(0)
+            req.cart = None
         if self.paged and req.block_ids is not None:
             # Worker-thread-only mutation (like all allocator use). The
             # freed blocks may be re-granted before in-flight chunks

@@ -106,15 +106,18 @@ class SingleDeviceBackend:
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
                valid_start=None, presence=None, counts=None, bias=None,
-               *, max_steps, with_logprobs=False):
+               constraint=None, *, max_steps, with_logprobs=False):
         return G.decode(
             self.cfg, self.params, first_token, cache, start_pos, limit, key,
-            sampling, valid_start, presence, counts, bias,
+            sampling, valid_start, presence, counts, bias, constraint,
             max_steps=max_steps, with_logprobs=with_logprobs,
         )
 
     # OpenAI logit_bias ([V] added to raw logits each sample)
     supports_bias = True
+    # grammar-constrained decoding (constrain/): FSM state + mask tables
+    # threaded through decode; first token rides the bias operand
+    supports_constrain = True
     # teacher-forced scoring (OpenAI echo+logprobs / lm-eval loglikelihood)
     supports_score = True
 
@@ -150,6 +153,17 @@ class SingleDeviceBackend:
         return G.decode_slots(
             self.cfg, self.params, state, cache, key, sparams,
             num_steps=num_steps,
+        )
+
+    # constrained slot decode (continuous fleets with grammar-constrained
+    # tenants; the fleet tables come from constrain/fleet.py)
+    supports_constrained_slots = True
+
+    def decode_slots_constrained(self, state, cache, key, sparams, fsm,
+                                 cmask, ctrans, *, num_steps):
+        return G.decode_slots_constrained(
+            self.cfg, self.params, state, cache, key, sparams, fsm, cmask,
+            ctrans, num_steps=num_steps,
         )
 
     # block-paged KV for the continuous fleet (engine/paged.py): pool +
@@ -267,6 +281,18 @@ class InferenceEngine:
         # smaller same-tokenizer model + its reusable donated KV cache
         self._draft = None
         self._draft_cache = None
+        # Grammar-constraint compiled-artifact cache (constrain/): LRU by
+        # canonical constraint hash. The token vocab + trie are built once
+        # (lazily — tokenizer byte extraction is per-engine, not per-spec)
+        # and shared by every compile; artifacts keep their device tables
+        # warm so repeated constraints re-upload nothing.
+        self._constraint_cache = collections.OrderedDict()
+        self._constraint_vocab = None
+        self._constraint_trie = None
+        # own lock: the continuous worker thread and request threads both
+        # compile (engine._lock is held for whole generations — a compile
+        # must not queue behind a multi-second decode)
+        self._constraint_lock = threading.Lock()
         # Abandoned (deadline-overrun) device calls still running on their
         # daemon threads: token -> {"what", "since"}. /health flips to
         # "degraded" while any exists (round-2 review weak #5 — on a flaky
@@ -471,6 +497,7 @@ class InferenceEngine:
         num_beams: int = 1,
         length_penalty: float = 1.0,
         early_stopping: bool = False,
+        constraint: Optional[dict] = None,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -505,6 +532,19 @@ class InferenceEngine:
         """
         t_start = time.time()
 
+        if constraint is not None and (num_beams > 1 or speculative):
+            # grammar constraints do not compose with beam search (no
+            # per-beam FSM state threads the beam reorder) nor with
+            # speculative verify (the draft argmax comparison ignores the
+            # mask) in this PR — reject loudly, never silently drop the
+            # grammar (a "guaranteed-valid JSON" promise silently broken
+            # is the worst possible failure mode)
+            what = "num_beams > 1" if num_beams > 1 else "speculative"
+            msg = f"constraint does not compose with {what}"
+            log.warning("invalid_request", error=msg)
+            return {"error": f"Error: {msg}", "status": "failed",
+                    "error_type": "invalid_request"}
+
         if num_beams > 1 and (frequency_penalty != 0.0 or presence_penalty != 0.0):
             # the beam path is a pure max-score search with no per-beam
             # count tracking: reject loudly instead of silently returning
@@ -530,7 +570,7 @@ class InferenceEngine:
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
                     repetition_penalty, stop, logprobs, logit_bias,
-                    frequency_penalty, presence_penalty,
+                    frequency_penalty, presence_penalty, constraint,
                 )
 
         try:
@@ -927,6 +967,55 @@ class InferenceEngine:
             messages, arch=self.cfg.arch, template=self.cfg.chat_template
         )
 
+    def _compile_constraint(self, raw: dict):
+        """Wire-format constraint -> CompiledConstraint through the engine
+        LRU (engine_cfg.constraint_cache_entries). ValueError (malformed
+        spec / unsupported schema / oversized DFA) propagates to the
+        caller's invalid_request envelope."""
+        from .. import constrain as C
+
+        if not getattr(self.backend, "supports_constrain", False):
+            raise ValueError(
+                f"backend {self.backend.name!r} does not support "
+                f"constrained decoding; serve constrained requests on the "
+                f"single-device or pipeline backend"
+            )
+        spec = C.parse_constraint_spec(raw)
+        key = C.constraint_key(spec)
+        with self._constraint_lock:
+            art = self._constraint_cache.get(key)
+            if art is not None:
+                self._constraint_cache.move_to_end(key)
+                return art
+            if self._constraint_vocab is None:
+                self._constraint_vocab = C.TokenVocab.from_tokenizer(
+                    self.tokenizer, self.cfg.vocab_size,
+                    eos_ids=self.cfg.all_stop_ids,
+                    special_ids=(self.cfg.pad_token_id, self.cfg.bos_token_id),
+                )
+                from ..constrain.tables import _build_trie
+
+                self._constraint_trie = _build_trie(self._constraint_vocab)
+            art = C.compile_constraint(
+                spec, self._constraint_vocab, self._constraint_trie
+            )
+            self._constraint_cache[key] = art
+            while len(self._constraint_cache) > max(
+                1, self.engine_cfg.constraint_cache_entries
+            ):
+                self._constraint_cache.popitem(last=False)
+            return art
+
+    @staticmethod
+    def _constraint_bias(art, bias):
+        """Fold the start-state mask into the (possibly absent) logit_bias
+        operand for the FIRST token (sampled by prefill, before any decode
+        fsm exists): -1e9 on banned tokens can never be resurrected by a
+        +100 user bias, and the constrained prefill reuses the compiled
+        bias program variants instead of growing new ones."""
+        mask_bias = jnp.asarray(art.start_bias())
+        return mask_bias if bias is None else bias + mask_bias
+
     def _bias_array(self, logit_bias):
         """{token_id: bias} -> dense [V] f32 on validated ids, or None.
 
@@ -966,7 +1055,7 @@ class InferenceEngine:
 
     def _decode_textual_stop_chunks(
         self, first, cache, prompt_len, max_tokens, key_dec, sampling, dkw,
-        logprobs, stop,
+        logprobs, stop, cart=None,
     ):
         """Bounded-chunk decode when textual `stop` sequences are set
         (round-2 review weak #4: the post-hoc check decoded the full
@@ -1035,6 +1124,16 @@ class InferenceEngine:
                 cnt = dkw["counts"]
                 cnt = cnt.at[0, jnp.asarray(row, jnp.int32)].add(1)
                 dkw = dict(dkw, counts=cnt)
+            if cart is not None and row:
+                # re-walk the chunk's tokens through the host transition
+                # table so the next chunk resumes at the right FSM state
+                # (a handful of numpy lookups per chunk, not per token)
+                fsm_host = int(np.asarray(dkw["constraint"][0])[0])
+                for t in row:
+                    fsm_host = cart.advance(fsm_host, t)
+                dkw = dict(dkw, constraint=(
+                    jnp.asarray([fsm_host], jnp.int32),
+                ) + dkw["constraint"][1:])
             text = self.tokenizer.decode(
                 ([first_id] if first_id not in self.cfg.all_stop_ids else [])
                 + collected,
@@ -1052,11 +1151,14 @@ class InferenceEngine:
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, debug=False, speculative=False, min_p=0.0,
         repetition_penalty=1.0, stop=None, logprobs=False, logit_bias=None,
-        frequency_penalty=0.0, presence_penalty=0.0,
+        frequency_penalty=0.0, presence_penalty=0.0, constraint=None,
     ):
         cfg = self.cfg
         self.request_count += 1
         bias = self._bias_array(logit_bias)
+        cart = self._compile_constraint(constraint) if constraint else None
+        if cart is not None:
+            bias = self._constraint_bias(cart, bias)
         text = self.render_chat(prompt) if chat else prompt
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
@@ -1206,12 +1308,21 @@ class InferenceEngine:
                 )
             if bias is not None:  # backends without the kwarg stay untouched
                 dkw["bias"] = bias
+            if cart is not None:
+                # FSM state after the (bias-masked) first token, computed
+                # host-side off the already-fetched first id — the decode
+                # loop then advances it on device, zero host syncs/token
+                fsm0 = cart.advance(cart.start, int(first[0]))
+                cm, ct = cart.device_tables()
+                dkw["constraint"] = (
+                    jnp.asarray([fsm0], jnp.int32), cm, ct
+                )
             if stop:
                 # textual stops: decode in bounded chunks and quit at the
                 # first match instead of burning the full budget on device
                 out, n_gen, step_lps, cache = self._decode_textual_stop_chunks(
                     first, cache, prompt_len, max_tokens, key_dec, sampling,
-                    dkw, logprobs, stop,
+                    dkw, logprobs, stop, cart=cart,
                 )
             elif logprobs:
                 out, n_gen, cache, step_lps = self.backend.decode(
@@ -1309,6 +1420,8 @@ class InferenceEngine:
             result["token_strings"] = token_strings
         if use_spec or use_draft:
             result["speculative"] = True
+        if cart is not None:
+            result["constrained"] = True
         if use_draft:
             result["draft_model"] = self._draft[0].name
         if top_predictions is not None:
@@ -1512,6 +1625,7 @@ class InferenceEngine:
         frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0,
         stop: Optional[list] = None,
+        constraint: Optional[dict] = None,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).
 
@@ -1531,7 +1645,7 @@ class InferenceEngine:
                 return self._generate_batch_locked(
                     prompts, max_tokens, temperature, top_k, top_p, greedy,
                     chat, seed, t_start, min_p, repetition_penalty, stop,
-                    frequency_penalty, presence_penalty,
+                    frequency_penalty, presence_penalty, constraint,
                 )
 
         try:
@@ -1547,7 +1661,7 @@ class InferenceEngine:
     def _generate_batch_locked(
         self, prompts, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, min_p=0.0, repetition_penalty=1.0, stop=None,
-        frequency_penalty=0.0, presence_penalty=0.0,
+        frequency_penalty=0.0, presence_penalty=0.0, constraint=None,
     ):
         cfg = self.cfg
         if not prompts or not all(isinstance(p, str) and p for p in prompts):
@@ -1611,6 +1725,9 @@ class InferenceEngine:
         presence = (
             self._presence_rows(rows) if repetition_penalty != 1.0 else None
         )
+        # shared grammar constraint: all rows decode under the SAME tables
+        # (one [S, V] pair broadcast), each row walking its own FSM state
+        cart = self._compile_constraint(constraint) if constraint else None
         key = jax.random.PRNGKey(seed) if seed is not None else self._next_key()
         key_pre, key_dec = jax.random.split(key)
 
@@ -1619,9 +1736,14 @@ class InferenceEngine:
         cache = self._batch_caches.pop(Bb, None)
         if cache is None:
             cache = self.backend.init_cache(Bb, cfg.max_seq_len)
+        pkw = {"presence": presence}
+        if cart is not None:
+            # first-token mask rides the bias operand ([V] broadcasts
+            # row-wise), exactly like the solo path
+            pkw["bias"] = self._constraint_bias(cart, None)
         first, logits, cache = self.backend.prefill(
             tokens, jnp.int32(bucket), cache, key_pre, sampling, valid_start,
-            presence=presence,
+            **pkw,
         )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
@@ -1640,10 +1762,21 @@ class InferenceEngine:
             counts = G.count_update(
                 jnp.zeros((Bb, cfg.vocab_size), jnp.int32), first
             )
+        bkw = {}
+        if cart is not None:
+            # per-row FSM states after each row's first token (host numpy
+            # walk off the already-fetched firsts; dummy pad rows got EOS
+            # firsts above — they start finished, their state is inert)
+            firsts = np.asarray(first)
+            fsm0 = np.asarray(
+                [cart.advance(cart.start, int(t)) for t in firsts], np.int32
+            )
+            cm, ct = cart.device_tables()
+            bkw["constraint"] = (jnp.asarray(fsm0), cm, ct)
         out, n_gen, cache = self.backend.decode(
             first, cache, jnp.int32(bucket), jnp.int32(max_tokens - 1),
             key_dec, sampling, valid_start, presence, counts,
-            max_steps=decode_bucket,
+            max_steps=decode_bucket, **bkw,
         )
         out = jax.block_until_ready(out)
         # keep at most ONE batch cache (the bucket just used): an entry per
@@ -1682,7 +1815,7 @@ class InferenceEngine:
             ttft_s=round(ttft, 4), aggregate_tokens_per_sec=round(tps, 2),
             elapsed_s=round(elapsed, 3),
         )
-        return {
+        result = {
             "results": results,
             "status": "success",
             "batch_size": B,
@@ -1692,6 +1825,9 @@ class InferenceEngine:
             "ttft_s": round(ttft, 4),
             "backend": self.backend.name,
         }
+        if cart is not None:
+            result["constrained"] = True
+        return result
 
     # -- perf stats ----------------------------------------------------------
     def stats(self) -> dict:

@@ -90,6 +90,24 @@ def presence_update(presence: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return presence | hit
 
 
+def fsm_allowed(cmask: jnp.ndarray, fsm: jnp.ndarray) -> jnp.ndarray:
+    """Allowed-token mask rows for the current FSM states: one gather
+    ([S, V] table x [B] states -> [B, V]) inside the compiled loop — the
+    grammar constraint's entire per-token mask cost (constrain/)."""
+    return jnp.take(cmask, fsm, axis=0)
+
+
+def fsm_advance(ctrans: jnp.ndarray, fsm: jnp.ndarray, tokens: jnp.ndarray,
+                active: jnp.ndarray) -> jnp.ndarray:
+    """Advance FSM states through the sampled tokens ([S, V] transition
+    table gather); rows with active=False (finished / idle slots) keep
+    their state frozen."""
+    nxt = jnp.take_along_axis(
+        jnp.take(ctrans, fsm, axis=0), tokens[:, None], axis=-1
+    )[:, 0]
+    return jnp.where(active, nxt, fsm)
+
+
 def stop_mask(cfg: ModelConfig, tokens: jnp.ndarray) -> jnp.ndarray:
     """True where a token is a stop token (eos OR any cfg.stop_token_ids,
     e.g. Gemma-it's <end_of_turn> — instruct checkpoints end their turn
@@ -179,6 +197,7 @@ def decode(
     presence=None,
     counts=None,
     bias=None,
+    constraint=None,
     *,
     max_steps: int,
     with_logprobs: bool = False,
@@ -197,6 +216,13 @@ def decode(
     emitted token's log-probability under the RAW model distribution
     (log_softmax of the step logits — before temperature/filters, the
     OpenAI-logprobs convention).
+
+    constraint: None, or (fsm0 [B] i32, cmask [S, V] bool, ctrans [S, V]
+    i32) — grammar-constrained decoding (constrain/): each step masks the
+    logits with cmask[fsm] and advances fsm = ctrans[fsm, token], both
+    gathers inside the compiled loop (zero host work per token). The fsm
+    carry exists ONLY in constrained traces, so unconstrained programs
+    compile to byte-identical HLO.
     """
     B = first_token.shape[0]
     # clamp: limit > max_steps would walk dynamic_update_slice off the end
@@ -218,13 +244,18 @@ def decode(
     cnt0 = counts if use_counts else jnp.zeros((B, 1), jnp.int32)
 
     lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
+    # constraint carry only exists in constrained traces (see docstring)
+    use_fsm = constraint is not None
+    if use_fsm:
+        fsm0, cmask, ctrans = constraint
 
     def cond(c):
-        step, _, _, _, _, finished, _, _, _, _, _ = c
+        step, _, _, _, _, finished, _, _, _, _, _ = c[:11]
         return (step < limit) & ~jnp.all(finished)
 
     def body(c):
-        step, token, pos, cache, key, finished, out, n_gen, pres, cnt, lps = c
+        step, token, pos, cache, key, finished, out, n_gen, pres, cnt, lps = c[:11]
+        fsm = c[11] if use_fsm else None
         logits, cache = _forward_step(
             cfg, params, token[:, None], cache, pos, valid_start
         )
@@ -232,6 +263,7 @@ def decode(
         nxt = sample_token(
             sub, logits, *sampling, presence=pres if use_presence else None,
             counts=cnt if use_counts else None, bias=bias,
+            allowed=fsm_allowed(cmask, fsm) if use_fsm else None,
         )
         if use_presence:
             pres = presence_update(pres, nxt)
@@ -247,10 +279,13 @@ def decode(
             lps = jax.lax.dynamic_update_slice(lps, tok_lp, (jnp.int32(0), step))
         n_gen = n_gen + (~newly_finished).astype(jnp.int32)
         token = jnp.where(newly_finished, pad, nxt)
-        return (
+        nc = (
             step + 1, token, pos + 1, cache, key, newly_finished, out, n_gen,
             pres, cnt, lps,
         )
+        if use_fsm:
+            nc = nc + (fsm_advance(ctrans, fsm, nxt, ~newly_finished),)
+        return nc
 
     init = (
         jnp.int32(0),
@@ -265,9 +300,10 @@ def decode(
         cnt0,
         lp0,
     )
-    (_, _, _, cache, _, _, out, n_gen, _, _, lps) = jax.lax.while_loop(
-        cond, body, init
-    )
+    if use_fsm:
+        init = init + (fsm0,)
+    final = jax.lax.while_loop(cond, body, init)
+    (_, _, _, cache, _, _, out, n_gen, _, _, lps) = final[:11]
     if with_logprobs:
         return out, n_gen, cache, lps
     return out, n_gen, cache
@@ -393,11 +429,52 @@ def decode_slots(
     return emitted, emit_mask, state, cache
 
 
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "num_steps"), donate_argnames=("cache",)
+)
+def decode_slots_constrained(
+    cfg: ModelConfig,
+    params,
+    state: SlotState,
+    cache,
+    key,
+    sparams: SlotParams,
+    fsm,
+    cmask,
+    ctrans,
+    *,
+    num_steps: int,
+):
+    """decode_slots under the fleet constraint tables: identical chunk
+    contract plus the fsm [B] carry chained device-side between chunks
+    (admission/release set rows host-side; decode never syncs). The
+    continuous engine launches this program only while >= 1 constrained
+    slot is active — pure-unconstrained fleets dispatch the untouched
+    decode_slots. Returns (emitted, emit_mask, state, cache, fsm)."""
+    def body(carry, sub):
+        state, cache, fsm = carry
+        logits, cache = _forward_step(
+            cfg, params, state.token[:, None], cache, state.pos
+        )
+        new, emit, can_emit, fsm = slot_step_constrained(
+            cfg, state, sparams, logits, sub, fsm, cmask, ctrans
+        )
+        return (new, cache, fsm), (emit, can_emit)
+
+    subs = jax.random.split(key, num_steps)
+    (state, cache, fsm), (emitted, emit_mask) = jax.lax.scan(
+        body, (state, cache, fsm), subs
+    )
+    return emitted, emit_mask, state, cache, fsm
+
+
 def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
-              logits, key):
+              logits, key, allowed=None):
     """ONE copy of the per-step slot sampling/bookkeeping — the single-chip
     decode_slots scan and the pipeline's shard_map slots program both call
     this, so the cross-backend token-parity guarantee can't drift.
+    allowed [B, V]: optional grammar-constraint mask rows (the constrained
+    slot programs gather them from the fleet table — slot_step_constrained).
     Returns (new_state, emit [B], can_emit [B])."""
     pad = jnp.int32(cfg.pad_token_id)
     nxt = sample_token(
@@ -417,6 +494,7 @@ def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
         sparams.pres_penalty[:, None],
         presence=state.presence,
         counts=state.counts,
+        allowed=allowed,
     )
     # break-before-append EOS semantics (orchestration.py:181-186)
     can_emit = state.active & ~stop_mask(cfg, nxt) & (state.remaining > 0)
@@ -430,6 +508,21 @@ def slot_step(cfg: ModelConfig, state: SlotState, sparams: SlotParams,
         counts=count_update(state.counts, nxt, can_emit),
     )
     return new, emit, can_emit
+
+
+def slot_step_constrained(cfg: ModelConfig, state: SlotState,
+                          sparams: SlotParams, logits, key, fsm, cmask,
+                          ctrans):
+    """slot_step under the FLEET constraint tables (constrain/fleet.py):
+    fsm [B] indexes the combined table — row 0 is the free state, so
+    unconstrained slots ride the same two gathers as a no-op. ONE copy for
+    the single-chip and pp shard_map constrained slot programs.
+    Returns (new_state, emit [B], can_emit [B], new_fsm [B])."""
+    new, emit, can_emit = slot_step(
+        cfg, state, sparams, logits, key, allowed=fsm_allowed(cmask, fsm)
+    )
+    # emit == the sampled token exactly where can_emit; frozen elsewhere
+    return new, emit, can_emit, fsm_advance(ctrans, fsm, emit, can_emit)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))

@@ -121,6 +121,7 @@ def sample_token(
     presence: jnp.ndarray = None,
     counts: jnp.ndarray = None,
     bias: jnp.ndarray = None,
+    allowed: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Full sampling stack -> int32 token ids, shape logits.shape[:-1].
 
@@ -137,6 +138,10 @@ def sample_token(
     through pres_penalty matches engine.generate.SamplingParams, so
     `sample_token(key, logits, *sampling, ...)` stays the universal call;
     presence/counts/bias are state, passed by keyword.
+    allowed ([..., V] bool, None = unconstrained) is the grammar-
+    constraint mask (constrain/): False tokens are -inf'd after
+    bias/penalties, before the warpers — greedy and sampled draws alike
+    can never emit a disallowed token.
 
     Hot-path note: this runs inside the decode `lax.scan` every token, so
     top-k and top-p share ONE descending sort (the standalone filters above
@@ -157,6 +162,14 @@ def sample_token(
         # OpenAI penalties ride the same pre-warper slot as the HF
         # repetition penalty (and apply to the greedy argmax too)
         logits = apply_oai_penalties(logits, counts, freq_penalty, pres_penalty)
+    if allowed is not None:
+        # grammar-constraint mask (constrain/): disallowed tokens drop to
+        # -inf AFTER bias/penalties and BEFORE the warpers, so a +100
+        # logit_bias can never resurrect a token the grammar forbids and
+        # the greedy argmax obeys the mask too. The table compiler
+        # guarantees every row keeps >= 1 allowed token (EOS at worst),
+        # so the masked row can never go all -inf.
+        logits = jnp.where(allowed, logits, NEG_INF)
 
     use_min_p = min_p is not None
     mp = jnp.float32(0.0) if min_p is None else min_p

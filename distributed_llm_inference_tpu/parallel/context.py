@@ -191,8 +191,15 @@ class ContextParallelBackend(SPMDBackendBase):
     # ring/ulysses/merge masks as a per-row floor on ABSOLUTE key positions
     # (parallel/ring.py:_raggedize) — chunk offsets and slot tags are both
     # absolute, so the queue-coalesced batched serving path shards over sp
-    # like any other batch.
-    supports_ragged = True
+    # like any other batch. Llama-family only (gpt2's forward_layers
+    # raises on valid_start — learned absolute positions are not
+    # shift-invariant), gated HERE at the backend seam so a ragged gpt2
+    # sp batch rejects loudly instead of relying on the engine/queue
+    # arch gates upstream (round-5 advice #1; same pattern as the
+    # supports_score property).
+    @property
+    def supports_ragged(self) -> bool:
+        return self.cfg.arch == "llama"
 
     def prefill(self, tokens, prompt_len, cache, key, sampling,
                 valid_start=None, presence=None, bias=None):

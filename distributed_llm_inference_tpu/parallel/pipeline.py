@@ -116,25 +116,32 @@ class SPMDBackendBase:
 
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
                valid_start=None, presence=None, counts=None, bias=None,
-               *, max_steps, with_logprobs=False):
+               constraint=None, *, max_steps, with_logprobs=False):
         """One dispatch for every subclass: programs are keyed by
-        (max_steps, ragged, presence, counts, bias, logprobs); builders
-        that don't support a variant raise NotImplementedError at build
-        time (loud, not silently wrong)."""
+        (max_steps, ragged, presence, counts, bias, constraint, logprobs);
+        builders that don't support a variant raise NotImplementedError at
+        build time (loud, not silently wrong)."""
         return self._decode_dispatch(
             self._decode_cache, self._variant_builder, first_token, cache,
             start_pos, limit, key, sampling, valid_start, presence, counts,
-            bias, max_steps=max_steps, with_logprobs=with_logprobs,
+            bias, constraint, max_steps=max_steps,
+            with_logprobs=with_logprobs,
         )
 
     def _variant_builder(self, variant):
-        """variant (max_steps, ragged, pres, wc, wb, logprobs) -> compiled
-        program, through the subclass's _build_decode* hooks."""
-        max_steps, ragged, pres, wc, wb, with_logprobs = variant
-        if wb or with_logprobs or wc:
+        """variant (max_steps, ragged, pres, wc, wb, wcn, logprobs) ->
+        compiled program, through the subclass's _build_decode* hooks."""
+        max_steps, ragged, pres, wc, wb, wcn, with_logprobs = variant
+        if wcn and not getattr(self, "supports_constrain", False):
+            raise NotImplementedError(
+                f"{self.name} does not support constrained decoding"
+            )
+        if wb or with_logprobs or wc or wcn:
+            kw = {"with_constraint": True} if wcn else {}
             return self._build_decode_full(
                 max_steps, ragged=ragged, with_presence=pres,
                 with_counts=wc, with_bias=wb, with_logprobs=with_logprobs,
+                **kw,
             )
         if ragged:
             return self._build_decode_ragged(max_steps, with_presence=pres)
@@ -142,7 +149,7 @@ class SPMDBackendBase:
 
     def _decode_dispatch(self, memo, builder, first_token, cache, start_pos,
                          limit, key, sampling, valid_start, presence, counts,
-                         bias, *, max_steps, with_logprobs):
+                         bias, constraint, *, max_steps, with_logprobs):
         """The ONE copy of the variant->program->args contract (memo key,
         builder selection, limit clamp, positional extra-arg order) —
         shared by the base dispatch and the 1F1B backend's plain-ring
@@ -151,7 +158,8 @@ class SPMDBackendBase:
         pres = presence is not None
         wc = counts is not None
         wb = bias is not None
-        variant = (max_steps, ragged, pres, wc, wb, with_logprobs)
+        wcn = constraint is not None
+        variant = (max_steps, ragged, pres, wc, wb, wcn, with_logprobs)
         fn = memo.get(variant)
         if fn is None:
             fn = builder(variant)
@@ -169,6 +177,8 @@ class SPMDBackendBase:
         ):
             if flag:
                 args.append(val)
+        if wcn:
+            args.extend(constraint)  # fsm0 [B], cmask [S, V], ctrans [S, V]
         return fn(*args)
 
     def health(self) -> list[dict]:
@@ -299,6 +309,11 @@ class PipelineBackend(SPMDBackendBase):
     supports_presence = True
     # OpenAI frequency/presence penalties (counts-tracked decode variants)
     supports_counts = True
+    # grammar-constrained decoding (constrain/): the FSM gathers run on
+    # the REPLICATED logits/tables after the vocab-shard all_gather, so
+    # every device samples and advances the same state — identical to the
+    # single-device stack by construction
+    supports_constrain = True
 
     # -- chunked prefill (engine: prompts beyond the largest bucket) --------
     def extend(self, tokens, pos, cache):
@@ -485,6 +500,67 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped, donate_argnums=(3,))
 
+    # -- constrained slot decode on the pp ring ------------------------------
+    @property
+    def supports_constrained_slots(self) -> bool:
+        """Grammar-constrained tenants in the continuous fleet on a pp
+        mesh: same dp == 1 slot constraint as decode_slots."""
+        return self.supports_slots
+
+    def decode_slots_constrained(self, state, cache, key, sparams, fsm,
+                                 cmask, ctrans, *, num_steps):
+        fn = self._programs.get(("slots_cn", num_steps))
+        if fn is None:
+            fn = self._build_decode_slots_constrained(num_steps)
+            self._programs[("slots_cn", num_steps)] = fn
+        return fn(self.shared, self.layers, state, cache, key, sparams,
+                  fsm, cmask, ctrans)
+
+    def _build_decode_slots_constrained(self, num_steps: int):
+        """Constrained twin of _build_decode_slots: the shared
+        slot_step_constrained (engine/generate.py) runs on the replicated
+        logits, so tokens AND fsm states are identical on every device —
+        the same one-copy parity guarantee as the unconstrained fleet."""
+        cfg, S = self.cfg, self.pp
+        from ..engine.generate import slot_step_constrained
+
+        def body(shared, layers, state, cache, key, sparams, fsm, cmask,
+                 ctrans):
+            def step(carry, sub):
+                state, cache, fsm = carry
+                x = embed_sharded(cfg, shared, state.token[:, None], state.pos, S)
+                buf, cache = self._microstep_loop(layers, x, cache, state.pos)
+                s = jax.lax.axis_index(AXIS_PP)
+                last = jax.lax.psum(
+                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
+                    AXIS_PP,
+                )
+                logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
+                new, emit, can_emit, fsm = slot_step_constrained(
+                    cfg, state, sparams, logits, sub, fsm, cmask, ctrans
+                )
+                return (new, cache, fsm), (emit, can_emit)
+
+            subs = jax.random.split(key, num_steps)
+            (state, cache, fsm), (emitted, emit_mask) = jax.lax.scan(
+                step, (state, cache, fsm), subs
+            )
+            return emitted, emit_mask, state, cache, fsm
+
+        from ..engine.generate import SlotParams, SlotState
+
+        state_specs = _replicated_specs(SlotState)
+        sparam_specs = _replicated_specs(SlotParams)
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, state_specs,
+                cache_spec(self.cfg), P(), sparam_specs, P(), P(), P(),
+            ),
+            out_specs=(P(), P(), state_specs, cache_spec(self.cfg), P()),
+        )
+        return jax.jit(shmapped, donate_argnums=(3,))
+
     # -- block-paged KV on the pp ring (round-3 review #2: the flagship
     # memory feature on the reference's flagship topology) ------------------
     @property
@@ -619,7 +695,8 @@ class PipelineBackend(SPMDBackendBase):
 
     def _build_decode_full(self, max_steps: int, *, ragged: bool,
                            with_presence: bool, with_bias: bool,
-                           with_logprobs: bool, with_counts: bool = False):
+                           with_logprobs: bool, with_counts: bool = False,
+                           with_constraint: bool = False):
         # OpenAI logit_bias and per-token logprobs on the pp mesh (round-2
         # review #3: the full request surface on every topology) — the
         # logits are replicated after the vocab-shard all_gather, so both
@@ -627,20 +704,23 @@ class PipelineBackend(SPMDBackendBase):
         return self._build_decode_any(
             max_steps, ragged=ragged, with_presence=with_presence,
             with_counts=with_counts, with_bias=with_bias,
-            with_logprobs=with_logprobs,
+            with_logprobs=with_logprobs, with_constraint=with_constraint,
         )
 
     def _build_decode_any(self, max_steps: int, *, ragged: bool,
                           with_presence: bool = False,
                           with_counts: bool = False,
                           with_bias: bool = False,
-                          with_logprobs: bool = False):
+                          with_logprobs: bool = False,
+                          with_constraint: bool = False):
         cfg, S = self.cfg, self.pp
+        from ..engine.generate import fsm_advance, fsm_allowed
 
         def body(shared, layers, first_token, cache, start_pos, limit, key,
                  sampling, *extra):
             i = 0
             valid_start = presence0 = counts0 = bias = None
+            fsm0 = cmask = ctrans = None
             if ragged:
                 valid_start = extra[i]
                 i += 1
@@ -653,6 +733,9 @@ class PipelineBackend(SPMDBackendBase):
             if with_bias:
                 bias = extra[i]
                 i += 1
+            if with_constraint:
+                fsm0, cmask, ctrans = extra[i: i + 3]
+                i += 3
             s = jax.lax.axis_index(AXIS_PP)
             key = self._dp_key(key)
             B = first_token.shape[0]
@@ -666,12 +749,13 @@ class PipelineBackend(SPMDBackendBase):
             lp0 = jnp.zeros((B, max_steps if with_logprobs else 1), jnp.float32)
 
             def cond(c):
-                step, _, _, _, _, finished, _, _, _, _, _ = c
+                step, _, _, _, _, finished, _, _, _, _, _ = c[:11]
                 return (step < limit) & ~jnp.all(finished)
 
             def step_fn(c):
                 (step, token, pos, cache, key, finished, out, n_gen, pres,
-                 cnt, lps) = c
+                 cnt, lps) = c[:11]
+                fsm = c[11] if with_constraint else None
                 x = embed_sharded(cfg, shared, token[:, None], pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos, valid_start)
                 # broadcast stage 0's real [B, 1, D] output (a masked psum
@@ -690,6 +774,9 @@ class PipelineBackend(SPMDBackendBase):
                     presence=pres if with_presence else None,
                     counts=cnt if with_counts else None,
                     bias=bias,
+                    allowed=(
+                        fsm_allowed(cmask, fsm) if with_constraint else None
+                    ),
                 )
                 if with_presence:
                     pres = presence_update(pres, nxt)
@@ -714,8 +801,11 @@ class PipelineBackend(SPMDBackendBase):
                     )
                 n_gen = n_gen + (~newly).astype(jnp.int32)
                 token = jnp.where(newly, pad, nxt)
-                return (step + 1, token, pos + 1, cache, key, newly, out,
-                        n_gen, pres, cnt, lps)
+                nc = (step + 1, token, pos + 1, cache, key, newly, out,
+                      n_gen, pres, cnt, lps)
+                if with_constraint:
+                    nc = nc + (fsm_advance(ctrans, fsm, nxt, ~newly),)
+                return nc
 
             init = (
                 jnp.int32(0),
@@ -730,9 +820,10 @@ class PipelineBackend(SPMDBackendBase):
                 cnt0,
                 lp0,
             )
-            (_, _, _, cache, _, _, out, n_gen, _, _, lps) = jax.lax.while_loop(
-                cond, step_fn, init
-            )
+            if with_constraint:
+                init = init + (fsm0,)
+            final = jax.lax.while_loop(cond, step_fn, init)
+            (_, _, _, cache, _, _, out, n_gen, _, _, lps) = final[:11]
             if with_logprobs:
                 return out, n_gen, cache, lps
             return out, n_gen, cache
@@ -749,6 +840,10 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(P(AXIS_DP))
         if with_bias:
             specs.append(P())
+        if with_constraint:
+            # fsm [B] shards with the batch; the [S, V] tables replicate
+            # (the gathers run on the replicated post-all_gather logits)
+            specs.extend([P(AXIS_DP), P(), P()])
         out_specs = [P(AXIS_DP), P(AXIS_DP), cache_spec(self.cfg)]
         if with_logprobs:
             out_specs.append(P(AXIS_DP))

@@ -291,18 +291,18 @@ class MicrobatchPipelineBackend(PipelineBackend):
     # -- decode -------------------------------------------------------------
     def decode(self, first_token, cache, start_pos, limit, key, sampling,
                valid_start=None, presence=None, counts=None, bias=None,
-               *, max_steps, with_logprobs=False):
+               constraint=None, *, max_steps, with_logprobs=False):
         """Shape-aware dispatch. Fleet-shaped plain/ragged calls (rows a
         multiple of dp*M, no variant extras) run the zero-bubble 1F1B
         schedule; every other call — solo rows, presence/counts/bias/
-        logprobs variants — runs the inherited plain-ring program from
-        PipelineBackend (correct and bit-identical to single-device, at
-        the plain ring's bubble cost — the variant paths are the rare
-        ones)."""
+        constraint/logprobs variants — runs the inherited plain-ring
+        program from PipelineBackend (correct and bit-identical to
+        single-device, at the plain ring's bubble cost — the variant
+        paths are the rare ones)."""
         rows = int(first_token.shape[0])
         extras = (
             presence is not None or counts is not None or bias is not None
-            or with_logprobs
+            or constraint is not None or with_logprobs
         )
         if rows % self.batch_granularity == 0 and not extras:
             return super().decode(
@@ -312,17 +312,20 @@ class MicrobatchPipelineBackend(PipelineBackend):
         return self._decode_dispatch(
             self._ring_variants, self._ring_builder, first_token, cache,
             start_pos, limit, key, sampling, valid_start, presence, counts,
-            bias, max_steps=max_steps, with_logprobs=with_logprobs,
+            bias, constraint, max_steps=max_steps,
+            with_logprobs=with_logprobs,
         )
 
     def _ring_builder(self, variant):
         """Plain-ring programs for the non-fleet dispatch — bypasses this
         class's 1F1B _build_decode/_build_decode_ragged overrides."""
-        max_steps, ragged, pres, wc, wb, with_logprobs = variant
-        if wb or with_logprobs or wc:
+        max_steps, ragged, pres, wc, wb, wcn, with_logprobs = variant
+        if wb or with_logprobs or wc or wcn:
+            kw = {"with_constraint": True} if wcn else {}
             return self._build_decode_full(
                 max_steps, ragged=ragged, with_presence=pres,
                 with_counts=wc, with_bias=wb, with_logprobs=with_logprobs,
+                **kw,
             )
         return self._build_decode_any(
             max_steps, ragged=ragged, with_presence=pres

@@ -80,7 +80,8 @@ def create_backend(
         )
     # weight quantization covers both families now (gpt2 projections go
     # through the quant-aware mm — ops/quant._QUANT_KEYS); an unknown arch
-    # still rejects inside quantize_params before any params init cost
+    # rejects inside quantize_params below — AFTER params init, since the
+    # registry only carries the two supported arches anyway
     if params is None:
         params = M.init_params(cfg, jax.random.PRNGKey(seed))
     if lora is not None:

@@ -15,10 +15,15 @@ Mapping notes:
   * /v1/completions is raw continuation (no chat template);
     /v1/chat/completions renders the message list through the model
     family's template (engine/chat.format_chat_messages).
-  * Unsupported OpenAI params (n>1, best_of>1, echo, suffix, logit_bias,
-    nonzero frequency/presence penalties) are rejected with a 400 error
-    object rather than silently ignored — silent acceptance would change
-    sampling semantics behind the client's back.
+  * `response_format` on /v1/chat/completions ({"type": "json_object"} or
+    {"type": "json_schema", "json_schema": {"schema": ...}}) compiles to a
+    grammar constraint (constrain/) — the completion is guaranteed to
+    parse as JSON (and validate against the schema subset) by traced
+    token masking, not prompting.
+  * Unsupported OpenAI params (best_of>1, suffix, echo outside the
+    scoring form) are rejected with a 400 error object rather than
+    silently ignored — silent acceptance would change sampling semantics
+    behind the client's back.
 """
 
 from __future__ import annotations
@@ -179,6 +184,37 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
     return kwargs
 
 
+def _response_format_constraint(rf) -> Optional[dict]:
+    """OpenAI `response_format` -> the engine's constraint spec, or None
+    for type "text". Malformed objects are 400s — a silently-ignored
+    response_format would hand the client unvalidated output under a
+    guaranteed-JSON contract, the worst possible failure mode."""
+    if not isinstance(rf, dict):
+        raise OpenAIError("response_format must be an object",
+                          param="response_format")
+    t = rf.get("type")
+    if t in (None, "text"):
+        return None
+    if t == "json_object":
+        return {"json_object": True}
+    if t == "json_schema":
+        js = rf.get("json_schema")
+        if not isinstance(js, dict):
+            raise OpenAIError(
+                "response_format.json_schema must be an object with a "
+                "'schema' member", param="response_format",
+            )
+        schema = js.get("schema")
+        if not isinstance(schema, dict):
+            raise OpenAIError(
+                "response_format.json_schema.schema must be a schema "
+                "object", param="response_format",
+            )
+        return {"json_schema": schema}
+    raise OpenAIError(f"unsupported response_format type {t!r}",
+                      param="response_format")
+
+
 def _check_n(n: int, prompts: list, kwargs: dict, stream: bool):
     """n > 1 serves as a ragged fleet of the same prompt — combinations
     the fleet cannot honor are rejected rather than silently degraded."""
@@ -206,6 +242,14 @@ def parse_completion(data: dict, cap: int):
         raise OpenAIError(
             "prompt must be a non-empty string or list of non-empty strings",
             param="prompt",
+        )
+    if data.get("response_format") is not None:
+        # structured output is a chat-completions feature (matching the
+        # OpenAI surface); silent acceptance here would change sampling
+        # semantics behind the client's back
+        raise OpenAIError(
+            "response_format is only supported on /v1/chat/completions",
+            param="response_format",
         )
     meta = {"stream": bool(data.get("stream", False)), "n": n,
             "echo_score": bool(data.get("echo"))}
@@ -253,6 +297,11 @@ def parse_chat(data: dict, render, cap: int):
     except ValueError as e:
         raise OpenAIError(str(e), param="messages") from None
     kwargs = _common_kwargs(data, cap, default_max=cap)
+    rf = data.get("response_format")
+    if rf is not None:
+        con = _response_format_constraint(rf)
+        if con is not None:
+            kwargs["constraint"] = con
     meta = {"stream": bool(data.get("stream", False)), "n": n}
     if data.get("top_logprobs"):
         # alternatives-per-position are not produced; silent empty lists

@@ -28,6 +28,7 @@ backpressure semantics are uniform.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Optional
@@ -74,6 +75,11 @@ class _Pending:
             # sampling knobs: only identical values may share a fleet
             k.get("frequency_penalty", 0.0), k.get("presence_penalty", 0.0),
             tuple(k.get("stop") or ()),
+            # a grammar constraint is fleet-shared (one [S, V] table pair
+            # broadcast over the rows), so only IDENTICAL constraints may
+            # coalesce — canonical-JSON'd because dicts don't hash
+            json.dumps(k["constraint"], sort_keys=True)
+            if k.get("constraint") is not None else None,
         )
 
 

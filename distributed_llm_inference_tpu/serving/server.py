@@ -412,6 +412,20 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     kwargs["logit_bias"] = {
                         int(k): float(v) for k, v in raw_bias.items()
                     }
+                raw_con = data.get("constraint")
+                if raw_con is not None:
+                    # grammar-constrained structured output (constrain/):
+                    # {"regex": ...} | {"choices": [...]} |
+                    # {"json_schema": {...}} | {"json_object": true}.
+                    # Spec validation happens engine-side
+                    # (parse_constraint_spec) -> invalid_request 400.
+                    if not isinstance(raw_con, dict):
+                        raise ValueError(
+                            "constraint must be an object with one of "
+                            "'regex', 'choices', 'json_schema', "
+                            "'json_object'"
+                        )
+                    kwargs["constraint"] = raw_con
                 raw_stop = data.get("stop")
                 if raw_stop is not None:
                     # OpenAI-style textual stop sequences: one string or a

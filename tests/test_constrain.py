@@ -1,0 +1,298 @@
+"""constrain/ compiler unit tests: regex -> DFA, schema -> regex -> DFA,
+token tables over the vocab trie, spec parsing/400 surface, and the fleet
+table registry. Pure host-side — no jit, fast tier.
+
+The property bar: for ANY compiled constraint, a masked sampler (greedy or
+categorical over random logits) must (a) never pick a masked-out token,
+(b) terminate (the bounded grammars are acyclic, accept-with-no-
+continuation forces EOS), and (c) produce text the ORIGINAL constraint
+accepts (Python re.fullmatch / json.loads + field checks — an independent
+oracle, not our own DFA).
+"""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu.constrain import (
+    CompiledConstraint,
+    ConstraintError,
+    FleetConstraintTable,
+    RegexError,
+    SchemaError,
+    TokenVocab,
+    compile_constraint,
+    compile_regex,
+    constraint_key,
+    constraint_to_regex,
+    parse_constraint_spec,
+)
+from distributed_llm_inference_tpu.constrain.schema import schema_to_regex
+from distributed_llm_inference_tpu.utils.tokenizer import ByteTokenizer
+
+
+def dfa_match(dfa, s: str) -> bool:
+    st = dfa.start
+    for b in s.encode():
+        st = int(dfa.trans[st, b])
+        if st < 0:
+            return False
+    return bool(dfa.accept[st])
+
+
+# -- regex -> DFA ------------------------------------------------------------
+
+REGEX_CORPUS = [
+    # (pattern, matches, non-matches) — pattern valid for Python re too,
+    # so re.fullmatch is the independent oracle
+    (r"(red|green|blue)", ["red", "green", "blue"], ["", "re", "redx"]),
+    (r"[0-9]{2,4}", ["12", "1234"], ["1", "12345", "ab"]),
+    (r"-?(0|[1-9][0-9]{0,3})(\.[0-9]{1,2})?",
+     ["0", "-0", "42", "9999.25"], ["01", "1.", ".5", "1.234"]),
+    (r"a+b*c?", ["a", "aab", "abc", "aaac"], ["", "b", "ca"]),
+    (r"\w+@\w+\.(com|org)", ["a_1@b.com", "x@y.org"], ["a@b.net", "@b.com"]),
+    (r"[^x-z]{1,3}", ["abc", "w"], ["", "xa", "abcd"]),
+    (r"yes|no|maybe( not)?", ["yes", "maybe", "maybe not"], ["may", "not"]),
+    (r"\s*\d\s*", ["5", " 5 ", "\t7\n"], ["55", "x"]),
+]
+
+
+@pytest.mark.parametrize("pattern,good,bad", REGEX_CORPUS)
+def test_regex_dfa_agrees_with_python_re(pattern, good, bad):
+    dfa = compile_regex(pattern)
+    for s in good:
+        assert re.fullmatch(pattern, s), f"corpus bug: {s!r}"
+        assert dfa_match(dfa, s), f"{pattern!r} should accept {s!r}"
+    for s in bad:
+        assert not re.fullmatch(pattern, s), f"corpus bug: {s!r}"
+        assert not dfa_match(dfa, s), f"{pattern!r} should reject {s!r}"
+
+
+def test_regex_utf8_literals_walk_bytes():
+    dfa = compile_regex("héllo")
+    assert dfa_match(dfa, "héllo") and not dfa_match(dfa, "hello")
+
+
+def test_regex_rejects_unsupported():
+    for pat in ("^abc", "a$", r"(a)\1", "a**"):
+        with pytest.raises(RegexError):
+            compile_regex(pat)
+    with pytest.raises(RegexError):
+        compile_regex("[z-a]")  # reversed range
+    with pytest.raises(RegexError):
+        compile_regex("a{1000}")  # repeat bound cap
+
+
+def test_regex_state_cap():
+    # the classic subset-construction blowup: .*a.{n} needs 2^n states
+    with pytest.raises(RegexError):
+        compile_regex(r"[ab]*a[ab]{15}")
+
+
+# -- schema -> regex ---------------------------------------------------------
+
+SCHEMA_CORPUS = [
+    {"type": "object",
+     "properties": {"name": {"type": "string"}, "age": {"type": "integer"}},
+     "required": ["name", "age"]},
+    {"type": "object",
+     "properties": {"color": {"enum": ["red", "green", "blue"]},
+                    "score": {"type": "number"},
+                    "tags": {"type": "array", "items": {"type": "string"}}},
+     "required": ["color"]},
+    {"type": "array", "items": {"type": "integer"}},
+    {"enum": ["north", "south", 42, True, None]},
+    {"type": "object",
+     "properties": {"inner": {"type": "object",
+                              "properties": {"ok": {"type": "boolean"}},
+                              "required": ["ok"]}},
+     "required": ["inner"]},
+]
+
+
+@pytest.mark.parametrize("schema", SCHEMA_CORPUS)
+def test_schema_regex_accepts_valid_instances(schema):
+    dfa = compile_regex(schema_to_regex(schema))
+    # hand-built valid instances per corpus entry
+    samples = {
+        0: ['{"name":"bob","age":42}', '{"name":"","age":-7}'],
+        1: ['{"color":"red","score":1.5,"tags":["a"]}',
+            '{"color":"blue","score":-2e4,"tags":[]}'],
+        2: ["[]", "[1,2,3]"],
+        3: ['"north"', "42", "true", "null"],
+        4: ['{"inner":{"ok":true}}'],
+    }[SCHEMA_CORPUS.index(schema)]
+    for s in samples:
+        json.loads(s)  # corpus sanity
+        assert dfa_match(dfa, s), f"schema should accept {s}"
+
+
+def test_schema_rejects_invalid_instances():
+    dfa = compile_regex(schema_to_regex(SCHEMA_CORPUS[0]))
+    for s in ['{"name":"bob"}', '{"age":42,"name":"b"}', "{}", "[1]",
+              '{"name":"bob","age":"x"}']:
+        assert not dfa_match(dfa, s)
+
+
+def test_schema_errors():
+    with pytest.raises(SchemaError):
+        schema_to_regex({"type": "tuple"})
+    with pytest.raises(SchemaError):
+        schema_to_regex({"type": "object", "properties": {"a": {"type": "string"}},
+                         "required": ["b"]})
+    with pytest.raises(SchemaError):
+        schema_to_regex({"enum": []})
+    with pytest.raises(SchemaError):
+        schema_to_regex({"enum": [{"nested": 1}]})
+
+
+# -- spec parsing (the serving 400 surface) ----------------------------------
+
+def test_parse_constraint_spec():
+    assert parse_constraint_spec({"regex": "a+"})["kind"] == "regex"
+    assert parse_constraint_spec({"choices": ["a"]})["kind"] == "choices"
+    assert parse_constraint_spec({"json_object": True})["kind"] == "json_object"
+    s = parse_constraint_spec({"json_schema": {"type": "string"}})
+    assert s == {"kind": "json_schema", "schema": {"type": "string"}}
+    for bad in (
+        "regex", {}, {"regex": "a", "choices": ["b"]}, {"regex": ""},
+        {"choices": []}, {"choices": ["a", 3]}, {"json_object": "yes"},
+        {"json_schema": "x"}, {"bogus": 1},
+    ):
+        with pytest.raises(ConstraintError):
+            parse_constraint_spec(bad)
+
+
+def test_constraint_key_canonical():
+    a = constraint_key(parse_constraint_spec({"json_schema": {"type": "object", "properties": {"a": {"type": "string"}}}}))
+    b = constraint_key(parse_constraint_spec({"json_schema": {"properties": {"a": {"type": "string"}}, "type": "object"}}))
+    assert a == b  # key order canonicalized
+    c = constraint_key(parse_constraint_spec({"regex": "a+"}))
+    assert c != a
+
+
+# -- token tables ------------------------------------------------------------
+
+def _byte_vocab(vocab_size=256):
+    return TokenVocab.from_tokenizer(
+        ByteTokenizer(), vocab_size, eos_ids=(2,), special_ids=(0, 1, 2)
+    )
+
+
+def _simulate(art: CompiledConstraint, tok, rng, greedy: bool,
+              max_steps=600):
+    """Host replica of the constrained sampler: masked draw + table
+    advance. Returns decoded text; asserts termination."""
+    st = art.start
+    out = []
+    for _ in range(max_steps):
+        logits = rng.normal(size=art.mask.shape[1])
+        masked = np.where(art.mask[st], logits, -1e30)
+        if greedy:
+            tid = int(np.argmax(masked))
+        else:
+            p = np.exp(masked - masked.max())
+            p /= p.sum()
+            tid = int(rng.choice(len(p), p=p))
+        assert art.mask[st, tid], "sampler picked a masked token"
+        if tid == 2:  # eos
+            return tok.decode(out)
+        out.append(tid)
+        st = art.advance(st, tid)
+    raise AssertionError("constrained generation did not terminate")
+
+
+@pytest.mark.parametrize("spec,check", [
+    ({"regex": r"(red|green|blue)"},
+     lambda t: re.fullmatch(r"(red|green|blue)", t)),
+    ({"regex": r"[0-9]{2,4}(\.[0-9])?"},
+     lambda t: re.fullmatch(r"[0-9]{2,4}(\.[0-9])?", t)),
+    ({"choices": ["alpha", "beta", "alphabet"]},
+     lambda t: t in ("alpha", "beta", "alphabet")),
+    ({"json_object": True}, lambda t: isinstance(json.loads(t), dict)),
+    ({"json_schema": SCHEMA_CORPUS[0]},
+     lambda t: isinstance(json.loads(t)["age"], int)),
+    ({"json_schema": SCHEMA_CORPUS[1]},
+     lambda t: json.loads(t)["color"] in ("red", "green", "blue")),
+    ({"json_schema": SCHEMA_CORPUS[2]},
+     lambda t: isinstance(json.loads(t), list)),
+])
+def test_masked_sampling_property(spec, check):
+    """Greedy AND categorical draws over random logits always produce
+    output the original constraint accepts (independent oracle)."""
+    tok = ByteTokenizer()
+    art = compile_constraint(spec, _byte_vocab())
+    rng = np.random.default_rng(0)
+    for trial in range(8):
+        for greedy in (True, False):
+            text = _simulate(art, tok, rng, greedy)
+            assert check(text), f"{spec} produced {text!r}"
+
+
+def test_eos_only_in_accept_states():
+    art = compile_constraint({"choices": ["ab"]}, _byte_vocab())
+    a = ord("a") + ByteTokenizer.OFFSET
+    b = ord("b") + ByteTokenizer.OFFSET
+    assert not art.mask[art.start, 2]  # can't end before "ab"
+    st = art.advance(art.start, a)
+    assert not art.mask[st, 2]
+    st = art.advance(st, b)
+    # accept with no live continuation: ONLY eos remains (forced)
+    assert art.mask[st, 2]
+    assert art.mask[st].sum() == 1
+
+
+def test_special_tokens_never_allowed():
+    art = compile_constraint({"regex": ".*"}, _byte_vocab())
+    assert not art.mask[:, 0].any() and not art.mask[:, 1].any()  # pad/bos
+
+
+def test_start_bias_matches_start_mask():
+    art = compile_constraint({"choices": ["no", "yes"]}, _byte_vocab())
+    bias = art.start_bias()
+    assert (bias[art.mask[art.start]] == 0).all()
+    assert (bias[~art.mask[art.start]] == -1e9).all()
+
+
+# -- fleet table registry ----------------------------------------------------
+
+def test_fleet_table_acquire_release_compact():
+    v = _byte_vocab()
+    a = compile_constraint({"choices": ["aa"]}, v)
+    b = compile_constraint({"choices": ["bbb"]}, v)
+    ft = FleetConstraintTable(256, max_states=32)
+    off_a = ft.acquire(a)
+    assert off_a == 1  # row 0 is the free state
+    assert ft.acquire(a) == off_a  # resident reuse
+    off_b = ft.acquire(b)
+    assert off_b == off_a + a.num_states
+    mask, trans = ft.numpy_tables()
+    assert mask.shape[0] == 32  # bucket-padded
+    assert mask[0].all() and (trans[0] == 0).all()  # free row
+    # rebased rows equal the artifact rows
+    assert (mask[off_b: off_b + b.num_states] == b.mask).all()
+    assert (trans[off_b: off_b + b.num_states] == b.next_state + off_b).all()
+    # full table backpressures (None), releases allow compaction
+    big = compile_constraint({"regex": "[a-z]{28}"}, v)
+    assert ft.fits(big)
+    assert ft.acquire(big) is None  # no room while a+b resident
+    for _ in range(3):
+        ft.release(a.key)
+    ft.release(b.key)
+    ft.release(b.key)
+    assert not ft.any_active
+    assert ft.acquire(big) == 1  # compacted: registry reset, rows reused
+    never = compile_constraint({"regex": "[a-z]{40}"}, v)
+    assert not ft.fits(never)  # can never fit max_states=32 -> solo route
+
+
+def test_fleet_free_row_is_inert():
+    """Unconstrained slots sit at state 0: every token allowed, state
+    pinned — the constrained program is a no-op for them."""
+    ft = FleetConstraintTable(256, max_states=32)
+    ft.acquire(compile_constraint({"choices": ["x"]}, _byte_vocab()))
+    mask, trans = ft.numpy_tables()
+    assert mask[0].all()
+    assert (trans[0] == 0).all()

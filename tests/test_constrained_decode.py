@@ -1,0 +1,271 @@
+"""Grammar-constrained decoding through the engine (single device, fast
+tier): solo + batched property tests (greedy AND sampled output always
+satisfies the constraint, judged by the independent Python re / json
+oracle), composition with the other sampling features, the 400 surface for
+unsupported combos, and the zero-Python-per-token guarantee (no host
+callbacks in the compiled constrained program).
+"""
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64)))
+
+
+CASES = [
+    ({"regex": r"(red|green|blue)"},
+     lambda t: re.fullmatch(r"(red|green|blue)", t)),
+    ({"regex": r"[0-9]{2,4}"}, lambda t: re.fullmatch(r"[0-9]{2,4}", t)),
+    ({"choices": ["alpha", "beta", "alphabet"]},
+     lambda t: t in ("alpha", "beta", "alphabet")),
+    ({"json_schema": {"type": "object",
+                      "properties": {"name": {"type": "string"},
+                                     "age": {"type": "integer"}},
+                      "required": ["name", "age"]}},
+     lambda t: isinstance(json.loads(t)["age"], int)),
+]
+
+
+@pytest.mark.parametrize("spec,check", CASES)
+def test_solo_constrained_greedy_and_sampled(engine, spec, check):
+    for kw in (dict(greedy=True), dict(temperature=1.5, top_k=0, top_p=1.0,
+                                       seed=3)):
+        r = engine.generate("the answer:", max_tokens=120, chat=False,
+                            constraint=spec, **kw)
+        assert r["status"] == "success", r
+        assert r.get("constrained") is True
+        assert check(r["response"]), (spec, r["response"])
+        # the constraint completed inside the budget: finish_reason stop
+        # (EOS forced at the accept state), never a length truncation
+        assert r["finish_reason"] == "stop", r
+
+
+def test_solo_sampled_many_seeds(engine):
+    """Property: across many sampled draws, output ALWAYS matches."""
+    pat = r"-?(0|[1-9][0-9]{0,2})(\.[0-9])?"
+    for seed in range(6):
+        r = engine.generate("n:", max_tokens=40, chat=False, seed=seed,
+                            temperature=2.0, top_k=0, top_p=1.0,
+                            constraint={"regex": pat})
+        assert re.fullmatch(pat, r["response"]), r["response"]
+
+
+def test_batched_constrained(engine):
+    pat = r"(yes|no|maybe)"
+    r = engine.generate_batch(
+        ["q1:", "a much longer second prompt row", "q3:"],
+        max_tokens=20, greedy=True, chat=False, constraint={"regex": pat},
+    )
+    assert r["status"] == "success", r
+    assert r.get("constrained") is True
+    for e in r["results"]:
+        assert re.fullmatch(pat, e["response"]), e
+
+
+def test_batched_constrained_sampled(engine):
+    pat = r"[ab]{1,6}!"
+    r = engine.generate_batch(
+        ["x", "y"], max_tokens=20, temperature=1.7, top_k=0, top_p=1.0,
+        seed=11, chat=False, constraint={"regex": pat},
+    )
+    for e in r["results"]:
+        assert re.fullmatch(pat, e["response"]), e
+
+
+def test_constraint_composes_with_penalties_and_bias(engine):
+    """The mask stacks on top of logit_bias + penalties: a +100 bias on a
+    banned token must NOT resurrect it."""
+    banned = ord("c") + 3  # ByteTokenizer id for 'c'
+    r = engine.generate(
+        "go:", max_tokens=30, greedy=True, chat=False,
+        constraint={"regex": "(ab|cd)"},
+        logit_bias={banned: 100.0},
+    )
+    # 'c' carries +100 raw bias, so under the mask the only question is
+    # whether cd (allowed) wins — either way the output matches
+    assert re.fullmatch("ab|cd", r["response"]), r
+    r2 = engine.generate(
+        "go:", max_tokens=60, greedy=True, chat=False,
+        repetition_penalty=1.3, frequency_penalty=0.5,
+        constraint={"regex": "[ab]{1,8}"},
+    )
+    assert re.fullmatch("[ab]{1,8}", r2["response"]), r2
+
+
+def test_constraint_with_textual_stop_chunks(engine):
+    """stop strings route through the chunked decode path; the host-side
+    FSM re-walk between chunks must keep the mask exact."""
+    pat = "[0-9]{1,12}"
+    r = engine.generate(
+        "n:", max_tokens=25, greedy=True, chat=False,
+        constraint={"regex": pat}, stop=["zzz-never-matches"],
+    )
+    assert r["status"] == "success"
+    assert re.fullmatch(pat, r["response"]), r
+
+
+def test_constraint_with_logprobs(engine):
+    r = engine.generate(
+        "pick:", max_tokens=20, greedy=True, chat=False, logprobs=True,
+        constraint={"choices": ["on", "off"]},
+    )
+    assert r["response"] in ("on", "off")
+    assert len(r["token_logprobs"]) == len(r["response"])  # byte tokenizer
+
+
+def test_unsupported_combos_reject(engine):
+    r = engine.generate("x", constraint={"regex": "a"}, num_beams=2)
+    assert r["status"] == "failed" and r["error_type"] == "invalid_request"
+    r = engine.generate("x", constraint={"regex": "a"}, speculative=True,
+                        greedy=True)
+    assert r["status"] == "failed" and r["error_type"] == "invalid_request"
+
+
+def test_malformed_constraints_reject(engine):
+    for bad in ({"bogus": 1}, {"regex": ""}, {"regex": "("},
+                {"choices": []}, {"json_schema": {"type": "tuple"}},
+                {"regex": "a", "choices": ["b"]}):
+        r = engine.generate("x", constraint=bad)
+        assert r["status"] == "failed", bad
+        assert r["error_type"] == "invalid_request", (bad, r)
+
+
+def test_artifact_cache_reuse(engine):
+    spec = {"regex": "cache(d|r)"}
+    engine.generate("x", max_tokens=15, greedy=True, chat=False,
+                    constraint=spec)
+    n = len(engine._constraint_cache)
+    engine.generate("y", max_tokens=15, greedy=True, chat=False,
+                    constraint=spec)
+    assert len(engine._constraint_cache) == n  # hash hit, no recompile
+
+
+def test_constrained_decode_has_no_host_callbacks(engine):
+    """Acceptance: the constrained decode loop stays zero-Python-per-token
+    — the lowered program contains no host callback custom-calls."""
+    cfg = engine.cfg
+    art = engine._compile_constraint({"regex": "[ab]{1,8}"})
+    cm, ct = art.device_tables()
+    cache = engine.backend.init_cache(1, cfg.max_seq_len)
+    lowered = jax.jit(
+        G.decode, static_argnames=("cfg", "max_steps"),
+    ).lower(
+        cfg, engine.backend.params, jnp.zeros((1,), jnp.int32), cache,
+        jnp.int32(4), jnp.int32(8), jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True),
+        None, None, None, None,
+        (jnp.zeros((1,), jnp.int32), cm, ct),
+        max_steps=16,
+    )
+    text = lowered.as_text()
+    assert "callback" not in text.lower()
+    assert "while" in text  # the loop really is compiled
+
+
+def test_unconstrained_loop_carry_unchanged(engine):
+    """constraint=None traces the SAME loop carry as before the feature
+    (no dummy fsm rides unconstrained programs): the lowered while-loop
+    carries one fewer tensor than the constrained variant."""
+    cfg = engine.cfg
+
+    def n_carry(constraint):
+        cache = engine.backend.init_cache(1, cfg.max_seq_len)
+        lowered = jax.jit(
+            G.decode, static_argnames=("cfg", "max_steps"),
+        ).lower(
+            cfg, engine.backend.params, jnp.zeros((1,), jnp.int32), cache,
+            jnp.int32(4), jnp.int32(8), jax.random.PRNGKey(0),
+            G.default_sampling(greedy=True),
+            None, None, None, None, constraint,
+            max_steps=16,
+        )
+        import re as _re
+
+        # count the while op's carry arity in the stablehlo text
+        m = _re.search(r"stablehlo\.while", lowered.as_text())
+        return lowered.as_text().count("stablehlo.while"), m is not None
+
+    art = engine._compile_constraint({"regex": "[ab]{1,8}"})
+    cm, ct = art.device_tables()
+    un = jax.jit(G.decode, static_argnames=("cfg", "max_steps")).lower(
+        cfg, engine.backend.params, jnp.zeros((1,), jnp.int32),
+        engine.backend.init_cache(1, cfg.max_seq_len),
+        jnp.int32(4), jnp.int32(8), jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True), None, None, None, None, None,
+        max_steps=16,
+    ).as_text()
+    con = jax.jit(G.decode, static_argnames=("cfg", "max_steps")).lower(
+        cfg, engine.backend.params, jnp.zeros((1,), jnp.int32),
+        engine.backend.init_cache(1, cfg.max_seq_len),
+        jnp.int32(4), jnp.int32(8), jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True), None, None, None, None,
+        (jnp.zeros((1,), jnp.int32), cm, ct),
+        max_steps=16,
+    ).as_text()
+    # the constrained trace gathers from the [S, V] tables; the
+    # unconstrained trace must not even mention their shape
+    S = art.num_states
+    assert f"{S}x{cfg.vocab_size}" in con
+    assert f"{S}x{cfg.vocab_size}" not in un
+
+
+def test_decode_slots_constrained_matches_plain_when_free(engine):
+    """Device-level: with every slot at the FREE state (row 0), the
+    constrained slot program emits exactly what plain decode_slots emits —
+    the free row really is a no-op."""
+    cfg = engine.cfg
+    backend = engine.backend
+    sampling = G.default_sampling(greedy=True)
+    key = jax.random.PRNGKey(7)
+    tokens = jnp.asarray(
+        [[cfg.bos_token_id, 11, 12, 13, 14, 15, 16, 17]], jnp.int32
+    )
+    tokens = jnp.pad(tokens, ((0, 0), (0, 24)), constant_values=cfg.pad_token_id)
+    plen = jnp.int32(8)
+
+    def arm(cache, state, sparams, first):
+        return G.insert_slot(
+            cfg, cache, scratch, state, sparams, 1, first[0], plen,
+            jnp.int32(9),
+            jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0), jnp.bool_(True),
+            jnp.float32(0.0), jnp.float32(1.0),
+            jnp.float32(0.0), jnp.float32(0.0),
+            jnp.zeros((cfg.vocab_size,), bool),
+        )
+
+    outs = []
+    for constrained in (False, True):
+        cache = backend.init_cache(2, cfg.max_seq_len)
+        state, sparams = G.init_slots(2, cfg.vocab_size)
+        scratch = backend.init_cache(1, cfg.max_seq_len)
+        first, _, scratch = backend.prefill(tokens, plen, scratch, key, sampling)
+        cache, state, sparams = arm(cache, state, sparams, first)
+        if constrained:
+            # free-state tables: 1 row, everything allowed, self-loop
+            cm = jnp.ones((1, cfg.vocab_size), bool)
+            ct = jnp.zeros((1, cfg.vocab_size), jnp.int32)
+            fsm = jnp.zeros((2,), jnp.int32)
+            emitted, mask, state, cache, fsm = backend.decode_slots_constrained(
+                state, cache, key, sparams, fsm, cm, ct, num_steps=10
+            )
+            assert (np.asarray(fsm) == 0).all()
+        else:
+            emitted, mask, state, cache = backend.decode_slots(
+                state, cache, key, sparams, num_steps=10
+            )
+        emitted, mask = np.asarray(emitted), np.asarray(mask)
+        outs.append([int(t) for t in emitted[mask[:, 1], 1]])
+    assert outs[0] == outs[1]

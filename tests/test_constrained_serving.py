@@ -1,0 +1,202 @@
+"""Structured output over HTTP: OpenAI `response_format` round-trips on
+/v1/chat/completions (valid JSON parsed from the response for every schema
+in the corpus), the `"constraint"` field on /generate, and the 400 surface
+for malformed specs and unsupported combos — all over real HTTP against a
+served tiny model (same harness as test_openai_api)."""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_llm_inference_tpu import (
+    EngineConfig, create_engine, get_model_config,
+)
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+
+
+@pytest.fixture(scope="module")
+def served():
+    # a longer window than the stock tiny config: the chat template eats a
+    # 64-token prefill bucket and a schema-shaped JSON object needs up to
+    # ~150 decode tokens — the decode budget is max_seq - bucket - 1
+    engine = create_engine(
+        get_model_config("test-llama-tiny", max_seq_len=512),
+        engine_cfg=EngineConfig(prefill_buckets=(64, 128)),
+    )
+    server = InferenceServer(engine, host="127.0.0.1", port=0,
+                             max_tokens_cap=256)
+    server.start()
+    yield server
+    server.shutdown()
+
+
+def _post(server, path, body, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_err(server, path, body):
+    try:
+        _post(server, path, body)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+    raise AssertionError("expected an HTTP error")
+
+
+SCHEMAS = [
+    {"type": "object",
+     "properties": {"name": {"type": "string"}, "age": {"type": "integer"}},
+     "required": ["name", "age"]},
+    {"type": "object",
+     "properties": {"color": {"enum": ["red", "green", "blue"]},
+                    "ok": {"type": "boolean"}},
+     "required": ["color", "ok"]},
+    {"type": "object",
+     "properties": {"items": {"type": "array",
+                              "items": {"type": "integer"}}},
+     "required": ["items"]},
+]
+
+
+@pytest.mark.parametrize("schema", SCHEMAS)
+def test_response_format_json_schema_round_trip(served, schema):
+    """Acceptance: valid JSON parsed from the response for every schema in
+    the corpus, over the real OpenAI route."""
+    out = _post(served, "/v1/chat/completions", {
+        "model": "test-llama-tiny",
+        "messages": [{"role": "user", "content": "emit the object"}],
+        "max_tokens": 200,
+        "temperature": 0,
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"name": "obj", "schema": schema}},
+    })
+    text = out["choices"][0]["message"]["content"]
+    obj = json.loads(text)  # MUST parse — that's the whole feature
+    for k in schema.get("required", []):
+        assert k in obj, (schema, text)
+
+
+def test_response_format_json_object(served):
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "give me json"}],
+        "max_tokens": 200,
+        "temperature": 0,
+        "response_format": {"type": "json_object"},
+    })
+    obj = json.loads(out["choices"][0]["message"]["content"])
+    assert isinstance(obj, dict)
+
+
+def test_response_format_sampled_round_trip(served):
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "emit"}],
+        "max_tokens": 200,
+        "temperature": 1.4,
+        "seed": 5,
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"schema": SCHEMAS[0]}},
+    })
+    obj = json.loads(out["choices"][0]["message"]["content"])
+    assert isinstance(obj["age"], int)
+
+
+def test_response_format_text_is_noop(served):
+    out = _post(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 5, "temperature": 0,
+        "response_format": {"type": "text"},
+    })
+    assert out["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_response_format_malformed_400(served):
+    for rf in ("json", {"type": "yaml"}, {"type": "json_schema"},
+               {"type": "json_schema", "json_schema": {"schema": "x"}}):
+        code, body = _post_err(served, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "x"}],
+            "response_format": rf,
+        })
+        assert code == 400, rf
+        assert body["error"]["param"] == "response_format"
+
+
+def test_response_format_rejected_on_completions(served):
+    code, body = _post_err(served, "/v1/completions", {
+        "prompt": "x", "response_format": {"type": "json_object"},
+    })
+    assert code == 400
+    assert body["error"]["param"] == "response_format"
+
+
+def test_unsupported_schema_is_400_not_500(served):
+    code, body = _post_err(served, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "x"}],
+        "response_format": {"type": "json_schema",
+                            "json_schema": {"schema": {"type": "tuple"}}},
+    })
+    assert code == 400
+    assert "invalid_request" in body["error"]["type"]
+
+
+# -- native /generate "constraint" field -------------------------------------
+
+def test_generate_constraint_regex(served):
+    out = _post(served, "/generate", {
+        "prompt": "pick a color:", "chat": False, "greedy": True,
+        "max_tokens": 20, "constraint": {"regex": "(red|green|blue)"},
+    })
+    assert out["status"] == "success"
+    assert re.fullmatch("red|green|blue", out["response"])
+    assert out.get("constrained") is True
+
+
+def test_generate_constraint_choices_and_schema(served):
+    out = _post(served, "/generate", {
+        "prompt": "pick:", "chat": False, "greedy": True, "max_tokens": 20,
+        "constraint": {"choices": ["on", "off"]},
+    })
+    assert out["response"] in ("on", "off")
+    out = _post(served, "/generate", {
+        "prompt": "emit:", "chat": False, "greedy": True, "max_tokens": 200,
+        "constraint": {"json_schema": SCHEMAS[0]},
+    })
+    assert isinstance(json.loads(out["response"])["age"], int)
+
+
+def test_generate_constraint_batched_prompts(served):
+    out = _post(served, "/generate", {
+        "prompts": ["a:", "b:"], "chat": False, "greedy": True,
+        "max_tokens": 20, "constraint": {"regex": "[0-9]{2,3}"},
+    })
+    assert out["status"] == "success"
+    for e in out["results"]:
+        assert re.fullmatch(r"[0-9]{2,3}", e["response"]), e
+
+
+def test_generate_constraint_400s(served):
+    # malformed spec shapes -> 400, never 500
+    for con in ("regex", {"regex": ""}, {"bogus": 1},
+                {"regex": "a", "choices": ["b"]}, {"regex": "(unclosed"}):
+        code, body = _post_err(served, "/generate", {
+            "prompt": "x", "constraint": con,
+        })
+        assert code == 400, con
+    # unsupported combos: constraint x speculative / x beam
+    code, body = _post_err(served, "/generate", {
+        "prompt": "x", "greedy": True, "speculative": True,
+        "constraint": {"regex": "a+"},
+    })
+    assert code == 400 and "speculative" in body["error"]
+    code, body = _post_err(served, "/generate", {
+        "prompt": "x", "num_beams": 4, "constraint": {"regex": "a+"},
+    })
+    assert code == 400 and "num_beams" in body["error"]
