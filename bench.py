@@ -682,6 +682,10 @@ def run_benchmark():
                 wall = time.perf_counter() - t0
                 return (done_tokens[0] / wall) if done_tokens[0] else None
 
+            from distributed_llm_inference_tpu.utils.metrics import (
+                latency_summary,
+            )
+
             eng = InferenceEngine(c_cfg, params=c_params)
             # slot_max_seq on every leg: the tiny engine's default slot
             # capacity (128) is smaller than a byte-tokenized 32-word
@@ -694,6 +698,10 @@ def run_benchmark():
                 v = churn(cont, prompts)
                 if v:
                     cont_block["dense_tokens_per_sec"] = round(v, 3)
+                    # registry snapshot of the dense leg: TTFT/TPOT/step
+                    # percentiles + occupancy, so BENCH_*.json rounds
+                    # carry the stage-level signal, not just tok/s
+                    cont_block["metrics"] = latency_summary(eng.metrics)
             finally:
                 cont.close()
             _write_sidecar(dict(result, continuous=cont_block))

@@ -101,6 +101,10 @@ def main():
             t.join()
         wall = time.perf_counter() - t0
         lat.sort()
+        from distributed_llm_inference_tpu.utils.metrics import (
+            latency_summary,
+        )
+
         out = {
             "continuous_tok_s": round(tokens[0] / wall, 2),
             "solo_tok_s": round(solo_tok_s, 2),
@@ -113,6 +117,10 @@ def main():
             "max_tokens": args.max_tokens,
             "platform": platform,
             "peak_occupancy": cont.stats()["peak_occupancy"],
+            # the registry's view of the same run: TTFT/TPOT/step-time
+            # percentiles + occupancy — the per-request stage signal the
+            # aggregate tok/s number cannot show
+            "metrics": latency_summary(eng.metrics),
         }
         print(json.dumps(out))
     finally:

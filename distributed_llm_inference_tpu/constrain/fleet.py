@@ -33,13 +33,38 @@ STATE_BUCKETS = (32, 64, 128, 256, 512, 1024)
 
 
 class FleetConstraintTable:
-    def __init__(self, vocab_size: int, max_states: int = STATE_BUCKETS[-1]):
+    def __init__(self, vocab_size: int, max_states: int = STATE_BUCKETS[-1],
+                 registry=None):
         self.vocab_size = int(vocab_size)
         self.max_states = int(max_states)
         self._entries: dict = {}  # key -> {"art", "offset", "refs"}
         self._total = 1  # row 0 = the free state
         self._np: Optional[tuple] = None  # (mask, trans) padded to bucket
         self._dev: Optional[tuple] = None
+        # /metrics residency + backpressure (utils/metrics.py): gauges
+        # track resident artifacts / occupied state rows, the counter
+        # counts acquire() refusals (the requeue-and-retry backpressure
+        # events the paged pool also reports)
+        self._m_resident = self._m_states = self._m_backpressure = None
+        if registry is not None:
+            self._m_resident = registry.gauge(
+                "dli_constraint_entries_resident",
+                "constraint artifacts resident in the fleet table",
+            ).labels()
+            self._m_states = registry.gauge(
+                "dli_constraint_states_resident",
+                "fleet-table state rows occupied (row 0 = free state)",
+            ).labels()
+            self._m_states.set(self._total)
+            self._m_backpressure = registry.counter(
+                "dli_constraint_backpressure_total",
+                "admissions refused because the fleet table was full",
+            ).labels()
+
+    def _update_gauges(self):
+        if self._m_resident is not None:
+            self._m_resident.set(len(self._entries))
+            self._m_states.set(self._total)
 
     @property
     def any_active(self) -> bool:
@@ -64,11 +89,15 @@ class FleetConstraintTable:
             self._total = 1
             self._np = self._dev = None
         if self._total + art.num_states > self.max_states:
+            self._update_gauges()
+            if self._m_backpressure is not None:
+                self._m_backpressure.inc()
             return None
         offset = self._total
         self._entries[art.key] = {"art": art, "offset": offset, "refs": 1}
         self._total += art.num_states
         self._np = self._dev = None
+        self._update_gauges()
         return offset
 
     def release(self, key: str):

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.logging import get_logger
+from ..utils.tracing import Trace
 from . import generate as G
 
 log = get_logger("continuous")
@@ -60,11 +61,17 @@ class _Request:
         "first_id", "tokens", "slot", "enqueued", "budget",
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
         "cancelled", "prompt_tokens", "block_ids", "need", "cart",
+        "trace",
     )
 
-    def __init__(self, prompt: str, kwargs: dict, stream_q=None):
+    def __init__(self, prompt: str, kwargs: dict, stream_q=None,
+                 request_id=None):
         self.prompt = prompt
         self.kwargs = kwargs
+        # per-request stage trace (utils/tracing.py): queue_wait /
+        # admission / decode / detokenize spans + the request id echoed
+        # in the response and the X-Request-Id header
+        self.trace = Trace(request_id)
         self.done = threading.Event()
         self.result: Optional[dict] = None
         self.enqueued = time.time()
@@ -189,7 +196,9 @@ class ContinuousEngine:
             self.cache = self.backend.init_paged_pool(
                 int(kv_pool_blocks), self.kv_block_size
             )
-            self._alloc = P.BlockAllocator(int(kv_pool_blocks))
+            self._alloc = P.BlockAllocator(
+                int(kv_pool_blocks), registry=engine.metrics
+            )
             # host-side block tables; device copy rebuilt lazily on change
             self._table = np.zeros(
                 (self.n_slots, self._max_blocks), np.int32
@@ -214,6 +223,7 @@ class ContinuousEngine:
         self._ctable = FleetConstraintTable(
             cfg.vocab_size,
             max_states=engine.engine_cfg.constraint_fleet_states,
+            registry=engine.metrics,
         )
         # scratch must match the fleet's logical extent: the insert splices
         # the whole row (dense) / scatters every logical block (paged)
@@ -231,6 +241,7 @@ class ContinuousEngine:
                 self._prefix = PrefixCache(
                     engine.engine_cfg.prefix_cache_entries,
                     engine.engine_cfg.prefix_chunk,
+                    registry=engine.metrics, scope="continuous",
                 )
             else:
                 log.info("prefix_cache_disabled", reason="cache layout")
@@ -243,6 +254,35 @@ class ContinuousEngine:
         self.admitted = 0
         self.completed = 0
         self.peak_occupancy = 0
+        # registry families (engine.metrics — the one registry /metrics
+        # scrapes): fleet occupancy, queue depth, admission waits, chunk
+        # launch-to-fetch step time, preemptions
+        m = engine.metrics
+        m.gauge(
+            "dli_slots_total", "continuous-fleet decode slots"
+        ).labels().set(self.n_slots)
+        self._m_occupied = m.gauge(
+            "dli_slots_occupied", "continuous-fleet slots serving a request"
+        ).labels()
+        self._m_depth = m.gauge(
+            "dli_queue_depth", "requests waiting for dispatch", ("queue",)
+        ).labels(queue="continuous")
+        self._m_admission_wait = m.histogram(
+            "dli_admission_wait_seconds",
+            "enqueue-to-admission wait", ("queue",),
+        ).labels(queue="continuous")
+        self._m_step = m.histogram(
+            "dli_decode_step_seconds",
+            "per-token decode step time, chunk launch-to-fetch / "
+            "chunk_steps (includes pipelining lag)", ("engine",),
+        ).labels(engine="continuous")
+        self._m_preempt = m.counter(
+            "dli_preemptions_total",
+            "slots killed before their budget drained", ("reason",),
+        )
+        self._m_shed = m.counter(
+            "dli_queue_shed_total", "requests shed with 429", ("queue",)
+        ).labels(queue="continuous")
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-engine"
         )
@@ -295,19 +335,22 @@ class ContinuousEngine:
                 }
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue))
+                self._m_shed.inc()
                 return {
                     "error": f"Error: request queue full ({self.max_queue})",
                     "status": "failed",
                     "error_type": "overloaded",
                 }
             self._queue.append(req)
+            self._m_depth.set(len(self._queue))
             self._cv.notify_all()
         return None
 
     def submit(self, prompt: str, **kwargs) -> dict:
         if self._needs_solo(kwargs):
             return self.engine.generate(prompt, **kwargs)
-        req = _Request(prompt, kwargs)
+        req = _Request(prompt, kwargs,
+                       request_id=kwargs.pop("request_id", None))
         err = self._enqueue(req)
         if err is not None:
             return err
@@ -335,7 +378,8 @@ class ContinuousEngine:
         import queue as _queue
 
         q: _queue.Queue = _queue.Queue()
-        req = _Request(prompt, kwargs, stream_q=q)
+        req = _Request(prompt, kwargs, stream_q=q,
+                       request_id=kwargs.pop("request_id", None))
         err = self._enqueue(req)  # error yielded OUTSIDE the engine lock:
         if err is not None:  # the consumer may block on a slow socket write
             yield {**err, "done": True}
@@ -359,6 +403,7 @@ class ContinuousEngine:
         with self._cv:
             if req in self._queue:
                 self._queue.remove(req)
+                self._m_depth.set(len(self._queue))
                 req.result = {
                     "error": "Error: request cancelled", "status": "failed",
                     "error_type": "cancelled",
@@ -409,6 +454,7 @@ class ContinuousEngine:
         with self._cv:
             pending = self._queue[:]
             self._queue.clear()
+            self._m_depth.set(0)
         for req in pending + [r for r in self._assignment if r is not None]:
             if req.result is None:
                 req.result = dict(fail)
@@ -542,7 +588,9 @@ class ContinuousEngine:
                         )
                     )
                 packed = G.pack_chunk(emitted, mask, self.state.active)
-                inflight.append((packed, list(self._assignment)))
+                inflight.append(
+                    (packed, list(self._assignment), time.perf_counter())
+                )
                 launched = True
             # Block on the oldest chunk when MORE than chunk_lag chunks
             # are unprocessed (so chunk_lag=1 keeps one outstanding after
@@ -580,6 +628,7 @@ class ContinuousEngine:
                     # on every chunk iteration; wait for a release
                     break
                 req = self._queue.pop(0)
+                self._m_depth.set(len(self._queue))
             try:
                 first_dev = self._admit_one(req, free[0])
                 if first_dev is _BLOCKED:
@@ -588,6 +637,7 @@ class ContinuousEngine:
                     # blocks — the fleet keeps decoding meanwhile
                     with self._cv:
                         self._queue.insert(0, req)
+                        self._m_depth.set(len(self._queue))
                     break
                 if first_dev is not None:  # None: failed fast (e.g. queued
                     wave.append((req, first_dev))  # past deadline), result set
@@ -628,6 +678,9 @@ class ContinuousEngine:
 
     def _admit_one(self, req: _Request, slot: int):
         eng, cfg = self.engine, self.cfg
+        # everything before this point (bounded queue + worker pickup) is
+        # queueing delay; a _BLOCKED retry folds its re-wait in here too
+        req.trace.checkpoint("queue_wait")
         if req.cancelled:
             # a _BLOCKED requeue can carry a request whose client already
             # went away (stream teardown races the pop) — drop it here
@@ -687,6 +740,7 @@ class ContinuousEngine:
             # then residency in the fleet's combined table; a full table
             # backpressures exactly like the paged pool
             cart = eng._compile_constraint(k["constraint"])
+            req.trace.checkpoint("constraint_compile")
             off = self._ctable.acquire(cart)
             if off is None:
                 return _BLOCKED  # retry after a release frees rows
@@ -771,6 +825,7 @@ class ContinuousEngine:
                 # would fail every later admission — reallocate
                 self._scratch = self.backend.init_cache(1, self._scratch_seq)
         req.slot = slot
+        req.trace.checkpoint("admission")  # prefill + splice into the slot
         with self._cv:
             self._assignment[slot] = req
             self.admitted += 1
@@ -778,16 +833,26 @@ class ContinuousEngine:
                 eng.request_count += 1
             occ = sum(r is not None for r in self._assignment)
             self.peak_occupancy = max(self.peak_occupancy, occ)
+        self._m_occupied.set(occ)
+        if req.record:
+            self._m_admission_wait.observe(time.time() - req.enqueued)
         log.info(
             "admitted", slot=slot, prompt_len=prompt_len,
             budget=req.budget, occupancy=occ,
+            request_id=req.trace.request_id,
         )
         return first  # [1] device array; the wave fetches these together
 
     def _process(self, chunk):
         """Fetch one decode chunk's packed results and distribute/finalize."""
-        packed_dev, snapshot = chunk
+        packed_dev, snapshot, t_launch = chunk
         packed = np.asarray(packed_dev)  # [2K+1, B] — the ONE fetch per chunk
+        # launch-to-fetch over the chunk's steps: under lag-N pipelining
+        # this includes queue wait behind earlier chunks, so it is the
+        # EFFECTIVE per-token step time the fleet delivers, not raw compute
+        self._m_step.observe(
+            max(0.0, time.perf_counter() - t_launch) / self.chunk_steps
+        )
         K = self.chunk_steps
         emitted = packed[:K]
         mask = packed[K : 2 * K].astype(bool)
@@ -810,6 +875,7 @@ class ContinuousEngine:
                     # termination actually save here)
                     if self._assignment[b] is req:
                         self.state = G.kill_slot(self.state, b)
+                        self._m_preempt.labels(reason="stop").inc()
                     self._finalize(req, pre=gen)
                     continue
                 if req.stream_q is not None:
@@ -823,6 +889,7 @@ class ContinuousEngine:
                 # queued request instead of decoding to the dead request's
                 # full budget
                 self.state = G.kill_slot(self.state, b)
+                self._m_preempt.labels(reason="cancelled").inc()
                 log.info("request_cancelled", slot=b)
                 req.result = {
                     "error": "Error: request cancelled", "status": "failed",
@@ -833,6 +900,7 @@ class ContinuousEngine:
                 # in-flight overrun: kill the slot, fail the request; the
                 # fleet keeps decoding for everyone else
                 self.state = G.kill_slot(self.state, b)
+                self._m_preempt.labels(reason="deadline").inc()
                 log.error("request_deadline_exceeded", slot=b, deadline_s=deadline)
                 req.result = {
                     "error": f"Error: request exceeded the {deadline:g}s deadline",
@@ -853,9 +921,11 @@ class ContinuousEngine:
         return gen_ids, cut, hit
 
     def _finalize(self, req: _Request, pre=None):
+        req.trace.checkpoint("decode")  # admission end -> last chunk fetched
         gen_ids, response, stopped = (
             pre if pre is not None else self._gen_text(req)
         )
+        req.trace.checkpoint("detokenize")
         if req.stream_q is not None:
             # flush the held-back tail (U+FFFD / stop hold-back), exactly
             # up to the truncation
@@ -864,7 +934,8 @@ class ContinuousEngine:
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
         if req.record:
-            self.engine._record_sample(req.ttft, tps, n)
+            self.engine._record_sample(req.ttft, tps, n, elapsed=elapsed,
+                                       engine="continuous")
         req.result = {
             "prompt": req.prompt,
             "response": response,
@@ -920,13 +991,22 @@ class ContinuousEngine:
             if req.slot is not None and self._assignment[req.slot] is req:
                 self._assignment[req.slot] = None
             self.completed += 1
+            occ = sum(r is not None for r in self._assignment)
             self._cv.notify_all()
+        self._m_occupied.set(occ)
         self._push_final(req)
 
     def _push_final(self, req: _Request):
-        """Single completion point: streaming clients get the terminal
-        envelope event (done: true) on their queue, then the done flag
-        unblocks submit()."""
+        """Single completion point: attach the trace (request_id +
+        timings), count + log the request (warmup traffic excluded via
+        record=False — same exclusion as /stats), then deliver. Streaming
+        clients get the terminal envelope event (done: true) on their
+        queue, then the done flag unblocks submit()."""
+        if req.result is not None:
+            self.engine._finish_request(
+                req.result, req.trace, engine="continuous",
+                record=req.record,
+            )
         if req.stream_q is not None and req.result is not None:
             out = dict(req.result)
             out["done"] = True

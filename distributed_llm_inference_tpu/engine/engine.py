@@ -30,8 +30,10 @@ import numpy as np
 
 from ..config import EngineConfig, ModelConfig
 from ..models import api as M
-from ..utils.logging import get_logger
+from ..utils.logging import get_logger, request_id_context
+from ..utils.metrics import DEFAULT_SIZE_BUCKETS, MetricsRegistry
 from ..utils.tokenizer import load_tokenizer
+from ..utils.tracing import Trace
 from . import generate as G
 from .prefix import PrefixCache
 
@@ -249,12 +251,95 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._key = jax.random.PRNGKey(seed)
         self.request_count = 0
-        # Rolling per-request perf samples for p50/p90 TTFT + throughput
+        # Rolling per-request perf samples for p50/p90/p99 TTFT + throughput
         # (BASELINE.json's metric is p50 TTFT — a measurement, not a print).
         # Own lock, NOT self._lock: that one is held for a whole generation,
         # and /health must not block behind a multi-second decode.
         self._samples = collections.deque(maxlen=256)
         self._samples_lock = threading.Lock()
+        self._samples_total = 0
+        # Metrics registry (utils/metrics.py): owned per engine so tests /
+        # embedded engines never cross-talk; the server, queue, continuous
+        # engine, prefix cache, and constraint table all register into it,
+        # and GET /metrics renders it. _record_sample is the ONE seam that
+        # feeds both this registry and the rolling deque above, so the
+        # /stats JSON view and the Prometheus view cannot diverge.
+        self.metrics = MetricsRegistry()
+        self._m_ttft = self.metrics.histogram(
+            "dli_ttft_seconds", "time to first token", ("engine",)
+        )
+        self._m_tpot = self.metrics.histogram(
+            "dli_tpot_seconds", "inter-token time (decode)", ("engine",)
+        )
+        self._m_duration = self.metrics.histogram(
+            "dli_request_duration_seconds", "end-to-end request latency",
+            ("engine",),
+        )
+        self._m_requests = self.metrics.counter(
+            "dli_requests_total", "served generations", ("engine", "model")
+        )
+        self._m_failures = self.metrics.counter(
+            "dli_request_failures_total", "failed generations",
+            ("engine", "error_type"),
+        )
+        self._m_tokens = self.metrics.counter(
+            "dli_tokens_generated_total", "generated tokens", ("engine",)
+        )
+        self._m_batch_size = self.metrics.histogram(
+            "dli_batch_rows", "rows per batched fleet", ("engine",),
+            buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        self._m_speculative = self.metrics.counter(
+            "dli_speculative_requests_total",
+            "requests served speculatively (acceptance stays on device; "
+            "no host callback inside the verify loop)", ("engine",),
+        )
+        # Pre-register the cross-component families (queue, continuous
+        # fleet, prefix cache, constraint table, paged pool) so a scrape's
+        # SCHEMA is stable across server configs — a bare solo server
+        # exposes the full catalog shape, and components attaching later
+        # (serving/queue.py, engine/continuous.py, ...) get-or-create the
+        # same families and simply add their labeled series.
+        self.metrics.gauge(
+            "dli_queue_depth", "requests waiting for dispatch", ("queue",)
+        )
+        self.metrics.counter(
+            "dli_queue_shed_total", "requests shed with 429", ("queue",)
+        )
+        self.metrics.histogram(
+            "dli_admission_wait_seconds", "enqueue-to-dispatch wait",
+            ("queue",),
+        )
+        self.metrics.gauge("dli_slots_total", "continuous-fleet decode slots")
+        self.metrics.gauge(
+            "dli_slots_occupied", "continuous-fleet slots serving a request"
+        )
+        self.metrics.histogram(
+            "dli_decode_step_seconds",
+            "per-token decode step time, chunk launch-to-fetch / "
+            "chunk_steps (includes pipelining lag)", ("engine",),
+        )
+        self.metrics.counter(
+            "dli_preemptions_total",
+            "slots killed before their budget drained", ("reason",),
+        )
+        self.metrics.counter(
+            "dli_prefix_cache_hits_total",
+            "prefix-cache hits (tail actually planned and spliced)",
+            ("scope",),
+        )
+        self.metrics.counter(
+            "dli_prefix_cache_misses_total", "prefix-cache misses",
+            ("scope",),
+        )
+        self.metrics.counter(
+            "dli_prefix_cache_evictions_total",
+            "prefix snapshots evicted by the LRU bound", ("scope",),
+        )
+        self.metrics.gauge(
+            "dli_prefix_cache_entries", "resident prefix snapshots",
+            ("scope",),
+        )
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
         # between requests are harmless — prefill rewrites slots [0, bucket)
@@ -273,7 +358,8 @@ class InferenceEngine:
         if engine_cfg.prefix_cache_entries > 0:
             if hasattr(self.backend, "prefill_at"):
                 self._prefix = PrefixCache(
-                    engine_cfg.prefix_cache_entries, engine_cfg.prefix_chunk
+                    engine_cfg.prefix_cache_entries, engine_cfg.prefix_chunk,
+                    registry=self.metrics, scope="solo",
                 )
             else:
                 log.info("prefix_cache_disabled", reason="backend lacks prefill_at")
@@ -466,13 +552,32 @@ class InferenceEngine:
             return text, False
         return text[:cut], True
 
-    def _record_sample(self, ttft: float, per_stream_tps: float, tokens: int):
+    def _record_sample(self, ttft: float, per_stream_tps: float, tokens: int,
+                       elapsed: Optional[float] = None,
+                       engine: str = "solo"):
         """Per-STREAM throughput sample (batch requests divide by B), so
-        /stats percentiles stay comparable to the single-stream metric."""
+        /stats percentiles stay comparable to the single-stream metric.
+
+        The ONE seam feeding both observability views: the rolling deque
+        (/stats percentiles) and the registry histograms (/metrics). Only
+        recorded traffic reaches either — warmup never calls this, so it
+        is excluded from both views identically."""
         with self._samples_lock:
             self._samples.append(
                 {"ttft_s": ttft, "tokens_per_sec": per_stream_tps, "tokens": tokens}
             )
+            self._samples_total += 1
+        self._m_ttft.labels(engine=engine).observe(ttft)
+        self._m_tokens.labels(engine=engine).inc(tokens)
+        if elapsed is not None:
+            self._m_duration.labels(engine=engine).observe(elapsed)
+            if tokens > 1:
+                # TPOT (inter-token time): decode wall over the tokens
+                # after the first — the metric that exposes slow steps
+                # independently of prompt length
+                self._m_tpot.labels(engine=engine).observe(
+                    max(0.0, elapsed - ttft) / (tokens - 1)
+                )
 
     # -- main entry ----------------------------------------------------------
     def generate(
@@ -498,6 +603,8 @@ class InferenceEngine:
         length_penalty: float = 1.0,
         early_stopping: bool = False,
         constraint: Optional[dict] = None,
+        request_id: Optional[str] = None,
+        _trace: Optional[Trace] = None,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
 
@@ -531,7 +638,25 @@ class InferenceEngine:
         results silently rather than fall back to documented semantics.
         """
         t_start = time.time()
+        trace = _trace if _trace is not None else Trace(request_id)
 
+        with request_id_context(trace.request_id):
+            result = self._generate_traced(
+                prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
+                seed, debug, speculative, min_p, repetition_penalty,
+                frequency_penalty, presence_penalty, stop, logprobs,
+                logit_bias, num_beams, length_penalty, early_stopping,
+                constraint, t_start, trace,
+            )
+            return self._finish_request(result, trace, engine="solo")
+
+    def _generate_traced(
+        self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
+        seed, debug, speculative, min_p, repetition_penalty,
+        frequency_penalty, presence_penalty, stop, logprobs, logit_bias,
+        num_beams, length_penalty, early_stopping, constraint, t_start,
+        trace,
+    ) -> dict:
         if constraint is not None and (num_beams > 1 or speculative):
             # grammar constraints do not compose with beam search (no
             # per-beam FSM state threads the beam reorder) nor with
@@ -561,16 +686,20 @@ class InferenceEngine:
 
         def locked():
             with self._lock:
+                # lock wait = this engine's queueing delay (requests
+                # arriving through serving/queue.py fold their dispatcher
+                # wait into the same span via the shared trace)
+                trace.checkpoint("queue_wait")
                 if num_beams > 1:
                     return self._beam_locked(
                         prompt, max_tokens, num_beams, length_penalty,
-                        early_stopping, chat, t_start, stop,
+                        early_stopping, chat, t_start, stop, trace,
                     )
                 return self._generate_locked(
                     prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                     seed, t_start, debug, speculative, min_p,
                     repetition_penalty, stop, logprobs, logit_bias,
-                    frequency_penalty, presence_penalty, constraint,
+                    frequency_penalty, presence_penalty, constraint, trace,
                 )
 
         try:
@@ -584,6 +713,33 @@ class InferenceEngine:
         except Exception as e:  # error envelope (orchestration.py:220-228)
             log.error("generate_failed", exc_info=True, error=str(e))
             return {"error": f"Error: {e}", "status": "failed"}
+
+    def _finish_request(self, result: dict, trace: Trace, engine: str,
+                        record: bool = True) -> dict:
+        """Attach the trace to the envelope, count it, and log ONE
+        structured `request_done` event. Shared by the solo/batch/beam
+        paths and the continuous engine's finalizer (record=False for
+        warmup traffic — excluded from metrics exactly like /stats)."""
+        result.setdefault("request_id", trace.request_id)
+        result.setdefault("timings", trace.timings())
+        if not record:
+            return result
+        status = result.get("status")
+        if status == "success":
+            self._m_requests.labels(engine=engine, model=self.cfg.name).inc()
+            if result.get("speculative"):
+                self._m_speculative.labels(engine=engine).inc()
+        else:
+            self._m_failures.labels(
+                engine=engine,
+                error_type=result.get("error_type", "internal"),
+            ).inc()
+        log.info(
+            "request_done", request_id=trace.request_id, status=status,
+            engine=engine, tokens=result.get("tokens_generated"),
+            **result["timings"],
+        )
+        return result
 
     def _plan_ingest(self, prompt_len: int, p0: int, buckets: tuple,
                      capacity: Optional[int] = None):
@@ -719,7 +875,7 @@ class InferenceEngine:
         return dcache
 
     def _beam_locked(self, prompt, max_tokens, num_beams, length_penalty,
-                     early_stopping, chat, t_start, stop):
+                     early_stopping, chat, t_start, stop, trace=None):
         """Deterministic beam search (engine side): prefill the prompt
         ONCE (batch 1), tile the prompt KV and first-position logits to
         [num_beams] rows, then G.decode_beam. Tiling instead of an
@@ -768,6 +924,8 @@ class InferenceEngine:
         )
         logits = jnp.tile(logits, (num_beams, 1))
         ttft = time.time() - t_start
+        if trace is not None:
+            trace.checkpoint("prefill")
         out, n_gen, scores, cache = self.backend.decode_beam(
             logits, cache, jnp.int32(prompt_len), jnp.int32(max_tokens),
             jnp.float32(length_penalty), max_steps=decode_bucket,
@@ -775,6 +933,8 @@ class InferenceEngine:
         )
         out = jax.block_until_ready(out)
         self._cache = cache1  # the batch-1 scratch, stale rows masked
+        if trace is not None:
+            trace.checkpoint("decode")
 
         beams = []
         for b in range(num_beams):
@@ -789,10 +949,12 @@ class InferenceEngine:
                 "tokens": n, "stopped": b_stopped,
             })
         best = beams[0]
+        if trace is not None:
+            trace.checkpoint("detokenize")
         elapsed = time.time() - t_start
         n = best["tokens"]
         tps = n / elapsed if elapsed > 0 else 0.0
-        self._record_sample(ttft, tps, n)
+        self._record_sample(ttft, tps, n, elapsed=elapsed)
         log.info(
             "beam_request", model=cfg.name, backend=self.backend.name,
             num_beams=num_beams, tokens=n, elapsed_s=round(elapsed, 3),
@@ -1152,6 +1314,7 @@ class InferenceEngine:
         seed, t_start, debug=False, speculative=False, min_p=0.0,
         repetition_penalty=1.0, stop=None, logprobs=False, logit_bias=None,
         frequency_penalty=0.0, presence_penalty=0.0, constraint=None,
+        trace=None,
     ):
         cfg = self.cfg
         self.request_count += 1
@@ -1159,6 +1322,8 @@ class InferenceEngine:
         cart = self._compile_constraint(constraint) if constraint else None
         if cart is not None:
             bias = self._constraint_bias(cart, bias)
+            if trace is not None:
+                trace.checkpoint("constraint_compile")
         text = self.render_chat(prompt) if chat else prompt
         ids = self.tokenizer.encode(text)
         prompt_len = len(ids)
@@ -1268,6 +1433,8 @@ class InferenceEngine:
         )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
+        if trace is not None:
+            trace.checkpoint("prefill")
 
         if use_draft:
             dcfg, dparams = self._draft
@@ -1338,10 +1505,14 @@ class InferenceEngine:
                 )
         out = jax.block_until_ready(out)
         self._cache = cache
+        if trace is not None:
+            trace.checkpoint("decode")
 
         gen_ids = self._row_tokens(int(first[0]), out[0], int(n_gen[0]))
         response = self.tokenizer.decode(gen_ids, skip_special_tokens=True)
         response, stopped = self._truncate_at_stop(response, stop)
+        if trace is not None:
+            trace.checkpoint("detokenize")
 
         token_logprobs = None
         token_strings = None
@@ -1387,7 +1558,7 @@ class InferenceEngine:
         elapsed = time.time() - t_start
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
-        self._record_sample(ttft, tps, n)
+        self._record_sample(ttft, tps, n, elapsed=elapsed)
         log.info(
             "request", model=cfg.name, backend=self.backend.name,
             prompt_len=prompt_len, bucket=bucket, tokens=n,
@@ -1626,6 +1797,8 @@ class InferenceEngine:
         presence_penalty: float = 0.0,
         stop: Optional[list] = None,
         constraint: Optional[dict] = None,
+        request_id: Optional[str] = None,
+        _trace: Optional[Trace] = None,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).
 
@@ -1639,29 +1812,34 @@ class InferenceEngine:
         (/root/reference/orchestration.py:98,144).
         """
         t_start = time.time()
+        trace = _trace if _trace is not None else Trace(request_id)
 
         def locked():
             with self._lock:
+                trace.checkpoint("queue_wait")
                 return self._generate_batch_locked(
                     prompts, max_tokens, temperature, top_k, top_p, greedy,
                     chat, seed, t_start, min_p, repetition_penalty, stop,
-                    frequency_penalty, presence_penalty, constraint,
+                    frequency_penalty, presence_penalty, constraint, trace,
                 )
 
-        try:
-            return self._with_deadline(locked, "generate_batch")
-        except ValueError as e:
-            log.warning("invalid_batch_request", error=str(e))
-            return {"error": f"Error: {e}", "status": "failed",
-                    "error_type": "invalid_request"}
-        except Exception as e:
-            log.error("generate_batch_failed", exc_info=True, error=str(e))
-            return {"error": f"Error: {e}", "status": "failed"}
+        with request_id_context(trace.request_id):
+            try:
+                result = self._with_deadline(locked, "generate_batch")
+            except ValueError as e:
+                log.warning("invalid_batch_request", error=str(e))
+                result = {"error": f"Error: {e}", "status": "failed",
+                          "error_type": "invalid_request"}
+            except Exception as e:
+                log.error("generate_batch_failed", exc_info=True, error=str(e))
+                result = {"error": f"Error: {e}", "status": "failed"}
+            return self._finish_request(result, trace, engine="batch")
 
     def _generate_batch_locked(
         self, prompts, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, t_start, min_p=0.0, repetition_penalty=1.0, stop=None,
         frequency_penalty=0.0, presence_penalty=0.0, constraint=None,
+        trace=None,
     ):
         cfg = self.cfg
         if not prompts or not all(isinstance(p, str) and p for p in prompts):
@@ -1741,12 +1919,16 @@ class InferenceEngine:
             # first-token mask rides the bias operand ([V] broadcasts
             # row-wise), exactly like the solo path
             pkw["bias"] = self._constraint_bias(cart, None)
+        if cart is not None and trace is not None:
+            trace.checkpoint("constraint_compile")
         first, logits, cache = self.backend.prefill(
             tokens, jnp.int32(bucket), cache, key_pre, sampling, valid_start,
             **pkw,
         )
         first = jax.block_until_ready(first)
         ttft = time.time() - t_start
+        if trace is not None:
+            trace.checkpoint("prefill")
 
         # dummy padding rows start "finished" (first token forced to EOS),
         # so the decode loop's all-finished early exit still fires when the
@@ -1779,6 +1961,8 @@ class InferenceEngine:
             max_steps=decode_bucket, **bkw,
         )
         out = jax.block_until_ready(out)
+        if trace is not None:
+            trace.checkpoint("decode")
         # keep at most ONE batch cache (the bucket just used): an entry per
         # bucket would re-pin sum(BATCH_BUCKETS) x max_seq of KV in HBM —
         # the footprint warmup's keep-only-largest eviction exists to avoid
@@ -1806,9 +1990,13 @@ class InferenceEngine:
             if row_stopped:
                 entry["stopped"] = True
             results.append(entry)
+        if trace is not None:
+            trace.checkpoint("detokenize")
         elapsed = time.time() - t_start
         tps = total_tokens / elapsed if elapsed > 0 else 0.0
-        self._record_sample(ttft, tps / B, total_tokens)
+        self._record_sample(ttft, tps / B, total_tokens, elapsed=elapsed,
+                            engine="batch")
+        self._m_batch_size.labels(engine="batch").observe(B)
         log.info(
             "batch_request", model=cfg.name, backend=self.backend.name,
             batch=B, batch_bucket=Bb, bucket=bucket, tokens=total_tokens,
@@ -1831,29 +2019,33 @@ class InferenceEngine:
 
     # -- perf stats ----------------------------------------------------------
     def stats(self) -> dict:
-        """Rolling p50/p90 over recent requests (TTFT seconds, tokens/sec).
+        """Rolling p50/p90/p99 over recent requests (TTFT seconds,
+        tokens/sec) plus the lifetime sample count.
 
         Snapshot under the samples lock: /stats and /health are served from
         other threads while a generate() may be appending to the deque.
+        The percentile formula is utils.metrics.percentile — the SAME one
+        the registry histograms use for their window percentiles, and both
+        are fed by the one _record_sample seam, so this JSON view and the
+        /metrics view agree by construction.
         """
+        from ..utils.metrics import percentile as pct
+
         with self._samples_lock:
             samples = list(self._samples)
-
-        def pct(vals, q):
-            if not vals:
-                return None
-            vals = sorted(vals)
-            idx = min(len(vals) - 1, int(round(q * (len(vals) - 1))))
-            return round(vals[idx], 4)
+            samples_total = self._samples_total
 
         ttfts = [s["ttft_s"] for s in samples]
         tpss = [s["tokens_per_sec"] for s in samples]
         out = {
             "window": len(samples),
+            "samples_total": samples_total,
             "ttft_p50_s": pct(ttfts, 0.5),
             "ttft_p90_s": pct(ttfts, 0.9),
+            "ttft_p99_s": pct(ttfts, 0.99),
             "tokens_per_sec_p50": pct(tpss, 0.5),
             "tokens_per_sec_p90": pct(tpss, 0.9),
+            "tokens_per_sec_p99": pct(tpss, 0.99),
             "tokens_total": sum(s["tokens"] for s in samples),
         }
         if self._prefix is not None:

@@ -97,13 +97,32 @@ class BlockAllocator:
     Not thread-safe by itself — the continuous engine calls it only from
     its single worker thread (admission/release), matching the engine's
     single-owner design.
+
+    registry (utils/metrics.MetricsRegistry, optional): pool-occupancy
+    gauges (`dli_kv_pool_blocks_total` / `_free`) and an exhaustion
+    counter (`dli_kv_pool_exhausted_total` — alloc refusals, i.e. the
+    admission backpressure events) for /metrics.
     """
 
-    def __init__(self, n_blocks: int):
+    def __init__(self, n_blocks: int, registry=None):
         if n_blocks < 2:
             raise ValueError("pool needs >= 2 blocks (one is the trash block)")
         self.n_blocks = n_blocks
         self._free = list(range(1, n_blocks))
+        self._m_free = self._m_exhausted = None
+        if registry is not None:
+            registry.gauge(
+                "dli_kv_pool_blocks_total",
+                "paged-KV pool size (excluding the trash block)",
+            ).labels().set(n_blocks - 1)
+            self._m_free = registry.gauge(
+                "dli_kv_pool_blocks_free", "unallocated paged-KV blocks"
+            ).labels()
+            self._m_free.set(len(self._free))
+            self._m_exhausted = registry.counter(
+                "dli_kv_pool_exhausted_total",
+                "admissions refused because the pool had too few blocks",
+            ).labels()
 
     @property
     def free_blocks(self) -> int:
@@ -112,13 +131,19 @@ class BlockAllocator:
     def alloc(self, n: int) -> Optional[list]:
         """n blocks or None (caller keeps the request queued)."""
         if n > len(self._free):
+            if self._m_exhausted is not None:
+                self._m_exhausted.inc()
             return None
         out = self._free[:n]
         del self._free[:n]
+        if self._m_free is not None:
+            self._m_free.set(len(self._free))
         return out
 
     def free(self, ids: list):
         self._free.extend(ids)
+        if self._m_free is not None:
+            self._m_free.set(len(self._free))
 
 
 def blocks_needed(prompt_len: int, max_tokens: int, block_size: int) -> int:

@@ -64,9 +64,15 @@ def _splice(cache, entry, p: int):
 
 
 class PrefixCache:
-    """LRU store of chunk-aligned prompt-prefix KV snapshots."""
+    """LRU store of chunk-aligned prompt-prefix KV snapshots.
 
-    def __init__(self, max_entries: int, chunk: int):
+    registry (utils/metrics.MetricsRegistry, optional): hit/miss/eviction
+    counters + an entry gauge, labeled by `scope` — the solo engine and
+    the continuous engine own SEPARATE instances, and a scrape must tell
+    them apart."""
+
+    def __init__(self, max_entries: int, chunk: int, registry=None,
+                 scope: str = "solo"):
         if max_entries < 1:
             raise ValueError("prefix cache needs max_entries >= 1")
         if chunk < 1:
@@ -80,6 +86,27 @@ class PrefixCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
+        self._m_hits = self._m_misses = self._m_evictions = None
+        self._m_entries = None
+        if registry is not None:
+            self._m_hits = registry.counter(
+                "dli_prefix_cache_hits_total",
+                "prefix-cache hits (tail actually planned and spliced)",
+                ("scope",),
+            ).labels(scope=scope)
+            self._m_misses = registry.counter(
+                "dli_prefix_cache_misses_total", "prefix-cache misses",
+                ("scope",),
+            ).labels(scope=scope)
+            self._m_evictions = registry.counter(
+                "dli_prefix_cache_evictions_total",
+                "prefix snapshots evicted by the LRU bound", ("scope",),
+            ).labels(scope=scope)
+            self._m_entries = registry.gauge(
+                "dli_prefix_cache_entries", "resident prefix snapshots",
+                ("scope",),
+            ).labels(scope=scope)
 
     @staticmethod
     def compatible(cache) -> bool:
@@ -128,6 +155,9 @@ class PrefixCache:
                     self._entries.move_to_end(key)
             else:
                 self.misses += 1
+        m = self._m_hits if hit else self._m_misses
+        if m is not None:
+            m.inc()
 
     def splice(self, entry: dict, cache, p: int):
         """Write the snapshot's first `p` slots into slots [0, p) of the
@@ -146,10 +176,18 @@ class PrefixCache:
                 self._entries.move_to_end(key)
                 return 0
         snapshot = _extract(cache, p)
+        evicted = 0
         with self._lock:
             self._entries[key] = snapshot
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
+                self.evictions += 1
+                evicted += 1
+            n_entries = len(self._entries)
+        if self._m_evictions is not None:
+            if evicted:
+                self._m_evictions.inc(evicted)
+            self._m_entries.set(n_entries)
         return p
 
     def stats(self) -> dict:
@@ -158,5 +196,6 @@ class PrefixCache:
                 "entries": len(self._entries),
                 "hits": self.hits,
                 "misses": self.misses,
+                "evictions": self.evictions,
                 "cached_tokens": sum(len(k) for k in self._entries),
             }

@@ -352,8 +352,23 @@ def _logprobs_obj(entry: dict) -> Optional[dict]:
             "text_offset": None}
 
 
+def _observability_fields(request_id, timings) -> dict:
+    """Extension keys carried on every non-streaming response: the
+    request_id (also echoed as the X-Request-Id header) and the trace's
+    stage breakdown. Extra top-level keys are OpenAI-SDK-safe (clients
+    ignore unknown fields)."""
+    out = {}
+    if request_id:
+        out["request_id"] = request_id
+    if timings:
+        out["timings"] = timings
+    return out
+
+
 def completion_response(entries: list, model: str, kwargs: dict,
-                        prompt_once: bool = False) -> dict:
+                        prompt_once: bool = False,
+                        request_id: Optional[str] = None,
+                        timings: Optional[dict] = None) -> dict:
     """Engine success envelope(s) -> one text_completion response."""
     choices = []
     for i, e in enumerate(entries):
@@ -373,11 +388,14 @@ def completion_response(entries: list, model: str, kwargs: dict,
         "model": model,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
+        **_observability_fields(request_id, timings),
     }
 
 
 def chat_response(entries: list, model: str, kwargs: dict,
-                  prompt_once: bool = False) -> dict:
+                  prompt_once: bool = False,
+                  request_id: Optional[str] = None,
+                  timings: Optional[dict] = None) -> dict:
     choices = []
     for i, entry in enumerate(entries):
         choice = {
@@ -404,6 +422,7 @@ def chat_response(entries: list, model: str, kwargs: dict,
         "model": model,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
+        **_observability_fields(request_id, timings),
     }
 
 

@@ -34,12 +34,15 @@ import time
 from typing import Any, Optional
 
 from ..utils.logging import get_logger
+from ..utils.metrics import DEFAULT_SIZE_BUCKETS
+from ..utils.tracing import Trace
 
 log = get_logger("queue")
 
 
 class _Pending:
-    __slots__ = ("prompt", "kwargs", "done", "result", "enqueued", "is_batch")
+    __slots__ = ("prompt", "kwargs", "done", "result", "enqueued", "is_batch",
+                 "trace")
 
     def __init__(self, prompt, kwargs: dict, is_batch: bool = False):
         self.prompt = prompt  # str, or list[str] for a client batch
@@ -48,6 +51,10 @@ class _Pending:
         self.result: Optional[dict] = None
         self.enqueued = time.time()
         self.is_batch = is_batch
+        # per-request trace: the dispatcher wait lands in the queue_wait
+        # span; solo dispatch hands the SAME trace to the engine so the
+        # response's timings cover enqueue -> detokenize contiguously
+        self.trace = Trace(kwargs.pop("request_id", None))
 
     def coalesce_key(self):
         k = self.kwargs
@@ -110,6 +117,28 @@ class BatchingQueue:
         self._queue: list[_Pending] = []
         self._closed = False
         self.coalesced_batches = 0  # observability: fleets actually formed
+        # registry families (engine.metrics — one /metrics scrape covers
+        # the queue alongside the engine): depth, shed 429s, dispatcher
+        # waits, fleets formed + their row counts
+        m = engine.metrics
+        self._m_depth = m.gauge(
+            "dli_queue_depth", "requests waiting for dispatch", ("queue",)
+        ).labels(queue="batching")
+        self._m_shed = m.counter(
+            "dli_queue_shed_total", "requests shed with 429", ("queue",)
+        ).labels(queue="batching")
+        self._m_wait = m.histogram(
+            "dli_admission_wait_seconds", "enqueue-to-dispatch wait",
+            ("queue",),
+        ).labels(queue="batching")
+        self._m_coalesced = m.counter(
+            "dli_coalesced_fleets_total",
+            "coalesced fleets that served successfully",
+        ).labels()
+        self._m_fleet_rows = m.histogram(
+            "dli_batch_rows", "rows per batched fleet", ("engine",),
+            buckets=DEFAULT_SIZE_BUCKETS,
+        ).labels(engine="queue")
         self._can_coalesce = (
             getattr(engine.cfg, "arch", None) == "llama"
             and getattr(engine.backend, "supports_ragged", False)
@@ -144,12 +173,14 @@ class BatchingQueue:
                 }
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue))
+                self._m_shed.inc()
                 return {
                     "error": f"Error: request queue full ({self.max_queue})",
                     "status": "failed",
                     "error_type": "overloaded",
                 }
             self._queue.append(pend)
+            self._m_depth.set(len(self._queue))
             self._cv.notify_all()
         pend.done.wait()
         return pend.result
@@ -168,6 +199,7 @@ class BatchingQueue:
                 }
                 p.done.set()
             self._queue.clear()
+            self._m_depth.set(0)
 
     def depth(self) -> int:
         with self._cv:
@@ -178,6 +210,7 @@ class BatchingQueue:
         """Pop the head request plus every compatible queued request (in
         arrival order) up to max_batch. Caller holds the lock."""
         head = self._queue.pop(0)
+        self._m_depth.set(len(self._queue))
         key = head.coalesce_key() if self._can_coalesce else None
         group = [head]
         if key is None:
@@ -189,6 +222,7 @@ class BatchingQueue:
             else:
                 rest.append(p)
         self._queue[:] = rest
+        self._m_depth.set(len(self._queue))
         return group
 
     def _dispatch_loop(self):
@@ -238,6 +272,8 @@ class BatchingQueue:
                     "deadline while queued",
                     "status": "failed",
                     "error_type": "timeout",
+                    "request_id": p.trace.request_id,
+                    "timings": p.trace.timings(),
                 }
                 p.done.set()
             else:
@@ -245,13 +281,23 @@ class BatchingQueue:
         return live
 
     def _run_group(self, group: list[_Pending]):
+        now = time.time()
+        for p in group:
+            self._m_wait.observe(now - p.enqueued)
         try:
             if len(group) == 1:
                 p = group[0]
+                # the engine continues THIS trace: its first checkpoint
+                # (lock acquisition) folds the dispatcher wait into the
+                # queue_wait span, and the envelope echoes p's request_id
                 if p.is_batch:
-                    p.result = self.engine.generate_batch(p.prompt, **p.kwargs)
+                    p.result = self.engine.generate_batch(
+                        p.prompt, _trace=p.trace, **p.kwargs
+                    )
                 else:
-                    p.result = self.engine.generate(p.prompt, **p.kwargs)
+                    p.result = self.engine.generate(
+                        p.prompt, _trace=p.trace, **p.kwargs
+                    )
                 return
             kwargs = dict(group[0].kwargs)
             kwargs.pop("seed", None)
@@ -263,6 +309,10 @@ class BatchingQueue:
             # requests never coalesce (coalesce_key).
             kwargs.pop("speculative", None)
             kwargs.pop("logprobs", None)
+            for p in group:
+                # dispatcher wait closed out per member; the fleet's own
+                # stage spans are copied onto each member below
+                p.trace.checkpoint("queue_wait")
             t0 = time.time()
             batch = self.engine.generate_batch(
                 [p.prompt for p in group], **kwargs
@@ -273,23 +323,36 @@ class BatchingQueue:
                 # fleet falls back to solo — counting it would mask a
                 # coalescing regression behind a healthy-looking metric)
                 self.coalesced_batches += 1
+                self._m_coalesced.inc()
+                self._m_fleet_rows.observe(len(group))
             if batch.get("status") != "success":
                 if batch.get("error_type") in ("timeout", "overloaded"):
                     # capacity failures propagate as-is: retrying N members
                     # solo against a wedged engine would stall the single
                     # dispatcher thread N x deadline and outage the queue
                     for p in group:
-                        p.result = batch
+                        p.result = dict(
+                            batch, request_id=p.trace.request_id,
+                            timings=p.trace.timings(),
+                        )
                     return
                 # request-shaped fleet failure (e.g. one over-long prompt):
                 # retry each member SOLO so one bad request cannot fail the
                 # innocent ones it happened to coalesce with — solo also
                 # reaches paths batching lacks (chunked prefill)
                 for p in group:
-                    p.result = self.engine.generate(p.prompt, **p.kwargs)
+                    p.result = self.engine.generate(
+                        p.prompt, _trace=p.trace, **p.kwargs
+                    )
                 return
+            fleet_spans = {
+                k: v for k, v in batch.get("timings", {}).items()
+                if k not in ("queue_wait_s", "total_s")
+            }
             for p, row in zip(group, batch["results"]):
                 n = row["tokens_generated"]
+                for k, v in fleet_spans.items():
+                    p.trace.add(k[:-2], v)  # strip the "_s" suffix
                 p.result = {
                     "prompt": row["prompt"],
                     "response": row["response"],
@@ -304,6 +367,8 @@ class BatchingQueue:
                     "ttft_s": batch["ttft_s"],
                     "backend": batch["backend"],
                     "batched_with": len(group),
+                    "request_id": p.trace.request_id,
+                    "timings": p.trace.timings(),
                 }
         except Exception as e:  # noqa: BLE001 - callers must always unblock
             log.error("dispatch_failed", exc_info=True, error=str(e))

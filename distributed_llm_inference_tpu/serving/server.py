@@ -130,12 +130,33 @@ class _Profiler:
             return {"status": "stopped", "trace_dir": out}
 
 
+# the fixed route set for the http counter's `route` label: anything else
+# collapses to "other" so an attacker probing random paths cannot grow the
+# label cardinality (the registry's own series cap is the second fence)
+_KNOWN_ROUTES = frozenset((
+    "/", "/health", "/workers", "/stats", "/metrics", "/v1/models",
+    "/generate", "/v1/completions", "/v1/chat/completions",
+    "/profiler/start", "/profiler/stop",
+))
+
+
+def _route_label(path: str) -> str:
+    return path if path in _KNOWN_ROUTES else "other"
+
+
 def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
                  queue=None, continuous=None):
+    from ..utils.tracing import new_request_id, sanitize_request_id
     from . import openai_api as oai
 
     profiler = profiler or _Profiler()
     started_at = int(time.time())
+    # HTTP request/error counter by route + status — every response path
+    # (JSON, HTML, SSE, NDJSON) passes through exactly one counting point
+    http_requests = engine.metrics.counter(
+        "dli_http_requests_total", "HTTP responses",
+        ("route", "method", "status"),
+    )
     # scoring requests bypass the queue/continuous ladder (they are not
     # generations), so they need their own backpressure: a small bound on
     # concurrent scorers — overflow sheds with 429 instead of piling
@@ -147,15 +168,26 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
         def log_message(self, fmt, *args):
             pass
 
+        _rid: Optional[str] = None  # set per POST; echoed as X-Request-Id
+
+        def _count(self, code: int):
+            http_requests.labels(
+                route=_route_label(self.path.split("?")[0].rstrip("/") or "/"),
+                method=self.command, status=str(code),
+            ).inc()
+
         def _send(self, code: int, payload: Any, content_type="application/json"):
             body = (
                 payload.encode()
                 if isinstance(payload, str)
                 else json.dumps(payload).encode()
             )
+            self._count(code)
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             self.wfile.write(body)
 
@@ -195,7 +227,20 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 s = engine.stats()
                 if continuous is not None:
                     s["continuous"] = continuous.stats()
+                if queue is not None:
+                    s["queue"] = {
+                        "depth": queue.depth(),
+                        "coalesced_batches": queue.coalesced_batches,
+                    }
                 self._send(200, s)
+            elif path == "/metrics":
+                # Prometheus text exposition over the SAME registry /stats
+                # reads (utils/metrics.py); warmup traffic never reaches
+                # _record_sample, so it is excluded from both views
+                self._send(
+                    200, engine.metrics.render(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
             elif path == "/v1/models":
                 self._send(
                     200, oai.models_response(engine.cfg.name, started_at)
@@ -237,9 +282,12 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     yield {**result, "done": True}
 
                 events = _one_shot()
+            self._count(200)
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
             self.end_headers()
             try:
                 for payload, _final in oai.stream_events(
@@ -253,6 +301,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
 
         def _openai(self, path: str, data: dict):
             chat = path == "/v1/chat/completions"
+            envelope = None  # the engine envelope carrying request_id/timings
             try:
                 if chat:
                     prompt, kwargs, meta = oai.parse_chat(
@@ -263,6 +312,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     prompts, kwargs, meta = oai.parse_completion(
                         data, max_tokens_cap
                     )
+                kwargs["request_id"] = self._rid
                 if meta.get("echo_score"):
                     # echo + logprobs + max_tokens=0: teacher-forced
                     # scoring of the prompt itself (lm-eval pattern)
@@ -300,6 +350,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     if result.get("status") != "success":
                         raise oai.error_for_envelope(result)
                     entries = [result]
+                    envelope = result
                 else:
                     if kwargs.get("logprobs"):
                         raise oai.OpenAIError(
@@ -314,6 +365,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     if batch.get("status") != "success":
                         raise oai.error_for_envelope(batch)
                     entries = batch["results"]
+                    envelope = batch
             except oai.OpenAIError as e:
                 self._send(e.status, e.body)
                 return
@@ -323,21 +375,24 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 self._send(400, oai.OpenAIError(f"bad parameter: {e}").body)
                 return
             prompt_once = meta.get("n", 1) > 1
-            if chat:
-                self._send(
-                    200,
-                    oai.chat_response(entries, engine.cfg.name, kwargs,
-                                      prompt_once=prompt_once),
-                )
-            else:
-                self._send(
-                    200,
-                    oai.completion_response(entries, engine.cfg.name, kwargs,
-                                            prompt_once=prompt_once),
-                )
+            build = oai.chat_response if chat else oai.completion_response
+            self._send(
+                200,
+                build(entries, engine.cfg.name, kwargs,
+                      prompt_once=prompt_once,
+                      request_id=envelope.get("request_id", self._rid),
+                      timings=envelope.get("timings")),
+            )
 
         def do_POST(self):
             path = self.path.split("?")[0].rstrip("/")
+            # accept a client-supplied X-Request-Id (sanitized) for
+            # cross-service correlation, else mint one; echoed on every
+            # response header and in the JSON envelope
+            self._rid = (
+                sanitize_request_id(self.headers.get("X-Request-Id"))
+                or new_request_id()
+            )
             if path in ("/v1/completions", "/v1/chat/completions"):
                 data = self._read_json()
                 if data is not None:
@@ -371,6 +426,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 max_tokens = min(int(data.get("max_tokens", DEFAULT_MAX_TOKENS)), max_tokens_cap)
                 seed = data.get("seed")
                 kwargs = dict(
+                    request_id=self._rid,
                     max_tokens=max_tokens,
                     temperature=float(data.get("temperature", DEFAULT_TEMPERATURE)),
                     top_k=int(data.get("top_k", DEFAULT_TOP_K)),
@@ -457,8 +513,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     kwargs["logprobs"] = _parse_bool(
                         data.get("logprobs", False), "logprobs"
                     )
+                    self._count(200)
                     self.send_response(200)
                     self.send_header("Content-Type", "application/x-ndjson")
+                    if self._rid:
+                        self.send_header("X-Request-Id", self._rid)
                     self.end_headers()
                     gen = continuous.stream(prompt, **kwargs)
                     try:
@@ -566,9 +625,10 @@ class InferenceServer:
         configure()  # JSON-lines handler; entry-point-only (library-safe)
         get_logger("server").info(
             "serving", port=self.port,
-            routes=["/generate", "/health", "/workers", "/stats", "/profiler/*"],
+            routes=["/generate", "/health", "/workers", "/stats", "/metrics",
+                    "/profiler/*"],
         )
-        print(f"🚀 serving on :{self.port} — /generate /health /workers /")
+        print(f"🚀 serving on :{self.port} — /generate /health /workers /metrics /")
         self.httpd.serve_forever()
 
     def shutdown(self):

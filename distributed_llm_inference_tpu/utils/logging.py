@@ -14,13 +14,41 @@ client CLI).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import json
 import logging
 import sys
 import time
-from typing import Any
+from typing import Any, Optional
 
 _CONFIGURED = False
+
+# Current request id (utils/tracing.Trace): set around a request's
+# processing so every record logged inside — engine internals included,
+# with no plumbing — carries the id for cross-service correlation.
+_REQUEST_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "request_id", default=None
+)
+
+
+def set_request_id(rid: Optional[str]):
+    """Set (rid) or clear (None) the context's request id; returns the
+    token for contextvars reset."""
+    return _REQUEST_ID.set(rid)
+
+
+def get_request_id() -> Optional[str]:
+    return _REQUEST_ID.get()
+
+
+@contextlib.contextmanager
+def request_id_context(rid: Optional[str]):
+    token = _REQUEST_ID.set(rid)
+    try:
+        yield
+    finally:
+        _REQUEST_ID.reset(token)
 
 
 class _JsonFormatter(logging.Formatter):
@@ -31,9 +59,12 @@ class _JsonFormatter(logging.Formatter):
             "logger": record.name,
             "event": record.getMessage(),
         }
+        rid = _REQUEST_ID.get()
+        if rid is not None:
+            out["request_id"] = rid
         fields = getattr(record, "fields", None)
         if fields:
-            out.update(fields)
+            out.update(fields)  # an explicit request_id field wins
         if record.exc_info and record.exc_info[0] is not None:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out, default=str)
@@ -63,15 +94,21 @@ class StructuredLogger:
 
 
 def configure(level: int = logging.INFO, stream=None) -> None:
-    """Install the JSON handler on the package root logger (idempotent)."""
+    """Install the JSON handler on the package root logger.
+
+    The handler is installed exactly once, but the LEVEL applies on every
+    call: a repeat `configure(logging.DEBUG)` (an operator turning on
+    verbosity at runtime) updates the root level instead of being
+    silently ignored.
+    """
     global _CONFIGURED
     root = logging.getLogger("distributed_llm_inference_tpu")
+    root.setLevel(level)
     if _CONFIGURED:
         return
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(_JsonFormatter())
     root.addHandler(handler)
-    root.setLevel(level)
     root.propagate = False
     _CONFIGURED = True
 

@@ -1,0 +1,93 @@
+"""Per-request stage tracing: request ids + host-side span breakdowns.
+
+Every request gets a `Trace` carrying a `request_id` (client-supplied via
+the `X-Request-Id` header, or generated) and an ordered set of stage
+spans — queue_wait, constraint_compile, admission, prefill, decode,
+detokenize — recorded as HOST-side timestamps only. Nothing here crosses
+into traced XLA code: a checkpoint is a `time.perf_counter()` read around
+an already-host-blocking boundary (block_until_ready, a queue pop), so
+the no-host-callback discipline of the compiled decode loops is untouched.
+
+The span model is CONTIGUOUS: `checkpoint(name)` attributes the time
+since the previous checkpoint (or trace creation) to `name`, so the spans
+sum to ≈ the end-to-end latency by construction — the property that makes
+a `timings` breakdown trustworthy for "where did this slow request spend
+its time". Repeated checkpoints under one name accumulate (a chunked
+decode records one growing `decode` span, not N).
+
+The breakdown is returned in each response's `timings` field and logged
+as one structured `request_done` event (utils/logging.py attaches the
+request_id to every record logged inside `request_id_context`).
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+import threading
+import time
+import uuid
+from typing import Optional
+
+_SAFE_ID = re.compile(r"^[A-Za-z0-9_\-\.:]{1,128}$")
+
+
+def new_request_id() -> str:
+    return "req-" + uuid.uuid4().hex[:20]
+
+
+def sanitize_request_id(raw) -> Optional[str]:
+    """A client-supplied id, or None if absent/unusable. Constrained to a
+    safe charset + length: the id is echoed into headers, logs, and
+    metrics-adjacent output — it must never be an injection vector."""
+    if not isinstance(raw, str):
+        return None
+    raw = raw.strip()
+    return raw if _SAFE_ID.match(raw) else None
+
+
+class Trace:
+    """Ordered, contiguous stage spans for one request."""
+
+    __slots__ = ("request_id", "_t0", "_last", "_spans", "_lock")
+
+    def __init__(self, request_id: Optional[str] = None):
+        self.request_id = request_id or new_request_id()
+        now = time.perf_counter()
+        self._t0 = now
+        self._last = now
+        self._spans: "collections.OrderedDict[str, float]" = (
+            collections.OrderedDict()
+        )
+        # a deadline-abandoned generation keeps checkpointing from its
+        # daemon thread while the caller reads timings(): cheap lock
+        self._lock = threading.Lock()
+
+    def checkpoint(self, name: str) -> float:
+        """Attribute time since the last checkpoint to span `name`."""
+        now = time.perf_counter()
+        with self._lock:
+            dur = now - self._last
+            self._last = now
+            self._spans[name] = self._spans.get(name, 0.0) + dur
+        return dur
+
+    def add(self, name: str, seconds: float):
+        """Record an externally-measured span (e.g. a queue wait measured
+        by the dispatcher on another thread)."""
+        with self._lock:
+            self._spans[name] = self._spans.get(name, 0.0) + float(seconds)
+
+    def spans(self) -> dict:
+        with self._lock:
+            return dict(self._spans)
+
+    def timings(self) -> dict:
+        """`{"<span>_s": dur, ..., "total_s": wall}` in chronological span
+        order. Spans sum to ≈ total_s (the unspanned tail is whatever ran
+        after the last checkpoint — response assembly, envelope fill)."""
+        now = time.perf_counter()
+        with self._lock:
+            out = {f"{k}_s": round(v, 6) for k, v in self._spans.items()}
+            out["total_s"] = round(now - self._t0, 6)
+        return out
