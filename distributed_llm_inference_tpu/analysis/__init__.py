@@ -1,0 +1,33 @@
+"""Static invariant checking for the compiled-decode contract.
+
+The whole point of this system versus the reference (JSON-over-HTTP, four
+hops per token) is that decode is ONE compiled XLA program with zero
+Python per token. That invariant is defended here, mechanically, in two
+complementary passes:
+
+  * `lint` — an AST rule engine over the package (rules/): no host-sync
+    calls in functions reachable from the jitted entry points, no Python
+    branching on traced values in ops//parallel/, donation coverage for
+    KV caches, recompile-hazard static args, metrics label hygiene, and
+    HTTP status-counter coverage. Per-line suppressions:
+    `# jaxlint: disable=RULE -- reason` (the reason is mandatory).
+  * `hlo` — compiled-artifact verification: lower the real decode
+    programs with tiny configs and assert on the StableHLO (zero host
+    callbacks, donation aliasing actually present, the loop compiled,
+    no recompile across invocations).
+
+CLI: `python -m distributed_llm_inference_tpu.analysis` (CI-gated; see
+.github/workflows/ci.yml and ARCHITECTURE.md "Invariants").
+"""
+
+from .callgraph import PackageIndex, build_index, traced_reachable
+from .lint import Diagnostic, format_diagnostics, run_lint
+
+__all__ = [
+    "Diagnostic",
+    "PackageIndex",
+    "build_index",
+    "format_diagnostics",
+    "run_lint",
+    "traced_reachable",
+]

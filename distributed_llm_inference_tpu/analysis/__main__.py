@@ -1,0 +1,85 @@
+"""CLI: `python -m distributed_llm_inference_tpu.analysis`.
+
+Exit 0 when the package is clean; exit 1 with `file:line: [rule] message`
+diagnostics otherwise. `--hlo` additionally lowers the real decode
+programs (tiny config, CPU) and verifies the compiled artifacts — this
+is the CI gate (.github/workflows/ci.yml `analysis` job).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_llm_inference_tpu.analysis",
+        description="compiled-decode invariant checker (AST lint + "
+                    "jaxpr/StableHLO verification)",
+    )
+    ap.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="package directory to lint (default: the installed "
+             "distributed_llm_inference_tpu package — pass a fixture tree "
+             "to lint something else)",
+    )
+    ap.add_argument(
+        "--rules", default=None, metavar="R1,R2",
+        help="comma-separated rule subset (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "--hlo", action="store_true",
+        help="also lower the decode programs and verify the compiled "
+             "artifacts (host callbacks, donation aliasing, recompiles)",
+    )
+    ap.add_argument(
+        "--hlo-only", action="store_true", help="skip the lint pass"
+    )
+    args = ap.parse_args(argv)
+
+    from .rules import ALL_RULES
+
+    if args.list_rules:
+        for rule_id, fn in sorted(ALL_RULES.items()):
+            doc = (sys.modules[fn.__module__].__doc__ or "").strip()
+            first = doc.splitlines()[0] if doc else ""
+            if first.startswith(rule_id + ":"):
+                first = first[len(rule_id) + 1:].strip()
+            print(f"{rule_id}: {first}")
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(__file__))
+    failed = False
+
+    if not args.hlo_only:
+        from .lint import format_diagnostics, run_lint
+
+        rules = args.rules.split(",") if args.rules else None
+        diagnostics, suppressed = run_lint(root, rules=rules)
+        print(format_diagnostics(diagnostics, suppressed))
+        failed = failed or bool(diagnostics)
+
+    if args.hlo or args.hlo_only:
+        # CPU is the reference surface for artifact checks (CI runs here);
+        # setdefault so an explicit TPU run still wins
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from .hlo import run_hlo_checks
+
+        results = run_hlo_checks()
+        for name, problems in results.items():
+            status = "ok" if not problems else "FAIL"
+            print(f"hlo:{name}: {status}")
+            for p in problems:
+                print(f"  - {p}")
+        failed = failed or any(problems for problems in results.values())
+
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

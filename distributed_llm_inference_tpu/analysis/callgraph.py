@@ -1,0 +1,363 @@
+"""Package AST index + intra-package call graph + traced reachability.
+
+The host-sync rule must know which functions execute INSIDE a jit trace:
+linting file-by-file would either miss `sample_token` (ops/sampling.py,
+called from every decode loop) or drown the host-side engine code in
+false positives. So we parse every module in the package once, resolve
+intra-package references (imports, module aliases, `self.` methods, the
+models/api family dispatch), and walk the graph from the jit roots.
+
+Jit roots — the functions whose BODIES are traced:
+  * defs decorated with `jax.jit` / `functools.partial(jax.jit, ...)`;
+  * functions passed by name to a `jax.jit(...)` call;
+  * functions passed by name to `shard_map` / `jax.shard_map` /
+    `self._shard(...)` (the parallel/ backends build their traced bodies
+    as closures handed to a shard_map partial, then jit the result).
+
+Edges — deliberately reference-based, not call-based: ANY Load of a name
+that resolves to a package function adds an edge (`jax.lax.while_loop(
+cond, body, init)` passes `body` without calling it; a reference is the
+honest "may be traced" signal). Dynamic dispatch through
+`family(cfg).embed(...)` (models/api.py) fans out to the same attribute
+in every package module the dispatching module imports.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+# modules whose names never resolve into the package
+_EXTERNAL = {
+    "jax", "jnp", "np", "numpy", "functools", "threading", "collections",
+    "math", "json", "time", "os", "re", "ast",
+}
+
+
+@dataclass
+class FuncInfo:
+    """One function (or method, or nested closure) in the package."""
+
+    module: str  # dotted module name relative to the package root
+    qualname: str  # "decode" / "PipelineBackend._build_prefill.body"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    params: tuple = ()
+    is_jit_root: bool = False
+    jit_site: Optional[ast.Call] = None  # the jit Call/decorator, if any
+
+    @property
+    def key(self) -> tuple:
+        return (self.module, self.qualname)
+
+
+@dataclass
+class ModuleInfo:
+    name: str  # dotted, relative to the package ("engine.generate")
+    path: str
+    tree: ast.Module
+    lines: list
+    functions: dict = field(default_factory=dict)  # qualname -> FuncInfo
+    # alias -> ("module", dotted) | ("obj", dotted_module, name)
+    #        | ("external", name)
+    imports: dict = field(default_factory=dict)
+
+
+@dataclass
+class PackageIndex:
+    root: str  # filesystem path of the package dir
+    modules: dict = field(default_factory=dict)  # dotted name -> ModuleInfo
+
+    def functions(self) -> Iterator[FuncInfo]:
+        for mod in self.modules.values():
+            yield from mod.functions.values()
+
+    def get(self, module: str, qualname: str) -> Optional[FuncInfo]:
+        mod = self.modules.get(module)
+        return mod.functions.get(qualname) if mod else None
+
+    def rel_path(self, module: str) -> str:
+        return self.modules[module].path
+
+
+def _module_name(root: str, path: str) -> str:
+    rel = os.path.relpath(path, root)
+    parts = rel[:-3].split(os.sep)  # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _collect_functions(mod: ModuleInfo) -> None:
+    """Register every def (top-level, method, nested) under a qualname."""
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}" if prefix else child.name
+                a = child.args
+                params = tuple(
+                    p.arg
+                    for p in (a.posonlyargs + a.args + a.kwonlyargs)
+                )
+                mod.functions[q] = FuncInfo(
+                    module=mod.name, qualname=q, node=child, params=params
+                )
+                visit(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(mod.tree, "")
+
+
+def _resolve_relative(current: str, level: int, target: str) -> str:
+    """Dotted module for a `from ...X import Y` seen inside `current`."""
+    parts = current.split(".")[:-1] if current else []  # current's package
+    if level > 1:
+        parts = parts[: len(parts) - (level - 1)]
+    if target:
+        parts = parts + target.split(".")
+    return ".".join(parts)
+
+
+def _collect_imports(index_modules: set, mod: ModuleInfo) -> None:
+    """Map aliases to package modules / objects (function-level imports
+    included — engine/paged.py imports models.api inside a traced body)."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                mod.imports[name] = ("external", alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = _resolve_relative(mod.name, node.level, node.module or "")
+            else:
+                base = node.module or ""
+            for alias in node.names:
+                name = alias.asname or alias.name
+                as_module = f"{base}.{alias.name}" if base else alias.name
+                if as_module in index_modules:
+                    mod.imports[name] = ("module", as_module)
+                elif base in index_modules:
+                    mod.imports[name] = ("obj", base, alias.name)
+                else:
+                    mod.imports[name] = ("external", alias.name)
+
+
+def build_index(root: str) -> PackageIndex:
+    """Parse every .py under `root` (a package directory)."""
+    index = PackageIndex(root=root)
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for f in sorted(filenames):
+            if f.endswith(".py"):
+                paths.append(os.path.join(dirpath, f))
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            source = fh.read()
+        name = _module_name(root, path)
+        mod = ModuleInfo(
+            name=name,
+            path=os.path.relpath(path, os.path.dirname(root.rstrip(os.sep))),
+            tree=ast.parse(source, filename=path),
+            lines=source.splitlines(),
+        )
+        _collect_functions(mod)
+        index.modules[name] = mod
+    names = set(index.modules)
+    for mod in index.modules.values():
+        _collect_imports(names, mod)
+    return index
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`jax.lax.ppermute` -> "jax.lax.ppermute"; None for non-chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST, mod: ModuleInfo) -> bool:
+    """True for `jax.jit` / `jit` (imported from jax) expressions."""
+    d = dotted(node)
+    return d in ("jax.jit", "jit")
+
+
+def _jit_roots_from_decorators(mod: ModuleInfo) -> Iterator[tuple]:
+    for fn in mod.functions.values():
+        node = fn.node
+        for dec in getattr(node, "decorator_list", ()):
+            if _is_jit_expr(dec, mod):
+                yield fn, None
+            elif isinstance(dec, ast.Call):
+                if _is_jit_expr(dec.func, mod):
+                    yield fn, dec
+                elif dotted(dec.func) in ("functools.partial", "partial"):
+                    if dec.args and _is_jit_expr(dec.args[0], mod):
+                        yield fn, dec
+
+
+_TRACING_WRAPPERS = ("shard_map", "jax.shard_map", "self._shard")
+
+
+def _jit_roots_from_calls(mod: ModuleInfo) -> Iterator[tuple]:
+    """`jax.jit(fn, ...)` / `shard_map(body, ...)` with a Name argument
+    that resolves to a function defined in this module."""
+    by_name = {}
+    for fn in mod.functions.values():
+        by_name.setdefault(fn.qualname.rsplit(".", 1)[-1], []).append(fn)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        is_jit = _is_jit_expr(node.func, mod)
+        if not is_jit and d not in _TRACING_WRAPPERS:
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                for fn in by_name[arg.id]:
+                    yield fn, (node if is_jit else None)
+
+
+def _local_scope(fn: FuncInfo, mod: ModuleInfo) -> dict:
+    """Names defined as nested functions directly inside `fn`."""
+    prefix = fn.qualname + "."
+    out = {}
+    for q, f in mod.functions.items():
+        if q.startswith(prefix) and "." not in q[len(prefix):]:
+            out[q[len(prefix):]] = f
+    return out
+
+
+def _class_scope(fn: FuncInfo, mod: ModuleInfo) -> dict:
+    """Sibling methods, for `self.method` edges."""
+    if "." not in fn.qualname:
+        return {}
+    cls = fn.qualname.split(".")[0]
+    prefix = cls + "."
+    out = {}
+    for q, f in mod.functions.items():
+        if q.startswith(prefix) and "." not in q[len(prefix):]:
+            out[q[len(prefix):]] = f
+    return out
+
+
+def _walk_own_body(fn: FuncInfo) -> Iterator[ast.AST]:
+    """Walk `fn`'s body but NOT nested function bodies (they are their own
+    graph nodes; the defining statement itself is yielded so a reference
+    to the nested name still resolves)."""
+
+    def walk(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield from walk(child)
+
+    for stmt in fn.node.body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from walk(stmt)
+
+
+def _edges_for(fn: FuncInfo, mod: ModuleInfo, index: PackageIndex) -> set:
+    """All package functions `fn` references (see module docstring)."""
+    out = set()
+    local = _local_scope(fn, mod)
+    methods = _class_scope(fn, mod)
+    local_fns = {f.qualname.rsplit(".", 1)[-1]: f
+                 for q, f in mod.functions.items() if "." not in q}
+
+    def resolve_name(name: str) -> Optional[FuncInfo]:
+        if name in local:
+            return local[name]
+        if name in local_fns:
+            return local_fns[name]
+        imp = mod.imports.get(name)
+        if imp and imp[0] == "obj":
+            return index.get(imp[1], imp[2])
+        return None
+
+    for node in _walk_own_body(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            target = resolve_name(node.id)
+            if target is not None:
+                out.add(target.key)
+        elif isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            if isinstance(node.value, ast.Name):
+                base = node.value.id
+                if base == "self" and node.attr in methods:
+                    out.add(methods[node.attr].key)
+                    continue
+                imp = mod.imports.get(base)
+                if imp and imp[0] == "module":
+                    target = index.get(imp[1], node.attr)
+                    if target is not None:
+                        out.add(target.key)
+            elif isinstance(node.value, ast.Call):
+                # dynamic family dispatch: `family(cfg).embed(...)` — when
+                # the inner call resolves to a package function, fan the
+                # attribute out to every package module this module
+                # imports (models/api.py imports exactly the families)
+                inner = None
+                if isinstance(node.value.func, ast.Name):
+                    inner = resolve_name(node.value.func.id)
+                if inner is not None:
+                    for imp in mod.imports.values():
+                        if imp[0] == "module":
+                            target = index.get(imp[1], node.attr)
+                            if target is not None:
+                                out.add(target.key)
+    return out
+
+
+def jit_roots(index: PackageIndex) -> dict:
+    """{(module, qualname): jit_site_or_None} for every traced root."""
+    roots = {}
+    for mod in index.modules.values():
+        for fn, site in _jit_roots_from_decorators(mod):
+            fn.is_jit_root = True
+            fn.jit_site = site
+            roots.setdefault(fn.key, site)
+        for fn, site in _jit_roots_from_calls(mod):
+            fn.is_jit_root = True
+            if site is not None and fn.jit_site is None:
+                fn.jit_site = site
+            roots.setdefault(fn.key, site)
+    return roots
+
+
+def call_graph(index: PackageIndex) -> dict:
+    """{func_key: set(func_key)} over the whole package."""
+    graph = {}
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            graph[fn.key] = _edges_for(fn, mod, index)
+    return graph
+
+
+def traced_reachable(index: PackageIndex, extra_roots=()) -> set:
+    """Keys of every function reachable from a jit root (the functions
+    whose bodies execute inside a trace)."""
+    graph = call_graph(index)
+    seen = set()
+    stack = list(jit_roots(index)) + list(extra_roots)
+    while stack:
+        key = stack.pop()
+        if key in seen or key not in graph:
+            continue
+        seen.add(key)
+        stack.extend(graph[key] - seen)
+    return seen

@@ -1,0 +1,244 @@
+"""Compiled-artifact verification: assert the invariants ON the lowered
+programs, not just the source.
+
+The AST lint proves the source doesn't *write* a host sync; this pass
+proves the artifact doesn't *contain* one — the two fail independently
+(a dependency could lower a callback; a refactor could drop donation
+without touching any linted line). Checks, all on the tiny test config
+so they run in CI on CPU in seconds:
+
+  * zero host callbacks (`pure_callback` / `io_callback` /
+    `debug_callback` custom calls) in the solo AND constrained decode
+    StableHLO — the zero-Python-per-token contract;
+  * the decode loop really is compiled (a `stablehlo.while` is present —
+    an unrolled or host-driven loop would be a silent regression);
+  * donation aliasing is ACTUALLY present for the KV cache (the
+    `tf.aliasing_output` attr on the donated inputs — `donate_argnames`
+    that XLA rejects degrades to a copy with only a warning);
+  * a two-invocation recompile guard: calling decode again with
+    different *traced* values (limit, start_pos) must not grow the jit
+    cache — a shape or weak-type drift here means compile-per-step in
+    production;
+  * on a pp mesh (gated on `jax.shard_map`, like every pp test): the
+    decode program contains the ring `collective_permute` and no
+    callbacks.
+
+Reused by tests/test_analysis.py and tests/test_constrained_decode.py —
+one implementation of the artifact assertions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+_CALLBACK_MARKERS = ("callback",)  # pure/io/debug callback custom calls
+
+
+def check_no_host_callbacks(text: str) -> list:
+    """Problems if the lowered text contains any host-callback custom
+    call. `text`: StableHLO (`lowered.as_text()`)."""
+    low = text.lower()
+    out = []
+    for marker in _CALLBACK_MARKERS:
+        if marker in low:
+            n = low.count(marker)
+            out.append(
+                f"lowered program contains {n} {marker!r} occurrence(s) — "
+                f"the decode hot path must run zero host callbacks"
+            )
+    return out
+
+
+def check_while_compiled(text: str) -> list:
+    if "stablehlo.while" not in text and "while" not in text.lower():
+        return ["no while op in the lowered decode — the loop is not "
+                "compiled (unrolled or host-driven?)"]
+    return []
+
+
+def check_donation(text: str, min_aliased: int = 1) -> list:
+    """Donation must survive lowering: each donated input carries a
+    `tf.aliasing_output` attr in the StableHLO. min_aliased: the number
+    of cache leaves expected to alias (a {k, v} cache has 2)."""
+    n = text.count("tf.aliasing_output")
+    if n < min_aliased:
+        return [
+            f"only {n} aliased input(s) in the lowered program, expected "
+            f">= {min_aliased} — cache donation was dropped (XLA will "
+            f"copy the cache every step)"
+        ]
+    return []
+
+
+def count_cache_leaves(cache) -> int:
+    import jax
+
+    return len(jax.tree.leaves(cache))
+
+
+@functools.lru_cache(maxsize=1)
+def tiny_engine():
+    """The shared tiny solo engine (test-llama-tiny: vocab 256, dim 64 —
+    compiles in seconds on CPU)."""
+    from ..config import EngineConfig
+    from ..engine.engine import InferenceEngine
+    from ..models.registry import get_model_config
+
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(
+        cfg, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+
+
+def _decode_args(engine, constraint=None, limit=8, start_pos=4):
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import generate as G
+
+    cfg = engine.cfg
+    cache = engine.backend.init_cache(1, cfg.max_seq_len)
+    return (
+        cfg, engine.backend.params, jnp.zeros((1,), jnp.int32), cache,
+        jnp.int32(start_pos), jnp.int32(limit), jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True), None, None, None, None, constraint,
+    )
+
+
+def lower_solo_decode(engine=None, constrained: bool = False,
+                      max_steps: int = 16) -> str:
+    """StableHLO text of the REAL solo decode program (G.decode with its
+    declared donation — not a re-wrap, which would silently drop
+    donate_argnames and void the aliasing check)."""
+    from ..engine import generate as G
+
+    engine = engine or tiny_engine()
+    constraint = None
+    if constrained:
+        art = engine._compile_constraint({"regex": "[ab]{1,8}"})
+        cm, ct = art.device_tables()
+        import jax.numpy as jnp
+
+        constraint = (jnp.zeros((1,), jnp.int32), cm, ct)
+    lowered = G.decode.lower(
+        *_decode_args(engine, constraint), max_steps=max_steps
+    )
+    return lowered.as_text()
+
+
+def check_no_recompile(engine=None) -> list:
+    """Run the decode program twice with different TRACED values; the jit
+    cache must not grow (a second entry means some 'traced' input is
+    actually specializing the program — compile-per-request in prod)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import generate as G
+
+    engine = engine or tiny_engine()
+    cfg = engine.cfg
+    sampling = G.default_sampling(greedy=True)
+
+    def run(limit, start_pos, seed):
+        cache = engine.backend.init_cache(1, cfg.max_seq_len)
+        return G.decode(
+            cfg, engine.backend.params, jnp.zeros((1,), jnp.int32), cache,
+            jnp.int32(start_pos), jnp.int32(limit), jax.random.PRNGKey(seed),
+            sampling, None, None, None, None, None, max_steps=16,
+        )
+
+    out = run(4, 2, 0)
+    jax.block_until_ready(out[0])
+    size_after_first = G.decode._cache_size()
+    out = run(9, 5, 3)
+    jax.block_until_ready(out[0])
+    size_after_second = G.decode._cache_size()
+    if size_after_second > size_after_first:
+        return [
+            f"decode recompiled across invocations with different traced "
+            f"values (jit cache grew {size_after_first} -> "
+            f"{size_after_second}) — limit/start_pos/key must stay traced"
+        ]
+    return []
+
+
+def pp_available() -> bool:
+    import jax
+
+    return hasattr(jax, "shard_map") and len(jax.devices()) >= 2
+
+
+def lower_pp_decode(max_steps: int = 4) -> str:
+    """StableHLO of the pp-ring decode step (2 stages, tiny config).
+    Caller must gate on pp_available()."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import EngineConfig, MeshConfig
+    from ..engine import generate as G
+    from ..runtime import create_engine
+
+    engine = create_engine(
+        "test-llama-tiny", mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(prefill_buckets=(32,)),
+    )
+    backend = engine.backend
+    cache = backend.init_cache(1, engine.cfg.max_seq_len)
+    fn = backend._build_decode(max_steps)
+    lowered = fn.lower(
+        backend.shared, backend.layers, jnp.zeros((1,), jnp.int32), cache,
+        jnp.int32(4), jnp.int32(max_steps), jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True),
+    )
+    return lowered.as_text()
+
+
+def check_pp_ring(text: str, max_per_step: int = 2) -> list:
+    """The pp decode program must hand activations around the ring: at
+    least one collective_permute (the lax.ppermute microstep hop), and a
+    small rolled count — an unrolled ring would multiply it per
+    microstep."""
+    n = text.count("collective_permute")
+    if n < 1:
+        return ["no collective_permute in the pp decode program — the "
+                "ring hand-off is missing (activations moving over host?)"]
+    if n > max_per_step:
+        return [
+            f"{n} collective_permute ops in the pp decode program "
+            f"(expected <= {max_per_step}) — the microstep ring appears "
+            f"unrolled (compile time and program size scale with steps)"
+        ]
+    return []
+
+
+def run_hlo_checks() -> dict:
+    """The full artifact suite; {check_name: [problems]} (empty list ==
+    pass). The CLI and the CI gate consume this."""
+    results = {}
+    engine = tiny_engine()
+
+    solo = lower_solo_decode(engine)
+    results["solo-decode-callbacks"] = check_no_host_callbacks(solo)
+    results["solo-decode-while"] = check_while_compiled(solo)
+    cache = engine.backend.init_cache(1, engine.cfg.max_seq_len)
+    results["solo-decode-donation"] = check_donation(
+        solo, min_aliased=count_cache_leaves(cache)
+    )
+
+    constrained = lower_solo_decode(engine, constrained=True)
+    results["constrained-decode-callbacks"] = check_no_host_callbacks(
+        constrained
+    )
+    results["constrained-decode-donation"] = check_donation(
+        constrained, min_aliased=count_cache_leaves(cache)
+    )
+
+    results["recompile-guard"] = check_no_recompile(engine)
+
+    if pp_available():
+        pp = lower_pp_decode()
+        results["pp-decode-callbacks"] = check_no_host_callbacks(pp)
+        results["pp-decode-ring"] = check_pp_ring(pp)
+    else:
+        results["pp-decode (skipped: no jax.shard_map / < 2 devices)"] = []
+    return results

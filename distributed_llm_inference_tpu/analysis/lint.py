@@ -1,0 +1,117 @@
+"""Rule engine: run the AST rules, apply per-line suppressions, report.
+
+Suppression syntax (per line, reason MANDATORY — an unexplained
+suppression is itself a violation):
+
+    x.item()  # jaxlint: disable=host-sync -- eager branch, guarded above
+
+A standalone `# jaxlint: disable=...` comment suppresses the NEXT line
+(for lines too long to carry the comment). `disable=all` silences every
+rule on that line. The separator before the reason may be `--`, an
+em/en dash, or a colon.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from .callgraph import PackageIndex, build_index
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*jaxlint:\s*disable=([\w,\-]+)\s*(?:--+|—|–|:)?\s*(.*)"
+)
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One finding, printable as `path:line: [rule] message`."""
+
+    path: str  # package-relative file path
+    line: int  # 1-indexed
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class Suppression:
+    rules: frozenset  # rule ids, or {"all"}
+    reason: str
+    line: int  # line the suppression APPLIES to
+
+    def covers(self, rule: str) -> bool:
+        return "all" in self.rules or rule in self.rules
+
+
+def parse_suppressions(lines) -> tuple:
+    """(by_line: {lineno: Suppression}, bad: [Diagnostic-args]) — a
+    suppression with no reason is reported, not honored."""
+    by_line = {}
+    bad = []
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(",") if r.strip())
+        reason = m.group(2).strip()
+        # a standalone comment line suppresses the next line
+        target = i + 1 if text.lstrip().startswith("#") else i
+        if not reason:
+            bad.append((i, "suppression without a reason — write "
+                           "`# jaxlint: disable=RULE -- why it is safe`"))
+            continue
+        by_line[target] = Suppression(rules=rules, reason=reason, line=target)
+    return by_line, bad
+
+
+def run_lint(root: str, rules=None, index: Optional[PackageIndex] = None):
+    """Run the rule set over the package at `root`.
+
+    Returns (diagnostics, suppressed_count). `rules`: iterable of rule
+    ids (default: all registered rules).
+    """
+    from .rules import ALL_RULES
+
+    if index is None:
+        index = build_index(root)
+    selected = list(ALL_RULES) if rules is None else list(rules)
+    unknown = [r for r in selected if r not in ALL_RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {unknown}; have {sorted(ALL_RULES)}")
+
+    raw: list = []
+    for rule_id in selected:
+        raw.extend(ALL_RULES[rule_id](index))
+
+    # suppression filtering, per file
+    supp_by_file = {}
+    diagnostics = []
+    suppressed = 0
+    for mod in index.modules.values():
+        by_line, bad = parse_suppressions(mod.lines)
+        supp_by_file[mod.path] = by_line
+        for line, msg in bad:
+            diagnostics.append(
+                Diagnostic(path=mod.path, line=line, rule="bad-suppression",
+                           message=msg)
+            )
+    for d in sorted(raw, key=lambda d: (d.path, d.line, d.rule)):
+        supp = supp_by_file.get(d.path, {}).get(d.line)
+        if supp is not None and supp.covers(d.rule):
+            suppressed += 1
+            continue
+        diagnostics.append(d)
+    return diagnostics, suppressed
+
+
+def format_diagnostics(diagnostics, suppressed: int = 0) -> str:
+    out = [d.format() for d in diagnostics]
+    tail = f"{len(diagnostics)} violation(s)"
+    if suppressed:
+        tail += f", {suppressed} suppressed"
+    out.append(tail)
+    return "\n".join(out)

@@ -1,0 +1,94 @@
+"""Rule registry + shared AST helpers.
+
+Each rule module exposes `RULE_ID: str` and `check(index) ->
+list[Diagnostic]`. Register new rules in ALL_RULES; document them in
+ARCHITECTURE.md "Invariants" when you do.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import dotted
+
+# attribute chains that read host-known metadata, not device values
+_HOST_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
+# names whose attributes are static under every hot-path jit (cfg is in
+# static_argnames everywhere; self only appears in host-side builders)
+_STATIC_BASES = {"cfg", "dcfg", "self"}
+_HOST_CALLS = {"len", "isinstance", "getattr", "hasattr", "min", "max", "abs"}
+
+
+def is_host_safe(node: ast.AST) -> bool:
+    """True when evaluating `node` cannot force a device sync: constants,
+    shape/dtype metadata, len(), static-config attribute chains, and
+    arithmetic over those. Conservative — unknown names are NOT safe."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        if node.attr in _HOST_ATTRS:
+            return True
+        base = node.value
+        while isinstance(base, ast.Attribute):
+            base = base.value
+        return isinstance(base, ast.Name) and base.id in _STATIC_BASES
+    if isinstance(node, ast.Name):
+        return node.id in _STATIC_BASES
+    if isinstance(node, ast.Subscript):
+        return is_host_safe(node.value)
+    if isinstance(node, ast.Call):
+        f = dotted(node.func)
+        return f in _HOST_CALLS and all(is_host_safe(a) for a in node.args)
+    if isinstance(node, ast.BinOp):
+        return is_host_safe(node.left) and is_host_safe(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return is_host_safe(node.operand)
+    if isinstance(node, ast.Compare):
+        return is_host_safe(node.left) and all(
+            is_host_safe(c) for c in node.comparators
+        )
+    if isinstance(node, ast.BoolOp):
+        return all(is_host_safe(v) for v in node.values)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(is_host_safe(e) for e in node.elts)
+    return False
+
+
+def walk_own_body(fn_node: ast.AST):
+    """Walk a function body without descending into nested defs/lambdas
+    (those are separate call-graph nodes)."""
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield from walk(child)
+
+    for stmt in fn_node.body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from walk(stmt)
+
+
+from . import (  # noqa: E402 — registry needs the helpers above
+    donation,
+    host_sync,
+    metrics_labels,
+    routes,
+    static_args,
+    tracer_branch,
+)
+
+ALL_RULES = {
+    mod.RULE_ID: mod.check
+    for mod in (
+        host_sync, tracer_branch, donation, static_args, metrics_labels,
+        routes,
+    )
+}
+
+__all__ = ["ALL_RULES", "is_host_safe", "walk_own_body"]

@@ -1,0 +1,106 @@
+"""host-sync: no host synchronization inside traced (decode-reachable)
+functions.
+
+Any of these in a function reachable from a jitted entry point either
+breaks the trace outright or — worse — silently lowers to a host
+callback, reintroducing a Python round-trip per token (the exact failure
+mode this reproduction exists to delete):
+
+  * `.item()` / `.tolist()` / `.block_until_ready()` /
+    `.copy_to_host_async()` — explicit device→host fetches;
+  * `int()` / `float()` / `bool()` on anything but host-known metadata
+    (shape/dtype/len/static cfg) — implicit concretization;
+  * `np.*` / `numpy.*` calls — numpy forces host values;
+  * `jax.device_get`, `jax.debug.*`, `jax.pure_callback`,
+    `io_callback` — host callbacks by construction;
+  * `print(...)` and `time.*` — host side effects (timestamps belong at
+    already-host-blocking boundaries, never inside the trace).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import PackageIndex, dotted, traced_reachable
+from ..lint import Diagnostic
+from . import is_host_safe, walk_own_body
+
+RULE_ID = "host-sync"
+
+_SYNC_METHODS = {
+    "item", "tolist", "block_until_ready", "copy_to_host_async",
+}
+_CONCRETIZERS = {"int", "float", "bool"}
+_NUMPY_ALIASES = {"np", "numpy"}
+_JAX_ESCAPES = {
+    "jax.device_get", "jax.pure_callback", "jax.debug.print",
+    "jax.debug.callback", "jax.debug.breakpoint",
+    "jax.experimental.io_callback", "io_callback", "pure_callback",
+}
+
+
+def _check_call(node: ast.Call, path: str, out: list) -> None:
+    func = node.func
+    d = dotted(func)
+    if isinstance(func, ast.Attribute):
+        if func.attr in _SYNC_METHODS:
+            out.append(Diagnostic(
+                path=path, line=node.lineno, rule=RULE_ID,
+                message=f".{func.attr}() forces a device->host sync inside "
+                        f"a traced function",
+            ))
+            return
+        base = d.split(".")[0] if d else None
+        if base in _NUMPY_ALIASES:
+            out.append(Diagnostic(
+                path=path, line=node.lineno, rule=RULE_ID,
+                message=f"{d}() runs on host (numpy concretizes traced "
+                        f"values); use jnp inside traced code",
+            ))
+            return
+        if base == "time":
+            out.append(Diagnostic(
+                path=path, line=node.lineno, rule=RULE_ID,
+                message=f"{d}() is a host side effect inside a traced "
+                        f"function; timestamps belong at host-blocking "
+                        f"boundaries",
+            ))
+            return
+    if d in _JAX_ESCAPES:
+        out.append(Diagnostic(
+            path=path, line=node.lineno, rule=RULE_ID,
+            message=f"{d} lowers to a host callback — zero Python per "
+                    f"token means zero callbacks in the decode program",
+        ))
+        return
+    if isinstance(func, ast.Name):
+        if func.id == "print":
+            out.append(Diagnostic(
+                path=path, line=node.lineno, rule=RULE_ID,
+                message="print() inside a traced function (prints at trace "
+                        "time, or syncs via debug callback)",
+            ))
+        elif (
+            func.id in _CONCRETIZERS
+            and node.args
+            and not all(is_host_safe(a) for a in node.args)
+        ):
+            out.append(Diagnostic(
+                path=path, line=node.lineno, rule=RULE_ID,
+                message=f"{func.id}() on a possibly-traced value "
+                        f"concretizes it (host sync); use jnp.{func.id}32/"
+                        f"astype, or compute from shapes/static cfg",
+            ))
+
+
+def check(index: PackageIndex) -> list:
+    out: list = []
+    reachable = traced_reachable(index)
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            if fn.key not in reachable:
+                continue
+            for node in walk_own_body(fn.node):
+                if isinstance(node, ast.Call):
+                    _check_call(node, mod.path, out)
+    return out

@@ -1,0 +1,96 @@
+"""metrics-labels: metric registrations must declare literal, bounded
+label sets.
+
+The registry (utils/metrics.py) caps series per family at MAX_SERIES and
+collapses overflow into `_other_` — but that fence only works when the
+LABEL NAMES are a small fixed set. A computed labelnames argument (or a
+wide one) turns label cardinality into a runtime property nobody can
+audit from the code, and a request-controlled label name is a
+memory-growth primitive the cap cannot see. So every
+`registry.counter/gauge/histogram(...)` registration must pass
+labelnames as a literal tuple/list of string constants, at most
+_MAX_LABELNAMES wide.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import PackageIndex
+from ..lint import Diagnostic
+from . import walk_own_body
+
+RULE_ID = "metrics-labels"
+
+_REGISTRATION_METHODS = {"counter", "gauge", "histogram"}
+# conservative: wider label sets multiply series counts combinatorially
+# against the registry's MAX_SERIES cap
+_MAX_LABELNAMES = 4
+# positional slot of labelnames in counter/gauge/histogram(name, help, labelnames)
+_LABELNAMES_POS = 2
+
+
+def _labelnames_arg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return kw.value
+    if len(call.args) > _LABELNAMES_POS:
+        return call.args[_LABELNAMES_POS]
+    return None
+
+
+def _literal_strs(node: ast.AST):
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return vals
+    return None
+
+
+def check(index: PackageIndex) -> list:
+    out: list = []
+    for mod in index.modules.values():
+        if mod.name.startswith("utils.metrics") or mod.name == "utils.metrics":
+            continue  # the registry's own internals register nothing
+        for fn in mod.functions.values():
+            for node in walk_own_body(fn.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTRATION_METHODS
+                ):
+                    continue
+                # only metric registrations: first positional arg is the
+                # metric name, a string literal by convention — anything
+                # else (e.g. collections.Counter) is not a registration
+                if not (
+                    node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith("dli_")
+                ):
+                    continue
+                arg = _labelnames_arg(node)
+                if arg is None:
+                    continue  # no labels: one unlabeled series, fine
+                names = _literal_strs(arg)
+                if names is None:
+                    out.append(Diagnostic(
+                        path=mod.path, line=node.lineno, rule=RULE_ID,
+                        message=f"metric {node.args[0].value!r}: labelnames "
+                                f"must be a literal tuple of string "
+                                f"constants (computed label sets defeat the "
+                                f"cardinality cap audit)",
+                    ))
+                elif len(names) > _MAX_LABELNAMES:
+                    out.append(Diagnostic(
+                        path=mod.path, line=node.lineno, rule=RULE_ID,
+                        message=f"metric {node.args[0].value!r} declares "
+                                f"{len(names)} labels (> {_MAX_LABELNAMES}) "
+                                f"— series counts multiply per label "
+                                f"against the registry cap",
+                    ))
+    return out

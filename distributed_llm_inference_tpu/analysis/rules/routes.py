@@ -1,0 +1,56 @@
+"""route-counter: every HTTP response path in serving/ must hit the
+status counter.
+
+The serving stack promises that `dli_http_requests_total` covers every
+response (ISSUE 2 carried this by hand). The server routes all JSON/HTML
+responses through `_send` (which counts), but streaming paths (SSE,
+NDJSON) write their own `send_response` — each of those call sites must
+be preceded by a `self._count(...)` in the same function, or the scrape
+silently undercounts exactly the long-lived requests that matter most.
+
+Rule: in serving/ modules, every call to `send_response` must either be
+inside a function whose name is `_send`, or have a `_count(...)` call
+earlier in the same function body.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import PackageIndex
+from ..lint import Diagnostic
+from . import walk_own_body
+
+RULE_ID = "route-counter"
+
+
+def _is_method_call(node: ast.Call, name: str) -> bool:
+    return isinstance(node.func, ast.Attribute) and node.func.attr == name
+
+
+def check(index: PackageIndex) -> list:
+    out: list = []
+    for mod in index.modules.values():
+        if mod.name.split(".")[0] != "serving":
+            continue
+        for fn in mod.functions.values():
+            if fn.qualname.rsplit(".", 1)[-1] == "_send":
+                continue
+            count_lines = []
+            sends = []
+            for node in walk_own_body(fn.node):
+                if isinstance(node, ast.Call):
+                    if _is_method_call(node, "_count"):
+                        count_lines.append(node.lineno)
+                    elif _is_method_call(node, "send_response"):
+                        sends.append(node)
+            for node in sends:
+                if not any(line <= node.lineno for line in count_lines):
+                    out.append(Diagnostic(
+                        path=mod.path, line=node.lineno, rule=RULE_ID,
+                        message=f"send_response in {fn.qualname} without a "
+                                f"preceding self._count(...) — this "
+                                f"response path is invisible to "
+                                f"dli_http_requests_total",
+                    ))
+    return out

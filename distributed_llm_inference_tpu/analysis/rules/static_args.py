@@ -1,0 +1,118 @@
+"""static-args: recompile hazards in jit static arguments.
+
+Two hazards, both of which turn "compile once per bucket" into "compile
+per request":
+
+  * a `static_argnames`/`static_argnums` value that is not a literal
+    tuple/list/str of constants — computed static names defeat auditing
+    and usually indicate a dynamically-varying static set;
+  * a CALL SITE passing an unhashable or per-call-fresh value (f-string,
+    list/dict/set literal or comprehension, lambda) as a known static
+    parameter of a package jit function — every distinct value is a new
+    cache entry, every call a potential recompile. (jax raises on
+    unhashables; f-strings hash fine and silently recompile per string —
+    the worse failure.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import PackageIndex, dotted
+from ..lint import Diagnostic
+from . import walk_own_body
+
+RULE_ID = "static-args"
+
+_FRESH_VALUE_NODES = (
+    ast.JoinedStr, ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+    ast.SetComp, ast.GeneratorExp, ast.Lambda,
+)
+
+
+def _literal_str_seq(node: ast.AST):
+    """The tuple of strings in a literal static_argnames value, or None
+    when the value is computed."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+            vals.append(e.value)
+        return tuple(vals)
+    return None
+
+
+def _jit_static_kwargs(call: ast.Call):
+    """(static_names or None, computed: bool) from a jit/partial call."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _literal_str_seq(kw.value)
+            return names, names is None
+    return (), False
+
+
+def _collect_jit_statics(index: PackageIndex) -> tuple:
+    """({func_bare_name: static_names}, diagnostics for computed sets)."""
+    statics = {}
+    diags: list = []
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            for dec in getattr(fn.node, "decorator_list", ()):
+                call = None
+                if isinstance(dec, ast.Call):
+                    d = dotted(dec.func)
+                    if d in ("jax.jit", "jit"):
+                        call = dec
+                    elif d in ("functools.partial", "partial") and dec.args:
+                        if dotted(dec.args[0]) in ("jax.jit", "jit"):
+                            call = dec
+                if call is None:
+                    continue
+                names, computed = _jit_static_kwargs(call)
+                if computed:
+                    diags.append(Diagnostic(
+                        path=mod.path, line=call.lineno, rule=RULE_ID,
+                        message=f"static_argnames of {fn.qualname} is not a "
+                                f"literal tuple of strings — static sets "
+                                f"must be auditable constants",
+                    ))
+                elif names:
+                    statics.setdefault(
+                        fn.qualname.rsplit(".", 1)[-1], set()
+                    ).update(names)
+    return statics, diags
+
+
+def check(index: PackageIndex) -> list:
+    statics, out = _collect_jit_statics(index)
+    if not statics:
+        return out
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            for node in walk_own_body(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    callee = node.func.attr
+                names = statics.get(callee)
+                if not names:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg in names and isinstance(
+                        kw.value, _FRESH_VALUE_NODES
+                    ):
+                        what = type(kw.value).__name__
+                        out.append(Diagnostic(
+                            path=mod.path, line=node.lineno, rule=RULE_ID,
+                            message=f"{callee}({kw.arg}=<{what}>): passing a "
+                                    f"fresh/unhashable value as a static "
+                                    f"argument recompiles per call — hoist "
+                                    f"it to a hashable constant",
+                        ))
+    return out

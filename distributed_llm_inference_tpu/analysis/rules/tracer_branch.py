@@ -1,0 +1,83 @@
+"""tracer-branch: no Python control flow on traced values in ops/ and
+parallel/.
+
+`if jnp.any(mask):` inside kernel/collective code either raises a
+ConcretizationTypeError at trace time or — when the module is also
+imported eagerly — silently branches on a single test value and bakes
+that branch into every compiled program. Data-dependent control flow in
+the hot path belongs in `lax.cond` / `lax.while_loop` / `jnp.where`.
+
+Detection is deliberately precise rather than exhaustive: a Python
+`if` / `while` / ternary / assert whose test contains a `jnp.*` /
+`jax.numpy.*` / `jax.lax.*` call, or an array-reduction method call
+(`.any()` / `.all()` / `.sum()` / `.max()` / `.min()`), is definitively
+branching on a computed array predicate. Shape / dtype / None tests
+never trip it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import PackageIndex, dotted
+from ..lint import Diagnostic
+from . import walk_own_body
+
+RULE_ID = "tracer-branch"
+
+_SCOPED_DIRS = ("ops", "parallel")
+_REDUCTIONS = {"any", "all", "sum", "max", "min", "argmax", "argmin"}
+_ARRAY_NAMESPACES = {"jnp", "jax.numpy", "jax.lax", "lax"}
+
+
+def _is_array_predicate(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        if d:
+            ns = d.rsplit(".", 1)[0]
+            if ns in _ARRAY_NAMESPACES:
+                return True
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REDUCTIONS
+            and not isinstance(node.func.value, ast.Name)
+        ):
+            # method reduction on a non-trivial expression; bare
+            # `name.sum()` also counts when name isn't a module alias
+            return True
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REDUCTIONS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id not in ("builtins", "math")
+        ):
+            return True
+    return False
+
+
+def check(index: PackageIndex) -> list:
+    out: list = []
+    for mod in index.modules.values():
+        top = mod.name.split(".")[0]
+        if top not in _SCOPED_DIRS:
+            continue
+        for fn in mod.functions.values():
+            for node in walk_own_body(fn.node):
+                test = None
+                kind = None
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "conditional expression"
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                if test is not None and _is_array_predicate(test):
+                    out.append(Diagnostic(
+                        path=mod.path, line=node.lineno, rule=RULE_ID,
+                        message=f"Python {kind} on an array predicate in "
+                                f"{fn.qualname} — use lax.cond/"
+                                f"lax.while_loop/jnp.where in traced code",
+                    ))
+    return out

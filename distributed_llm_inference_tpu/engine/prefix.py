@@ -46,6 +46,7 @@ import jax.numpy as jnp
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
+# jaxlint: disable=donate-cache -- pure snapshot READ: the live cache must survive extraction (the engine keeps decoding on it)
 def _extract(cache, p: int):
     return jax.tree.map(
         lambda x: jax.lax.slice_in_dim(x, 0, p, axis=3), cache

@@ -208,6 +208,7 @@ def sample_token(
     # re-traces fresh branch closures every call and XLA recompiles the
     # whole computation each time (measured 10x test-suite blowup) — a
     # concrete flag needs a plain Python branch instead.
+    # jaxlint: disable=host-sync -- eager-only branch: the Tracer case returned via lax.cond above; a concrete flag costs nothing to read
     if bool(all_greedy):
         return _argmax_only(*operands)
     return _fused(*operands)

@@ -1,0 +1,524 @@
+"""The compiled-decode invariant checker (analysis/): rule fixtures
+(positive + negative + suppressed per rule), call-graph reachability
+units on synthetic packages AND the real one, the CLI exit contract, and
+the compiled-artifact (HLO) assertions for solo and pp decode.
+
+Selectable standalone: `pytest -m analysis`.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import pytest
+
+from distributed_llm_inference_tpu.analysis import hlo
+from distributed_llm_inference_tpu.analysis.callgraph import (
+    build_index, traced_reachable,
+)
+from distributed_llm_inference_tpu.analysis.lint import run_lint
+
+pytestmark = pytest.mark.analysis
+
+PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "distributed_llm_inference_tpu",
+)
+
+needs_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="this jax build has no jax.shard_map (pp backends unavailable)",
+)
+
+
+def make_pkg(tmp_path, files: dict) -> str:
+    """Write a throwaway package tree and return its root."""
+    root = tmp_path / "fixture_pkg"
+    for rel, body in files.items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(body))
+    return str(root)
+
+
+def lint(tmp_path, files, rules=None):
+    return run_lint(make_pkg(tmp_path, files), rules=rules)
+
+
+def rules_hit(diagnostics):
+    return sorted({d.rule for d in diagnostics})
+
+
+# -- host-sync: reachability-scoped sync detection ---------------------------
+
+HOST_SYNC_PKG = {
+    "engine/generate.py": """
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from ..ops.helpers import traced_helper
+
+        @functools.partial(jax.jit, donate_argnames=("cache",))
+        def decode(tokens, cache):
+            return traced_helper(tokens), cache
+
+        def host_only(x):
+            return x.item()  # NOT reachable from a jit root: no finding
+    """,
+    "ops/helpers.py": """
+        import jax.numpy as jnp
+
+        def traced_helper(x):
+            return jnp.sum(x)
+    """,
+}
+
+
+def test_host_sync_negative(tmp_path):
+    diags, _ = lint(tmp_path, HOST_SYNC_PKG, rules=["host-sync"])
+    assert diags == []
+
+
+def test_host_sync_positive_through_call_graph(tmp_path):
+    files = dict(HOST_SYNC_PKG)
+    files["ops/helpers.py"] = """
+        import jax.numpy as jnp
+
+        def traced_helper(x):
+            n = x.item()
+            return jnp.sum(x) + n
+    """
+    diags, _ = lint(tmp_path, files, rules=["host-sync"])
+    assert len(diags) == 1
+    d = diags[0]
+    assert d.rule == "host-sync"
+    assert d.path.endswith("ops/helpers.py")
+    assert d.line == 5
+    assert ".item()" in d.message
+
+
+@pytest.mark.parametrize("snippet,expect", [
+    ("jnp.sum(x)", 0),                       # clean
+    ("x.tolist()", 1),                       # explicit fetch
+    ("float(x)", 1),                         # concretization
+    ("float(x.shape[0])", 0),                # shape metadata is host-known
+    ("int(len(x.shape))", 0),                # len() is host-known
+    ("np.asarray(x)", 1),                    # numpy forces host
+    ("print(x)", 1),                         # host side effect
+    ("time.time()", 1),                      # timestamps in the trace
+    ("jax.device_get(x)", 1),                # device->host
+    ("jax.debug.print('{}', x)", 1),         # lowers to a callback
+])
+def test_host_sync_catalog(tmp_path, snippet, expect):
+    files = {
+        "engine/mod.py": f"""
+            import time
+            import functools
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @functools.partial(jax.jit, donate_argnames=("cache",))
+            def decode(x, cache):
+                y = {snippet}
+                return y, cache
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["host-sync"])
+    assert len(diags) == expect, (snippet, diags)
+
+
+def test_host_sync_suppressed_with_reason(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import jax
+
+            @jax.jit
+            def decode(x):
+                n = x.item()  # jaxlint: disable=host-sync -- fixture: known-safe here
+                return n
+        """,
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["host-sync"])
+    assert diags == []
+    assert suppressed == 1
+
+
+def test_suppression_without_reason_is_reported(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import jax
+
+            @jax.jit
+            def decode(x):
+                n = x.item()  # jaxlint: disable=host-sync
+                return n
+        """,
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["host-sync"])
+    assert suppressed == 0
+    assert rules_hit(diags) == ["bad-suppression", "host-sync"]
+
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import jax
+
+            @jax.jit
+            def decode(x):
+                # jaxlint: disable=host-sync -- fixture: next-line form
+                n = x.item()
+                return n
+        """,
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["host-sync"])
+    assert diags == []
+    assert suppressed == 1
+
+
+# -- tracer-branch -----------------------------------------------------------
+
+def test_tracer_branch_positive_and_negative(tmp_path):
+    files = {
+        "ops/kernels.py": """
+            import jax.numpy as jnp
+
+            def bad(x):
+                if jnp.any(x > 0):
+                    return x
+                return -x
+
+            def good(x):
+                if x.shape[0] > 1:
+                    return x
+                if x is None:
+                    return None
+                return -x
+        """,
+        "serving/host.py": """
+            import jax.numpy as jnp
+
+            def fine_here(x):
+                # serving/ is host code: data-dependent branching is normal
+                if jnp.any(x > 0):
+                    return x
+                return -x
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["tracer-branch"])
+    assert len(diags) == 1
+    assert diags[0].path.endswith("ops/kernels.py")
+    assert diags[0].line == 5
+
+
+def test_tracer_branch_while_and_reduction_method(tmp_path):
+    files = {
+        "parallel/ring.py": """
+            def spin(x):
+                while x.sum() > 0:
+                    x = x - 1
+                return x
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["tracer-branch"])
+    assert len(diags) == 1
+    assert "while" in diags[0].message
+
+
+# -- donate-cache ------------------------------------------------------------
+
+def test_donation_positive_negative_argnums(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnames=("cache",))
+            def good_names(tokens, cache):
+                return tokens, cache
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def bad(tokens, cache, *, n):
+                return tokens, cache
+
+            @jax.jit
+            def no_cache_arg(tokens):
+                return tokens
+
+            def build():
+                def body(shared, tokens, cache):
+                    return tokens, cache
+                shmapped = wrap(body)
+                return jax.jit(shmapped, donate_argnums=(2,))
+
+            def build_bad():
+                def body(shared, tokens, cache):
+                    return tokens, cache
+                shmapped = wrap(body)
+                return jax.jit(shmapped, donate_argnums=(1,))
+
+            def wrap(f):
+                return f
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["donate-cache"])
+    assert len(diags) == 2
+    assert {d.line for d in diags} == {10, 27}  # `bad` def, build_bad's jit
+
+
+# -- static-args -------------------------------------------------------------
+
+def test_static_args_fstring_call_site(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def run(x, *, mode):
+                return x
+
+            def bad_caller(x, name):
+                return run(x, mode=f"m-{name}")
+
+            def good_caller(x):
+                return run(x, mode="fixed")
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["static-args"])
+    assert len(diags) == 1
+    assert diags[0].line == 10
+
+
+def test_static_args_computed_names(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import functools
+            import jax
+
+            NAMES = ("mode",)
+
+            @functools.partial(jax.jit, static_argnames=NAMES)
+            def run(x, *, mode):
+                return x
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["static-args"])
+    assert len(diags) == 1
+    assert "literal" in diags[0].message
+
+
+# -- metrics-labels ----------------------------------------------------------
+
+def test_metrics_labels_literal_and_cap(tmp_path):
+    files = {
+        "serving/mod.py": """
+            def setup(registry, names):
+                ok = registry.counter(
+                    "dli_good_total", "fine", ("route", "status"),
+                )
+                computed = registry.counter(
+                    "dli_computed_total", "bad", tuple(names),
+                )
+                wide = registry.gauge(
+                    "dli_wide", "bad",
+                    ("a", "b", "c", "d", "e"),
+                )
+                unlabeled = registry.counter("dli_plain_total", "fine")
+                not_a_metric = registry.counter("requests", "no dli_ prefix")
+                return ok, computed, wide, unlabeled, not_a_metric
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["metrics-labels"])
+    assert len(diags) == 2
+    msgs = " / ".join(d.message for d in diags)
+    assert "dli_computed_total" in msgs and "dli_wide" in msgs
+
+
+# -- route-counter -----------------------------------------------------------
+
+def test_route_counter_rule(tmp_path):
+    files = {
+        "serving/srv.py": """
+            class Handler:
+                def _send(self, code):
+                    self._count(code)
+                    self.send_response(code)
+
+                def good_stream(self):
+                    self._count(200)
+                    self.send_response(200)
+
+                def bad_stream(self):
+                    self.send_response(200)
+        """,
+        "engine/not_serving.py": """
+            class Other:
+                def whatever(self):
+                    self.send_response(200)  # not serving/: out of scope
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["route-counter"])
+    assert len(diags) == 1
+    assert diags[0].line == 12
+    assert "bad_stream" in diags[0].message
+
+
+# -- call-graph units on the REAL package ------------------------------------
+
+@pytest.fixture(scope="module")
+def real_reachable():
+    index = build_index(PKG_ROOT)
+    return traced_reachable(index)
+
+
+def test_real_traced_set_includes_hot_path(real_reachable):
+    for key in [
+        ("engine.generate", "decode"),
+        ("engine.generate", "stop_mask"),
+        ("engine.generate", "slot_step"),
+        ("ops.sampling", "sample_token"),
+        ("ops.sampling", "_sample_warped"),
+        ("models.api", "forward_layers"),
+        ("models.llama", "forward_layers"),
+        ("models.gpt2", "forward_layers"),  # family-dispatch fan-out
+        ("ops.attention", "attend"),
+        ("engine.paged", "make_paged_hook.hook"),  # nested closure
+    ]:
+        assert key in real_reachable, key
+
+
+def test_real_traced_set_excludes_host_code(real_reachable):
+    for key in [
+        ("engine.generate", "pick_bucket"),  # host-side bucket picker
+        ("engine.engine", "InferenceEngine.generate"),
+        ("serving.server", "main"),
+        ("utils.metrics", "MetricsRegistry.render"),
+    ]:
+        assert key not in real_reachable, key
+
+
+def test_repo_is_clean():
+    """The package itself lints clean — the same gate CI runs."""
+    diags, _ = run_lint(PKG_ROOT)
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
+# -- CLI exit contract (acceptance criterion) --------------------------------
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "distributed_llm_inference_tpu.analysis",
+         *args],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(PKG_ROOT),
+    )
+
+
+def test_cli_clean_repo_exits_zero():
+    r = _run_cli()
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_item_in_decode_reachable_function_exits_nonzero(tmp_path):
+    """A `.item()` injected into a decode-reachable function must fail the
+    CLI with a file:line diagnostic."""
+    import shutil
+
+    bad_root = str(tmp_path / "pkg_with_item")
+    shutil.copytree(PKG_ROOT, bad_root, ignore=shutil.ignore_patterns(
+        "__pycache__", "*.pyc"
+    ))
+    gen = os.path.join(bad_root, "engine", "generate.py")
+    with open(gen) as fh:
+        src = fh.read()
+    needle = "    m = tokens == jnp.int32(cfg.eos_token_id)"
+    assert needle in src
+    with open(gen, "w") as fh:
+        fh.write(src.replace(
+            needle, "    _bad = tokens.item()\n" + needle
+        ))
+    r = _run_cli("--root", bad_root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "host-sync" in r.stdout
+    # file:line diagnostics
+    assert "generate.py:" in r.stdout and ".item()" in r.stdout
+
+
+# -- compiled-artifact (HLO) assertions --------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    return hlo.tiny_engine()
+
+
+def test_solo_decode_artifact(engine):
+    text = hlo.lower_solo_decode(engine)
+    assert hlo.check_no_host_callbacks(text) == []
+    assert hlo.check_while_compiled(text) == []
+    cache = engine.backend.init_cache(1, engine.cfg.max_seq_len)
+    n_leaves = hlo.count_cache_leaves(cache)
+    assert hlo.check_donation(text, min_aliased=n_leaves) == []
+
+
+def test_constrained_decode_artifact(engine):
+    text = hlo.lower_solo_decode(engine, constrained=True)
+    assert hlo.check_no_host_callbacks(text) == []
+    assert hlo.check_while_compiled(text) == []
+
+
+def test_donation_checker_catches_dropped_donation(engine):
+    """check_donation must FAIL on a re-wrap that drops donate_argnames —
+    the exact silent regression it exists to catch."""
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from distributed_llm_inference_tpu.engine import generate as G
+
+    cfg = engine.cfg
+    cache = engine.backend.init_cache(1, cfg.max_seq_len)
+    undonated = _jax.jit(
+        G.decode, static_argnames=("cfg", "max_steps"),
+    ).lower(
+        cfg, engine.backend.params, jnp.zeros((1,), jnp.int32), cache,
+        jnp.int32(4), jnp.int32(8), _jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True), None, None, None, None, None,
+        max_steps=16,
+    ).as_text()
+    assert hlo.check_donation(undonated, min_aliased=1) != []
+
+
+def test_callback_checker_catches_injected_callback(engine):
+    """check_no_host_callbacks must FAIL on a program that really does
+    call back into Python per step."""
+    import jax as _jax
+    import jax.numpy as jnp
+
+    def with_callback(x):
+        _jax.debug.print("step {}", x)
+        return x * 2
+
+    text = _jax.jit(with_callback).lower(jnp.ones((4,))).as_text()
+    assert hlo.check_no_host_callbacks(text) != []
+
+
+def test_recompile_guard(engine):
+    assert hlo.check_no_recompile(engine) == []
+
+
+def test_run_hlo_checks_all_green():
+    results = hlo.run_hlo_checks()
+    bad = {k: v for k, v in results.items() if v}
+    assert not bad, bad
+
+
+@needs_shard_map
+def test_pp_decode_artifact(eight_devices):
+    if not hlo.pp_available():
+        pytest.skip("pp HLO check needs >= 2 devices")
+    text = hlo.lower_pp_decode()
+    assert hlo.check_no_host_callbacks(text) == []
+    assert hlo.check_pp_ring(text) == []
