@@ -6,6 +6,13 @@ to GB) every step instead of updating it in place in HBM — functionally
 invisible, catastrophic for tok/s and memory headroom. Parameters named
 `cache` / `dcache` / `pool` / `*_cache` are treated as KV caches.
 
+Shared-block exception (block-level prefix sharing): a parameter named
+`shared_pool` is a pool whose blocks are MAPPED into other requests'
+block tables (engine/block_prefix.py) — the program only reads it, and
+donating it would let XLA reuse the buffer while every other table still
+reads those exact blocks. The rule INVERTS for that name: `shared_pool`
+must NOT be donated, and donating it is flagged.
+
 Resolvable jit sites are checked: decorated defs (`@jax.jit`,
 `@functools.partial(jax.jit, ...)`) and `jax.jit(f, ...)` calls whose
 wrapped callable traces back — through simple local assignments like
@@ -25,10 +32,17 @@ from . import walk_own_body
 RULE_ID = "donate-cache"
 
 _CACHE_NAMES = {"cache", "dcache", "pool"}
+# READ-ONLY mapped-pool convention: blocks of a `shared_pool` are mapped
+# into other live block tables, so the buffer must outlive this program —
+# donation is the bug here, not the fix.
+_SHARED_RO_NAMES = {"shared_pool"}
 
 
 def _is_cache_param(name: str) -> bool:
-    return name in _CACHE_NAMES or name.endswith("_cache")
+    return (
+        name not in _SHARED_RO_NAMES
+        and (name in _CACHE_NAMES or name.endswith("_cache"))
+    )
 
 
 def _params_of(node: ast.AST) -> tuple:
@@ -83,7 +97,8 @@ def _jit_call_of_decorator(dec: ast.AST):
 def _check_site(path: str, line: int, qualname: str, params: tuple,
                 jit_call, out: list) -> None:
     cache_params = [p for p in params if _is_cache_param(p)]
-    if not cache_params:
+    shared_params = [p for p in params if p in _SHARED_RO_NAMES]
+    if not cache_params and not shared_params:
         return
     donated = _donated(jit_call, params) if jit_call is not None else set()
     for p in cache_params:
@@ -93,6 +108,15 @@ def _check_site(path: str, line: int, qualname: str, params: tuple,
                 message=f"jit of {qualname} does not donate cache argument "
                         f"{p!r} (index {params.index(p)}) — XLA will copy "
                         f"the cache every call instead of updating in place",
+            ))
+    for p in shared_params:
+        if p in donated:
+            out.append(Diagnostic(
+                path=path, line=line, rule=RULE_ID,
+                message=f"jit of {qualname} DONATES shared pool argument "
+                        f"{p!r} — mapped shared blocks must not be "
+                        f"donated: other requests' block tables still "
+                        f"read those buffers",
             ))
 
 

@@ -90,7 +90,10 @@ class _Request:
         self.cancelled = False  # client went away; free the slot early
         self.prompt_tokens = 0  # set at admission (tokenized prompt length)
         self.block_ids = None  # paged mode: this request's pool blocks
-        self.need = None  # paged mode: blocks required (set on 1st attempt)
+        # paged mode: FRESH blocks required after the mapped shared head
+        # (set on the 1st admission attempt; drives the head-of-queue
+        # backpressure check)
+        self.need = None
         # grammar constraint (constrain/): (CompiledConstraint, fleet-table
         # row offset) once admitted; None = unconstrained
         self.cart = None
@@ -229,22 +232,37 @@ class ContinuousEngine:
         # the whole row (dense) / scatters every logical block (paged)
         self._scratch = self.backend.init_cache(1, self._scratch_seq)
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
-        # Own PrefixCache instance (engine/prefix.py), NOT shared with the
-        # solo engine's: the solo path touches its cache under the engine
-        # lock while this worker thread runs lock-free — separate instances
-        # cost duplicate snapshots at worst, never a race.
+        # Prefix reuse, one planner per fleet mode (both drive the shared
+        # engine._prefix_plan seam):
+        #   * paged: block-level sharing (engine/block_prefix.py) — a hit
+        #     MAPS the cached physical blocks into the request's table
+        #     (refcounted, dedup'd in pool HBM), no snapshot, no splice;
+        #   * dense: own PrefixCache instance (engine/prefix.py), NOT
+        #     shared with the solo engine's — the solo path touches its
+        #     cache under the engine lock while this worker thread runs
+        #     lock-free; separate instances cost duplicate snapshots at
+        #     worst, never a race.
         self._prefix = None
+        self._bpx = None
         if engine.engine_cfg.prefix_cache_entries > 0:
-            from .prefix import PrefixCache
+            if self.paged:
+                from .block_prefix import BlockPrefixIndex
 
-            if PrefixCache.compatible(self._scratch):
-                self._prefix = PrefixCache(
-                    engine.engine_cfg.prefix_cache_entries,
-                    engine.engine_cfg.prefix_chunk,
-                    registry=engine.metrics, scope="continuous",
+                self._bpx = BlockPrefixIndex(
+                    self._alloc, self.kv_block_size,
+                    registry=engine.metrics,
                 )
             else:
-                log.info("prefix_cache_disabled", reason="cache layout")
+                from .prefix import PrefixCache
+
+                if PrefixCache.compatible(self._scratch):
+                    self._prefix = PrefixCache(
+                        engine.engine_cfg.prefix_cache_entries,
+                        engine.engine_cfg.prefix_chunk,
+                        registry=engine.metrics, scope="continuous",
+                    )
+                else:
+                    log.info("prefix_cache_disabled", reason="cache layout")
 
         self._cv = threading.Condition()
         self._queue: list[_Request] = []
@@ -502,12 +520,19 @@ class ContinuousEngine:
                 "block_size": self.kv_block_size,
                 "pool_blocks": self._alloc.n_blocks,
                 "free_blocks": self._alloc.free_blocks,
+                "shared_blocks": self._alloc.shared_blocks,
+                "cached_blocks": (
+                    self._bpx.stats()["cached_blocks"]
+                    if self._bpx is not None else 0
+                ),
             }
         cstats = self._ctable.stats()
         if cstats["resident"]:
             out["constraints"] = cstats
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
+        elif self._bpx is not None:
+            out["prefix_cache"] = self._bpx.stats()
         return out
 
     # -- worker thread -------------------------------------------------------
@@ -621,10 +646,15 @@ class ContinuousEngine:
                 if (
                     self.paged
                     and self._queue[0].need is not None
-                    and self._queue[0].need > self._alloc.free_blocks
+                    and self._queue[0].need > self._alloc.free_blocks + (
+                        self._bpx.evictable_blocks()
+                        if self._bpx is not None else 0
+                    )
                 ):
-                    # a prior attempt already sized this request and the
-                    # pool still can't take it — don't re-tokenize/replan
+                    # a prior attempt already sized this request (need =
+                    # FRESH blocks after any mapped shared head) and the
+                    # pool still can't take it even by evicting every
+                    # unreferenced cached chain — don't re-tokenize/replan
                     # on every chunk iteration; wait for a release
                     break
                 req = self._queue.pop(0)
@@ -710,10 +740,13 @@ class ContinuousEngine:
         ids = eng.tokenizer.encode(text)
         prompt_len = len(ids)
         req.prompt_tokens = prompt_len
-        # prefix-cache lookup + ingest plan: the solo engine's shared
-        # helper (one copy of the lookup/cold-fallback/mark discipline)
+        # prefix lookup + ingest plan: the solo engine's shared planner
+        # helper (one copy of the lookup/cold-fallback/mark discipline);
+        # the planner is mode-specific — block-chain index (paged) or
+        # dense snapshot cache
         p0, entry, plan = eng._prefix_plan(
-            self._prefix, ids, capacity=self.slot_max_seq
+            self._bpx if self.paged else self._prefix, ids,
+            capacity=self.slot_max_seq,
         )
         if plan is None:
             raise ValueError(
@@ -724,17 +757,42 @@ class ContinuousEngine:
             prompt_len, int(k.get("max_tokens", 20)),
             capacity=self.slot_max_seq,
         )
-        table_row = None
+        table_row = insert_row = None
         if self.paged:
-            req.need = self._P.blocks_needed(
+            need_total = self._P.blocks_needed(
                 prompt_len, max_tokens, self.kv_block_size
             )
+            # entry may be deeper than the PLANNED depth (bucket limits
+            # degrade p0 — engine._prefix_plan): map exactly p0 worth
+            shared = list(entry)[: p0 // self.kv_block_size] if p0 else []
+            n_shared = len(shared)
+            # need records the FRESH-block shortfall for the head-of-queue
+            # backpressure check — the mapped head costs no new blocks
+            req.need = need_total - n_shared
+            if shared:
+                # hold the mapped chain NOW: this admission's own eviction
+                # (below) must never reclaim the blocks it is about to map
+                self._alloc.incref(shared)
             blk_ids = self._alloc.alloc(req.need)
+            if blk_ids is None and self._bpx is not None:
+                # reclaim LRU unreferenced cached chains, then retry once
+                self._bpx.evict(req.need - self._alloc.free_blocks)
+                blk_ids = self._alloc.alloc(req.need)
             if blk_ids is None:
+                if shared:
+                    self._alloc.decref(shared)
                 return _BLOCKED  # pool exhausted; caller requeues at front
-            req.block_ids = blk_ids
+            req.block_ids = shared + blk_ids
             table_row = np.zeros((self._max_blocks,), np.int32)
-            table_row[: len(blk_ids)] = blk_ids  # tail stays at trash
+            table_row[: need_total] = req.block_ids  # tail stays at trash
+            # insert scatters the WHOLE scratch row; the shared head must
+            # not be rewritten (other tables read those exact blocks), so
+            # the insert's view of the row redirects head entries to the
+            # write-only trash block — the DECODE table keeps the real row
+            insert_row = table_row
+            if n_shared:
+                insert_row = table_row.copy()
+                insert_row[:n_shared] = self._P.TRASH_BLOCK
         if k.get("constraint") is not None:
             # compiled-artifact reuse by constraint hash (the engine LRU),
             # then residency in the fleet's combined table; a full table
@@ -743,6 +801,13 @@ class ContinuousEngine:
             req.trace.checkpoint("constraint_compile")
             off = self._ctable.acquire(cart)
             if off is None:
+                if req.block_ids is not None:
+                    # blocks were granted above: release them (decref —
+                    # the mapped head just loses this holder) or every
+                    # constraint-backpressure retry would re-allocate and
+                    # orphan the first grant
+                    self._alloc.decref(req.block_ids)
+                    req.block_ids = None
                 return _BLOCKED  # retry after a release frees rows
             req.cart = (cart, off)
         sampling = G.default_sampling(
@@ -763,18 +828,34 @@ class ContinuousEngine:
         rp = float(k.get("repetition_penalty", 1.0))
         presence = eng._presence_rows([ids]) if rp != 1.0 else None
         try:
-            # shared splice/ingest/store sequence (engine/engine.py) —
-            # same machinery, same ordering as the solo path. A grammar
-            # constraint masks the FIRST token through the bias operand
-            # (engine._constraint_bias), same as solo.
-            first, _, scratch = eng._ingest_with_prefix(
-                self._prefix, ids, p0, entry, plan, scratch, key, sampling,
-                presence=presence,
-                bias=(
-                    eng._constraint_bias(req.cart[0], None)
-                    if req.cart is not None else None
-                ),
+            bias = (
+                eng._constraint_bias(req.cart[0], None)
+                if req.cart is not None else None
             )
+            if self.paged:
+                if p0:
+                    # block-level hit: the shared physical blocks are
+                    # already MAPPED into table_row — no splice, no copy
+                    # into the pool. One gather assembles the scratch's
+                    # contiguous view of the shared head so the chunked
+                    # tail prefill below attends real KV; garbage past the
+                    # head is overwritten by the tail or never attended.
+                    scratch = self.backend.fill_scratch_paged(
+                        self.cache, jnp.asarray(table_row)
+                    )
+                first, _, scratch = eng._ingest(
+                    ids, p0, plan, scratch, key, sampling,
+                    presence=presence, bias=bias,
+                )
+            else:
+                # shared splice/ingest/store sequence (engine/engine.py) —
+                # same machinery, same ordering as the solo path. A
+                # grammar constraint masks the FIRST token through the
+                # bias operand (engine._constraint_bias), same as solo.
+                first, _, scratch = eng._ingest_with_prefix(
+                    self._prefix, ids, p0, entry, plan, scratch, key,
+                    sampling, presence=presence, bias=bias,
+                )
             # prefill token is emitted token #0 (unless EOS — break-before-
             # append); the EOS check happens inside insert_slot on device
             req.budget = max_tokens - 1
@@ -796,7 +877,7 @@ class ContinuousEngine:
                 self.cache, self.state, self.sparams = (
                     self.backend.insert_slot_paged(
                         self.cache, scratch, self.state, self.sparams, slot,
-                        jnp.asarray(table_row), *arm,
+                        jnp.asarray(insert_row), *arm,
                     )
                 )
                 self._table[slot] = table_row
@@ -810,8 +891,9 @@ class ContinuousEngine:
         except BaseException:
             if req.block_ids is not None:
                 # admission died after the block grant (failed prefill,
-                # device error): return the blocks or the pool leaks
-                self._alloc.free(req.block_ids)
+                # device error): release the blocks (decref — the mapped
+                # shared head just loses this holder) or the pool leaks
+                self._alloc.decref(req.block_ids)
                 req.block_ids = None
             if req.cart is not None:
                 # same discipline for the constraint residency refcount
@@ -824,6 +906,13 @@ class ContinuousEngine:
                 # scratch buffer mid-sequence; a permanently-None scratch
                 # would fail every later admission — reallocate
                 self._scratch = self.backend.init_cache(1, self._scratch_seq)
+        if self.paged and self._bpx is not None:
+            # index the prompt's full blocks (complete + immutable once
+            # the insert scatter above lands — decode and tail writes only
+            # target later positions): the request's own fresh blocks
+            # become cached chains, the mapped head is promoted. Later
+            # admissions' gathers serialize behind this insert on device.
+            self._bpx.register(ids, prompt_len, req.block_ids)
         req.slot = slot
         req.trace.checkpoint("admission")  # prefill + splice into the slot
         with self._cv:
@@ -974,15 +1063,21 @@ class ContinuousEngine:
                 self._fsm = self._fsm.at[jnp.int32(req.slot)].set(0)
             req.cart = None
         if self.paged and req.block_ids is not None:
-            # Worker-thread-only mutation (like all allocator use). The
-            # freed blocks may be re-granted before in-flight chunks
-            # drain: safe, because device execution is serialized in
-            # launch order and the new tenant's insert scatter overwrites
-            # its whole logical extent before any later decode chunk —
-            # and this slot's table row reverts to trash at the next
-            # table rebuild, so its frozen row can't touch the old
-            # blocks in any chunk launched after this point.
-            self._alloc.free(req.block_ids)
+            # Worker-thread-only mutation (like all allocator use). DECREF,
+            # not free: blocks cached by the block-prefix index (or mapped
+            # by other live tables) survive this request and keep serving
+            # prefix hits; only sole-holder blocks return to the free
+            # list. Those freed blocks may be re-granted before in-flight
+            # chunks drain: safe, because device execution is serialized
+            # in launch order and the new tenant's insert scatter
+            # overwrites its whole logical extent before any later decode
+            # chunk — and this slot's table row reverts to trash at the
+            # next table rebuild, so its frozen row can't touch the old
+            # blocks in any chunk launched after this point. (A frozen
+            # row's overrun clamp only ever writes the request's OWN last
+            # allocated block, which is never a registered/shared one —
+            # see ARCHITECTURE.md "Block sharing".)
+            self._alloc.decref(req.block_ids)
             req.block_ids = None
             if req.slot is not None:
                 self._table[req.slot] = 0

@@ -198,6 +198,14 @@ class SingleDeviceBackend:
             num_steps=num_steps,
         )
 
+    def fill_scratch_paged(self, pool, table_row):
+        # block-level prefix sharing: assemble a contiguous scratch view
+        # of a hit's mapped blocks (the pool is read — NOT donated; other
+        # block tables keep reading those exact buffers)
+        from . import paged as P
+
+        return P.gather_scratch_blocks(pool, table_row)
+
     def decode_speculative(self, first_token, cache, hist, hist_len, limit,
                            *, max_steps, draft_len):
         return G.decode_speculative(
@@ -819,22 +827,45 @@ class InferenceEngine:
         )
 
     def _prefix_plan(self, prefix, ids: list, capacity: Optional[int] = None):
-        """Prefix-cache lookup + ingest planning, ONE copy for the solo and
-        continuous paths: lookup -> plan the tail -> cold fallback when no
-        tail plan fits -> mark hit/miss on the PLANNED outcome (a lookup
-        hit that fell back cold is a miss). Returns (p0, entry, plan);
-        prefix may be None (plain cold plan)."""
+        """Prefix lookup + ingest planning, ONE copy for every serving
+        path: lookup -> plan the tail -> cold fallback when no tail plan
+        fits -> mark hit/miss on the PLANNED outcome (a lookup hit that
+        fell back cold is a miss). Returns (p0, entry, plan).
+
+        `prefix` is any PLANNER implementing the two-method protocol
+          lookup(ids) -> (p0, entry, key)   # reusable depth + opaque entry
+          mark(key, hit)                    # counters + LRU promotion
+        — engine/prefix.PrefixCache (dense snapshots: entry is a KV
+        pytree the caller splices) and engine/block_prefix.BlockPrefixIndex
+        (paged fleets: entry is the shared physical block ids the caller
+        maps into the request's block table) both satisfy it; None means
+        a plain cold plan. What "reuse" physically does with `entry` is
+        the caller's business — this helper owns only the depth/plan/mark
+        discipline, which is identical across planners."""
         buckets = self._buckets()
         prompt_len = len(ids)
         p0, entry, pkey = 0, None, None
         if prefix is not None:
             p0, entry, pkey = prefix.lookup(ids)
         plan = self._plan_ingest(prompt_len, p0, buckets, capacity)
+        # Depth degradation: the deepest reuse offset can leave a tail no
+        # prefill bucket fits inside the capacity (e.g. a hit at offset 96
+        # in a 128-token window with a 64-token smallest bucket). Both
+        # reuse mechanisms serve ANY aligned depth (a snapshot splices its
+        # first p0 slots; a block chain maps its first p0/bs blocks), so
+        # walk down one planner granule at a time before giving the whole
+        # prefix up — partial reuse beats cold.
+        step = getattr(prefix, "chunk", 0)
+        while plan is None and p0 > step > 0:
+            p0 -= step
+            plan = self._plan_ingest(prompt_len, p0, buckets, capacity)
         if plan is None and p0:
-            p0, entry = 0, None
+            p0 = 0
             plan = self._plan_ingest(prompt_len, 0, buckets, capacity)
+        if not p0:
+            entry = None
         if prefix is not None:
-            prefix.mark(pkey, hit=bool(p0) and plan is not None)
+            prefix.mark(pkey, hit=bool(p0) and plan is not None, depth=p0)
         return p0, entry, plan
 
     def _ingest_with_prefix(

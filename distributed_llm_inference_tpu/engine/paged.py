@@ -9,11 +9,21 @@ lives in a shared pool of fixed-size blocks:
 
 and each slot's logical sequence is a *block table* — an int32 row mapping
 logical block j to a physical pool block. Admission allocates exactly
-ceil((prompt_len + max_tokens) / block_size) blocks from a host-side free
-list; release returns them. Fleet memory is a function of the POOL size
-(aggregate tokens actually in flight), not n_slots x window, and the pool
-naturally backpressures: a request that cannot get blocks waits in the
-queue until a running request completes.
+ceil((prompt_len + max_tokens) / block_size) blocks from a host-side
+REFCOUNTED free list; release decrefs them. Fleet memory is a function of
+the POOL size (aggregate tokens actually in flight), not n_slots x
+window, and the pool naturally backpressures: a request that cannot get
+blocks waits in the queue until a running request completes (after the
+block-prefix index has evicted what it can — engine/block_prefix.py).
+
+Block-level prefix sharing rides the refcounts: full prompt blocks are
+immutable once the insert scatter lands, so a prefix hit MAPS the cached
+physical blocks into the new request's table (one more holder each),
+gathers a contiguous scratch view of the shared head
+(gather_scratch_blocks) for the tail prefill, and scatters the scratch
+back with the head entries of the insert's row redirected to the trash
+block. Both decode paths run unchanged over shared tables. See
+ARCHITECTURE.md "Block sharing" for the invariant walk-through.
 
 TPU/XLA design notes (why this shape, not a translation of vLLM's CUDA
 paged attention):
@@ -92,14 +102,25 @@ def init_pool(cfg: ModelConfig, n_blocks: int, block_size: int,
 
 
 class BlockAllocator:
-    """Host-side free list over pool blocks 1..n_blocks-1 (0 is trash).
+    """Host-side REFCOUNTED free list over pool blocks 1..n_blocks-1 (0 is
+    trash).
+
+    Every allocated block carries a reference count: alloc() hands blocks
+    out at refcount 1, incref() adds a holder (a request mapping a SHARED
+    block into its table, or the block-prefix index caching a chain —
+    engine/block_prefix.py), and decref() removes one — a block returns
+    to the free list only when its LAST holder lets go. Pool-memory
+    accounting therefore counts shared blocks once: free_blocks is the
+    physical free list, however many tables map the resident blocks.
 
     Not thread-safe by itself — the continuous engine calls it only from
     its single worker thread (admission/release), matching the engine's
     single-owner design.
 
     registry (utils/metrics.MetricsRegistry, optional): pool-occupancy
-    gauges (`dli_kv_pool_blocks_total` / `_free`) and an exhaustion
+    gauges (`dli_kv_pool_blocks_total` / `_free`), a shared-block gauge
+    (`dli_kv_pool_shared_blocks` — blocks held by more than one
+    referencer: live tables and/or the prefix index) and an exhaustion
     counter (`dli_kv_pool_exhausted_total` — alloc refusals, i.e. the
     admission backpressure events) for /metrics.
     """
@@ -109,7 +130,9 @@ class BlockAllocator:
             raise ValueError("pool needs >= 2 blocks (one is the trash block)")
         self.n_blocks = n_blocks
         self._free = list(range(1, n_blocks))
-        self._m_free = self._m_exhausted = None
+        self._ref: dict = {}  # block id -> holders (allocated blocks only)
+        self._shared = 0  # blocks at refcount >= 2
+        self._m_free = self._m_exhausted = self._m_shared = None
         if registry is not None:
             registry.gauge(
                 "dli_kv_pool_blocks_total",
@@ -123,27 +146,72 @@ class BlockAllocator:
                 "dli_kv_pool_exhausted_total",
                 "admissions refused because the pool had too few blocks",
             ).labels()
+            self._m_shared = registry.gauge(
+                "dli_kv_pool_shared_blocks",
+                "pool blocks held by more than one referencer "
+                "(live block tables and/or the block-prefix index)",
+            ).labels()
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    @property
+    def shared_blocks(self) -> int:
+        return self._shared
+
+    def refcount(self, block: int) -> int:
+        """Current holder count (0 = on the free list / never allocated)."""
+        return self._ref.get(block, 0)
+
     def alloc(self, n: int) -> Optional[list]:
-        """n blocks or None (caller keeps the request queued)."""
+        """n blocks at refcount 1, or None (caller keeps the request
+        queued — or evicts unreferenced cached chains and retries)."""
         if n > len(self._free):
             if self._m_exhausted is not None:
                 self._m_exhausted.inc()
             return None
         out = self._free[:n]
         del self._free[:n]
+        for b in out:
+            self._ref[b] = 1
         if self._m_free is not None:
             self._m_free.set(len(self._free))
         return out
 
-    def free(self, ids: list):
-        self._free.extend(ids)
+    def incref(self, ids: list):
+        """Add a holder to each block (mapping a shared block into another
+        request's table, or caching it in the block-prefix index)."""
+        for b in ids:
+            c = self._ref[b]  # KeyError on a free block = caller bug
+            self._ref[b] = c + 1
+            if c == 1:
+                self._shared += 1
+        if self._m_shared is not None:
+            self._m_shared.set(self._shared)
+
+    def decref(self, ids: list):
+        """Drop one holder per block; blocks reaching zero return to the
+        free list. Replaces unconditional free(): a completed request
+        decrefs its whole table and shared blocks simply lose one mapper.
+        """
+        for b in ids:
+            c = self._ref[b] - 1
+            if c == 0:
+                del self._ref[b]
+                self._free.append(b)
+            else:
+                self._ref[b] = c
+                if c == 1:
+                    self._shared -= 1
         if self._m_free is not None:
             self._m_free.set(len(self._free))
+            self._m_shared.set(self._shared)
+
+    def free(self, ids: list):
+        """Back-compat spelling of decref() — single-holder blocks behave
+        exactly as the pre-refcount free list did."""
+        self.decref(ids)
 
 
 def blocks_needed(prompt_len: int, max_tokens: int, block_size: int) -> int:
@@ -280,6 +348,47 @@ def scatter_scratch(pool, scratch, table_row):
     return jax.tree.map(scatter, pool, scratch)
 
 
+def _gather_blocks(shared_pool, table_row):
+    """Core of gather_scratch_blocks (un-jitted so the pp backend's
+    shard_map body can trace it layer-locally — the gather reads whole
+    blocks, so it runs unchanged on a layer-sharded pool slice)."""
+
+    def g(pl):
+        # pl [L, N, KV, bs(, Dh)] -> row blocks [L, MB, KV, bs(, Dh)] ->
+        # contiguous batch-1 scratch layout [L, 1, KV, MB*bs(, Dh)]; the
+        # int8 pool's scale leaves ride the same recipe one rank down
+        blocks = pl[:, table_row]
+        if pl.ndim == 5:
+            L, MB, KV, bs, Dh = blocks.shape
+            flat = blocks.transpose(0, 2, 1, 3, 4).reshape(L, KV, MB * bs, Dh)
+        else:
+            L, MB, KV, bs = blocks.shape
+            flat = blocks.transpose(0, 2, 1, 3).reshape(L, KV, MB * bs)
+        return flat[:, None]
+
+    return jax.tree.map(g, shared_pool)
+
+
+@jax.jit
+def gather_scratch_blocks(shared_pool, table_row):
+    """Assemble a CONTIGUOUS batch-1 scratch cache from `table_row`'s pool
+    blocks — the exact inverse of scatter_scratch. Block-level prefix
+    sharing uses it on a hit: the request's table maps the shared physical
+    blocks directly (no splice, no copy into the pool), and this one
+    gather hands the tail prefill a contiguous view of the shared head so
+    the chunked-prefill machinery runs unchanged. Entries past the shared
+    head (fresh private blocks, trash tails) gather stale garbage that
+    the tail prefill/scatter overwrite or the slot mask discards — same
+    stale-region argument as insert_slot_paged's whole-row scatter.
+
+    shared_pool is a READ-ONLY view of live mapped blocks and must NOT be
+    donated: other requests' block tables keep reading these exact
+    buffers (analysis/rules/donation.py enforces the inverse of its usual
+    donate-your-cache rule for this parameter name).
+    """
+    return _gather_blocks(shared_pool, table_row)
+
+
 def _forward_step_paged(cfg, params, tokens, pool, table, pos):
     """One decode step through the stack over the paged pool (family-
     dispatched: gpt2 rides the same hook seam)."""
@@ -362,7 +471,11 @@ def insert_slot_paged(
     writes are write-only garbage (positions there are beyond every
     owner's budget). One compiled program per prompt bucket is avoided the
     same way insert_slot does it: the WHOLE scratch row is scattered, and
-    stale high blocks are never attended.
+    stale high blocks are never attended. On a block-sharing hit the
+    caller passes a row whose SHARED HEAD entries are redirected to the
+    trash block too (the decode table keeps the real ids): the mapped
+    blocks already hold exactly this content and must not be rewritten
+    while other tables read them.
     """
     slot = jnp.int32(slot)
     pool = scatter_scratch(pool, scratch, table_row)

@@ -146,9 +146,13 @@ class PrefixCache:
             return 0, None, None
         return best_p, best, best_key
 
-    def mark(self, key: Optional[tuple], hit: bool) -> None:
+    def mark(self, key: Optional[tuple], hit: bool, depth: int = 0) -> None:
         """Record the request outcome; promotes the entry on a REAL hit
-        (one whose tail actually planned and spliced)."""
+        (one whose tail actually planned and spliced). depth (the planned
+        reuse offset, which bucket limits may have degraded below the
+        lookup depth) is part of the planner protocol; snapshots don't
+        account per-token, so it is unused here."""
+        del depth
         with self._lock:
             if hit:
                 self.hits += 1
@@ -179,6 +183,15 @@ class PrefixCache:
         snapshot = _extract(cache, p)
         evicted = 0
         with self._lock:
+            if key in self._entries:
+                # two threads can race past the first key check and both
+                # snapshot (the device _extract runs OUTSIDE the lock on
+                # purpose); re-check under the insert lock and drop the
+                # loser's snapshot instead of double-inserting — the
+                # winner's entry keeps its LRU position and no eviction
+                # is charged for a duplicate
+                self._entries.move_to_end(key)
+                return 0
             self._entries[key] = snapshot
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)

@@ -630,6 +630,33 @@ class PipelineBackend(SPMDBackendBase):
             self._programs[("slots_paged", num_steps)] = fn
         return fn(self.shared, self.layers, state, pool, table, key, sparams)
 
+    def fill_scratch_paged(self, pool, table_row):
+        fn = self._programs.get("fill_paged")
+        if fn is None:
+            fn = self._build_fill_paged()
+            self._programs["fill_paged"] = fn
+        return fn(pool, table_row)
+
+    def _build_fill_paged(self):
+        """shard_map twin of engine/paged.gather_scratch_blocks: the pool →
+        scratch block gather is LAYER-LOCAL (each stage reads its own
+        layer shard of the pool into its slice of the contiguous scratch),
+        so block-level prefix sharing serves the pp fleet unchanged. The
+        pool is mapped shared state — read, never donated."""
+        cfg = self.cfg
+        from ..engine import paged as EP
+        from .partition import pool_spec
+
+        def body(shared_pool, table_row):
+            return EP._gather_blocks(shared_pool, table_row)
+
+        shmapped = self._shard(
+            body,
+            in_specs=(pool_spec(cfg), P()),
+            out_specs=cache_spec(cfg),
+        )
+        return jax.jit(shmapped)
+
     def _build_decode_slots_paged(self, num_steps: int):
         """Paged twin of _build_decode_slots: each of the S ring
         microsteps runs the local layer shard over the slot fleet with the

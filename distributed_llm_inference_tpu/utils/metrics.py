@@ -372,6 +372,7 @@ def latency_summary(registry: MetricsRegistry) -> dict:
             out[name] = block
     for name in (
         "dli_slots_total", "dli_slots_occupied", "dli_kv_pool_blocks_free",
+        "dli_kv_pool_shared_blocks",
     ):
         fam = registry.get(name)
         if fam is not None:

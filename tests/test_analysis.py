@@ -269,6 +269,63 @@ def test_donation_positive_negative_argnums(tmp_path):
     assert {d.line for d in diags} == {10, 27}  # `bad` def, build_bad's jit
 
 
+def test_donation_shared_pool_exception(tmp_path):
+    """Block-level prefix sharing: a `shared_pool` param is a READ-ONLY
+    mapped pool — the rule inverts: leaving it undonated is correct, and
+    donating it (which would let XLA recycle buffers other block tables
+    still read) is the flagged defect."""
+    files = {
+        "engine/mod.py": """
+            import functools
+            import jax
+
+            @jax.jit
+            def good_gather(shared_pool, table_row):
+                return shared_pool
+
+            @functools.partial(jax.jit, donate_argnames=("shared_pool",))
+            def bad_gather(shared_pool, table_row):
+                return shared_pool
+
+            @jax.jit
+            def still_bad_plain_pool(pool, table_row):
+                return pool
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["donate-cache"])
+    assert len(diags) == 2
+    by_line = {d.line: d.message for d in diags}
+    assert 10 in by_line and "must not be donated" in by_line[10]
+    assert 14 in by_line and "does not donate" in by_line[14]
+
+
+def test_donation_shared_pool_reasoned_suppression(tmp_path):
+    """A donated shared_pool under a REASONED suppression is accepted;
+    dropping the reason downgrades to the bad-suppression diagnostic —
+    same contract as every other rule's escape hatch."""
+    files = {
+        "engine/mod.py": """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnames=("shared_pool",))
+            # jaxlint: disable=donate-cache -- single-tenant pool: no other table maps these blocks
+            def gather_private(shared_pool, table_row):
+                return shared_pool
+        """,
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["donate-cache"])
+    assert diags == []
+    assert suppressed == 1
+    files_bad = {
+        "engine/mod.py": files["engine/mod.py"].replace(
+            " -- single-tenant pool: no other table maps these blocks", ""
+        ),
+    }
+    diags, _ = lint(tmp_path, files_bad, rules=["donate-cache"])
+    assert any(d.rule == "bad-suppression" for d in diags)
+
+
 # -- static-args -------------------------------------------------------------
 
 def test_static_args_fstring_call_site(tmp_path):

@@ -148,3 +148,50 @@ def test_ttft_improves_on_hit():
     # warm-vs-warm comparison is unfair on compile-heavy first calls;
     # just require the hit path not to be slower than 1.5x the miss
     assert r2["ttft_s"] <= r1["ttft_s"] * 1.5
+
+
+def test_store_double_snapshot_race_drops_loser():
+    """Two threads racing store() for the same key both pass the first
+    key-exists check and both snapshot (the device _extract runs outside
+    the lock on purpose) — the insert must re-check under the lock and
+    DROP the loser: exactly one entry, exactly one winner return value,
+    no eviction charged for the duplicate."""
+    import threading
+
+    import jax.numpy as jnp
+
+    from distributed_llm_inference_tpu.engine import prefix as PX
+
+    cache = {
+        "k": jnp.zeros((2, 1, 2, 16, 4)),
+        "v": jnp.zeros((2, 1, 2, 16, 4)),
+    }
+    pc = PX.PrefixCache(max_entries=4, chunk=8)
+    barrier = threading.Barrier(2)
+    real_extract = PX._extract
+
+    def racy_extract(c, p):
+        # both threads must be PAST the first key check before either
+        # inserts — the widest possible race window
+        barrier.wait(timeout=10)
+        return real_extract(c, p)
+
+    ids = list(range(16))
+    out = [None, None]
+
+    def run(i):
+        out[i] = pc.store(ids, 16, cache)
+
+    PX._extract = racy_extract
+    try:
+        threads = [threading.Thread(target=run, args=(i,)) for i in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        PX._extract = real_extract
+    assert sorted(out) == [0, 16]  # one winner, one dropped loser
+    st = pc.stats()
+    assert st["entries"] == 1
+    assert st["evictions"] == 0
