@@ -10,23 +10,48 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
+import time
 import urllib.error
 import urllib.request
 from typing import Any, Optional
 
+# bounded-retry defaults: 429 (shed load) and 503 (draining replica /
+# deadline / restarting scheduler) are the two RETRYABLE answers the
+# serving edge hands out — anything else (400, 500 incl. poison) is not
+RETRY_STATUSES = (429, 503)
+
 
 class DistributedLLMClient:
-    def __init__(self, base_url: str = "http://127.0.0.1:5000", timeout: float = 200.0):
+    def __init__(self, base_url: str = "http://127.0.0.1:5000", timeout: float = 200.0,
+                 max_retries: int = 3, retry_backoff_s: float = 0.5):
         # 200 s default mirrors Test.py:71's request timeout; a TPU backend
         # answers in milliseconds-to-seconds, but slow cold compiles exist.
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # bounded retry on 429/503 with jittered exponential backoff,
+        # honoring the server's Retry-After (the drain path sends one);
+        # 0 retries restores the old fail-fast behavior
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
 
     def _get(self, path: str, timeout: Optional[float] = None) -> dict:
         with urllib.request.urlopen(
             f"{self.base_url}{path}", timeout=timeout or self.timeout
         ) as r:
             return json.loads(r.read())
+
+    def _retry_delay(self, attempt: int, retry_after) -> float:
+        """Server-directed delay when Retry-After parses, else jittered
+        exponential backoff (full jitter on the upper half, so a herd of
+        retrying clients decorrelates instead of re-stampeding)."""
+        if retry_after:
+            try:
+                return max(0.0, float(retry_after))
+            except ValueError:
+                pass  # HTTP-date form / junk: fall through to backoff
+        base = min(8.0, self.retry_backoff_s * (2 ** attempt))
+        return base * (0.5 + random.random() / 2)
 
     def _post(self, path: str, payload: dict, timeout: Optional[float] = None) -> dict:
         req = urllib.request.Request(
@@ -35,18 +60,27 @@ class DistributedLLMClient:
             headers={"Content-Type": "application/json"},
             method="POST",
         )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as r:
-                return json.loads(r.read())
-        except urllib.error.HTTPError as e:
+        for attempt in range(self.max_retries + 1):
             try:
-                return json.loads(e.read())
-            except Exception:
-                return {"error": str(e), "status": "failed"}
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            # connection refused / timeout: error envelope, not a traceback
-            # (keeps the interactive REPL alive across server restarts)
-            return {"error": f"connection failed: {e}", "status": "failed"}
+                with urllib.request.urlopen(req, timeout=timeout or self.timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                try:
+                    body = json.loads(e.read())
+                except Exception:
+                    body = {"error": str(e), "status": "failed"}
+                if e.code in RETRY_STATUSES and attempt < self.max_retries:
+                    time.sleep(self._retry_delay(
+                        attempt, e.headers.get("Retry-After")
+                    ))
+                    continue
+                return body
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # connection refused / timeout: error envelope, not a traceback
+                # (keeps the interactive REPL alive across server restarts).
+                # NOT retried: a timed-out POST may have generated server-side.
+                return {"error": f"connection failed: {e}", "status": "failed"}
+        return {"error": "retries exhausted", "status": "failed"}
 
     # -- reference-parity surface (Test.py:18-103) --------------------------
     def check_health(self) -> dict:
@@ -91,7 +125,14 @@ class DistributedLLMClient:
 
     def generate_stream(self, prompt: str, max_tokens: int = 20, **kw: Any):
         """Stream a generation: print deltas as they arrive (NDJSON lines
-        from a --continuous server), return the final envelope."""
+        from a --continuous server), return the final envelope.
+
+        Retry discipline: only a PRE-STREAM rejection (HTTP 429/503 — the
+        stream never opened, zero output reached us) is retried. Once the
+        200 stream opens, NOTHING is retried: partial generation output
+        may already be on the user's screen, and replaying the request
+        would bill and print it twice. Mid-stream failures arrive as a
+        normal done-event and are returned as-is."""
         req = urllib.request.Request(
             f"{self.base_url}/generate",
             data=json.dumps(
@@ -101,35 +142,43 @@ class DistributedLLMClient:
             method="POST",
         )
         final: dict = {}
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                print("\n🤖 ", end="", flush=True)
-                for line in r:
-                    ev = json.loads(line)
-                    if ev.get("done"):
-                        final = ev
-                        break
-                    print(ev.get("delta", ""), end="", flush=True)
-            # failures arrive as a normal done-event over HTTP 200 (queue
-            # full, deadline) — and a dropped connection leaves final empty
-            if final.get("status") == "success":
-                print(
-                    f"\n   ⏱  {final.get('time_taken')} | "
-                    f"{final.get('tokens_generated')} tokens | "
-                    f"{final.get('tokens_per_sec')} tok/s | "
-                    f"TTFT {final.get('ttft_s')}s"
-                )
-            else:
-                print(f"\n❌ {final.get('error', 'stream ended without a result')}")
-        except urllib.error.HTTPError as e:
+        for attempt in range(self.max_retries + 1):
             try:
-                final = json.loads(e.read())
-            except Exception:
-                final = {"error": str(e), "status": "failed"}
-            print(f"\n❌ {final.get('error', 'unknown error')}")
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            final = {"error": f"connection failed: {e}", "status": "failed"}
-            print(f"\n❌ {final['error']}")
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    print("\n🤖 ", end="", flush=True)
+                    for line in r:
+                        ev = json.loads(line)
+                        if ev.get("done"):
+                            final = ev
+                            break
+                        print(ev.get("delta", ""), end="", flush=True)
+                # failures arrive as a normal done-event over HTTP 200 (queue
+                # full, deadline) — and a dropped connection leaves final empty
+                if final.get("status") == "success":
+                    print(
+                        f"\n   ⏱  {final.get('time_taken')} | "
+                        f"{final.get('tokens_generated')} tokens | "
+                        f"{final.get('tokens_per_sec')} tok/s | "
+                        f"TTFT {final.get('ttft_s')}s"
+                    )
+                else:
+                    print(f"\n❌ {final.get('error', 'stream ended without a result')}")
+            except urllib.error.HTTPError as e:
+                try:
+                    final = json.loads(e.read())
+                except Exception:
+                    final = {"error": str(e), "status": "failed"}
+                if e.code in RETRY_STATUSES and attempt < self.max_retries:
+                    time.sleep(self._retry_delay(
+                        attempt, e.headers.get("Retry-After")
+                    ))
+                    continue
+                print(f"\n❌ {final.get('error', 'unknown error')}")
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                # never retried: the stream may have started (partial output)
+                final = {"error": f"connection failed: {e}", "status": "failed"}
+                print(f"\n❌ {final['error']}")
+            return final
         return final
 
     # -- interactive REPL (Test.py:105-144) ---------------------------------

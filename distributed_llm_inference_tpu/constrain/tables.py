@@ -121,13 +121,21 @@ class CompiledConstraint:
             self._dev = (jnp.asarray(self.mask), jnp.asarray(self.next_state))
         return self._dev
 
+    def state_bias(self, state: int) -> np.ndarray:
+        """[V] f32 added to the PREFILL logits when the FSM sits at
+        `state`: 0 where the state allows the token, a -1e9 floor
+        otherwise — rides the existing logit_bias operand, so constrained
+        prefill reuses the already-compiled bias program variants. The
+        scheduler's crash-recovery continuation prefill samples from a
+        mid-constraint state (the DFA advanced over the salvaged tokens),
+        hence the state parameter."""
+        return np.where(self.mask[state], 0.0, -1e9).astype(np.float32)
+
     def start_bias(self) -> np.ndarray:
-        """[V] f32 added to the PREFILL logits (the first token is sampled
-        by prefill, before any decode-loop state exists): 0 where the start
-        state allows the token, a -1e9 floor otherwise — rides the existing
-        logit_bias operand, so constrained prefill reuses the already-
-        compiled bias program variants."""
-        return np.where(self.mask[self.start], 0.0, -1e9).astype(np.float32)
+        """state_bias at the DFA start state (the cold-admission case:
+        the first token is sampled by prefill, before any decode-loop
+        state exists)."""
+        return self.state_bias(self.start)
 
     def advance(self, state: int, token_id: int) -> int:
         """Host-side single-step advance (admission / chunked-stop paths)."""

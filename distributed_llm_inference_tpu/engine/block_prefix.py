@@ -269,6 +269,29 @@ class BlockPrefixIndex:
             self._m_evictions.inc()
         return freed
 
+    def clear(self) -> int:
+        """Drop EVERY cached entry, releasing the index's own reference
+        on each block. The supervisor's fleet-rebuild path (engine/
+        continuous._rebuild_fleet) uses this: the pool buffer is being
+        reinitialized, so cached chains no longer hold valid KV and must
+        not survive into the restarted fleet. Unlike evict(), refcounts
+        above 1 are legal here — the caller has already released the
+        live tables, but a block only loses THIS index's holder either
+        way. Returns the number of entries dropped."""
+        with self._lock:
+            blocks = list(self._entries.values())
+            self._entries.clear()
+            self._children.clear()
+            self._block_key.clear()
+            self.evictions += len(blocks)
+            if blocks:
+                self._alloc.decref(blocks)
+        if self._m_evictions is not None and blocks:
+            self._m_evictions.inc(len(blocks))
+        if self._m_entries is not None:
+            self._m_entries.set(0)
+        return len(blocks)
+
     def stats(self) -> dict:
         with self._lock:
             return {

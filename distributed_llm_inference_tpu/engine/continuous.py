@@ -31,6 +31,22 @@ absolute positions stay exact — the one batching mode gpt2 supports.
 Seeded / debug / speculative requests fall back to the solo engine — their
 contracts (deterministic RNG stream, single-stream prefill logits, draft
 verification) are per-request, not per-fleet.
+
+Failure containment (ARCHITECTURE.md "Failure containment"): the worker
+loop runs under a SUPERVISOR (_loop/_supervise). A crash anywhere in the
+scheduler releases every fleet-held resource (block tables decref'd,
+constraint rows freed, cached prefix chains dropped), rebuilds the
+device-side fleet, and restarts the loop under a bounded consecutive-crash
+budget with exponential backoff. Live requests are salvaged: their prompt
+and fetched tokens are host-side, so each is re-admitted as a CONTINUATION
+prefill (prompt + tokens-so-far) — greedy output across a crash is
+bit-identical to a fault-free run. Requests admitted since the last
+healthy fetch form the crash SUSPECT set; recovery re-admits one request
+per healthy chunk so a recurring crash implicates exactly one suspect,
+and a request implicated poison_strikes times is quarantined alone
+(error_type "poison") while its fleet-mates survive. Every path is
+exercised deterministically in CI via utils/faults.py injection points
+(tests/test_faults.py).
 """
 
 from __future__ import annotations
@@ -44,6 +60,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import faults
 from ..utils.logging import get_logger
 from ..utils.tracing import Trace
 from . import generate as G
@@ -61,7 +78,7 @@ class _Request:
         "first_id", "tokens", "slot", "enqueued", "budget",
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
         "cancelled", "prompt_tokens", "block_ids", "need", "cart",
-        "trace",
+        "trace", "salvaged", "strikes", "allowed",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
@@ -97,6 +114,16 @@ class _Request:
         # grammar constraint (constrain/): (CompiledConstraint, fleet-table
         # row offset) once admitted; None = unconstrained
         self.cart = None
+        # crash recovery (the scheduler supervisor): tokens generated
+        # before a scheduler crash, re-prefilled as a continuation on
+        # re-admission so greedy decode resumes bit-exactly
+        self.salvaged: list[int] = []
+        # crash-restarts this request was implicated in (suspect set at
+        # crash time); poison_strikes of them quarantine it
+        self.strikes = 0
+        # total generated-token cap fixed at FIRST admission (clamped
+        # max_tokens) — re-admissions shrink their budget against it
+        self.allowed: Optional[int] = None
 
 
 class ContinuousEngine:
@@ -117,6 +144,9 @@ class ContinuousEngine:
         slot_max_seq: Optional[int] = None,
         kv_pool_blocks: Optional[int] = None,
         kv_block_size: int = 16,
+        restart_budget: int = 3,
+        restart_backoff_s: float = 0.05,
+        poison_strikes: int = 2,
     ):
         cfg = engine.cfg
         if cfg.arch not in ("llama", "gpt2"):
@@ -144,6 +174,15 @@ class ContinuousEngine:
         # lag-1) at the cost of noticing EOS/stop/cancel up to `lag`
         # chunks late — bounded compute waste, never wrong output.
         self.chunk_lag = max(1, int(chunk_lag))
+        # Failure containment (the supervisor wrapped around _loop_inner):
+        # how many CONSECUTIVE crashes the scheduler absorbs before it
+        # declares the fleet dead (a healthy fetch resets the window), the
+        # backoff base doubled per consecutive crash, and how many crash
+        # implications (suspect-set membership at crash time) quarantine a
+        # request as poison.
+        self.restart_budget = max(0, int(restart_budget))
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.poison_strikes = max(1, int(poison_strikes))
 
         # Per-slot KV budget (round-2 review weak #7): the fleet cache pins
         # n_slots x slot_max_seq of KV in HBM for the server's lifetime —
@@ -196,11 +235,12 @@ class ContinuousEngine:
                     f"of {self.kv_block_size} + the trash block); raise it "
                     f"or shrink slot_max_seq"
                 )
+            self._pool_blocks = int(kv_pool_blocks)
             self.cache = self.backend.init_paged_pool(
-                int(kv_pool_blocks), self.kv_block_size
+                self._pool_blocks, self.kv_block_size
             )
             self._alloc = P.BlockAllocator(
-                int(kv_pool_blocks), registry=engine.metrics
+                self._pool_blocks, registry=engine.metrics
             )
             # host-side block tables; device copy rebuilt lazily on change
             self._table = np.zeros(
@@ -268,10 +308,25 @@ class ContinuousEngine:
         self._queue: list[_Request] = []
         self._closed = False
         self._key = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
+        # supervisor state (all worker-thread-mutated; readiness reads are
+        # racy-but-monotone flags)
+        self._draining = False
+        self._dead = False        # restart budget exhausted
+        self._restarting = False  # mid crash-recovery (readiness = False)
+        self._recovery: list[_Request] = []  # salvaged, awaiting re-admission
+        # requests admitted since the last healthy fetch — the crash
+        # suspect set (see _supervise / _process)
+        self._suspects: set = set()
+        self._admitting: Optional[_Request] = None
+        self._consecutive_crashes = 0
+        self._mutation_seq = 0  # bumped per admission; chunks snapshot it
         # observability
         self.admitted = 0
         self.completed = 0
         self.peak_occupancy = 0
+        self.restarts_total = 0
+        self.recovered_total = 0
+        self.poisoned_total = 0
         # registry families (engine.metrics — the one registry /metrics
         # scrapes): fleet occupancy, queue depth, admission waits, chunk
         # launch-to-fetch step time, preemptions
@@ -301,6 +356,24 @@ class ContinuousEngine:
         self._m_shed = m.counter(
             "dli_queue_shed_total", "requests shed with 429", ("queue",)
         ).labels(queue="continuous")
+        self._m_restarts = m.counter(
+            "dli_scheduler_restarts_total",
+            "continuous-scheduler supervisor restarts", ("engine",),
+        ).labels(engine="continuous")
+        self._m_recovered = m.counter(
+            "dli_requests_recovered_total",
+            "in-flight requests re-admitted (continuation prefill) after "
+            "a scheduler restart", ("engine",),
+        ).labels(engine="continuous")
+        self._m_poison = m.counter(
+            "dli_poison_requests_total",
+            "requests quarantined as poison after repeated crash "
+            "implication", ("engine",),
+        ).labels(engine="continuous")
+        self._m_drain = m.histogram(
+            "dli_drain_duration_seconds",
+            "graceful-drain wall time (SIGTERM / drain())", ("component",),
+        ).labels(component="continuous")
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-engine"
         )
@@ -350,6 +423,14 @@ class ContinuousEngine:
                 return {
                     "error": "Error: server shutting down", "status": "failed",
                     "error_type": "overloaded",
+                }
+            if self._draining:
+                # graceful drain: the serving edge maps this to HTTP 503
+                # with a Retry-After header — the load balancer's cue to
+                # take this replica out while in-flight work finishes
+                return {
+                    "error": "Error: server draining", "status": "failed",
+                    "error_type": "draining",
                 }
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue))
@@ -460,6 +541,63 @@ class ContinuousEngine:
             req.streamed_text = text
             req.stream_q.put({"delta": delta, "tokens_so_far": len(gen_ids)})
 
+    @property
+    def ready(self) -> bool:
+        """Load-balancer readiness: False while draining, while the
+        supervisor is mid-restart, or once the scheduler is closed or
+        dead. Liveness (/health, process up) is deliberately separate —
+        a restart-looping scheduler is alive but should take no new
+        traffic."""
+        return not (
+            self._draining or self._restarting or self._dead or self._closed
+        )
+
+    def _work_pending(self) -> bool:
+        """Anything the fleet still owes a response for: queued, assigned
+        to a slot, mid-admission (popped from the queue but not yet
+        spliced — invisible to both), or salvaged awaiting re-admission."""
+        return bool(
+            self._queue
+            or any(r is not None for r in self._assignment)
+            or self._admitting is not None
+            or self._recovery
+        )
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Graceful drain: stop admitting NEW requests (draining envelope
+        → HTTP 503 + Retry-After at the serving edge), then wait for the
+        queue and every in-flight slot to finish, up to deadline_s.
+        Returns True when fully drained; stragglers past the deadline are
+        failed by the caller's close(). Idempotent."""
+        t0 = time.time()
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        drained = True
+        with self._cv:
+            while self._work_pending():
+                if self._closed or self._dead:
+                    # a dead scheduler cannot drain its backlog; close()
+                    # already failed (or will fail) the stragglers
+                    drained = not self._work_pending()
+                    break
+                left = (
+                    None if deadline_s is None
+                    else deadline_s - (time.time() - t0)
+                )
+                if left is not None and left <= 0:
+                    drained = False
+                    break
+                self._cv.wait(
+                    timeout=0.1 if left is None else min(left, 0.1)
+                )
+        self._m_drain.observe(time.time() - t0)
+        log.info(
+            "continuous_drained", ok=drained,
+            seconds=round(time.time() - t0, 3),
+        )
+        return drained
+
     def close(self):
         with self._cv:
             self._closed = True
@@ -515,6 +653,16 @@ class ContinuousEngine:
                 "peak_occupancy": self.peak_occupancy,
                 "chunk_steps": self.chunk_steps,
             }
+        out["supervisor"] = {
+            "ready": self.ready,
+            "draining": self._draining,
+            "dead": self._dead,
+            "restarts": self.restarts_total,
+            "recovered": self.recovered_total,
+            "poisoned": self.poisoned_total,
+            "consecutive_crashes": self._consecutive_crashes,
+            "restart_budget": self.restart_budget,
+        }
         if self.paged:
             out["paged"] = {
                 "block_size": self.kv_block_size,
@@ -541,31 +689,330 @@ class ContinuousEngine:
         return sub
 
     def _loop(self):
-        try:
-            self._loop_inner()
-        except Exception as e:  # noqa: BLE001 - a dead worker must not hang clients
-            log.error("continuous_loop_died", exc_info=True, error=str(e))
-            fail = {"error": f"Error: {e}", "status": "failed"}
+        """Supervisor: a scheduler crash is recoverable and request-
+        scoped, not fleet-fatal. Each exception out of _loop_inner goes
+        through one _supervise round — release every fleet-held resource,
+        strike/quarantine suspects, rebuild the fleet, re-admit salvaged
+        requests — under a bounded consecutive-crash budget with
+        exponential backoff. A dead worker must never hang clients: the
+        give-up path fails everything with clean envelopes."""
+        while True:
+            try:
+                self._loop_inner()
+                return  # clean exit: close() flipped _closed
+            except Exception as e:  # noqa: BLE001 - contained by the supervisor
+                if not self._supervise(e):
+                    return
+
+    def _casualties(self) -> list:
+        """Detach every live in-flight request (plus the one mid-
+        admission, if any) from the dead fleet. Order: running tenants
+        first, the just-admitting request last — recovery re-admits in
+        this order, so vindicated tenants re-enter before the newest
+        (most suspicious) one."""
+        with self._cv:
+            running = [
+                r for r in self._assignment
+                if r is not None and not r.done.is_set()
+            ]
+            self._assignment = [None] * self.n_slots
+            admitting, self._admitting = self._admitting, None
+        if (
+            admitting is not None and admitting not in running
+            and not admitting.done.is_set()
+        ):
+            running.append(admitting)
+        return running
+
+    def _release_fleet_resources(self, reqs: list):
+        """Return every device/host resource the dead fleet holds:
+        constraint-table rows, paged pool blocks, cached block-prefix
+        chains, block-table rows. Shared by the restart and the give-up
+        paths — leaking these on loop death (blocks never decref'd, rows
+        never freed) was the failure mode this layer exists to fix."""
+        for req in reqs:
+            if req.cart is not None:
+                self._ctable.release(req.cart[0].key)
+                req.cart = None
+            if self.paged and req.block_ids is not None:
+                self._alloc.decref(req.block_ids)
+                req.block_ids = None
+        if self._bpx is not None:
+            # cached chains point into the pool buffer the rebuild below
+            # replaces — drop them (and the index's refs) wholesale
+            self._bpx.clear()
+        if self.paged:
+            self._table[:] = 0
+            self._table_dev = None
+            if self._alloc.outstanding:
+                # the explicit releases above must zero the books; a
+                # mismatch is an accounting bug — surface it loudly, then
+                # reset so the restarted fleet has no phantom holders
+                log.error(
+                    "kv_pool_leak_on_crash",
+                    outstanding=self._alloc.outstanding,
+                )
+                self._alloc.reset()
+
+    def _rebuild_fleet(self):
+        """Fresh device-side fleet state for the restarted loop. Buffers
+        the crashed iteration may have donated mid-program (fleet cache /
+        pool, scratch) are rebuilt outright — cheaper than proving a
+        half-executed donation chain left them intact. The dense prefix
+        cache keeps its snapshots (standalone arrays, never donated)."""
+        if self.paged:
+            self.cache = self.backend.init_paged_pool(
+                self._pool_blocks, self.kv_block_size
+            )
+            self._table = np.zeros(
+                (self.n_slots, self._max_blocks), np.int32
+            )
+            self._table_dev = None
+        else:
+            self.cache = self.backend.init_cache(
+                self.n_slots, self.slot_max_seq
+            )
+        self._scratch = self.backend.init_cache(1, self._scratch_seq)
+        self.state, self.sparams = G.init_slots(
+            self.n_slots, self.cfg.vocab_size
+        )
+        self._fsm = jnp.zeros((self.n_slots,), jnp.int32)
+
+    def _supervise(self, exc: Exception) -> bool:
+        """One crash-containment round. Returns True to restart the loop,
+        False to give up (budget exhausted or closing)."""
+        self._restarting = True
+        self._consecutive_crashes += 1
+        log.error(
+            "continuous_loop_crashed", exc_info=True, error=str(exc),
+            consecutive=self._consecutive_crashes,
+        )
+        casualties = self._casualties()
+        for req in casualties:
+            if req in self._suspects:
+                req.strikes += 1
+        self._suspects.clear()
+        self._release_fleet_resources(casualties)
+        survivors = []
+        for req in casualties:
+            if req.strikes >= self.poison_strikes:
+                # implicated in poison_strikes consecutive crash-restarts:
+                # fail it ALONE; its fleet-mates are salvaged below
+                self.poisoned_total += 1
+                self._m_poison.inc()
+                log.error(
+                    "request_quarantined", strikes=req.strikes,
+                    request_id=req.trace.request_id,
+                )
+                req.result = {
+                    "error": f"Error: request quarantined after "
+                    f"implication in {req.strikes} scheduler crashes "
+                    f"(last: {exc})",
+                    "status": "failed",
+                    "error_type": "poison",
+                }
+                self._push_final(req)
+            else:
+                survivors.append(req)
+        if self._closed or self._consecutive_crashes > self.restart_budget:
             with self._cv:
+                self._dead = not self._closed
                 self._closed = True
                 pending = self._queue[:]
                 self._queue.clear()
-                running = [r for r in self._assignment if r is not None]
-                self._assignment = [None] * self.n_slots
-            for req in pending + running:
+                self._m_depth.set(0)
+                self._cv.notify_all()
+            fail = {
+                "error": f"Error: continuous scheduler died after "
+                f"{self._consecutive_crashes} consecutive crashes "
+                f"(restart budget {self.restart_budget}): {exc}",
+                "status": "failed",
+                "error_type": "unavailable",
+            }
+            # self._recovery: salvaged requests a previous round never got
+            # to re-admit (a crash mid-recovery) — they hang otherwise
+            for req in survivors + pending + self._recovery:
                 if req.result is None:
                     req.result = dict(fail)
                 self._push_final(req)
+            self._recovery = []
+            self._restarting = False
+            log.error(
+                "continuous_scheduler_dead", restarts=self.restarts_total
+            )
+            return False
+        # exponential backoff: a crash loop must not spin the host
+        time.sleep(min(
+            self.restart_backoff_s * (2 ** (self._consecutive_crashes - 1)),
+            5.0,
+        ))
+        self._rebuild_fleet()
+        # Salvage: prompt + tokens generated so far are host-side. The
+        # restarted loop re-admits each request as a CONTINUATION prefill
+        # (prompt + salvaged tokens), so greedy decode resumes bit-exactly
+        # where the fetched token stream stopped — tokens lost in
+        # unfetched in-flight chunks are simply regenerated.
+        for req in survivors:
+            head = (
+                [req.first_id]
+                if req.first_id is not None
+                and req.first_id not in self.cfg.all_stop_ids else []
+            )
+            req.salvaged = req.salvaged + head + req.tokens
+            req.first_id = None
+            req.tokens = []
+            req.slot = None
+            req.need = None
+            req.prefix_hit_tokens = 0
+        # a crash mid-recovery leaves earlier salvage in self._recovery
+        # (already reset — never re-admitted): keep it, after this round's
+        # survivors (who were vindicated tenants before the crash)
+        self._recovery = survivors + [
+            r for r in self._recovery if not r.done.is_set()
+        ]
+        self.restarts_total += 1
+        self._m_restarts.inc()
+        log.info(
+            "continuous_scheduler_restarted", restart=self.restarts_total,
+            salvaged=len(survivors),
+        )
+        return True
+
+    def _run_recovery(self):
+        """Serialized re-admission of salvaged requests: ONE request per
+        healthy chunk, so a recurring crash implicates exactly the
+        request just re-admitted (the suspect set narrows to a singleton)
+        instead of striking every fleet-mate — the mechanism that
+        isolates a poison request within poison_strikes restarts while
+        the rest of the fleet survives."""
+        try:
+            while self._recovery:
+                if self._closed:
+                    # close() fails queued + assigned requests, but the
+                    # not-yet-readmitted salvage is in neither place
+                    fail = {
+                        "error": "Error: server shutting down",
+                        "status": "failed", "error_type": "overloaded",
+                    }
+                    while self._recovery:
+                        r = self._recovery.pop(0)
+                        if r.result is None:
+                            r.result = dict(fail)
+                        self._push_final(r)
+                    return
+                req = self._recovery[0]
+                if (
+                    req.allowed is not None
+                    and len(req.salvaged) >= req.allowed
+                ):
+                    # budget already consumed pre-crash (the crash cut the
+                    # loop between the last fetch and finalize)
+                    self._recovery.pop(0)
+                    self._finalize(req)
+                    continue
+                with self._cv:
+                    free = [
+                        b for b, r in enumerate(self._assignment)
+                        if r is None
+                    ]
+                if not free:
+                    # more casualties than slots (a crash mid-admission):
+                    # decode until a tenant completes and frees one
+                    chunk = self._launch_chunk()
+                    if chunk is None:
+                        break  # unreachable: no free slot implies tenants
+                    self._process(chunk)
+                    continue
+                self._recovery.pop(0)
+                self._suspects.add(req)
+                self._mutation_seq += 1
+                # survives an exception unwind on purpose — the
+                # supervisor's pointer to a request cut mid-re-admission
+                self._admitting = req
+                first_dev = self._admit_one(req, free[0])
+                self._admitting = None
+                if first_dev is _BLOCKED:
+                    # the rebuilt pool/table cannot take it right now
+                    # (another recovered tenant holds the blocks): back to
+                    # the FRONT of the normal queue
+                    with self._cv:
+                        self._queue.insert(0, req)
+                        self._m_depth.set(len(self._queue))
+                    continue
+                if first_dev is None:
+                    continue  # failed fast (cancelled/deadline); result set
+                req.first_id = int(np.asarray(first_dev)[0])
+                if not req.ttft:
+                    req.ttft = time.time() - req.t_start
+                self.recovered_total += 1
+                self._m_recovered.inc()
+                self._post_admit(req)
+                # one synchronous chunk = the healthy step that vindicates
+                # this re-admission before the next one joins the fleet
+                chunk = self._launch_chunk()
+                if chunk is not None:
+                    self._process(chunk)
+        finally:
+            self._restarting = False
+
+    def _launch_chunk(self):
+        """Launch one decode chunk over the current fleet (paged /
+        constrained / plain slot program — state, cache, and fsm chain
+        device-side between launches, so no fetch is needed to launch the
+        next chunk). Returns the inflight tuple (packed results dev
+        array, assignment snapshot, launch time, mutation seq) or None
+        when no slot is active."""
+        if not any(r is not None for r in self._assignment):
+            return None
+        faults.check("decode_launch", tag=",".join(
+            r.prompt for r in self._assignment if r is not None
+        ))
+        if self.paged:
+            if self._table_dev is None:
+                self._table_dev = jnp.asarray(self._table)
+            emitted, mask, self.state, self.cache = (
+                self.backend.decode_slots_paged(
+                    self.state, self.cache, self._table_dev,
+                    self._next_key(), self.sparams,
+                    num_steps=self.chunk_steps,
+                )
+            )
+        elif self._ctable.any_active:
+            # >= 1 constrained tenant: the constrained slot program
+            # (two extra gathers; free rows make it a no-op for
+            # unconstrained slots). The fsm chunk output chains
+            # device-side exactly like state/cache.
+            cm, ct = self._ctable.device_tables()
+            emitted, mask, self.state, self.cache, self._fsm = (
+                self.backend.decode_slots_constrained(
+                    self.state, self.cache, self._next_key(),
+                    self.sparams, self._fsm, cm, ct,
+                    num_steps=self.chunk_steps,
+                )
+            )
+        else:
+            emitted, mask, self.state, self.cache = (
+                self.backend.decode_slots(
+                    self.state, self.cache, self._next_key(),
+                    self.sparams, num_steps=self.chunk_steps,
+                )
+            )
+        packed = G.pack_chunk(emitted, mask, self.state.active)
+        return (
+            packed, list(self._assignment), time.perf_counter(),
+            self._mutation_seq,
+        )
 
     def _loop_inner(self):
-        # In-flight decode chunks, oldest first: (packed results dev array,
-        # assignment snapshot). Launch up to chunk_lag chunks before
-        # blocking on the oldest fetch — state/cache chain device-side
-        # between launches (no fetch needed to launch the next chunk), so
-        # the device stays fed even when the fetch RTT exceeds a chunk's
-        # compute. Admission (insert_slot) and kill (kill_slot) mutate the
-        # FUTURE-most state, which is exactly the one the next launch uses.
+        # In-flight decode chunks, oldest first. Launch up to chunk_lag
+        # chunks before blocking on the oldest fetch, so the device stays
+        # fed even when the fetch RTT exceeds a chunk's compute. Admission
+        # (insert_slot) and kill (kill_slot) mutate the FUTURE-most state,
+        # which is exactly the one the next launch uses.
         inflight: collections.deque = collections.deque()
+        # after a supervisor restart: serially re-admit salvaged requests
+        # (no-op on a clean start; also clears the restarting flag)
+        self._run_recovery()
         while True:
             with self._cv:
                 while (
@@ -580,43 +1027,10 @@ class ContinuousEngine:
                 queue_head = bool(self._queue)
             if queue_head:
                 self._admit()
-            launched = False
-            if any(r is not None for r in self._assignment):
-                if self.paged:
-                    if self._table_dev is None:
-                        self._table_dev = jnp.asarray(self._table)
-                    emitted, mask, self.state, self.cache = (
-                        self.backend.decode_slots_paged(
-                            self.state, self.cache, self._table_dev,
-                            self._next_key(), self.sparams,
-                            num_steps=self.chunk_steps,
-                        )
-                    )
-                elif self._ctable.any_active:
-                    # >= 1 constrained tenant: the constrained slot program
-                    # (two extra gathers; free rows make it a no-op for
-                    # unconstrained slots). The fsm chunk output chains
-                    # device-side exactly like state/cache.
-                    cm, ct = self._ctable.device_tables()
-                    emitted, mask, self.state, self.cache, self._fsm = (
-                        self.backend.decode_slots_constrained(
-                            self.state, self.cache, self._next_key(),
-                            self.sparams, self._fsm, cm, ct,
-                            num_steps=self.chunk_steps,
-                        )
-                    )
-                else:
-                    emitted, mask, self.state, self.cache = (
-                        self.backend.decode_slots(
-                            self.state, self.cache, self._next_key(),
-                            self.sparams, num_steps=self.chunk_steps,
-                        )
-                    )
-                packed = G.pack_chunk(emitted, mask, self.state.active)
-                inflight.append(
-                    (packed, list(self._assignment), time.perf_counter())
-                )
-                launched = True
+            chunk = self._launch_chunk()
+            launched = chunk is not None
+            if launched:
+                inflight.append(chunk)
             # Block on the oldest chunk when MORE than chunk_lag chunks
             # are unprocessed (so chunk_lag=1 keeps one outstanding after
             # draining — the classic fetch-N-1-overlaps-compute-N) — or
@@ -660,7 +1074,18 @@ class ContinuousEngine:
                 req = self._queue.pop(0)
                 self._m_depth.set(len(self._queue))
             try:
+                # suspect-set bookkeeping: this request mutates the fleet
+                # now; until a chunk launched after this point fetches
+                # clean, a scheduler crash implicates it (_supervise)
+                self._suspects.add(req)
+                self._mutation_seq += 1
+                # _admitting stays set through an exception unwind ON
+                # PURPOSE: the supervisor reads it to salvage the request
+                # a crash cut mid-admission (a finally here would erase
+                # the crash's only pointer to it and hang the caller)
+                self._admitting = req
                 first_dev = self._admit_one(req, free[0])
+                self._admitting = None
                 if first_dev is _BLOCKED:
                     # paged pool exhausted: requeue at the FRONT (FIFO
                     # fairness) and stop admitting until a release frees
@@ -672,16 +1097,18 @@ class ContinuousEngine:
                 if first_dev is not None:  # None: failed fast (e.g. queued
                     wave.append((req, first_dev))  # past deadline), result set
             except ValueError as e:
+                self._admitting = None
                 log.warning("invalid_request", error=str(e))
                 req.result = {
                     "error": f"Error: {e}", "status": "failed",
                     "error_type": "invalid_request",
                 }
                 self._push_final(req)
-            except Exception as e:  # noqa: BLE001 - must unblock the caller
-                log.error("admit_failed", exc_info=True, error=str(e))
-                req.result = {"error": f"Error: {e}", "status": "failed"}
-                self._push_final(req)
+            # any OTHER exception escapes to the supervisor: the crash is
+            # contained there (restart + salvage via _admitting), the
+            # request is implicated via the suspect set, and a
+            # deterministic admission failure quarantines it within
+            # poison_strikes restarts instead of failing fleet-mates
         if not wave:
             return
         firsts = np.asarray(jnp.concatenate([f for _, f in wave]))
@@ -689,25 +1116,34 @@ class ContinuousEngine:
         for (req, _), first_id in zip(wave, firsts):
             req.first_id = int(first_id)
             req.ttft = now - req.t_start
-            # mirror insert_slot's on-device budget: stop-token-first or a
-            # one-token cap means the slot was armed inactive
-            if req.first_id in self.cfg.all_stop_ids or req.budget == 0:
-                self._finalize(req)
-                continue
-            if req.cart is not None:
-                # arm the slot's FSM row: fleet-absolute state after the
-                # (bias-masked) first token. Set BEFORE the next chunk
-                # launch, so the constrained program picks it up — same
-                # future-most-state contract as insert_slot.
-                cart, off = req.cart
-                self._fsm = self._fsm.at[req.slot].set(
-                    off + cart.advance(cart.start, req.first_id)
-                )
-            if req.stream_q is not None:
-                self._stream_tokens(req)  # first token, right after TTFT
+            self._post_admit(req)
+
+    def _post_admit(self, req: _Request):
+        """First-token bookkeeping shared by the admission wave and the
+        recovery path: stop-token-first / zero-budget requests finalize
+        immediately (mirroring insert_slot's on-device decision);
+        constrained slots arm their fleet-table FSM row — the DFA
+        advanced over any salvaged continuation tokens, then the first
+        token — BEFORE the next chunk launch (same future-most-state
+        contract as insert_slot); streaming clients get their first
+        event right after TTFT."""
+        if req.first_id in self.cfg.all_stop_ids or req.budget == 0:
+            self._finalize(req)
+            return
+        if req.cart is not None:
+            cart, off = req.cart
+            st = cart.start
+            for t in req.salvaged:
+                st = cart.advance(st, t)
+            self._fsm = self._fsm.at[req.slot].set(
+                off + cart.advance(st, req.first_id)
+            )
+        if req.stream_q is not None:
+            self._stream_tokens(req)
 
     def _admit_one(self, req: _Request, slot: int):
         eng, cfg = self.engine, self.cfg
+        faults.check("admission", tag=req.prompt)
         # everything before this point (bounded queue + worker pickup) is
         # queueing delay; a _BLOCKED retry folds its re-wait in here too
         req.trace.checkpoint("queue_wait")
@@ -738,8 +1174,13 @@ class ContinuousEngine:
             if k.get("chat", True) else req.prompt
         )
         ids = eng.tokenizer.encode(text)
+        req.prompt_tokens = len(ids)
+        if req.salvaged:
+            # crash-recovery continuation: prefill prompt + the tokens
+            # generated before the crash (all host-side), so greedy decode
+            # resumes bit-exactly where the fetched stream stopped
+            ids = ids + list(req.salvaged)
         prompt_len = len(ids)
-        req.prompt_tokens = prompt_len
         # prefix lookup + ingest plan: the solo engine's shared planner
         # helper (one copy of the lookup/cold-fallback/mark discipline);
         # the planner is mode-specific — block-chain index (paged) or
@@ -754,11 +1195,17 @@ class ContinuousEngine:
                 f"(slot_max_seq {self.slot_max_seq})"
             )
         max_tokens, _ = eng._clamp_decode(
-            prompt_len, int(k.get("max_tokens", 20)),
+            prompt_len, int(k.get("max_tokens", 20)) - len(req.salvaged),
             capacity=self.slot_max_seq,
         )
+        if req.allowed is None:
+            req.allowed = max_tokens  # total generated-token cap, fixed once
+        else:
+            # re-admission: never exceed the cap fixed at first admission
+            max_tokens = min(max_tokens, req.allowed - len(req.salvaged))
         table_row = insert_row = None
         if self.paged:
+            faults.check("alloc", tag=req.prompt)
             need_total = self._P.blocks_needed(
                 prompt_len, max_tokens, self.kv_block_size
             )
@@ -828,10 +1275,17 @@ class ContinuousEngine:
         rp = float(k.get("repetition_penalty", 1.0))
         presence = eng._presence_rows([ids]) if rp != 1.0 else None
         try:
-            bias = (
-                eng._constraint_bias(req.cart[0], None)
-                if req.cart is not None else None
-            )
+            faults.check("prefill", tag=req.prompt)
+            bias = None
+            if req.cart is not None:
+                # first-token mask from the DFA state the salvaged
+                # continuation lands on (the cold path's start state when
+                # salvaged is empty — state_bias(start) == start_bias)
+                art = req.cart[0]
+                st = art.start
+                for t in req.salvaged:
+                    st = art.advance(st, t)
+                bias = jnp.asarray(art.state_bias(st))
             if self.paged:
                 if p0:
                     # block-level hit: the shared physical blocks are
@@ -934,7 +1388,10 @@ class ContinuousEngine:
 
     def _process(self, chunk):
         """Fetch one decode chunk's packed results and distribute/finalize."""
-        packed_dev, snapshot, t_launch = chunk
+        packed_dev, snapshot, t_launch, seq = chunk
+        faults.check("fetch", tag=",".join(
+            r.prompt for r in snapshot if r is not None
+        ))
         packed = np.asarray(packed_dev)  # [2K+1, B] — the ONE fetch per chunk
         # launch-to-fetch over the chunk's steps: under lag-N pipelining
         # this includes queue wait behind earlier chunks, so it is the
@@ -997,12 +1454,23 @@ class ContinuousEngine:
                     "error_type": "timeout",
                 }
                 self._release(req)
+        # healthy step: the fleet (as launched) fetched clean — reset the
+        # supervisor's consecutive-crash window, and vindicate suspects
+        # when no admission happened after this chunk's launch (an older
+        # chunk's clean fetch says nothing about a newer tenant)
+        self._consecutive_crashes = 0
+        if seq >= self._mutation_seq:
+            self._suspects.clear()
 
     def _gen_text(self, req: _Request) -> tuple:
-        """(full decoded text, stop-truncated text, stop hit) for req."""
-        gen_ids = (
-            [req.first_id] if req.first_id not in self.cfg.all_stop_ids else []
-        ) + req.tokens
+        """(generated ids — crash-salvaged continuation included — then
+        stop-truncated text, stop hit) for req."""
+        head = (
+            [req.first_id]
+            if req.first_id is not None
+            and req.first_id not in self.cfg.all_stop_ids else []
+        )
+        gen_ids = list(req.salvaged) + head + req.tokens
         text = self.engine.tokenizer.decode(gen_ids, skip_special_tokens=True)
         cut, hit = self.engine._truncate_at_stop(
             text, req.kwargs.get("stop")
@@ -1036,12 +1504,19 @@ class ContinuousEngine:
             "ttft_s": round(req.ttft, 4),
             "backend": "continuous",
             "continuous": True,
-            # budget counts decode steps after the first token, so the
-            # generated-token budget is budget + 1 (clamped, see _admit)
+            # allowed is the total generated-token cap fixed at first
+            # admission (budget + 1 there; re-admissions shrink budget but
+            # keep allowed, so recovered requests report honestly)
             "finish_reason": (
-                "stop" if stopped or n < req.budget + 1 else "length"
+                "stop" if stopped or n < (
+                    req.allowed if req.allowed is not None
+                    else req.budget + 1
+                ) else "length"
             ),
         }
+        if req.salvaged:
+            # served across a scheduler restart (continuation prefill)
+            req.result["recovered"] = True
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
         if req.cart is not None:

@@ -348,6 +348,27 @@ class InferenceEngine:
             "dli_prefix_cache_entries", "resident prefix snapshots",
             ("scope",),
         )
+        # failure-containment families (engine/continuous.py supervisor +
+        # the serving drain path): restarts, salvaged re-admissions,
+        # quarantined requests, drain latency
+        self.metrics.counter(
+            "dli_scheduler_restarts_total",
+            "continuous-scheduler supervisor restarts", ("engine",),
+        )
+        self.metrics.counter(
+            "dli_requests_recovered_total",
+            "in-flight requests re-admitted (continuation prefill) after "
+            "a scheduler restart", ("engine",),
+        )
+        self.metrics.counter(
+            "dli_poison_requests_total",
+            "requests quarantined as poison after repeated crash "
+            "implication", ("engine",),
+        )
+        self.metrics.histogram(
+            "dli_drain_duration_seconds",
+            "graceful-drain wall time (SIGTERM / drain())", ("component",),
+        )
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
         # between requests are harmless — prefill rewrites slots [0, bucket)
@@ -2082,6 +2103,19 @@ class InferenceEngine:
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
         return out
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Wait for any in-flight generation to finish (the engine lock is
+        held for a whole request). The solo engine has no queue of its own
+        — the serving drain path rejects NEW work at the HTTP edge first,
+        so once the lock frees the engine is idle. Returns False when the
+        deadline expired with a request still running."""
+        t0 = time.time()
+        while self._lock.locked():
+            if deadline_s is not None and time.time() - t0 > deadline_s:
+                return False
+            time.sleep(0.05)
+        return True
 
     # -- health (reference /health + /workers, orchestration.py:297-329) ----
     def health(self) -> dict:

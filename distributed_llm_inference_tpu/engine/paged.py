@@ -157,6 +157,27 @@ class BlockAllocator:
         return len(self._free)
 
     @property
+    def outstanding(self) -> int:
+        """Blocks currently held by anyone (leak accounting: after every
+        holder releases — requests done, prefix index cleared — this must
+        be 0, i.e. free_blocks == n_blocks - 1)."""
+        return len(self._ref)
+
+    def reset(self):
+        """Forget every allocation and rebuild the full free list. The
+        scheduler supervisor's DEFENSIVE path only: after a crash it
+        releases every holder explicitly (the accounting is the leak
+        regression the chaos suite pins) and calls this solely when the
+        books still disagree, because a rebuilt pool must never start
+        with phantom holders."""
+        self._free = list(range(1, self.n_blocks))
+        self._ref.clear()
+        self._shared = 0
+        if self._m_free is not None:
+            self._m_free.set(len(self._free))
+            self._m_shared.set(0)
+
+    @property
     def shared_blocks(self) -> int:
         return self._shared
 

@@ -116,6 +116,8 @@ class BatchingQueue:
         self._cv = threading.Condition()
         self._queue: list[_Pending] = []
         self._closed = False
+        self._draining = False
+        self._busy = False  # dispatcher mid-group (drain must wait for it)
         self.coalesced_batches = 0  # observability: fleets actually formed
         # registry families (engine.metrics — one /metrics scrape covers
         # the queue alongside the engine): depth, shed 429s, dispatcher
@@ -171,6 +173,13 @@ class BatchingQueue:
                     "error": "Error: server shutting down", "status": "failed",
                     "error_type": "overloaded",
                 }
+            if self._draining:
+                # graceful drain: the serving edge maps this to HTTP 503
+                # with a Retry-After header (in-flight work still finishes)
+                return {
+                    "error": "Error: server draining", "status": "failed",
+                    "error_type": "draining",
+                }
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue))
                 self._m_shed.inc()
@@ -184,6 +193,40 @@ class BatchingQueue:
             self._cv.notify_all()
         pend.done.wait()
         return pend.result
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Graceful drain: reject NEW submissions (draining envelope →
+        HTTP 503 + Retry-After), then wait until the queue is empty and
+        the dispatcher is idle, up to deadline_s. Returns True when fully
+        drained; the caller's close() fails any stragglers. Idempotent."""
+        t0 = time.time()
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        drained = True
+        with self._cv:
+            while self._queue or self._busy:
+                if self._closed:
+                    drained = not self._queue and not self._busy
+                    break
+                left = (
+                    None if deadline_s is None
+                    else deadline_s - (time.time() - t0)
+                )
+                if left is not None and left <= 0:
+                    drained = False
+                    break
+                self._cv.wait(
+                    timeout=0.1 if left is None else min(left, 0.1)
+                )
+        self.engine.metrics.histogram(
+            "dli_drain_duration_seconds",
+            "graceful-drain wall time (SIGTERM / drain())", ("component",),
+        ).labels(component="queue").observe(time.time() - t0)
+        log.info(
+            "queue_drained", ok=drained, seconds=round(time.time() - t0, 3)
+        )
+        return drained
 
     def close(self):
         with self._cv:
@@ -251,9 +294,15 @@ class BatchingQueue:
                 if not self._queue:
                     continue
                 group = self._take_group()
-            group = self._expire(group)
-            if group:
-                self._run_group(group)
+                self._busy = True  # drain() waits for the group to finish
+            try:
+                group = self._expire(group)
+                if group:
+                    self._run_group(group)
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
 
     def _expire(self, group: list[_Pending]) -> list[_Pending]:
         """Fail requests whose QUEUE WAIT already exceeded the engine's

@@ -134,10 +134,14 @@ class _Profiler:
 # collapses to "other" so an attacker probing random paths cannot grow the
 # label cardinality (the registry's own series cap is the second fence)
 _KNOWN_ROUTES = frozenset((
-    "/", "/health", "/workers", "/stats", "/metrics", "/v1/models",
+    "/", "/health", "/ready", "/workers", "/stats", "/metrics", "/v1/models",
     "/generate", "/v1/completions", "/v1/chat/completions",
     "/profiler/start", "/profiler/stop",
 ))
+
+# Retry-After (seconds) sent with every drain/overload rejection — the
+# client's bounded-retry backoff honors it (client.py)
+RETRY_AFTER_S = 2
 
 
 def _route_label(path: str) -> str:
@@ -145,11 +149,13 @@ def _route_label(path: str) -> str:
 
 
 def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
-                 queue=None, continuous=None):
+                 queue=None, continuous=None, state=None):
     from ..utils.tracing import new_request_id, sanitize_request_id
     from . import openai_api as oai
 
     profiler = profiler or _Profiler()
+    if state is None:  # embedding callers without an InferenceServer
+        state = _ServerState()
     started_at = int(time.time())
     # HTTP request/error counter by route + status — every response path
     # (JSON, HTML, SSE, NDJSON) passes through exactly one counting point
@@ -176,7 +182,8 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 method=self.command, status=str(code),
             ).inc()
 
-        def _send(self, code: int, payload: Any, content_type="application/json"):
+        def _send(self, code: int, payload: Any, content_type="application/json",
+                  headers=None):
             body = (
                 payload.encode()
                 if isinstance(payload, str)
@@ -188,8 +195,24 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             self.send_header("Content-Length", str(len(body)))
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _readiness(self) -> tuple:
+            """(ready, reason): liveness is /health's job; THIS is the
+            load-balancer signal — False while draining and while the
+            continuous scheduler is restart-looping or dead."""
+            if state.draining:
+                return False, "draining"
+            if continuous is not None and not continuous.ready:
+                return False, (
+                    "scheduler_dead"
+                    if continuous.stats()["supervisor"]["dead"]
+                    else "scheduler_restarting"
+                )
+            return True, None
 
         def do_GET(self):
             path = self.path.split("?")[0].rstrip("/") or "/"
@@ -197,12 +220,19 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 self._send(200, _status_html(engine), content_type="text/html")
             elif path == "/health":
                 h = engine.health()
+                ready, why = self._readiness()
                 # reference shape: status/role/model/version
-                # (orchestration.py:297-304) + our backend detail
+                # (orchestration.py:297-304) + our backend detail.
+                # LIVENESS stays 200 even while draining/restart-looping —
+                # readiness is the separate /ready signal (and the `ready`
+                # field here), so an LB can stop routing without the
+                # process being reaped mid-drain.
                 self._send(
                     200,
                     {
                         "status": h["status"],
+                        "ready": ready,
+                        **({"ready_reason": why} if why else {}),
                         "role": "orchestrator",
                         "model": h["model"],
                         "version": __version__,
@@ -212,6 +242,17 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         "stats": h["stats"],
                     },
                 )
+            elif path == "/ready":
+                # load-balancer readiness probe: 200/503 is the whole
+                # contract (k8s readinessProbe-friendly)
+                ready, why = self._readiness()
+                if ready:
+                    self._send(200, {"ready": True})
+                else:
+                    self._send(
+                        503, {"ready": False, "reason": why},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
             elif path == "/workers":
                 # reference shape: {"worker_1": "online", ...}
                 # (orchestration.py:306-329); stages are in-process mesh
@@ -393,6 +434,21 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 sanitize_request_id(self.headers.get("X-Request-Id"))
                 or new_request_id()
             )
+            if state.draining and path in (
+                "/generate", "/v1/completions", "/v1/chat/completions"
+            ):
+                # graceful drain: admission closed at the edge (in-flight
+                # work keeps finishing); Retry-After tells well-behaved
+                # clients when to try the next replica
+                self._send(
+                    503,
+                    {
+                        "error": "Error: server draining",
+                        "status": "failed", "error_type": "draining",
+                    },
+                    headers={"Retry-After": str(RETRY_AFTER_S)},
+                )
+                return
             if path in ("/v1/completions", "/v1/chat/completions"):
                 data = self._read_json()
                 if data is not None:
@@ -579,38 +635,61 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             except (TypeError, ValueError) as e:
                 self._send(400, {"error": f"bad parameter: {e}"})
                 return
+            err_type = result.get("error_type")
+            headers = None
             if result.get("status") == "success":
                 code = 200
-            elif result.get("error_type") == "invalid_request":
+            elif err_type == "invalid_request":
                 code = 400
-            elif result.get("error_type") == "timeout":
-                # request deadline exceeded (EngineConfig.request_deadline_s):
-                # service-unavailable, mirroring the reference's per-hop
-                # timeout failure mode (orchestration.py:118,131)
+            elif err_type in ("timeout", "unavailable", "draining"):
+                # timeout: deadline exceeded (reference's per-hop failure,
+                # orchestration.py:118,131). unavailable: the continuous
+                # scheduler exhausted its restart budget. draining: raced
+                # the drain flag inside the engine — all service-
+                # unavailable, all retryable elsewhere.
                 code = 503
-            elif result.get("error_type") == "overloaded":
+                if err_type != "timeout":
+                    headers = {"Retry-After": str(RETRY_AFTER_S)}
+            elif err_type == "overloaded":
                 # bounded queue full (serving/queue.py): shed load
                 code = 429
             else:
+                # includes "poison": the request itself crashed the
+                # scheduler K times — a server-side fault answer, and the
+                # one 5xx a client must NOT blindly retry
                 code = 500
-            self._send(code, result)
+            self._send(code, result, headers=headers)
 
     return Handler
 
 
+class _ServerState:
+    """Mutable flags shared between the server object and its handler
+    class (the handler closes over this; InferenceServer.drain flips it)."""
+
+    __slots__ = ("draining",)
+
+    def __init__(self):
+        self.draining = False
+
+
 class InferenceServer:
     """Owns the HTTP server + engine; start()/shutdown() for embedding in
-    tests, serve_forever() for the CLI."""
+    tests, serve_forever() for the CLI (which installs the SIGTERM →
+    graceful-drain handler)."""
 
     def __init__(self, engine, host: str = "0.0.0.0", port: int = 5000,
-                 max_tokens_cap: int = 30, queue=None, continuous=None):
+                 max_tokens_cap: int = 30, queue=None, continuous=None,
+                 drain_deadline_s: float = 30.0):
         self.engine = engine
         self.queue = queue
         self.continuous = continuous
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.state = _ServerState()
         self.httpd = ThreadingHTTPServer(
             (host, port),
             make_handler(engine, max_tokens_cap, queue=queue,
-                         continuous=continuous),
+                         continuous=continuous, state=self.state),
         )
         self.port = self.httpd.server_address[1]
 
@@ -619,17 +698,74 @@ class InferenceServer:
         t.start()
         return t
 
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Graceful drain, the SIGTERM path: flip readiness (new requests
+        get 503 + Retry-After, /ready goes 503), let queued + in-flight
+        work finish up to the deadline, then stop the HTTP server and
+        close the engines. Ordering matters: edge first (no new
+        admissions), then the batching layers (their own queues), then
+        the bare engine's in-flight lock. Returns True when everything
+        finished inside the deadline."""
+        deadline = (
+            self.drain_deadline_s if deadline_s is None else float(deadline_s)
+        )
+        t0 = time.time()
+        self.state.draining = True
+        ok = True
+
+        def left() -> float:
+            return max(0.0, deadline - (time.time() - t0))
+
+        if self.continuous is not None:
+            ok = self.continuous.drain(left()) and ok
+        if self.queue is not None:
+            ok = self.queue.drain(left()) and ok
+        if hasattr(self.engine, "drain"):  # MirroredEngine proxies lack it
+            ok = self.engine.drain(left()) and ok
+        self.engine.metrics.histogram(
+            "dli_drain_duration_seconds",
+            "graceful-drain wall time (SIGTERM / drain())", ("component",),
+        ).labels(component="server").observe(time.time() - t0)
+        from ..utils.logging import get_logger
+
+        get_logger("server").info(
+            "drained", ok=ok, seconds=round(time.time() - t0, 3)
+        )
+        self.shutdown()
+        return ok
+
+    def install_signal_handlers(self):
+        """SIGTERM → graceful drain (must run on the main thread; the
+        handler only spawns the drain thread, so it returns immediately).
+        The second SIGTERM is left at default disposition semantics: the
+        drain already owns shutdown, and repeated signals must not stack
+        drain threads."""
+        import signal
+
+        def _on_term(signum, frame):
+            if self.state.draining:
+                return  # drain already in flight
+            self.state.draining = True  # flip readiness before the thread spawns
+            threading.Thread(
+                target=self.drain, name="sigterm-drain", daemon=True
+            ).start()
+
+        signal.signal(signal.SIGTERM, _on_term)
+
     def serve_forever(self):
         from ..utils.logging import configure, get_logger
 
         configure()  # JSON-lines handler; entry-point-only (library-safe)
+        self.install_signal_handlers()
         get_logger("server").info(
             "serving", port=self.port,
-            routes=["/generate", "/health", "/workers", "/stats", "/metrics",
-                    "/profiler/*"],
+            routes=["/generate", "/health", "/ready", "/workers", "/stats",
+                    "/metrics", "/profiler/*"],
         )
-        print(f"🚀 serving on :{self.port} — /generate /health /workers /metrics /")
+        print(f"🚀 serving on :{self.port} — /generate /health /ready /workers /metrics /")
         self.httpd.serve_forever()
+        # serve_forever returns when drain()/shutdown() stopped the
+        # listener — SIGTERM ends as a clean exit 0
 
     def shutdown(self):
         self.httpd.shutdown()
@@ -806,6 +942,33 @@ def main(argv: Optional[list] = None):
              "timeout envelope (reference: 30s per worker hop)",
     )
     ap.add_argument(
+        "--drain-deadline", type=float, default=30.0, metavar="SECONDS",
+        help="graceful-drain budget on SIGTERM: readiness flips "
+             "immediately (503 + Retry-After on new requests, /ready "
+             "503), in-flight requests get this long to finish, then the "
+             "process exits cleanly",
+    )
+    ap.add_argument(
+        "--restart-budget", type=int, default=3, metavar="N",
+        help="continuous-scheduler supervisor: how many CONSECUTIVE "
+             "crashes to absorb (restart + re-admit in-flight requests "
+             "as continuation prefills) before declaring the fleet dead; "
+             "a healthy decode chunk resets the window",
+    )
+    ap.add_argument(
+        "--poison-strikes", type=int, default=2, metavar="K",
+        help="quarantine a request implicated in K consecutive "
+             "scheduler crash-restarts (error_type 'poison'), instead of "
+             "letting it take the fleet down with it",
+    )
+    ap.add_argument(
+        "--faults", default=None, metavar="SPEC",
+        help="arm the deterministic fault-injection harness "
+             "(utils/faults.py), e.g. 'decode_launch:transient:on=3'; "
+             "the DLI_FAULTS env var is the config-file-free spelling. "
+             "Chaos drills only — never in front of real traffic",
+    )
+    ap.add_argument(
         "--die-on-wedge", type=float, default=None, metavar="SECONDS",
         help="exit the process (code 17) once an abandoned deadline-overrun "
              "device call has been stuck this long — a supervisor restart "
@@ -896,6 +1059,16 @@ def main(argv: Optional[list] = None):
             "--die-on-wedge needs --deadline: wedges are detected by "
             "deadline-overrun calls that never drain"
         )
+    from ..utils import faults as _faults
+
+    if args.faults:
+        try:
+            _faults.arm(args.faults)
+        except ValueError as e:
+            raise SystemExit(f"--faults: {e}") from e
+        print(f"💥 fault injection armed: {args.faults}")
+    elif _faults.arm_from_env() is not None:
+        print(f"💥 fault injection armed from DLI_FAULTS")
     if args.compile_cache:
         import jax
 
@@ -1029,6 +1202,8 @@ def main(argv: Optional[list] = None):
             chunk_lag=args.continuous_lag, slot_max_seq=args.continuous_max_seq,
             kv_pool_blocks=args.kv_pool_blocks,
             kv_block_size=args.kv_block_size,
+            restart_budget=args.restart_budget,
+            poison_strikes=args.poison_strikes,
         )
         if args.warmup:
             w = continuous.warmup()
@@ -1048,7 +1223,7 @@ def main(argv: Optional[list] = None):
     try:
         InferenceServer(
             engine, args.host, args.port, args.max_tokens_cap, queue=queue,
-            continuous=continuous,
+            continuous=continuous, drain_deadline_s=args.drain_deadline,
         ).serve_forever()
     finally:
         if hasattr(engine, "shutdown_followers"):

@@ -458,6 +458,28 @@ def test_real_traced_set_excludes_host_code(real_reachable):
         assert key not in real_reachable, key
 
 
+def test_fault_hooks_decode_unreachable(real_reachable):
+    """The fault-injection harness (utils/faults.py) is strictly
+    host-side: no function in it — and none of the scheduler host-loop
+    functions that call faults.check — may be reachable from any jit
+    root. This is what keeps the chaos suite (tests/test_faults.py)
+    invisible to the compiled-decode invariants: check() can sleep and
+    raise precisely BECAUSE it can never be traced."""
+    fault_funcs = sorted(k for k in real_reachable if k[0] == "utils.faults")
+    assert not fault_funcs, fault_funcs
+    # the host-loop callers of faults.check stay untraced too — if one of
+    # these ever became a jit root, the hook (and its time.sleep wedge)
+    # would land in compiled code
+    for key in [
+        ("engine.continuous", "ContinuousEngine._launch_chunk"),
+        ("engine.continuous", "ContinuousEngine._process"),
+        ("engine.continuous", "ContinuousEngine._admit_one"),
+        ("engine.continuous", "ContinuousEngine._supervise"),
+        ("engine.continuous", "ContinuousEngine._run_recovery"),
+    ]:
+        assert key not in real_reachable, key
+
+
 def test_repo_is_clean():
     """The package itself lints clean — the same gate CI runs."""
     diags, _ = run_lint(PKG_ROOT)

@@ -1,0 +1,809 @@
+"""Chaos suite: deterministic fault injection (utils/faults.py) driving
+every failure-containment path in the continuous scheduler and the
+serving drain/readiness surface.
+
+The bar, per recovery path:
+  * transient faults at each injection point: every non-poison greedy
+    request completes with output IDENTICAL to a fault-free run, and the
+    restart counter matches the injection count;
+  * resource accounting returns to zero leaks (paged pool free == total,
+    constraint rows free) after crashes — including on the permanent
+    loop-death path;
+  * a poison request is isolated within poison_strikes restarts WITHOUT
+    failing its fleet-mates;
+  * restart-budget exhaustion fails the whole fleet with clean
+    envelopes, never hangs a client;
+  * SIGTERM flips readiness (503 + Retry-After at the edge), in-flight
+    work drains, and the server exits cleanly.
+
+Everything here is tier-1 (marker `chaos`, never `slow`): the triggers
+are call counters, not wall clock, so the suite replays identically.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.client import DistributedLLMClient
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+from distributed_llm_inference_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+PROMPTS = [
+    "the quick brown fox",
+    "jumps over",
+    "a lazy dog while the band plays on",
+]
+POISON = "POISONPILL do not serve this"
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No armed plan may leak between tests (or into other suites)."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(
+        cfg, engine_cfg=EngineConfig(prefill_buckets=(32, 64))
+    )
+
+
+@pytest.fixture(scope="module")
+def solo(engine):
+    """Fault-free greedy references (the bit-exactness bar)."""
+    return {
+        p: engine.generate(p, max_tokens=10, greedy=True, chat=False)
+        for p in PROMPTS
+    }
+
+
+def _cont(engine, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("chunk_steps", 4)
+    kw.setdefault("restart_backoff_s", 0.01)
+    return ContinuousEngine(engine, **kw)
+
+
+def _submit_all(cont, prompts, max_tokens=10, stagger=0.05):
+    out = {}
+    lock = threading.Lock()
+
+    def run(p, delay):
+        time.sleep(delay)
+        r = cont.submit(p, max_tokens=max_tokens, greedy=True, chat=False)
+        with lock:
+            out[p] = r
+
+    threads = [
+        threading.Thread(target=run, args=(p, stagger * i))
+        for i, p in enumerate(prompts)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    return out
+
+
+# -- harness units (no engine) ----------------------------------------------
+
+def test_rule_triggers_nth_every_times():
+    r = faults.FaultRule("fetch", "transient", on_call=2, every=3, times=2)
+    fired = [r.should_fire("") for _ in range(9)]
+    # calls:      1      2     3      4      5     6      7  (times cap)
+    assert fired == [
+        False, True, False, False, True, False, False, False, False
+    ]
+    assert r.fired == 2
+
+
+def test_rule_match_restricts_and_counts_matching_calls_only():
+    r = faults.FaultRule("prefill", "fatal", match="BAD", every=1, times=0)
+    assert not r.should_fire("good prompt")
+    assert r.should_fire("a BAD prompt")
+    assert not r.should_fire("still good")
+    assert r.should_fire("BAD again")
+
+
+def test_check_is_noop_when_disarmed():
+    faults.disarm()
+    faults.check("decode_launch", tag="anything")  # must not raise
+
+
+def test_armed_check_raises_typed_errors():
+    faults.arm([faults.FaultRule("fetch", "transient")])
+    with pytest.raises(faults.TransientFault, match="RESOURCE_EXHAUSTED"):
+        faults.check("fetch")
+    faults.arm([faults.FaultRule("fetch", "fatal")])
+    with pytest.raises(faults.FatalFault):
+        faults.check("fetch")
+    # other points untouched by the plan stay silent
+    faults.check("prefill")
+
+
+def test_spec_parsing_round_trip():
+    plan = faults.arm(
+        "decode_launch:transient:on=3,every=2,times=4;"
+        "prefill:fatal:match=XYZ,wedge=0.001"
+    )
+    kinds = {(r.point, r.kind) for r in plan.rules}
+    assert kinds == {("decode_launch", "transient"), ("prefill", "fatal")}
+    assert plan.rules[0].on_call == 3 and plan.rules[0].every == 2
+    assert plan.rules[1].match == "XYZ" and plan.rules[1].wedge_s == 0.001
+    for bad in ("nonsense", "fetch", "fetch:weird", "fetch:fatal:zz=1", ""):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+def test_arm_from_env():
+    assert faults.arm_from_env({}) is None
+    plan = faults.arm_from_env({"DLI_FAULTS": "alloc:transient:on=5"})
+    assert plan is not None and plan.rules[0].point == "alloc"
+
+
+def test_seeded_probabilistic_rule_is_deterministic():
+    def draws():
+        r = faults.FaultRule(
+            "fetch", "transient", on_call=1, every=1, times=0, p=0.5, seed=7
+        )
+        return [r.should_fire("") for _ in range(32)]
+
+    a, b = draws(), draws()
+    assert a == b  # same seed => same firing sequence
+    assert any(a) and not all(a)
+
+
+def test_wedge_sleeps_before_raising():
+    faults.arm([faults.FaultRule("fetch", "transient", wedge_s=0.15)])
+    t0 = time.time()
+    with pytest.raises(faults.TransientFault):
+        faults.check("fetch")
+    assert time.time() - t0 >= 0.14
+
+
+# -- scheduler recovery: transient/fatal × injection point -------------------
+
+@pytest.mark.parametrize("kind", ["transient", "fatal"])
+@pytest.mark.parametrize("point", ["admission", "prefill", "decode_launch",
+                                   "fetch"])
+def test_one_shot_fault_recovers_bit_exact(engine, solo, point, kind):
+    """One injected crash at each host-loop point: the supervisor
+    restarts once, salvages the in-flight request as a continuation
+    prefill, and the greedy output matches the fault-free run exactly."""
+    cont = _cont(engine)
+    try:
+        faults.arm([faults.FaultRule(point, kind, on_call=1)])
+        r = cont.submit(
+            PROMPTS[0], max_tokens=10, greedy=True, chat=False
+        )
+        faults.disarm()
+        assert r["status"] == "success", r
+        assert r["response"] == solo[PROMPTS[0]]["response"]
+        assert r["tokens_generated"] == solo[PROMPTS[0]]["tokens_generated"]
+        assert cont.restarts_total == 1
+        assert cont.stats()["supervisor"]["ready"] is True
+        # the fleet keeps serving afterwards
+        r2 = cont.submit(
+            PROMPTS[1], max_tokens=10, greedy=True, chat=False
+        )
+        assert r2["status"] == "success"
+        assert r2["response"] == solo[PROMPTS[1]]["response"]
+    finally:
+        cont.close()
+
+
+def test_mid_decode_crash_recovers_fleet_bit_exact(engine, solo):
+    """A fetch fault while SEVERAL requests are in flight: every one is
+    salvaged, re-admitted serially, and finishes identical to solo; the
+    restart counter matches the injection count (1)."""
+    cont = _cont(engine)
+    try:
+        faults.arm([faults.FaultRule("fetch", "transient", on_call=3)])
+        out = _submit_all(cont, PROMPTS)
+        faults.disarm()
+        for p in PROMPTS:
+            assert out[p]["status"] == "success", out[p]
+            assert out[p]["response"] == solo[p]["response"], p
+        assert cont.restarts_total == 1
+        s = cont.stats()
+        assert s["occupied"] == 0
+        assert s["supervisor"]["recovered"] >= 1
+        # recovered envelopes are flagged
+        assert any(out[p].get("recovered") for p in PROMPTS)
+    finally:
+        cont.close()
+
+
+def test_repeated_transient_faults_within_budget(engine, solo):
+    """Two separate crashes separated by healthy work: the consecutive-
+    crash window resets, so the default budget absorbs both."""
+    cont = _cont(engine)
+    try:
+        # fetch call 1 is restart #1's recovery chunk (healthy — resets
+        # the consecutive window); fetch call 2 crashes MID-REQUEST, so
+        # both restarts complete before submit() returns
+        faults.arm([
+            faults.FaultRule("decode_launch", "transient", on_call=2),
+            faults.FaultRule("fetch", "transient", on_call=2),
+        ])
+        r = cont.submit(PROMPTS[2], max_tokens=10, greedy=True, chat=False)
+        faults.disarm()
+        assert r["status"] == "success", r
+        assert r["response"] == solo[PROMPTS[2]]["response"]
+        assert cont.restarts_total == 2
+    finally:
+        cont.close()
+
+
+def test_streaming_across_crash_reassembles_exactly(engine, solo):
+    """A crash mid-stream: deltas already emitted are never re-emitted,
+    and the joined deltas still equal the fault-free response."""
+    cont = _cont(engine, chunk_steps=2)
+    try:
+        faults.arm([faults.FaultRule("fetch", "transient", on_call=3)])
+        events = list(cont.stream(
+            PROMPTS[0], max_tokens=10, greedy=True, chat=False
+        ))
+        faults.disarm()
+        final = events[-1]
+        assert final["status"] == "success", final
+        assert final["response"] == solo[PROMPTS[0]]["response"]
+        deltas = [e["delta"] for e in events[:-1]]
+        assert "".join(deltas) == solo[PROMPTS[0]]["response"]
+        assert cont.restarts_total == 1
+    finally:
+        cont.close()
+
+
+def test_restart_metrics_exposed(engine):
+    cont = _cont(engine)
+    try:
+        faults.arm([faults.FaultRule("fetch", "transient", on_call=1)])
+        r = cont.submit(PROMPTS[1], max_tokens=6, greedy=True, chat=False)
+        faults.disarm()
+        assert r["status"] == "success"
+        m = engine.metrics
+        assert m.get("dli_scheduler_restarts_total").labels(
+            engine="continuous"
+        ).value >= 1
+        assert m.get("dli_requests_recovered_total").labels(
+            engine="continuous"
+        ).value >= 1
+        render = m.render()
+        assert "dli_scheduler_restarts_total" in render
+        assert "dli_poison_requests_total" in render
+        assert "dli_drain_duration_seconds" in render
+    finally:
+        cont.close()
+
+
+# -- poison quarantine --------------------------------------------------------
+
+def test_poison_quarantined_within_strikes_fleet_survives(engine, solo):
+    """A request that deterministically crashes the scheduler on every
+    admission is failed ALONE (error_type "poison") within
+    poison_strikes restarts; its innocent fleet-mate completes
+    bit-exact and the fleet keeps serving."""
+    cont = _cont(engine, poison_strikes=2)
+    try:
+        faults.arm([
+            faults.FaultRule("prefill", "fatal", match="POISONPILL",
+                             every=1, times=0),
+        ])
+        out = {}
+
+        def bg(name, prompt):
+            out[name] = cont.submit(
+                prompt, max_tokens=12, greedy=True, chat=False
+            )
+
+        t1 = threading.Thread(target=bg, args=("good", PROMPTS[2]))
+        t1.start()
+        time.sleep(0.3)  # the innocent tenant is decoding when P arrives
+        t2 = threading.Thread(target=bg, args=("bad", POISON))
+        t2.start()
+        t1.join(timeout=300)
+        t2.join(timeout=300)
+        faults.disarm()
+        assert out["bad"]["status"] == "failed"
+        assert out["bad"]["error_type"] == "poison", out["bad"]
+        assert out["good"]["status"] == "success", out["good"]
+        solo_good = engine.generate(
+            PROMPTS[2], max_tokens=12, greedy=True, chat=False
+        )
+        assert out["good"]["response"] == solo_good["response"]
+        assert cont.poisoned_total == 1
+        # isolated within poison_strikes restarts
+        assert cont.restarts_total <= cont.poison_strikes
+        # fleet survives the quarantine
+        r = cont.submit("hello", max_tokens=5, greedy=True, chat=False)
+        assert r["status"] == "success"
+        assert cont.stats()["supervisor"]["ready"] is True
+    finally:
+        cont.close()
+
+
+# -- restart-budget exhaustion ------------------------------------------------
+
+def test_budget_exhaustion_fails_fleet_cleanly(engine):
+    """Unbounded crashes: after restart_budget consecutive failures the
+    scheduler declares itself dead — every waiter gets a clean
+    `unavailable` envelope (no hangs), readiness goes false, and later
+    submissions fail fast."""
+    cont = _cont(engine, restart_budget=2, poison_strikes=99)
+    try:
+        faults.arm([
+            faults.FaultRule("decode_launch", "fatal", every=1, times=0)
+        ])
+        r = cont.submit("doomed", max_tokens=6, greedy=True, chat=False)
+        faults.disarm()
+        assert r["status"] == "failed"
+        assert r["error_type"] == "unavailable", r
+        s = cont.stats()["supervisor"]
+        assert s["dead"] is True and s["ready"] is False
+        assert cont.restarts_total == 2  # budget worth of restarts
+        r2 = cont.submit("after death", max_tokens=3, chat=False)
+        assert r2["status"] == "failed"  # fails fast, never hangs
+    finally:
+        cont.close()
+
+
+# -- resource accounting (the loop-death leak regression) ---------------------
+
+def test_paged_pool_zero_leak_after_fatal_loop_death(engine):
+    """Satellite regression: the loop-death path must release paged
+    blocks — pool free == total after an injected fatal crash with no
+    restart budget."""
+    cont = _cont(engine, restart_budget=0, kv_pool_blocks=24,
+                 kv_block_size=8)
+    try:
+        faults.arm([faults.FaultRule("decode_launch", "fatal")])
+        r = cont.submit("leak check", max_tokens=8, greedy=True, chat=False)
+        faults.disarm()
+        assert r["error_type"] == "unavailable"
+        assert cont._alloc.free_blocks == cont._alloc.n_blocks - 1
+        assert cont._alloc.outstanding == 0
+    finally:
+        cont.close()
+
+
+def test_paged_recovery_bit_exact_and_pool_clean(engine, solo):
+    """Paged fleet: a transient crash mid-decode recovers bit-exact and
+    the allocator books return to zero outstanding blocks once requests
+    complete (prefix sharing disabled: engine cfg has no prefix cache)."""
+    cont = _cont(engine, kv_pool_blocks=24, kv_block_size=8)
+    try:
+        faults.arm([faults.FaultRule("fetch", "transient", on_call=2)])
+        r = cont.submit(
+            PROMPTS[0], max_tokens=10, greedy=True, chat=False
+        )
+        faults.disarm()
+        assert r["status"] == "success", r
+        assert r["response"] == solo[PROMPTS[0]]["response"]
+        assert cont.restarts_total == 1
+        deadline = time.time() + 10
+        while time.time() < deadline and cont._alloc.outstanding:
+            time.sleep(0.05)
+        assert cont._alloc.outstanding == 0
+        assert cont._alloc.free_blocks == cont._alloc.n_blocks - 1
+    finally:
+        cont.close()
+
+
+def test_alloc_fault_on_paged_admission_recovers(engine, solo):
+    cont = _cont(engine, kv_pool_blocks=24, kv_block_size=8)
+    try:
+        faults.arm([faults.FaultRule("alloc", "transient", on_call=1)])
+        r = cont.submit(PROMPTS[1], max_tokens=8, greedy=True, chat=False)
+        faults.disarm()
+        assert r["status"] == "success", r
+        solo_ref = engine.generate(
+            PROMPTS[1], max_tokens=8, greedy=True, chat=False
+        )
+        assert r["response"] == solo_ref["response"]
+        assert cont.restarts_total == 1
+        assert cont._alloc.outstanding == 0 or r["tokens_generated"] >= 0
+    finally:
+        cont.close()
+
+
+# -- graceful drain + readiness ----------------------------------------------
+
+def test_continuous_drain_completes_inflight_rejects_new(engine):
+    cont = _cont(engine)
+    try:
+        out = {}
+
+        def bg():
+            out["r"] = cont.submit(
+                PROMPTS[2], max_tokens=16, greedy=True, chat=False
+            )
+
+        t = threading.Thread(target=bg)
+        t.start()
+        time.sleep(0.2)
+        assert cont.ready
+        drained = cont.drain(deadline_s=120)
+        assert drained is True
+        assert not cont.ready
+        t.join(timeout=60)
+        assert out["r"]["status"] == "success"
+        # new work is rejected with the draining envelope
+        r = cont.submit("late arrival", max_tokens=3, chat=False)
+        assert r["status"] == "failed" and r["error_type"] == "draining"
+        # drain duration was recorded
+        fam = engine.metrics.get("dli_drain_duration_seconds")
+        assert fam.labels(component="continuous").count >= 1
+    finally:
+        cont.close()
+
+
+def test_queue_drain(engine):
+    from distributed_llm_inference_tpu.serving.queue import BatchingQueue
+
+    q = BatchingQueue(engine, max_queue=8, max_batch=2, max_wait_ms=1.0)
+    try:
+        out = {}
+
+        def bg():
+            out["r"] = q.submit(
+                PROMPTS[0], max_tokens=8, greedy=True, chat=False
+            )
+
+        t = threading.Thread(target=bg)
+        t.start()
+        time.sleep(0.1)
+        assert q.drain(deadline_s=120) is True
+        t.join(timeout=60)
+        assert out["r"]["status"] == "success"
+        r = q.submit("late", max_tokens=3, chat=False)
+        assert r["status"] == "failed" and r["error_type"] == "draining"
+    finally:
+        q.close()
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=15) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_ready_endpoint_and_health_ready_field(engine):
+    server = InferenceServer(engine, host="127.0.0.1", port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, body, _ = _get(base, "/ready")
+        assert code == 200 and body["ready"] is True
+        code, body, _ = _get(base, "/health")
+        assert code == 200 and body["ready"] is True
+        # liveness/readiness split: draining keeps /health 200 while
+        # /ready goes 503 (LB-friendly) and POSTs bounce with Retry-After
+        server.state.draining = True
+        code, body, hdrs = _get(base, "/ready")
+        assert code == 503 and body["reason"] == "draining"
+        assert hdrs.get("Retry-After")
+        code, body, _ = _get(base, "/health")
+        assert code == 200 and body["ready"] is False
+        assert body["ready_reason"] == "draining"
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": "x"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=15)
+        assert exc_info.value.code == 503
+        assert exc_info.value.headers.get("Retry-After")
+        assert json.loads(exc_info.value.read())["error_type"] == "draining"
+    finally:
+        server.state.draining = False
+        server.shutdown()
+
+
+def test_ready_false_while_scheduler_dead(engine):
+    cont = _cont(engine, restart_budget=0, poison_strikes=99)
+    server = InferenceServer(engine, host="127.0.0.1", port=0,
+                             continuous=cont)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        faults.arm([faults.FaultRule("decode_launch", "fatal")])
+        r = cont.submit("kill it", max_tokens=4, greedy=True, chat=False)
+        faults.disarm()
+        assert r["error_type"] == "unavailable"
+        code, body, _ = _get(base, "/ready")
+        assert code == 503 and body["reason"] == "scheduler_dead"
+    finally:
+        server.shutdown()
+
+
+def test_sigterm_drains_inflight_then_exits(engine):
+    """The SIGTERM handler: readiness flips immediately, the in-flight
+    request finishes, then the HTTP listener closes (clean exit path)."""
+    cont = _cont(engine, chunk_steps=2)
+    server = InferenceServer(engine, host="127.0.0.1", port=0,
+                             continuous=cont, drain_deadline_s=120)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    old_handler = signal.getsignal(signal.SIGTERM)
+    out = {}
+    try:
+        server.install_signal_handlers()
+
+        def bg():
+            out["r"] = DistributedLLMClient(base, max_retries=0).generate(
+                PROMPTS[2], max_tokens=16, greedy=True, chat=False,
+                verbose=False,
+            )
+
+        t = threading.Thread(target=bg)
+        t.start()
+        time.sleep(0.3)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 10
+        while time.time() < deadline and not server.state.draining:
+            time.sleep(0.05)
+        assert server.state.draining
+        # a 503 while draining — unless the drain already finished and
+        # closed the listener (warm fleets finish 16 tokens fast), which
+        # the connection error below proves just as well
+        try:
+            code, _body, _ = _get(base, "/ready")
+            assert code == 503
+        except (urllib.error.URLError, ConnectionError):
+            pass
+        t.join(timeout=120)
+        assert out["r"]["status"] == "success", out["r"]
+        # listener eventually closes: new connections fail
+        deadline = time.time() + 60
+        down = False
+        while time.time() < deadline:
+            try:
+                _get(base, "/health")
+                time.sleep(0.1)
+            except Exception:
+                down = True
+                break
+        assert down, "server never closed after drain"
+    finally:
+        signal.signal(signal.SIGTERM, old_handler)
+        try:
+            server.shutdown()
+        except Exception:
+            pass
+
+
+# -- client retry discipline --------------------------------------------------
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    """Stub server: N rejections (with Retry-After) before success."""
+
+    rejections = 2
+    reject_code = 503
+    seen = 0
+    lock = threading.Lock()
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_POST(self):
+        cls = type(self)
+        with cls.lock:
+            cls.seen += 1
+            n = cls.seen
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        if n <= cls.rejections:
+            body = json.dumps({
+                "error": "Error: draining", "status": "failed",
+                "error_type": "draining",
+            }).encode()
+            self.send_response(cls.reject_code)
+            self.send_header("Retry-After", "0")
+        else:
+            body = json.dumps({
+                "status": "success", "response": "ok", "attempts": n,
+            }).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _stub_server(handler):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+@pytest.mark.parametrize("code", [429, 503])
+def test_client_retries_on_retryable_codes(code):
+    class H(_FlakyHandler):
+        rejections = 2
+        reject_code = code
+        seen = 0
+        lock = threading.Lock()
+
+    httpd, base = _stub_server(H)
+    try:
+        c = DistributedLLMClient(base, max_retries=3, retry_backoff_s=0.01)
+        r = c.generate("hi", verbose=False)
+        assert r["status"] == "success"
+        assert r["attempts"] == 3  # 2 rejections + the success
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_retry_bounded_then_returns_envelope():
+    class H(_FlakyHandler):
+        rejections = 99
+        seen = 0
+        lock = threading.Lock()
+
+    httpd, base = _stub_server(H)
+    try:
+        c = DistributedLLMClient(base, max_retries=2, retry_backoff_s=0.01)
+        r = c.generate("hi", verbose=False)
+        assert r["status"] == "failed"
+        assert r["error_type"] == "draining"
+        assert H.seen == 3  # initial + 2 retries, bounded
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_never_retries_non_retryable():
+    class H(_FlakyHandler):
+        rejections = 99
+        reject_code = 400
+        seen = 0
+        lock = threading.Lock()
+
+    httpd, base = _stub_server(H)
+    try:
+        c = DistributedLLMClient(base, max_retries=3, retry_backoff_s=0.01)
+        r = c.generate("hi", verbose=False)
+        assert r["status"] == "failed"
+        assert H.seen == 1  # a 400 is the caller's bug; retrying is spam
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_honors_retry_after():
+    class H(_FlakyHandler):
+        rejections = 1
+        seen = 0
+        lock = threading.Lock()
+
+        def do_POST(self):
+            cls = type(self)
+            with cls.lock:
+                cls.seen += 1
+                n = cls.seen
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if n == 1:
+                body = b'{"status": "failed", "error_type": "draining"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0.4")
+            else:
+                body = b'{"status": "success", "response": "ok"}'
+                self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd, base = _stub_server(H)
+    try:
+        c = DistributedLLMClient(base, max_retries=2, retry_backoff_s=0.001)
+        t0 = time.time()
+        r = c.generate("hi", verbose=False)
+        elapsed = time.time() - t0
+        assert r["status"] == "success"
+        assert elapsed >= 0.4  # waited the server-directed delay, not 1 ms
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_stream_never_retries_after_partial_output(capsys):
+    """The no-retry-after-partial-output contract: a stream that emits a
+    delta and then fails mid-stream is returned as-is — exactly one
+    request reaches the server."""
+
+    class H(BaseHTTPRequestHandler):
+        seen = 0
+        lock = threading.Lock()
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            cls = type(self)
+            with cls.lock:
+                cls.seen += 1
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.end_headers()
+            self.wfile.write(b'{"delta": "partial", "tokens_so_far": 1}\n')
+            self.wfile.flush()
+            self.wfile.write(
+                b'{"status": "failed", "error": "Error: boom", "done": true}\n'
+            )
+
+    httpd, base = _stub_server(H)
+    try:
+        c = DistributedLLMClient(base, max_retries=5, retry_backoff_s=0.01)
+        r = c.generate_stream("hi")
+        assert r["status"] == "failed"
+        assert H.seen == 1  # partial output happened: NEVER replayed
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_client_stream_retries_pre_stream_rejection():
+    """A 503 BEFORE the stream opens produced zero output — that one is
+    retryable."""
+
+    class H(BaseHTTPRequestHandler):
+        seen = 0
+        lock = threading.Lock()
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            cls = type(self)
+            with cls.lock:
+                cls.seen += 1
+                n = cls.seen
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if n == 1:
+                body = b'{"status": "failed", "error_type": "draining"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0")
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.end_headers()
+            self.wfile.write(b'{"delta": "ok", "tokens_so_far": 1}\n')
+            self.wfile.write(
+                b'{"status": "success", "response": "ok", "done": true}\n'
+            )
+
+    httpd, base = _stub_server(H)
+    try:
+        c = DistributedLLMClient(base, max_retries=2, retry_backoff_s=0.01)
+        r = c.generate_stream("hi")
+        assert r["status"] == "success"
+        assert H.seen == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
