@@ -10,16 +10,14 @@ from __future__ import annotations
 
 import argparse
 import json
-import random
 import time
 import urllib.error
 import urllib.request
 from typing import Any, Optional
 
-# bounded-retry defaults: 429 (shed load) and 503 (draining replica /
-# deadline / restarting scheduler) are the two RETRYABLE answers the
-# serving edge hands out — anything else (400, 500 incl. poison) is not
-RETRY_STATUSES = (429, 503)
+# bounded-retry policy shared with the router tier (utils/retry.py):
+# 429/503 retryable, Retry-After wins over jittered exponential backoff
+from .utils.retry import RETRY_STATUSES, retry_delay
 
 
 class DistributedLLMClient:
@@ -43,15 +41,9 @@ class DistributedLLMClient:
 
     def _retry_delay(self, attempt: int, retry_after) -> float:
         """Server-directed delay when Retry-After parses, else jittered
-        exponential backoff (full jitter on the upper half, so a herd of
-        retrying clients decorrelates instead of re-stampeding)."""
-        if retry_after:
-            try:
-                return max(0.0, float(retry_after))
-            except ValueError:
-                pass  # HTTP-date form / junk: fall through to backoff
-        base = min(8.0, self.retry_backoff_s * (2 ** attempt))
-        return base * (0.5 + random.random() / 2)
+        exponential backoff (utils/retry.py — the one copy of the policy
+        this client shares with the router's upstream calls)."""
+        return retry_delay(attempt, retry_after, base_s=self.retry_backoff_s)
 
     def _post(self, path: str, payload: dict, timeout: Optional[float] = None) -> dict:
         req = urllib.request.Request(

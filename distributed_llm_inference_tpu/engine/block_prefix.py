@@ -49,10 +49,46 @@ snapshot there).
 from __future__ import annotations
 
 import collections
+import hashlib
 import threading
 from typing import Optional
 
 ROOT = -1  # parent id of a prompt's first block
+
+
+def chunk_digests(seq, chunk: int, max_chunks: int = 64) -> list:
+    """Progressive chain digests of `seq`'s head at `chunk` granularity —
+    the affinity-key export the router tier (serving/router.py) uses.
+
+    digest[i] covers chunks 0..i with the SAME parent-chained structure
+    as the index keys above (each digest folds the previous one in, so
+    two sequences share digest[i] iff their first (i+1)*chunk items are
+    identical — a chain, not a bag of chunks). Only FULL chunks digest,
+    mirroring lookup(): a partial tail block is never shared, so it must
+    never pin affinity either.
+
+    `seq` may be token ids (engine-side, chunk = block_size) or
+    bytes/str (the router hashes the raw prompt head — it has no
+    tokenizer, so it works at a byte granularity approximating
+    block_size * bytes-per-token). Digests are hex strings, safe as dict
+    keys and log fields. Collisions are a ROUTING concern only (a wrong
+    replica pick costs a cache-cold prefill, never wrong KV), so a
+    truncated sha1 is plenty.
+    """
+    if chunk < 1:
+        raise ValueError("chunk_digests needs chunk >= 1")
+    if isinstance(seq, str):
+        seq = seq.encode("utf-8")
+    out: list = []
+    h = hashlib.sha1(b"dli-chunk-chain")
+    for i in range(min(len(seq) // chunk, max_chunks)):
+        part = seq[i * chunk : (i + 1) * chunk]
+        if isinstance(part, bytes):
+            h.update(part)
+        else:
+            h.update(",".join(str(int(t)) for t in part).encode())
+        out.append(h.hexdigest()[:20])
+    return out
 
 
 class BlockPrefixIndex:

@@ -62,6 +62,7 @@ import numpy as np
 
 from ..utils import faults
 from ..utils.logging import get_logger
+from ..utils.retry import overload_retry_after
 from ..utils.tracing import Trace
 from . import generate as G
 
@@ -435,10 +436,16 @@ class ContinuousEngine:
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue))
                 self._m_shed.inc()
+                # queue-depth-derived Retry-After hint (serving edge maps
+                # it to the 429's header): ~one second per fleet-width of
+                # backlog ahead of the shed request
                 return {
                     "error": f"Error: request queue full ({self.max_queue})",
                     "status": "failed",
                     "error_type": "overloaded",
+                    "retry_after_s": overload_retry_after(
+                        len(self._queue), self.n_slots
+                    ),
                 }
             self._queue.append(req)
             self._m_depth.set(len(self._queue))

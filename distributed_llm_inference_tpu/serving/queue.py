@@ -35,6 +35,7 @@ from typing import Any, Optional
 
 from ..utils.logging import get_logger
 from ..utils.metrics import DEFAULT_SIZE_BUCKETS
+from ..utils.retry import overload_retry_after
 from ..utils.tracing import Trace
 
 log = get_logger("queue")
@@ -183,10 +184,18 @@ class BatchingQueue:
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue))
                 self._m_shed.inc()
+                # the 429 carries a queue-depth-derived Retry-After hint
+                # (the drain path always sent one; overload must too, so
+                # client and router backoff stays server-directed): one
+                # second per max_batch-sized dispatch cycle the backlog
+                # needs to clear
                 return {
                     "error": f"Error: request queue full ({self.max_queue})",
                     "status": "failed",
                     "error_type": "overloaded",
+                    "retry_after_s": overload_retry_after(
+                        len(self._queue), self.max_batch
+                    ),
                 }
             self._queue.append(pend)
             self._m_depth.set(len(self._queue))

@@ -1,0 +1,1070 @@
+"""Replica router tier: an HTTP front door over N independent engine
+replicas (ROADMAP "cache-aware horizontal scale-out").
+
+Everything below a replica is fault-contained and observable (PR 5:
+supervised scheduler, poison quarantine, SIGTERM drain, liveness/
+readiness split) — this is the missing "millions of users" layer that
+makes replica death an operational non-event instead of a deployment
+outage. Four jobs:
+
+  * PREFIX-AFFINITY ROUTING (Orca-style load balancing + vLLM-style
+    cache awareness): the prompt head is hashed at block-prefix chunk
+    granularity (engine/block_prefix.chunk_digests — the same chained
+    structure as the refcounted block index's keys) and a bounded
+    router-side residency map remembers which replica last served each
+    chunk chain. Shared-prefix traffic lands where its KV blocks are
+    already resident; everything else falls back to least-outstanding.
+    A wrong guess costs one cache-cold prefill, never wrong output, so
+    the map needs no invalidation protocol.
+  * HEALTH-DRIVEN EJECTION: active `GET /ready` probes plus passive
+    circuit breaking on consecutive connect/5xx failures. An ejected
+    replica receives no traffic until a successful probe moves it to
+    HALF_OPEN (trial traffic only when no READY replica remains), and a
+    further success readmits it.
+  * FAILOVER: a non-streamed request that hits a dead or draining
+    replica is transparently re-dispatched to a healthy one — safe
+    because zero bytes of the reply have reached the client, the same
+    discipline client.py applies to its own retries. Streamed requests
+    fail over ONLY on pre-stream rejection; after the first forwarded
+    byte the stream is bound to its replica. Retry-After from an
+    upstream 429/503 is honored as a per-replica cool-down, and when no
+    candidate remains it propagates to the client. X-Request-Id crosses
+    the hop both ways; a `router` span is folded into the envelope's
+    `timings`.
+  * DRAIN-AWARE ROLLING RESTARTS: `POST /admin/rolling-restart` cycles
+    ROUTER-SPAWNED replicas one at a time through the PR-5 drain path
+    (SIGTERM -> readiness flips -> in-flight work finishes -> clean
+    exit), respawns, and waits for `/ready` before touching the next —
+    a config/weight rollout never drops a request.
+
+The router is strictly host-side glue: it never imports jax, never
+touches an engine, and stays decode-UNREACHABLE in the analysis call
+graph (pinned in tests/test_analysis.py, like utils/faults.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import http.client
+import json
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..engine.block_prefix import chunk_digests
+from ..utils.logging import get_logger
+from ..utils.metrics import MetricsRegistry
+from ..utils.retry import parse_retry_after
+from ..utils.tracing import new_request_id, sanitize_request_id
+
+log = get_logger("router")
+
+__version__ = "tpu_pipeline_router_v1"
+
+# replica ejection state machine (ARCHITECTURE.md "Router tier"):
+#   READY --(eject_threshold consecutive connect/5xx failures,
+#            probe or proxied)--> EJECTED
+#   EJECTED --(successful /ready probe)--> HALF_OPEN
+#   HALF_OPEN --(successful probe OR successful trial request)--> READY
+#   HALF_OPEN --(any failure)--> EJECTED
+#   any --(rolling restart picks it)--> DRAINING --(respawn + /ready)-->
+#   READY
+READY = "ready"
+EJECTED = "ejected"
+HALF_OPEN = "half_open"
+DRAINING = "draining"
+
+# Retry-After (seconds) when the router itself must reject: no healthy
+# replica, or rolling-restart races. Matches serving/server.py's default.
+RETRY_AFTER_S = 2
+
+# default byte granularity of the affinity hash: ~a 16-token KV block of
+# typical English text. Must divide consistently across requests, not
+# match the replica's tokenizer exactly — a mismatch only shortens the
+# usable chain, it cannot route to wrong output.
+AFFINITY_CHUNK_BYTES = 64
+AFFINITY_MAX_CHUNKS = 32
+
+_FORWARD_ROUTES = ("/generate", "/v1/completions", "/v1/chat/completions")
+
+_KNOWN_ROUTES = frozenset((
+    "/", "/health", "/ready", "/stats", "/metrics", "/v1/models",
+    "/admin/rolling-restart", *_FORWARD_ROUTES,
+))
+
+
+def _route_label(path: str) -> str:
+    return path if path in _KNOWN_ROUTES else "other"
+
+
+class Replica:
+    """One upstream engine server, plus the router's view of its health."""
+
+    def __init__(self, rid: str, url: str, proc=None, spawn_argv=None,
+                 spawn_env=None):
+        self.rid = rid
+        self.url = url.rstrip("/")
+        # router-spawned replicas carry their subprocess + respawn recipe
+        # (rolling restarts need both); URL-joined replicas have neither
+        self.proc = proc
+        self.spawn_argv = spawn_argv
+        self.spawn_env = spawn_env
+        self.state = READY  # optimistic; the first probe corrects it
+        self.consecutive_failures = 0
+        self.outstanding = 0
+        # Retry-After honored as a dispatch cool-down (monotonic deadline)
+        self.cooldown_until = 0.0
+        self.lock = threading.Lock()
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            return {
+                "url": self.url,
+                "state": self.state,
+                "outstanding": self.outstanding,
+                "consecutive_failures": self.consecutive_failures,
+                "spawned": self.proc is not None,
+            }
+
+
+class Router:
+    """Routing + health logic, independent of the HTTP surface (the
+    handler and the CLI both drive this object; tests drive it directly).
+
+    Replica state transitions happen under each replica's lock, so the
+    prober thread, handler threads, and the rolling-restart thread can
+    all drive the ejection state machine concurrently."""
+
+    def __init__(self, replicas, eject_threshold: int = 3,
+                 probe_interval_s: float = 2.0, probe_timeout_s: float = 5.0,
+                 affinity_chunk: int = AFFINITY_CHUNK_BYTES,
+                 affinity_entries: int = 4096,
+                 request_timeout_s: float = 200.0,
+                 drain_deadline_s: float = 60.0,
+                 failover_attempts: Optional[int] = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.replicas = list(replicas)
+        self._by_id = {r.rid: r for r in self.replicas}
+        self.eject_threshold = int(eject_threshold)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.affinity_chunk = int(affinity_chunk)
+        self.affinity_entries = int(affinity_entries)
+        self.request_timeout_s = float(request_timeout_s)
+        self.drain_deadline_s = float(drain_deadline_s)
+        # each request tries at most every replica once by default
+        self.failover_attempts = (
+            int(failover_attempts) if failover_attempts
+            else max(2, len(self.replicas))
+        )
+        # chunk-chain digest -> replica id, LRU-bounded. One entry per
+        # digest DEPTH, so a long shared prefix costs several entries —
+        # that is the point: a deeper match wins routing.
+        self._residency: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        self._res_lock = threading.Lock()
+        self.rolling: dict = {"active": False, "done": [], "current": None,
+                              "error": None}
+        self._roll_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "dli_router_requests_total",
+            "requests proxied per replica by upstream outcome",
+            ("replica", "code"),
+        )
+        self._m_failovers = self.metrics.counter(
+            "dli_router_failovers_total",
+            "requests transparently re-dispatched off a dead/draining/"
+            "overloaded replica", ("replica",),
+        )
+        self._m_ejections = self.metrics.counter(
+            "dli_router_ejections_total",
+            "replicas ejected by the circuit breaker", ("replica",),
+        )
+        self._m_readmissions = self.metrics.counter(
+            "dli_router_readmissions_total",
+            "ejected replicas readmitted after half-open success",
+            ("replica",),
+        )
+        self._m_outstanding = self.metrics.gauge(
+            "dli_router_outstanding",
+            "requests in flight per replica", ("replica",),
+        )
+        self._m_ready = self.metrics.gauge(
+            "dli_router_replica_ready",
+            "1 = replica READY for traffic, 0 = ejected/half-open/draining",
+            ("replica",),
+        )
+        self._m_probe = self.metrics.histogram(
+            "dli_router_probe_seconds",
+            "active /ready probe latency", ("replica",),
+        )
+        self._m_affinity = self.metrics.counter(
+            "dli_router_affinity_total",
+            "routing decisions by affinity outcome (hit = residency map "
+            "named a dispatchable replica)", ("result",),
+        )
+        for r in self.replicas:
+            self._m_ready.labels(replica=r.rid).set(1.0)
+            self._m_outstanding.labels(replica=r.rid).set(0.0)
+
+    # -- health / ejection ---------------------------------------------------
+    def _set_ready_gauge(self, rep: Replica):
+        self._m_ready.labels(replica=rep.rid).set(
+            1.0 if rep.state == READY else 0.0
+        )
+
+    def note_failure(self, rep: Replica, why: str = ""):
+        """One connect/5xx failure (probe or proxied). Ejects at the
+        threshold; a HALF_OPEN replica re-ejects immediately (its trial
+        failed — the breaker reopens)."""
+        with rep.lock:
+            if rep.state == DRAINING:
+                return  # rolling restart owns this replica's lifecycle
+            rep.consecutive_failures += 1
+            eject = (
+                rep.state == HALF_OPEN
+                or (rep.state == READY
+                    and rep.consecutive_failures >= self.eject_threshold)
+            )
+            if eject and rep.state != EJECTED:
+                rep.state = EJECTED
+                self._m_ejections.labels(replica=rep.rid).inc()
+                log.warning("replica_ejected", replica=rep.rid,
+                            failures=rep.consecutive_failures, why=why)
+            self._set_ready_gauge(rep)
+
+    def note_success(self, rep: Replica):
+        """A successful probe or proxied request: reset the breaker; a
+        HALF_OPEN replica is readmitted."""
+        with rep.lock:
+            rep.consecutive_failures = 0
+            if rep.state == HALF_OPEN:
+                rep.state = READY
+                self._m_readmissions.labels(replica=rep.rid).inc()
+                log.info("replica_readmitted", replica=rep.rid)
+            self._set_ready_gauge(rep)
+
+    def probe_once(self):
+        """One active probe sweep: GET /ready on every replica the router
+        currently owns traffic for. EJECTED + success -> HALF_OPEN;
+        HALF_OPEN + success -> READY (readmission)."""
+        for rep in self.replicas:
+            if rep.state == DRAINING:
+                continue
+            t0 = time.perf_counter()
+            ok = False
+            try:
+                req = urllib.request.Request(rep.url + "/ready")
+                with urllib.request.urlopen(
+                    req, timeout=self.probe_timeout_s
+                ) as resp:
+                    ok = resp.status == 200
+            except (urllib.error.URLError, OSError, ValueError):
+                ok = False  # connect failure or a 503 not-ready answer
+            self._m_probe.labels(replica=rep.rid).observe(
+                time.perf_counter() - t0
+            )
+            if not ok:
+                self.note_failure(rep, why="probe")
+                continue
+            stepped = False
+            with rep.lock:
+                if rep.state == EJECTED:
+                    # one successful probe only OPENS the breaker halfway;
+                    # readmission needs a further success (next sweep, or
+                    # a successful trial request)
+                    rep.state = HALF_OPEN
+                    rep.consecutive_failures = 0
+                    stepped = True
+                    log.info("replica_half_open", replica=rep.rid)
+                    self._set_ready_gauge(rep)
+            # READY/HALF_OPEN probe success flows through the same seam
+            # as proxied successes (HALF_OPEN -> READY readmission)
+            if not stepped and rep.state in (READY, HALF_OPEN):
+                self.note_success(rep)
+
+    def start_prober(self):
+        def _loop():
+            while not self._closed.wait(self.probe_interval_s):
+                try:
+                    self.probe_once()
+                except Exception as e:  # noqa: BLE001 - prober must survive
+                    log.error("probe_sweep_failed", error=str(e))
+
+        self._probe_thread = threading.Thread(
+            target=_loop, daemon=True, name="router-prober"
+        )
+        self._probe_thread.start()
+
+    def close(self):
+        self._closed.set()
+
+    # -- routing -------------------------------------------------------------
+    def _candidates(self, exclude) -> list:
+        now = time.monotonic()
+        ready = [
+            r for r in self.replicas
+            if r.rid not in exclude and r.state == READY
+            and r.cooldown_until <= now
+        ]
+        if ready:
+            return ready
+        # no READY replica: HALF_OPEN trial traffic is better than a
+        # hard 503 — a success readmits, a failure re-ejects
+        return [
+            r for r in self.replicas
+            if r.rid not in exclude and r.state == HALF_OPEN
+            and r.cooldown_until <= now
+        ]
+
+    def pick(self, affinity_key: str, exclude=()) -> tuple:
+        """(replica, digests) for one dispatch attempt, or (None, digests)
+        when nothing is dispatchable. Deepest-residency match wins;
+        least-outstanding breaks the miss case."""
+        digests = (
+            chunk_digests(affinity_key, self.affinity_chunk,
+                          AFFINITY_MAX_CHUNKS)
+            if affinity_key and self.affinity_chunk >= 1 else []
+        )
+        cands = self._candidates(exclude)
+        if not cands:
+            return None, digests
+        by_id = {r.rid: r for r in cands}
+        with self._res_lock:
+            for d in reversed(digests):
+                rep = by_id.get(self._residency.get(d))
+                if rep is not None:
+                    self._m_affinity.labels(result="hit").inc()
+                    return rep, digests
+        self._m_affinity.labels(result="miss").inc()
+        return min(cands, key=lambda r: (r.outstanding, r.rid)), digests
+
+    def record_residency(self, digests, rid: str):
+        """Remember that `rid` now holds the KV blocks for this chain
+        (called with the replica that ACTUALLY served, so failovers move
+        the residency with the traffic)."""
+        if not digests:
+            return
+        with self._res_lock:
+            for d in digests:
+                self._residency[d] = rid
+                self._residency.move_to_end(d)
+            while len(self._residency) > self.affinity_entries:
+                self._residency.popitem(last=False)
+
+    def residency_entries(self) -> int:
+        with self._res_lock:
+            return len(self._residency)
+
+    # -- upstream calls ------------------------------------------------------
+    def _begin(self, rep: Replica):
+        with rep.lock:
+            rep.outstanding += 1
+            self._m_outstanding.labels(replica=rep.rid).set(rep.outstanding)
+
+    def _end(self, rep: Replica):
+        with rep.lock:
+            rep.outstanding -= 1
+            self._m_outstanding.labels(replica=rep.rid).set(rep.outstanding)
+
+    def _proxy(self, rep: Replica, path: str, body: bytes, rid: str,
+               timeout: Optional[float] = None):
+        """One POST to one replica. Returns (status, body_bytes, headers);
+        HTTP error statuses come back as values, connect-level failures
+        raise (urllib.error.URLError / OSError)."""
+        req = urllib.request.Request(
+            rep.url + path, data=body,
+            headers={"Content-Type": "application/json", "X-Request-Id": rid},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout or self.request_timeout_s
+            ) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    def dispatch(self, path: str, body: bytes, affinity_key: str,
+                 rid: str) -> tuple:
+        """Route one NON-STREAMED request with transparent failover.
+
+        Returns (replica_or_None, status, body_bytes, headers, attempts).
+        Failover re-dispatches on: connect-level failures (dead replica,
+        kill -9 mid-request — zero reply bytes reached the client, so a
+        fresh greedy run elsewhere is indistinguishable), 503 (draining /
+        restart-looping), and 429 (that replica is full; another may not
+        be). It does NOT re-dispatch 4xx (the request is the problem) or
+        500 (a request-shaped server fault — poison would just take down
+        a second fleet). Upstream Retry-After becomes a per-replica
+        cool-down, honored by the next pick()."""
+        tried: set = set()
+        prev: Optional[Replica] = None
+        last = (503, json.dumps({
+            "error": "Error: no healthy replica", "status": "failed",
+            "error_type": "unavailable",
+        }).encode(), {"Retry-After": str(RETRY_AFTER_S)})
+        for attempt in range(self.failover_attempts):
+            rep, digests = self.pick(affinity_key, exclude=tried)
+            if rep is None:
+                break
+            tried.add(rep.rid)
+            if prev is not None:
+                self._m_failovers.labels(replica=prev.rid).inc()
+                log.info("failover", request_id=rid,
+                         from_replica=prev.rid, to_replica=rep.rid)
+            self._begin(rep)
+            try:
+                status, rbody, headers = self._proxy(rep, path, body, rid)
+            # HTTPException covers IncompleteRead/RemoteDisconnected — a
+            # replica kill -9'd MID-RESPONSE surfaces as one of these,
+            # and it is exactly the failover case (zero reply bytes have
+            # reached the client)
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException) as e:
+                self._m_requests.labels(
+                    replica=rep.rid, code="connect_error"
+                ).inc()
+                self.note_failure(rep, why=f"proxy: {e}")
+                prev = rep
+                continue
+            finally:
+                self._end(rep)
+            self._m_requests.labels(replica=rep.rid, code=str(status)).inc()
+            if status in (429, 503):
+                ra = parse_retry_after(headers.get("Retry-After"))
+                with rep.lock:
+                    rep.cooldown_until = time.monotonic() + (
+                        ra if ra is not None else float(RETRY_AFTER_S)
+                    )
+                if status == 503:
+                    # draining / dead scheduler: a breaker strike too
+                    self.note_failure(rep, why="503")
+                prev = rep
+                last = (status, rbody, headers)
+                continue
+            if status >= 500:
+                self.note_failure(rep, why=str(status))
+                return rep, status, rbody, headers, attempt + 1
+            self.note_success(rep)
+            self.record_residency(digests, rep.rid)
+            return rep, status, rbody, headers, attempt + 1
+        return None, last[0], last[1], last[2], len(tried)
+
+    # -- aggregate views -----------------------------------------------------
+    def replica_health(self, rep: Replica) -> dict:
+        entry = rep.snapshot()
+        try:
+            with urllib.request.urlopen(
+                rep.url + "/health", timeout=self.probe_timeout_s
+            ) as resp:
+                entry["health"] = json.loads(resp.read())
+                entry["reachable"] = True
+        except (urllib.error.URLError, OSError, ValueError):
+            entry["reachable"] = False
+        return entry
+
+    def health(self) -> dict:
+        replicas = {r.rid: self.replica_health(r) for r in self.replicas}
+        n_ready = sum(r.state == READY for r in self.replicas)
+        status = (
+            "healthy" if n_ready == len(self.replicas)
+            else ("degraded" if n_ready else "unhealthy")
+        )
+        with self._roll_lock:
+            rolling = dict(self.rolling)
+        return {
+            "status": status,
+            "role": "router",
+            "version": __version__,
+            "replicas_total": len(self.replicas),
+            "replicas_ready": n_ready,
+            "replicas": replicas,
+            "rolling_restart": rolling,
+        }
+
+    def ready(self) -> bool:
+        return any(r.state == READY for r in self.replicas)
+
+    def stats(self) -> dict:
+        with self._roll_lock:
+            rolling = dict(self.rolling)
+        return {
+            "replicas": {r.rid: r.snapshot() for r in self.replicas},
+            "residency_entries": self.residency_entries(),
+            "rolling_restart": rolling,
+        }
+
+    # -- rolling restart -----------------------------------------------------
+    def start_rolling_restart(self) -> dict:
+        """Kick the rolling restart on a background thread. Returns a
+        rejection dict ({"error": ...}) or the initial progress dict."""
+        not_spawned = [r.rid for r in self.replicas if r.proc is None]
+        if not_spawned:
+            return {
+                "error": "rolling restart requires router-spawned replicas "
+                         f"(no subprocess for {not_spawned}); restart "
+                         "URL-joined replicas out of band — the router's "
+                         "probes handle ejection/readmission either way",
+            }
+        with self._roll_lock:
+            if self.rolling["active"]:
+                return {"error": "rolling restart already in progress"}
+            self.rolling = {"active": True, "done": [], "current": None,
+                            "error": None}
+        threading.Thread(
+            target=self._rolling_restart, daemon=True, name="rolling-restart"
+        ).start()
+        with self._roll_lock:
+            return dict(self.rolling)
+
+    def _rolling_restart(self):
+        try:
+            for rep in self.replicas:
+                with self._roll_lock:
+                    self.rolling["current"] = rep.rid
+                self._restart_one(rep)
+                with self._roll_lock:
+                    self.rolling["done"].append(rep.rid)
+            log.info("rolling_restart_done",
+                     replicas=[r.rid for r in self.replicas])
+        except Exception as e:  # noqa: BLE001 - progress dict carries it
+            log.error("rolling_restart_failed", error=str(e))
+            with self._roll_lock:
+                self.rolling["error"] = str(e)
+        finally:
+            with self._roll_lock:
+                self.rolling["active"] = False
+                self.rolling["current"] = None
+
+    def _restart_one(self, rep: Replica):
+        """One replica through the PR-5 drain path: stop routing to it,
+        SIGTERM (its server flips readiness, finishes in-flight work,
+        exits cleanly), respawn, wait for /ready, readmit."""
+        with rep.lock:
+            rep.state = DRAINING
+            self._set_ready_gauge(rep)
+        log.info("rolling_restart_draining", replica=rep.rid)
+        rep.proc.send_signal(signal.SIGTERM)
+        try:
+            rep.proc.wait(timeout=self.drain_deadline_s)
+        except subprocess.TimeoutExpired:
+            # past the drain deadline the replica has broken its own
+            # contract; reap it so the port frees for the respawn
+            rep.proc.kill()
+            rep.proc.wait(timeout=10)
+        rep.proc = subprocess.Popen(
+            rep.spawn_argv, env=rep.spawn_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        self._wait_replica_ready(rep)
+        with rep.lock:
+            rep.state = READY
+            rep.consecutive_failures = 0
+            rep.cooldown_until = 0.0
+            self._set_ready_gauge(rep)
+        log.info("rolling_restart_replica_ready", replica=rep.rid)
+
+    def _wait_replica_ready(self, rep: Replica, deadline_s: float = 300.0):
+        t0 = time.time()
+        while time.time() - t0 < deadline_s:
+            if rep.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{rep.rid} exited rc={rep.proc.returncode} during "
+                    "rolling restart"
+                )
+            try:
+                with urllib.request.urlopen(
+                    rep.url + "/ready", timeout=self.probe_timeout_s
+                ) as resp:
+                    if resp.status == 200:
+                        return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.2)
+        raise RuntimeError(f"{rep.rid} never became ready after respawn")
+
+
+def _affinity_key(data: dict) -> str:
+    """The prompt-head text the residency hash keys on: `prompt` on
+    /generate and /v1/completions, the rendered message contents on chat
+    (the replica-side chat template is deterministic, so equal message
+    lists produce equal prompts — hashing the raw contents keys the same
+    equivalence classes)."""
+    p = data.get("prompt")
+    if isinstance(p, str) and p:
+        return p
+    prompts = data.get("prompts")
+    if isinstance(prompts, list) and prompts and isinstance(prompts[0], str):
+        return prompts[0]
+    msgs = data.get("messages")
+    if isinstance(msgs, list):
+        return "\x1e".join(
+            str(m.get("role", "")) + ":" + str(m.get("content", ""))
+            for m in msgs if isinstance(m, dict)
+        )
+    return ""
+
+
+def make_router_handler(router: Router):
+    http_requests = router.metrics.counter(
+        "dli_http_requests_total", "HTTP responses at the router edge",
+        ("route", "method", "status"),
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        _rid: Optional[str] = None
+
+        def _count(self, code: int):
+            http_requests.labels(
+                route=_route_label(self.path.split("?")[0].rstrip("/") or "/"),
+                method=self.command, status=str(code),
+            ).inc()
+
+        def _send(self, code: int, payload, content_type="application/json",
+                  headers=None):
+            body = (
+                payload if isinstance(payload, bytes)
+                else payload.encode() if isinstance(payload, str)
+                else json.dumps(payload).encode()
+            )
+            self._count(code)
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            if self._rid:
+                self.send_header("X-Request-Id", self._rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        # -- GET surface -----------------------------------------------------
+        def do_GET(self):
+            path = self.path.split("?")[0].rstrip("/") or "/"
+            if path == "/":
+                h = router.stats()
+                rows = "".join(
+                    f"<tr><td>{rid}</td><td>{s['url']}</td>"
+                    f"<td>{s['state']}</td><td>{s['outstanding']}</td></tr>"
+                    for rid, s in h["replicas"].items()
+                )
+                self._send(
+                    200,
+                    "<html><body style=\"font-family: monospace\">"
+                    "<h1>distributed_llm_inference_tpu — router</h1>"
+                    "<table border=\"1\" cellpadding=\"4\">"
+                    "<tr><th>replica</th><th>url</th><th>state</th>"
+                    f"<th>outstanding</th></tr>{rows}</table>"
+                    "<p>POST /generate | /v1/completions | "
+                    "/v1/chat/completions | /admin/rolling-restart</p>"
+                    "</body></html>",
+                    content_type="text/html",
+                )
+            elif path == "/health":
+                self._send(200, router.health())
+            elif path == "/ready":
+                if router.ready():
+                    self._send(200, {"ready": True})
+                else:
+                    self._send(
+                        503, {"ready": False, "reason": "no_ready_replica"},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+            elif path == "/stats":
+                self._send(200, router.stats())
+            elif path == "/metrics":
+                self._send(
+                    200, router.metrics.render(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+            elif path == "/v1/models":
+                # proxy to any dispatchable replica (model list is
+                # identical across a homogeneous fleet)
+                rep, _ = router.pick("")
+                if rep is None:
+                    self._send(
+                        503, {"error": "no healthy replica"},
+                        headers={"Retry-After": str(RETRY_AFTER_S)},
+                    )
+                    return
+                try:
+                    with urllib.request.urlopen(
+                        rep.url + path, timeout=router.probe_timeout_s
+                    ) as resp:
+                        self._send(resp.status, resp.read())
+                except (urllib.error.URLError, OSError) as e:
+                    router.note_failure(rep, why=f"models: {e}")
+                    self._send(502, {"error": f"upstream failed: {e}"})
+            else:
+                self._send(404, {"error": f"no route {path}"})
+
+        # -- POST surface ----------------------------------------------------
+        def do_POST(self):
+            path = self.path.split("?")[0].rstrip("/")
+            self._rid = (
+                sanitize_request_id(self.headers.get("X-Request-Id"))
+                or new_request_id()
+            )
+            if path == "/admin/rolling-restart":
+                res = router.start_rolling_restart()
+                self._send(400 if res.get("error") else 202, res)
+                return
+            if path not in _FORWARD_ROUTES:
+                self._send(404, {"error": f"no route {path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) or b"{}"
+                data = json.loads(body)
+                if not isinstance(data, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, json.JSONDecodeError):
+                self._send(400, {"error": "invalid JSON body"})
+                return
+            if data.get("stream") is True or data.get("stream") == "true":
+                self._stream(path, body, _affinity_key(data))
+                return
+            t0 = time.perf_counter()
+            rep, status, rbody, headers, attempts = router.dispatch(
+                path, body, _affinity_key(data), self._rid
+            )
+            fwd = {
+                k: v for k, v in headers.items() if k == "Retry-After"
+            }
+            try:
+                payload = json.loads(rbody)
+            except (ValueError, json.JSONDecodeError):
+                self._send(status, rbody, headers=fwd)
+                return
+            if isinstance(payload, dict):
+                # fold the router hop into the envelope's contiguous span
+                # model: router_s = wall time here minus the replica's own
+                # total, so the spans still sum to ≈ end-to-end
+                elapsed = time.perf_counter() - t0
+                tm = payload.get("timings")
+                if isinstance(tm, dict):
+                    tm["router_s"] = round(
+                        max(0.0, elapsed - float(tm.get("total_s", 0.0))), 6
+                    )
+                    tm["total_s"] = round(elapsed, 6)
+                if rep is not None:
+                    payload["replica"] = rep.rid
+                if attempts > 1:
+                    payload["router_attempts"] = attempts
+            self._send(status, payload, headers=fwd)
+
+        def _stream(self, path: str, body: bytes, affinity_key: str):
+            """Streamed requests: failover ONLY before the upstream
+            stream opens; after the first forwarded byte the request is
+            bound to its replica (re-dispatching would replay partial
+            output — client.py's own stream-retry rule)."""
+            tried: set = set()
+            prev = None
+            for _ in range(router.failover_attempts):
+                rep, digests = router.pick(affinity_key, exclude=tried)
+                if rep is None:
+                    break
+                tried.add(rep.rid)
+                if prev is not None:
+                    router._m_failovers.labels(replica=prev.rid).inc()
+                req = urllib.request.Request(
+                    rep.url + path, data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Request-Id": self._rid},
+                    method="POST",
+                )
+                router._begin(rep)
+                try:
+                    upstream = urllib.request.urlopen(
+                        req, timeout=router.request_timeout_s
+                    )
+                except urllib.error.HTTPError as e:
+                    router._end(rep)
+                    router._m_requests.labels(
+                        replica=rep.rid, code=str(e.code)
+                    ).inc()
+                    if e.code in (429, 503):
+                        ra = parse_retry_after(e.headers.get("Retry-After"))
+                        with rep.lock:
+                            rep.cooldown_until = time.monotonic() + (
+                                ra if ra is not None else float(RETRY_AFTER_S)
+                            )
+                        if e.code == 503:
+                            router.note_failure(rep, why="503")
+                        prev = rep
+                        continue  # pre-stream rejection: zero output sent
+                    self._send(
+                        e.code, e.read(),
+                        headers={
+                            k: v for k, v in e.headers.items()
+                            if k == "Retry-After"
+                        },
+                    )
+                    return
+                except (urllib.error.URLError, OSError,
+                        http.client.HTTPException) as e:
+                    router._end(rep)
+                    router._m_requests.labels(
+                        replica=rep.rid, code="connect_error"
+                    ).inc()
+                    router.note_failure(rep, why=f"stream: {e}")
+                    prev = rep
+                    continue  # connect failure: stream never opened
+                try:
+                    router._m_requests.labels(
+                        replica=rep.rid, code=str(upstream.status)
+                    ).inc()
+                    self._count(upstream.status)
+                    self.send_response(upstream.status)
+                    self.send_header(
+                        "Content-Type",
+                        upstream.headers.get(
+                            "Content-Type", "application/x-ndjson"
+                        ),
+                    )
+                    if self._rid:
+                        self.send_header("X-Request-Id", self._rid)
+                    self.end_headers()
+                    router.record_residency(digests, rep.rid)
+                    while True:
+                        try:
+                            chunk = upstream.read(4096)
+                        except (urllib.error.URLError, OSError,
+                                http.client.HTTPException) as e:
+                            # mid-stream upstream death: partial output
+                            # is already with the client — NEVER
+                            # re-dispatched; the truncated stream is the
+                            # client's failure signal
+                            router.note_failure(rep, why=f"mid_stream: {e}")
+                            return
+                        if not chunk:
+                            break
+                        try:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError):
+                            return  # client went away, replica innocent
+                    router.note_success(rep)
+                finally:
+                    router._end(rep)
+                    upstream.close()
+                return
+            self._send(
+                503,
+                {"error": "Error: no healthy replica", "status": "failed",
+                 "error_type": "unavailable"},
+                headers={"Retry-After": str(RETRY_AFTER_S)},
+            )
+
+    return Handler
+
+
+class RouterServer:
+    """Owns the HTTP listener + the Router; start()/shutdown() for tests,
+    serve_forever() for the CLI."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0",
+                 port: int = 8000):
+        self.router = router
+        self.httpd = ThreadingHTTPServer(
+            (host, port), make_router_handler(router)
+        )
+        self.port = self.httpd.server_address[1]
+
+    def start(self) -> threading.Thread:
+        self.router.start_prober()
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def serve_forever(self):
+        from ..utils.logging import configure
+
+        configure()
+        self.router.start_prober()
+        self.install_signal_handlers()
+        log.info(
+            "router_serving", port=self.port,
+            replicas=[r.url for r in self.router.replicas],
+        )
+        print(
+            f"🔀 router on :{self.port} over "
+            f"{len(self.router.replicas)} replicas — /generate /health "
+            f"/ready /metrics /admin/rolling-restart"
+        )
+        self.httpd.serve_forever()
+
+    def install_signal_handlers(self):
+        def _on_term(signum, frame):
+            threading.Thread(target=self.shutdown, daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _on_term)
+
+    def shutdown(self):
+        self.router.close()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        # forward the shutdown to router-spawned replicas (their own
+        # SIGTERM handler runs the PR-5 graceful drain)
+        for rep in self.router.replicas:
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.send_signal(signal.SIGTERM)
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def spawn_replicas(n: int, spawn_args, host: str = "127.0.0.1",
+                   ready_deadline_s: float = 300.0, env=None) -> list:
+    """Spawn N engine servers as subprocesses on free ports and wait for
+    every /ready. Each replica remembers its argv/env so rolling restarts
+    can respawn it identically."""
+    import os
+
+    replicas = []
+    for i in range(n):
+        port = _free_port(host)
+        argv = [
+            sys.executable, "-m",
+            "distributed_llm_inference_tpu.serving.server",
+            "--host", host, "--port", str(port), *spawn_args,
+        ]
+        spawn_env = dict(os.environ if env is None else env)
+        proc = subprocess.Popen(
+            argv, env=spawn_env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+        )
+        replicas.append(Replica(
+            f"r{i}", f"http://{host}:{port}", proc=proc, spawn_argv=argv,
+            spawn_env=spawn_env,
+        ))
+    deadline = time.time() + ready_deadline_s
+    for rep in replicas:
+        while True:
+            if rep.proc.poll() is not None:
+                raise SystemExit(
+                    f"replica {rep.rid} exited rc={rep.proc.returncode} "
+                    "during startup"
+                )
+            try:
+                with urllib.request.urlopen(
+                    rep.url + "/ready", timeout=5
+                ) as resp:
+                    if resp.status == 200:
+                        break
+            except (urllib.error.URLError, OSError):
+                pass
+            if time.time() > deadline:
+                raise SystemExit(f"replica {rep.rid} never became ready")
+            time.sleep(0.2)
+        print(f"✅ replica {rep.rid} ready at {rep.url}")
+    return replicas
+
+
+def main(argv: Optional[list] = None):
+    ap = argparse.ArgumentParser(
+        description="distributed_llm_inference_tpu replica router"
+    )
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument(
+        "--replicas", default=None, metavar="URL,URL",
+        help="join already-running engine servers (comma-separated base "
+             "URLs). Rolling restarts need --spawn replicas; URL-joined "
+             "ones are probed/ejected/readmitted but restarted out of band",
+    )
+    ap.add_argument(
+        "--spawn", type=int, default=0, metavar="N",
+        help="spawn N engine-server replicas as subprocesses on free "
+             "ports (each gets --spawn-args), wait for every /ready, "
+             "and SIGTERM them on router shutdown",
+    )
+    ap.add_argument(
+        "--spawn-args", default="", metavar="ARGS",
+        help="argument string passed to every spawned replica's server "
+             "CLI, e.g. \"--model tinyllama-1.1b --continuous 4 --warmup\"",
+    )
+    ap.add_argument("--probe-interval", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="active /ready probe period per replica")
+    ap.add_argument("--probe-timeout", type=float, default=5.0)
+    ap.add_argument(
+        "--eject-threshold", type=int, default=3, metavar="N",
+        help="consecutive connect/5xx failures (probe or proxied) before "
+             "a replica is ejected; readmission is via half-open probes",
+    )
+    ap.add_argument(
+        "--affinity-chunk", type=int, default=AFFINITY_CHUNK_BYTES,
+        metavar="BYTES",
+        help="prompt-head hash granularity for prefix-affinity routing "
+             "(~ one KV block of text; 0 disables affinity)",
+    )
+    ap.add_argument("--affinity-entries", type=int, default=4096,
+                    help="residency-map LRU bound (chunk-chain digests)")
+    ap.add_argument("--request-timeout", type=float, default=200.0)
+    ap.add_argument(
+        "--drain-deadline", type=float, default=60.0, metavar="SECONDS",
+        help="per-replica drain budget during a rolling restart (SIGTERM "
+             "-> graceful drain; past this the replica is killed)",
+    )
+    ap.add_argument(
+        "--failover-attempts", type=int, default=0, metavar="N",
+        help="max replicas one request may try (0 = one try per replica)",
+    )
+    args = ap.parse_args(argv)
+
+    replicas = []
+    if args.spawn > 0:
+        replicas.extend(
+            spawn_replicas(args.spawn, shlex.split(args.spawn_args))
+        )
+    if args.replicas:
+        for i, url in enumerate(u for u in args.replicas.split(",") if u):
+            replicas.append(Replica(f"u{i}", url.strip()))
+    if not replicas:
+        raise SystemExit("router needs --spawn N and/or --replicas URL,URL")
+    router = Router(
+        replicas,
+        eject_threshold=args.eject_threshold,
+        probe_interval_s=args.probe_interval,
+        probe_timeout_s=args.probe_timeout,
+        affinity_chunk=args.affinity_chunk,  # 0 = pure least-outstanding
+        affinity_entries=args.affinity_entries,
+        request_timeout_s=args.request_timeout,
+        drain_deadline_s=args.drain_deadline,
+        failover_attempts=args.failover_attempts or None,
+    )
+    try:
+        RouterServer(router, args.host, args.port).serve_forever()
+    finally:
+        for rep in replicas:
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.send_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":
+    main()

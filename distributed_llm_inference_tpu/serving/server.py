@@ -651,8 +651,16 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 if err_type != "timeout":
                     headers = {"Retry-After": str(RETRY_AFTER_S)}
             elif err_type == "overloaded":
-                # bounded queue full (serving/queue.py): shed load
+                # bounded queue full (serving/queue.py or the continuous
+                # admission queue): shed load, with the queue-depth-derived
+                # Retry-After hint so overload backoff is server-directed
+                # exactly like the drain path's
                 code = 429
+                headers = {
+                    "Retry-After": str(
+                        result.get("retry_after_s", RETRY_AFTER_S)
+                    )
+                }
             else:
                 # includes "poison": the request itself crashed the
                 # scheduler K times — a server-side fault answer, and the

@@ -480,6 +480,22 @@ def test_fault_hooks_decode_unreachable(real_reachable):
         assert key not in real_reachable, key
 
 
+def test_router_tier_decode_unreachable(real_reachable):
+    """The replica router (serving/router.py) is host-side glue — an
+    HTTP front tier that never touches an engine or jax. Nothing in it
+    may be reachable from any jit root: its blocking urllib calls,
+    time.sleep waits, and subprocess management are exactly the host
+    syncs the hot-path lint exists to keep out of compiled code. Same
+    pin as utils/faults.py."""
+    router_funcs = sorted(
+        k for k in real_reachable if k[0] == "serving.router"
+    )
+    assert not router_funcs, router_funcs
+    # the shared retry policy it leans on stays host-side too
+    retry_funcs = sorted(k for k in real_reachable if k[0] == "utils.retry")
+    assert not retry_funcs, retry_funcs
+
+
 def test_repo_is_clean():
     """The package itself lints clean — the same gate CI runs."""
     diags, _ = run_lint(PKG_ROOT)

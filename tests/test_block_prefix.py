@@ -19,7 +19,9 @@ import pytest
 
 from distributed_llm_inference_tpu import EngineConfig, get_model_config
 from distributed_llm_inference_tpu.engine import paged as P
-from distributed_llm_inference_tpu.engine.block_prefix import BlockPrefixIndex
+from distributed_llm_inference_tpu.engine.block_prefix import (
+    BlockPrefixIndex, chunk_digests,
+)
 from distributed_llm_inference_tpu.engine.continuous import (
     ContinuousEngine, _Request,
 )
@@ -66,6 +68,39 @@ def test_allocator_alloc_refuses_then_recovers():
 def _ids(n, seed=0):
     rng = np.random.RandomState(seed)
     return [int(t) for t in rng.randint(0, 1000, size=n)]
+
+
+def test_chunk_digests_chain_structure():
+    """The affinity-key export (router tier): digests are CHAINED — two
+    sequences share digest[i] iff their first (i+1)*chunk items match —
+    and only full chunks digest, mirroring lookup()'s partial-tail rule."""
+    a = chunk_digests(list(range(40)), 16)
+    b = chunk_digests(list(range(16)) + list(range(100, 124)), 16)
+    assert len(a) == 2  # 40 // 16 full chunks, partial tail ignored
+    assert a[0] == b[0]  # shared first chunk
+    assert a[1] != b[1]  # chains diverge at the second chunk
+    # chained, not a bag: same chunks in a different order differ at [1]
+    c = chunk_digests(list(range(16, 32)) + list(range(16)), 16)
+    assert c[0] != a[0] and c[1] != a[1]
+    # progressive: a longer head extends, never rewrites, the chain
+    assert chunk_digests(list(range(48)), 16)[:2] == a
+
+
+def test_chunk_digests_bytes_and_str_forms():
+    # the router hashes raw prompt text; str and its utf-8 bytes agree
+    assert chunk_digests("x" * 130, 64) == chunk_digests(b"x" * 130, 64)
+    assert len(chunk_digests("x" * 130, 64)) == 2
+    assert chunk_digests("short", 64) == []  # no full chunk, no digest
+    assert chunk_digests("", 64) == []
+    # max_chunks bounds the walk (router-side cost cap)
+    assert len(chunk_digests(b"y" * 1000, 8, max_chunks=4)) == 4
+    # token-id and byte forms are distinct key spaces (no cross-collision
+    # by construction worth asserting, but both must be stable hex)
+    d = chunk_digests([1, 2, 3, 4], 4)
+    assert d == chunk_digests([1, 2, 3, 4], 4)
+    assert all(isinstance(s, str) and len(s) == 20 for s in d)
+    with pytest.raises(ValueError):
+        chunk_digests("abc", 0)
 
 
 def test_index_register_lookup_roundtrip():

@@ -107,6 +107,10 @@ def test_full_queue_sheds_load():
         assert served, "expected at least one served request"
         for r in shed:
             assert "queue full" in r["error"]
+            # regression: overload sheds must carry the queue-depth-derived
+            # Retry-After hint (only the drain path used to send one), so
+            # client/router backoff is server-directed on overload too
+            assert r["retry_after_s"] >= 1
     finally:
         queue.close()
 
@@ -224,6 +228,7 @@ def test_queue_over_http_429():
     server.start()
     try:
         codes = []
+        retry_afters = []
 
         def post():
             req = urllib.request.Request(
@@ -237,6 +242,8 @@ def test_queue_over_http_429():
                     codes.append(resp.status)
             except urllib.error.HTTPError as e:
                 codes.append(e.code)
+                if e.code == 429:
+                    retry_afters.append(e.headers.get("Retry-After"))
 
         threads = [threading.Thread(target=post) for _ in range(6)]
         for t in threads:
@@ -245,6 +252,11 @@ def test_queue_over_http_429():
             t.join(60)
         assert 429 in codes, codes
         assert 200 in codes, codes
+        # regression: the 429 must arrive with the queue-depth-derived
+        # Retry-After header, not just the drain path's 503
+        assert retry_afters and all(
+            ra is not None and float(ra) >= 1 for ra in retry_afters
+        ), retry_afters
     finally:
         server.shutdown()
 

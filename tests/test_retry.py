@@ -1,0 +1,63 @@
+"""Shared retry/backoff policy units (utils/retry.py) — the one copy of
+the discipline client.py and the router's upstream calls both follow.
+"""
+
+import random
+
+from distributed_llm_inference_tpu.utils import retry
+
+
+def test_retry_statuses_are_the_serving_edge_contract():
+    assert retry.RETRY_STATUSES == (429, 503)
+    assert retry.is_retryable(429) and retry.is_retryable(503)
+    for code in (200, 400, 404, 500, 502):
+        assert not retry.is_retryable(code)
+
+
+def test_parse_retry_after_numeric_forms():
+    assert retry.parse_retry_after("3") == 3.0
+    assert retry.parse_retry_after("0.4") == 0.4
+    assert retry.parse_retry_after(2) == 2.0
+    assert retry.parse_retry_after("-5") == 0.0  # clamp: retry immediately
+    assert retry.parse_retry_after("0") == 0.0
+
+
+def test_parse_retry_after_junk_falls_back_to_none():
+    # HTTP-date form and garbage both mean "use local backoff"
+    for junk in (None, "", "Wed, 21 Oct 2015 07:28:00 GMT", "soon", object()):
+        assert retry.parse_retry_after(junk) is None
+
+
+def test_backoff_delay_bounds_and_growth():
+    rng = random.Random(7)
+    for attempt in range(6):
+        upper = min(retry.BACKOFF_CAP_S, 0.5 * (2 ** attempt))
+        for _ in range(50):
+            d = retry.backoff_delay(attempt, base_s=0.5, rng=rng)
+            # full jitter on the upper half: [upper/2, upper]
+            assert upper / 2 <= d <= upper, (attempt, d)
+
+
+def test_backoff_delay_caps():
+    rng = random.Random(3)
+    for _ in range(50):
+        assert retry.backoff_delay(30, base_s=0.5, rng=rng) <= retry.BACKOFF_CAP_S
+
+
+def test_retry_delay_server_directed_wins():
+    assert retry.retry_delay(0, retry_after="4") == 4.0
+    # junk Retry-After falls through to jittered backoff
+    d = retry.retry_delay(0, retry_after="junk", base_s=0.5,
+                          rng=random.Random(1))
+    assert 0.25 <= d <= 0.5
+
+
+def test_overload_retry_after_scales_with_depth():
+    # empty queue still says "wait a beat", deeper backlog says longer
+    assert retry.overload_retry_after(0, 1) == 1
+    assert retry.overload_retry_after(8, 8) == 2
+    assert retry.overload_retry_after(32, 8) == 5
+    hints = [retry.overload_retry_after(d, 4) for d in range(0, 64, 4)]
+    assert hints == sorted(hints)  # monotone in depth
+    # bounded: a huge backlog never directs an unbounded wait
+    assert retry.overload_retry_after(10_000, 1) == int(retry.BACKOFF_CAP_S)
