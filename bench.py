@@ -735,14 +735,19 @@ def run_benchmark():
                     cont.close()
                 _write_sidecar(dict(result, continuous=cont_block))
 
-            # paged + prefix reuse: admissions after the first MAP the
+            # paged + prefix reuse over the BUCKETED fallback
+            # (ragged_prefill=False): admissions after the first MAP the
             # shared-prefix blocks straight into their tables (refcounted
             # block sharing, engine/block_prefix.py) and prefill only the
-            # tail — no snapshot, no splice, no duplicate pool copy
+            # tail through the scratch gather + bucket ladder + insert
+            # scatter — the baseline the ragged leg below is measured
+            # against
             if time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
                 eng_px = InferenceEngine(
                     c_cfg, params=c_params,
-                    engine_cfg=EngineConfig(prefix_cache_entries=4),
+                    engine_cfg=EngineConfig(
+                        prefix_cache_entries=4, ragged_prefill=False
+                    ),
                 )
                 cont = ContinuousEngine(
                     eng_px, n_slots=n_slots, chunk_steps=chunk,
@@ -764,6 +769,64 @@ def run_benchmark():
                         st = cont.stats()
                         cont_block["prefix_cache"] = st.get("prefix_cache")
                         cont_block["paged_sharing"] = st.get("paged")
+                finally:
+                    cont.close()
+                _write_sidecar(dict(result, continuous=cont_block))
+
+            # ragged leg: the SAME mixed prefill+decode shared-prefix
+            # churn through the ragged ingest (engine_cfg.ragged_prefill
+            # default-on — admission prefills straight into the pool, one
+            # compiled launch pair for any tail, exact-depth prefix
+            # reuse). Reported side by side with the bucketed
+            # paged_prefix leg so the BENCH trajectory captures the gap
+            # closing (~50 tok/s in r05).
+            if time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+                eng_rg = InferenceEngine(
+                    c_cfg, params=c_params,
+                    engine_cfg=EngineConfig(prefix_cache_entries=4),
+                )
+                cont = ContinuousEngine(
+                    eng_rg, n_slots=n_slots, chunk_steps=chunk,
+                    slot_max_seq=slot_max_seq,
+                    kv_pool_blocks=pool_blocks, kv_block_size=32,
+                )
+                try:
+                    v = churn(cont, prefix_prompts)
+                    if v:
+                        cont_block["ragged_tokens_per_sec"] = round(v, 3)
+                        base = cont_block.get("paged_prefix_tokens_per_sec")
+                        if base:
+                            cont_block["ragged_vs_prefix_speedup"] = round(
+                                v / base, 3
+                            )
+                        st = cont.stats()
+                        cont_block["ragged_paged"] = st.get("paged")
+                        snap = eng_rg.metrics.snapshot()
+
+                        def _ctr(name):
+                            return {
+                                "|".join(
+                                    f"{k}={v2}"
+                                    for k, v2 in sorted(
+                                        s["labels"].items()
+                                    )
+                                ) or "_": s["value"]
+                                for s in snap.get(name, {}).get(
+                                    "series", []
+                                )
+                            }
+
+                        cont_block["ragged_metrics"] = {
+                            "rows": _ctr("dli_ragged_rows_total"),
+                            "tiles": _ctr("dli_ragged_tiles_total"),
+                            "launches": _ctr("dli_ragged_launches_total"),
+                            "exact_prefix_hits": _ctr(
+                                "dli_ragged_exact_prefix_hits_total"
+                            ),
+                            "compiled_programs": _ctr(
+                                "dli_ragged_compiled_programs"
+                            ),
+                        }
                 finally:
                     cont.close()
         except Exception:  # noqa: BLE001 - optional leg, never fatal

@@ -162,6 +162,87 @@ def check_no_recompile(engine=None) -> list:
     return []
 
 
+def _ragged_args(engine, tail: int, width: int = 32):
+    """Operand tuple for the ragged paged prefill program
+    (engine/paged.prefill_ragged_paged) on the tiny config with
+    attn_impl="pallas", a fresh pool (donated per run) and a `tail`-token
+    prompt padded to the fixed launch `width`."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import generate as G
+    from ..engine import paged as EP
+
+    cfg = engine.cfg.replace(attn_impl="pallas")
+    bs, MB = 16, 8
+    pool = EP.init_pool(cfg, MB + 2, bs)
+    table = jnp.asarray([list(range(1, MB + 1))], jnp.int32)
+    meta, tok_row, tok_pos, _, _ = EP.build_ragged_meta(
+        [(0, 0, tail, EP.RAGGED_PREFILL)], width=width, tile=8
+    )
+    toks = jnp.asarray([1] * tail + [0] * (width - tail), jnp.int32)
+    return (
+        cfg, engine.backend.params, toks, jnp.asarray(tok_row),
+        jnp.asarray(tok_pos), jnp.asarray(meta), pool, table,
+        jnp.int32(tail - 1), jax.random.PRNGKey(0),
+        G.default_sampling(greedy=True),
+    )
+
+
+def lower_ragged_prefill(engine=None, tail: int = 20, width: int = 32) -> str:
+    """StableHLO of the REAL ragged paged prefill launch (the program the
+    paged admission path dispatches when engine_cfg.ragged_prefill is on)
+    — declared donation intact, ragged kernel selected."""
+    from ..engine import paged as EP
+
+    engine = engine or tiny_engine()
+    return EP.prefill_ragged_paged.lower(
+        *_ragged_args(engine, tail, width)
+    ).as_text()
+
+
+def check_ragged_shape_stability(engine=None) -> list:
+    """Two DIFFERENT tail lengths must lower to the IDENTICAL program:
+    the tail only moves traced values (token contents, metadata, the
+    sample position), never shapes. Identical StableHLO text is the
+    artifact-level proof that one compiled launch serves any prompt tail
+    — the property that deletes the prefill-bucket ladder."""
+    engine = engine or tiny_engine()
+    a = lower_ragged_prefill(engine, tail=20)
+    b = lower_ragged_prefill(engine, tail=27)
+    if a != b:
+        return [
+            "ragged prefill lowered DIFFERENT programs for tails 20 and "
+            "27 — some per-tail value became shape-specializing "
+            "(compile-per-prompt-length in production)"
+        ]
+    return []
+
+
+def check_ragged_no_recompile(engine=None) -> list:
+    """Execute the ragged prefill with two different tail lengths; the
+    jit cache must not grow (a second entry means a 'traced' operand is
+    specializing the program — the bucket ladder reborn as recompiles)."""
+    import jax
+
+    from ..engine import paged as EP
+
+    engine = engine or tiny_engine()
+    out = EP.prefill_ragged_paged(*_ragged_args(engine, 20))
+    jax.block_until_ready(out[0])
+    size_after_first = EP.prefill_ragged_paged._cache_size()
+    out = EP.prefill_ragged_paged(*_ragged_args(engine, 27))
+    jax.block_until_ready(out[0])
+    size_after_second = EP.prefill_ragged_paged._cache_size()
+    if size_after_second > size_after_first:
+        return [
+            f"ragged prefill recompiled across tail lengths (jit cache "
+            f"grew {size_after_first} -> {size_after_second}) — the "
+            f"launch width must be the only shape"
+        ]
+    return []
+
+
 def pp_available() -> bool:
     import jax
 
@@ -234,6 +315,15 @@ def run_hlo_checks() -> dict:
     )
 
     results["recompile-guard"] = check_no_recompile(engine)
+
+    # ragged paged ingest (engine/paged.py + the ragged kernel): the
+    # admission path must stay ONE host-sync-free launch per chunk with
+    # no per-tail-shape recompile — the properties that replaced the
+    # prefill-bucket ladder
+    ragged = lower_ragged_prefill(engine)
+    results["ragged-prefill-callbacks"] = check_no_host_callbacks(ragged)
+    results["ragged-shape-stability"] = check_ragged_shape_stability(engine)
+    results["ragged-recompile-guard"] = check_ragged_no_recompile(engine)
 
     if pp_available():
         pp = lower_pp_decode()

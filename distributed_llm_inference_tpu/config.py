@@ -345,6 +345,20 @@ class EngineConfig:
     # resident set transiently fills. Memory: 2 tables x capacity x vocab
     # (bool + int32).
     constraint_fleet_states: int = 1024
+    # Ragged paged ingest (engine/paged.py ragged programs + the
+    # ops/paged_attention ragged kernel): paged-fleet admission prefills
+    # straight into the pool in fixed-width flat-token launches — no
+    # scratch cache, no insert scatter, no prefill-bucket ladder, and the
+    # block-prefix planner reuses at EXACT chunk depth. False falls back
+    # to the bucketed scratch path (prefill_buckets), which also serves
+    # any backend without the ragged fill programs.
+    ragged_prefill: bool = True
+    # Flat-token launch width of the ragged ingest programs: one compiled
+    # (extend, prefill) program pair per width serves every tail length
+    # (longer tails loop whole-width launches; the final launch pads with
+    # dead tiles the kernel's DMA skips). Rounded up to a multiple of the
+    # query tile (8).
+    ragged_width: int = 64
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":

@@ -196,10 +196,20 @@ class ContinuousEngine:
             int(slot_max_seq or cfg.max_seq_len), cfg.max_seq_len
         )
         buckets = engine._buckets()
-        if buckets and self.slot_max_seq < buckets[0]:
-            # the ingest plan needs at least one prefill bucket inside the
-            # slot class — a smaller budget would start a healthy-looking
-            # server that rejects EVERY request
+        # Ragged paged ingest (engine/paged.py): admission prefills
+        # straight into the pool in fixed-width flat-token launches — the
+        # prefill-bucket ladder (and its scratch gather/scatter) becomes
+        # the cfg-gated fallback. Decided here because the bucket guard
+        # below only applies when the bucketed plan is what admission runs.
+        ragged_planned = bool(
+            kv_pool_blocks is not None
+            and engine.engine_cfg.ragged_prefill
+            and getattr(engine.backend, "supports_ragged_fill", False)
+        )
+        if not ragged_planned and buckets and self.slot_max_seq < buckets[0]:
+            # the bucketed ingest plan needs at least one prefill bucket
+            # inside the slot class — a smaller budget would start a
+            # healthy-looking server that rejects EVERY request
             raise ValueError(
                 f"slot_max_seq={self.slot_max_seq} is smaller than the "
                 f"smallest prefill bucket {buckets[0]}; raise it or shrink "
@@ -248,7 +258,16 @@ class ContinuousEngine:
                 (self.n_slots, self._max_blocks), np.int32
             )
             self._table_dev = None
+            self._ragged = ragged_planned
+            # query-tile granularity of the ragged kernel's flat token
+            # axis; the launch width rounds up to a whole number of tiles
+            self._ragged_tile = 8
+            self._ragged_width = -(
+                -max(1, int(engine.engine_cfg.ragged_width))
+                // self._ragged_tile
+            ) * self._ragged_tile
         else:
+            self._ragged = False
             self._scratch_seq = self.slot_max_seq
             self.cache = self.backend.init_cache(
                 self.n_slots, self.slot_max_seq
@@ -270,8 +289,14 @@ class ContinuousEngine:
             registry=engine.metrics,
         )
         # scratch must match the fleet's logical extent: the insert splices
-        # the whole row (dense) / scatters every logical block (paged)
-        self._scratch = self.backend.init_cache(1, self._scratch_seq)
+        # the whole row (dense) / scatters every logical block (paged).
+        # The RAGGED paged path prefills straight into the pool, so it
+        # carries no scratch cache at all — one slot-class of HBM saved
+        # on top of deleting the gather/scatter admission moves.
+        self._scratch = (
+            None if self._ragged
+            else self.backend.init_cache(1, self._scratch_seq)
+        )
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
         # Prefix reuse, one planner per fleet mode (both drive the shared
         # engine._prefix_plan seam):
@@ -375,6 +400,33 @@ class ContinuousEngine:
             "dli_drain_duration_seconds",
             "graceful-drain wall time (SIGTERM / drain())", ("component",),
         ).labels(component="continuous")
+        # ragged-ingest observability (families pre-registered in
+        # engine/engine.py for schema stability): launch composition,
+        # padding overhead, exact-depth reuse, compiled-program gauge
+        self._m_ragged_rows = m.counter(
+            "dli_ragged_rows_total",
+            "ragged-launch rows by kind (prefill chunk / decode token)",
+            ("kind",),
+        )
+        self._m_ragged_tiles = m.counter(
+            "dli_ragged_tiles_total",
+            "ragged-launch query tiles by liveness (live / pad — pad "
+            "tiles cost no DMA, only grid steps)", ("state",),
+        )
+        self._m_ragged_launches = m.counter(
+            "dli_ragged_launches_total",
+            "ragged ingest launches", ("phase",),
+        )
+        self._m_ragged_exact = m.counter(
+            "dli_ragged_exact_prefix_hits_total",
+            "prefix hits reused at exact chunk depth (no bucket "
+            "degradation — the ragged path's planner win)",
+        ).labels()
+        self._m_ragged_programs = m.gauge(
+            "dli_ragged_compiled_programs",
+            "compiled ragged ingest programs (flat after warmup = no "
+            "per-tail-shape recompile)",
+        ).labels()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-engine"
         )
@@ -680,7 +732,10 @@ class ContinuousEngine:
                     self._bpx.stats()["cached_blocks"]
                     if self._bpx is not None else 0
                 ),
+                "ragged_prefill": self._ragged,
             }
+            if self._ragged:
+                out["paged"]["ragged_width"] = self._ragged_width
         cstats = self._ctable.stats()
         if cstats["resident"]:
             out["constraints"] = cstats
@@ -779,7 +834,10 @@ class ContinuousEngine:
             self.cache = self.backend.init_cache(
                 self.n_slots, self.slot_max_seq
             )
-        self._scratch = self.backend.init_cache(1, self._scratch_seq)
+        self._scratch = (
+            None if self._ragged
+            else self.backend.init_cache(1, self._scratch_seq)
+        )
         self.state, self.sparams = G.init_slots(
             self.n_slots, self.cfg.vocab_size
         )
@@ -1191,10 +1249,13 @@ class ContinuousEngine:
         # prefix lookup + ingest plan: the solo engine's shared planner
         # helper (one copy of the lookup/cold-fallback/mark discipline);
         # the planner is mode-specific — block-chain index (paged) or
-        # dense snapshot cache
+        # dense snapshot cache. ragged=True (paged ragged ingest) plans
+        # the tail as fixed-width launches with NO bucket ladder, so the
+        # deepest cached chain is reused at EXACT chunk depth — the
+        # degradation walk only runs for the bucketed fallback.
         p0, entry, plan = eng._prefix_plan(
             self._bpx if self.paged else self._prefix, ids,
-            capacity=self.slot_max_seq,
+            capacity=self.slot_max_seq, ragged=self._ragged,
         )
         if plan is None:
             raise ValueError(
@@ -1271,8 +1332,11 @@ class ContinuousEngine:
             k.get("frequency_penalty", 0.0), k.get("presence_penalty", 0.0),
         )
         key = self._next_key()
-        scratch = self._scratch
-        self._scratch = None
+        use_ragged = self.paged and self._ragged
+        scratch = None
+        if not use_ragged:
+            scratch = self._scratch
+            self._scratch = None
         req.prefix_hit_tokens = p0
         # repetition-penalty state: the prompt's token-id set, host-built.
         # The fleet always carries presence (a 1.0 penalty is an exact
@@ -1293,7 +1357,18 @@ class ContinuousEngine:
                 for t in req.salvaged:
                     st = art.advance(st, t)
                 bias = jnp.asarray(art.state_bias(st))
-            if self.paged:
+            if use_ragged:
+                # ragged ingest: the tail prefills STRAIGHT INTO THE POOL
+                # (flat-token launches through the ragged kernel) — no
+                # scratch, no shared-head gather, no insert scatter. A
+                # prefix hit attends the mapped blocks in place, at the
+                # exact depth the planner found.
+                if p0:
+                    self._m_ragged_exact.inc()
+                first = self._ragged_ingest(
+                    ids, p0, table_row, key, sampling, presence, bias
+                )
+            elif self.paged:
                 if p0:
                     # block-level hit: the shared physical blocks are
                     # already MAPPED into table_row — no splice, no copy
@@ -1334,7 +1409,15 @@ class ContinuousEngine:
                 sampling.freq_penalty, sampling.pres_penalty,
                 presence_row,
             )
-            if self.paged:
+            if use_ragged:
+                # the prompt's K/V is ALREADY in the pool blocks: arm the
+                # slot's state only (shared generate.arm_slot semantics)
+                self.state, self.sparams = self.backend.arm_slot_paged(
+                    self.state, self.sparams, slot, *arm
+                )
+                self._table[slot] = table_row
+                self._table_dev = None  # rebuilt at the next chunk launch
+            elif self.paged:
                 self.cache, self.state, self.sparams = (
                     self.backend.insert_slot_paged(
                         self.cache, scratch, self.state, self.sparams, slot,
@@ -1348,7 +1431,8 @@ class ContinuousEngine:
                     cfg, self.cache, scratch, self.state, self.sparams, slot,
                     *arm,
                 )
-            self._scratch = scratch
+            if not use_ragged:
+                self._scratch = scratch
         except BaseException:
             if req.block_ids is not None:
                 # admission died after the block grant (failed prefill,
@@ -1362,10 +1446,11 @@ class ContinuousEngine:
                 req.cart = None
             raise
         finally:
-            if self._scratch is None:
+            if not use_ragged and self._scratch is None:
                 # a failed extend/prefill may have consumed (donated) the
                 # scratch buffer mid-sequence; a permanently-None scratch
-                # would fail every later admission — reallocate
+                # would fail every later admission — reallocate (the
+                # ragged path never holds a scratch at all)
                 self._scratch = self.backend.init_cache(1, self._scratch_seq)
         if self.paged and self._bpx is not None:
             # index the prompt's full blocks (complete + immutable once
@@ -1392,6 +1477,76 @@ class ContinuousEngine:
             request_id=req.trace.request_id,
         )
         return first  # [1] device array; the wave fetches these together
+
+    def _ragged_launch_args(self, chunk_ids, start):
+        """Build one ragged launch's device operands (host-side planning —
+        engine/paged.build_ragged_meta — plus the flat token buffer) and
+        count its composition into the dli_ragged_* families."""
+        P = self._P
+        W, tile = self._ragged_width, self._ragged_tile
+        meta, tok_row, tok_pos, _, stats = P.build_ragged_meta(
+            [(0, start, len(chunk_ids), P.RAGGED_PREFILL)],
+            width=W, tile=tile,
+        )
+        toks = np.zeros((W,), np.int32)
+        toks[: len(chunk_ids)] = chunk_ids
+        self._m_ragged_rows.labels(kind="prefill").inc(stats["prefill_rows"])
+        if stats["decode_rows"]:
+            self._m_ragged_rows.labels(kind="decode").inc(
+                stats["decode_rows"]
+            )
+        self._m_ragged_tiles.labels(state="pad").inc(stats["pad_tiles"])
+        self._m_ragged_tiles.labels(state="live").inc(
+            stats["tiles"] - stats["pad_tiles"]
+        )
+        return (
+            jnp.asarray(toks), jnp.asarray(tok_row), jnp.asarray(tok_pos),
+            jnp.asarray(meta),
+        )
+
+    def _ragged_ingest(self, ids, p0, table_row, key, sampling, presence,
+                       bias):
+        """Prefill ids[p0:] straight into the pool through the ragged
+        launch programs: whole-width extend launches for the body of the
+        tail, then ONE width-padded prefill launch that samples the first
+        token off the tail's last flat position. Exactly two compiled
+        programs serve EVERY tail length (the recompile guard the
+        analysis ragged rule pins), and a prefix hit's mapped shared head
+        is attended in place through the block table — no gather, no
+        insert scatter, no bucket ladder. Returns the [1] first-token
+        device array (the admission wave's stacked-fetch contract)."""
+        be = self.backend
+        W = self._ragged_width
+        tail = ids[p0:]
+        n_full = max(0, (len(tail) - 1) // W)  # leaves >= 1 sampling token
+        table1 = jnp.asarray(
+            np.asarray(table_row, np.int32)[None, :]
+        )  # [1, MB]: this admission's single fleet row
+        for c in range(n_full):
+            toks, tok_row, tok_pos, meta = self._ragged_launch_args(
+                tail[c * W : (c + 1) * W], p0 + c * W
+            )
+            self.cache = be.extend_ragged_paged(
+                toks, tok_row, tok_pos, meta, self.cache, table1
+            )
+            self._m_ragged_launches.labels(phase="extend").inc()
+        rem = tail[n_full * W :]
+        toks, tok_row, tok_pos, meta = self._ragged_launch_args(
+            rem, p0 + n_full * W
+        )
+        first, _, self.cache = be.prefill_ragged_paged(
+            toks, tok_row, tok_pos, meta, self.cache, table1,
+            jnp.int32(len(rem) - 1), key, sampling,
+            presence=presence, bias=bias,
+        )
+        self._m_ragged_launches.labels(phase="prefill").inc()
+        if hasattr(be, "ragged_program_count"):
+            # warmup compiles show as the gauge's settle point; a gauge
+            # that keeps climbing under steady traffic is a
+            # recompile-per-admission regression (also machine-checked by
+            # the analysis ragged rule on the lowered programs)
+            self._m_ragged_programs.set(be.ragged_program_count())
+        return first
 
     def _process(self, chunk):
         """Fetch one decode chunk's packed results and distribute/finalize."""

@@ -206,6 +206,49 @@ class SingleDeviceBackend:
 
         return P.gather_scratch_blocks(pool, table_row)
 
+    # ragged ingest (engine/paged.py): admission prefills straight into
+    # the pool through the ragged kernel/gather — no scratch, no insert
+    # scatter, no bucket ladder. Gated per engine by
+    # engine_cfg.ragged_prefill; PipelineBackend provides shard_map twins.
+    @property
+    def supports_ragged_fill(self):
+        return self.supports_paged
+
+    def extend_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
+                            table):
+        from . import paged as P
+
+        return P.extend_ragged_paged(
+            self.cfg, self.params, tokens, tok_row, tok_pos, meta, pool,
+            table,
+        )
+
+    def prefill_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
+                             table, sample_at, key, sampling, presence=None,
+                             bias=None):
+        from . import paged as P
+
+        return P.prefill_ragged_paged(
+            self.cfg, self.params, tokens, tok_row, tok_pos, meta, pool,
+            table, sample_at, key, sampling, presence=presence, bias=bias,
+        )
+
+    def arm_slot_paged(self, state, sparams, slot, *arm):
+        from . import paged as P
+
+        return P.arm_slot_only(self.cfg, state, sparams, slot, *arm)
+
+    def ragged_program_count(self) -> int:
+        """Compiled ragged-ingest program count (jit cache entries of the
+        two launch programs) — the dli_ragged_compiled_programs gauge:
+        flat after warmup proves no per-shape recompile."""
+        from . import paged as P
+
+        return (
+            P.extend_ragged_paged._cache_size()
+            + P.prefill_ragged_paged._cache_size()
+        )
+
     def decode_speculative(self, first_token, cache, hist, hist_len, limit,
                            *, max_steps, draft_len):
         return G.decode_speculative(
@@ -368,6 +411,34 @@ class InferenceEngine:
         self.metrics.histogram(
             "dli_drain_duration_seconds",
             "graceful-drain wall time (SIGTERM / drain())", ("component",),
+        )
+        # ragged-ingest families (engine/continuous.py labels them when
+        # the ragged path is live): launch composition, padding-tile
+        # overhead, exact-depth prefix reuse, and the compiled-program
+        # gauge that makes the no-recompile-per-tail invariant observable
+        self.metrics.counter(
+            "dli_ragged_rows_total",
+            "ragged-launch rows by kind (prefill chunk / decode token)",
+            ("kind",),
+        )
+        self.metrics.counter(
+            "dli_ragged_tiles_total",
+            "ragged-launch query tiles by liveness (live / pad — pad "
+            "tiles cost no DMA, only grid steps)", ("state",),
+        )
+        self.metrics.counter(
+            "dli_ragged_launches_total",
+            "ragged ingest launches", ("phase",),
+        )
+        self.metrics.counter(
+            "dli_ragged_exact_prefix_hits_total",
+            "prefix hits reused at exact chunk depth (no bucket "
+            "degradation — the ragged path's planner win)",
+        )
+        self.metrics.gauge(
+            "dli_ragged_compiled_programs",
+            "compiled ragged ingest programs (flat after warmup = no "
+            "per-tail-shape recompile)",
         )
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
@@ -847,7 +918,8 @@ class InferenceEngine:
             sampling, **kw,
         )
 
-    def _prefix_plan(self, prefix, ids: list, capacity: Optional[int] = None):
+    def _prefix_plan(self, prefix, ids: list, capacity: Optional[int] = None,
+                     ragged: bool = False):
         """Prefix lookup + ingest planning, ONE copy for every serving
         path: lookup -> plan the tail -> cold fallback when no tail plan
         fits -> mark hit/miss on the PLANNED outcome (a lookup hit that
@@ -862,20 +934,40 @@ class InferenceEngine:
         maps into the request's block table) both satisfy it; None means
         a plain cold plan. What "reuse" physically does with `entry` is
         the caller's business — this helper owns only the depth/plan/mark
-        discipline, which is identical across planners."""
+        discipline, which is identical across planners.
+
+        ragged=True (paged admission through the ragged ingest,
+        engine/paged.extend_ragged_paged): there is no bucket ladder to
+        fit, so ANY tail length >= 1 is serveable and the deepest lookup
+        depth is used AS IS — exact-chunk-depth reuse, never degraded.
+        The plan is the ("ragged", tail_len) sentinel; only the capacity
+        guard can reject (same bound as the cold path, so acceptance
+        stays independent of cache state)."""
         buckets = self._buckets()
         prompt_len = len(ids)
         p0, entry, pkey = 0, None, None
         if prefix is not None:
             p0, entry, pkey = prefix.lookup(ids)
+        if ragged:
+            cap = capacity if capacity is not None else self.cfg.max_seq_len
+            ok = 1 <= prompt_len <= cap - 2
+            plan = ("ragged", prompt_len - p0) if ok else None
+            if plan is None or not p0:
+                entry = None
+                if plan is None:
+                    p0 = 0
+            if prefix is not None:
+                prefix.mark(pkey, hit=bool(p0), depth=p0)
+            return p0, entry, plan
         plan = self._plan_ingest(prompt_len, p0, buckets, capacity)
-        # Depth degradation: the deepest reuse offset can leave a tail no
-        # prefill bucket fits inside the capacity (e.g. a hit at offset 96
-        # in a 128-token window with a 64-token smallest bucket). Both
-        # reuse mechanisms serve ANY aligned depth (a snapshot splices its
-        # first p0 slots; a block chain maps its first p0/bs blocks), so
-        # walk down one planner granule at a time before giving the whole
-        # prefix up — partial reuse beats cold.
+        # Depth degradation (BUCKETED fallback path only — the ragged
+        # branch above never degrades): the deepest reuse offset can
+        # leave a tail no prefill bucket fits inside the capacity (e.g. a
+        # hit at offset 96 in a 128-token window with a 64-token smallest
+        # bucket). Both reuse mechanisms serve ANY aligned depth (a
+        # snapshot splices its first p0 slots; a block chain maps its
+        # first p0/bs blocks), so walk down one planner granule at a time
+        # before giving the whole prefix up — partial reuse beats cold.
         step = getattr(prefix, "chunk", 0)
         while plan is None and p0 > step > 0:
             p0 -= step

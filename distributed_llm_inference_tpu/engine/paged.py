@@ -506,3 +506,273 @@ def insert_slot_paged(
         freq_penalty, pres_penalty, presence_row,
     )
     return pool, state, sparams
+
+
+# -- ragged ingest: prefill straight into the pool, no bucket ladder ----------
+#
+# The bucketed admission path above prefills a request on a CONTIGUOUS
+# batch-1 scratch cache (chunked through the prefill-bucket ladder), then
+# scatters the whole scratch row into the slot's pool blocks — and on a
+# block-prefix hit first GATHERS the mapped shared head back out of the
+# pool so the tail chunks can attend it. The ragged path deletes all
+# three moves: the prompt tail is laid out on a FLAT token axis (each
+# token is a batch row of one — forward_layers' slots mode, so RoPE and
+# the learned-position families take per-token positions for free), each
+# token's K/V scatters directly into its row's pool block, and attention
+# runs over the pool through the ragged kernel
+# (ops/paged_attention.ragged_paged_attend) — or its XLA gather twin on
+# CPU — reading the mapped shared head IN PLACE. One compiled program per
+# launch width covers ANY tail length (the last launch pads with dead
+# tiles whose DMA Pallas skips), so the block-prefix planner reuses at
+# exact chunk depth instead of degrading to a bucket boundary.
+
+RAGGED_PREFILL = 0  # launch-entry kind: a prompt chunk (length >= 1)
+RAGGED_DECODE = 1  # launch-entry kind: one decode token at its own pos
+
+
+def build_ragged_meta(entries, *, width: int, tile: int):
+    """HOST-side launch planner for the ragged ingest programs (strictly
+    decode-unreachable — pinned in the test_analysis.py callgraph
+    fixture, like utils/faults.py).
+
+    entries: [(row, start, length, kind)] — each fleet row's contribution
+    to this launch, in flat-token order; a decode row is (row, pos, 1,
+    RAGGED_DECODE), a prefill chunk (row, chunk_start, chunk_len,
+    RAGGED_PREFILL). Every entry starts on a query-tile boundary, so an
+    entry's tokens occupy flat slots [offset, offset + length)
+    contiguously (all its tiles but the last are full).
+
+    Returns (meta [G, 4] int32, tok_row [W] int32, tok_pos [W] int32,
+    offsets, stats): meta is the per-tile (row, q_start, q_len, kind)
+    array the kernel prefetches; tok_row / tok_pos are the per-token row
+    index (-1 = launch padding, scattered to the trash block) and
+    absolute position; offsets[i] is entry i's flat token offset; stats
+    counts tiles/pad_tiles/rows-by-kind for the dli_ragged_* metrics.
+    Dead tiles copy their predecessor's (row, q_start) with q_len 0, so
+    their clamped KV walk repeats the predecessor's physical indices and
+    Pallas skips the DMA (see ops/paged_attention._ragged_live_range).
+    """
+    import numpy as np
+
+    if width % tile != 0:
+        raise ValueError(f"ragged width {width} must be a multiple of the "
+                         f"query tile {tile}")
+    G = width // tile
+    meta = np.zeros((G, 4), np.int32)
+    tok_row = np.full((width,), -1, np.int32)
+    tok_pos = np.zeros((width,), np.int32)
+    offsets = []
+    stats = {"tiles": G, "pad_tiles": 0, "prefill_rows": 0, "decode_rows": 0}
+    g = 0
+    for row, start, length, kind in entries:
+        if length < 1:
+            raise ValueError("ragged launch entries need length >= 1")
+        need = -(-length // tile)
+        if g + need > G:
+            raise ValueError(
+                f"launch overflow: {length} tokens need {need} tiles, "
+                f"{G - g} left of {G}"
+            )
+        offsets.append(g * tile)
+        stats["decode_rows" if kind == RAGGED_DECODE else "prefill_rows"] += 1
+        for t in range(need):
+            q_len = min(tile, length - t * tile)
+            q_start = start + t * tile
+            meta[g] = (row, q_start, q_len, kind)
+            w = g * tile
+            tok_row[w : w + q_len] = row
+            tok_pos[w : w + q_len] = q_start + np.arange(q_len)
+            g += 1
+    # launch padding: dead tiles inherit the predecessor's placement so
+    # the kernel's clamped index repeats (DMA skipped), q_len 0 gates the
+    # compute off
+    stats["pad_tiles"] = G - g
+    while g < G:
+        if g > 0:
+            meta[g] = meta[g - 1]
+            meta[g, 2] = 0
+        g += 1
+    return meta, tok_row, tok_pos, offsets, stats
+
+
+def _ragged_attend_xla(cfg, q, cache_k, cache_v, table, tok_row, tok_pos,
+                       window_flag):
+    """XLA twin of the ragged kernel: per-token gather of the owning
+    row's blocks into a contiguous logical view, then the stock masked
+    attention. This is the CPU / debug reference (the kernel's interpret
+    mode is the bit-exactness oracle); on TPU the kernel path avoids
+    materializing the W x MB*bs view entirely. q [W, 1, H, Dh]."""
+    from ..models.llama import kernel_window
+
+    W = q.shape[0]
+    KV, bs = cache_k.shape[1], cache_k.shape[2]
+    MB = table.shape[1]
+    Dh = cache_k.shape[-1]
+    S = MB * bs
+    w, wd = kernel_window(cfg, window_flag)
+
+    def win_mask(mask, kv_pos, q_pos):
+        if wd is not None:
+            mask &= (wd <= 0) | (kv_pos > q_pos - wd)
+        elif w is not None:
+            mask &= kv_pos > q_pos - w
+        return mask
+
+    if table.shape[0] == 1:
+        # Single fleet row (the admission launch shape): gather the row's
+        # logical view ONCE and attend the whole flat token axis as one
+        # [1, W, S] batch — the same attention shape the bucketed scratch
+        # prefill runs, with none of its gather/scatter bookends.
+        def gathered1(leaf):
+            g = (
+                kv_dequantize(KVQuant(leaf.q[table[0]], leaf.s[table[0]]))
+                if isinstance(leaf, KVQuant) else leaf[table[0]]
+            )  # [MB, KV, bs, Dh]
+            return g.transpose(1, 0, 2, 3).reshape(1, KV, S, Dh)
+
+        kv_pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        q_pos = tok_pos[:, None]
+        mask = (kv_pos <= q_pos) & (tok_row >= 0)[:, None]  # [W, S]
+        mask = win_mask(mask, kv_pos, q_pos)
+        out = attend(
+            q[:, 0][None], gathered1(cache_k), gathered1(cache_v),
+            mask[None], scale=cfg.query_scale, softcap=cfg.attn_softcap,
+        )  # [1, W, H, Dh]
+        return out[0][:, None]
+
+    rows = jnp.maximum(tok_row, 0)
+    row_table = table[rows]  # [W, MB]
+
+    def gathered(leaf):
+        g = (
+            kv_dequantize(KVQuant(leaf.q[row_table], leaf.s[row_table]))
+            if isinstance(leaf, KVQuant) else leaf[row_table]
+        )  # [W, MB, KV, bs, Dh]
+        return g.transpose(0, 2, 1, 3, 4).reshape(W, KV, S, Dh)
+
+    kv_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+    q_pos = tok_pos[:, None, None]
+    mask = (kv_pos <= q_pos) & (tok_row >= 0)[:, None, None]
+    mask = win_mask(mask, kv_pos, q_pos)
+    return attend(
+        q, gathered(cache_k), gathered(cache_v), mask,
+        scale=cfg.query_scale, softcap=cfg.attn_softcap,
+    )
+
+
+def make_ragged_fill_hook(table, meta, tok_row):
+    """attn_hook for the ragged ingest programs: flat-token layout
+    ([W, 1] chunks — each token is a batch row at its own position, the
+    slots-mode contract), per-token K/V scatter into the owning row's
+    pool block, attention over the pool via the ragged kernel
+    (attn_impl="pallas") or its XLA gather twin.
+
+    table [R, MB]: the launch's fleet rows' block tables; meta [G, 4]:
+    the per-tile launch plan (build_ragged_meta); tok_row [W]: per-token
+    owning row, -1 for launch padding — padding writes are redirected to
+    the write-only TRASH block, exactly like ungated pp microsteps.
+    """
+
+    def hook(cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate,
+             valid_start, window_flag=None):
+        del mask, valid_start  # mask derived from pos/tok_row in-kernel
+        W, T = q.shape[0], q.shape[1]
+        assert T == 1, "ragged fill runs the flat token layout (T=1 rows)"
+        bs = cache_k.shape[2]
+        MB = table.shape[1]
+        # Write: token w's K/V -> pool[table[row_w, pos_w // bs], :,
+        # pos_w % bs]. Launch padding (row -1) — and, on the pp ring,
+        # microsteps whose stage doesn't own the buffer (update_gate) —
+        # redirect to the trash block: colliding trash writes are
+        # write-only garbage at positions nothing ever attends.
+        rows_ix = jnp.maximum(tok_row, 0)
+        lblk = jnp.minimum(pos // bs, MB - 1)  # [W]
+        blk = table[rows_ix, lblk]  # [W]
+        live = tok_row >= 0
+        if update_gate is not None:
+            live = live & update_gate
+        blk = jnp.where(live, blk, TRASH_BLOCK)
+        off = pos % bs
+        if isinstance(cache_k, KVQuant):
+            qk, sk = quantize_chunk(k)
+            qv, sv = quantize_chunk(v)
+            new_k = KVQuant(
+                cache_k.q.at[blk, :, off, :].set(qk[:, 0]),
+                cache_k.s.at[blk, :, off].set(sk[:, 0]),
+            )
+            new_v = KVQuant(
+                cache_v.q.at[blk, :, off, :].set(qv[:, 0]),
+                cache_v.s.at[blk, :, off].set(sv[:, 0]),
+            )
+        else:
+            new_k = cache_k.at[blk, :, off, :].set(k[:, 0])
+            new_v = cache_v.at[blk, :, off, :].set(v[:, 0])
+        if cfg.attn_impl == "pallas":
+            from ..models.llama import kernel_window
+            from ..ops.paged_attention import ragged_paged_attend
+
+            w, wd = kernel_window(cfg, window_flag)
+            attn = ragged_paged_attend(
+                q[:, 0], new_k, new_v, table, meta, wd, window=w,
+                scale=cfg.query_scale, softcap=cfg.attn_softcap,
+            )[:, None]
+        else:
+            attn = _ragged_attend_xla(
+                cfg, q, new_k, new_v, table, tok_row, pos, window_flag
+            )
+        return attn, new_k, new_v
+
+    return hook
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def extend_ragged_paged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
+                        meta, pool, table):
+    """One full ragged launch with no sampling — the chunked-prefill
+    extend() twin over the pool. tokens [W] int32 flat launch tokens;
+    tok_row/tok_pos [W]; meta [G, 4]; table [R, MB]. The pool is donated
+    (updated in place); the table is read-only."""
+    from ..models import api as M
+
+    x = M.embed(cfg, params, tokens[:, None], tok_pos)
+    _, pool = M.forward_layers(
+        cfg, params["layers"], x, pool, tok_pos,
+        attn_hook=make_ragged_fill_hook(table, meta, tok_row),
+        attn_seq_len=1,
+    )
+    return pool
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def prefill_ragged_paged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
+                         meta, pool, table, sample_at, key, sampling,
+                         presence=None, bias=None):
+    """Final ragged launch: run the tail chunk, unembed ONE flat position
+    (`sample_at` — the entry's last valid token, traced so every tail
+    length shares this compiled program) and sample the first token.
+    Returns (first [1], logits [1, V], pool) — the G.prefill contract the
+    admission wave's stacked fetch expects."""
+    from ..models import api as M
+    from ..ops.sampling import sample_token
+
+    x = M.embed(cfg, params, tokens[:, None], tok_pos)
+    x, pool = M.forward_layers(
+        cfg, params["layers"], x, pool, tok_pos,
+        attn_hook=make_ragged_fill_hook(table, meta, tok_row),
+        attn_seq_len=1,
+    )
+    last = jax.lax.dynamic_slice_in_dim(x, sample_at, 1, axis=0)  # [1, 1, D]
+    logits = M.unembed(cfg, params, last)[:, 0, :]
+    first = sample_token(key, logits, *sampling, presence=presence, bias=bias)
+    return first, logits, pool
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def arm_slot_only(cfg: ModelConfig, state: G.SlotState,
+                  sparams: G.SlotParams, slot, *arm):
+    """Arm a slot with NO cache movement — the ragged ingest already wrote
+    the prompt's K/V into the pool blocks, so admission needs only the
+    state-side half of insert_slot_paged (same shared generate.arm_slot,
+    so the budget / EOS-on-first semantics cannot drift)."""
+    state, sparams = G.arm_slot(cfg, state, sparams, jnp.int32(slot), *arm)
+    return state, sparams

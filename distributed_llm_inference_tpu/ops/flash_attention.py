@@ -38,6 +38,7 @@ CPU test suite exercises; numerics match `attend` to fp32 tolerance.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,21 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # mask fill; avoids inf-inf NaNs
+
+
+def resolve_interpret(interpret: bool | None) -> bool:
+    """Resolve a kernel's interpret mode: an explicit argument wins, then
+    the DLI_PALLAS_INTERPRET env switch ("1"/"0" — tests/conftest.py pins
+    it to 1 so tier-1 exercises every Pallas kernel bit-for-bit on CPU),
+    then the backend default (interpret anywhere but a real TPU). ONE
+    resolver for all kernels (flash / paged / ragged), so the test-suite
+    switch cannot miss one."""
+    if interpret is not None:
+        return interpret
+    env = os.environ.get("DLI_PALLAS_INTERPRET", "")
+    if env != "":
+        return env not in ("0", "false", "no")
+    return jax.default_backend() != "tpu"
 
 
 def _needed_tiles(pos, qi, *, T: int, block_t: int, block_k: int):
@@ -203,8 +219,7 @@ def flash_attend(
     KV, S = cache_k.shape[1], cache_k.shape[2]
     group = H // KV
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     if block_t <= 0:
         # ~<=1024 query rows per tile keeps q + fp32 acc well inside VMEM.
         block_t = max(1, min(T, 1024 // group))

@@ -173,6 +173,7 @@ def paged_flash_attend(
     Returns [B,1,H,Dh] in q.dtype — same contract as the gather path in
     engine/paged.make_paged_hook with the mask derived from pos/window.
     """
+    from .flash_attention import resolve_interpret
     from .kv_quant import KVQuant
 
     quant = isinstance(pool_k, KVQuant)
@@ -185,8 +186,7 @@ def paged_flash_attend(
     MB = table.shape[1]
     group = H // KV
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
 
     q5 = q.reshape(B, 1, KV, group, Dh)
     table = table.astype(jnp.int32)
@@ -386,13 +386,14 @@ def flash_attend_slots(
     q [B,1,H,Dh] (decode, T=1); cache_k/v [B,KV,S,Dh]; pos [B] int32.
     Returns [B,1,H,Dh] in q.dtype.
     """
+    from .flash_attention import resolve_interpret
+
     B, T, H, Dh = q.shape
     assert T == 1, "slots kernel serves decode steps (T=1) only"
     KV, S = cache_k.shape[1], cache_k.shape[2]
     group = H // KV
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     if block_k <= 0:
         block_k = min(S, 512)
     MB = pl.cdiv(S, block_k)
@@ -439,3 +440,251 @@ def flash_attend_slots(
         interpret=interpret,
     )(pos, q5, cache_k, cache_v)
     return out.reshape(B, 1, H, Dh)
+
+
+# -- ragged paged attention: mixed prefill + decode rows, one launch ----------
+#
+# The decode kernel above serves exactly one query per row; prefill still
+# climbs a bucket ladder of chunked fills over a contiguous scratch cache
+# that is then scattered into the pool. This kernel collapses both phases
+# into ONE grid: the flat query axis holds every row's tokens back to back
+# (a prefill row contributes its chunk, a decode row contributes one
+# token), a per-tile metadata array carries (row, start, length, kind),
+# and the KV walk reads each tile's placement straight from the block
+# table. Dead tiles (launch padding, or KV blocks past a tile's causal
+# frontier) repeat their neighbour's physical index, so Pallas skips the
+# DMA — padding costs control flow, not HBM bandwidth. The TPU "Ragged
+# Paged Attention" kernel (PAPERS.md) is the design source; the flash
+# accumulation discipline is shared with ops/flash_attention.py.
+
+RAGGED_PREFILL = 0  # metadata `kind`: a prompt-chunk row (length >= 1)
+RAGGED_DECODE = 1  # metadata `kind`: a single-token decode row
+
+
+def _ragged_live_range(q_start, q_len, *, bs: int, MB: int, win):
+    """(first, needed) logical-block bounds for a query tile starting at
+    absolute position q_start with q_len valid queries. Dead tiles
+    (q_len == 0 launch padding) evaluate with an effective length of 1 so
+    their range — and therefore their clamped physical index — equals
+    their predecessor's, which is what lets Pallas skip the DMA
+    entirely (the builder copies the predecessor's row/start into pad
+    tiles). `win` is a TRACED scalar (<= 0 = full causal)."""
+    last = q_start + jnp.maximum(q_len, 1) - 1
+    needed = jnp.clip(pl.cdiv(last + 1, bs), 1, MB)
+    first = jnp.where(
+        win > 0,
+        jnp.minimum(jnp.maximum(q_start - win + 1, 0) // bs, needed - 1),
+        0,
+    )
+    return first, needed
+
+
+def _ragged_kernel(
+    meta_ref,  # scalar-prefetch [G, 4] int32: (row, q_start, q_len, kind)
+    table_ref,  # scalar-prefetch [R, MB] int32
+    win_ref,  # scalar-prefetch [1] int32: sliding window (<= 0 = full)
+    q_ref,  # [1, tq, 1, group, Dh] VMEM (one query tile, one kv head)
+    k_ref,  # [1, 1, bs, Dh] VMEM (one physical pool block)
+    v_ref,  # [1, 1, bs, Dh] VMEM
+    *rest,  # quant: (ks_ref, vscale_ref, o_ref, scratch...) else (o_ref, ...)
+    bs: int,
+    MB: int,
+    tq: int,
+    group: int,
+    scale: float,
+    softcap: float | None,
+    quant: bool = False,
+):
+    del table_ref  # physical placement is the index maps' concern
+    if quant:
+        ks_ref, vscale_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        ks_ref = vscale_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
+    g = pl.program_id(0)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+    q_start = meta_ref[g, 1]
+    q_len = meta_ref[g, 2]  # 0 = dead (launch-padding) tile
+    win = win_ref[0]
+    rows = tq * group
+    Dh = q_ref.shape[-1]
+    first, needed = _ragged_live_range(q_start, q_len, bs=bs, MB=MB, win=win)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full((rows, 1), _NEG, jnp.float32)
+        l_ref[:] = jnp.zeros((rows, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((rows, Dh), jnp.float32)
+
+    @pl.when((q_len > 0) & (j >= first) & (j < needed))
+    def _():
+        # Row r of the tile is (local query t = r // group, head g = r %
+        # group); its absolute position is q_start + t — the SAME GQA
+        # row-folding as the decode kernel, with tq queries per tile
+        # instead of one.
+        q = q_ref[0].reshape(rows, Dh).astype(jnp.float32) * scale
+        ks = k_ref[0, 0].astype(jnp.float32)  # [bs, Dh]
+        vs = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            ks = ks * ks_ref[0, 0][:, None]
+            vs = vs * vscale_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [rows, bs]
+        if softcap is not None:  # Gemma-2 logit capping, pre-mask (HF order)
+            s = softcap * jnp.tanh(s / softcap)
+        t_local = jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 0) // group
+        q_pos = q_start + t_local
+        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (rows, bs), 1)
+        mask = (t_local < q_len) & (kv_pos <= q_pos)
+        mask &= (win <= 0) | (kv_pos > q_pos - win)
+        s = jnp.where(mask, s, _NEG)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)  # first block: exp(_NEG - _NEG) == 1
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, vs, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == n_j - 1)
+    def _():
+        l = l_ref[:]
+        l = jnp.where(l == 0.0, 1.0, l)  # padding rows are fully masked
+        o_ref[0] = (
+            (acc_ref[:] / l).reshape(tq, 1, group, Dh).astype(o_ref.dtype)
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "window", "scale", "softcap")
+)
+def ragged_paged_attend(
+    q: jnp.ndarray,
+    pool_k,
+    pool_v,
+    table: jnp.ndarray,
+    meta: jnp.ndarray,
+    window_dyn: jnp.ndarray | None = None,
+    *,
+    window: int | None = None,
+    scale: float | None = None,
+    softcap: float | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Mixed prefill + decode GQA attention over the (already updated)
+    block pool — one launch for rows of ARBITRARY per-row length.
+
+    q [W, H, Dh]: the flat query-token axis — every row's tokens laid out
+    back to back at query-tile granularity (tq = W // meta.shape[0]); a
+    prefill row contributes its chunk, a decode row one token.
+    pool_k/v [N, KV, bs, Dh] (one layer's pool slice) — or
+    ops/kv_quant.KVQuant leaves (int8 blocks + per-(token, head) fp32
+    scales), dequantized in the block prologue.
+    table [R, MB] int32 physical block ids, one row per fleet row.
+    meta [G, 4] int32 per-tile metadata (row, q_start, q_len, kind), the
+    host-built launch plan (engine/paged.build_ragged_meta): q_start is
+    the tile's first ABSOLUTE position, q_len its valid queries (0 =
+    launch-padding tile — its row/q_start repeat the predecessor's so the
+    clamped KV index repeats and Pallas skips the DMA), kind is
+    RAGGED_PREFILL / RAGGED_DECODE (launch accounting; the math is
+    uniform — a decode row is simply q_len == 1 at its own position).
+    window / window_dyn / scale / softcap: as `paged_flash_attend`.
+    Returns [W, H, Dh] in q.dtype: each query token's attention output
+    over its row's KV prefix (positions 0..q_pos through the block
+    table), which is exactly the bucketed scratch prefill's per-token
+    contract — so one compiled program replaces the whole bucket ladder.
+    """
+    from .flash_attention import resolve_interpret
+    from .kv_quant import KVQuant
+
+    quant = isinstance(pool_k, KVQuant)
+    if quant:
+        pool_k, k_scale = pool_k.q, pool_k.s
+        pool_v, v_scale = pool_v.q, pool_v.s
+    W, H, Dh = q.shape
+    G = meta.shape[0]
+    tq = W // G
+    assert tq * G == W, "flat query axis must be a whole number of tiles"
+    KV, bs = pool_k.shape[1], pool_k.shape[2]
+    MB = table.shape[1]
+    group = H // KV
+
+    interpret = resolve_interpret(interpret)
+
+    q5 = q.reshape(G, tq, KV, group, Dh)
+    table = table.astype(jnp.int32)
+    meta = meta.astype(jnp.int32)
+    if window_dyn is None:
+        win_arr = jnp.full((1,), window if window is not None else -1, jnp.int32)
+    else:
+        win_arr = jnp.reshape(window_dyn.astype(jnp.int32), (1,))
+
+    def kv_index(g, kv, j, meta_ref, table_ref, win_ref):
+        # Clamp dead logical blocks to the tile's live range; pad tiles
+        # (q_len == 0) share their predecessor's (row, q_start), so their
+        # whole walk repeats the previous tile's physical indices and
+        # Pallas skips every DMA. The kernel's pl.when gate skips the
+        # compute either way.
+        first, needed = _ragged_live_range(
+            meta_ref[g, 1], meta_ref[g, 2], bs=bs, MB=MB, win=win_ref[0]
+        )
+        row = jnp.maximum(meta_ref[g, 0], 0)
+        return (table_ref[row, jnp.clip(j, first, needed - 1)], kv, 0, 0)
+
+    def kv_index_3(g, kv, j, meta_ref, table_ref, win_ref):
+        # the quant-scale operands [N, KV, bs]: same table walk, one rank
+        # down
+        return kv_index(g, kv, j, meta_ref, table_ref, win_ref)[:3]
+
+    kernel = functools.partial(
+        _ragged_kernel,
+        bs=bs,
+        MB=MB,
+        tq=tq,
+        group=group,
+        scale=scale if scale is not None else Dh**-0.5,
+        softcap=softcap,
+        quant=quant,
+    )
+    rows = tq * group
+    in_specs = [
+        pl.BlockSpec(
+            (1, tq, 1, group, Dh),
+            lambda g, kv, j, meta_ref, table_ref, win_ref: (g, 0, kv, 0, 0),
+        ),
+        pl.BlockSpec((1, 1, bs, Dh), kv_index),
+        pl.BlockSpec((1, 1, bs, Dh), kv_index),
+    ]
+    operands = [q5, pool_k, pool_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs), kv_index_3),
+            pl.BlockSpec((1, 1, bs), kv_index_3),
+        ]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(G, KV, MB),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, tq, 1, group, Dh),
+            lambda g, kv, j, meta_ref, table_ref, win_ref: (g, 0, kv, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((G, tq, KV, group, Dh), q.dtype),
+        interpret=interpret,
+    )(meta, table, win_arr, *operands)
+    return out.reshape(W, H, Dh)

@@ -657,6 +657,141 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped)
 
+    # -- ragged paged ingest on the pp ring (engine/paged.py twins) ----------
+    @property
+    def supports_ragged_fill(self) -> bool:
+        """Ragged pool prefill on the pipeline mesh: same dp == 1 / family
+        constraints as the rest of the paged fleet. The flat token axis is
+        fleet-shaped (W rows of T=1 at per-token positions), so it rides
+        the same gated microstep ring as paged slot decode — ungated
+        microsteps redirect their block writes to the trash block through
+        the ragged hook's update_gate, exactly like the decode hook."""
+        return self.supports_paged
+
+    def extend_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
+                            table):
+        fn = self._programs.get("extend_ragged_paged")
+        if fn is None:
+            fn = self._build_extend_ragged_paged()
+            self._programs["extend_ragged_paged"] = fn
+        return fn(self.shared, self.layers, tokens, tok_row, tok_pos, meta,
+                  pool, table)
+
+    def _build_extend_ragged_paged(self):
+        """shard_map twin of engine/paged.extend_ragged_paged: each of the
+        S ring microsteps runs the local layer shard over the flat token
+        fleet with the ragged fill hook; the pool is donated (updated in
+        place), the table/metadata replicate."""
+        cfg = self.cfg
+        from ..engine import paged as EP
+        from .partition import pool_spec
+
+        def body(shared, layers, tokens, tok_row, tok_pos, meta, pool,
+                 table):
+            hook = EP.make_ragged_fill_hook(table, meta, tok_row)
+            x = embed_sharded(cfg, shared, tokens[:, None], tok_pos, self.pp)
+            _, pool = self._microstep_loop(
+                layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1
+            )
+            return pool
+
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(), P(), P(), P(),
+                pool_spec(cfg), P(),
+            ),
+            out_specs=pool_spec(cfg),
+        )
+        return jax.jit(shmapped, donate_argnums=(6,))
+
+    def prefill_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
+                             table, sample_at, key, sampling, presence=None,
+                             bias=None):
+        pres = presence is not None
+        wb = bias is not None
+        mkey = ("prefill_ragged_paged", pres, wb)
+        fn = self._programs.get(mkey)
+        if fn is None:
+            fn = self._build_prefill_ragged_paged(pres, wb)
+            self._programs[mkey] = fn
+        args = [self.shared, self.layers, tokens, tok_row, tok_pos, meta,
+                pool, table, sample_at, key, sampling]
+        if pres:
+            args.append(presence)
+        if wb:
+            args.append(bias)
+        return fn(*args)
+
+    def _build_prefill_ragged_paged(self, with_presence: bool,
+                                    with_bias: bool):
+        """Final ragged launch on the ring: after the microstep loop the
+        real final-stage output sits on stage 0; the sampled flat position
+        is sliced there, psum-broadcast, and unembedded through the vocab
+        shards — the same replicated-logits sampling discipline as every
+        other pp program, so tokens are identical on every device."""
+        cfg, S = self.cfg, self.pp
+        from ..engine import paged as EP
+        from .partition import pool_spec
+
+        def body(shared, layers, tokens, tok_row, tok_pos, meta, pool,
+                 table, sample_at, key, sampling, *extra):
+            i = 0
+            presence = bias = None
+            if with_presence:
+                presence = extra[i]
+                i += 1
+            if with_bias:
+                bias = extra[i]
+                i += 1
+            hook = EP.make_ragged_fill_hook(table, meta, tok_row)
+            s = jax.lax.axis_index(AXIS_PP)
+            x = embed_sharded(cfg, shared, tokens[:, None], tok_pos, S)
+            buf, pool = self._microstep_loop(
+                layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1
+            )
+            last = jax.lax.dynamic_slice_in_dim(buf, sample_at, 1, axis=0)
+            last = jax.lax.psum(
+                jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
+            )  # [1, 1, D]
+            logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
+            first = sample_token(
+                key, logits, *sampling, presence=presence, bias=bias
+            )
+            return first, logits, pool
+
+        specs = [
+            self._shared_specs, self._layer_specs, P(), P(), P(), P(),
+            pool_spec(cfg), P(), P(), P(), P(),
+        ]
+        if with_presence:
+            specs.append(P())
+        if with_bias:
+            specs.append(P())
+        shmapped = self._shard(
+            body,
+            in_specs=tuple(specs),
+            out_specs=(P(), P(), pool_spec(cfg)),
+        )
+        return jax.jit(shmapped, donate_argnums=(6,))
+
+    def arm_slot_paged(self, state, sparams, slot, *arm):
+        # state/sparams are replicated — the shared jitted arm program
+        # (engine/paged.arm_slot_only) runs on them directly, no shard_map
+        from ..engine import paged as EP
+
+        return EP.arm_slot_only(self.cfg, state, sparams, slot, *arm)
+
+    def ragged_program_count(self) -> int:
+        """Compiled ragged-ingest programs resident on this backend (the
+        dli_ragged_compiled_programs gauge: flat after warmup = no
+        per-tail recompile)."""
+        return sum(
+            1 for k in self._programs
+            if (isinstance(k, str) and k == "extend_ragged_paged")
+            or (isinstance(k, tuple) and k and k[0] == "prefill_ragged_paged")
+        )
+
     def _build_decode_slots_paged(self, num_steps: int):
         """Paged twin of _build_decode_slots: each of the S ring
         microsteps runs the local layer shard over the slot fleet with the

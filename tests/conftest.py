@@ -8,6 +8,12 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Run every Pallas kernel (flash / paged decode / ragged) in interpret
+# mode regardless of backend (ops/flash_attention.resolve_interpret reads
+# this), so tier-1 exercises the kernels' exact math on CPU — the ragged
+# kernel's bit-exactness suite (tests/test_ragged_attention.py) depends
+# on it. Set to "0" to force real Mosaic lowering on a TPU host.
+os.environ.setdefault("DLI_PALLAS_INTERPRET", "1")
 
 import jax
 

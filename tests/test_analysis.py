@@ -480,6 +480,22 @@ def test_fault_hooks_decode_unreachable(real_reachable):
         assert key not in real_reachable, key
 
 
+def test_ragged_host_planner_decode_unreachable(real_reachable):
+    """The ragged launch planner (engine/paged.build_ragged_meta — numpy
+    metadata assembly) and the continuous engine's launch-loop callers
+    are strictly host-side: none may be reachable from a jit root, or
+    their numpy work would land inside compiled programs. The TRACED half
+    of the ragged path (make_ragged_fill_hook's closure, the kernel) must
+    stay reachable — that is what the host-sync rule audits."""
+    for key in [
+        ("engine.paged", "build_ragged_meta"),
+        ("engine.continuous", "ContinuousEngine._ragged_ingest"),
+        ("engine.continuous", "ContinuousEngine._ragged_launch_args"),
+    ]:
+        assert key not in real_reachable, key
+    assert ("engine.paged", "make_ragged_fill_hook.hook") in real_reachable
+
+
 def test_router_tier_decode_unreachable(real_reachable):
     """The replica router (serving/router.py) is host-side glue — an
     HTTP front tier that never touches an engine or jax. Nothing in it

@@ -454,11 +454,15 @@ def test_hit_depth_degrades_to_fit_buckets(base_engine):
     depth instead of falling all the way back to cold — found driving
     the HTTP surface with the default bucket ladder (smallest bucket 64,
     window 128: a 96-token-deep hit can never plan, a 64-token one can).
+    BUCKETED FALLBACK ONLY (ragged_prefill=False): the ragged ingest has
+    no bucket ladder and reuses at exact depth — that contract is pinned
+    in tests/test_ragged_attention.py's exact-depth regression.
     """
     eng = InferenceEngine(
         base_engine.cfg, params=base_engine.backend.params,
         engine_cfg=EngineConfig(
-            prefill_buckets=(64,), prefix_cache_entries=4
+            prefill_buckets=(64,), prefix_cache_entries=4,
+            ragged_prefill=False,
         ),
     )
     p = SHARED + "first question"  # ~98 tokens; full-depth reuse = 96
