@@ -243,6 +243,105 @@ def check_ragged_no_recompile(engine=None) -> list:
     return []
 
 
+def _mixed_args(engine, n_decode: int, chunk: int, width: int = 32):
+    """Operand tuple for the mixed scheduler step program
+    (engine/paged.mixed_step_ragged) on the tiny config: `n_decode`
+    decode rows + one `chunk`-token prefill chunk on a 2-slot fleet with
+    attn_impl="pallas" — the launch the chunked-prefill scheduler
+    dispatches every step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engine import generate as G
+    from ..engine import paged as EP
+
+    cfg = engine.cfg.replace(attn_impl="pallas")
+    bs, MB, B = 16, 4, 2
+    pool = EP.init_pool(cfg, 2 * MB + 2, bs)
+    table = jnp.asarray(
+        [list(range(1, MB + 1)), list(range(MB + 1, 2 * MB + 1))], jnp.int32
+    )
+    entries = [
+        (b, 4 + b, 1, EP.RAGGED_DECODE) for b in range(n_decode)
+    ] + [(1, 0, chunk, EP.RAGGED_PREFILL)]
+    meta, tok_row, tok_pos, offsets, _ = EP.build_ragged_meta(
+        entries, width=width, tile=8,
+    )
+    toks = np.zeros((width,), np.int32)
+    dec_flag = np.zeros((width,), bool)
+    dec_idx = np.zeros((B,), np.int32)
+    for b in range(n_decode):
+        dec_flag[offsets[b]] = True
+        dec_idx[b] = offsets[b]
+    off = offsets[n_decode]
+    toks[off : off + chunk] = 1
+    state, sparams = G.init_slots(B, cfg.vocab_size)
+    arm = EP.idle_mixed_arm(B, cfg.vocab_size)._replace(
+        on=jnp.asarray([False, True]),
+        idx=jnp.asarray([0, off + chunk - 1], jnp.int32),
+        prompt_len=jnp.asarray([0, chunk], jnp.int32),
+        max_tokens=jnp.asarray([0, 4], jnp.int32),
+    )
+    return (
+        cfg, engine.backend.params, jnp.asarray(toks), jnp.asarray(tok_row),
+        jnp.asarray(tok_pos), jnp.asarray(dec_flag), jnp.asarray(meta),
+        pool, table, state, sparams, jax.random.PRNGKey(0),
+        jnp.asarray(dec_idx), arm,
+    )
+
+
+def lower_mixed_step(engine=None, n_decode: int = 1, chunk: int = 9) -> str:
+    """StableHLO of the REAL mixed scheduler launch (decode rows +
+    prefill chunks in one program) — declared pool donation intact."""
+    from ..engine import paged as EP
+
+    engine = engine or tiny_engine()
+    return EP.mixed_step_ragged.lower(
+        *_mixed_args(engine, n_decode, chunk)
+    ).as_text()
+
+
+def check_mixed_shape_stability(engine=None) -> list:
+    """Two DIFFERENT launch compositions (decode-row count, chunk length)
+    must lower to the IDENTICAL program: the scheduler re-plans the mix
+    every step, so any composition-dependent shape would recompile
+    per step — the chunked-prefill equivalent of the bucket ladder."""
+    engine = engine or tiny_engine()
+    a = lower_mixed_step(engine, n_decode=1, chunk=9)
+    b = lower_mixed_step(engine, n_decode=2, chunk=14)
+    if a != b:
+        return [
+            "mixed scheduler step lowered DIFFERENT programs for two "
+            "launch compositions — some per-step plan value became "
+            "shape-specializing (compile-per-step in production)"
+        ]
+    return []
+
+
+def check_mixed_no_recompile(engine=None) -> list:
+    """Execute the mixed step with two different compositions; the jit
+    cache must not grow."""
+    import jax
+
+    from ..engine import paged as EP
+
+    engine = engine or tiny_engine()
+    out = EP.mixed_step_ragged(*_mixed_args(engine, 1, 9))
+    jax.block_until_ready(out[0])
+    size_after_first = EP.mixed_step_ragged._cache_size()
+    out = EP.mixed_step_ragged(*_mixed_args(engine, 2, 14))
+    jax.block_until_ready(out[0])
+    size_after_second = EP.mixed_step_ragged._cache_size()
+    if size_after_second > size_after_first:
+        return [
+            f"mixed scheduler step recompiled across launch compositions "
+            f"(jit cache grew {size_after_first} -> {size_after_second}) — "
+            f"the launch width must be the only shape"
+        ]
+    return []
+
+
 def pp_available() -> bool:
     import jax
 
@@ -324,6 +423,19 @@ def run_hlo_checks() -> dict:
     results["ragged-prefill-callbacks"] = check_no_host_callbacks(ragged)
     results["ragged-shape-stability"] = check_ragged_shape_stability(engine)
     results["ragged-recompile-guard"] = check_ragged_no_recompile(engine)
+
+    # mixed scheduler step (engine/scheduler.py + engine/paged.
+    # mixed_step_ragged): the chunked-prefill launch must stay ONE
+    # host-sync-free program across every per-step launch composition —
+    # the scheduler re-plans the decode/prefill mix every step, so a
+    # composition-dependent shape would compile per step
+    mixed = lower_mixed_step(engine)
+    results["sched-mixed-callbacks"] = check_no_host_callbacks(mixed)
+    results["sched-mixed-donation"] = check_donation(mixed, min_aliased=2)
+    results["sched-mixed-shape-stability"] = check_mixed_shape_stability(
+        engine
+    )
+    results["sched-mixed-recompile-guard"] = check_mixed_no_recompile(engine)
 
     if pp_available():
         pp = lower_pp_decode()

@@ -359,6 +359,35 @@ class EngineConfig:
     # dead tiles the kernel's DMA skips). Rounded up to a multiple of the
     # query tile (8).
     ragged_width: int = 64
+    # SLO-aware chunked-prefill scheduler (engine/scheduler.py): ragged
+    # paged fleets stop prefilling an admission whole before it joins the
+    # decode fleet — each scheduler step assembles ONE mixed ragged launch
+    # of every active DECODE row plus PREFILL chunks of pending
+    # admissions, sliced to the per-step flat-token budget below, so a
+    # long prompt never stalls the decoding requests' TPOT. False (or a
+    # non-ragged fleet) falls back to admit-then-prefill-whole.
+    chunked_prefill: bool = True
+    # Per-step flat-token budget of the mixed launch (rounded up to a
+    # whole number of query tiles, and to at least one prefill tile above
+    # the decode fleet — every active slot's decode row is reserved ahead
+    # of any prefill chunk, so decode can never be starved by prefill and
+    # at least one pending prefill always progresses).
+    step_token_budget: int = 128
+    # SLO classes: (name, ttft_target_s, tpot_target_s, weight,
+    # sheddable). The scheduler apportions the per-step prefill budget
+    # across classes by weight x urgency (urgency = queue head wait over
+    # the class TTFT target, fed back from the request timing samples),
+    # and admission sheds a sheddable class's request with a 429 when its
+    # class-local queue drain estimate already overruns the TTFT target
+    # (Retry-After derived from THAT class's drain estimate, never the
+    # global queue depth). Non-sheddable classes only queue.
+    slo_classes: tuple = (
+        ("interactive", 0.5, 0.1, 4.0, True),
+        ("standard", 2.0, 0.5, 2.0, True),
+        ("batch", 30.0, 2.0, 1.0, False),
+    )
+    # Class assigned when a request carries no slo_class field.
+    slo_default_class: str = "standard"
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":

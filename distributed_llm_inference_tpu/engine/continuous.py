@@ -79,12 +79,16 @@ class _Request:
         "first_id", "tokens", "slot", "enqueued", "budget",
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
         "cancelled", "prompt_tokens", "block_ids", "need", "cart",
-        "trace", "salvaged", "strikes", "allowed",
+        "trace", "salvaged", "strikes", "allowed", "slo",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
                  request_id=None):
         self.prompt = prompt
+        # SLO class name (engine/scheduler.py): resolved against the
+        # configured classes at enqueue; drives prefill-budget
+        # apportionment, shed decisions, and class-aware Retry-After
+        self.slo = kwargs.pop("slo_class", None)
         self.kwargs = kwargs
         # per-request stage trace (utils/tracing.py): queue_wait /
         # admission / decode / detokenize spans + the request id echoed
@@ -268,9 +272,48 @@ class ContinuousEngine:
             ) * self._ragged_tile
         else:
             self._ragged = False
+            self._ragged_tile = 8
             self._scratch_seq = self.slot_max_seq
             self.cache = self.backend.init_cache(
                 self.n_slots, self.slot_max_seq
+            )
+        # SLO-aware chunked-prefill scheduler (engine/scheduler.py): the
+        # ragged paged fleet stops prefilling admissions whole — each
+        # scheduler step is ONE mixed launch of every active decode row
+        # plus budget-sliced prefill chunks. The TokenBudgetScheduler is
+        # built for EVERY fleet mode (its SLO classification, per-class
+        # feedback, shed decisions, and class-aware Retry-After apply to
+        # admission regardless of ingest strategy); only the step
+        # planning needs the mixed ragged program.
+        from .scheduler import TokenBudgetScheduler, parse_slo_classes
+
+        self._slo = parse_slo_classes(engine.engine_cfg)
+        self._sched = TokenBudgetScheduler(
+            self._slo, engine.engine_cfg.slo_default_class,
+            int(engine.engine_cfg.step_token_budget), self._ragged_tile,
+            self.n_slots, registry=engine.metrics,
+        )
+        self._chunked = bool(
+            self._ragged
+            and engine.engine_cfg.chunked_prefill
+            and getattr(engine.backend, "supports_mixed_step", False)
+        )
+        # chunked-mode host state: pending PrefillJobs (arrival order),
+        # slot -> job for slots whose prompt is still landing, and the
+        # host's position model per slot (exact for live rows — used for
+        # the decode tiles' kernel metadata; over-advance on rows that
+        # went inactive since the last fetch is masked garbage, the
+        # frozen-row argument)
+        self._jobs: list = []
+        self._prefilling: dict = {}
+        self._host_pos = np.zeros((self.n_slots,), np.int64)
+        self._idle_arm = None
+        if self._chunked:
+            from . import paged as _P_arm
+
+            self._sched_width = self._sched.width
+            self._idle_arm = _P_arm.idle_mixed_arm(
+                self.n_slots, cfg.vocab_size
             )
         self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
         # Grammar-constraint fleet state (constrain/): per-slot FSM rows
@@ -427,6 +470,22 @@ class ContinuousEngine:
             "compiled ragged ingest programs (flat after warmup = no "
             "per-tail-shape recompile)",
         ).labels()
+        # chunked-prefill scheduler families (pre-registered in
+        # engine/engine.py): mixed-launch composition — how much of each
+        # step's flat-token budget went to decode rows vs prefill chunks
+        self._m_sched_tokens = m.counter(
+            "dli_sched_step_tokens_total",
+            "flat tokens launched by the chunked-prefill scheduler, by "
+            "kind (decode rows / prefill chunk tokens)", ("kind",),
+        )
+        self._m_sched_chunks = m.counter(
+            "dli_sched_prefill_chunks_total",
+            "prefill chunks interleaved into mixed scheduler launches",
+        ).labels()
+        self._m_sched_rows = m.counter(
+            "dli_sched_decode_rows_total",
+            "decode rows carried by mixed scheduler launches",
+        ).labels()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-engine"
         )
@@ -467,10 +526,33 @@ class ContinuousEngine:
                 return True
         return False
 
+    def _note_queue_locked(self):
+        """Refresh the global + per-SLO-class queue-depth gauges (caller
+        holds the lock). One helper so every queue mutation keeps both
+        views consistent."""
+        self._m_depth.set(len(self._queue))
+        counts: dict = {}
+        for r in self._queue:
+            counts[r.slo] = counts.get(r.slo, 0) + 1
+        for name in self._slo:
+            self._sched.set_depth(name, counts.get(name, 0))
+
+    def _class_depth_locked(self, cls_name: str) -> int:
+        return sum(1 for r in self._queue if r.slo == cls_name)
+
     def _enqueue(self, req: _Request) -> Optional[dict]:
         """Admit a request to the bounded queue. Returns an error envelope
         (caller delivers it OUTSIDE any lock — a streaming caller yields to
-        a possibly-slow socket write) or None on success."""
+        a possibly-slow socket write) or None on success.
+
+        SLO admission control (engine/scheduler.py): the request's class
+        resolves here; a full queue AND an over-target sheddable class
+        both shed with 429, and in BOTH cases Retry-After derives from
+        the CLASS's queue drain estimate (depth x observed per-request
+        service time), never the global queue depth — a deep batch
+        backlog must not tell an interactive client to stay away."""
+        cls = self._sched.classify(req.slo)
+        req.slo = cls.name
         with self._cv:
             if self._closed:
                 return {
@@ -485,22 +567,46 @@ class ContinuousEngine:
                     "error": "Error: server draining", "status": "failed",
                     "error_type": "draining",
                 }
+            class_depth = self._class_depth_locked(cls.name)
             if len(self._queue) >= self.max_queue:
-                log.warning("queue_full", depth=len(self._queue))
+                log.warning("queue_full", depth=len(self._queue),
+                            slo_class=cls.name)
                 self._m_shed.inc()
-                # queue-depth-derived Retry-After hint (serving edge maps
-                # it to the 429's header): ~one second per fleet-width of
-                # backlog ahead of the shed request
+                self._sched.count_shed(cls.name)
                 return {
                     "error": f"Error: request queue full ({self.max_queue})",
                     "status": "failed",
                     "error_type": "overloaded",
-                    "retry_after_s": overload_retry_after(
-                        len(self._queue), self.n_slots
+                    "slo_class": cls.name,
+                    "retry_after_s": self._sched.retry_after_s(
+                        cls, class_depth
+                    ),
+                }
+            if self._sched.should_shed(cls, class_depth):
+                # the class's drain estimate already overruns its TTFT
+                # target: admitting would burn prefill budget on a
+                # request whose SLO is unmeetable — shed it now with the
+                # class-local horizon
+                log.warning(
+                    "slo_shed", slo_class=cls.name, depth=class_depth,
+                    ttft_target_s=cls.ttft_target_s,
+                )
+                self._m_shed.inc()
+                self._sched.count_shed(cls.name)
+                return {
+                    "error": (
+                        f"Error: {cls.name} queue drain estimate exceeds "
+                        f"the {cls.ttft_target_s:g}s TTFT target"
+                    ),
+                    "status": "failed",
+                    "error_type": "overloaded",
+                    "slo_class": cls.name,
+                    "retry_after_s": self._sched.retry_after_s(
+                        cls, class_depth
                     ),
                 }
             self._queue.append(req)
-            self._m_depth.set(len(self._queue))
+            self._note_queue_locked()
             self._cv.notify_all()
         return None
 
@@ -561,7 +667,7 @@ class ContinuousEngine:
         with self._cv:
             if req in self._queue:
                 self._queue.remove(req)
-                self._m_depth.set(len(self._queue))
+                self._note_queue_locked()
                 req.result = {
                     "error": "Error: request cancelled", "status": "failed",
                     "error_type": "cancelled",
@@ -669,7 +775,7 @@ class ContinuousEngine:
         with self._cv:
             pending = self._queue[:]
             self._queue.clear()
-            self._m_depth.set(0)
+            self._note_queue_locked()
         for req in pending + [r for r in self._assignment if r is not None]:
             if req.result is None:
                 req.result = dict(fail)
@@ -736,6 +842,27 @@ class ContinuousEngine:
             }
             if self._ragged:
                 out["paged"]["ragged_width"] = self._ragged_width
+        out["slo"] = {
+            "default": self._sched.default_name,
+            "classes": {
+                name: {
+                    "ttft_target_s": c.ttft_target_s,
+                    "tpot_target_s": c.tpot_target_s,
+                    "weight": c.weight,
+                    "sheddable": c.sheddable,
+                    "ttft_ewma_s": self._sched.feedback[name].ttft_ewma,
+                    "tpot_ewma_s": self._sched.feedback[name].tpot_ewma,
+                }
+                for name, c in self._slo.items()
+            },
+        }
+        if self._chunked:
+            out["scheduler"] = {
+                "chunked_prefill": True,
+                "step_width": self._sched_width,
+                "tile": self._ragged_tile,
+                "prefilling": len(self._jobs),
+            }
         cstats = self._ctable.stats()
         if cstats["resident"]:
             out["constraints"] = cstats
@@ -779,6 +906,14 @@ class ContinuousEngine:
             ]
             self._assignment = [None] * self.n_slots
             admitting, self._admitting = self._admitting, None
+        # chunked-prefill state dies with the fleet: jobs' requests are
+        # casualties above (they sat in _assignment from job start), and
+        # progress resets — the rebuilt pool holds none of their chunks,
+        # so recovery re-plans each salvage from its last durable
+        # boundary (zero; `done` was chunk-aligned by construction)
+        self._jobs = []
+        self._prefilling = {}
+        self._host_pos[:] = 0
         if (
             admitting is not None and admitting not in running
             and not admitting.done.is_set()
@@ -885,7 +1020,7 @@ class ContinuousEngine:
                 self._closed = True
                 pending = self._queue[:]
                 self._queue.clear()
-                self._m_depth.set(0)
+                self._note_queue_locked()
                 self._cv.notify_all()
             fail = {
                 "error": f"Error: continuous scheduler died after "
@@ -1002,7 +1137,7 @@ class ContinuousEngine:
                     # the FRONT of the normal queue
                     with self._cv:
                         self._queue.insert(0, req)
-                        self._m_depth.set(len(self._queue))
+                        self._note_queue_locked()
                     continue
                 if first_dev is None:
                     continue  # failed fast (cancelled/deadline); result set
@@ -1078,6 +1213,12 @@ class ContinuousEngine:
         # after a supervisor restart: serially re-admit salvaged requests
         # (no-op on a clean start; also clears the restarting flag)
         self._run_recovery()
+        if self._chunked:
+            # SLO-aware chunked-prefill scheduling (engine/scheduler.py):
+            # admissions land chunk by chunk inside mixed launches
+            # instead of prefilling whole before the fleet advances
+            self._sched_loop(inflight)
+            return
         while True:
             with self._cv:
                 while (
@@ -1105,6 +1246,457 @@ class ContinuousEngine:
                                 or not launched):
                 self._process(inflight.popleft())
                 launched = True  # drain one per wakeup once non-empty
+
+    # -- chunked-prefill scheduler loop (engine/scheduler.py) ----------------
+    def _sched_loop(self, inflight: collections.deque):
+        """Token-budget scheduling: each iteration starts any queued
+        requests a free slot + pool blocks can take (as PrefillJobs — no
+        device work yet), then launches ONE step. With pending prefill
+        work the step is a MIXED ragged launch (every active decode row
+        plus budget-sliced prefill chunks — engine/paged.
+        mixed_step_ragged); a fleet with no prefill pending falls back to
+        the amortized multi-step decode chunk, which runs the identical
+        slot_step math over the same pool. Lag pipelining, crash
+        supervision, drain, and recovery all work exactly as in the
+        whole-prefill loop — mixed steps plan from the host position
+        model and gather decode tokens from slot state ON DEVICE, so no
+        fetch is ever needed to launch the next step."""
+        while True:
+            with self._cv:
+                while (
+                    not self._queue
+                    and not any(self._assignment)
+                    and not inflight
+                    and not self._closed
+                ):
+                    self._cv.wait()
+                if self._closed:
+                    return
+            self._reap_jobs()
+            self._start_jobs()
+            if self._jobs:
+                step = self._launch_mixed()
+            else:
+                step = self._launch_chunk()
+                if step is not None:
+                    # host position model: every believed-active slot
+                    # advanced chunk_steps (over-advance on rows that die
+                    # mid-chunk is masked garbage, the frozen-row rule)
+                    for b, r in enumerate(self._assignment):
+                        if r is not None:
+                            self._host_pos[b] += self.chunk_steps
+            launched = step is not None
+            if launched:
+                inflight.append(step)
+            while inflight and (len(inflight) > self.chunk_lag
+                                or not launched):
+                self._process_any(inflight.popleft())
+                launched = True
+
+    def _process_any(self, step):
+        if isinstance(step, tuple) and step and step[0] == "mixed":
+            self._process_mixed(step)
+        else:
+            self._process(step)
+
+    def _reap_jobs(self):
+        """Fail pending prefills whose client went away or whose deadline
+        passed BEFORE spending more budget on them (the mid-decode
+        equivalents live in _distribute)."""
+        deadline = self.engine.engine_cfg.request_deadline_s
+        now = time.time()
+        for job in list(self._jobs):
+            req = job.req
+            if req.cancelled:
+                req.result = {
+                    "error": "Error: request cancelled", "status": "failed",
+                    "error_type": "cancelled",
+                }
+            elif deadline and now - req.t_start > deadline:
+                req.result = {
+                    "error": f"Error: request exceeded the {deadline:g}s "
+                    "deadline",
+                    "status": "failed",
+                    "error_type": "timeout",
+                }
+            else:
+                continue
+            self._m_preempt.labels(
+                reason="cancelled" if req.cancelled else "deadline"
+            ).inc()
+            self._release(req)  # drops the job via the slot mapping
+
+    def _start_jobs(self):
+        """Move queued requests into PrefillJobs while a slot and pool
+        blocks are available. Host-side only — tokenize, plan prefix
+        reuse, allocate blocks, install the slot's block table; the
+        prompt lands chunk by chunk in subsequent mixed launches. Same
+        suspect/_admitting crash discipline as whole-prefill admission."""
+        while True:
+            with self._cv:
+                if not self._queue:
+                    return
+                free = [
+                    b for b, r in enumerate(self._assignment) if r is None
+                ]
+                if not free:
+                    return
+                head = self._queue[0]
+                if (
+                    head.need is not None
+                    and head.need > self._alloc.free_blocks + (
+                        self._bpx.evictable_blocks()
+                        if self._bpx is not None else 0
+                    )
+                ):
+                    # the admission policy's capacity leg: a previously
+                    # sized head that still cannot get blocks (even by
+                    # evicting every unreferenced cached chain) waits for
+                    # a release — no re-tokenize/replan churn per step
+                    return
+                req = self._queue.pop(0)
+                self._note_queue_locked()
+            try:
+                self._suspects.add(req)
+                self._mutation_seq += 1
+                # survives an exception unwind ON PURPOSE (see _admit)
+                self._admitting = req
+                if req.kwargs.get("constraint") is not None:
+                    # constrained requests keep the whole-prefill
+                    # admission path (the mixed program carries no
+                    # first-token bias operand; _needs_solo routes public
+                    # constrained traffic solo anyway — this preserves
+                    # the constraint-table backpressure/leak discipline
+                    # for embedded callers)
+                    first_dev = self._admit_one(req, free[0])
+                    self._admitting = None
+                    if first_dev is _BLOCKED:
+                        with self._cv:
+                            self._queue.insert(0, req)
+                            self._note_queue_locked()
+                        return
+                    if first_dev is not None:
+                        req.first_id = int(np.asarray(first_dev)[0])
+                        req.ttft = time.time() - req.t_start
+                        self._post_admit(req)
+                    continue
+                started = self._start_job(req, free[0])
+                self._admitting = None
+                if started is _BLOCKED:
+                    with self._cv:
+                        self._queue.insert(0, req)
+                        self._note_queue_locked()
+                    return
+            except ValueError as e:
+                self._admitting = None
+                log.warning("invalid_request", error=str(e))
+                req.result = {
+                    "error": f"Error: {e}", "status": "failed",
+                    "error_type": "invalid_request",
+                }
+                self._push_final(req)
+            # any other exception escapes to the supervisor (crash
+            # containment + suspect implication), exactly like _admit
+
+    def _start_job(self, req: _Request, slot: int):
+        """Plan one chunked admission: tokenize, prefix-reuse lookup at
+        EXACT chunk depth, clamp the budget, allocate + map pool blocks,
+        and queue the PrefillJob. Returns _BLOCKED when the pool cannot
+        take it (caller requeues at the front), None when the request
+        failed fast (result already set), or the job."""
+        eng, cfg = self.engine, self.cfg
+        faults.check("admission", tag=req.prompt)
+        req.trace.checkpoint("queue_wait")
+        if req.cancelled:
+            req.result = {
+                "error": "Error: request cancelled", "status": "failed",
+                "error_type": "cancelled",
+            }
+            self._push_final(req)
+            return None
+        deadline = eng.engine_cfg.request_deadline_s
+        if deadline and time.time() - req.enqueued > deadline:
+            req.result = {
+                "error": f"Error: request exceeded the {deadline:g}s "
+                "deadline while queued",
+                "status": "failed",
+                "error_type": "timeout",
+            }
+            self._push_final(req)
+            return None
+        k = req.kwargs
+        text = (
+            eng.render_chat(req.prompt)
+            if k.get("chat", True) else req.prompt
+        )
+        ids = eng.tokenizer.encode(text)
+        req.prompt_tokens = len(ids)
+        if req.salvaged:
+            # crash-recovery continuation: prompt + pre-crash tokens
+            ids = ids + list(req.salvaged)
+        prompt_len = len(ids)
+        p0, entry, plan = eng._prefix_plan(
+            self._bpx, ids, capacity=self.slot_max_seq, ragged=True,
+        )
+        if plan is None:
+            raise ValueError(
+                f"prompt length {prompt_len} exceeds the slot capacity "
+                f"(slot_max_seq {self.slot_max_seq})"
+            )
+        max_tokens, _ = eng._clamp_decode(
+            prompt_len, int(k.get("max_tokens", 20)) - len(req.salvaged),
+            capacity=self.slot_max_seq,
+        )
+        if req.allowed is None:
+            req.allowed = max_tokens
+        else:
+            max_tokens = min(max_tokens, req.allowed - len(req.salvaged))
+        faults.check("alloc", tag=req.prompt)
+        need_total = self._P.blocks_needed(
+            prompt_len, max_tokens, self.kv_block_size
+        )
+        shared = list(entry)[: p0 // self.kv_block_size] if p0 else []
+        n_shared = len(shared)
+        req.need = need_total - n_shared
+        if shared:
+            self._alloc.incref(shared)
+        blk_ids = self._alloc.alloc(req.need)
+        if blk_ids is None and self._bpx is not None:
+            self._bpx.evict(req.need - self._alloc.free_blocks)
+            blk_ids = self._alloc.alloc(req.need)
+        if blk_ids is None:
+            if shared:
+                self._alloc.decref(shared)
+            return _BLOCKED
+        req.block_ids = shared + blk_ids
+        table_row = np.zeros((self._max_blocks,), np.int32)
+        table_row[:need_total] = req.block_ids
+        req.prefix_hit_tokens = p0
+        if p0:
+            self._m_ragged_exact.inc()
+        rp = float(k.get("repetition_penalty", 1.0))
+        presence_row = (
+            np.asarray(eng._presence_rows([ids])[0]) if rp != 1.0
+            else np.zeros((cfg.vocab_size,), bool)
+        )
+        sampling = (
+            float(k.get("temperature", 0.7)), int(k.get("top_k", 50)),
+            float(k.get("top_p", 0.9)), bool(k.get("greedy", False)),
+            float(k.get("min_p", 0.0)), rp,
+            float(k.get("frequency_penalty", 0.0)),
+            float(k.get("presence_penalty", 0.0)),
+        )
+        from .scheduler import PrefillJob
+
+        job = PrefillJob(
+            req, ids, p0, prompt_len, max_tokens, slot, sampling,
+            presence_row, table_row, self._sched.classify(req.slo),
+        )
+        self._table[slot] = table_row
+        self._table_dev = None
+        self._host_pos[slot] = 0
+        req.slot = slot
+        with self._cv:
+            self._assignment[slot] = req
+        self._jobs.append(job)
+        self._prefilling[slot] = job
+        log.info(
+            "prefill_started", slot=slot, prompt_len=prompt_len,
+            tail=job.remaining, prefix_hit=p0, slo_class=job.cls.name,
+            request_id=req.trace.request_id,
+        )
+        return job
+
+    def _launch_mixed(self):
+        """ONE scheduler step: every active decode row plus the budget
+        slice of pending prefill chunks, in one mixed ragged launch.
+        Returns the inflight tuple ("mixed", packed [5, B] dev, decode
+        snapshot, {slot: req} completions, launch time, mutation seq) or
+        None when the fleet is empty."""
+        P = self._P
+        active = [
+            b for b, r in enumerate(self._assignment)
+            if r is not None and b not in self._prefilling
+        ]
+        plan = self._sched.plan(
+            len(active), self._jobs,
+            active_classes={
+                self._assignment[b].slo for b in active
+                if self._assignment[b] is not None
+            },
+        )
+        if not active and not plan:
+            return None
+        faults.check("decode_launch", tag=",".join(
+            r.prompt for r in self._assignment if r is not None
+        ))
+        if plan:
+            faults.check("prefill", tag=",".join(
+                job.req.prompt for job, _ in plan
+            ))
+        W, tile, B = self._sched_width, self._ragged_tile, self.n_slots
+        entries = []
+        for b in active:
+            entries.append((b, int(self._host_pos[b]), 1, P.RAGGED_DECODE))
+        chunk_list = []
+        for job, n in plan:
+            start = job.p0 + job.done
+            entries.append((job.slot, start, n, P.RAGGED_PREFILL))
+            chunk_list.append((job, n, start))
+        meta, tok_row, tok_pos, offsets, stats = P.build_ragged_meta(
+            entries, width=W, tile=tile,
+        )
+        toks = np.zeros((W,), np.int32)
+        dec_flag = np.zeros((W,), bool)
+        dec_idx = np.zeros((B,), np.int32)
+        n_dec = len(active)
+        for b, off in zip(active, offsets[:n_dec]):
+            dec_flag[off] = True
+            dec_idx[b] = off
+        completions = {}
+        arm = self._idle_arm
+        arm_np = None
+        for (job, n, start), off in zip(chunk_list, offsets[n_dec:]):
+            toks[off : off + n] = job.ids[start : start + n]
+            job.done += n
+            if job.remaining == 0:
+                # final chunk: the launch samples this admission's first
+                # token and arms its slot ON DEVICE (vectorized arm_slot
+                # in mixed_step_ragged); the host learns the first token
+                # from the same packed fetch as the decode results
+                if arm_np is None:
+                    arm_np = self._fresh_arm()
+                (on, idx, plen, mtk, sp, presence) = arm_np
+                s = job.slot
+                on[s] = True
+                idx[s] = off + n - 1
+                plen[s] = job.prompt_len
+                mtk[s] = job.max_tokens
+                (sp[0][s], sp[1][s], sp[2][s], sp[3][s], sp[4][s],
+                 sp[5][s], sp[6][s], sp[7][s]) = job.sampling
+                presence[s] = job.presence_row
+                completions[s] = job.req
+                job.req.budget = job.max_tokens - 1
+        if arm_np is not None:
+            (on, idx, plen, mtk, sp, presence) = arm_np
+            arm = P.MixedArm(
+                jnp.asarray(on), jnp.asarray(idx), jnp.asarray(plen),
+                jnp.asarray(mtk),
+                G.SlotParams(*(jnp.asarray(a) for a in sp)),
+                jnp.asarray(presence),
+            )
+        if self._table_dev is None:
+            self._table_dev = jnp.asarray(self._table)
+        packed, self.state, self.sparams, self.cache = (
+            self.backend.mixed_step_ragged(
+                jnp.asarray(toks), jnp.asarray(tok_row),
+                jnp.asarray(tok_pos), jnp.asarray(dec_flag),
+                jnp.asarray(meta), self.cache, self._table_dev,
+                self.state, self.sparams, self._next_key(),
+                jnp.asarray(dec_idx), arm,
+            )
+        )
+        # host position model + completion bookkeeping AFTER the launch
+        # is enqueued (the arming rode the program itself)
+        for b in active:
+            self._host_pos[b] += 1
+        for slot, req in completions.items():
+            job = self._prefilling.pop(slot)
+            self._jobs.remove(job)
+            self._host_pos[slot] = job.prompt_len
+            if self._bpx is not None:
+                # full prompt blocks are complete + immutable once this
+                # launch lands; later gathers serialize behind it on
+                # device — same register point as the whole-prefill path
+                self._bpx.register(job.ids, job.prompt_len, req.block_ids)
+        # launch-composition observability
+        n_pf_tokens = sum(n for _, n, _ in chunk_list)
+        self._m_sched_rows.inc(n_dec)
+        self._m_sched_chunks.inc(len(chunk_list))
+        self._m_sched_tokens.labels(kind="decode").inc(n_dec)
+        self._m_sched_tokens.labels(kind="prefill").inc(n_pf_tokens)
+        if stats["prefill_rows"]:
+            self._m_ragged_rows.labels(kind="prefill").inc(
+                stats["prefill_rows"]
+            )
+        if stats["decode_rows"]:
+            self._m_ragged_rows.labels(kind="decode").inc(
+                stats["decode_rows"]
+            )
+        self._m_ragged_tiles.labels(state="pad").inc(stats["pad_tiles"])
+        self._m_ragged_tiles.labels(state="live").inc(
+            stats["tiles"] - stats["pad_tiles"]
+        )
+        self._m_ragged_launches.labels(phase="mixed").inc()
+        # decode snapshot: only rows DECODING at launch (mid-prefill rows
+        # emit nothing; the completing slot's first decode token arrives
+        # with the NEXT launch) — attribution discipline as ever
+        snapshot = [
+            self._assignment[b] if b in active else None for b in range(B)
+        ]
+        return (
+            "mixed", packed, snapshot, completions, time.perf_counter(),
+            self._mutation_seq,
+        )
+
+    def _fresh_arm(self):
+        """Mutable numpy MixedArm builder (one per launch WITH
+        completions; completion-free steps reuse the device-resident
+        idle arm and ship no [B, V] presence buffer)."""
+        B, V = self.n_slots, self.cfg.vocab_size
+        return (
+            np.zeros((B,), bool), np.zeros((B,), np.int32),
+            np.zeros((B,), np.int32), np.zeros((B,), np.int32),
+            [
+                np.ones((B,), np.float32), np.zeros((B,), np.int32),
+                np.ones((B,), np.float32), np.ones((B,), bool),
+                np.zeros((B,), np.float32), np.ones((B,), np.float32),
+                np.zeros((B,), np.float32), np.zeros((B,), np.float32),
+            ],
+            np.zeros((B, V), bool),
+        )
+
+    def _process_mixed(self, step):
+        """Fetch one mixed step's packed results: first-token bookkeeping
+        for admissions that completed their prefill in that launch, then
+        the shared decode distribution (stop/cancel/deadline/finalize)."""
+        _, packed_dev, snapshot, completions, t_launch, seq = step
+        faults.check("fetch", tag=",".join(
+            r.prompt for r in snapshot if r is not None
+        ))
+        packed = np.asarray(packed_dev)  # [5, B] — the ONE fetch per step
+        self._m_step.observe(max(0.0, time.perf_counter() - t_launch))
+        emitted, mask, active, firsts, armed = packed
+        now = time.time()
+        for slot, req in completions.items():
+            if req.done.is_set():
+                continue
+            req.first_id = int(firsts[slot])
+            if not req.ttft:
+                req.ttft = now - req.t_start
+            req.trace.checkpoint("admission")  # chunked prefill span
+            with self._cv:
+                self.admitted += 1
+                if req.record:
+                    self.engine.request_count += 1
+                occ = sum(r is not None for r in self._assignment)
+                self.peak_occupancy = max(self.peak_occupancy, occ)
+            self._m_occupied.set(occ)
+            if req.record:
+                self._m_admission_wait.observe(now - req.enqueued)
+            log.info(
+                "admitted", slot=slot, prompt_len=req.prompt_tokens,
+                budget=req.budget, occupancy=occ, chunked=True,
+                request_id=req.trace.request_id,
+            )
+            self._post_admit(req)
+        self._distribute(
+            emitted[None, :], mask[None, :].astype(bool),
+            active.astype(bool), snapshot,
+        )
+        self._consecutive_crashes = 0
+        if seq >= self._mutation_seq:
+            self._suspects.clear()
 
     def _admit(self):
         """Prefill + splice every queued request a free slot can take.
@@ -1137,7 +1729,7 @@ class ContinuousEngine:
                     # on every chunk iteration; wait for a release
                     break
                 req = self._queue.pop(0)
-                self._m_depth.set(len(self._queue))
+                self._note_queue_locked()
             try:
                 # suspect-set bookkeeping: this request mutates the fleet
                 # now; until a chunk launched after this point fetches
@@ -1157,7 +1749,7 @@ class ContinuousEngine:
                     # blocks — the fleet keeps decoding meanwhile
                     with self._cv:
                         self._queue.insert(0, req)
-                        self._m_depth.set(len(self._queue))
+                        self._note_queue_locked()
                     break
                 if first_dev is not None:  # None: failed fast (e.g. queued
                     wave.append((req, first_dev))  # past deadline), result set
@@ -1417,6 +2009,10 @@ class ContinuousEngine:
                 )
                 self._table[slot] = table_row
                 self._table_dev = None  # rebuilt at the next chunk launch
+                # chunked mode reaches here through RECOVERY's serialized
+                # whole-prefill re-admissions: seed the host position
+                # model so subsequent mixed launches plan this row exactly
+                self._host_pos[slot] = prompt_len
             elif self.paged:
                 self.cache, self.state, self.sparams = (
                     self.backend.insert_slot_paged(
@@ -1565,6 +2161,20 @@ class ContinuousEngine:
         emitted = packed[:K]
         mask = packed[K : 2 * K].astype(bool)
         active = packed[2 * K].astype(bool)
+        self._distribute(emitted, mask, active, snapshot)
+        # healthy step: the fleet (as launched) fetched clean — reset the
+        # supervisor's consecutive-crash window, and vindicate suspects
+        # when no admission happened after this chunk's launch (an older
+        # chunk's clean fetch says nothing about a newer tenant)
+        self._consecutive_crashes = 0
+        if seq >= self._mutation_seq:
+            self._suspects.clear()
+
+    def _distribute(self, emitted, mask, active, snapshot):
+        """Attribute one fetched launch's emissions ([K, B] + final
+        active row) to the snapshot's tenants and handle stop / cancel /
+        deadline / finalize — ONE copy for the decode-chunk and mixed-
+        scheduler fetch paths."""
         deadline = self.engine.engine_cfg.request_deadline_s
         now = time.time()
         for b, req in enumerate(snapshot):
@@ -1616,13 +2226,6 @@ class ContinuousEngine:
                     "error_type": "timeout",
                 }
                 self._release(req)
-        # healthy step: the fleet (as launched) fetched clean — reset the
-        # supervisor's consecutive-crash window, and vindicate suspects
-        # when no admission happened after this chunk's launch (an older
-        # chunk's clean fetch says nothing about a newer tenant)
-        self._consecutive_crashes = 0
-        if seq >= self._mutation_seq:
-            self._suspects.clear()
 
     def _gen_text(self, req: _Request) -> tuple:
         """(generated ids — crash-salvaged continuation included — then
@@ -1655,6 +2258,13 @@ class ContinuousEngine:
         if req.record:
             self.engine._record_sample(req.ttft, tps, n, elapsed=elapsed,
                                        engine="continuous")
+            # SLO feedback: the same per-request TTFT/TPOT samples the
+            # timing histograms record feed the scheduler's per-class
+            # EWMAs — drain estimates, urgency, and decode protection
+            self._sched.observe(
+                req.slo, req.ttft or None,
+                max(0.0, elapsed - req.ttft) / (n - 1) if n > 1 else None,
+            )
         req.result = {
             "prompt": req.prompt,
             "response": response,
@@ -1676,6 +2286,8 @@ class ContinuousEngine:
                 ) else "length"
             ),
         }
+        if req.slo is not None:
+            req.result["slo_class"] = req.slo
         if req.salvaged:
             # served across a scheduler restart (continuation prefill)
             req.result["recovered"] = True
@@ -1692,6 +2304,13 @@ class ContinuousEngine:
         self._release(req)
 
     def _release(self, req: _Request):
+        if self._chunked and req.slot is not None:
+            # mid-prefill teardown (cancel / deadline / EOS-on-first of a
+            # just-armed admission): drop the job so the planner stops
+            # scheduling chunks for a dead tenant
+            job = self._prefilling.pop(req.slot, None)
+            if job is not None and job in self._jobs:
+                self._jobs.remove(job)
         if req.cart is not None:
             # refcount down; the slot's FSM row back to the free state so
             # the row is inert under any still-constrained chunk program

@@ -238,6 +238,23 @@ class SingleDeviceBackend:
 
         return P.arm_slot_only(self.cfg, state, sparams, slot, *arm)
 
+    # mixed scheduler launch (engine/scheduler.py): every active decode
+    # row plus budget-sliced prefill chunks in ONE ragged program —
+    # decode tokens/positions gathered from slot state on device,
+    # completing admissions sample + arm in the same pass.
+    @property
+    def supports_mixed_step(self):
+        return self.supports_ragged_fill
+
+    def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
+                          pool, table, state, sparams, key, dec_idx, arm):
+        from . import paged as P
+
+        return P.mixed_step_ragged(
+            self.cfg, self.params, tokens, tok_row, tok_pos, dec_flag,
+            meta, pool, table, state, sparams, key, dec_idx, arm,
+        )
+
     def ragged_program_count(self) -> int:
         """Compiled ragged-ingest program count (jit cache entries of the
         two launch programs) — the dli_ragged_compiled_programs gauge:
@@ -439,6 +456,32 @@ class InferenceEngine:
             "dli_ragged_compiled_programs",
             "compiled ragged ingest programs (flat after warmup = no "
             "per-tail-shape recompile)",
+        )
+        # SLO-aware chunked-prefill scheduler families (engine/
+        # scheduler.py labels them when the chunked path is live): mixed-
+        # launch composition plus per-class admission state — pre-
+        # registered here so a scrape's schema is stable across configs
+        self.metrics.counter(
+            "dli_sched_step_tokens_total",
+            "flat tokens launched by the chunked-prefill scheduler, by "
+            "kind (decode rows / prefill chunk tokens)", ("kind",),
+        )
+        self.metrics.counter(
+            "dli_sched_prefill_chunks_total",
+            "prefill chunks interleaved into mixed scheduler launches",
+        )
+        self.metrics.counter(
+            "dli_sched_decode_rows_total",
+            "decode rows carried by mixed scheduler launches",
+        )
+        self.metrics.gauge(
+            "dli_slo_queue_depth",
+            "queued requests per SLO class", ("slo_class",),
+        )
+        self.metrics.counter(
+            "dli_slo_shed_total",
+            "requests shed with 429 by SLO admission control (class drain "
+            "estimate over the TTFT target, or queue full)", ("slo_class",),
         )
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
@@ -704,6 +747,7 @@ class InferenceEngine:
         early_stopping: bool = False,
         constraint: Optional[dict] = None,
         request_id: Optional[str] = None,
+        slo_class: Optional[str] = None,
         _trace: Optional[Trace] = None,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
@@ -748,6 +792,12 @@ class InferenceEngine:
                 logit_bias, num_beams, length_penalty, early_stopping,
                 constraint, t_start, trace,
             )
+            if slo_class is not None:
+                # admission priority is a fleet concept (the continuous
+                # scheduler's SLO classes); the solo path serves directly
+                # but accepts + echoes the class so fleet fallbacks and
+                # class-tagged clients keep one request schema
+                result.setdefault("slo_class", slo_class)
             return self._finish_request(result, trace, engine="solo")
 
     def _generate_traced(
@@ -1942,6 +1992,7 @@ class InferenceEngine:
         stop: Optional[list] = None,
         constraint: Optional[dict] = None,
         request_id: Optional[str] = None,
+        slo_class: Optional[str] = None,
         _trace: Optional[Trace] = None,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).

@@ -63,7 +63,7 @@ north-star scope (serving HBM discipline), not parity scope.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -776,3 +776,143 @@ def arm_slot_only(cfg: ModelConfig, state: G.SlotState,
     so the budget / EOS-on-first semantics cannot drift)."""
     state, sparams = G.arm_slot(cfg, state, sparams, jnp.int32(slot), *arm)
     return state, sparams
+
+
+# -- mixed launch: all decode rows + prefill chunks in ONE program ------------
+#
+# The chunked-prefill scheduler (engine/scheduler.py) stops prefilling an
+# admission whole before it joins the decode fleet: each scheduler step is
+# ONE launch of this program, carrying every active slot's decode token
+# plus budget-sliced PREFILL chunks of pending admissions on the same flat
+# token axis. Decode tokens/positions are gathered FROM THE SLOT STATE on
+# device (the host never fetches to plan the next step — lag pipelining
+# and the zero-host-sync launch invariant both survive), decode sampling
+# is the shared generate.slot_step (cross-mode token parity is
+# structural), and an admission whose FINAL chunk rides this launch
+# samples its first token and arms its slot entirely on device
+# (vectorized generate.arm_slot semantics) — the host learns the first
+# token from the same packed fetch that carries the decode chunk.
+
+
+class MixedArm(NamedTuple):
+    """Per-slot arming operands for prefill chunks COMPLETING in a mixed
+    launch (all [B]-shaped; rows with on=False are untouched). The
+    sampling knobs ride a stacked SlotParams so the armed slot's decode
+    sampling state is set in the same pass."""
+
+    on: jnp.ndarray  # bool [B]: slot completes its prefill this launch
+    idx: jnp.ndarray  # i32 [B]: flat index of its last prompt token
+    prompt_len: jnp.ndarray  # i32 [B]
+    max_tokens: jnp.ndarray  # i32 [B]
+    params: G.SlotParams  # [B]-shaped sampling knobs
+    presence: jnp.ndarray  # bool [B, V]: prompt token sets (host-built)
+
+
+def idle_mixed_arm(n_slots: int, vocab_size: int) -> MixedArm:
+    """An all-off MixedArm (no admission completes this launch)."""
+    z = jnp.zeros((n_slots,), jnp.int32)
+    _, sp = G.init_slots(n_slots, 1)
+    return MixedArm(
+        jnp.zeros((n_slots,), bool), z, z, z,
+        sp, jnp.zeros((n_slots, vocab_size), bool),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
+                      dec_flag, meta, pool, table, state: G.SlotState,
+                      sparams: G.SlotParams, key, dec_idx, arm: MixedArm):
+    """One scheduler step: advance every active slot one decode token AND
+    write the launch's prefill chunks into the pool, in one program.
+
+    tokens/tok_pos [W]: host-planned flat launch (prefill chunk contents;
+    decode positions hold placeholders). dec_flag [W]: True where the
+    flat slot is a decode-row token — its token/position are REPLACED by
+    the owning slot's device state (state.token / state.pos), so the host
+    plans launches ahead of its fetches without ever syncing. meta [G,4] /
+    tok_row [W]: the build_ragged_meta plan (decode tiles' q_start is the
+    host's position model — exact for live rows, masked garbage for rows
+    that went inactive since the last fetch, the frozen-row argument).
+    dec_idx [B]: flat index of each slot's decode token (0 for slots
+    without one — their sampled garbage is gated by state.active exactly
+    like idle rows in decode_slots_paged). arm: completing-prefill
+    operands (MixedArm; all-off most steps).
+
+    Returns (packed [5, B] int32 — emitted / emit_mask / active / firsts /
+    armed, ONE fetch per step — state, sparams, pool)."""
+    from ..models import api as M
+
+    rows_ix = jnp.maximum(tok_row, 0)
+    toks = jnp.where(dec_flag, state.token[rows_ix], tokens)
+    pos = jnp.where(dec_flag, state.pos[rows_ix], tok_pos)
+    x = M.embed(cfg, params, toks[:, None], pos)
+    x, pool = M.forward_layers(
+        cfg, params["layers"], x, pool, pos,
+        attn_hook=make_ragged_fill_hook(table, meta, tok_row),
+        attn_seq_len=1,
+    )
+    # decode: gather each slot's flat position, one shared slot_step —
+    # the same sampler/bookkeeping the whole-chunk decode programs run
+    logits = M.unembed(cfg, params, x[dec_idx])[:, 0, :]  # [B, V]
+    # completing prefills: sample each one's FIRST token off its last
+    # prompt position with its own (stacked) sampling knobs, then arm the
+    # slot in place — vectorized generate.arm_slot (budget / EOS-on-first
+    # decided on device, same as insert_slot)
+    pf_logits = M.unembed(cfg, params, x[arm.idx])[:, 0, :]  # [B, V]
+    packed, state, sparams = mixed_epilogue(
+        cfg, state, sparams, logits, pf_logits, key, arm
+    )
+    return packed, state, sparams, pool
+
+
+def mixed_epilogue(cfg: ModelConfig, state: G.SlotState,
+                   sparams: G.SlotParams, logits, pf_logits, key,
+                   arm: MixedArm):
+    """Sampling/arming tail of the mixed step, ONE copy for the single-
+    device program above and the pp shard_map twin (parallel/pipeline.
+    _build_mixed_step_ragged — both hand replicated [B, V] logits in):
+    slot_step advances the decoding rows, completing prefills sample
+    their first token and arm via the vectorized arm_slot recipe.
+    Returns (packed [5, B], state, sparams)."""
+    from ..ops.sampling import sample_token
+
+    k_dec, k_arm = jax.random.split(key)
+    state, emit, can_emit = G.slot_step(cfg, state, sparams, logits, k_dec)
+    firsts = sample_token(
+        k_arm, pf_logits,
+        arm.params.temperature[:, None], arm.params.top_k[:, None],
+        arm.params.top_p[:, None], arm.params.greedy | ~arm.on,
+        arm.params.min_p[:, None], arm.params.rep_penalty[:, None],
+        arm.params.freq_penalty[:, None], arm.params.pres_penalty[:, None],
+        presence=arm.presence,
+    )
+    budget = jnp.where(
+        G.stop_mask(cfg, firsts), jnp.int32(0),
+        jnp.maximum(arm.max_tokens - 1, 0),
+    )
+    vocab = jnp.arange(cfg.vocab_size, dtype=jnp.int32)
+    first_onehot = vocab[None, :] == firsts[:, None]  # [B, V]
+    on, on_col = arm.on, arm.on[:, None]
+    state = G.SlotState(
+        token=jnp.where(on, firsts, state.token),
+        pos=jnp.where(on, arm.prompt_len, state.pos),
+        active=jnp.where(on, budget > 0, state.active),
+        remaining=jnp.where(on, budget, state.remaining),
+        presence=jnp.where(on_col, arm.presence | first_onehot,
+                           state.presence),
+        counts=jnp.where(on_col, first_onehot.astype(jnp.int32),
+                         state.counts),
+    )
+    sparams = G.SlotParams(*(
+        jnp.where(on, new, old)
+        for new, old in zip(arm.params, sparams)
+    ))
+    packed = jnp.concatenate(
+        [
+            emit[None], can_emit.astype(jnp.int32)[None],
+            state.active.astype(jnp.int32)[None], firsts[None],
+            on.astype(jnp.int32)[None],
+        ],
+        axis=0,
+    )
+    return packed, state, sparams

@@ -1,0 +1,385 @@
+"""SLO-aware chunked-prefill scheduling for the continuous paged fleet.
+
+The admit-then-prefill-whole flow (engine/continuous.py's original
+admission) prefills a request's entire prompt before any decoding slot
+advances again: one long prompt stalls every in-flight request's TPOT for
+the full prefill duration — the classic Sarathi/Orca observation, and the
+ROADMAP's top open item. The ragged kernel (ops/paged_attention) already
+serves mixed prefill+decode rows in one launch; what stopped at
+per-admission prefill entries was the HOST-side planning. This module is
+that planning:
+
+  * TOKEN-BUDGET STEPS: every scheduler step assembles ONE mixed ragged
+    launch (engine/paged.mixed_step_ragged) containing a decode row for
+    every active slot plus PREFILL chunks of pending admissions, sliced
+    to `engine_cfg.step_token_budget` flat tokens. Decode rows are
+    reserved FIRST (prefill can never starve decode — the TPOT
+    guarantee); the remaining query tiles are the per-step prefill
+    budget. A prompt of any length therefore costs each decode step at
+    most `budget - n_slots` extra flat tokens instead of a whole-prompt
+    stall, and TTFT degrades gracefully (the prompt lands over several
+    steps) instead of TPOT collapsing.
+  * SLO CLASSES: requests carry an `slo_class` (serving/queue.py field,
+    surfaced on /generate and the OpenAI routes) with per-class TTFT /
+    TPOT targets from config (engine_cfg.slo_classes). The prefill
+    budget is apportioned across classes by weight x URGENCY, where
+    urgency is the class's oldest pending prefill's wait measured
+    against its TTFT target — the feedback signal the observability
+    layer's timing histograms established (the same samples feed the
+    per-class EWMAs here). When any decoding class's observed TPOT runs
+    over its target, the whole prefill budget is halved for the step
+    (decode protection), never below one tile (prefill liveness).
+  * ADMISSION CONTROL: the head-of-queue evictable-block check grew into
+    a policy object — a class whose queue drain ESTIMATE (class depth x
+    observed per-request service time) already overruns its TTFT target
+    is shed at enqueue with a 429 whose Retry-After derives from THAT
+    class's drain estimate, never the global queue depth; non-sheddable
+    classes only queue.
+
+Everything here is host-side planning over plain Python/numpy state —
+strictly decode-UNREACHABLE (pinned in the test_analysis.py callgraph
+fixture, like engine/paged.build_ragged_meta); the device work happens in
+the one mixed program the continuous engine launches per step.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Optional
+
+from ..utils.logging import get_logger
+from ..utils.retry import BACKOFF_CAP_S, overload_retry_after
+
+log = get_logger("scheduler")
+
+# shed when the class drain estimate exceeds grace x its TTFT target
+SHED_GRACE = 4.0
+# never shed a backlog smaller than this many requests per class — the
+# estimate is too noisy at tiny depths to refuse work over it
+MIN_SHED_DEPTH = 4
+# ceiling for a class-derived Retry-After hint (seconds)
+RETRY_AFTER_CAP_S = 30.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One service class: latency targets + its share of the prefill
+    budget. `sheddable=False` (bulk/batch traffic) means admission only
+    ever queues it — capacity pressure sheds the latency-sensitive
+    classes first, because those are the requests whose SLO a deep queue
+    has already broken."""
+
+    name: str
+    ttft_target_s: float
+    tpot_target_s: float
+    weight: float = 1.0
+    sheddable: bool = True
+
+
+def parse_slo_classes(engine_cfg) -> "collections.OrderedDict[str, SLOClass]":
+    """engine_cfg.slo_classes tuples -> name-keyed SLOClass map (insertion
+    order preserved — it is the display/apportionment order)."""
+    out = collections.OrderedDict()
+    for entry in engine_cfg.slo_classes:
+        c = SLOClass(*entry)
+        if c.ttft_target_s <= 0 or c.tpot_target_s <= 0 or c.weight <= 0:
+            raise ValueError(
+                f"slo class {c.name!r} needs positive targets and weight"
+            )
+        out[c.name] = c
+    if engine_cfg.slo_default_class not in out:
+        raise ValueError(
+            f"slo_default_class {engine_cfg.slo_default_class!r} is not in "
+            f"slo_classes {tuple(out)}"
+        )
+    return out
+
+
+class PrefillJob:
+    """Host state of one chunked admission: the prompt tail past the
+    prefix-reuse depth is fed into the pool CHUNK BY CHUNK across mixed
+    launches. `done` counts tail tokens already launched — always a whole
+    number of chunks, so a crash between launches loses only whole chunks
+    (the chunk-boundary salvage contract; the rebuilt pool means recovery
+    re-plans from zero, and prefill determinism keeps greedy output
+    bit-identical)."""
+
+    __slots__ = (
+        "req", "ids", "p0", "done", "prompt_len", "max_tokens", "slot",
+        "sampling", "presence_row", "table_row", "cls",
+    )
+
+    def __init__(self, req, ids, p0, prompt_len, max_tokens, slot, sampling,
+                 presence_row, table_row, cls):
+        self.req = req
+        self.ids = ids  # full token list (salvaged continuation included)
+        self.p0 = p0  # prefix-reuse depth (mapped shared blocks)
+        self.done = 0  # tail tokens already launched
+        self.prompt_len = prompt_len
+        self.max_tokens = max_tokens
+        self.slot = slot
+        self.sampling = sampling  # host-side scalar tuple (SamplingParams)
+        self.presence_row = presence_row  # np bool [V] prompt token set
+        self.table_row = table_row
+        self.cls = cls  # SLOClass
+
+    @property
+    def remaining(self) -> int:
+        """Tail tokens not yet launched (>= 1 until the final chunk —
+        which must carry the sampling token — has gone out)."""
+        return len(self.ids) - self.p0 - self.done
+
+
+class _ClassFeedback:
+    """Per-class rolling latency observations (the feedback half of the
+    SLO loop): EWMA TTFT — the class drain-estimate unit — and EWMA TPOT
+    — the decode-protection signal. Fed from the same per-request samples
+    the dli_ttft/dli_tpot histograms record, one write per completed
+    request; reads are racy-but-monotone floats (GIL-atomic), safe from
+    the enqueue path without the engine lock."""
+
+    __slots__ = ("ttft_ewma", "tpot_ewma", "samples")
+
+    ALPHA = 0.3
+
+    def __init__(self):
+        self.ttft_ewma: Optional[float] = None
+        self.tpot_ewma: Optional[float] = None
+        self.samples = 0
+
+    def observe(self, ttft_s: Optional[float], tpot_s: Optional[float]):
+        if ttft_s is not None:
+            self.ttft_ewma = (
+                ttft_s if self.ttft_ewma is None
+                else (1 - self.ALPHA) * self.ttft_ewma + self.ALPHA * ttft_s
+            )
+        if tpot_s is not None:
+            self.tpot_ewma = (
+                tpot_s if self.tpot_ewma is None
+                else (1 - self.ALPHA) * self.tpot_ewma + self.ALPHA * tpot_s
+            )
+        self.samples += 1
+
+
+class TokenBudgetScheduler:
+    """Pure host-side planner: slices the per-step flat-token budget into
+    decode rows + class-apportioned prefill chunks, and answers the
+    admission-control questions (shed? Retry-After?) from per-class
+    feedback. Owns NO device state — the continuous engine translates the
+    plan into one mixed ragged launch.
+
+    width: flat-token launch width (the compiled mixed program's shape);
+    tile: the ragged kernel's query tile — every launch entry occupies
+    whole tiles, so budget accounting is in tiles.
+    """
+
+    def __init__(self, classes, default_name: str, width: int, tile: int,
+                 n_slots: int, registry=None):
+        self.classes = classes
+        self.default_name = default_name
+        self.tile = int(tile)
+        # every active slot's decode row costs one tile, and at least one
+        # tile must remain for prefill progress (starvation freedom) —
+        # clamp the width up instead of starting a scheduler that can
+        # wedge with a full fleet
+        min_width = (int(n_slots) + 1) * self.tile
+        self.width = -(-max(int(width), min_width) // self.tile) * self.tile
+        if self.width > width:
+            log.info(
+                "step_budget_clamped", requested=width, width=self.width,
+                reason="decode rows + one prefill tile must fit",
+            )
+        self.n_slots = int(n_slots)
+        self.feedback = {name: _ClassFeedback() for name in classes}
+        self._m_depth = self._m_shed = None
+        if registry is not None:
+            self._m_depth = registry.gauge(
+                "dli_slo_queue_depth",
+                "queued requests per SLO class", ("slo_class",),
+            )
+            self._m_shed = registry.counter(
+                "dli_slo_shed_total",
+                "requests shed with 429 by SLO admission control (class "
+                "drain estimate over the TTFT target, or queue full)",
+                ("slo_class",),
+            )
+            for name in classes:
+                # pre-touch every class series so the scrape schema is
+                # stable from the first request
+                self._m_depth.labels(slo_class=name).set(0)
+
+    # -- classification ------------------------------------------------------
+    def classify(self, name: Optional[str]) -> SLOClass:
+        """Request slo_class -> SLOClass; None/unknown falls back to the
+        default class (the serving edge validates and 400s unknown names
+        BEFORE enqueue — this fallback covers embedded/API callers)."""
+        if name is not None and name in self.classes:
+            return self.classes[name]
+        return self.classes[self.default_name]
+
+    def valid(self, name: str) -> bool:
+        return name in self.classes
+
+    # -- feedback ------------------------------------------------------------
+    def observe(self, cls_name: str, ttft_s: Optional[float],
+                tpot_s: Optional[float]):
+        fb = self.feedback.get(cls_name)
+        if fb is not None:
+            fb.observe(ttft_s, tpot_s)
+
+    def set_depth(self, cls_name: str, depth: int):
+        if self._m_depth is not None:
+            self._m_depth.labels(slo_class=cls_name).set(depth)
+
+    def count_shed(self, cls_name: str):
+        if self._m_shed is not None:
+            self._m_shed.labels(slo_class=cls_name).inc()
+
+    # -- admission control ---------------------------------------------------
+    def drain_estimate_s(self, cls: SLOClass, class_depth: int) -> float:
+        """Expected wait for a NEW request of `cls` behind its class-local
+        backlog: depth x the class's observed per-request TTFT EWMA. With
+        no samples yet, a coarse depth/fleet-width heuristic (the same
+        unit the pre-SLO global hint used, but over the CLASS depth)."""
+        fb = self.feedback.get(cls.name)
+        if fb is not None and fb.ttft_ewma is not None:
+            return class_depth * fb.ttft_ewma
+        return float(overload_retry_after(class_depth, self.n_slots))
+
+    def retry_after_s(self, cls: SLOClass, class_depth: int) -> int:
+        """Class-aware Retry-After: when THIS class's backlog drains, not
+        when the global queue does — a deep batch backlog must not tell
+        an interactive client to stay away, and vice versa."""
+        est = self.drain_estimate_s(cls, class_depth)
+        return int(min(RETRY_AFTER_CAP_S, max(1.0, round(est))))
+
+    def should_shed(self, cls: SLOClass, class_depth: int) -> bool:
+        """Shed (429) a sheddable class whose drain estimate already
+        overruns SHED_GRACE x its TTFT target — admitting it would burn
+        budget on a request whose SLO is unmeetable. Small backlogs never
+        shed (estimate noise), non-sheddable classes never shed (they
+        queue until the bounded queue itself is full)."""
+        if not cls.sheddable or class_depth < MIN_SHED_DEPTH:
+            return False
+        fb = self.feedback.get(cls.name)
+        if fb is None or fb.ttft_ewma is None:
+            return False  # no data: never refuse work on a guess
+        return (
+            self.drain_estimate_s(cls, class_depth)
+            > SHED_GRACE * cls.ttft_target_s
+        )
+
+    # -- the per-step budget slice -------------------------------------------
+    def _urgency(self, cls: SLOClass, oldest_wait_s: float) -> float:
+        """How far past (or inside) its TTFT target the class's oldest
+        pending prefill is — the apportionment feedback term, clamped so
+        one pathological wait cannot zero everyone else's share."""
+        return min(8.0, max(0.25, oldest_wait_s / cls.ttft_target_s))
+
+    def decode_pressure(self, active_classes) -> bool:
+        """True when any class with active decode rows observes TPOT over
+        its target — the signal to halve the step's prefill budget."""
+        for name in active_classes:
+            cls = self.classes.get(name)
+            fb = self.feedback.get(name)
+            if (
+                cls is not None and fb is not None
+                and fb.tpot_ewma is not None
+                and fb.tpot_ewma > cls.tpot_target_s
+            ):
+                return True
+        return False
+
+    def plan(self, n_decode_rows: int, jobs: list,
+             active_classes=(), now: Optional[float] = None) -> list:
+        """Slice one step's budget: returns [(job, chunk_tokens)] with
+        chunk_tokens >= 1, tile-granular except a job's FINAL chunk.
+
+        Decode rows were reserved upstream (one tile each); `jobs` are
+        the pending prefills in arrival order. Tiles left after decode are
+        apportioned across classes by weight x urgency, distributed FIFO
+        within a class; leftovers spill FIFO across classes; the OLDEST
+        job is guaranteed a tile (starvation freedom). Under decode TPOT
+        pressure the prefill budget halves (never below one tile)."""
+        if not jobs:
+            return []
+        t = time.time() if now is None else now
+        tiles_total = self.width // self.tile
+        tiles_left = tiles_total - n_decode_rows
+        if tiles_left < 1:
+            # structurally unreachable (width clamps to n_slots + 1 tiles
+            # and a prefilling admission occupies a slot), but never plan
+            # a launch that cannot hold its entries
+            return []
+        if self.decode_pressure(active_classes):
+            tiles_left = max(1, tiles_left // 2)
+
+        by_class: dict = collections.OrderedDict()
+        for job in jobs:
+            by_class.setdefault(job.cls.name, []).append(job)
+        # class shares: weight x urgency over the classes with work
+        scores = {}
+        for name, members in by_class.items():
+            cls = members[0].cls
+            oldest_wait = max(t - m.req.enqueued for m in members)
+            scores[name] = cls.weight * self._urgency(cls, oldest_wait)
+        total = sum(scores.values())
+        tiles_for = {
+            name: int(tiles_left * s / total) for name, s in scores.items()
+        }
+        # remainder tiles to the highest-scoring classes, deterministic
+        spare = tiles_left - sum(tiles_for.values())
+        for name in sorted(scores, key=lambda n: -scores[n]):
+            if spare <= 0:
+                break
+            tiles_for[name] += 1
+            spare -= 1
+
+        grants: dict = {}
+
+        def give(job, tiles):
+            need = -(-job.remaining // self.tile)
+            take = min(tiles, need - grants.get(id(job), 0))
+            if take > 0:
+                grants[id(job)] = grants.get(id(job), 0) + take
+            return take
+
+        leftover = 0
+        for name, members in by_class.items():
+            tiles = tiles_for.get(name, 0)
+            for job in members:
+                tiles -= give(job, tiles)
+                if tiles <= 0:
+                    break
+            leftover += max(0, tiles)
+        # spill unused class budget FIFO across every class
+        if leftover > 0:
+            for job in jobs:
+                leftover -= give(job, leftover)
+                if leftover <= 0:
+                    break
+        # starvation freedom: the globally oldest job always progresses —
+        # reclaim a tile from the fattest (newest on ties) grant when the
+        # budget is fully spoken for
+        oldest = min(jobs, key=lambda j: j.req.enqueued)
+        if not grants.get(id(oldest)):
+            if sum(grants.values()) >= tiles_left:
+                granted = [j for j in jobs if grants.get(id(j))]
+                if granted:
+                    victim = max(
+                        granted,
+                        key=lambda j: (grants[id(j)], j.req.enqueued),
+                    )
+                    grants[id(victim)] -= 1
+                    if not grants[id(victim)]:
+                        del grants[id(victim)]
+            give(oldest, 1)
+
+        out = []
+        for job in jobs:  # arrival order, independent of grant order
+            tiles = grants.get(id(job), 0)
+            if tiles > 0:
+                out.append((job, min(tiles * self.tile, job.remaining)))
+        return out

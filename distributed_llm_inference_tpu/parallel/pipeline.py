@@ -792,6 +792,82 @@ class PipelineBackend(SPMDBackendBase):
             or (isinstance(k, tuple) and k and k[0] == "prefill_ragged_paged")
         )
 
+    # -- mixed scheduler step on the pp ring (engine/scheduler.py) -----------
+    @property
+    def supports_mixed_step(self) -> bool:
+        """The chunked-prefill scheduler's mixed launch (decode rows +
+        prefill chunks in one program): same dp == 1 / family constraints
+        as the rest of the ragged paged fleet."""
+        return self.supports_ragged_fill
+
+    def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
+                          pool, table, state, sparams, key, dec_idx, arm):
+        fn = self._programs.get("mixed_step_ragged")
+        if fn is None:
+            fn = self._build_mixed_step_ragged()
+            self._programs["mixed_step_ragged"] = fn
+        return fn(self.shared, self.layers, tokens, tok_row, tok_pos,
+                  dec_flag, meta, pool, table, state, sparams, key,
+                  dec_idx, arm)
+
+    def _build_mixed_step_ragged(self):
+        """shard_map twin of engine/paged.mixed_step_ragged: the flat
+        token fleet (decode rows gathered from the replicated slot state,
+        prefill chunks from the host plan) runs the S ring microsteps
+        with the ragged fill hook (ungated microsteps trash-redirect
+        their pool writes); the decode and first-token positions are
+        gathered off stage 0's real output, psum-broadcast, and
+        unembedded through the vocab shards — then the SHARED
+        engine/paged.mixed_epilogue advances/arm-s the slots, so tokens
+        are identical on every device and cannot drift from the
+        single-device program."""
+        cfg, S = self.cfg, self.pp
+        from ..engine import paged as EP
+        from ..engine.generate import SlotParams, SlotState
+        from .partition import pool_spec
+
+        def body(shared, layers, tokens, tok_row, tok_pos, dec_flag, meta,
+                 pool, table, state, sparams, key, dec_idx, arm):
+            hook = EP.make_ragged_fill_hook(table, meta, tok_row)
+            s = jax.lax.axis_index(AXIS_PP)
+            rows_ix = jnp.maximum(tok_row, 0)
+            toks = jnp.where(dec_flag, state.token[rows_ix], tokens)
+            pos = jnp.where(dec_flag, state.pos[rows_ix], tok_pos)
+            x = embed_sharded(cfg, shared, toks[:, None], pos, S)
+            buf, pool = self._microstep_loop(
+                layers, x, pool, pos, attn_hook=hook, attn_seq_len=1
+            )
+
+            def replicated_logits(idx):
+                sel = buf[idx]  # [B, 1, D]
+                sel = jax.lax.psum(
+                    jnp.where(s == 0, sel, jnp.zeros((), sel.dtype)),
+                    AXIS_PP,
+                )
+                return unembed_sharded(cfg, shared, sel, S)[:, 0, :]
+
+            packed, state, sparams = EP.mixed_epilogue(
+                cfg, state, sparams, replicated_logits(dec_idx),
+                replicated_logits(arm.idx), key, arm,
+            )
+            return packed, state, sparams, pool
+
+        state_specs = _replicated_specs(SlotState)
+        sparam_specs = _replicated_specs(SlotParams)
+        arm_specs = EP.MixedArm(
+            P(), P(), P(), P(), _replicated_specs(SlotParams), P()
+        )
+        shmapped = self._shard(
+            body,
+            in_specs=(
+                self._shared_specs, self._layer_specs, P(), P(), P(), P(),
+                P(), pool_spec(cfg), P(), state_specs, sparam_specs, P(),
+                P(), arm_specs,
+            ),
+            out_specs=(P(), state_specs, sparam_specs, pool_spec(cfg)),
+        )
+        return jax.jit(shmapped, donate_argnums=(7,))
+
     def _build_decode_slots_paged(self, num_steps: int):
         """Paged twin of _build_decode_slots: each of the S ring
         microsteps runs the local layer shard over the slot fleet with the

@@ -158,6 +158,16 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
         frequency_penalty=freq,
         presence_penalty=pres,
     )
+    slo = data.get("slo_class")
+    if slo is not None:
+        # extension field (engine/scheduler.py SLO classes): admission
+        # priority / prefill-budget share / shed policy on the continuous
+        # fleet. The server validates the name against the configured
+        # classes (unknown -> 400); here only the shape is checked.
+        if not isinstance(slo, str):
+            raise OpenAIError("slo_class must be a string",
+                              param="slo_class")
+        kwargs["slo_class"] = slo
     stop = data.get("stop")
     if stop is not None:
         if isinstance(stop, str):

@@ -43,7 +43,7 @@ log = get_logger("queue")
 
 class _Pending:
     __slots__ = ("prompt", "kwargs", "done", "result", "enqueued", "is_batch",
-                 "trace")
+                 "trace", "slo")
 
     def __init__(self, prompt, kwargs: dict, is_batch: bool = False):
         self.prompt = prompt  # str, or list[str] for a client batch
@@ -52,6 +52,11 @@ class _Pending:
         self.result: Optional[dict] = None
         self.enqueued = time.time()
         self.is_batch = is_batch
+        # SLO class (engine/scheduler.py): resolved against the engine's
+        # configured classes at submit; drives the per-class depth gauge
+        # and the class-local Retry-After on shed — the kwarg itself
+        # stays, the engine accepts + echoes it
+        self.slo = kwargs.get("slo_class")
         # per-request trace: the dispatcher wait lands in the queue_wait
         # span; solo dispatch hands the SAME trace to the engine so the
         # response's timings cover enqueue -> detokenize contiguously
@@ -82,6 +87,9 @@ class _Pending:
             # the OpenAI penalties are fleet-shared scalars like the other
             # sampling knobs: only identical values may share a fleet
             k.get("frequency_penalty", 0.0), k.get("presence_penalty", 0.0),
+            # class-pure fleets: the envelope echoes one slo_class per
+            # fleet call, so mixed-class coalescing would mislabel rows
+            k.get("slo_class"),
             tuple(k.get("stop") or ()),
             # a grammar constraint is fleet-shared (one [S, V] table pair
             # broadcast over the rows), so only IDENTICAL constraints may
@@ -142,6 +150,24 @@ class BatchingQueue:
             "dli_batch_rows", "rows per batched fleet", ("engine",),
             buckets=DEFAULT_SIZE_BUCKETS,
         ).labels(engine="queue")
+        # SLO classes (engine/scheduler.py): the batching queue has no
+        # prefill budget to apportion, but classed requests still get the
+        # per-class depth gauge and a CLASS-local Retry-After on shed —
+        # a deep batch backlog must not tell an interactive client to
+        # stay away, and vice versa
+        from ..engine.scheduler import parse_slo_classes
+
+        self._slo = parse_slo_classes(engine.engine_cfg)
+        self._slo_default = engine.engine_cfg.slo_default_class
+        self._m_slo_depth = m.gauge(
+            "dli_slo_queue_depth",
+            "queued requests per SLO class", ("slo_class",),
+        )
+        self._m_slo_shed = m.counter(
+            "dli_slo_shed_total",
+            "requests shed with 429 by SLO admission control (class drain "
+            "estimate over the TTFT target, or queue full)", ("slo_class",),
+        )
         self._can_coalesce = (
             getattr(engine.cfg, "arch", None) == "llama"
             and getattr(engine.backend, "supports_ragged", False)
@@ -167,7 +193,21 @@ class BatchingQueue:
         dispatches as its own fleet, never coalesced with others)."""
         return self._submit(_Pending(prompts, kwargs, is_batch=True))
 
+    def _note_queue_locked(self):
+        """Refresh the global + per-SLO-class depth gauges (caller holds
+        the lock)."""
+        self._m_depth.set(len(self._queue))
+        counts: dict = {}
+        for p in self._queue:
+            counts[p.slo] = counts.get(p.slo, 0) + 1
+        for name in self._slo:
+            self._m_slo_depth.labels(slo_class=name).set(
+                counts.get(name, 0)
+            )
+
     def _submit(self, pend: _Pending) -> dict:
+        if pend.slo not in self._slo:
+            pend.slo = self._slo_default
         with self._cv:
             if self._closed:
                 return {
@@ -182,23 +222,30 @@ class BatchingQueue:
                     "error_type": "draining",
                 }
             if len(self._queue) >= self.max_queue:
-                log.warning("queue_full", depth=len(self._queue))
+                log.warning("queue_full", depth=len(self._queue),
+                            slo_class=pend.slo)
                 self._m_shed.inc()
-                # the 429 carries a queue-depth-derived Retry-After hint
-                # (the drain path always sent one; overload must too, so
-                # client and router backoff stays server-directed): one
-                # second per max_batch-sized dispatch cycle the backlog
-                # needs to clear
+                self._m_slo_shed.labels(slo_class=pend.slo).inc()
+                # the 429 carries a drain-estimate Retry-After hint (the
+                # drain path always sent one; overload must too, so
+                # client and router backoff stays server-directed) —
+                # derived from the shed request's OWN class depth: one
+                # second per max_batch-sized dispatch cycle THAT class's
+                # backlog needs to clear, never the global queue depth
+                class_depth = sum(
+                    1 for p in self._queue if p.slo == pend.slo
+                )
                 return {
                     "error": f"Error: request queue full ({self.max_queue})",
                     "status": "failed",
                     "error_type": "overloaded",
+                    "slo_class": pend.slo,
                     "retry_after_s": overload_retry_after(
-                        len(self._queue), self.max_batch
+                        class_depth, self.max_batch
                     ),
                 }
             self._queue.append(pend)
-            self._m_depth.set(len(self._queue))
+            self._note_queue_locked()
             self._cv.notify_all()
         pend.done.wait()
         return pend.result
@@ -251,7 +298,7 @@ class BatchingQueue:
                 }
                 p.done.set()
             self._queue.clear()
-            self._m_depth.set(0)
+            self._note_queue_locked()
 
     def depth(self) -> int:
         with self._cv:
@@ -262,7 +309,7 @@ class BatchingQueue:
         """Pop the head request plus every compatible queued request (in
         arrival order) up to max_batch. Caller holds the lock."""
         head = self._queue.pop(0)
-        self._m_depth.set(len(self._queue))
+        self._note_queue_locked()
         key = head.coalesce_key() if self._can_coalesce else None
         group = [head]
         if key is None:
@@ -274,7 +321,7 @@ class BatchingQueue:
             else:
                 rest.append(p)
         self._queue[:] = rest
-        self._m_depth.set(len(self._queue))
+        self._note_queue_locked()
         return group
 
     def _dispatch_loop(self):

@@ -157,6 +157,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
     if state is None:  # embedding callers without an InferenceServer
         state = _ServerState()
     started_at = int(time.time())
+    # configured SLO classes (engine/scheduler.py): the serving edge
+    # validates request slo_class fields against them (unknown -> 400)
+    from ..engine.scheduler import parse_slo_classes
+
+    slo_classes = parse_slo_classes(engine.engine_cfg)
     # HTTP request/error counter by route + status — every response path
     # (JSON, HTML, SSE, NDJSON) passes through exactly one counting point
     http_requests = engine.metrics.counter(
@@ -353,6 +358,17 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     prompts, kwargs, meta = oai.parse_completion(
                         data, max_tokens_cap
                     )
+                if (
+                    kwargs.get("slo_class") is not None
+                    and kwargs["slo_class"] not in slo_classes
+                ):
+                    # same validation as /generate: an unknown class is a
+                    # caller bug, never a silent fallback to the default
+                    raise oai.OpenAIError(
+                        f"unknown slo_class {kwargs['slo_class']!r}; "
+                        f"configured: {sorted(slo_classes)}",
+                        param="slo_class",
+                    )
                 kwargs["request_id"] = self._rid
                 if meta.get("echo_score"):
                     # echo + logprobs + max_tokens=0: teacher-forced
@@ -503,6 +519,21 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         data.get("presence_penalty", 0.0)
                     ),
                 )
+                raw_slo = data.get("slo_class")
+                if raw_slo is not None:
+                    # SLO class (engine/scheduler.py): admission priority,
+                    # prefill-budget share, and shed policy on the
+                    # continuous fleet; class-aware Retry-After on 429s.
+                    # Unknown names are a caller bug -> 400.
+                    if (
+                        not isinstance(raw_slo, str)
+                        or raw_slo not in slo_classes
+                    ):
+                        raise ValueError(
+                            f"unknown slo_class {raw_slo!r}; configured: "
+                            f"{sorted(slo_classes)}"
+                        )
+                    kwargs["slo_class"] = raw_slo
                 nbeams = data.get("num_beams")
                 if nbeams is not None and int(nbeams) > 1:
                     # deterministic beam search (HF num_beams semantics);

@@ -496,6 +496,26 @@ def test_ragged_host_planner_decode_unreachable(real_reachable):
     assert ("engine.paged", "make_ragged_fill_hook.hook") in real_reachable
 
 
+def test_chunked_scheduler_decode_unreachable(real_reachable):
+    """The SLO-aware chunked-prefill scheduler (engine/scheduler.py) is
+    pure host-side planning — numpy/time/metrics work that must never
+    land in a compiled program. Same pin as the ragged meta builder; the
+    TRACED half of the chunked path (engine/paged.mixed_step_ragged's
+    epilogue via slot_step) stays reachable."""
+    sched_funcs = sorted(
+        k for k in real_reachable if k[0] == "engine.scheduler"
+    )
+    assert not sched_funcs, sched_funcs
+    for key in [
+        ("engine.continuous", "ContinuousEngine._launch_mixed"),
+        ("engine.continuous", "ContinuousEngine._process_mixed"),
+        ("engine.continuous", "ContinuousEngine._start_job"),
+        ("engine.continuous", "ContinuousEngine._sched_loop"),
+    ]:
+        assert key not in real_reachable, key
+    assert ("engine.paged", "mixed_epilogue") in real_reachable
+
+
 def test_router_tier_decode_unreachable(real_reachable):
     """The replica router (serving/router.py) is host-side glue — an
     HTTP front tier that never touches an engine or jax. Nothing in it

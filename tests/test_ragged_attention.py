@@ -368,7 +368,13 @@ def test_ragged_single_program_any_tail():
     params = M.init_params(cfg, jax.random.PRNGKey(0))
     eng = InferenceEngine(
         cfg, params=params,
-        engine_cfg=EngineConfig(prefix_cache_entries=0, ragged_prefill=True),
+        # chunked_prefill=False: this test pins the PER-ADMISSION ragged
+        # ingest launches (extend/prefill pair); the chunked scheduler's
+        # mixed-launch counting lives in tests/test_scheduler.py
+        engine_cfg=EngineConfig(
+            prefix_cache_entries=0, ragged_prefill=True,
+            chunked_prefill=False,
+        ),
     )
     cont = ContinuousEngine(
         eng, n_slots=2, chunk_steps=4, slot_max_seq=256,
@@ -416,7 +422,13 @@ def test_ragged_metrics_and_pool_hygiene():
     params = M.init_params(cfg, jax.random.PRNGKey(0))
     eng = InferenceEngine(
         cfg, params=params,
-        engine_cfg=EngineConfig(prefix_cache_entries=0, ragged_prefill=True),
+        # per-admission ingest metrics (phase=extend/prefill launches);
+        # the chunked scheduler's phase=mixed accounting is covered in
+        # tests/test_scheduler.py
+        engine_cfg=EngineConfig(
+            prefix_cache_entries=0, ragged_prefill=True,
+            chunked_prefill=False,
+        ),
     )
     cont = ContinuousEngine(
         eng, n_slots=2, chunk_steps=4, slot_max_seq=256,
