@@ -961,6 +961,109 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
+    # recovery leg (engine/shadow.py warm-state recovery): the same
+    # long-prompt request served across a mid-decode scheduler crash,
+    # shadow ON (warm: restore + partial-tail re-prefill) vs OFF (cold:
+    # whole-prompt re-prefill). time_to_recover = faulted wall minus the
+    # fault-free wall of the identical request, so the number isolates
+    # the recovery cost; tokens_recomputed comes straight off
+    # dli_recovery_tokens_recomputed_total. Headline:
+    # warm_recovery_speedup = cold time-to-recover / warm.
+    if cont_block and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        try:
+            from distributed_llm_inference_tpu.utils import faults as _faults
+
+            long_p = "r " * int(slot_max_seq * 0.4)
+
+            def _ctr_total(eng_x, name):
+                snap = eng_x.metrics.snapshot()
+                return sum(
+                    s["value"]
+                    for s in snap.get(name, {}).get("series", [])
+                )
+
+            def recovery_leg(warm):
+                eng_v = InferenceEngine(
+                    c_cfg, params=c_params,
+                    engine_cfg=EngineConfig(prefix_cache_entries=4),
+                )
+                cont = ContinuousEngine(
+                    eng_v, n_slots=n_slots, chunk_steps=chunk,
+                    slot_max_seq=slot_max_seq,
+                    kv_pool_blocks=pool_blocks, kv_block_size=32,
+                    restart_backoff_s=0.01, kv_shadow=warm,
+                )
+                try:
+                    cont.submit(long_p, **kw)  # compile + shadow warm
+                    # warm the RECOVERY path too (the whole-prefill
+                    # re-admission programs the chunked serving path
+                    # never compiles, plus the restore scatter) with a
+                    # throwaway crash — the timed window below measures
+                    # steady-state recovery, not jit latency, same
+                    # discipline as every other leg's warmup
+                    _faults.arm([_faults.FaultRule(
+                        "decode_launch", "transient", on_call=2
+                    )])
+                    cont.submit(long_p, **kw)
+                    _faults.disarm()
+                    t0 = time.perf_counter()
+                    r_clean = cont.submit(long_p, **kw)
+                    clean_s = time.perf_counter() - t0
+                    if warm:
+                        cont._shadow.flush(10.0)
+                    base = _ctr_total(
+                        eng_v, "dli_recovery_tokens_recomputed_total"
+                    )
+                    _faults.arm([_faults.FaultRule(
+                        "decode_launch", "transient", on_call=3
+                    )])
+                    t0 = time.perf_counter()
+                    r_fault = cont.submit(long_p, **kw)
+                    fault_s = time.perf_counter() - t0
+                    _faults.disarm()
+                    ok = (
+                        r_fault.get("status") == "success"
+                        and r_fault.get("response")
+                        == r_clean.get("response")
+                        and cont.restarts_total == 2
+                    )
+                    return {
+                        "ok": ok,
+                        "clean_request_s": round(clean_s, 4),
+                        "faulted_request_s": round(fault_s, 4),
+                        "time_to_recover_s": round(
+                            max(0.0, fault_s - clean_s), 4
+                        ),
+                        "tokens_recomputed": int(_ctr_total(
+                            eng_v, "dli_recovery_tokens_recomputed_total"
+                        ) - base),
+                        "restored_blocks": cont.shadow_restored_total,
+                    }
+                finally:
+                    _faults.disarm()
+                    cont.close()
+
+            warm_leg = recovery_leg(True)
+            cold_leg = recovery_leg(False)
+            cont_block["recovery"] = {
+                "warm": warm_leg, "cold": cold_leg,
+                "prompt_tokens_approx": len(long_p),
+                "kv_block_size": 32,
+            }
+            if (
+                warm_leg["ok"] and cold_leg["ok"]
+                and warm_leg["time_to_recover_s"] > 0
+            ):
+                cont_block["warm_recovery_speedup"] = round(
+                    cold_leg["time_to_recover_s"]
+                    / warm_leg["time_to_recover_s"], 3,
+                )
+            _write_sidecar(dict(result, continuous=cont_block))
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
     if cont_block:
         result["continuous"] = cont_block
         # keep the round-3 flat key so round-over-round comparisons of the

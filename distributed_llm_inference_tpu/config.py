@@ -388,6 +388,20 @@ class EngineConfig:
     )
     # Class assigned when a request carries no slo_class field.
     slo_default_class: str = "standard"
+    # Warm-state recovery (engine/shadow.py): host-side crash-consistent
+    # shadowing of filled paged-KV blocks, so supervisor restarts
+    # re-prefill only each salvaged request's partial tail block and a
+    # graceful drain can persist the block-prefix cache for a warm
+    # rolling restart (--restore-dir). Paged fleets with a block-prefix
+    # index only (prefix_cache_entries > 0 — restore re-enters through
+    # the ordinary block-prefix hit machinery); the dense fleet has no
+    # immutable-block contract to shadow.
+    kv_shadow: bool = True
+    # Host-RAM bound of the shadow store, in blocks (LRU with cascade
+    # eviction, like the block-prefix index). 0 = auto: twice the pool,
+    # so a full pool's worth of warm chains survives one generation of
+    # churn.
+    kv_shadow_blocks: int = 0
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":

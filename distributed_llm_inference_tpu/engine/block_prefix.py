@@ -252,6 +252,47 @@ class BlockPrefixIndex:
             self._m_entries.set(n_entries)
         return new
 
+    def import_chain(self, ids: list, row_blocks: list) -> int:
+        """Register a RESTORED chain of already-filled pool blocks (the
+        warm-recovery path, engine/shadow.py): the caller allocated the
+        blocks and scattered their shadowed KV back into the pool, so
+        they satisfy the same filled-and-immutable contract register()
+        relies on. Thin wrapper over register()'s dedup/incref walk —
+        whole blocks only (row_blocks[i] holds ids[i*bs:(i+1)*bs]).
+        Returns the number of newly cached blocks."""
+        if len(row_blocks) * self.block_size > len(ids):
+            raise ValueError(
+                f"import_chain: {len(row_blocks)} blocks of "
+                f"{self.block_size} exceed the {len(ids)}-token chain"
+            )
+        return self.register(
+            ids, len(row_blocks) * self.block_size, row_blocks
+        )
+
+    def export_chains(self) -> list:
+        """Every cached chain as token-chunk lists, LRU->MRU by chain
+        tip — [(chunk tuple, ...), ...], one entry per LEAF block (a
+        chain tip no other entry extends). The persist path
+        (engine/shadow.py save ordering) and tests use it; physical
+        block ids deliberately do NOT appear — they are meaningless
+        across a pool rebuild, which is the whole point of the
+        content-keyed shadow."""
+        with self._lock:
+            parents_with_children = {k[0] for k in self._entries}
+            chains = []
+            for key, b in self._entries.items():
+                if b in parents_with_children:
+                    continue  # interior block: some entry extends it
+                chunks = []
+                k = key
+                while True:
+                    chunks.append(k[1])
+                    if k[0] == ROOT:
+                        break
+                    k = self._block_key[k[0]]
+                chains.append(tuple(reversed(chunks)))
+        return chains
+
     def evictable_blocks(self) -> int:
         """Cached blocks reclaimable right now (refcount 1 — held only by
         this index). Admission adds this to the free count when deciding

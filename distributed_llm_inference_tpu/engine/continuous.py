@@ -47,6 +47,17 @@ and a request implicated poison_strikes times is quarantined alone
 (error_type "poison") while its fleet-mates survive. Every path is
 exercised deterministically in CI via utils/faults.py injection points
 (tests/test_faults.py).
+
+Warm-state recovery (ARCHITECTURE.md "Warm recovery"): paged fleets
+shadow every FILLED pool block host-side as it becomes immutable
+(engine/shadow.py — async device->host copies off the scheduler
+thread), so a supervisor restart scatters the shadowed blocks back
+into the rebuilt pool, re-learns their block-prefix chains, and each
+salvage re-admission re-prefills ONLY its partial tail block instead
+of the whole prompt (dli_recovery_tokens_recomputed_total measures
+it). Graceful drain persists the shadow to --restore-dir and startup
+restores it, so the router's rolling restarts hand replicas back in
+with a WARM prefix cache (tests/test_recovery.py chaos matrix).
 """
 
 from __future__ import annotations
@@ -80,6 +91,7 @@ class _Request:
         "stream_q", "streamed_text", "record", "prefix_hit_tokens",
         "cancelled", "prompt_tokens", "block_ids", "need", "cart",
         "trace", "salvaged", "strikes", "allowed", "slo",
+        "ids", "shadow_depth", "recovering",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
@@ -129,6 +141,15 @@ class _Request:
         # total generated-token cap fixed at FIRST admission (clamped
         # max_tokens) — re-admissions shrink their budget against it
         self.allowed: Optional[int] = None
+        # warm-recovery shadow bookkeeping (engine/shadow.py): the
+        # admitted token sequence (prompt + salvaged continuation — the
+        # content the request's pool blocks hold) and how many of its
+        # full blocks have been handed to the shadow copier
+        self.ids: Optional[list] = None
+        self.shadow_depth = 0
+        # set while the recovery path re-admits this request — drives
+        # the dli_recovery_tokens_recomputed_total accounting
+        self.recovering = False
 
 
 class ContinuousEngine:
@@ -152,6 +173,8 @@ class ContinuousEngine:
         restart_budget: int = 3,
         restart_backoff_s: float = 0.05,
         poison_strikes: int = 2,
+        kv_shadow: Optional[bool] = None,
+        restore_dir: Optional[str] = None,
     ):
         cfg = engine.cfg
         if cfg.arch not in ("llama", "gpt2"):
@@ -373,6 +396,60 @@ class ContinuousEngine:
                 else:
                     log.info("prefix_cache_disabled", reason="cache layout")
 
+        # Warm-state recovery (engine/shadow.py): host-side crash-
+        # consistent shadow of filled pool blocks. Requires the paged
+        # fleet (block immutability is the consistency argument), the
+        # block-prefix index (restore re-enters through the ordinary
+        # prefix-hit machinery), and a backend with the shadow
+        # gather/scatter programs (single-device today — the pp pool
+        # would need shard_map twins, so pp fleets recover cold).
+        self._shadow = None
+        self._restore_dir = restore_dir
+        self._needs_restore = False
+        self.shadow_restored_total = 0
+        use_shadow = (
+            engine.engine_cfg.kv_shadow if kv_shadow is None else kv_shadow
+        )
+        if (
+            self.paged and use_shadow and self._bpx is not None
+            and hasattr(self.backend, "gather_shadow_blocks")
+        ):
+            from .shadow import ShadowStore
+
+            self._shadow = ShadowStore(
+                self.kv_block_size,
+                max_blocks=(
+                    engine.engine_cfg.kv_shadow_blocks
+                    or 2 * self._pool_blocks
+                ),
+                registry=engine.metrics,
+            )
+            if restore_dir and self._shadow.load(restore_dir):
+                # persisted warm state (a drained predecessor's blocks +
+                # chain metadata): restored by the worker thread before
+                # it serves anything — same path as the crash restore
+                self._needs_restore = True
+        # fixed gather width of the shadow capture program: one compiled
+        # program serves every capture batch (callers pad by repeating)
+        self._shadow_gather_w = 8
+        # fixed restore width: restores pad to a multiple of this (pad
+        # rows scatter garbage into the write-only TRASH block), so one
+        # compiled restore program serves the common case — and it is
+        # PRE-WARMED here so a crash's restore never pays jit latency
+        # inside the recovery window (same discipline as warmup())
+        self._shadow_restore_w = 32
+        if self._shadow is not None:
+            W = self._shadow_restore_w
+            zeros = jax.tree.map(
+                lambda pl: jnp.zeros(
+                    (W, pl.shape[0]) + pl.shape[2:], pl.dtype
+                ),
+                self.cache,
+            )
+            self.cache = self.backend.restore_shadow_blocks(
+                self.cache, zeros,
+                jnp.zeros((W,), jnp.int32),  # all rows -> trash block
+            )
         self._cv = threading.Condition()
         self._queue: list[_Request] = []
         self._closed = False
@@ -443,6 +520,22 @@ class ContinuousEngine:
             "dli_drain_duration_seconds",
             "graceful-drain wall time (SIGTERM / drain())", ("component",),
         ).labels(component="continuous")
+        # warm-recovery accounting (families pre-registered in
+        # engine/engine.py): how much prefill each salvage re-admission
+        # actually recomputed (warm recovery bounds it by the partial
+        # tail block) and how many shadowed blocks restores scattered
+        # back into rebuilt pools
+        self._m_recovery_recomputed = m.counter(
+            "dli_recovery_tokens_recomputed_total",
+            "prompt tokens re-prefilled for crash-recovery re-admissions "
+            "(warm recovery bounds this by the partial tail block)",
+            ("engine",),
+        ).labels(engine="continuous")
+        self._m_shadow_restored = m.counter(
+            "dli_shadow_restored_blocks_total",
+            "shadowed blocks scattered back into a rebuilt pool "
+            "(supervisor restart or --restore-dir start)",
+        ).labels()
         # ragged-ingest observability (families pre-registered in
         # engine/engine.py for schema stability): launch composition,
         # padding overhead, exact-depth reuse, compiled-program gauge
@@ -756,6 +849,16 @@ class ContinuousEngine:
                 self._cv.wait(
                     timeout=0.1 if left is None else min(left, 0.1)
                 )
+        if self._shadow is not None and self._restore_dir:
+            # warm handoff for the respawn (the router's rolling-restart
+            # path): persist the shadow — blocks + chain metadata — so
+            # `--restore-dir` starts the successor with a warm
+            # block-prefix cache instead of a cold pool
+            try:
+                self._shadow.flush(timeout_s=5.0)
+                self._shadow.save(self._restore_dir)
+            except Exception as e:  # noqa: BLE001 - a failed persist only
+                log.error("shadow_persist_failed", error=str(e))  # colder
         self._m_drain.observe(time.time() - t0)
         log.info(
             "continuous_drained", ok=drained,
@@ -780,6 +883,8 @@ class ContinuousEngine:
             if req.result is None:
                 req.result = dict(fail)
             self._push_final(req)
+        if self._shadow is not None:
+            self._shadow.close()
 
     def warmup(self) -> dict:
         """Compile the slot programs (scratch prefill for the smallest
@@ -842,6 +947,11 @@ class ContinuousEngine:
             }
             if self._ragged:
                 out["paged"]["ragged_width"] = self._ragged_width
+        if self._shadow is not None:
+            out["shadow"] = {
+                **self._shadow.stats(),
+                "restored_blocks": self.shadow_restored_total,
+            }
         out["slo"] = {
             "default": self._sched.default_name,
             "classes": {
@@ -978,6 +1088,133 @@ class ContinuousEngine:
         )
         self._fsm = jnp.zeros((self.n_slots,), jnp.int32)
 
+    def _shadow_capture(self, req: _Request, written: Optional[int] = None):
+        """Hand req's newly FILLED pool blocks to the shadow copier
+        (worker thread; engine/shadow.py). `written` = tokens known to
+        be in the pool for this row (mid-chunked-prefill callers pass
+        job progress); None derives it from the fetched token stream —
+        the last sampled token's K/V is not yet written, hence the -1.
+        The gather is dispatched AFTER the launch that filled the
+        blocks (device execution order makes the bytes final); only the
+        enqueue happens here, the device->host copy runs on the shadow
+        thread — the scheduler loop never blocks."""
+        if self._shadow is None or req.block_ids is None or req.ids is None:
+            return
+        bs = self.kv_block_size
+        if written is None:
+            head = (
+                [req.first_id]
+                if req.first_id is not None
+                and req.first_id not in self.cfg.all_stop_ids else []
+            )
+            gen = head + req.tokens
+            written = len(req.ids) + max(0, len(gen) - 1)
+            seq_tokens = req.ids + gen
+        else:
+            seq_tokens = req.ids
+        full = min(written // bs, len(req.block_ids))
+        if full <= req.shadow_depth:
+            return
+        # chaos hook BEFORE the dedup: a repeat prompt whose blocks are
+        # all resident must still exercise the shadow_copy drill
+        faults.check("shadow_copy", tag=req.prompt)
+        new_keys, new_blocks = [], []
+        for i in range(req.shadow_depth, full):
+            key = tuple(seq_tokens[: (i + 1) * bs])
+            if not self._shadow.has(key):
+                new_keys.append(key)
+                new_blocks.append(int(req.block_ids[i]))
+        req.shadow_depth = full
+        if not new_keys:
+            return
+        W = self._shadow_gather_w
+        for off in range(0, len(new_keys), W):
+            keys = new_keys[off : off + W]
+            ids = new_blocks[off : off + W]
+            padded = ids + [ids[-1]] * (W - len(ids))  # one program, any n
+            dev = self.backend.gather_shadow_blocks(
+                self.cache, jnp.asarray(padded, jnp.int32)
+            )
+            self._shadow.put_async(
+                keys, jax.tree.leaves(dev), self._mutation_seq
+            )
+
+    def _restore_shadow(self) -> int:
+        """Scatter shadowed chains back into a FRESH pool (one restore
+        launch) and register them into the block-prefix index, so
+        salvage re-admissions — and post-restart traffic — hit them
+        through the ordinary prefix machinery. Runs on the worker
+        thread strictly BEFORE any re-admission (start of _loop_inner),
+        under the supervisor: a crash mid-restore is contained like any
+        scheduler crash, the partial registration is released by the
+        next round's clear(), and the restore simply runs again (the
+        double-fault drill in tests/test_recovery.py). Returns blocks
+        restored."""
+        if self._shadow is None or self._bpx is None:
+            return 0
+        # pending captures from before the crash land first, so the
+        # restore depth is deterministic (the chaos matrix depends on it)
+        self._shadow.flush(timeout_s=10.0)
+        faults.check("shadow_copy", tag="restore")
+        # leave one slot-class of headroom: restored chains are
+        # evictable (refcount 1, index-held), but admission should not
+        # have to evict just to place the first request
+        budget = self._alloc.free_blocks - self._max_blocks
+        entries, leaf_keys = self._shadow.select(budget)
+        if not entries:
+            return 0
+        blocks = self._alloc.alloc(len(entries))
+        if blocks is None:
+            return 0
+        bs = self.kv_block_size
+        # pad to the fixed restore width (pre-warmed program): pad rows
+        # repeat row 0's data and scatter it into the write-only TRASH
+        # block — same discard as ungated pp microsteps
+        W = self._shadow_restore_w
+        pad = (-len(entries)) % W
+        ids_padded = blocks + [self._P.TRASH_BLOCK] * pad
+        try:
+            stacked = []
+            for i in range(len(entries[0][1].leaves)):
+                arr = np.stack([e.leaves[i] for _, e in entries])
+                if pad:
+                    arr = np.concatenate(
+                        [arr, np.repeat(arr[:1], pad, axis=0)]
+                    )
+                stacked.append(jnp.asarray(arr))
+            restored = jax.tree.unflatten(
+                jax.tree.structure(self.cache), stacked
+            )
+            self.cache = self.backend.restore_shadow_blocks(
+                self.cache, restored, jnp.asarray(ids_padded, jnp.int32)
+            )
+        except Exception as e:  # noqa: BLE001 - a bad persisted shadow
+            # (config drift across a restart) must cold-start, not
+            # crash-loop the supervisor
+            log.warning("shadow_restore_invalid", error=str(e))
+            self._alloc.decref(blocks)
+            self._shadow.clear()
+            return 0
+        assigned = {key: b for (key, _), b in zip(entries, blocks)}
+        for leaf in leaf_keys:
+            row_blocks = [
+                assigned[leaf[: (i + 1) * bs]]
+                for i in range(len(leaf) // bs)
+            ]
+            self._bpx.import_chain(list(leaf), row_blocks)
+        # the index holds its own reference per cached block now; drop
+        # the allocation's — restored chains end at refcount 1
+        # (index-held, evictable), the steady-state cached-chain invariant
+        self._alloc.decref(blocks)
+        n = len(entries)
+        self.shadow_restored_total += n
+        self._m_shadow_restored.inc(n)
+        log.info(
+            "shadow_restored", blocks=n, chains=len(leaf_keys),
+            free_blocks=self._alloc.free_blocks,
+        )
+        return n
+
     def _supervise(self, exc: Exception) -> bool:
         """One crash-containment round. Returns True to restart the loop,
         False to give up (budget exhausted or closing)."""
@@ -1047,6 +1284,13 @@ class ContinuousEngine:
             5.0,
         ))
         self._rebuild_fleet()
+        # warm recovery: the restarted loop restores shadowed blocks
+        # into the fresh pool BEFORE re-admitting anything. Deliberately
+        # not done here: _supervise runs inside _loop's except handler,
+        # where a restore crash (the double-fault drill) would escape
+        # containment — _loop_inner owns the restore under the
+        # supervisor instead.
+        self._needs_restore = self._shadow is not None
         # Salvage: prompt + tokens generated so far are host-side. The
         # restarted loop re-admits each request as a CONTINUATION prefill
         # (prompt + salvaged tokens), so greedy decode resumes bit-exactly
@@ -1064,6 +1308,10 @@ class ContinuousEngine:
             req.slot = None
             req.need = None
             req.prefix_hit_tokens = 0
+            # shadow bookkeeping resets with the fleet: the re-admission
+            # gets fresh blocks (content keys dedup re-captures)
+            req.ids = None
+            req.shadow_depth = 0
         # a crash mid-recovery leaves earlier salvage in self._recovery
         # (already reset — never re-admitted): keep it, after this round's
         # survivors (who were vindicated tenants before the crash)
@@ -1126,6 +1374,9 @@ class ContinuousEngine:
                 self._recovery.pop(0)
                 self._suspects.add(req)
                 self._mutation_seq += 1
+                # recomputed-prefill accounting: the re-admission below
+                # counts its tail into dli_recovery_tokens_recomputed_total
+                req.recovering = True
                 # survives an exception unwind on purpose — the
                 # supervisor's pointer to a request cut mid-re-admission
                 self._admitting = req
@@ -1210,6 +1461,16 @@ class ContinuousEngine:
         # (insert_slot) and kill (kill_slot) mutate the FUTURE-most state,
         # which is exactly the one the next launch uses.
         inflight: collections.deque = collections.deque()
+        # warm restore FIRST (supervisor restart or --restore-dir start):
+        # the rebuilt pool takes the shadowed blocks back in one scatter
+        # and the block-prefix index re-learns the chains, so the
+        # serialized salvage re-admissions below hit them and re-prefill
+        # only their partial tail. Runs under the supervisor: a crash
+        # here is contained, resources released, and the restore retried
+        # next round (tests/test_recovery.py double-fault leg).
+        if self._needs_restore:
+            self._needs_restore = False
+            self._restore_shadow()
         # after a supervisor restart: serially re-admit salvaged requests
         # (no-op on a clean start; also clears the restarting flag)
         self._run_recovery()
@@ -1451,6 +1712,11 @@ class ContinuousEngine:
             req.allowed = max_tokens
         else:
             max_tokens = min(max_tokens, req.allowed - len(req.salvaged))
+        if req.recovering:
+            # a salvage that fell back through the queue (_BLOCKED) and
+            # re-entered as a chunked job still counts its recomputed tail
+            self._m_recovery_recomputed.inc(prompt_len - p0)
+            req.recovering = False
         faults.check("alloc", tag=req.prompt)
         need_total = self._P.blocks_needed(
             prompt_len, max_tokens, self.kv_block_size
@@ -1496,6 +1762,12 @@ class ContinuousEngine:
         self._table_dev = None
         self._host_pos[slot] = 0
         req.slot = slot
+        if self._shadow is not None:
+            # chunked admissions shadow as their chunks land (the
+            # _launch_mixed capture hook); the mapped shared head is
+            # usually resident already — content keys dedup it
+            req.ids = ids
+            req.shadow_depth = 0
         with self._cv:
             self._assignment[slot] = req
         self._jobs.append(job)
@@ -1609,6 +1881,12 @@ class ContinuousEngine:
                 # launch lands; later gathers serialize behind it on
                 # device — same register point as the whole-prefill path
                 self._bpx.register(job.ids, job.prompt_len, req.block_ids)
+        if self._shadow is not None:
+            # chunk crossed a block boundary -> those blocks are now
+            # immutable; the capture gather dispatches BEHIND the mixed
+            # launch above, so it reads their final content
+            for job, _, _ in chunk_list:
+                self._shadow_capture(job.req, written=job.p0 + job.done)
         # launch-composition observability
         n_pf_tokens = sum(n for _, n, _ in chunk_list)
         self._m_sched_rows.inc(n_dec)
@@ -1863,6 +2141,12 @@ class ContinuousEngine:
         else:
             # re-admission: never exceed the cap fixed at first admission
             max_tokens = min(max_tokens, req.allowed - len(req.salvaged))
+        if req.recovering:
+            # warm recovery's headline number: the tail this salvage
+            # re-admission actually re-prefills (everything past the
+            # restored/mapped head; cold recovery recomputes it all)
+            self._m_recovery_recomputed.inc(prompt_len - p0)
+            req.recovering = False
         table_row = insert_row = None
         if self.paged:
             faults.check("alloc", tag=req.prompt)
@@ -2055,6 +2339,13 @@ class ContinuousEngine:
             # become cached chains, the mapped head is promoted. Later
             # admissions' gathers serialize behind this insert on device.
             self._bpx.register(ids, prompt_len, req.block_ids)
+        if self._shadow is not None:
+            # shadow the prompt's full blocks (same immutability point
+            # as the register above); the gather rides the launch queue
+            # behind the prefill, the copy lands on the shadow thread
+            req.ids = ids
+            req.shadow_depth = 0
+            self._shadow_capture(req, written=prompt_len)
         req.slot = slot
         req.trace.checkpoint("admission")  # prefill + splice into the slot
         with self._cv:
@@ -2182,6 +2473,12 @@ class ContinuousEngine:
                 continue  # freed/killed tenant's masked leftovers
             new = emitted[mask[:, b], b]
             req.tokens.extend(int(t) for t in new)
+            if len(new) and self._shadow is not None:
+                # decode crossed a block boundary? shadow the newly
+                # immutable blocks (token content is host-side now, the
+                # filling launch was fetched — device order guarantees
+                # the gathered bytes are final)
+                self._shadow_capture(req)
             gen = None
             if len(new) and req.kwargs.get("stop"):
                 gen = self._gen_text(req)  # ONE full decode per chunk

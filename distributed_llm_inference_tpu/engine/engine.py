@@ -30,6 +30,7 @@ import numpy as np
 
 from ..config import EngineConfig, ModelConfig
 from ..models import api as M
+from ..utils import faults
 from ..utils.logging import get_logger, request_id_context
 from ..utils.metrics import DEFAULT_SIZE_BUCKETS, MetricsRegistry
 from ..utils.tokenizer import load_tokenizer
@@ -205,6 +206,20 @@ class SingleDeviceBackend:
         from . import paged as P
 
         return P.gather_scratch_blocks(pool, table_row)
+
+    # warm-recovery shadow seam (engine/shadow.py): single-device only
+    # for now — the pp backend's layer-sharded pool would need shard_map
+    # twins for the gather/scatter, so pp fleets recover cold (the
+    # continuous engine gates on these attributes)
+    def gather_shadow_blocks(self, pool, block_ids):
+        from . import paged as P
+
+        return P.gather_shadow_blocks(pool, block_ids)
+
+    def restore_shadow_blocks(self, pool, blocks, block_ids):
+        from . import paged as P
+
+        return P.restore_shadow_blocks(pool, blocks, block_ids)
 
     # ragged ingest (engine/paged.py): admission prefills straight into
     # the pool through the ragged kernel/gather — no scratch, no insert
@@ -429,6 +444,43 @@ class InferenceEngine:
             "dli_drain_duration_seconds",
             "graceful-drain wall time (SIGTERM / drain())", ("component",),
         )
+        # warm-recovery families (engine/shadow.py + the continuous
+        # supervisor's restore path): shadow residency/traffic, blocks
+        # restored into rebuilt pools, and the per-salvage recompute
+        # cost warm recovery exists to shrink
+        self.metrics.gauge(
+            "dli_shadow_blocks",
+            "host-shadowed paged-KV blocks resident for warm recovery",
+        )
+        self.metrics.counter(
+            "dli_shadow_copies_total",
+            "paged-KV blocks copied device->host into the shadow store",
+        )
+        self.metrics.counter(
+            "dli_shadow_dropped_total",
+            "shadow blocks dropped (copier backpressure or a failed "
+            "device->host transfer)",
+        )
+        self.metrics.counter(
+            "dli_shadow_restored_blocks_total",
+            "shadowed blocks scattered back into a rebuilt pool "
+            "(supervisor restart or --restore-dir start)",
+        )
+        self.metrics.counter(
+            "dli_recovery_tokens_recomputed_total",
+            "prompt tokens re-prefilled for crash-recovery re-admissions "
+            "(warm recovery bounds this by the partial tail block)",
+            ("engine",),
+        )
+        # wedge observability (engine._with_deadline): abandoned
+        # deadline-overrun device calls still occupying the device — the
+        # serving edge flips /ready 503 past --wedge-unready off the
+        # same state, so the router tier ejects a wedged replica
+        self._m_wedged = self.metrics.gauge(
+            "dli_engine_wedged",
+            "abandoned deadline-overrun device calls still running "
+            "(nonzero = wedged; /ready reports 503 past --wedge-unready)",
+        ).labels()
         # ragged-ingest families (engine/continuous.py labels them when
         # the ragged path is live): launch composition, padding-tile
         # overhead, exact-depth prefix reuse, and the compiled-program
@@ -591,6 +643,7 @@ class InferenceEngine:
                 with self._wedged_lock:
                     box["done"] = True
                     self._wedged.pop(token, None)
+                    self._m_wedged.set(len(self._wedged))
 
         t = threading.Thread(target=run, daemon=True, name=f"engine-{what}")
         t.start()
@@ -607,6 +660,7 @@ class InferenceEngine:
                     self._wedged[token] = {
                         "what": what, "since": time.monotonic(),
                     }
+                    self._m_wedged.set(len(self._wedged))
             return {
                 "error": f"Error: request exceeded the {deadline:g}s deadline",
                 "status": "failed",
@@ -1510,6 +1564,12 @@ class InferenceEngine:
         frequency_penalty=0.0, presence_penalty=0.0, constraint=None,
         trace=None,
     ):
+        # chaos hook (utils/faults.py point "solo"): inside the deadline
+        # wrapper, so a wedge_s > deadline rule exercises the abandoned-
+        # call path — engine._wedged fills, /ready flips 503 past
+        # --wedge-unready, and the router ejects the replica until the
+        # sleep drains (the DLI_FAULTS wedge drill in tests/test_router)
+        faults.check("solo", tag=prompt)
         cfg = self.cfg
         self.request_count += 1
         bias = self._bias_array(logit_bias)

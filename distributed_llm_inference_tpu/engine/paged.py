@@ -410,6 +410,46 @@ def gather_scratch_blocks(shared_pool, table_row):
     return _gather_blocks(shared_pool, table_row)
 
 
+@jax.jit
+def gather_shadow_blocks(shared_pool, block_ids):
+    """Read `block_ids`' pool blocks into a fresh stacked buffer for the
+    warm-recovery shadow store (engine/shadow.py): each leaf comes back
+    [N, L, KV, bs(, Dh)] — one row per requested block, whole layer
+    axis. Dispatched by the scheduler worker right AFTER the launch that
+    filled the blocks, so device execution order guarantees the gathered
+    bytes are the blocks' final (immutable) content; the device->host
+    transfer happens on the shadow copier thread, never here.
+
+    shared_pool is a READ-ONLY view of live mapped blocks and must NOT
+    be donated: live block tables keep reading these exact buffers
+    (same inverse-donation rule as gather_scratch_blocks). block_ids is
+    a fixed-width operand (callers pad by repeating a real id) so one
+    compiled program serves every capture batch.
+    """
+
+    def g(pl):
+        return pl[:, block_ids].swapaxes(0, 1)
+
+    return jax.tree.map(g, shared_pool)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def restore_shadow_blocks(pool, blocks, block_ids):
+    """Scatter host-restored shadow blocks back into a rebuilt pool in
+    ONE launch — the exact inverse of gather_shadow_blocks. `blocks` is
+    the pool-structured pytree of stacked per-block leaves
+    [N, L, KV, bs(, Dh)]; block_ids [N] the freshly allocated physical
+    destinations. The pool is donated (updated in place); restored
+    blocks are complete by construction, so later tail prefills and
+    decode writes only ever land at positions past them — the same
+    immutability contract live blocks carry."""
+
+    def s(pl, bl):
+        return pl.at[:, block_ids].set(bl.swapaxes(0, 1))
+
+    return jax.tree.map(s, pool, blocks)
+
+
 def _forward_step_paged(cfg, params, tokens, pool, table, pos):
     """One decode step through the stack over the paged pool (family-
     dispatched: gpt2 rides the same hook seam)."""

@@ -1,0 +1,427 @@
+"""Host-side crash-consistent KV shadow store: warm recovery for the
+paged fleet.
+
+Every recovery path this repro grew in PRs 5-8 — supervisor restarts,
+poison quarantine, graceful drain, router failover, rolling restarts —
+comes back COLD: the rebuilt pool holds no KV, so each salvaged request
+re-prefills its whole prompt and a drained replica respawns with an
+empty block-prefix cache. At production scale that is minutes of
+recomputed prefill per incident (the reference's recovery story is
+"restart the Colab"; preemptible TPU capacity makes restart cost a
+first-order serving metric — see PAPERS.md).
+
+Paged KV blocks are append-only and immutable once FILLED (decode and
+tail-prefill writes only ever land at later positions; the frozen-row
+overrun clamp only touches a request's own partial last block or the
+trash block — engine/paged.py), so the shadow works at block
+granularity:
+
+  * CAPTURE (worker thread, async): when a block fills — a whole-prefill
+    admission lands, a chunked-prefill launch crosses a block boundary,
+    or a fetched decode chunk shows a row crossed one — the engine
+    dispatches a small read-only device gather of the filled blocks
+    (engine/paged.gather_shadow_blocks, enqueued in launch order AFTER
+    the filling program, so device execution order guarantees the
+    gathered bytes are the block's final content) and hands the device
+    arrays to THIS module's copier thread. The device->host transfer
+    (the only blocking step) happens entirely off the scheduler loop;
+    the pending queue is bounded and overflow DROPS the batch (a lost
+    shadow block costs a colder recovery, never correctness), so the
+    zero-host-sync launch invariants survive untouched — this module is
+    pinned decode-UNREACHABLE in the test_analysis.py callgraph fixture
+    exactly like utils/faults.py.
+  * KEYS are content: a block's key is the full token prefix it
+    completes (a tuple of ids, length a multiple of block_size). A
+    block's KV is a pure function of the token prefix under
+    teacher-forcing, so a content-keyed entry can never be stale and
+    restoring it into ANY rebuilt pool is bit-exact — the same
+    immutability argument engine/block_prefix.py makes for live block
+    sharing, extended across a pool rebuild. Entries are stamped with
+    the engine's mutation seq at capture (observability + persist
+    versioning; consistency never depends on the stamp).
+  * RESTORE (supervisor restart): the engine flushes pending copies,
+    selects as many MRU chains as the fresh pool can hold, scatters
+    them back in ONE launch (engine/paged.restore_shadow_blocks), and
+    registers the chains into the BlockPrefixIndex — salvaged requests
+    then re-admit through the ordinary block-prefix hit machinery and
+    re-prefill ONLY the partial tail block.
+  * PERSIST (graceful drain): save()/load() serialize the store to an
+    atomic npz under --restore-dir, so a rolling restart cycles the
+    replica back in with a WARM prefix cache.
+
+What is deliberately NOT shadowed: partial tail blocks (mutable until
+they fill), slot/sampling state (host-reconstructable from the salvage
+record), constraint FSM rows (re-derived by advancing the DFA over
+salvaged tokens), the trash block, and dense-fleet caches (no block
+immutability to lean on).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger("shadow")
+
+_PERSIST_VERSION = 1
+_PERSIST_NAME = "shadow.npz"
+
+
+class _Entry:
+    __slots__ = ("leaves", "seq")
+
+    def __init__(self, leaves, seq):
+        self.leaves = leaves  # list of per-leaf np arrays (one block each)
+        self.seq = seq
+
+
+class ShadowStore:
+    """Bounded LRU of host-side shadowed KV blocks, content-keyed by the
+    token prefix each block completes.
+
+    Single-writer discipline mirrors the allocator's: put_async /
+    select / drop_pending run on the continuous engine's worker thread,
+    the copier thread only consumes its own queue, and the lock exists
+    for stats()/save() readers on other threads.
+
+    registry (utils/metrics.MetricsRegistry, optional):
+    `dli_shadow_blocks` (resident host-shadowed blocks),
+    `dli_shadow_copies_total` (blocks copied device->host),
+    `dli_shadow_dropped_total` (blocks dropped: queue backpressure or a
+    failed transfer) — families pre-registered in engine/engine.py.
+    """
+
+    def __init__(self, block_size: int, max_blocks: int = 256,
+                 max_pending: int = 32, registry=None):
+        if block_size < 1:
+            raise ValueError("shadow store needs block_size >= 1")
+        self.block_size = int(block_size)
+        self.max_blocks = max(1, int(max_blocks))
+        self.max_pending = max(1, int(max_pending))
+        self._entries: "collections.OrderedDict[tuple, _Entry]" = (
+            collections.OrderedDict()
+        )
+        self._children: dict = {}  # key -> set of child keys
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # copier queue: (keys, dev_leaves, seq) batches; keys in
+        # _pending are visible to has() so the worker never re-captures
+        # a block whose copy is still in flight
+        self._q: collections.deque = collections.deque()
+        self._pending: set = set()
+        self._busy = False
+        self._closed = False
+        self.copied = 0
+        self.dropped = 0
+        self.evicted = 0
+        self._m_blocks = self._m_copies = self._m_dropped = None
+        if registry is not None:
+            self._m_blocks = registry.gauge(
+                "dli_shadow_blocks",
+                "host-shadowed paged-KV blocks resident for warm recovery",
+            ).labels()
+            self._m_copies = registry.counter(
+                "dli_shadow_copies_total",
+                "paged-KV blocks copied device->host into the shadow store",
+            ).labels()
+            self._m_dropped = registry.counter(
+                "dli_shadow_dropped_total",
+                "shadow blocks dropped (copier backpressure or a failed "
+                "device->host transfer)",
+            ).labels()
+        self._thread = threading.Thread(
+            target=self._copier, daemon=True, name="shadow-copier"
+        )
+        self._thread.start()
+
+    # -- worker-thread surface ----------------------------------------------
+    def has(self, key: tuple) -> bool:
+        """True when `key` is resident OR its copy is already in flight."""
+        with self._lock:
+            return key in self._entries or key in self._pending
+
+    def put_async(self, keys: list, dev_leaves: list, seq: int) -> bool:
+        """Hand one gathered batch to the copier. keys[i] is the token
+        prefix block i of the batch completes; dev_leaves are the
+        STACKED device arrays from gather_shadow_blocks (leaf order =
+        jax.tree flatten order of the pool; row i of each leaf is key
+        i's block — rows past len(keys) are gather padding). NEVER
+        blocks: a full queue drops the batch and counts it."""
+        if not keys:
+            return True
+        with self._lock:
+            if self._closed:
+                return False
+            if len(self._q) >= self.max_pending:
+                self.dropped += len(keys)
+                if self._m_dropped is not None:
+                    self._m_dropped.inc(len(keys))
+                return False
+            self._q.append((list(keys), list(dev_leaves), int(seq)))
+            self._pending.update(keys)
+            self._cv.notify_all()
+        return True
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Wait for every in-flight copy to land (restore/persist call
+        this so the recovery depth is deterministic). True when the
+        queue fully drained inside the timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while self._q or self._busy:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(timeout=min(left, 0.1))
+        return True
+
+    def select(self, max_blocks: int) -> tuple:
+        """Pick up to `max_blocks` resident entries for a pool restore,
+        newest chains first, every selected entry's ancestors included
+        (a chain with a hole cannot be registered). Returns
+        (entries, leaf_keys): `entries` is [(key, leaves)] ordered
+        parents-before-children (the scatter/registration order),
+        `leaf_keys` the maximal keys — one per restored chain tip."""
+        if max_blocks <= 0:
+            return [], []
+        bs = self.block_size
+        chosen: dict = {}
+        with self._lock:
+            for key in reversed(self._entries):  # MRU first
+                if key in chosen:
+                    continue
+                chain = []
+                k = key
+                while len(k) > 0:
+                    if k in chosen:
+                        break
+                    e = self._entries.get(k)
+                    if e is None:
+                        chain = None  # hole (cascade should prevent this)
+                        break
+                    chain.append(k)
+                    k = k[:-bs]
+                if chain is None:
+                    continue
+                if len(chosen) + len(chain) > max_blocks:
+                    continue  # try a shorter chain further down the LRU
+                for k in chain:
+                    chosen[k] = self._entries[k]
+            entries = sorted(chosen.items(), key=lambda kv: len(kv[0]))
+            selected = set(chosen)
+            leaf_keys = [
+                k for k in selected
+                if not any(
+                    c in selected for c in self._children.get(k, ())
+                )
+            ]
+        return entries, leaf_keys
+
+    # -- copier thread -------------------------------------------------------
+    def _copier(self):
+        while True:
+            with self._lock:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._q:
+                    return
+                keys, dev_leaves, seq = self._q.popleft()
+                self._busy = True
+            try:
+                # the one blocking device->host transfer, strictly off
+                # the scheduler thread
+                host = [np.asarray(leaf) for leaf in dev_leaves]
+                per_block = [
+                    [leaf[i] for leaf in host] for i in range(len(keys))
+                ]
+            except Exception as e:  # noqa: BLE001 - a lost copy is only colder
+                log.warning("shadow_copy_failed", error=str(e))
+                with self._lock:
+                    self._pending.difference_update(keys)
+                    self.dropped += len(keys)
+                    if self._m_dropped is not None:
+                        self._m_dropped.inc(len(keys))
+                    self._busy = False
+                    self._cv.notify_all()
+                continue
+            with self._lock:
+                for key, leaves in zip(keys, per_block):
+                    self._insert_locked(key, _Entry(leaves, seq))
+                self._pending.difference_update(keys)
+                self.copied += len(keys)
+                if self._m_copies is not None:
+                    self._m_copies.inc(len(keys))
+                self._note_blocks_locked()
+                self._busy = False
+                self._cv.notify_all()
+
+    def _insert_locked(self, key: tuple, entry: _Entry):
+        if key in self._entries:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            return
+        self._entries[key] = entry
+        parent = key[: -self.block_size]
+        if parent:
+            self._children.setdefault(parent, set()).add(key)
+        while len(self._entries) > self.max_blocks:
+            victim = next(iter(self._entries))
+            if victim == key:
+                break  # never evict what we just inserted
+            self._evict_subtree_locked(victim)
+
+    def _evict_subtree_locked(self, key: tuple):
+        """LRU eviction cascades through descendants, like the
+        block-prefix index's: a chain with a missing interior block can
+        never be restored, so children of an evicted block are dead
+        weight."""
+        if key not in self._entries:
+            return
+        del self._entries[key]
+        parent = key[: -self.block_size]
+        sibs = self._children.get(parent)
+        if sibs is not None:
+            sibs.discard(key)
+            if not sibs:
+                self._children.pop(parent, None)
+        self.evicted += 1
+        for child in list(self._children.get(key, ())):
+            self._evict_subtree_locked(child)
+        self._children.pop(key, None)
+
+    def _note_blocks_locked(self):
+        if self._m_blocks is not None:
+            self._m_blocks.set(len(self._entries))
+
+    # -- persistence (graceful drain / --restore-dir) ------------------------
+    def save(self, directory: str) -> int:
+        """Serialize every resident entry to `directory`/shadow.npz,
+        atomically (tmp + rename): a crash mid-save leaves the previous
+        file intact — the on-disk shadow is crash-consistent the same
+        way the in-memory one is. Returns entries written."""
+        os.makedirs(directory, exist_ok=True)
+        bs = self.block_size
+        with self._lock:
+            ordered = sorted(
+                self._entries.items(),
+                key=lambda kv: len(kv[0]),
+            )
+            lru_pos = {k: i for i, k in enumerate(self._entries)}
+            snapshot = [
+                (k, [np.array(a) for a in e.leaves], e.seq, lru_pos[k])
+                for k, e in ordered
+            ]
+        idx = {k: i for i, (k, _, _, _) in enumerate(snapshot)}
+        manifest = {
+            "version": _PERSIST_VERSION,
+            "block_size": bs,
+            "entries": [
+                {
+                    "p": idx.get(k[:-bs], -1),
+                    "t": [int(t) for t in k[-bs:]],
+                    "seq": seq,
+                    "lru": lru,
+                }
+                for k, _, seq, lru in snapshot
+            ],
+        }
+        arrays = {"manifest": np.array(json.dumps(manifest))}
+        if snapshot:
+            n_leaves = len(snapshot[0][1])
+            for j in range(n_leaves):
+                arrays[f"leaf_{j}"] = np.stack(
+                    [leaves[j] for _, leaves, _, _ in snapshot]
+                )
+        tmp = os.path.join(directory, "." + _PERSIST_NAME + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(directory, _PERSIST_NAME))
+        log.info("shadow_saved", entries=len(snapshot), dir=directory)
+        return len(snapshot)
+
+    def load(self, directory: str) -> int:
+        """Load a persisted shadow (missing/invalid file = start cold,
+        never an error: a warm cache is an optimization). Returns
+        entries loaded."""
+        path = os.path.join(directory, _PERSIST_NAME)
+        if not os.path.exists(path):
+            return 0
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                manifest = json.loads(str(z["manifest"]))
+                if (
+                    manifest.get("version") != _PERSIST_VERSION
+                    or manifest.get("block_size") != self.block_size
+                ):
+                    log.warning(
+                        "shadow_load_skipped",
+                        reason="version/block_size mismatch", path=path,
+                    )
+                    return 0
+                ents = manifest.get("entries", [])
+                leaves = []
+                j = 0
+                while f"leaf_{j}" in z.files:
+                    leaves.append(z[f"leaf_{j}"])
+                    j += 1
+        except Exception as e:  # noqa: BLE001 - cold start beats crashing
+            log.warning("shadow_load_failed", error=str(e), path=path)
+            return 0
+        if not ents or not leaves or any(
+            leaf.shape[0] != len(ents) for leaf in leaves
+        ):
+            return 0
+        keys: list = []
+        for i, ent in enumerate(ents):
+            p = int(ent["p"])
+            if p >= i:  # parents-first ordering violated: corrupt
+                return 0
+            parent_key = keys[p] if p >= 0 else ()
+            keys.append(parent_key + tuple(int(t) for t in ent["t"]))
+        order = sorted(range(len(ents)), key=lambda i: ents[i]["lru"])
+        with self._lock:
+            for i in order:
+                self._insert_locked(
+                    keys[i],
+                    _Entry(
+                        [leaf[i] for leaf in leaves], int(ents[i]["seq"])
+                    ),
+                )
+            self._note_blocks_locked()
+            n = len(self._entries)
+        log.info("shadow_loaded", entries=n, dir=directory)
+        return n
+
+    # -- shared surface ------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "blocks": len(self._entries),
+                "block_size": self.block_size,
+                "max_blocks": self.max_blocks,
+                "pending": len(self._pending),
+                "copied": self.copied,
+                "dropped": self.dropped,
+                "evicted": self.evicted,
+            }
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._children.clear()
+            self._note_blocks_locked()
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)

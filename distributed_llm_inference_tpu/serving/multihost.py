@@ -34,6 +34,10 @@ import threading
 import jax
 import numpy as np
 
+from ..utils.logging import get_logger
+
+log = get_logger("multihost")
+
 # Fixed wire size: the payload collective must have the same shape on
 # every process, request content is length-prefixed inside it. 64 KiB
 # covers any request the HTTP edge accepts (prompts are bounded by the
@@ -104,11 +108,36 @@ class MirroredEngine:
     def score(self, *args, **kwargs):
         return self._mirrored("score", args, kwargs)
 
-    def shutdown_followers(self):
+    def shutdown_followers(self, timeout_s: float = 5.0) -> bool:
         """Release the follower loops (idempotent best-effort: call once,
-        right before the leader exits)."""
-        with self._issue_lock:
-            _broadcast_obj(_SHUTDOWN, is_source=True)
+        right before the leader exits).
+
+        Bounded: a follower that already DIED can never answer the
+        collective, and an unguarded broadcast would wedge leader exit
+        on it forever. The broadcast (lock acquisition included — a
+        stuck mirrored call may hold the issue lock for the same reason)
+        runs on a daemon thread the leader abandons past `timeout_s` —
+        the same abandonment discipline as engine._with_deadline. Returns
+        True when the broadcast completed inside the timeout."""
+        done = threading.Event()
+
+        def _bcast():
+            try:
+                with self._issue_lock:
+                    _broadcast_obj(_SHUTDOWN, is_source=True)
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=_bcast, daemon=True, name="multihost-shutdown"
+        )
+        t.start()
+        if done.wait(timeout_s):
+            return True
+        log.warning(
+            "shutdown_followers_timeout", timeout_s=timeout_s,
+        )
+        return False
 
     def __getattr__(self, name):
         return getattr(self._engine, name)

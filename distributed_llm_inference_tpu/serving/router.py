@@ -175,7 +175,7 @@ class Router:
         )
         self._res_lock = threading.Lock()
         self.rolling: dict = {"active": False, "done": [], "current": None,
-                              "error": None}
+                              "error": None, "warm": {}}
         self._roll_lock = threading.Lock()
         self._closed = threading.Event()
         self._probe_thread: Optional[threading.Thread] = None
@@ -526,7 +526,7 @@ class Router:
             if self.rolling["active"]:
                 return {"error": "rolling restart already in progress"}
             self.rolling = {"active": True, "done": [], "current": None,
-                            "error": None}
+                            "error": None, "warm": {}}
         threading.Thread(
             target=self._rolling_restart, daemon=True, name="rolling-restart"
         ).start()
@@ -573,12 +573,36 @@ class Router:
             stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
         )
         self._wait_replica_ready(rep)
+        # warm-handoff check: a replica started with --restore-dir
+        # reloads its drained predecessor's shadowed KV (engine/
+        # shadow.py) and reports restored_blocks in its stats — surfaced
+        # per replica in /health.rolling_restart.warm so a rollout that
+        # silently came back COLD (missing --restore-dir, config drift
+        # invalidating the persisted shadow) is visible, not inferred
+        # from TTFT regressions later
+        warm = self._warm_handoff(rep)
+        with self._roll_lock:
+            self.rolling.setdefault("warm", {})[rep.rid] = warm
         with rep.lock:
             rep.state = READY
             rep.consecutive_failures = 0
             rep.cooldown_until = 0.0
             self._set_ready_gauge(rep)
-        log.info("rolling_restart_replica_ready", replica=rep.rid)
+        log.info("rolling_restart_replica_ready", replica=rep.rid, warm=warm)
+
+    def _warm_handoff(self, rep: Replica) -> bool:
+        """True when the respawned replica restored shadowed KV blocks
+        (warm prefix cache); False on a cold start or an unreadable
+        stats surface (never raises — warmth is an optimization)."""
+        try:
+            with urllib.request.urlopen(
+                rep.url + "/stats", timeout=self.probe_timeout_s
+            ) as resp:
+                st = json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 - diagnostics only
+            return False
+        shadow = (st.get("continuous") or {}).get("shadow") or {}
+        return bool(shadow.get("restored_blocks", 0))
 
     def _wait_replica_ready(self, rep: Replica, deadline_s: float = 300.0):
         t0 = time.time()

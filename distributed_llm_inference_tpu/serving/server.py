@@ -149,7 +149,8 @@ def _route_label(path: str) -> str:
 
 
 def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
-                 queue=None, continuous=None, state=None):
+                 queue=None, continuous=None, state=None,
+                 wedge_unready_s: float = 10.0):
     from ..utils.tracing import new_request_id, sanitize_request_id
     from . import openai_api as oai
 
@@ -207,10 +208,18 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
 
         def _readiness(self) -> tuple:
             """(ready, reason): liveness is /health's job; THIS is the
-            load-balancer signal — False while draining and while the
-            continuous scheduler is restart-looping or dead."""
+            load-balancer signal — False while draining, while the
+            continuous scheduler is restart-looping or dead, and while
+            an abandoned deadline-overrun device call has been wedged
+            past --wedge-unready (the router tier's probes eject the
+            replica off this; /health keeps answering 200 so the
+            process is not reaped — a wedge can still drain)."""
             if state.draining:
                 return False, "draining"
+            if wedge_unready_s and hasattr(engine, "max_wedged_age"):
+                age = engine.max_wedged_age()
+                if age is not None and age > wedge_unready_s:
+                    return False, "wedged"
             if continuous is not None and not continuous.ready:
                 return False, (
                     "scheduler_dead"
@@ -719,7 +728,8 @@ class InferenceServer:
 
     def __init__(self, engine, host: str = "0.0.0.0", port: int = 5000,
                  max_tokens_cap: int = 30, queue=None, continuous=None,
-                 drain_deadline_s: float = 30.0):
+                 drain_deadline_s: float = 30.0,
+                 wedge_unready_s: float = 10.0):
         self.engine = engine
         self.queue = queue
         self.continuous = continuous
@@ -728,7 +738,8 @@ class InferenceServer:
         self.httpd = ThreadingHTTPServer(
             (host, port),
             make_handler(engine, max_tokens_cap, queue=queue,
-                         continuous=continuous, state=self.state),
+                         continuous=continuous, state=self.state,
+                         wedge_unready_s=wedge_unready_s),
         )
         self.port = self.httpd.server_address[1]
 
@@ -1008,6 +1019,29 @@ def main(argv: Optional[list] = None):
              "Chaos drills only — never in front of real traffic",
     )
     ap.add_argument(
+        "--wedge-unready", type=float, default=10.0, metavar="SECONDS",
+        help="flip GET /ready to 503 (reason 'wedged') while an abandoned "
+             "deadline-overrun device call has been stuck this long — the "
+             "router tier's health probes then eject the replica until "
+             "the call drains (0 disables; needs --deadline to ever "
+             "trigger; liveness /health stays 200 throughout)",
+    )
+    ap.add_argument(
+        "--restore-dir", default=None, metavar="DIR",
+        help="warm-state persistence for --continuous with "
+             "--kv-pool-blocks (engine/shadow.py): graceful drain "
+             "(SIGTERM / rolling restart) serializes the shadowed KV "
+             "blocks + block-prefix chains here, and startup restores "
+             "them into the fresh pool — the replica rejoins with a "
+             "WARM prefix cache (needs --prefix-cache > 0)",
+    )
+    ap.add_argument(
+        "--no-kv-shadow", action="store_true",
+        help="disable the warm-recovery shadow store (supervisor "
+             "restarts and --restore-dir starts then recover cold, "
+             "re-prefilling every salvaged request from its full prompt)",
+    )
+    ap.add_argument(
         "--die-on-wedge", type=float, default=None, metavar="SECONDS",
         help="exit the process (code 17) once an abandoned deadline-overrun "
              "device call has been stuck this long — a supervisor restart "
@@ -1163,6 +1197,7 @@ def main(argv: Optional[list] = None):
         engine_cfg=EngineConfig(
             request_deadline_s=args.deadline,
             prefix_cache_entries=args.prefix_cache,
+            kv_shadow=not args.no_kv_shadow,
         ),
         microbatches=args.microbatches,
         params=params,
@@ -1243,6 +1278,7 @@ def main(argv: Optional[list] = None):
             kv_block_size=args.kv_block_size,
             restart_budget=args.restart_budget,
             poison_strikes=args.poison_strikes,
+            restore_dir=args.restore_dir,
         )
         if args.warmup:
             w = continuous.warmup()
@@ -1263,6 +1299,7 @@ def main(argv: Optional[list] = None):
         InferenceServer(
             engine, args.host, args.port, args.max_tokens_cap, queue=queue,
             continuous=continuous, drain_deadline_s=args.drain_deadline,
+            wedge_unready_s=args.wedge_unready,
         ).serve_forever()
     finally:
         if hasattr(engine, "shutdown_followers"):

@@ -15,6 +15,17 @@ containment path runs in CI, deterministically:
                    the BaseException handler must release them)
     decode_launch  before a decode chunk launch
     fetch          before a chunk's device->host fetch
+    shadow_copy    the warm-recovery shadow store (engine/shadow.py):
+                   before a filled-block device->host capture is
+                   dispatched (tag = the request's prompt) and before a
+                   rebuilt pool restores shadowed blocks (tag
+                   "restore" — the crash-during-restore double-fault
+                   drill)
+    solo           the solo engine's generation path, inside the
+                   deadline wrapper (engine._generate_locked) — the
+                   wedge drill for /ready-driven router ejection: a
+                   wedge_s > deadline rule leaves an abandoned device
+                   call in engine._wedged until the sleep drains
 
 Design rules:
   * Zero overhead disarmed: check() is one module-global None test.
@@ -50,7 +61,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-POINTS = ("admission", "prefill", "decode_launch", "fetch", "alloc")
+POINTS = (
+    "admission", "prefill", "decode_launch", "fetch", "alloc",
+    "shadow_copy", "solo",
+)
 
 
 class FaultError(RuntimeError):

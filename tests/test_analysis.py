@@ -476,6 +476,26 @@ def test_fault_hooks_decode_unreachable(real_reachable):
         ("engine.continuous", "ContinuousEngine._admit_one"),
         ("engine.continuous", "ContinuousEngine._supervise"),
         ("engine.continuous", "ContinuousEngine._run_recovery"),
+        ("engine.engine", "InferenceEngine._generate_locked"),
+    ]:
+        assert key not in real_reachable, key
+
+
+def test_shadow_store_decode_unreachable(real_reachable):
+    """The warm-recovery shadow store (engine/shadow.py) is strictly
+    host-side: its copier thread blocks on device->host transfers and
+    its persistence does file I/O — none of it may be reachable from a
+    jit root, exactly like utils/faults.py. The engine-side capture /
+    restore drivers stay untraced too; only the tiny gather/scatter
+    PROGRAMS (engine/paged.gather_shadow_blocks /
+    restore_shadow_blocks) touch the device, as their own jit roots."""
+    shadow_funcs = sorted(
+        k for k in real_reachable if k[0] == "engine.shadow"
+    )
+    assert not shadow_funcs, shadow_funcs
+    for key in [
+        ("engine.continuous", "ContinuousEngine._shadow_capture"),
+        ("engine.continuous", "ContinuousEngine._restore_shadow"),
     ]:
         assert key not in real_reachable, key
 

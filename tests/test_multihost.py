@@ -311,3 +311,55 @@ def test_multiprocess_rejects_timing_dependent_layers(monkeypatch):
         server_mod.main(
             ["--model", "test-llama-tiny", "--continuous", "2", "--port", "0"]
         )
+
+
+def test_shutdown_followers_bounded_when_follower_dead(monkeypatch):
+    """A follower that already died can never answer the shutdown
+    collective; the leader's exit must be bounded, not wedged — the
+    broadcast runs on an abandoned daemon thread past timeout_s (same
+    discipline as engine._with_deadline)."""
+    import threading
+    import time
+
+    from distributed_llm_inference_tpu.serving import multihost as mh
+
+    m = mh.MirroredEngine(object())
+    hung = threading.Event()
+
+    def _hang(obj, is_source):
+        hung.set()
+        time.sleep(30)  # the dead-follower collective never completes
+
+    monkeypatch.setattr(mh, "_broadcast_obj", _hang)
+    t0 = time.time()
+    assert m.shutdown_followers(timeout_s=0.2) is False
+    assert time.time() - t0 < 5
+    assert hung.wait(5)  # the broadcast really was attempted
+
+
+def test_shutdown_followers_returns_true_on_fast_broadcast(monkeypatch):
+    from distributed_llm_inference_tpu.serving import multihost as mh
+
+    m = mh.MirroredEngine(object())
+    seen = []
+    monkeypatch.setattr(
+        mh, "_broadcast_obj", lambda obj, is_source: seen.append(obj)
+    )
+    assert m.shutdown_followers(timeout_s=5.0) is True
+    assert seen == [mh._SHUTDOWN]
+
+
+def test_shutdown_followers_bounded_when_issue_lock_held(monkeypatch):
+    """A wedged mirrored call holds the issue lock; shutdown must not
+    wait on it forever either — the lock acquisition lives on the same
+    abandoned thread as the broadcast."""
+    from distributed_llm_inference_tpu.serving import multihost as mh
+
+    m = mh.MirroredEngine(object())
+    monkeypatch.setattr(
+        mh, "_broadcast_obj", lambda obj, is_source: None
+    )
+    with m._issue_lock:  # a stuck mirrored call, in spirit
+        assert m.shutdown_followers(timeout_s=0.2) is False
+    # lock released: the abandoned thread's broadcast now completes
+    # harmlessly in the background

@@ -783,3 +783,88 @@ def test_rolling_restart_never_drops_a_request(fleet, ref_engine):
             break
         time.sleep(0.25)
     assert _get(base, "/health")[1]["rolling_restart"]["error"] is None
+
+
+# -- wedge-driven ejection (warm-recovery PR satellite) ----------------------
+
+WEDGE_ARGS = [
+    "--model", "test-llama-tiny", "--deadline", "1",
+    "--wedge-unready", "0.3", "--max-tokens-cap", "64", "--warmup",
+]
+# the solo point sleeps PAST the 1s deadline, so the engine abandons the
+# call (engine._wedged fills) and only 7s later does the sleep drain
+WEDGE_FAULTS = "solo:transient:match=WEDGEME,wedge=7,times=1"
+
+
+def test_wedge_ejection_and_readmission_after_drain():
+    """DLI_FAULTS wedge -> the replica's /ready flips 503 (reason
+    'wedged', off engine.max_wedged_age past --wedge-unready) -> the
+    router's probes eject it -> once the abandoned call drains, probes
+    readmit it and it serves again. The liveness surface (/health) stays
+    200 throughout: nothing reaps a process that can still recover."""
+    rep = spawn_replicas(1, WEDGE_ARGS, env=_spawn_env(WEDGE_FAULTS))[0]
+    router = Router(
+        [rep], eject_threshold=2, probe_interval_s=0.2,
+        probe_timeout_s=2.0, request_timeout_s=60.0, drain_deadline_s=30.0,
+    )
+    server = RouterServer(router, host="127.0.0.1", port=0)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        assert _wait_state(router, "r0", READY, deadline_s=300)
+        # sanity: a clean request serves (and warms the solo programs so
+        # the wedge request's 1s deadline is all wedge, not compile)
+        code, body, _ = _post(
+            base, {"prompt": "clean", "max_tokens": 2, "greedy": True,
+                   "chat": False}, timeout=120,
+        )
+        assert code == 200 and body["status"] == "success", body
+
+        # fire the wedge prompt; the replica answers 503 timeout after
+        # its 1s deadline while the device call stays stuck for 7s
+        out = {}
+
+        def fire():
+            out["r"] = _post(
+                base, {"prompt": "WEDGEME now", "max_tokens": 4,
+                       "greedy": True, "chat": False}, timeout=60,
+            )
+
+        t = threading.Thread(target=fire)
+        t.start()
+        # ejection: probes see /ready 503 (reason wedged) and strike it
+        # out within the probe window
+        assert _wait_state(router, "r0", EJECTED, deadline_s=15), (
+            "wedged replica was never ejected"
+        )
+        code, body, _ = _get(base, "/ready")  # router itself: no replica
+        assert code == 503
+        # the replica's own readiness says WHY, and its liveness is 200
+        rcode, rbody, _ = _get(rep.url, "/ready")
+        assert rcode == 503 and rbody["reason"] == "wedged", rbody
+        hcode, hbody, _ = _get(rep.url, "/health")
+        assert hcode == 200 and hbody["ready_reason"] == "wedged"
+        t.join(timeout=60)
+        code, body, _ = out["r"]
+        assert body.get("error_type") == "timeout", body
+
+        # the abandoned call drains (the 7s sleep ends) -> /ready 200 ->
+        # probes readmit without any restart
+        assert _wait_state(router, "r0", READY, deadline_s=30), (
+            "replica was never readmitted after the wedge drained"
+        )
+        assert _counter(
+            router, "dli_router_readmissions_total", replica="r0"
+        ) >= 1
+        code, body, _ = _post(
+            base, {"prompt": "after the wedge", "max_tokens": 2,
+                   "greedy": True, "chat": False}, timeout=120,
+        )
+        assert code == 200 and body["status"] == "success", body
+    finally:
+        server.shutdown()
+        if rep.proc is not None:
+            try:
+                rep.proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
