@@ -1064,6 +1064,121 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
+    # overload leg (SLO-aware KV preemption, engine/continuous.py
+    # _preempt_for): a low-priority HOG decode holds most of a pool
+    # sized to ~60% of the combined working set while deadline-carrying
+    # interactive requests arrive. Shed-only ("off"): each interactive
+    # admission waits for the hog's full decode and blows its
+    # deadline_ms (504). Preemption ("swap"): the hog is evicted
+    # (lowest weight), its KV swapped to the host shadow, and the
+    # interactive stream completes inside its deadlines; the hog
+    # resumes between arrivals. Headline: interactive completion rate
+    # + p99 — "pool full" as a policy decision, not a tail-latency
+    # cliff.
+    if cont_block and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        try:
+            ov_bs = 32
+            ov_slot_seq = 512  # 16 blocks
+            hog_p = prompts[0]
+            # the hog's budget fills its WHOLE slot class, so its blocks
+            # span the entire usable pool and no short can be placed
+            # beside it — the pool lands at ~60% of the combined
+            # (hog + interactive stream) working set
+            hog_mt = ov_slot_seq - (len(hog_p) + 8) - 1
+            hog_kw = dict(max_tokens=hog_mt, greedy=True, chat=False,
+                          slo_class="batch")
+            short_p = "interactive q"
+            short_kw = dict(max_tokens=8, greedy=True, chat=False,
+                            slo_class="interactive")
+            hog_need = -(-(len(hog_p) + 8 + hog_mt) // ov_bs)
+            short_need = -(-(len(short_p) + 8 + 8) // ov_bs)
+            ov_pool = ov_slot_seq // ov_bs + 1  # usable == one slot class
+            n_short = 6
+
+            def overload_leg(policy):
+                eng_o = InferenceEngine(
+                    c_cfg, params=c_params,
+                    engine_cfg=EngineConfig(
+                        prefix_cache_entries=4, preempt_policy=policy,
+                        # the livelock cap exists for safety; the bench
+                        # measures the policy ceiling, so let the hog be
+                        # preempted once per interactive arrival
+                        max_preemptions_per_req=64,
+                    ),
+                )
+                cont = ContinuousEngine(
+                    eng_o, n_slots=n_slots, chunk_steps=chunk,
+                    slot_max_seq=ov_slot_seq,
+                    kv_pool_blocks=ov_pool, kv_block_size=ov_bs,
+                )
+                try:
+                    cont.submit(hog_p, **dict(hog_kw, max_tokens=8))
+                    t0 = time.perf_counter()
+                    clean = cont.submit(short_p, **short_kw)
+                    clean_s = time.perf_counter() - t0
+                    if clean.get("status") != "success":
+                        return None
+                    deadline_ms = max(200.0, 6 * clean_s * 1e3)
+                    hog_out = {}
+
+                    def run_hog():
+                        hog_out["r"] = cont.submit(hog_p, **hog_kw)
+
+                    th = threading.Thread(target=run_hog)
+                    th.start()
+                    while cont.stats()["occupied"] < 1:
+                        time.sleep(0.002)
+                    walls, ok = [], 0
+                    t0 = time.perf_counter()
+                    for _ in range(n_short):
+                        t1 = time.perf_counter()
+                        r = cont.submit(
+                            short_p, deadline_ms=deadline_ms, **short_kw
+                        )
+                        w = time.perf_counter() - t1
+                        if r.get("status") == "success":
+                            ok += 1
+                            walls.append(w)
+                        time.sleep(0.01)
+                    wall = time.perf_counter() - t0
+                    th.join(timeout=120)
+                    walls.sort()
+                    return {
+                        "offered": n_short,
+                        "completed": ok,
+                        "completion_rate": round(ok / n_short, 3),
+                        "p99_s": round(
+                            walls[min(len(walls) - 1,
+                                      int(0.99 * len(walls)))], 4,
+                        ) if walls else None,
+                        "deadline_ms": round(deadline_ms, 1),
+                        "wall_s": round(wall, 3),
+                        "preempted": cont.preempted_total,
+                        "hog_status": hog_out.get("r", {}).get("status"),
+                    }
+                finally:
+                    cont.close()
+
+            preempt_leg = overload_leg("swap")
+            shed_leg = overload_leg("off")
+            if preempt_leg and shed_leg:
+                cont_block["overload"] = {
+                    "preempt": preempt_leg, "shed_only": shed_leg,
+                    "pool_blocks": ov_pool,
+                    "working_set_blocks": hog_need + 6 * short_need,
+                }
+                cont_block["overload_completion_rate"] = preempt_leg[
+                    "completion_rate"
+                ]
+                cont_block["overload_completion_rate_shed_only"] = shed_leg[
+                    "completion_rate"
+                ]
+            _write_sidecar(dict(result, continuous=cont_block))
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
     if cont_block:
         result["continuous"] = cont_block
         # keep the round-3 flat key so round-over-round comparisons of the

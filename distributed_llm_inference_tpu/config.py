@@ -402,6 +402,24 @@ class EngineConfig:
     # so a full pool's worth of warm chains survives one generation of
     # churn.
     kv_shadow_blocks: int = 0
+    # SLO-aware KV preemption (engine/continuous.py _preempt_for): when a
+    # paged admission still cannot get blocks after the evict-
+    # unreferenced-chains retry, the scheduler preempts the lowest-SLO-
+    # weight / youngest DECODING request instead of stalling the queue:
+    #   "swap"      — push the victim's filled blocks to the host shadow
+    #                 (synchronous flush through engine/shadow.py) before
+    #                 releasing them, so the resume re-admission restores
+    #                 the chain in one scatter and re-prefills only the
+    #                 tail; a backlogged copier falls back to
+    #                 drop-and-recompute (bit-identical either way);
+    #   "recompute" — always drop the KV and re-prefill from the salvage
+    #                 record (prompt + fetched tokens) on resume;
+    #   "off"       — never preempt (pool exhaustion waits for a release,
+    #                 the pre-preemption behavior).
+    preempt_policy: str = "swap"
+    # Livelock guard: a request preempted this many times becomes immune
+    # (it keeps its blocks until completion; admission waits instead).
+    max_preemptions_per_req: int = 2
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":

@@ -92,6 +92,8 @@ class _Request:
         "cancelled", "prompt_tokens", "block_ids", "need", "cart",
         "trace", "salvaged", "strikes", "allowed", "slo",
         "ids", "shadow_depth", "recovering",
+        "deadline_at", "cancel_cause", "preemptions", "preempted_at",
+        "resume_seq", "drop_seq",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
@@ -150,6 +152,32 @@ class _Request:
         # set while the recovery path re-admits this request — drives
         # the dli_recovery_tokens_recomputed_total accounting
         self.recovering = False
+        # end-to-end deadline (deadline_ms on /generate and the OpenAI
+        # routes, propagated via X-Request-Deadline-Ms through the
+        # router): absolute wall-clock expiry, checked ONLY at launch
+        # boundaries on the host (never inside compiled code); None =
+        # no per-request deadline (engine_cfg.request_deadline_s still
+        # applies as the server-wide cap)
+        dl = kwargs.pop("deadline_ms", None)
+        self.deadline_at = (
+            self.enqueued + float(dl) / 1e3 if dl is not None else None
+        )
+        # why the cancel flag was flipped (dli_cancelled_total{cause})
+        self.cancel_cause = "disconnect"
+        # SLO-aware KV preemption (engine_cfg.preempt_policy): how many
+        # times this request was evicted mid-decode to make pool room —
+        # at max_preemptions_per_req it becomes immune — and when it was
+        # last parked (feeds dli_preempted_resume_seconds)
+        self.preemptions = 0
+        self.preempted_at: float = 0.0
+        # swap path: the token sequence whose shadowed chain the resume
+        # re-admission restores (None = drop-and-recompute)
+        self.resume_seq = None
+        # launch-seq barrier: emissions fetched from chunks launched
+        # BEFORE this seq are dropped (a preempted victim's in-flight
+        # chunks are regenerated after resume, exactly like the crash
+        # salvage contract)
+        self.drop_seq = 0
 
 
 class ContinuousEngine:
@@ -211,6 +239,26 @@ class ContinuousEngine:
         self.restart_budget = max(0, int(restart_budget))
         self.restart_backoff_s = float(restart_backoff_s)
         self.poison_strikes = max(1, int(poison_strikes))
+        # SLO-aware KV preemption (graceful degradation under memory
+        # pressure): when the pool still cannot place an admission after
+        # the evict-unreferenced-chains retry, _preempt_for evicts the
+        # lowest-SLO-weight / youngest decoding victim instead of
+        # stalling the queue (policy: "swap" pushes the victim's filled
+        # blocks to the host shadow first, "recompute" drops them,
+        # "off" restores the wait-for-release behavior).
+        self.preempt_policy = str(engine.engine_cfg.preempt_policy)
+        if self.preempt_policy not in ("swap", "recompute", "off"):
+            raise ValueError(
+                f"preempt_policy must be 'swap', 'recompute', or 'off', "
+                f"got {self.preempt_policy!r}"
+            )
+        self.max_preemptions = max(
+            0, int(engine.engine_cfg.max_preemptions_per_req)
+        )
+        # preempted requests parked for re-admission (served BEFORE the
+        # regular queue — a victim must not also lose its queue position)
+        self._resume: list[_Request] = []
+        self.preempted_total = 0
 
         # Per-slot KV budget (round-2 review weak #7): the fleet cache pins
         # n_slots x slot_max_seq of KV in HBM for the server's lifetime —
@@ -401,8 +449,9 @@ class ContinuousEngine:
         # fleet (block immutability is the consistency argument), the
         # block-prefix index (restore re-enters through the ordinary
         # prefix-hit machinery), and a backend with the shadow
-        # gather/scatter programs (single-device today — the pp pool
-        # would need shard_map twins, so pp fleets recover cold).
+        # gather/scatter programs (the single device AND the pp pipeline
+        # — parallel/pipeline's layer-local shard_map twins — so
+        # pp-sharded pools recover warm too).
         self._shadow = None
         self._restore_dir = restore_dir
         self._needs_restore = False
@@ -502,6 +551,21 @@ class ContinuousEngine:
         self._m_shed = m.counter(
             "dli_queue_shed_total", "requests shed with 429", ("queue",)
         ).labels(queue="continuous")
+        # graceful-degradation families (pre-registered in
+        # engine/engine.py): preempt->resume latency, cancellations by
+        # cause, deadline overruns
+        self._m_resume_s = m.histogram(
+            "dli_preempted_resume_seconds",
+            "preemption to successful re-admission latency",
+        ).labels()
+        self._m_cancelled = m.counter(
+            "dli_cancelled_total",
+            "requests cancelled before completion", ("cause",),
+        )
+        self._m_deadline_exceeded = m.counter(
+            "dli_deadline_exceeded_total",
+            "requests failed by their end-to-end deadline_ms",
+        ).labels()
         self._m_restarts = m.counter(
             "dli_scheduler_restarts_total",
             "continuous-scheduler supervisor restarts", ("engine",),
@@ -633,6 +697,34 @@ class ContinuousEngine:
     def _class_depth_locked(self, cls_name: str) -> int:
         return sum(1 for r in self._queue if r.slo == cls_name)
 
+    def _cancel_env(self, req: _Request) -> dict:
+        """The cancelled envelope (HTTP 499 at the edge; the router
+        never re-dispatches it) + the cause-labeled counter."""
+        self._m_cancelled.labels(cause=req.cancel_cause).inc()
+        return {
+            "error": "Error: request cancelled", "status": "failed",
+            "error_type": "cancelled",
+        }
+
+    def _deadline_env(self, req: _Request, where: str = "") -> dict:
+        """The deadline_exceeded envelope (HTTP 504 at the edge; the
+        router never re-dispatches it — the budget is the REQUEST's
+        property, not the replica's)."""
+        self._m_deadline_exceeded.inc()
+        suffix = f" {where}" if where else ""
+        return {
+            "error": f"Error: request exceeded its deadline_ms "
+            f"budget{suffix}",
+            "status": "failed",
+            "error_type": "deadline_exceeded",
+        }
+
+    @staticmethod
+    def _past_deadline(req: _Request, now: Optional[float] = None) -> bool:
+        return req.deadline_at is not None and (
+            now if now is not None else time.time()
+        ) >= req.deadline_at
+
     def _enqueue(self, req: _Request) -> Optional[dict]:
         """Admit a request to the bounded queue. Returns an error envelope
         (caller delivers it OUTSIDE any lock — a streaming caller yields to
@@ -646,6 +738,11 @@ class ContinuousEngine:
         backlog must not tell an interactive client to stay away."""
         cls = self._sched.classify(req.slo)
         req.slo = cls.name
+        if self._past_deadline(req):
+            # fail-fast: an already-expired request must not spend a
+            # prefill launch or a single pool block (tests assert zero
+            # allocations for these)
+            return self._deadline_env(req, where="before admission")
         with self._cv:
             if self._closed:
                 return {
@@ -754,20 +851,26 @@ class ContinuousEngine:
             if not req.done.is_set():
                 self.cancel(req)
 
-    def cancel(self, req: _Request):
-        """Cancel a request: dequeue it if still waiting, or flag it for
-        the worker to kill its slot at the next chunk boundary."""
+    def cancel(self, req: _Request, cause: str = "disconnect"):
+        """Cancel a request: dequeue it if still waiting (queue or the
+        preemption resume queue), or flag it for the worker to kill its
+        slot — and free its blocks/constraint row — at the next launch
+        boundary. `cause` labels dli_cancelled_total."""
+        req.cancel_cause = cause
         with self._cv:
-            if req in self._queue:
-                self._queue.remove(req)
-                self._note_queue_locked()
-                req.result = {
-                    "error": "Error: request cancelled", "status": "failed",
-                    "error_type": "cancelled",
-                }
+            if req in self._queue or req in self._resume:
+                if req in self._queue:
+                    self._queue.remove(req)
+                    self._note_queue_locked()
+                else:
+                    self._resume.remove(req)
+                req.result = self._cancel_env(req)
                 self._push_final(req)
                 return
             req.cancelled = True
+            # wake the worker: a cancel must free the slot within one
+            # scheduler step even when nothing else is queued
+            self._cv.notify_all()
 
     def _stream_tokens(self, req: _Request, final: bool = False, pre=None):
         """Push the not-yet-streamed suffix of req's text (worker thread).
@@ -819,6 +922,7 @@ class ContinuousEngine:
             or any(r is not None for r in self._assignment)
             or self._admitting is not None
             or self._recovery
+            or self._resume
         )
 
     def drain(self, deadline_s: Optional[float] = None) -> bool:
@@ -876,8 +980,9 @@ class ContinuousEngine:
             "error_type": "overloaded",
         }
         with self._cv:
-            pending = self._queue[:]
+            pending = self._queue[:] + self._resume[:]
             self._queue.clear()
+            self._resume.clear()
             self._note_queue_locked()
         for req in pending + [r for r in self._assignment if r is not None]:
             if req.result is None:
@@ -923,6 +1028,12 @@ class ContinuousEngine:
                 "peak_occupancy": self.peak_occupancy,
                 "chunk_steps": self.chunk_steps,
             }
+        out["preemption"] = {
+            "policy": self.preempt_policy,
+            "max_per_request": self.max_preemptions,
+            "preempted_total": self.preempted_total,
+            "parked": len(self._resume),
+        }
         out["supervisor"] = {
             "ready": self.ready,
             "draining": self._draining,
@@ -1215,6 +1326,200 @@ class ContinuousEngine:
         )
         return n
 
+    # -- SLO-aware KV preemption (graceful degradation under memory
+    # pressure; ARCHITECTURE.md "Preemption & cancellation") ----------------
+    def _alloc_with_pressure(self, req: _Request) -> Optional[list]:
+        """`req.need` fresh blocks through the full memory-pressure
+        ladder: plain alloc → evict unreferenced cached chains → preempt
+        a victim (whose chains the next evict round can reclaim) → None
+        (the caller requeues with _BLOCKED). Worker thread only."""
+        blk_ids = self._alloc.alloc(req.need)
+        while blk_ids is None:
+            if self._bpx is not None:
+                self._bpx.evict(req.need - self._alloc.free_blocks)
+                blk_ids = self._alloc.alloc(req.need)
+                if blk_ids is not None:
+                    return blk_ids
+            if not self._preempt_for(req):
+                return None
+            blk_ids = self._alloc.alloc(req.need)
+        return blk_ids
+
+    def _victim_for(self, req: _Request) -> Optional[_Request]:
+        """The decoding tenant to evict so `req` can be placed, or None.
+        Candidates: assigned, still running, NOT mid-prefill (a chunked
+        job's partial blocks are not yet a restorable chain), below the
+        preemption cap, and not outranking the beneficiary's SLO weight.
+        The scheduler's policy object picks lowest-weight / youngest."""
+        with self._cv:
+            cands = [
+                r for b, r in enumerate(self._assignment)
+                if r is not None and not r.done.is_set() and r is not req
+                and b not in self._prefilling
+                and r.preemptions < self.max_preemptions
+            ]
+        if not cands:
+            return None
+        return self._sched.select_victim(
+            [(r, self._sched.classify(r.slo), r.enqueued) for r in cands],
+            self._sched.classify(req.slo),
+        )
+
+    def _preempt_for(self, req: _Request) -> bool:
+        """Evict one decoding victim to make pool room for `req` (worker
+        thread, called when allocation failed even after the
+        evict-unreferenced-chains retry). Returns True when a victim was
+        preempted (its blocks decref'd — the caller re-runs the evict +
+        alloc retry, which can now reclaim the victim's index-cached
+        chains too).
+
+        The victim's host-side record (prompt + fetched tokens) is the
+        same salvage contract a supervisor restart uses, so its resume
+        re-admission is greedy bit-identical; under preempt_policy
+        "swap" its filled blocks are pushed to the host shadow FIRST
+        (synchronous flush) so the resume restores them in one scatter
+        and re-prefills only the tail — a backlogged copier falls back
+        to drop-and-recompute. Emissions from the victim's still-in-
+        flight chunks are dropped via the drop_seq barrier (regenerated
+        after resume), exactly like unfetched chunks across a crash."""
+        if self.preempt_policy == "off":
+            return False
+        victim = self._victim_for(req)
+        if victim is None:
+            return False
+        faults.check("preempt", tag=victim.prompt)
+        swapped = False
+        if self.preempt_policy == "swap" and self._shadow is not None:
+            # capture any blocks filled since the last fetch, then wait
+            # for every pending copy to LAND — only resident entries are
+            # restorable, and a half-shadowed chain is worthless
+            self._shadow_capture(victim)
+            swapped = self._shadow.flush(timeout_s=5.0)
+        # fold the fetched token stream into the salvage record before
+        # releasing anything (the continuation re-prefill's source)
+        head = (
+            [victim.first_id]
+            if victim.first_id is not None
+            and victim.first_id not in self.cfg.all_stop_ids else []
+        )
+        if swapped and victim.ids is not None:
+            victim.resume_seq = list(victim.ids) + head + victim.tokens
+        else:
+            victim.resume_seq = None
+        victim.salvaged = victim.salvaged + head + victim.tokens
+        victim.first_id = None
+        victim.tokens = []
+        victim.preemptions += 1
+        victim.preempted_at = time.time()
+        # launch-seq barrier: chunks launched before this point may still
+        # fetch emissions for the victim's old slot — drop them (they are
+        # regenerated after resume; appending them post-fold would
+        # corrupt the salvage order)
+        self._mutation_seq += 1
+        victim.drop_seq = self._mutation_seq
+        if victim.slot is not None:
+            self.state = G.kill_slot(self.state, victim.slot)
+        self._free_slot_resources(victim)
+        victim.slot = None
+        victim.need = None
+        victim.prefix_hit_tokens = 0
+        victim.ids = None
+        victim.shadow_depth = 0
+        self.preempted_total += 1
+        self._m_preempt.labels(reason="pool").inc()
+        log.info(
+            "request_preempted", policy=self.preempt_policy, swap=swapped,
+            preemptions=victim.preemptions, slo_class=victim.slo,
+            beneficiary_class=req.slo, request_id=victim.trace.request_id,
+        )
+        with self._cv:
+            self._resume.append(victim)
+            self._cv.notify_all()
+        return True
+
+    def _prepare_resume(self, req: _Request):
+        """Swap-preemption's warm half (worker thread, just before the
+        resume re-admission): scatter the victim's shadowed chain back
+        into freshly allocated pool blocks (the pre-warmed fixed-width
+        restore program) and re-register it into the block-prefix index,
+        so the ordinary admission path below prefix-hits it and
+        re-prefills ONLY the tail past the deepest restored block. Any
+        shortfall (entries evicted from the shadow, pool still tight)
+        degrades to a colder re-prefill — never an error."""
+        seq = req.resume_seq
+        if seq is None or self._shadow is None or self._bpx is None:
+            req.resume_seq = None
+            return
+        bs = self.kv_block_size
+        # same reuse cap as BlockPrefixIndex.lookup: at least one tail
+        # token must remain for the sampling chunk
+        cap_full = max(0, (len(seq) - 1) // bs)
+        p0, entry, _ = self._bpx.lookup(seq)
+        have = p0 // bs
+        keys = []
+        for i in range(have, cap_full):
+            key = tuple(seq[: (i + 1) * bs])
+            if not self._shadow.has_resident(key):
+                break  # a chain with a hole cannot be registered
+            keys.append(key)
+        if not keys:
+            req.resume_seq = None  # nothing restorable, ever
+            return
+        blocks = self._alloc.alloc(len(keys))
+        if blocks is None and self._bpx is not None:
+            self._bpx.evict(len(keys) - self._alloc.free_blocks)
+            blocks = self._alloc.alloc(len(keys))
+        if blocks is None:
+            # pool still tight (the admission below will _BLOCK and
+            # requeue): KEEP resume_seq so the retry after the next
+            # release still restores warm instead of recomputing
+            return
+        entries = self._shadow.entries_for(keys)
+        if entries is None:
+            self._alloc.decref(blocks)
+            req.resume_seq = None
+            return
+        try:
+            W = self._shadow_restore_w
+            for off in range(0, len(keys), W):
+                ids = blocks[off : off + W]
+                batch = entries[off : off + W]
+                pad = W - len(ids)
+                ids_p = ids + [self._P.TRASH_BLOCK] * pad
+                stacked = []
+                for i in range(len(batch[0].leaves)):
+                    arr = np.stack([e.leaves[i] for e in batch])
+                    if pad:
+                        arr = np.concatenate(
+                            [arr, np.repeat(arr[:1], pad, axis=0)]
+                        )
+                    stacked.append(jnp.asarray(arr))
+                restored = jax.tree.unflatten(
+                    jax.tree.structure(self.cache), stacked
+                )
+                self.cache = self.backend.restore_shadow_blocks(
+                    self.cache, restored, jnp.asarray(ids_p, jnp.int32)
+                )
+        except BaseException:
+            # a crash mid-restore is contained by the supervisor, but
+            # these blocks are not yet tracked anywhere — release them
+            # before the unwind or the pool leaks
+            self._alloc.decref(blocks)
+            raise
+        req.resume_seq = None
+        row_blocks = list(entry or []) + blocks
+        self._bpx.import_chain(
+            list(seq[: len(row_blocks) * bs]), row_blocks
+        )
+        # the index holds its own reference now; restored chains end at
+        # refcount 1 (index-held, evictable) like every cached chain
+        self._alloc.decref(blocks)
+        self._m_shadow_restored.inc(len(blocks))
+        log.info(
+            "preempt_resume_restored", blocks=len(blocks),
+            request_id=req.trace.request_id,
+        )
+
     def _supervise(self, exc: Exception) -> bool:
         """One crash-containment round. Returns True to restart the loop,
         False to give up (budget exhausted or closing)."""
@@ -1267,12 +1572,15 @@ class ContinuousEngine:
                 "error_type": "unavailable",
             }
             # self._recovery: salvaged requests a previous round never got
-            # to re-admit (a crash mid-recovery) — they hang otherwise
-            for req in survivors + pending + self._recovery:
+            # to re-admit (a crash mid-recovery) — they hang otherwise.
+            # self._resume: preempted requests parked for re-admission
+            # (host-side only, resources already released) — same hazard.
+            for req in survivors + pending + self._recovery + self._resume:
                 if req.result is None:
                     req.result = dict(fail)
                 self._push_final(req)
             self._recovery = []
+            self._resume = []
             self._restarting = False
             log.error(
                 "continuous_scheduler_dead", restarts=self.restarts_total
@@ -1484,6 +1792,7 @@ class ContinuousEngine:
             with self._cv:
                 while (
                     not self._queue
+                    and not self._resume
                     and not any(self._assignment)
                     and not inflight
                     and not self._closed
@@ -1491,7 +1800,7 @@ class ContinuousEngine:
                     self._cv.wait()
                 if self._closed:
                     return
-                queue_head = bool(self._queue)
+                queue_head = bool(self._queue or self._resume)
             if queue_head:
                 self._admit()
             chunk = self._launch_chunk()
@@ -1526,6 +1835,7 @@ class ContinuousEngine:
             with self._cv:
                 while (
                     not self._queue
+                    and not self._resume
                     and not any(self._assignment)
                     and not inflight
                     and not self._closed
@@ -1569,10 +1879,9 @@ class ContinuousEngine:
         for job in list(self._jobs):
             req = job.req
             if req.cancelled:
-                req.result = {
-                    "error": "Error: request cancelled", "status": "failed",
-                    "error_type": "cancelled",
-                }
+                req.result = self._cancel_env(req)
+            elif self._past_deadline(req, now):
+                req.result = self._deadline_env(req, where="mid-prefill")
             elif deadline and now - req.t_start > deadline:
                 req.result = {
                     "error": f"Error: request exceeded the {deadline:g}s "
@@ -1595,33 +1904,52 @@ class ContinuousEngine:
         suspect/_admitting crash discipline as whole-prefill admission."""
         while True:
             with self._cv:
-                if not self._queue:
+                # preempted requests resume first (see _admit)
+                from_resume = bool(self._resume)
+                if not from_resume and not self._queue:
                     return
                 free = [
                     b for b, r in enumerate(self._assignment) if r is None
                 ]
                 if not free:
                     return
-                head = self._queue[0]
-                if (
-                    head.need is not None
-                    and head.need > self._alloc.free_blocks + (
-                        self._bpx.evictable_blocks()
-                        if self._bpx is not None else 0
-                    )
-                ):
-                    # the admission policy's capacity leg: a previously
-                    # sized head that still cannot get blocks (even by
-                    # evicting every unreferenced cached chain) waits for
-                    # a release — no re-tokenize/replan churn per step
-                    return
-                req = self._queue.pop(0)
-                self._note_queue_locked()
+                if not from_resume:
+                    head = self._queue[0]
+                    if (
+                        head.need is not None
+                        and head.need > self._alloc.free_blocks + (
+                            self._bpx.evictable_blocks()
+                            if self._bpx is not None else 0
+                        )
+                    ):
+                        # the admission policy's capacity leg: a previously
+                        # sized head that still cannot get blocks (even by
+                        # evicting every unreferenced cached chain) waits
+                        # for a release — no re-tokenize/replan churn per
+                        # step. Preemption happens INSIDE the admission
+                        # attempt (the pressure ladder), so a head whose
+                        # shortfall a victim could cover is sized with
+                        # need=None on its first attempt and reaches it.
+                        return
+                    req = self._queue.pop(0)
+                    self._note_queue_locked()
+                else:
+                    req = self._resume.pop(0)
+            if (
+                from_resume and req.allowed is not None
+                and len(req.salvaged) >= req.allowed
+            ):
+                self._finalize(req)
+                continue
             try:
                 self._suspects.add(req)
                 self._mutation_seq += 1
                 # survives an exception unwind ON PURPOSE (see _admit)
                 self._admitting = req
+                if from_resume:
+                    # swap-preemption resume: restore the shadowed chain
+                    # so the prefix plan below hits it (tail-only chunks)
+                    self._prepare_resume(req)
                 if req.kwargs.get("constraint") is not None:
                     # constrained requests keep the whole-prefill
                     # admission path (the mixed program carries no
@@ -1633,21 +1961,37 @@ class ContinuousEngine:
                     self._admitting = None
                     if first_dev is _BLOCKED:
                         with self._cv:
-                            self._queue.insert(0, req)
-                            self._note_queue_locked()
+                            if from_resume:
+                                self._resume.insert(0, req)
+                            else:
+                                self._queue.insert(0, req)
+                                self._note_queue_locked()
                         return
                     if first_dev is not None:
                         req.first_id = int(np.asarray(first_dev)[0])
-                        req.ttft = time.time() - req.t_start
+                        if not req.ttft:
+                            req.ttft = time.time() - req.t_start
+                        if from_resume and req.preempted_at:
+                            self._m_resume_s.observe(
+                                time.time() - req.preempted_at
+                            )
                         self._post_admit(req)
                     continue
                 started = self._start_job(req, free[0])
                 self._admitting = None
                 if started is _BLOCKED:
                     with self._cv:
-                        self._queue.insert(0, req)
-                        self._note_queue_locked()
+                        if from_resume:
+                            self._resume.insert(0, req)
+                        else:
+                            self._queue.insert(0, req)
+                            self._note_queue_locked()
                     return
+                if (
+                    started is not None and from_resume
+                    and req.preempted_at
+                ):
+                    self._m_resume_s.observe(time.time() - req.preempted_at)
             except ValueError as e:
                 self._admitting = None
                 log.warning("invalid_request", error=str(e))
@@ -1669,10 +2013,13 @@ class ContinuousEngine:
         faults.check("admission", tag=req.prompt)
         req.trace.checkpoint("queue_wait")
         if req.cancelled:
-            req.result = {
-                "error": "Error: request cancelled", "status": "failed",
-                "error_type": "cancelled",
-            }
+            req.result = self._cancel_env(req)
+            self._push_final(req)
+            return None
+        if self._past_deadline(req):
+            # end-to-end deadline_ms expired while queued: zero prefill,
+            # zero pool blocks spent on it
+            req.result = self._deadline_env(req, where="while queued")
             self._push_final(req)
             return None
         deadline = eng.engine_cfg.request_deadline_s
@@ -1725,14 +2072,17 @@ class ContinuousEngine:
         n_shared = len(shared)
         req.need = need_total - n_shared
         if shared:
+            # holders land on block_ids immediately (see _admit_one): a
+            # crash inside the pressure ladder releases them cleanly
             self._alloc.incref(shared)
-        blk_ids = self._alloc.alloc(req.need)
-        if blk_ids is None and self._bpx is not None:
-            self._bpx.evict(req.need - self._alloc.free_blocks)
-            blk_ids = self._alloc.alloc(req.need)
+            req.block_ids = list(shared)
+        # same pressure ladder as the whole-prefill admission: evict
+        # cached chains, then preempt a decoding victim before stalling
+        blk_ids = self._alloc_with_pressure(req)
         if blk_ids is None:
             if shared:
                 self._alloc.decref(shared)
+            req.block_ids = None
             return _BLOCKED
         req.block_ids = shared + blk_ids
         table_row = np.zeros((self._max_blocks,), np.int32)
@@ -1947,7 +2297,10 @@ class ContinuousEngine:
         emitted, mask, active, firsts, armed = packed
         now = time.time()
         for slot, req in completions.items():
-            if req.done.is_set():
+            if req.done.is_set() or req.drop_seq > seq:
+                # drop_seq: the tenant was preempted after this step
+                # launched — its completion bookkeeping is stale (the
+                # resume re-admission regenerates the first token)
                 continue
             req.first_id = int(firsts[slot])
             if not req.ttft:
@@ -1970,7 +2323,7 @@ class ContinuousEngine:
             self._post_admit(req)
         self._distribute(
             emitted[None, :], mask[None, :].astype(bool),
-            active.astype(bool), snapshot,
+            active.astype(bool), snapshot, seq=seq,
         )
         self._consecutive_crashes = 0
         if seq >= self._mutation_seq:
@@ -1987,13 +2340,17 @@ class ContinuousEngine:
         wave = []  # (req, first_dev [1]) admitted this round
         while True:
             with self._cv:
-                if not self._queue:
+                # preempted requests resume FIRST: a victim must not also
+                # lose its place behind the queue that evicted it
+                from_resume = bool(self._resume)
+                if not from_resume and not self._queue:
                     break
                 free = [b for b, r in enumerate(self._assignment) if r is None]
                 if not free:
                     break
                 if (
-                    self.paged
+                    not from_resume
+                    and self.paged
                     and self._queue[0].need is not None
                     and self._queue[0].need > self._alloc.free_blocks + (
                         self._bpx.evictable_blocks()
@@ -2006,8 +2363,19 @@ class ContinuousEngine:
                     # unreferenced cached chain — don't re-tokenize/replan
                     # on every chunk iteration; wait for a release
                     break
-                req = self._queue.pop(0)
-                self._note_queue_locked()
+                if from_resume:
+                    req = self._resume.pop(0)
+                else:
+                    req = self._queue.pop(0)
+                    self._note_queue_locked()
+            if (
+                from_resume and req.allowed is not None
+                and len(req.salvaged) >= req.allowed
+            ):
+                # budget fully consumed before the preemption landed:
+                # finalize straight from the salvage record
+                self._finalize(req)
+                continue
             try:
                 # suspect-set bookkeeping: this request mutates the fleet
                 # now; until a chunk launched after this point fetches
@@ -2019,6 +2387,11 @@ class ContinuousEngine:
                 # a crash cut mid-admission (a finally here would erase
                 # the crash's only pointer to it and hang the caller)
                 self._admitting = req
+                if from_resume:
+                    # swap-preemption resume: restore the shadowed chain
+                    # into the pool first so _admit_one's prefix plan
+                    # hits it and re-prefills only the tail
+                    self._prepare_resume(req)
                 first_dev = self._admit_one(req, free[0])
                 self._admitting = None
                 if first_dev is _BLOCKED:
@@ -2026,10 +2399,17 @@ class ContinuousEngine:
                     # fairness) and stop admitting until a release frees
                     # blocks — the fleet keeps decoding meanwhile
                     with self._cv:
-                        self._queue.insert(0, req)
-                        self._note_queue_locked()
+                        if from_resume:
+                            self._resume.insert(0, req)
+                        else:
+                            self._queue.insert(0, req)
+                            self._note_queue_locked()
                     break
                 if first_dev is not None:  # None: failed fast (e.g. queued
+                    if from_resume and req.preempted_at:
+                        self._m_resume_s.observe(
+                            time.time() - req.preempted_at
+                        )
                     wave.append((req, first_dev))  # past deadline), result set
             except ValueError as e:
                 self._admitting = None
@@ -2050,7 +2430,8 @@ class ContinuousEngine:
         now = time.time()
         for (req, _), first_id in zip(wave, firsts):
             req.first_id = int(first_id)
-            req.ttft = now - req.t_start
+            if not req.ttft:  # resumed victims keep their first TTFT
+                req.ttft = now - req.t_start
             self._post_admit(req)
 
     def _post_admit(self, req: _Request):
@@ -2087,10 +2468,13 @@ class ContinuousEngine:
             # went away (stream teardown races the pop) — drop it here
             # instead of letting it head-of-line-block the queue and then
             # burn pool blocks + a prefill on a dead request
-            req.result = {
-                "error": "Error: request cancelled", "status": "failed",
-                "error_type": "cancelled",
-            }
+            req.result = self._cancel_env(req)
+            self._push_final(req)
+            return None
+        if self._past_deadline(req):
+            # end-to-end deadline_ms expired while queued: fail before
+            # any prefill launch or pool-block grant
+            req.result = self._deadline_env(req, where="while queued")
             self._push_final(req)
             return None
         deadline = eng.engine_cfg.request_deadline_s
@@ -2162,16 +2546,20 @@ class ContinuousEngine:
             req.need = need_total - n_shared
             if shared:
                 # hold the mapped chain NOW: this admission's own eviction
-                # (below) must never reclaim the blocks it is about to map
+                # (below) must never reclaim the blocks it is about to
+                # map. block_ids carries the holders immediately so a
+                # crash inside the pressure ladder (the preempt fault
+                # point) releases them through the supervisor's unwind.
                 self._alloc.incref(shared)
-            blk_ids = self._alloc.alloc(req.need)
-            if blk_ids is None and self._bpx is not None:
-                # reclaim LRU unreferenced cached chains, then retry once
-                self._bpx.evict(req.need - self._alloc.free_blocks)
-                blk_ids = self._alloc.alloc(req.need)
+                req.block_ids = list(shared)
+            # full pressure ladder: evict unreferenced cached chains,
+            # then PREEMPT a decoding victim (engine_cfg.preempt_policy)
+            # instead of stalling — "pool full" is a policy decision now
+            blk_ids = self._alloc_with_pressure(req)
             if blk_ids is None:
                 if shared:
                     self._alloc.decref(shared)
+                req.block_ids = None
                 return _BLOCKED  # pool exhausted; caller requeues at front
             req.block_ids = shared + blk_ids
             table_row = np.zeros((self._max_blocks,), np.int32)
@@ -2452,7 +2840,7 @@ class ContinuousEngine:
         emitted = packed[:K]
         mask = packed[K : 2 * K].astype(bool)
         active = packed[2 * K].astype(bool)
-        self._distribute(emitted, mask, active, snapshot)
+        self._distribute(emitted, mask, active, snapshot, seq=seq)
         # healthy step: the fleet (as launched) fetched clean — reset the
         # supervisor's consecutive-crash window, and vindicate suspects
         # when no admission happened after this chunk's launch (an older
@@ -2461,16 +2849,21 @@ class ContinuousEngine:
         if seq >= self._mutation_seq:
             self._suspects.clear()
 
-    def _distribute(self, emitted, mask, active, snapshot):
+    def _distribute(self, emitted, mask, active, snapshot, seq=None):
         """Attribute one fetched launch's emissions ([K, B] + final
         active row) to the snapshot's tenants and handle stop / cancel /
         deadline / finalize — ONE copy for the decode-chunk and mixed-
-        scheduler fetch paths."""
+        scheduler fetch paths. `seq` is the chunk's launch-time mutation
+        seq: a preempted victim's drop_seq barrier discards emissions
+        from chunks launched before its eviction (they are regenerated
+        after resume — appending them would corrupt the salvage order)."""
         deadline = self.engine.engine_cfg.request_deadline_s
         now = time.time()
         for b, req in enumerate(snapshot):
             if req is None or req.done.is_set():
                 continue  # freed/killed tenant's masked leftovers
+            if seq is not None and req.drop_seq > seq:
+                continue  # preempted after this chunk launched
             new = emitted[mask[:, b], b]
             req.tokens.extend(int(t) for t in new)
             if len(new) and self._shadow is not None:
@@ -2505,11 +2898,17 @@ class ContinuousEngine:
                 # full budget
                 self.state = G.kill_slot(self.state, b)
                 self._m_preempt.labels(reason="cancelled").inc()
-                log.info("request_cancelled", slot=b)
-                req.result = {
-                    "error": "Error: request cancelled", "status": "failed",
-                    "error_type": "cancelled",
-                }
+                log.info("request_cancelled", slot=b, cause=req.cancel_cause)
+                req.result = self._cancel_env(req)
+                self._release(req)
+            elif self._past_deadline(req, now) and self._assignment[b] is req:
+                # end-to-end deadline_ms overrun mid-decode: kill the
+                # slot, free blocks/constraint row NOW (checked at the
+                # launch boundary only — never inside compiled code)
+                self.state = G.kill_slot(self.state, b)
+                self._m_preempt.labels(reason="deadline").inc()
+                log.info("request_deadline_ms_exceeded", slot=b)
+                req.result = self._deadline_env(req)
                 self._release(req)
             elif deadline and now - req.t_start > deadline:
                 # in-flight overrun: kill the slot, fail the request; the
@@ -2588,6 +2987,9 @@ class ContinuousEngine:
         if req.salvaged:
             # served across a scheduler restart (continuation prefill)
             req.result["recovered"] = True
+        if req.preemptions:
+            # evicted for pool pressure and resumed (swap or recompute)
+            req.result["preempted"] = req.preemptions
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
         if req.cart is not None:
@@ -2600,7 +3002,11 @@ class ContinuousEngine:
         )
         self._release(req)
 
-    def _release(self, req: _Request):
+    def _free_slot_resources(self, req: _Request):
+        """Return every fleet-held resource of `req` (constraint row +
+        FSM reset, pool blocks, block-table row, slot assignment) WITHOUT
+        finalizing it — shared by _release (completion/cancel/deadline)
+        and _preempt_for (the request lives on, parked for resume)."""
         if self._chunked and req.slot is not None:
             # mid-prefill teardown (cancel / deadline / EOS-on-first of a
             # just-armed admission): drop the job so the planner stops
@@ -2638,10 +3044,14 @@ class ContinuousEngine:
         with self._cv:
             if req.slot is not None and self._assignment[req.slot] is req:
                 self._assignment[req.slot] = None
-            self.completed += 1
             occ = sum(r is not None for r in self._assignment)
             self._cv.notify_all()
         self._m_occupied.set(occ)
+
+    def _release(self, req: _Request):
+        self._free_slot_resources(req)
+        with self._cv:
+            self.completed += 1
         self._push_final(req)
 
     def _push_final(self, req: _Request):

@@ -406,6 +406,21 @@ class InferenceEngine:
             "dli_preemptions_total",
             "slots killed before their budget drained", ("reason",),
         )
+        # graceful-degradation families (engine/continuous.py preemption
+        # + the deadline/cancellation surface): preempt->resume latency,
+        # cancellations by cause, end-to-end deadline_ms overruns
+        self.metrics.histogram(
+            "dli_preempted_resume_seconds",
+            "preemption to successful re-admission latency",
+        )
+        self.metrics.counter(
+            "dli_cancelled_total",
+            "requests cancelled before completion", ("cause",),
+        )
+        self._m_deadline_exceeded = self.metrics.counter(
+            "dli_deadline_exceeded_total",
+            "requests failed by their end-to-end deadline_ms",
+        ).labels()
         self.metrics.counter(
             "dli_prefix_cache_hits_total",
             "prefix-cache hits (tail actually planned and spliced)",
@@ -612,7 +627,8 @@ class InferenceEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def _with_deadline(self, fn, what: str):
+    def _with_deadline(self, fn, what: str, deadline_s: Optional[float] = None,
+                       exceeded_type: str = "timeout"):
         """Run fn() under the configured per-request deadline.
 
         TPU-native analogue of the reference's per-hop 30s timeout
@@ -622,8 +638,17 @@ class InferenceEngine:
         thread finishes, so one wedged device call delays — but never
         permanently wedges — subsequent requests; they time out cleanly
         against the same deadline until the lock frees.
+
+        deadline_s overrides the configured server-wide cap (the
+        end-to-end deadline_ms surface passes the request's remaining
+        budget); exceeded_type names the envelope's error_type —
+        "deadline_exceeded" (HTTP 504, never router-retried) when the
+        request's own budget is the binding constraint.
         """
-        deadline = self.engine_cfg.request_deadline_s
+        deadline = (
+            deadline_s if deadline_s is not None
+            else self.engine_cfg.request_deadline_s
+        )
         if not deadline:
             return fn()
         box: dict = {}
@@ -664,7 +689,7 @@ class InferenceEngine:
             return {
                 "error": f"Error: request exceeded the {deadline:g}s deadline",
                 "status": "failed",
-                "error_type": "timeout",
+                "error_type": exceeded_type,
             }
         if "exc" in box:
             raise box["exc"]
@@ -802,6 +827,7 @@ class InferenceEngine:
         constraint: Optional[dict] = None,
         request_id: Optional[str] = None,
         slo_class: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
         _trace: Optional[Trace] = None,
     ) -> dict:
         """Full generation; returns the reference-schema response dict.
@@ -839,13 +865,28 @@ class InferenceEngine:
         trace = _trace if _trace is not None else Trace(request_id)
 
         with request_id_context(trace.request_id):
+            dl_s, dl_type = self._resolve_deadline(deadline_ms)
+            if dl_s is not None and dl_s <= 0:
+                # end-to-end budget already spent (queue/router hops ate
+                # it): fail before touching the device
+                self._m_deadline_exceeded.inc()
+                result = {
+                    "error": "Error: request exceeded its deadline_ms "
+                    "budget before generation",
+                    "status": "failed",
+                    "error_type": "deadline_exceeded",
+                }
+                return self._finish_request(result, trace, engine="solo")
             result = self._generate_traced(
                 prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
                 seed, debug, speculative, min_p, repetition_penalty,
                 frequency_penalty, presence_penalty, stop, logprobs,
                 logit_bias, num_beams, length_penalty, early_stopping,
                 constraint, t_start, trace,
+                deadline_s=dl_s, exceeded_type=dl_type,
             )
+            if result.get("error_type") == "deadline_exceeded":
+                self._m_deadline_exceeded.inc()
             if slo_class is not None:
                 # admission priority is a fleet concept (the continuous
                 # scheduler's SLO classes); the solo path serves directly
@@ -854,12 +895,27 @@ class InferenceEngine:
                 result.setdefault("slo_class", slo_class)
             return self._finish_request(result, trace, engine="solo")
 
+    def _resolve_deadline(self, deadline_ms) -> tuple:
+        """(deadline_s, exceeded_type) for a request carrying an
+        end-to-end deadline_ms: the binding constraint is the smaller of
+        the request's remaining budget and the server-wide
+        request_deadline_s cap; the envelope's error_type follows the
+        binding one ("deadline_exceeded" -> HTTP 504, never retried by
+        the router — "timeout" -> 503 keeps its legacy semantics)."""
+        cfg_s = self.engine_cfg.request_deadline_s
+        if deadline_ms is None:
+            return None if not cfg_s else cfg_s, "timeout"
+        req_s = float(deadline_ms) / 1e3
+        if cfg_s and cfg_s < req_s:
+            return cfg_s, "timeout"
+        return req_s, "deadline_exceeded"
+
     def _generate_traced(
         self, prompt, max_tokens, temperature, top_k, top_p, greedy, chat,
         seed, debug, speculative, min_p, repetition_penalty,
         frequency_penalty, presence_penalty, stop, logprobs, logit_bias,
         num_beams, length_penalty, early_stopping, constraint, t_start,
-        trace,
+        trace, deadline_s=None, exceeded_type="timeout",
     ) -> dict:
         if constraint is not None and (num_beams > 1 or speculative):
             # grammar constraints do not compose with beam search (no
@@ -907,7 +963,10 @@ class InferenceEngine:
                 )
 
         try:
-            return self._with_deadline(locked, "generate")
+            return self._with_deadline(
+                locked, "generate", deadline_s=deadline_s,
+                exceeded_type=exceeded_type,
+            )
         except ValueError as e:
             # caller-caused (e.g. prompt longer than the largest prefill
             # bucket): tagged so the serving edge can answer 400, not 500
@@ -2053,6 +2112,7 @@ class InferenceEngine:
         constraint: Optional[dict] = None,
         request_id: Optional[str] = None,
         slo_class: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
         _trace: Optional[Trace] = None,
     ) -> dict:
         """One forward fleet for N prompts (shared sampling params).
@@ -2079,8 +2139,25 @@ class InferenceEngine:
                 )
 
         with request_id_context(trace.request_id):
+            dl_s, dl_type = self._resolve_deadline(deadline_ms)
+            if dl_s is not None and dl_s <= 0:
+                self._m_deadline_exceeded.inc()
+                return self._finish_request(
+                    {
+                        "error": "Error: request exceeded its deadline_ms "
+                        "budget before generation",
+                        "status": "failed",
+                        "error_type": "deadline_exceeded",
+                    },
+                    trace, engine="batch",
+                )
             try:
-                result = self._with_deadline(locked, "generate_batch")
+                result = self._with_deadline(
+                    locked, "generate_batch", deadline_s=dl_s,
+                    exceeded_type=dl_type,
+                )
+                if result.get("error_type") == "deadline_exceeded":
+                    self._m_deadline_exceeded.inc()
             except ValueError as e:
                 log.warning("invalid_batch_request", error=str(e))
                 result = {"error": f"Error: {e}", "status": "failed",

@@ -410,6 +410,29 @@ def gather_scratch_blocks(shared_pool, table_row):
     return _gather_blocks(shared_pool, table_row)
 
 
+def _gather_shadow(shared_pool, block_ids):
+    """Core of gather_shadow_blocks (un-jitted so the pp backend's
+    shard_map body can trace it layer-locally — the gather reads whole
+    blocks of the LOCAL layer shard, so it runs unchanged on a
+    layer-sharded pool slice)."""
+
+    def g(pl):
+        return pl[:, block_ids].swapaxes(0, 1)
+
+    return jax.tree.map(g, shared_pool)
+
+
+def _restore_shadow(pool, blocks, block_ids):
+    """Core of restore_shadow_blocks (un-jitted for the same shard_map
+    reuse: the scatter is layer-local — each stage writes its own layer
+    slice of every restored block)."""
+
+    def s(pl, bl):
+        return pl.at[:, block_ids].set(bl.swapaxes(0, 1))
+
+    return jax.tree.map(s, pool, blocks)
+
+
 @jax.jit
 def gather_shadow_blocks(shared_pool, block_ids):
     """Read `block_ids`' pool blocks into a fresh stacked buffer for the
@@ -426,11 +449,7 @@ def gather_shadow_blocks(shared_pool, block_ids):
     a fixed-width operand (callers pad by repeating a real id) so one
     compiled program serves every capture batch.
     """
-
-    def g(pl):
-        return pl[:, block_ids].swapaxes(0, 1)
-
-    return jax.tree.map(g, shared_pool)
+    return _gather_shadow(shared_pool, block_ids)
 
 
 @functools.partial(jax.jit, donate_argnames=("pool",))
@@ -443,11 +462,7 @@ def restore_shadow_blocks(pool, blocks, block_ids):
     blocks are complete by construction, so later tail prefills and
     decode writes only ever land at positions past them — the same
     immutability contract live blocks carry."""
-
-    def s(pl, bl):
-        return pl.at[:, block_ids].set(bl.swapaxes(0, 1))
-
-    return jax.tree.map(s, pool, blocks)
+    return _restore_shadow(pool, blocks, block_ids)
 
 
 def _forward_step_paged(cfg, params, tokens, pool, table, pos):

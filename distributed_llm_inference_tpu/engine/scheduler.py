@@ -271,6 +271,30 @@ class TokenBudgetScheduler:
             > SHED_GRACE * cls.ttft_target_s
         )
 
+    # -- preemption policy ---------------------------------------------------
+    def victim_key(self, cls: SLOClass, enqueued: float) -> tuple:
+        """Sort key for KV-preemption victim selection: LOWEST SLO weight
+        first, then the YOUNGEST request (latest enqueue) within a
+        weight tie — the request whose eviction wastes the least
+        progress and whose class the operator values least. min() over
+        candidates' keys picks the victim."""
+        return (cls.weight, -enqueued)
+
+    def select_victim(self, candidates, beneficiary_cls: SLOClass):
+        """Pick the preemption victim from `candidates`
+        ([(request, SLOClass, enqueued_s)]) on behalf of a request of
+        `beneficiary_cls`, or None. A victim must not outrank the
+        beneficiary (weight strictly above it is protected — a batch
+        admission never preempts an interactive decode); among eligible
+        candidates the lowest-weight / youngest loses."""
+        eligible = [
+            (req, cls, enq) for req, cls, enq in candidates
+            if cls.weight <= beneficiary_cls.weight
+        ]
+        if not eligible:
+            return None
+        return min(eligible, key=lambda c: self.victim_key(c[1], c[2]))[0]
+
     # -- the per-step budget slice -------------------------------------------
     def _urgency(self, cls: SLOClass, oldest_wait_s: float) -> float:
         """How far past (or inside) its TTFT target the class's oldest

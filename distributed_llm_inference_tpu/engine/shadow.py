@@ -147,6 +147,27 @@ class ShadowStore:
         with self._lock:
             return key in self._entries or key in self._pending
 
+    def has_resident(self, key: tuple) -> bool:
+        """True only when `key`'s copy has LANDED (restorable right now —
+        an in-flight copy is not; preemption's swap path flushes first)."""
+        with self._lock:
+            return key in self._entries
+
+    def entries_for(self, keys: list) -> Optional[list]:
+        """The resident entries for `keys` in order, or None when ANY is
+        missing (a targeted restore needs the whole contiguous run — a
+        chain with a hole cannot be registered). Touches each entry MRU,
+        like a hit."""
+        out = []
+        with self._lock:
+            for k in keys:
+                e = self._entries.get(k)
+                if e is None:
+                    return None
+                self._entries.move_to_end(k)
+                out.append(e)
+        return out
+
     def put_async(self, keys: list, dev_leaves: list, seq: int) -> bool:
         """Hand one gathered batch to the copier. keys[i] is the token
         prefix block i of the batch completes; dev_leaves are the

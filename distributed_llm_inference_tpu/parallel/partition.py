@@ -267,6 +267,21 @@ def pool_spec(cfg):
     return {"k": leaf, "v": leaf}
 
 
+def shadow_block_spec(cfg):
+    """Stacked shadow-block buffers [N, L, KV, bs(, Dh)] (engine/paged.
+    gather_shadow_blocks / restore_shadow_blocks): block rows replicate,
+    the LAYER axis — position 1 after the gather's swapaxes — shards
+    over pp and kv heads over tp, mirroring pool_spec one axis over.
+    KVQuant scales [N, L, KV, bs] drop the head_dim axis like always."""
+    p5 = P(None, AXIS_PP, AXIS_TP, None, None)
+    if getattr(cfg, "kv_quant", None) is None:
+        return {"k": p5, "v": p5}
+    from ..ops.kv_quant import KVQuant
+
+    leaf = KVQuant(p5, P(None, AXIS_PP, AXIS_TP, None))
+    return {"k": leaf, "v": leaf}
+
+
 def init_sharded_pool(cfg: ModelConfig, mesh: Mesh, n_blocks: int,
                       block_size: int):
     """Zeroed paged-KV pool sharded per pool_spec(), allocated shard-local.

@@ -657,6 +657,60 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped)
 
+    # -- warm-recovery shadow gather/scatter on the pp ring ------------------
+    # shard_map twins of engine/paged.gather_shadow_blocks /
+    # restore_shadow_blocks: both moves are LAYER-LOCAL (a stage reads or
+    # writes its own layer shard of every requested block), so the
+    # host-side shadow store sees the same [N, L, ...] stacked leaves as
+    # on a single device — pp-sharded pools now recover WARM instead of
+    # cold (the ROADMAP follow-up seam from the warm-recovery PR).
+    def gather_shadow_blocks(self, pool, block_ids):
+        fn = self._programs.get("gather_shadow")
+        if fn is None:
+            fn = self._build_gather_shadow()
+            self._programs["gather_shadow"] = fn
+        return fn(pool, block_ids)
+
+    def _build_gather_shadow(self):
+        cfg = self.cfg
+        from ..engine import paged as EP
+        from .partition import pool_spec, shadow_block_spec
+
+        def body(shared_pool, block_ids):
+            return EP._gather_shadow(shared_pool, block_ids)
+
+        shmapped = self._shard(
+            body,
+            in_specs=(pool_spec(cfg), P()),
+            out_specs=shadow_block_spec(cfg),
+        )
+        # the pool is mapped shared state here — read, never donated
+        # (live block tables keep reading these buffers), exactly like
+        # the single-device program's inverse-donation rule
+        return jax.jit(shmapped)
+
+    def restore_shadow_blocks(self, pool, blocks, block_ids):
+        fn = self._programs.get("restore_shadow")
+        if fn is None:
+            fn = self._build_restore_shadow()
+            self._programs["restore_shadow"] = fn
+        return fn(pool, blocks, block_ids)
+
+    def _build_restore_shadow(self):
+        cfg = self.cfg
+        from ..engine import paged as EP
+        from .partition import pool_spec, shadow_block_spec
+
+        def body(pool, blocks, block_ids):
+            return EP._restore_shadow(pool, blocks, block_ids)
+
+        shmapped = self._shard(
+            body,
+            in_specs=(pool_spec(cfg), shadow_block_spec(cfg), P()),
+            out_specs=pool_spec(cfg),
+        )
+        return jax.jit(shmapped, donate_argnums=(0,))
+
     # -- ragged paged ingest on the pp ring (engine/paged.py twins) ----------
     @property
     def supports_ragged_fill(self) -> bool:

@@ -64,6 +64,16 @@ def error_for_envelope(result: dict) -> "OpenAIError":
         return OpenAIError(msg)
     if et == "timeout":
         return OpenAIError(msg, status=503, err_type="timeout_error")
+    if et == "deadline_exceeded":
+        # the request's own deadline_ms budget expired: 504, and the
+        # router/clients must NOT retry (the budget is spent wherever
+        # the retry lands)
+        return OpenAIError(msg, status=504, err_type="timeout_error")
+    if et == "cancelled":
+        # client went away (or explicitly cancelled): nobody is waiting
+        # for this body; 499 (nginx convention) so logs/metrics can tell
+        # it from server faults, and the router never re-dispatches it
+        return OpenAIError(msg, status=499, err_type="cancelled")
     if et == "overloaded":
         return OpenAIError(msg, status=429, err_type="overloaded_error")
     return OpenAIError(msg, status=500, err_type="server_error")
@@ -168,6 +178,22 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
             raise OpenAIError("slo_class must be a string",
                               param="slo_class")
         kwargs["slo_class"] = slo
+    dl = data.get("deadline_ms")
+    if dl is not None:
+        # extension field: end-to-end deadline in milliseconds. Expiry
+        # anywhere along the pipeline (queued, mid-prefill, mid-decode)
+        # fails the request with a deadline_exceeded envelope (HTTP 504)
+        # and frees its resources at the next launch boundary; the
+        # router forwards the REMAINING budget via X-Request-Deadline-Ms.
+        try:
+            dl = float(dl)
+        except (TypeError, ValueError):
+            raise OpenAIError("deadline_ms must be a number",
+                              param="deadline_ms") from None
+        if dl <= 0:
+            raise OpenAIError("deadline_ms must be > 0",
+                              param="deadline_ms")
+        kwargs["deadline_ms"] = dl
     stop = data.get("stop")
     if stop is not None:
         if isinstance(stop, str):

@@ -43,7 +43,7 @@ log = get_logger("queue")
 
 class _Pending:
     __slots__ = ("prompt", "kwargs", "done", "result", "enqueued", "is_batch",
-                 "trace", "slo")
+                 "trace", "slo", "deadline_at")
 
     def __init__(self, prompt, kwargs: dict, is_batch: bool = False):
         self.prompt = prompt  # str, or list[str] for a client batch
@@ -52,6 +52,14 @@ class _Pending:
         self.result: Optional[dict] = None
         self.enqueued = time.time()
         self.is_batch = is_batch
+        # end-to-end deadline_ms: absolute expiry. Checked at submit
+        # (fail-fast, zero queue time spent) and again at dispatch
+        # (_expire); the engine enforces the REMAINING budget in-flight
+        # (the kwarg is rewritten at dispatch so queue wait counts).
+        dl = kwargs.get("deadline_ms")
+        self.deadline_at = (
+            self.enqueued + float(dl) / 1e3 if dl is not None else None
+        )
         # SLO class (engine/scheduler.py): resolved against the engine's
         # configured classes at submit; drives the per-class depth gauge
         # and the class-local Retry-After on shed — the kwarg itself
@@ -76,6 +84,10 @@ class _Pending:
             or k.get("logprobs")
             # generate_batch has no logit_bias seam; biased requests solo
             or k.get("logit_bias")
+            # a deadline_ms request runs solo: a fleet-wide deadline
+            # would fail innocent rows the moment one member's budget
+            # expires, and per-row deadlines have no fleet seam
+            or k.get("deadline_ms") is not None
             # beam search is its own batched program; runs solo
             or int(k.get("num_beams", 1) or 1) > 1
         ):
@@ -168,6 +180,10 @@ class BatchingQueue:
             "requests shed with 429 by SLO admission control (class drain "
             "estimate over the TTFT target, or queue full)", ("slo_class",),
         )
+        self._m_deadline_exceeded = m.counter(
+            "dli_deadline_exceeded_total",
+            "requests failed by their end-to-end deadline_ms",
+        ).labels()
         self._can_coalesce = (
             getattr(engine.cfg, "arch", None) == "llama"
             and getattr(engine.backend, "supports_ragged", False)
@@ -205,9 +221,23 @@ class BatchingQueue:
                 counts.get(name, 0)
             )
 
+    def _deadline_env(self, where: str = "") -> dict:
+        self._m_deadline_exceeded.inc()
+        suffix = f" {where}" if where else ""
+        return {
+            "error": f"Error: request exceeded its deadline_ms "
+            f"budget{suffix}",
+            "status": "failed",
+            "error_type": "deadline_exceeded",
+        }
+
     def _submit(self, pend: _Pending) -> dict:
         if pend.slo not in self._slo:
             pend.slo = self._slo_default
+        if pend.deadline_at is not None and time.time() >= pend.deadline_at:
+            # fail-fast: an already-expired request never enters the
+            # queue, never reaches the engine (zero prefill spent)
+            return self._deadline_env(where="before admission")
         with self._cv:
             if self._closed:
                 return {
@@ -366,12 +396,19 @@ class BatchingQueue:
         clock, and under backlog (the only time deadlines matter) the
         wait would otherwise not count against it."""
         deadline = getattr(self.engine.engine_cfg, "request_deadline_s", None)
-        if not deadline:
-            return group
         now = time.time()
         live = []
         for p in group:
-            if now - p.enqueued > deadline:
+            if p.deadline_at is not None and now >= p.deadline_at:
+                # the request's OWN deadline_ms expired while queued:
+                # distinct envelope (504 at the edge, never retried)
+                p.result = dict(
+                    self._deadline_env(where="while queued"),
+                    request_id=p.trace.request_id,
+                    timings=p.trace.timings(),
+                )
+                p.done.set()
+            elif deadline and now - p.enqueued > deadline:
                 p.result = {
                     "error": f"Error: request exceeded the {deadline:g}s "
                     "deadline while queued",
@@ -382,6 +419,12 @@ class BatchingQueue:
                 }
                 p.done.set()
             else:
+                if p.deadline_at is not None:
+                    # the engine enforces the REMAINING budget: rewrite
+                    # the kwarg so queue wait counts against end-to-end
+                    p.kwargs["deadline_ms"] = max(
+                        1.0, (p.deadline_at - now) * 1e3
+                    )
                 live.append(p)
         return live
 

@@ -383,14 +383,15 @@ class Router:
             self._m_outstanding.labels(replica=rep.rid).set(rep.outstanding)
 
     def _proxy(self, rep: Replica, path: str, body: bytes, rid: str,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None, extra_headers=None):
         """One POST to one replica. Returns (status, body_bytes, headers);
         HTTP error statuses come back as values, connect-level failures
         raise (urllib.error.URLError / OSError)."""
+        hdrs = {"Content-Type": "application/json", "X-Request-Id": rid}
+        if extra_headers:
+            hdrs.update(extra_headers)
         req = urllib.request.Request(
-            rep.url + path, data=body,
-            headers={"Content-Type": "application/json", "X-Request-Id": rid},
-            method="POST",
+            rep.url + path, data=body, headers=hdrs, method="POST",
         )
         try:
             with urllib.request.urlopen(
@@ -401,7 +402,7 @@ class Router:
             return e.code, e.read(), dict(e.headers)
 
     def dispatch(self, path: str, body: bytes, affinity_key: str,
-                 rid: str) -> tuple:
+                 rid: str, deadline_ms: Optional[float] = None) -> tuple:
         """Route one NON-STREAMED request with transparent failover.
 
         Returns (replica_or_None, status, body_bytes, headers, attempts).
@@ -409,10 +410,18 @@ class Router:
         kill -9 mid-request — zero reply bytes reached the client, so a
         fresh greedy run elsewhere is indistinguishable), 503 (draining /
         restart-looping), and 429 (that replica is full; another may not
-        be). It does NOT re-dispatch 4xx (the request is the problem) or
+        be). It does NOT re-dispatch 4xx (the request is the problem),
         500 (a request-shaped server fault — poison would just take down
-        a second fleet). Upstream Retry-After becomes a per-replica
-        cool-down, honored by the next pick()."""
+        a second fleet), or 504 deadline_exceeded (the request's OWN
+        budget is spent — just as spent wherever a retry lands, and
+        never a replica-health strike). Upstream Retry-After becomes a
+        per-replica cool-down, honored by the next pick().
+
+        deadline_ms: the request's remaining end-to-end budget at
+        ingress; each attempt relays what is LEFT via
+        X-Request-Deadline-Ms, and a spent budget answers 504 here
+        without burning another replica's prefill."""
+        t_in = time.monotonic()
         tried: set = set()
         prev: Optional[Replica] = None
         last = (503, json.dumps({
@@ -420,6 +429,13 @@ class Router:
             "error_type": "unavailable",
         }).encode(), {"Retry-After": str(RETRY_AFTER_S)})
         for attempt in range(self.failover_attempts):
+            extra = None
+            if deadline_ms is not None:
+                left = deadline_ms - (time.monotonic() - t_in) * 1e3
+                if left <= 0:
+                    st, bd, hd = _deadline_exceeded_response()
+                    return None, st, bd, hd, len(tried)
+                extra = {"X-Request-Deadline-Ms": f"{left:.0f}"}
             rep, digests = self.pick(affinity_key, exclude=tried)
             if rep is None:
                 break
@@ -430,7 +446,9 @@ class Router:
                          from_replica=prev.rid, to_replica=rep.rid)
             self._begin(rep)
             try:
-                status, rbody, headers = self._proxy(rep, path, body, rid)
+                status, rbody, headers = self._proxy(
+                    rep, path, body, rid, extra_headers=extra
+                )
             # HTTPException covers IncompleteRead/RemoteDisconnected — a
             # replica kill -9'd MID-RESPONSE surfaces as one of these,
             # and it is exactly the failover case (zero reply bytes have
@@ -446,6 +464,12 @@ class Router:
             finally:
                 self._end(rep)
             self._m_requests.labels(replica=rep.rid, code=str(status)).inc()
+            if status == 504:
+                # deadline_exceeded: a property of the REQUEST's budget,
+                # not the replica — no breaker strike, no re-dispatch
+                # (the budget is spent wherever a retry would land)
+                self.note_success(rep)
+                return rep, status, rbody, headers, attempt + 1
             if status in (429, 503):
                 ra = parse_retry_after(headers.get("Retry-After"))
                 with rep.lock:
@@ -645,6 +669,40 @@ def _affinity_key(data: dict) -> str:
     return ""
 
 
+def _deadline_ms(data: dict, headers) -> Optional[float]:
+    """The request's end-to-end deadline budget (ms) at router INGRESS:
+    an inbound X-Request-Deadline-Ms (an upstream tier already started
+    the clock) wins over the body's deadline_ms. The router burns this
+    budget across failover attempts and relays the REMAINDER to the
+    replica via the same header, so queueing and failover time count
+    against the client's deadline instead of silently extending it."""
+    hdr = headers.get("X-Request-Deadline-Ms")
+    if hdr is not None:
+        try:
+            return float(hdr)
+        except (TypeError, ValueError):
+            pass
+    raw = data.get("deadline_ms")
+    if raw is None:
+        return None
+    try:
+        dl = float(raw)
+    except (TypeError, ValueError):
+        return None  # the replica's parser owns the 400
+    return dl if dl > 0 else None
+
+
+def _deadline_exceeded_response() -> tuple:
+    """(status, body, headers) for a budget spent inside the router —
+    the same envelope a replica would emit, so clients see ONE shape."""
+    return 504, json.dumps({
+        "error": "Error: request exceeded its deadline_ms budget "
+        "at the router",
+        "status": "failed",
+        "error_type": "deadline_exceeded",
+    }).encode(), {}
+
+
 def make_router_handler(router: Router):
     http_requests = router.metrics.counter(
         "dli_http_requests_total", "HTTP responses at the router edge",
@@ -764,12 +822,15 @@ def make_router_handler(router: Router):
             except (ValueError, json.JSONDecodeError):
                 self._send(400, {"error": "invalid JSON body"})
                 return
+            deadline_ms = _deadline_ms(data, self.headers)
             if data.get("stream") is True or data.get("stream") == "true":
-                self._stream(path, body, _affinity_key(data))
+                self._stream(path, body, _affinity_key(data),
+                             deadline_ms=deadline_ms)
                 return
             t0 = time.perf_counter()
             rep, status, rbody, headers, attempts = router.dispatch(
-                path, body, _affinity_key(data), self._rid
+                path, body, _affinity_key(data), self._rid,
+                deadline_ms=deadline_ms,
             )
             fwd = {
                 k: v for k, v in headers.items() if k == "Retry-After"
@@ -796,14 +857,25 @@ def make_router_handler(router: Router):
                     payload["router_attempts"] = attempts
             self._send(status, payload, headers=fwd)
 
-        def _stream(self, path: str, body: bytes, affinity_key: str):
+        def _stream(self, path: str, body: bytes, affinity_key: str,
+                    deadline_ms: Optional[float] = None):
             """Streamed requests: failover ONLY before the upstream
             stream opens; after the first forwarded byte the request is
             bound to its replica (re-dispatching would replay partial
             output — client.py's own stream-retry rule)."""
+            t_in = time.monotonic()
             tried: set = set()
             prev = None
             for _ in range(router.failover_attempts):
+                hdrs = {"Content-Type": "application/json",
+                        "X-Request-Id": self._rid}
+                if deadline_ms is not None:
+                    left = deadline_ms - (time.monotonic() - t_in) * 1e3
+                    if left <= 0:
+                        st, bd, _hd = _deadline_exceeded_response()
+                        self._send(st, json.loads(bd))
+                        return
+                    hdrs["X-Request-Deadline-Ms"] = f"{left:.0f}"
                 rep, digests = router.pick(affinity_key, exclude=tried)
                 if rep is None:
                     break
@@ -811,10 +883,7 @@ def make_router_handler(router: Router):
                 if prev is not None:
                     router._m_failovers.labels(replica=prev.rid).inc()
                 req = urllib.request.Request(
-                    rep.url + path, data=body,
-                    headers={"Content-Type": "application/json",
-                             "X-Request-Id": self._rid},
-                    method="POST",
+                    rep.url + path, data=body, headers=hdrs, method="POST",
                 )
                 router._begin(rep)
                 try:

@@ -303,6 +303,26 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             else:
                 self._send(404, {"error": f"no route {path}"})
 
+        def _deadline_ms(self, data: dict):
+            """The request's end-to-end deadline budget in ms, or None.
+            X-Request-Deadline-Ms (the router's remaining-budget relay)
+            overrides the body's deadline_ms; both must be positive
+            numbers (a non-positive header means the budget is already
+            spent upstream — keep it, the engine fail-fasts it)."""
+            hdr = self.headers.get("X-Request-Deadline-Ms")
+            if hdr is not None:
+                try:
+                    return float(hdr)
+                except (TypeError, ValueError):
+                    pass  # junk header: fall back to the body field
+            raw = data.get("deadline_ms")
+            if raw is None:
+                return None
+            dl = float(raw)  # ValueError -> the route's 400 handler
+            if dl <= 0:
+                raise ValueError("deadline_ms must be > 0")
+            return dl
+
         def _read_json(self):
             """Parse the request body; None (after a 400 reply) on bad JSON."""
             try:
@@ -350,7 +370,15 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 ):
                     self.wfile.write(payload)
                     self.wfile.flush()
-            except (BrokenPipeError, ConnectionResetError):
+            except OSError:
+                # vanished SSE client (BrokenPipe/ConnectionReset and the
+                # platform-specific OSError spellings): closing the event
+                # generator routes into the engine's cancellation path —
+                # continuous.stream's finally flips the cancel flag, the
+                # worker kills the slot and frees its blocks at the next
+                # launch boundary instead of decoding the dead client's
+                # full max_new_tokens budget (regression-pinned in
+                # tests/test_preemption.py)
                 if hasattr(events, "close"):
                     events.close()  # cancel: frees the decode slot
 
@@ -378,6 +406,14 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         f"configured: {sorted(slo_classes)}",
                         param="slo_class",
                     )
+                hdr_dl = self.headers.get("X-Request-Deadline-Ms")
+                if hdr_dl is not None:
+                    # router relay of the REMAINING end-to-end budget:
+                    # wins over the body's own deadline_ms
+                    try:
+                        kwargs["deadline_ms"] = float(hdr_dl)
+                    except (TypeError, ValueError):
+                        pass
                 kwargs["request_id"] = self._rid
                 if meta.get("echo_score"):
                     # echo + logprobs + max_tokens=0: teacher-forced
@@ -528,6 +564,15 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         data.get("presence_penalty", 0.0)
                     ),
                 )
+                raw_dl = self._deadline_ms(data)
+                if raw_dl is not None:
+                    # end-to-end deadline: expiry anywhere (queued,
+                    # mid-prefill, mid-decode) returns a 504
+                    # deadline_exceeded envelope and frees the request's
+                    # blocks/slot at the next launch boundary. The header
+                    # form (X-Request-Deadline-Ms, set by the router with
+                    # the REMAINING budget) wins over the body field.
+                    kwargs["deadline_ms"] = raw_dl
                 raw_slo = data.get("slo_class")
                 if raw_slo is not None:
                     # SLO class (engine/scheduler.py): admission priority,
@@ -620,7 +665,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         for ev in gen:
                             self.wfile.write(json.dumps(ev).encode() + b"\n")
                             self.wfile.flush()
-                    except (BrokenPipeError, ConnectionResetError):
+                    except OSError:
                         # client went away mid-stream: closing the
                         # generator cancels the request — the engine kills
                         # its slot at the next chunk boundary so the fleet
@@ -681,6 +726,16 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 code = 200
             elif err_type == "invalid_request":
                 code = 400
+            elif err_type == "deadline_exceeded":
+                # the request's OWN deadline_ms budget expired: 504, and
+                # nobody — router included — may retry it (the budget is
+                # just as spent wherever the retry lands)
+                code = 504
+            elif err_type == "cancelled":
+                # client went away (or the stream was torn down): 499
+                # (nginx convention) so access logs can tell a dead
+                # client from a server fault; never router-retried
+                code = 499
             elif err_type in ("timeout", "unavailable", "draining"):
                 # timeout: deadline exceeded (reference's per-hop failure,
                 # orchestration.py:118,131). unavailable: the continuous

@@ -26,6 +26,10 @@ containment path runs in CI, deterministically:
                    wedge drill for /ready-driven router ejection: a
                    wedge_s > deadline rule leaves an abandoned device
                    call in engine._wedged until the sleep drains
+    preempt        SLO-aware KV preemption (engine/continuous.
+                   _preempt_for): after the victim is selected, before
+                   any of its state is touched (tag = the victim's
+                   prompt) — the crash-during-preempt chaos drill
 
 Design rules:
   * Zero overhead disarmed: check() is one module-global None test.
@@ -63,7 +67,7 @@ from typing import Optional
 
 POINTS = (
     "admission", "prefill", "decode_launch", "fetch", "alloc",
-    "shadow_copy", "solo",
+    "shadow_copy", "solo", "preempt",
 )
 
 

@@ -500,6 +500,30 @@ def test_shadow_store_decode_unreachable(real_reachable):
         assert key not in real_reachable, key
 
 
+def test_preemption_host_paths_decode_unreachable(real_reachable):
+    """The SLO-aware preemption machinery (victim selection, the
+    swap-to-host flush, the resume-queue restore, the pressure ladder)
+    and the deadline/cancellation checks are strictly host-side launch-
+    boundary logic: time.time/wall-clock comparisons, allocator walks,
+    and a SYNCHRONOUS shadow flush — exactly the host syncs the hot-path
+    lint exists to keep out of compiled code. None may be reachable from
+    any jit root (the acceptance criterion's 'zero new host syncs in the
+    decode hot path'); only the pre-existing restore/gather PROGRAMS
+    touch the device, as their own jit roots."""
+    for key in [
+        ("engine.continuous", "ContinuousEngine._preempt_for"),
+        ("engine.continuous", "ContinuousEngine._victim_for"),
+        ("engine.continuous", "ContinuousEngine._alloc_with_pressure"),
+        ("engine.continuous", "ContinuousEngine._prepare_resume"),
+        ("engine.continuous", "ContinuousEngine._cancel_env"),
+        ("engine.continuous", "ContinuousEngine._deadline_env"),
+        ("engine.continuous", "ContinuousEngine._past_deadline"),
+        ("engine.scheduler", "TokenBudgetScheduler.select_victim"),
+        ("engine.scheduler", "TokenBudgetScheduler.victim_key"),
+    ]:
+        assert key not in real_reachable, key
+
+
 def test_ragged_host_planner_decode_unreachable(real_reachable):
     """The ragged launch planner (engine/paged.build_ragged_meta — numpy
     metadata assembly) and the continuous engine's launch-loop callers

@@ -1,0 +1,709 @@
+"""Graceful degradation under memory pressure: SLO-aware KV preemption
+with swap-to-host, end-to-end deadlines, and real cancellation.
+
+The bar (ISSUE 10 acceptance):
+  * preempt→resume greedy output is bit-identical to an uncontended run,
+    on BOTH policies — "swap" (victim's filled blocks pushed to the host
+    shadow, restored in one scatter on resume, tail-only re-prefill) and
+    "recompute" (drop-and-recompute from the salvage record);
+  * victim selection is SLO policy: lowest weight first, youngest within
+    a weight tie, and a victim never outranks the beneficiary;
+  * preemption STORM: a pool sized so N concurrent requests force
+    repeated preemption still completes every request, bit-identically,
+    with `free == total` (minus cached chains) after the fleet drains;
+  * chaos: a crash landing at every fault point — the new `preempt`
+    point included — during a preempt/resume cycle is contained by the
+    supervisor and the output stays bit-identical;
+  * cancellation frees resources promptly: a vanished streaming client
+    (broken pipe) or an expired `deadline_ms` releases blocks + slot at
+    the next launch boundary, long before the token budget drains;
+  * HTTP surface: deadline_ms on /generate and the OpenAI routes (504
+    `deadline_exceeded`, 499 `cancelled`), fail-fast for already-expired
+    requests (ZERO pool allocations), X-Request-Deadline-Ms relay, and
+    the router NEVER re-dispatching a 504.
+
+Deterministic where possible (counter-triggered faults); the contention
+legs poll real scheduler state with bounded timeouts (marker `chaos`).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distributed_llm_inference_tpu import EngineConfig, get_model_config
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.engine import InferenceEngine
+from distributed_llm_inference_tpu.engine.scheduler import (
+    SLOClass, TokenBudgetScheduler,
+)
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+from distributed_llm_inference_tpu.utils import faults
+
+pytestmark = pytest.mark.chaos
+
+BS = 8  # kv_block_size for every fleet here
+PROMPT_A = "the quick brown fox jumps over the"
+PROMPT_B = "pack my box with five dozen liquor"
+KW = dict(max_tokens=10, greedy=True, chat=False)
+# the contention victim decodes LONG (and holds 7 of the 8 usable
+# blocks), so the second admission always finds it mid-decode
+KW_LONG = dict(max_tokens=24, greedy=True, chat=False)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = get_model_config("test-llama-tiny")
+    return InferenceEngine(
+        cfg,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=8
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def solo_a(engine):
+    return engine.generate(PROMPT_A, **KW_LONG)
+
+
+@pytest.fixture(scope="module")
+def solo_b(engine):
+    return engine.generate(PROMPT_B, **KW)
+
+
+def _cont(engine, pool=16, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("chunk_steps", 2)
+    kw.setdefault("restart_backoff_s", 0.01)
+    kw.setdefault("slot_max_seq", 64)  # 8 blocks of BS
+    return ContinuousEngine(
+        engine, kv_pool_blocks=pool, kv_block_size=BS, **kw
+    )
+
+
+def _ctr(engine, name):
+    snap = engine.metrics.snapshot()
+    return sum(
+        s.get("value", s.get("count", 0))
+        for s in snap.get(name, {}).get("series", [])
+    )
+
+
+def _pool_clean(cont):
+    """free + index-cached == everything (the trash block excluded)."""
+    st = cont.stats()["paged"]
+    return st["free_blocks"] + st["cached_blocks"] == st["pool_blocks"] - 1
+
+
+def _wait(pred, timeout=20.0, what="condition"):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _contended_pair(engine, cont):
+    """Serve A (long decode) and B (admitted mid-A against a pool that
+    cannot hold both) concurrently; returns (result_a, result_b)."""
+    out = {}
+
+    def run(tag, prompt, kw):
+        out[tag] = cont.submit(prompt, **kw)
+
+    ta = threading.Thread(target=run, args=("a", PROMPT_A, KW_LONG))
+    ta.start()
+    # B joins only once A is decoding (occupying its blocks)
+    _wait(lambda: cont.stats()["occupied"] >= 1, what="A admitted")
+    tb = threading.Thread(target=run, args=("b", PROMPT_B, KW))
+    tb.start()
+    ta.join(timeout=60)
+    tb.join(timeout=60)
+    assert not ta.is_alive() and not tb.is_alive(), "requests hung"
+    return out["a"], out["b"]
+
+
+# -- preempt -> resume bit-exactness -----------------------------------------
+
+# pool: 9 usable blocks. A (35 ids + 24 tokens) needs ceil(59 / 8) = 8;
+# B (35 ids + 10) needs ceil(45 / 8) = 6 > 1 free, and A's mapped chains
+# are pinned while it decodes — B can only be placed by preempting A.
+TIGHT_POOL = 10
+
+
+@pytest.mark.parametrize("policy", ["swap", "recompute"])
+def test_preempt_resume_bit_exact(policy, solo_a, solo_b):
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(
+        cfg,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=8,
+            preempt_policy=policy,
+        ),
+    )
+    cont = _cont(eng, pool=TIGHT_POOL, kv_shadow=(policy == "swap"))
+    try:
+        restored0 = _ctr(eng, "dli_shadow_restored_blocks_total")
+        ra, rb = _contended_pair(eng, cont)
+        assert ra["status"] == "success", ra
+        assert rb["status"] == "success", rb
+        # the acceptance bar: preempted-and-resumed output is
+        # bit-identical to the never-preempted (solo) run
+        assert ra["response"] == solo_a["response"]
+        assert rb["response"] == solo_b["response"]
+        assert cont.preempted_total >= 1
+        assert _ctr(eng, "dli_preempted_resume_seconds") >= 1  # _count
+        if policy == "swap":
+            # the victim's chain came back through the shadow scatter
+            assert (
+                _ctr(eng, "dli_shadow_restored_blocks_total") > restored0
+            )
+        assert _pool_clean(cont)
+    finally:
+        cont.close()
+
+
+def test_preempted_request_reports_recovered(solo_a):
+    """A preempted request's envelope carries recovered: true (it was
+    served through the salvage-continuation machinery)."""
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(
+        cfg,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=8,
+        ),
+    )
+    cont = _cont(eng, pool=TIGHT_POOL)
+    try:
+        ra, _ = _contended_pair(eng, cont)
+        assert ra["status"] == "success"
+        assert ra["response"] == solo_a["response"]
+        # A was the victim (B never preempts anyone else); its envelope
+        # records the eviction count
+        assert ra.get("preempted", 0) >= 1
+        assert cont.stats()["preemption"]["preempted_total"] >= 1
+    finally:
+        cont.close()
+
+
+def test_preempt_policy_off_waits(solo_a, solo_b):
+    """preempt_policy='off' restores the old behavior: B waits for A's
+    release instead of evicting it — both still complete, zero
+    preemptions."""
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(
+        cfg,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=8,
+            preempt_policy="off",
+        ),
+    )
+    cont = _cont(eng, pool=TIGHT_POOL)
+    try:
+        ra, rb = _contended_pair(eng, cont)
+        assert ra["response"] == solo_a["response"]
+        assert rb["response"] == solo_b["response"]
+        assert cont.preempted_total == 0
+        assert _pool_clean(cont)
+    finally:
+        cont.close()
+
+
+def test_bad_preempt_policy_rejected():
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(
+        cfg, engine_cfg=EngineConfig(preempt_policy="sometimes"),
+    )
+    with pytest.raises(ValueError, match="preempt_policy"):
+        _cont(eng, pool=TIGHT_POOL)
+
+
+# -- victim-selection policy units -------------------------------------------
+
+INTERACTIVE = SLOClass("interactive", 0.5, 0.1, 4.0, True)
+STANDARD = SLOClass("standard", 2.0, 0.5, 2.0, True)
+BATCH = SLOClass("batch", 30.0, 2.0, 1.0, False)
+
+
+def _sched():
+    classes = {
+        c.name: c for c in (INTERACTIVE, STANDARD, BATCH)
+    }
+    return TokenBudgetScheduler(classes, "standard", 128, 8, 2)
+
+
+def test_victim_lowest_weight_first():
+    s = _sched()
+    v = s.select_victim(
+        [("i", INTERACTIVE, 1.0), ("b", BATCH, 2.0), ("s", STANDARD, 3.0)],
+        INTERACTIVE,
+    )
+    assert v == "b"
+
+
+def test_victim_youngest_within_weight_tie():
+    s = _sched()
+    v = s.select_victim(
+        [("old", STANDARD, 1.0), ("young", STANDARD, 9.0)], STANDARD,
+    )
+    assert v == "young"
+
+
+def test_victim_never_outranks_beneficiary():
+    s = _sched()
+    # a batch admission may not preempt interactive/standard decodes
+    assert s.select_victim(
+        [("i", INTERACTIVE, 1.0), ("s", STANDARD, 2.0)], BATCH,
+    ) is None
+    # equal weight IS eligible (FIFO fairness: youngest yields)
+    assert s.select_victim([("b2", BATCH, 5.0)], BATCH) == "b2"
+
+
+def test_victim_cap_respected(solo_a):
+    """A request preempted max_preemptions_per_req times becomes immune:
+    the pool then backpressures instead of thrashing it forever."""
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(
+        cfg,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=8,
+            max_preemptions_per_req=0,  # everyone immune from the start
+        ),
+    )
+    cont = _cont(eng, pool=TIGHT_POOL)
+    try:
+        ra, rb = _contended_pair(eng, cont)
+        assert ra["status"] == "success" and rb["status"] == "success"
+        assert ra["response"] == solo_a["response"]
+        assert cont.preempted_total == 0  # cap 0 == policy off in effect
+    finally:
+        cont.close()
+
+
+# -- preemption storm ---------------------------------------------------------
+
+def test_preemption_storm_all_complete(engine):
+    """N concurrent requests against a pool that can hold ~one of them:
+    repeated preemption, every request completes bit-identically, and
+    the pool books balance after the fleet drains."""
+    solos = {}
+    prompts = [
+        PROMPT_A, PROMPT_B,
+        "sphinx of black quartz judge my vow today",
+        "how vexingly quick daft zebras jump now",
+    ]
+    for p in prompts:
+        solos[p] = engine.generate(p, **KW)
+    cont = _cont(engine, pool=TIGHT_POOL, n_slots=4)
+    try:
+        out = {}
+
+        def run(p):
+            out[p] = cont.submit(p, **KW)
+
+        threads = [
+            threading.Thread(target=run, args=(p,)) for p in prompts
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+            assert not t.is_alive(), "storm request hung"
+        for p in prompts:
+            assert out[p]["status"] == "success", out[p]
+            assert out[p]["response"] == solos[p]["response"], p
+        assert _pool_clean(cont)
+    finally:
+        cont.close()
+
+
+# -- chaos: crash landing during a preempt/resume cycle -----------------------
+
+_CYCLE_RULES = {
+    "preempt": dict(on_call=1),
+    "admission": dict(on_call=3),
+    "alloc": dict(on_call=3),
+    "prefill": dict(on_call=2),
+    "decode_launch": dict(on_call=6),
+    "fetch": dict(on_call=4),
+}
+
+
+@pytest.mark.parametrize("point", sorted(_CYCLE_RULES))
+def test_crash_during_preempt_cycle(point, solo_a, solo_b):
+    """A transient crash landing anywhere in a contended preempt/resume
+    cycle — the preempt hook itself included — is contained by the
+    supervisor, and BOTH requests still finish bit-identically."""
+    cfg = get_model_config("test-llama-tiny")
+    eng = InferenceEngine(
+        cfg,
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32, 64), prefix_cache_entries=8,
+        ),
+    )
+    cont = _cont(eng, pool=TIGHT_POOL)
+    try:
+        faults.arm([
+            faults.FaultRule(point, "transient", **_CYCLE_RULES[point])
+        ])
+        ra, rb = _contended_pair(eng, cont)
+        faults.disarm()
+        assert ra["status"] == "success", (point, ra)
+        assert rb["status"] == "success", (point, rb)
+        assert ra["response"] == solo_a["response"], point
+        assert rb["response"] == solo_b["response"], point
+        assert _pool_clean(cont)
+        assert cont.stats()["supervisor"]["ready"] is True
+    finally:
+        faults.disarm()
+        cont.close()
+
+
+# -- cancellation frees resources promptly ------------------------------------
+
+def test_stream_close_cancels_and_frees(engine):
+    """Abandoning a stream (the serving edge's broken-pipe path calls
+    generator.close()) flips the cancel flag; the worker kills the slot
+    and frees the blocks within one scheduler step — NOT after the full
+    max_new_tokens budget."""
+    cont = _cont(engine, pool=16)
+    try:
+        cancelled0 = _ctr(engine, "dli_cancelled_total")
+        gen = cont.stream(PROMPT_A, max_tokens=2000, greedy=True,
+                          chat=False)
+        first = next(gen)  # at least one delta: the request is decoding
+        assert "delta" in first
+        gen.close()
+        _wait(
+            lambda: cont.stats()["occupied"] == 0 and _pool_clean(cont),
+            what="slot+blocks freed after stream close",
+        )
+        # well under the 2000-token budget: the fleet is idle already
+        assert cont.stats()["occupied"] == 0
+        assert _ctr(engine, "dli_cancelled_total") > cancelled0
+    finally:
+        cont.close()
+
+
+def test_http_sse_disconnect_cancels(engine):
+    """A vanished SSE client (socket closed mid-stream) routes into the
+    cancellation path: the engine stops decoding and frees the slot long
+    before the budget drains (the PR's streaming-disconnect bugfix)."""
+    cont = _cont(engine, pool=16)
+    server = InferenceServer(
+        engine, host="127.0.0.1", port=0, max_tokens_cap=4096,
+        continuous=cont,
+    )
+    server.start()
+    try:
+        body = json.dumps({
+            "model": "m",
+            "messages": [{"role": "user", "content": PROMPT_A}],
+            "stream": True, "max_tokens": 2000, "temperature": 0.0,
+        })
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=10)
+        s.sendall(
+            (
+                f"POST /v1/chat/completions HTTP/1.1\r\n"
+                f"Host: 127.0.0.1\r\nContent-Type: application/json\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n{body}"
+            ).encode()
+        )
+        s.recv(1024)  # headers + the first SSE bytes: decode is live
+        s.close()  # vanish mid-stream
+        _wait(
+            lambda: cont.stats()["occupied"] == 0 and _pool_clean(cont),
+            what="engine freed after SSE disconnect",
+        )
+    finally:
+        server.shutdown()
+
+
+# -- end-to-end deadlines ------------------------------------------------------
+
+def test_expired_deadline_fails_fast_zero_allocations(engine):
+    """An already-expired deadline_ms is refused BEFORE admission: no
+    prefill launch, zero pool blocks touched."""
+    cont = _cont(engine, pool=16)
+    try:
+        free0 = cont.stats()["paged"]["free_blocks"]
+        exceeded0 = _ctr(engine, "dli_deadline_exceeded_total")
+        r = cont.submit(PROMPT_B + " xyz", deadline_ms=0.01, **KW)
+        assert r["status"] == "failed"
+        assert r["error_type"] == "deadline_exceeded"
+        assert cont.stats()["paged"]["free_blocks"] == free0
+        assert _ctr(engine, "dli_deadline_exceeded_total") > exceeded0
+    finally:
+        cont.close()
+
+
+def test_mid_decode_deadline_frees_blocks(engine):
+    """A deadline expiring mid-decode kills the slot at the next launch
+    boundary and releases blocks + slot immediately — the envelope is
+    the distinct deadline_exceeded, not the legacy timeout. The deadline
+    is sized off a measured warm request so it reliably lands INSIDE the
+    decode window on any host speed."""
+    cont = _cont(engine, pool=16, slot_max_seq=120, chunk_steps=1)
+    kw = dict(max_tokens=4000, greedy=True, chat=False)
+    try:
+        # dry run (also pays every compile): the exact request's warm
+        # TTFT and total wall clock bound the decode window
+        cont.submit(PROMPT_A, **kw)
+        t0 = time.time()
+        dry = cont.submit(PROMPT_A, **kw)
+        dry_s = time.time() - t0
+        assert dry["status"] == "success"
+        ttft = float(dry["ttft_s"])
+        # aim the deadline inside the decode window; per-run jitter can
+        # still let a fast run finish first, so try a few fractions —
+        # ONE mid-decode expiry proves the property
+        hit = None
+        for frac in (0.5, 0.3, 0.7, 0.2, 0.85):
+            deadline_s = ttft + frac * max(0.01, dry_s - ttft)
+            t0 = time.time()
+            r = cont.submit(PROMPT_A, deadline_ms=deadline_s * 1e3, **kw)
+            elapsed = time.time() - t0
+            if r["status"] == "failed":
+                hit = (r, elapsed)
+                break
+        assert hit is not None, "deadline never landed mid-decode"
+        r, elapsed = hit
+        assert r["error_type"] == "deadline_exceeded"
+        # the request died at its deadline, not at budget exhaustion
+        assert elapsed < 30
+        _wait(
+            lambda: cont.stats()["occupied"] == 0 and _pool_clean(cont),
+            what="blocks freed after deadline",
+        )
+    finally:
+        cont.close()
+
+
+def test_solo_engine_deadline_ms(engine):
+    r = engine.generate(PROMPT_A, deadline_ms=0.01, **KW)
+    assert r["status"] == "failed"
+    assert r["error_type"] == "deadline_exceeded"
+
+
+def test_queue_expired_deadline_fails_fast(engine):
+    from distributed_llm_inference_tpu.serving.queue import BatchingQueue
+
+    q = BatchingQueue(engine, max_queue=4)
+    try:
+        r = q.submit(PROMPT_A, deadline_ms=0.01, **KW)
+        assert r["status"] == "failed"
+        assert r["error_type"] == "deadline_exceeded"
+    finally:
+        q.close()
+
+
+# -- HTTP surface -------------------------------------------------------------
+
+def _post(base, path, payload, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def http_server(engine):
+    cont = _cont(engine, pool=16)
+    server = InferenceServer(
+        engine, host="127.0.0.1", port=0, max_tokens_cap=64,
+        continuous=cont,
+    )
+    server.start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.shutdown()
+
+
+def test_http_generate_deadline_504(http_server):
+    code, body = _post(
+        http_server, "/generate",
+        {"prompt": PROMPT_A, "max_tokens": 5, "deadline_ms": 0.01},
+    )
+    assert code == 504
+    assert body["error_type"] == "deadline_exceeded"
+
+
+def test_http_generate_bad_deadline_400(http_server):
+    code, body = _post(
+        http_server, "/generate",
+        {"prompt": PROMPT_A, "deadline_ms": -5},
+    )
+    assert code == 400
+
+
+def test_http_openai_deadline_504(http_server):
+    for path, payload in (
+        ("/v1/completions", {"model": "m", "prompt": PROMPT_A,
+                             "deadline_ms": 0.01}),
+        ("/v1/chat/completions", {
+            "model": "m",
+            "messages": [{"role": "user", "content": PROMPT_A}],
+            "deadline_ms": 0.01,
+        }),
+    ):
+        code, body = _post(http_server, path, payload)
+        assert code == 504, (path, body)
+        assert body["error"]["type"] == "timeout_error"
+
+
+def test_http_deadline_header_overrides_body(http_server):
+    """X-Request-Deadline-Ms (the router's remaining-budget relay) wins
+    over the body field: a generous body deadline with a spent header
+    budget still 504s."""
+    code, body = _post(
+        http_server, "/generate",
+        {"prompt": PROMPT_A, "max_tokens": 5, "deadline_ms": 60000},
+        headers={"X-Request-Deadline-Ms": "0.01"},
+    )
+    assert code == 504
+    assert body["error_type"] == "deadline_exceeded"
+
+
+def test_http_deadline_success_when_budget_fits(http_server):
+    code, body = _post(
+        http_server, "/generate",
+        {"prompt": PROMPT_A, "max_tokens": 3, "greedy": True,
+         "chat": False, "deadline_ms": 120000},
+    )
+    assert code == 200, body
+    assert body["status"] == "success"
+
+
+# -- router discipline ---------------------------------------------------------
+
+class _StubReplica:
+    """Minimal replica: /ready 200; POST answers a fixed (status, body);
+    records hits + headers."""
+
+    def __init__(self, status, body):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                payload = json.dumps({"ready": True}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                stub.hits += 1
+                stub.headers.append(dict(self.headers))
+                payload = json.dumps(stub.body).encode()
+                self.send_response(stub.status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self.hits = 0
+        self.headers: list = []
+        self.status = status
+        self.body = body
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _router(urls):
+    from distributed_llm_inference_tpu.serving.router import Replica, Router
+
+    return Router(
+        [Replica(f"r{i}", u) for i, u in enumerate(urls)],
+        probe_interval_s=3600.0,
+    )
+
+
+def test_router_never_retries_deadline_exceeded():
+    """A 504 deadline_exceeded comes straight back: ONE dispatch, no
+    failover to the second replica, no breaker strike."""
+    dead_env = {
+        "error": "Error: request exceeded its deadline_ms budget",
+        "status": "failed", "error_type": "deadline_exceeded",
+    }
+    a = _StubReplica(504, dead_env)
+    b = _StubReplica(504, dead_env)
+    router = _router([a.url, b.url])
+    try:
+        body = json.dumps({"prompt": "x", "deadline_ms": 5000}).encode()
+        rep, status, rbody, _h, attempts = router.dispatch(
+            "/generate", body, "x", "rid-1", deadline_ms=5000.0,
+        )
+        assert status == 504
+        assert json.loads(rbody)["error_type"] == "deadline_exceeded"
+        assert attempts == 1
+        assert a.hits + b.hits == 1  # exactly one replica was asked
+        assert rep is not None and rep.consecutive_failures == 0
+    finally:
+        router.close()
+        a.close()
+        b.close()
+
+
+def test_router_relays_remaining_deadline_header():
+    ok = _StubReplica(200, {"status": "success", "response": "hi"})
+    router = _router([ok.url])
+    try:
+        body = json.dumps({"prompt": "x"}).encode()
+        _rep, status, _b, _h, _n = router.dispatch(
+            "/generate", body, "x", "rid-2", deadline_ms=5000.0,
+        )
+        assert status == 200
+        hdr = ok.headers[0].get("X-Request-Deadline-Ms")
+        assert hdr is not None
+        assert 0 < float(hdr) <= 5000.0
+    finally:
+        router.close()
+        ok.close()
+
+
+def test_router_spent_budget_answers_504_without_dispatch():
+    ok = _StubReplica(200, {"status": "success"})
+    router = _router([ok.url])
+    try:
+        body = json.dumps({"prompt": "x"}).encode()
+        _rep, status, rbody, _h, _n = router.dispatch(
+            "/generate", body, "x", "rid-3", deadline_ms=0.0001,
+        )
+        assert status == 504
+        assert json.loads(rbody)["error_type"] == "deadline_exceeded"
+        assert ok.hits == 0  # the budget died at the router
+    finally:
+        router.close()
+        ok.close()
