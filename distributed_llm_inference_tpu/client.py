@@ -111,6 +111,22 @@ class DistributedLLMClient:
                     f"{result.get('tokens_per_sec')} tok/s | "
                     f"TTFT {result.get('ttft_s')}s"
                 )
+                # disaggregated serving detail (router envelopes): which
+                # replica ran the token loop, and whether its prefix
+                # arrived over the KV fabric instead of a local prefill
+                extras = []
+                if result.get("replica"):
+                    extras.append(f"replica {result['replica']}")
+                if result.get("kv_fabric_blocks"):
+                    extras.append(
+                        f"{result['kv_fabric_blocks']} KV blocks via fabric"
+                    )
+                if result.get("prefix_cached_tokens"):
+                    extras.append(
+                        f"{result['prefix_cached_tokens']} prefix tokens cached"
+                    )
+                if extras:
+                    print(f"   🔀 {' | '.join(extras)}")
             else:
                 print(f"\n❌ {result.get('error', 'unknown error')}")
         return result

@@ -402,6 +402,26 @@ class EngineConfig:
     # so a full pool's worth of warm chains survives one generation of
     # churn.
     kv_shadow_blocks: int = 0
+    # Cross-replica KV fabric (serving/kv_fabric.py): serve this
+    # replica's shadowed KV chains by chunk digest on GET /kv/{digest},
+    # and honor the router's X-KV-Transfer-* handoff hints by pulling a
+    # missing prefix from the resident peer (scattered through the
+    # pre-warmed restore program) instead of re-prefilling it. Needs the
+    # same stack as kv_shadow (paged fleet + block-prefix index); False
+    # keeps the shadow purely local (crash recovery only).
+    kv_fabric: bool = True
+    # Hard deadline on one fabric fetch, end to end: a dead or wedged
+    # peer costs at most this long, then admission degrades to a local
+    # cold prefill (the fallback ladder never errors).
+    kv_fabric_timeout_s: float = 5.0
+    # Replica specialization class for prefill/decode disaggregation
+    # ("prefill" | "decode" | "mixed"): the router sends fresh
+    # long-prompt work to prefill-class replicas and hands the finished
+    # prefix (by digest, via the fabric) to a decode-class replica for
+    # the token loop. Engine-side this only labels the fabric metrics
+    # and /health — specialization is routing policy, not a different
+    # engine.
+    replica_class: str = "mixed"
     # SLO-aware KV preemption (engine/continuous.py _preempt_for): when a
     # paged admission still cannot get blocks after the evict-
     # unreferenced-chains retry, the scheduler preempts the lowest-SLO-

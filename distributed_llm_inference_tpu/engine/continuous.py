@@ -76,6 +76,7 @@ from ..utils.logging import get_logger
 from ..utils.retry import overload_retry_after
 from ..utils.tracing import Trace
 from . import generate as G
+from .block_prefix import chunk_digests
 
 log = get_logger("continuous")
 
@@ -93,11 +94,11 @@ class _Request:
         "trace", "salvaged", "strikes", "allowed", "slo",
         "ids", "shadow_depth", "recovering",
         "deadline_at", "cancel_cause", "preemptions", "preempted_at",
-        "resume_seq", "drop_seq",
+        "resume_seq", "drop_seq", "kv_hint", "fabric_blocks",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
-                 request_id=None):
+                 request_id=None, kv_hint=None):
         self.prompt = prompt
         # SLO class name (engine/scheduler.py): resolved against the
         # configured classes at enqueue; drives prefill-budget
@@ -178,6 +179,16 @@ class _Request:
         # chunks are regenerated after resume, exactly like the crash
         # salvage contract)
         self.drop_seq = 0
+        # KV-fabric handoff hint (the router's X-KV-Transfer-* headers):
+        # {"peer": url, "digest": hex} naming where this prompt's prefix
+        # chain is resident. Consumed on the FIRST admission attempt —
+        # retries/requeues/salvages never re-fetch (the first import
+        # either landed in the block-prefix index or the fallback is
+        # local prefill).
+        self.kv_hint = kv_hint
+        # blocks imported over the fabric for this request (envelope
+        # observability: the router reads it to score handoff outcomes)
+        self.fabric_blocks = 0
 
 
 class ContinuousEngine:
@@ -499,6 +510,29 @@ class ContinuousEngine:
                 self.cache, zeros,
                 jnp.zeros((W,), jnp.int32),  # all rows -> trash block
             )
+        # Cross-replica KV fabric (serving/kv_fabric.py): this replica's
+        # fetch client, plus the serving half's gate. Rides the SAME
+        # stack as warm recovery — the shadow store holds the servable
+        # chains, the pre-warmed restore program scatters fetched ones,
+        # the block-prefix index registers them — so fabric imports are
+        # bit-exact by the identical content-key argument.
+        self.replica_class = str(engine.engine_cfg.replica_class)
+        if self.replica_class not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"replica_class must be 'prefill', 'decode', or 'mixed', "
+                f"got {self.replica_class!r}"
+            )
+        self._fabric = None
+        self.fabric_serving = bool(
+            self._shadow is not None and engine.engine_cfg.kv_fabric
+        )
+        if self.fabric_serving:
+            from ..serving.kv_fabric import KVFabricClient
+
+            self._fabric = KVFabricClient(
+                registry=engine.metrics, role=self.replica_class,
+                timeout_s=engine.engine_cfg.kv_fabric_timeout_s,
+            )
         self._cv = threading.Condition()
         self._queue: list[_Request] = []
         self._closed = False
@@ -801,14 +835,29 @@ class ContinuousEngine:
         return None
 
     def submit(self, prompt: str, **kwargs) -> dict:
+        # KV-fabric handoff surface (serving/kv_fabric.py): the hint is
+        # consumed at admission; prefill_only serves the disaggregation
+        # handshake's phase 1 — prefill (and shadow) the prompt, sample
+        # one token, and only answer once the shadow copies have LANDED,
+        # so the decode-class replica's immediate fetch finds the chain
+        # resident instead of racing the copier thread.
+        kv_hint = kwargs.pop("kv_hint", None)
+        prefill_only = bool(kwargs.pop("prefill_only", False))
+        if prefill_only:
+            kwargs["max_tokens"] = 1
         if self._needs_solo(kwargs):
             return self.engine.generate(prompt, **kwargs)
         req = _Request(prompt, kwargs,
-                       request_id=kwargs.pop("request_id", None))
+                       request_id=kwargs.pop("request_id", None),
+                       kv_hint=kv_hint)
         err = self._enqueue(req)
         if err is not None:
             return err
         req.done.wait()
+        if prefill_only and isinstance(req.result, dict):
+            if self._shadow is not None:
+                self._shadow.flush(timeout_s=10.0)
+            req.result.setdefault("prefill_only", True)
         return req.result
 
     def stream(self, prompt: str, **kwargs):
@@ -824,6 +873,7 @@ class ContinuousEngine:
         on the wrapped engine, which decodes entirely on-device) — one
         final envelope event is yielded instead.
         """
+        kv_hint = kwargs.pop("kv_hint", None)
         if self._needs_solo(kwargs):
             out = self.engine.generate(prompt, **kwargs)
             out["done"] = True
@@ -833,7 +883,8 @@ class ContinuousEngine:
 
         q: _queue.Queue = _queue.Queue()
         req = _Request(prompt, kwargs, stream_q=q,
-                       request_id=kwargs.pop("request_id", None))
+                       request_id=kwargs.pop("request_id", None),
+                       kv_hint=kv_hint)
         err = self._enqueue(req)  # error yielded OUTSIDE the engine lock:
         if err is not None:  # the consumer may block on a slow socket write
             yield {**err, "done": True}
@@ -1062,6 +1113,11 @@ class ContinuousEngine:
             out["shadow"] = {
                 **self._shadow.stats(),
                 "restored_blocks": self.shadow_restored_total,
+            }
+        if self._fabric is not None:
+            out["kv_fabric"] = {
+                **self._fabric.stats(),
+                "serving": self.fabric_serving,
             }
         out["slo"] = {
             "default": self._sched.default_name,
@@ -1325,6 +1381,115 @@ class ContinuousEngine:
             free_blocks=self._alloc.free_blocks,
         )
         return n
+
+    # -- cross-replica KV fabric (serving/kv_fabric.py; ARCHITECTURE.md
+    # "KV fabric & disaggregation") ------------------------------------------
+    def fabric_chain(self, digest: str):
+        """Wire bytes for the resident shadow chain ending at `digest`,
+        or None (the server's GET /kv/{digest} -> 404). Any thread: the
+        shadow store is lock-protected and the encode reads host arrays
+        only — the HTTP handler serves peers without touching the
+        scheduler loop."""
+        if not self.fabric_serving:
+            return None
+        from ..serving.kv_fabric import serve_chain
+
+        return serve_chain(self._shadow, digest)
+
+    def fabric_digests(self, limit: int = 64) -> list:
+        """Resident chain digests, MRU first (capped) — the /health field
+        the router's residency bootstrap reads."""
+        if not self.fabric_serving:
+            return []
+        return self._shadow.resident_digests(limit=limit)
+
+    def _fabric_prefetch(self, req: _Request, ids: list):
+        """Consume req's handoff hint (worker thread, at the admission
+        host boundary — strictly BEFORE the prefix plan, so a successful
+        import is just a deeper local hit). The fallback ladder: local
+        chain already covers the prompt -> skip; fetch 404 / dead peer /
+        timeout / failed recheck -> local prefill; pool too full to place
+        the chain -> import what fits (a chain prefix is still a valid
+        chain). Nothing here can fail the request."""
+        hint, req.kv_hint = req.kv_hint, None
+        if (
+            hint is None or self._fabric is None or self._bpx is None
+            or not self.paged
+        ):
+            return
+        peer = hint.get("peer") if isinstance(hint, dict) else None
+        digest = hint.get("digest") if isinstance(hint, dict) else None
+        if not peer or not digest:
+            return
+        bs = self.kv_block_size
+        # deepest depth the planner could ever use (it caps reuse to
+        # leave >= 1 tail token); a local chain at that depth makes the
+        # fetch pure waste
+        cap = max(0, (len(ids) - 1) // bs) * bs
+        p0_local, _, _ = self._bpx.lookup(ids)
+        if cap <= 0 or p0_local >= cap:
+            return
+        fetched = self._fabric.fetch(peer, digest, bs)
+        if fetched is None:
+            return  # counted as a miss; admission continues cold
+        keys, leaves = fetched
+        req.fabric_blocks = self._import_fabric_chain(keys, leaves)
+
+    def _import_fabric_chain(self, keys: list, per_block_leaves: list) -> int:
+        """Scatter a verified fetched chain into the pool (the SAME
+        pre-warmed restore program warm recovery uses), register it into
+        the block-prefix index, and feed it to the local shadow so this
+        replica can onward-serve it through /kv. Returns blocks imported
+        (0 when the pool has no headroom — local prefill still works)."""
+        # one slot-class of headroom, like _restore_shadow: an import
+        # must never make the admission it serves unplaceable
+        budget = self._alloc.free_blocks - self._max_blocks
+        if budget <= 0:
+            return 0
+        if len(keys) > budget:
+            keys = keys[:budget]
+            per_block_leaves = per_block_leaves[:budget]
+        blocks = self._alloc.alloc(len(keys))
+        if blocks is None:
+            return 0
+        W = self._shadow_restore_w
+        pad = (-len(keys)) % W
+        ids_padded = blocks + [self._P.TRASH_BLOCK] * pad
+        try:
+            stacked = []
+            for j in range(len(per_block_leaves[0])):
+                arr = np.stack([pb[j] for pb in per_block_leaves])
+                if pad:
+                    arr = np.concatenate(
+                        [arr, np.repeat(arr[:1], pad, axis=0)]
+                    )
+                stacked.append(jnp.asarray(arr))
+            restored = jax.tree.unflatten(
+                jax.tree.structure(self.cache), stacked
+            )
+            self.cache = self.backend.restore_shadow_blocks(
+                self.cache, restored, jnp.asarray(ids_padded, jnp.int32)
+            )
+        except Exception as e:  # noqa: BLE001 - a leaf-shape mismatch
+            # (peer config drift the digest cannot see) must degrade to
+            # a cold prefill, never crash the scheduler
+            log.warning("fabric_import_invalid", error=str(e))
+            self._alloc.decref(blocks)
+            return 0
+        self._bpx.import_chain(list(keys[-1]), blocks)
+        if self._shadow is not None:
+            self._shadow.put_host(
+                keys, per_block_leaves, self._mutation_seq
+            )
+        # the index now holds its reference per cached block; drop the
+        # allocation's — imported chains end refcount-1 (evictable),
+        # exactly like restored ones
+        self._alloc.decref(blocks)
+        log.info(
+            "fabric_imported", blocks=len(keys),
+            free_blocks=self._alloc.free_blocks,
+        )
+        return len(keys)
 
     # -- SLO-aware KV preemption (graceful degradation under memory
     # pressure; ARCHITECTURE.md "Preemption & cancellation") ----------------
@@ -2043,6 +2208,10 @@ class ContinuousEngine:
             # crash-recovery continuation: prompt + pre-crash tokens
             ids = ids + list(req.salvaged)
         prompt_len = len(ids)
+        if req.kv_hint is not None:
+            # same remote-hit seam as the whole-prefill admission: a
+            # fetched chain becomes a deeper exact-depth hit below
+            self._fabric_prefetch(req, ids)
         p0, entry, plan = eng._prefix_plan(
             self._bpx, ids, capacity=self.slot_max_seq, ragged=True,
         )
@@ -2500,6 +2669,12 @@ class ContinuousEngine:
             # resumes bit-exactly where the fetched stream stopped
             ids = ids + list(req.salvaged)
         prompt_len = len(ids)
+        if req.kv_hint is not None:
+            # router handoff hint: pull the prefix chain from the
+            # resident peer BEFORE planning, so the plan below sees it
+            # as an ordinary (deeper) block-prefix hit; every fetch
+            # failure degrades to the cold plan
+            self._fabric_prefetch(req, ids)
         # prefix lookup + ingest plan: the solo engine's shared planner
         # helper (one copy of the lookup/cold-fallback/mark discipline);
         # the planner is mode-specific — block-chain index (paged) or
@@ -2992,6 +3167,20 @@ class ContinuousEngine:
             req.result["preempted"] = req.preemptions
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
+        if req.fabric_blocks:
+            # prefix blocks pulled over the KV fabric instead of
+            # prefilled: the router scores handoff outcomes off this
+            req.result["kv_fabric_blocks"] = req.fabric_blocks
+        if self.fabric_serving and req.ids is not None:
+            # the prompt chain's parent-chained digests (deepest last):
+            # the router learns digest->replica residency from these,
+            # and a handoff's phase-2 hint carries the deepest one
+            ds = chunk_digests(
+                req.ids, self.kv_block_size,
+                max_chunks=len(req.ids) // self.kv_block_size,
+            )
+            if ds:
+                req.result["kv_digests"] = ds[-8:]
         if req.cart is not None:
             req.result["constrained"] = True
         if stopped:

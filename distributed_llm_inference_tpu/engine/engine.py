@@ -487,6 +487,31 @@ class InferenceEngine:
             "(warm recovery bounds this by the partial tail block)",
             ("engine",),
         )
+        # KV-fabric families (serving/kv_fabric.py — labeled by the
+        # continuous engine's fetch client when the fabric is live;
+        # role = this replica's --replica-class): cross-replica chain
+        # fetches, their outcomes, wire bytes, and fetch latency
+        self.metrics.counter(
+            "dli_kv_fabric_fetches_total",
+            "cross-replica /kv chain fetches attempted", ("role",),
+        )
+        self.metrics.counter(
+            "dli_kv_fabric_hits_total",
+            "fabric fetches that returned a verified chain", ("role",),
+        )
+        self.metrics.counter(
+            "dli_kv_fabric_misses_total",
+            "fabric fetches that fell back to local prefill (404, "
+            "dead/wedged peer, failed content-key recheck)", ("role",),
+        )
+        self.metrics.counter(
+            "dli_kv_fabric_bytes_total",
+            "wire bytes of verified fabric chains received", ("role",),
+        )
+        self.metrics.histogram(
+            "dli_kv_fabric_fetch_seconds",
+            "fabric fetch wall time, failures included",
+        )
         # wedge observability (engine._with_deadline): abandoned
         # deadline-overrun device calls still occupying the device — the
         # serving edge flips /ready 503 past --wedge-unready off the

@@ -48,6 +48,15 @@ granularity:
   * PERSIST (graceful drain): save()/load() serialize the store to an
     atomic npz under --restore-dir, so a rolling restart cycles the
     replica back in with a WARM prefix cache.
+  * WIRE (the cross-replica KV fabric, serving/kv_fabric.py): entries
+    are additionally indexed by their parent-chained chunk digest
+    (block_prefix.chunk_digests over the key), so a peer replica can
+    fetch a whole chain by digest through GET /kv/{digest} —
+    chain_for_digest / resident_digests / put_host are that surface.
+    Content keying is what makes this sound over the wire: the digest
+    names the token prefix, the fetcher recomputes it from the payload's
+    tokens, and KV is a pure function of the prefix — so a fetched chain
+    is bit-identical to one computed locally, or it is rejected.
 
 What is deliberately NOT shadowed: partial tail blocks (mutable until
 they fill), slot/sampling state (host-reconstructable from the salvage
@@ -67,6 +76,7 @@ from typing import Optional
 import numpy as np
 
 from ..utils.logging import get_logger
+from .block_prefix import chunk_digests
 
 log = get_logger("shadow")
 
@@ -109,6 +119,11 @@ class ShadowStore:
             collections.OrderedDict()
         )
         self._children: dict = {}  # key -> set of child keys
+        # chunk-digest index over the resident keys (the same parent-
+        # chained digests engine/block_prefix.chunk_digests exports for
+        # router affinity), so the KV fabric's /kv lookups are O(1)
+        # instead of a full-store digest sweep per request
+        self._digest_key: dict = {}  # digest hex -> key
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # copier queue: (keys, dev_leaves, seq) batches; keys in
@@ -167,6 +182,67 @@ class ShadowStore:
                 self._entries.move_to_end(k)
                 out.append(e)
         return out
+
+    # -- chunk-digest surface (the KV fabric, serving/kv_fabric.py) ----------
+    def digest_of(self, key: tuple) -> str:
+        """The parent-chained chunk digest covering the whole of `key`
+        (engine/block_prefix.chunk_digests — the router tier's affinity
+        and residency currency). Content-addressed: two replicas holding
+        the same token prefix compute the same digest with no
+        coordination, which is what makes the digest a fetchable name."""
+        bs = self.block_size
+        return chunk_digests(key, bs, max_chunks=len(key) // bs)[-1]
+
+    def resident_digests(self, limit: int = 0) -> list:
+        """Digests of resident entries, MRU first (the /health residency
+        bootstrap reads this so a router can learn what a replica holds
+        without ever having routed traffic to it). limit > 0 caps the
+        list — /health must stay cheap on a large store."""
+        with self._lock:
+            out = []
+            for key in reversed(self._entries):
+                out.append(self.digest_of(key))
+                if limit and len(out) >= limit:
+                    break
+        return out
+
+    def chain_for_digest(self, digest: str) -> Optional[tuple]:
+        """(keys, entries) for the full resident chain ending at the key
+        `digest` names — parents first, the scatter/registration order a
+        fetching replica needs — or None when the digest is unknown or
+        the chain has a hole (cascade eviction should prevent holes; a
+        miss is a 404, never an error). O(1) digest lookup + O(depth)
+        ancestor walk; touches each entry MRU like a hit."""
+        bs = self.block_size
+        with self._lock:
+            key = self._digest_key.get(digest)
+            if key is None:
+                return None
+            keys = [key[: (i + 1) * bs] for i in range(len(key) // bs)]
+            out = []
+            for k in keys:
+                e = self._entries.get(k)
+                if e is None:
+                    return None
+                self._entries.move_to_end(k)
+                out.append(e)
+        return keys, out
+
+    def put_host(self, keys: list, per_block_leaves: list, seq: int) -> int:
+        """Insert already-host-resident blocks (a chain fetched over the
+        KV fabric): no copier hop — the bytes are here. Same LRU/cascade
+        discipline as a landed copy, so a fetched chain becomes onward-
+        servable through /kv exactly like a locally captured one.
+        Returns entries inserted."""
+        with self._lock:
+            if self._closed:
+                return 0
+            for key, leaves in zip(keys, per_block_leaves):
+                self._insert_locked(
+                    key, _Entry([np.asarray(a) for a in leaves], int(seq))
+                )
+            self._note_blocks_locked()
+        return len(keys)
 
     def put_async(self, keys: list, dev_leaves: list, seq: int) -> bool:
         """Hand one gathered batch to the copier. keys[i] is the token
@@ -291,6 +367,7 @@ class ShadowStore:
             self._entries.move_to_end(key)
             return
         self._entries[key] = entry
+        self._digest_key[self.digest_of(key)] = key
         parent = key[: -self.block_size]
         if parent:
             self._children.setdefault(parent, set()).add(key)
@@ -308,6 +385,7 @@ class ShadowStore:
         if key not in self._entries:
             return
         del self._entries[key]
+        self._digest_key.pop(self.digest_of(key), None)
         parent = key[: -self.block_size]
         sibs = self._children.get(parent)
         if sibs is not None:
@@ -439,6 +517,7 @@ class ShadowStore:
         with self._lock:
             self._entries.clear()
             self._children.clear()
+            self._digest_key.clear()
             self._note_blocks_locked()
 
     def close(self):

@@ -1,0 +1,272 @@
+"""Cross-replica KV fabric: shadowed KV blocks as a WIRE format.
+
+The shadow store (engine/shadow.py) made filled paged-KV blocks a
+content-keyed, host-portable artifact for crash recovery. This module
+promotes that artifact to a wire format so N replicas' caches behave as
+one logical cache — the disaggregated-serving shape the router tier
+builds on (serving/router.py: prefill-class replicas compute long
+prefixes, decode-class replicas pull them by digest and run the token
+loop, TTFT and TPOT stop competing for one step budget).
+
+Three pieces, all strictly host-side (pinned decode-UNREACHABLE in the
+tests/test_analysis.py callgraph fixture, like the router tier):
+
+  * WIRE FORMAT: encode_chain/decode_chain serialize one shadow chain —
+    parents-first blocks of one token prefix — as an npz blob: a JSON
+    manifest (version, block_size, per-block token chunks) plus the
+    stacked per-leaf KV arrays, the exact layout ShadowStore entries
+    hold. The manifest carries the TOKENS, not the digest: the fetcher
+    recomputes the parent-chained digests (engine/block_prefix.
+    chunk_digests) from the payload's own tokens and rejects any blob
+    whose recomputed digest differs from the one it asked for. That
+    content-key recheck is the whole consistency protocol — KV is a pure
+    function of the token prefix under teacher forcing, so a verified
+    chain is bit-identical to one computed locally, and a corrupt,
+    truncated, or wrong-prefix payload can only produce a REJECTION
+    (cold local prefill), never wrong output.
+  * SERVER: serve_chain(shadow, digest) -> npz bytes | None backs the
+    replica's GET /kv/{digest} route (serving/server.py). A miss — the
+    digest was never resident, or LRU churn evicted it — is a 404 the
+    fetcher treats as "prefill locally".
+  * CLIENT: KVFabricClient.fetch(peer, digest) with a hard deadline.
+    EVERY failure (connect refused on a kill -9'd peer, a wedged socket
+    timing out, 404, a payload failing the recheck) returns None — the
+    fallback ladder ends at local re-prefill, never at an error. Counts
+    dli_kv_fabric_{fetches,hits,misses,bytes}_total{role} and
+    dli_kv_fabric_fetch_seconds (families pre-registered in
+    engine/engine.py; role = this replica's --replica-class).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from ..engine.block_prefix import chunk_digests
+from ..utils.logging import get_logger
+
+log = get_logger("kv_fabric")
+
+WIRE_VERSION = 1
+
+# hex digests only (block_prefix.chunk_digests emits truncated sha1 hex);
+# the /kv route validates against this so a probing client cannot make
+# the digest index do arbitrary-string lookups
+_DIGEST_CHARS = frozenset("0123456789abcdef")
+MAX_DIGEST_LEN = 64
+
+
+def valid_digest(digest: str) -> bool:
+    return (
+        0 < len(digest) <= MAX_DIGEST_LEN
+        and all(c in _DIGEST_CHARS for c in digest)
+    )
+
+
+class FabricPayloadError(ValueError):
+    """A /kv payload failed structural validation or the content-key
+    recheck. Callers degrade to local prefill — never an error."""
+
+
+def chain_digest(ids, block_size: int) -> Optional[str]:
+    """The deepest parent-chained digest of `ids`' full blocks — the name
+    a peer would serve this prefix under — or None when `ids` has no full
+    block."""
+    n = len(ids) // block_size
+    if n <= 0:
+        return None
+    return chunk_digests(ids, block_size, max_chunks=n)[-1]
+
+
+def encode_chain(block_size: int, keys: list, entries: list) -> bytes:
+    """Serialize one parents-first chain. keys[i] is the token prefix
+    block i completes (len == (i+1) * block_size, each extending the
+    previous by one chunk); entries[i] carries .leaves — the per-leaf
+    arrays in jax.tree flatten order of the pool, exactly as the shadow
+    store holds them."""
+    if not keys:
+        raise ValueError("encode_chain needs a non-empty chain")
+    chunks = []
+    for i, key in enumerate(keys):
+        if len(key) != (i + 1) * block_size:
+            raise ValueError(
+                f"chain key {i} has {len(key)} tokens, expected "
+                f"{(i + 1) * block_size}"
+            )
+        chunks.append([int(t) for t in key[-block_size:]])
+    manifest = {
+        "version": WIRE_VERSION,
+        "block_size": int(block_size),
+        "chunks": chunks,
+    }
+    arrays = {"manifest": np.array(json.dumps(manifest))}
+    for j in range(len(entries[0].leaves)):
+        arrays[f"leaf_{j}"] = np.stack([e.leaves[j] for e in entries])
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def decode_chain(data: bytes, block_size: int,
+                 expected_digest: str) -> tuple:
+    """Parse + VERIFY one wire chain. Returns (keys, per_block_leaves):
+    keys parents-first, per_block_leaves[i] the list of per-leaf arrays
+    for block i (the put_host / restore-scatter layout).
+
+    The content-key recheck: the parent-chained digest is recomputed
+    from the payload's OWN token chunks and must equal the digest the
+    caller fetched by. A tampered token, a truncated chain, a
+    block-size mismatch, or a peer answering with the wrong prefix all
+    land here as FabricPayloadError — the caller prefills locally."""
+    try:
+        with np.load(io.BytesIO(data), allow_pickle=False) as z:
+            manifest = json.loads(str(z["manifest"]))
+            leaves = []
+            j = 0
+            while f"leaf_{j}" in z.files:
+                leaves.append(z[f"leaf_{j}"])
+                j += 1
+    except Exception as e:
+        raise FabricPayloadError(f"unparseable /kv payload: {e}") from e
+    if manifest.get("version") != WIRE_VERSION:
+        raise FabricPayloadError(
+            f"wire version {manifest.get('version')!r} != {WIRE_VERSION}"
+        )
+    if manifest.get("block_size") != block_size:
+        raise FabricPayloadError(
+            f"peer block_size {manifest.get('block_size')!r} != local "
+            f"{block_size} — replicas must share --kv-block-size"
+        )
+    chunks = manifest.get("chunks") or []
+    if not chunks or not leaves or any(
+        leaf.shape[0] != len(chunks) for leaf in leaves
+    ):
+        raise FabricPayloadError("empty or ragged /kv payload")
+    ids: list = []
+    keys = []
+    for chunk in chunks:
+        if len(chunk) != block_size:
+            raise FabricPayloadError("chunk length != block_size")
+        ids.extend(int(t) for t in chunk)
+        keys.append(tuple(ids))
+    got = chunk_digests(ids, block_size, max_chunks=len(chunks))[-1]
+    if got != expected_digest:
+        raise FabricPayloadError(
+            f"content-key recheck failed: payload tokens digest to "
+            f"{got}, fetched {expected_digest}"
+        )
+    per_block = [
+        [leaf[i] for leaf in leaves] for i in range(len(chunks))
+    ]
+    return keys, per_block
+
+
+def serve_chain(shadow, digest: str) -> Optional[bytes]:
+    """The /kv route's body: the resident chain ending at `digest`, wire-
+    encoded, or None (-> 404) when not resident / not a valid digest."""
+    if not valid_digest(digest):
+        return None
+    chain = shadow.chain_for_digest(digest)
+    if chain is None:
+        return None
+    keys, entries = chain
+    return encode_chain(shadow.block_size, keys, entries)
+
+
+class KVFabricClient:
+    """One replica's fetching half of the fabric. Deadline'd, metric'd,
+    and failure-silent: fetch() returns the verified chain or None."""
+
+    def __init__(self, registry=None, role: str = "mixed",
+                 timeout_s: float = 5.0):
+        self.role = str(role)
+        self.timeout_s = float(timeout_s)
+        self.fetches = 0
+        self.hits = 0
+        self.misses = 0
+        self.bytes = 0
+        self._m_fetches = self._m_hits = None
+        self._m_misses = self._m_bytes = self._m_seconds = None
+        if registry is not None:
+            self._m_fetches = registry.counter(
+                "dli_kv_fabric_fetches_total",
+                "cross-replica /kv chain fetches attempted", ("role",),
+            ).labels(role=self.role)
+            self._m_hits = registry.counter(
+                "dli_kv_fabric_hits_total",
+                "fabric fetches that returned a verified chain", ("role",),
+            ).labels(role=self.role)
+            self._m_misses = registry.counter(
+                "dli_kv_fabric_misses_total",
+                "fabric fetches that fell back to local prefill (404, "
+                "dead/wedged peer, failed content-key recheck)", ("role",),
+            ).labels(role=self.role)
+            self._m_bytes = registry.counter(
+                "dli_kv_fabric_bytes_total",
+                "wire bytes of verified fabric chains received", ("role",),
+            ).labels(role=self.role)
+            self._m_seconds = registry.histogram(
+                "dli_kv_fabric_fetch_seconds",
+                "fabric fetch wall time, failures included",
+            ).labels()
+
+    def fetch(self, peer_url: str, digest: str,
+              block_size: int) -> Optional[tuple]:
+        """GET {peer}/kv/{digest}, verify, return (keys, per_block_leaves)
+        or None. Bounded by timeout_s end to end (a wedged peer costs one
+        deadline, then the caller prefills locally)."""
+        self.fetches += 1
+        if self._m_fetches is not None:
+            self._m_fetches.inc()
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            if not valid_digest(digest):
+                raise FabricPayloadError(f"invalid digest {digest[:80]!r}")
+            url = peer_url.rstrip("/") + "/kv/" + digest
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
+                data = r.read()
+            out = decode_chain(data, block_size, digest)
+            ok = True
+        except FabricPayloadError as e:
+            log.warning("kv_fabric_payload_rejected", peer=peer_url,
+                        digest=digest, error=str(e))
+            out = None
+        except (urllib.error.URLError, urllib.error.HTTPError, OSError,
+                TimeoutError, ValueError) as e:
+            # 404 (evicted / never resident), connect refused (peer
+            # kill -9'd mid-handoff), socket timeout (wedged peer) — all
+            # one outcome: prefill locally
+            log.info("kv_fabric_miss", peer=peer_url, digest=digest,
+                     error=str(e))
+            out = None
+        finally:
+            if self._m_seconds is not None:
+                self._m_seconds.observe(time.perf_counter() - t0)
+        if not ok or out is None:
+            self.misses += 1
+            if self._m_misses is not None:
+                self._m_misses.inc()
+            return None
+        self.hits += 1
+        self.bytes += len(data)
+        if self._m_hits is not None:
+            self._m_hits.inc()
+            self._m_bytes.inc(len(data))
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "role": self.role,
+            "fetches": self.fetches,
+            "hits": self.hits,
+            "misses": self.misses,
+            "bytes": self.bytes,
+            "timeout_s": self.timeout_s,
+        }

@@ -404,8 +404,17 @@ def _observability_fields(request_id, timings) -> dict:
 def completion_response(entries: list, model: str, kwargs: dict,
                         prompt_once: bool = False,
                         request_id: Optional[str] = None,
-                        timings: Optional[dict] = None) -> dict:
-    """Engine success envelope(s) -> one text_completion response."""
+                        timings: Optional[dict] = None,
+                        kv_extra: Optional[dict] = None) -> dict:
+    """Engine success envelope(s) -> one text_completion response.
+
+    kv_extra: KV-fabric extension fields (kv_digests / kv_fabric_blocks /
+    prefill_only) lifted from the engine envelope — OpenAI clients ignore
+    unknown top-level keys, while the router reads them to learn
+    digest->replica residency and score prefill->decode handoffs on the
+    OpenAI routes exactly as on /generate (handoff-transparent
+    streaming: phase 1 is forced non-streamed server-side, phase 2
+    streams from the decode replica through the unchanged SSE path)."""
     choices = []
     for i, e in enumerate(entries):
         c = {
@@ -425,13 +434,15 @@ def completion_response(entries: list, model: str, kwargs: dict,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
         **_observability_fields(request_id, timings),
+        **(kv_extra or {}),
     }
 
 
 def chat_response(entries: list, model: str, kwargs: dict,
                   prompt_once: bool = False,
                   request_id: Optional[str] = None,
-                  timings: Optional[dict] = None) -> dict:
+                  timings: Optional[dict] = None,
+                  kv_extra: Optional[dict] = None) -> dict:
     choices = []
     for i, entry in enumerate(entries):
         choice = {
@@ -459,6 +470,7 @@ def chat_response(entries: list, model: str, kwargs: dict,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
         **_observability_fields(request_id, timings),
+        **(kv_extra or {}),
     }
 
 

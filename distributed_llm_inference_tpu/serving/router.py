@@ -36,6 +36,21 @@ outage. Four jobs:
     (SIGTERM -> readiness flips -> in-flight work finishes -> clean
     exit), respawns, and waits for `/ready` before touching the next —
     a config/weight rollout never drops a request.
+  * KV FABRIC + PREFILL/DECODE DISAGGREGATION (serving/kv_fabric.py;
+    ARCHITECTURE.md "KV fabric & disaggregation"): on top of the byte
+    affinity map the router keeps a digest->replica residency view in
+    TOKEN-digest space (learned from response envelopes' kv_digests and
+    /health bootstraps, purged on ejection). A dispatch landing away
+    from the prefix's holder carries X-KV-Transfer-* headers so the
+    replica pulls the chain over the fabric instead of re-prefilling;
+    and when the fleet has prefill- AND decode-class replicas
+    (--spawn-prefill/--spawn-decode or --replica-class on the servers),
+    fresh long-prompt work runs a TWO-PHASE dispatch — phase 1 prefills
+    (+ shadow-flushes) on the prefill tier, phase 2 hands the digest to
+    a decode replica for the token loop — so TTFT and TPOT stop
+    competing for one step_token_budget. Every handoff failure (dead
+    prefill tier, evicted digest, failed fetch) degrades to a normal
+    dispatch + local prefill, never an error.
 
 The router is strictly host-side glue: it never imports jax, never
 touches an engine, and stays decode-UNREACHABLE in the analysis call
@@ -110,7 +125,7 @@ class Replica:
     """One upstream engine server, plus the router's view of its health."""
 
     def __init__(self, rid: str, url: str, proc=None, spawn_argv=None,
-                 spawn_env=None):
+                 spawn_env=None, replica_class: str = "mixed"):
         self.rid = rid
         self.url = url.rstrip("/")
         # router-spawned replicas carry their subprocess + respawn recipe
@@ -118,6 +133,11 @@ class Replica:
         self.proc = proc
         self.spawn_argv = spawn_argv
         self.spawn_env = spawn_env
+        # disaggregation class ("prefill" | "decode" | "mixed"): set at
+        # spawn (--spawn-prefill/--spawn-decode) or learned from the
+        # replica's /health — fresh long-prompt work goes to prefill-
+        # class replicas, the token loop to decode/mixed ones
+        self.replica_class = replica_class
         self.state = READY  # optimistic; the first probe corrects it
         self.consecutive_failures = 0
         self.outstanding = 0
@@ -130,6 +150,7 @@ class Replica:
             return {
                 "url": self.url,
                 "state": self.state,
+                "class": self.replica_class,
                 "outstanding": self.outstanding,
                 "consecutive_failures": self.consecutive_failures,
                 "spawned": self.proc is not None,
@@ -150,7 +171,9 @@ class Router:
                  affinity_entries: int = 4096,
                  request_timeout_s: float = 200.0,
                  drain_deadline_s: float = 60.0,
-                 failover_attempts: Optional[int] = None):
+                 failover_attempts: Optional[int] = None,
+                 fabric: bool = True,
+                 handoff_min_bytes: int = 192):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = list(replicas)
@@ -162,15 +185,34 @@ class Router:
         self.affinity_entries = int(affinity_entries)
         self.request_timeout_s = float(request_timeout_s)
         self.drain_deadline_s = float(drain_deadline_s)
+        # KV fabric (serving/kv_fabric.py): attach X-KV-Transfer-* hints
+        # so a replica that misses a prefix pulls it from the resident
+        # peer, and run the prefill->decode handoff when the fleet has
+        # both classes. handoff_min_bytes gates what counts as "fresh
+        # long-prompt work" worth a two-phase dispatch.
+        self.fabric = bool(fabric)
+        self.handoff_min_bytes = int(handoff_min_bytes)
         # each request tries at most every replica once by default
         self.failover_attempts = (
             int(failover_attempts) if failover_attempts
             else max(2, len(self.replicas))
         )
-        # chunk-chain digest -> replica id, LRU-bounded. One entry per
-        # digest DEPTH, so a long shared prefix costs several entries —
-        # that is the point: a deeper match wins routing.
-        self._residency: "collections.OrderedDict[str, str]" = (
+        # chunk-chain digest -> (replica id, deepest TOKEN digest the
+        # replica reported for this chain, or None), LRU-bounded. One
+        # entry per digest DEPTH, so a long shared prefix costs several
+        # entries — that is the point: a deeper match wins routing. The
+        # token digest is the byte->token bridge the fabric needs: the
+        # router has no tokenizer, so it can only name a fetchable chain
+        # by remembering what the serving replica reported.
+        self._residency: "collections.OrderedDict[str, tuple]" = (
+            collections.OrderedDict()
+        )
+        # the global digest->replica residency view in TOKEN-digest
+        # space: learned from response envelopes (kv_digests) and from
+        # replica /health bootstraps (resident_digests), purged with
+        # ejections — stale entries must not steer fabric pulls at a
+        # corpse
+        self._kv_residency: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
         self._res_lock = threading.Lock()
@@ -218,6 +260,14 @@ class Router:
             "routing decisions by affinity outcome (hit = residency map "
             "named a dispatchable replica)", ("result",),
         )
+        self._m_handoffs = self.metrics.counter(
+            "dli_router_handoffs_total",
+            "prefill->decode disaggregation handoffs by outcome "
+            "(handoff = decode replica imported the chain; cold_fallback "
+            "= it re-prefilled locally; prefill_failed / no_digests = "
+            "phase 1 degraded to a normal dispatch; stream = streamed "
+            "phase 2, outcome not observable)", ("outcome",),
+        )
         for r in self.replicas:
             self._m_ready.labels(replica=r.rid).set(1.0)
             self._m_outstanding.labels(replica=r.rid).set(0.0)
@@ -231,7 +281,11 @@ class Router:
     def note_failure(self, rep: Replica, why: str = ""):
         """One connect/5xx failure (probe or proxied). Ejects at the
         threshold; a HALF_OPEN replica re-ejects immediately (its trial
-        failed — the breaker reopens)."""
+        failed — the breaker reopens). Ejection PURGES the replica's
+        residency entries: a stale digest steering affinity (or a fabric
+        pull) at a corpse costs a failover/cold-prefill on every routed
+        request until the entry happens to be overwritten."""
+        ejected = False
         with rep.lock:
             if rep.state == DRAINING:
                 return  # rolling restart owns this replica's lifecycle
@@ -243,10 +297,13 @@ class Router:
             )
             if eject and rep.state != EJECTED:
                 rep.state = EJECTED
+                ejected = True
                 self._m_ejections.labels(replica=rep.rid).inc()
                 log.warning("replica_ejected", replica=rep.rid,
                             failures=rep.consecutive_failures, why=why)
             self._set_ready_gauge(rep)
+        if ejected:
+            self.purge_residency(rep.rid)
 
     def note_success(self, rep: Replica):
         """A successful probe or proxied request: reset the breaker; a
@@ -315,24 +372,35 @@ class Router:
         self._closed.set()
 
     # -- routing -------------------------------------------------------------
-    def _candidates(self, exclude) -> list:
+    def _candidates(self, exclude, role: str = "any") -> list:
+        """Dispatchable replicas, class-filtered. role="decode" (the
+        token loop) prefers decode/mixed replicas so prefill-class ones
+        never compete with decode traffic — unless they are ALL that is
+        left, because availability beats specialization. role="prefill"
+        returns strictly prefill-class replicas (empty = no handoff —
+        the caller degrades to a normal dispatch, never an error)."""
         now = time.monotonic()
         ready = [
             r for r in self.replicas
             if r.rid not in exclude and r.state == READY
             and r.cooldown_until <= now
         ]
-        if ready:
-            return ready
-        # no READY replica: HALF_OPEN trial traffic is better than a
-        # hard 503 — a success readmits, a failure re-ejects
-        return [
-            r for r in self.replicas
-            if r.rid not in exclude and r.state == HALF_OPEN
-            and r.cooldown_until <= now
-        ]
+        if not ready:
+            # no READY replica: HALF_OPEN trial traffic is better than a
+            # hard 503 — a success readmits, a failure re-ejects
+            ready = [
+                r for r in self.replicas
+                if r.rid not in exclude and r.state == HALF_OPEN
+                and r.cooldown_until <= now
+            ]
+        if role == "decode":
+            pref = [r for r in ready if r.replica_class != "prefill"]
+            return pref or ready
+        if role == "prefill":
+            return [r for r in ready if r.replica_class == "prefill"]
+        return ready
 
-    def pick(self, affinity_key: str, exclude=()) -> tuple:
+    def pick(self, affinity_key: str, exclude=(), role: str = "any") -> tuple:
         """(replica, digests) for one dispatch attempt, or (None, digests)
         when nothing is dispatchable. Deepest-residency match wins;
         least-outstanding breaks the miss case."""
@@ -341,35 +409,117 @@ class Router:
                           AFFINITY_MAX_CHUNKS)
             if affinity_key and self.affinity_chunk >= 1 else []
         )
-        cands = self._candidates(exclude)
+        cands = self._candidates(exclude, role=role)
         if not cands:
             return None, digests
         by_id = {r.rid: r for r in cands}
         with self._res_lock:
             for d in reversed(digests):
-                rep = by_id.get(self._residency.get(d))
+                ent = self._residency.get(d)
+                rep = by_id.get(ent[0]) if ent is not None else None
                 if rep is not None:
                     self._m_affinity.labels(result="hit").inc()
                     return rep, digests
         self._m_affinity.labels(result="miss").inc()
         return min(cands, key=lambda r: (r.outstanding, r.rid)), digests
 
-    def record_residency(self, digests, rid: str):
+    def record_residency(self, digests, rid: str,
+                         token_digest: Optional[str] = None):
         """Remember that `rid` now holds the KV blocks for this chain
-        (called with the replica that ACTUALLY served, so failovers move
-        the residency with the traffic)."""
+        (called with the replica that ACTUALLY served, so failovers —
+        and fabric pulls — move the residency with the traffic).
+        token_digest is the deepest TOKEN-chain digest the replica
+        reported for this prompt (its fetchable name on /kv); a
+        same-replica overwrite without one keeps the previous bridge, a
+        replica CHANGE drops it (the new holder's digest arrives with
+        its own envelope)."""
         if not digests:
             return
         with self._res_lock:
             for d in digests:
-                self._residency[d] = rid
+                prev = self._residency.get(d)
+                tok = token_digest
+                if tok is None and prev is not None and prev[0] == rid:
+                    tok = prev[1]
+                self._residency[d] = (rid, tok)
                 self._residency.move_to_end(d)
             while len(self._residency) > self.affinity_entries:
                 self._residency.popitem(last=False)
 
+    def record_kv_residency(self, token_digests, rid: str,
+                            bootstrap: bool = False):
+        """Update the token-digest residency view. bootstrap=True (the
+        /health resident_digests sweep) only fills gaps — a digest
+        learned from live traffic is fresher than a poll."""
+        if not token_digests:
+            return
+        with self._res_lock:
+            for d in token_digests:
+                if bootstrap and d in self._kv_residency:
+                    continue
+                self._kv_residency[d] = rid
+                self._kv_residency.move_to_end(d)
+            while len(self._kv_residency) > self.affinity_entries:
+                self._kv_residency.popitem(last=False)
+
+    def purge_residency(self, rid: str):
+        """Drop every residency entry naming `rid` — byte-affinity AND
+        token-digest views. Called on ejection (and rolling-restart
+        kills): a dead replica's digests must neither pin affinity nor
+        steer fabric pulls at a corpse until overwritten."""
+        with self._res_lock:
+            for d in [
+                d for d, v in self._residency.items() if v[0] == rid
+            ]:
+                del self._residency[d]
+            for d in [
+                d for d, r in self._kv_residency.items() if r == rid
+            ]:
+                del self._kv_residency[d]
+
     def residency_entries(self) -> int:
         with self._res_lock:
             return len(self._residency)
+
+    def kv_residency_entries(self) -> int:
+        with self._res_lock:
+            return len(self._kv_residency)
+
+    def _kv_hint(self, digests, rep: Replica) -> Optional[dict]:
+        """X-KV-Transfer-* headers for dispatching this prompt to `rep`,
+        when the residency view knows a DIFFERENT ready replica holding
+        the prefix chain (deepest byte digest with a token bridge wins).
+        None when rep already holds it, nobody does, or the holder is
+        not currently fetchable — a wrong or missing hint costs one cold
+        prefill, never wrong output, same contract as affinity."""
+        if not self.fabric or not digests:
+            return None
+        with self._res_lock:
+            for d in reversed(digests):
+                ent = self._residency.get(d)
+                if ent is None or ent[1] is None:
+                    continue
+                if ent[0] == rep.rid:
+                    return None  # the pick already lands on the holder
+                peer = self._by_id.get(ent[0])
+                if peer is not None and peer.state == READY:
+                    return {
+                        "X-KV-Transfer-Peer": peer.url,
+                        "X-KV-Transfer-Digest": ent[1],
+                    }
+        return None
+
+    def _envelope_kv_digests(self, rbody: bytes) -> Optional[list]:
+        """kv_digests from a replica's JSON envelope (None when absent /
+        unparseable — residency learning is best-effort)."""
+        if not self.fabric or not rbody:
+            return None
+        try:
+            env = json.loads(rbody)
+        except (ValueError, json.JSONDecodeError):
+            return None
+        out = env.get("kv_digests") if isinstance(env, dict) else None
+        return out if isinstance(out, list) and out else None
 
     # -- upstream calls ------------------------------------------------------
     def _begin(self, rep: Replica):
@@ -402,7 +552,8 @@ class Router:
             return e.code, e.read(), dict(e.headers)
 
     def dispatch(self, path: str, body: bytes, affinity_key: str,
-                 rid: str, deadline_ms: Optional[float] = None) -> tuple:
+                 rid: str, deadline_ms: Optional[float] = None,
+                 hint_headers: Optional[dict] = None) -> tuple:
         """Route one NON-STREAMED request with transparent failover.
 
         Returns (replica_or_None, status, body_bytes, headers, attempts).
@@ -420,7 +571,12 @@ class Router:
         deadline_ms: the request's remaining end-to-end budget at
         ingress; each attempt relays what is LEFT via
         X-Request-Deadline-Ms, and a spent budget answers 504 here
-        without burning another replica's prefill."""
+        without burning another replica's prefill.
+
+        hint_headers: fixed X-KV-Transfer-* headers (a handoff's phase
+        2); when absent, each attempt derives its own fabric hint from
+        the residency view, so a replica that misses the prefix pulls
+        it from the resident peer instead of re-prefilling."""
         t_in = time.monotonic()
         tried: set = set()
         prev: Optional[Replica] = None
@@ -429,16 +585,23 @@ class Router:
             "error_type": "unavailable",
         }).encode(), {"Retry-After": str(RETRY_AFTER_S)})
         for attempt in range(self.failover_attempts):
-            extra = None
+            extra: dict = {}
             if deadline_ms is not None:
                 left = deadline_ms - (time.monotonic() - t_in) * 1e3
                 if left <= 0:
                     st, bd, hd = _deadline_exceeded_response()
                     return None, st, bd, hd, len(tried)
-                extra = {"X-Request-Deadline-Ms": f"{left:.0f}"}
-            rep, digests = self.pick(affinity_key, exclude=tried)
+                extra["X-Request-Deadline-Ms"] = f"{left:.0f}"
+            rep, digests = self.pick(affinity_key, exclude=tried,
+                                     role="decode")
             if rep is None:
                 break
+            hint = (
+                hint_headers if hint_headers is not None
+                else self._kv_hint(digests, rep)
+            )
+            if hint:
+                extra.update(hint)
             tried.add(rep.rid)
             if prev is not None:
                 self._m_failovers.labels(replica=prev.rid).inc()
@@ -486,9 +649,128 @@ class Router:
                 self.note_failure(rep, why=str(status))
                 return rep, status, rbody, headers, attempt + 1
             self.note_success(rep)
-            self.record_residency(digests, rep.rid)
+            # residency moves with the replica that ACTUALLY served —
+            # failovers and fabric pulls included. The envelope's
+            # kv_digests (when the replica runs the fabric) bridge the
+            # byte-affinity chain to a fetchable token digest and feed
+            # the token-space residency view.
+            toks = self._envelope_kv_digests(rbody)
+            self.record_residency(
+                digests, rep.rid,
+                token_digest=toks[-1] if toks else None,
+            )
+            if toks:
+                self.record_kv_residency(toks, rep.rid)
             return rep, status, rbody, headers, attempt + 1
         return None, last[0], last[1], last[2], len(tried)
+
+    # -- prefill->decode handoff (the disaggregated dispatch) ---------------
+    def handoff_topology(self) -> bool:
+        """True when the fleet can disaggregate RIGHT NOW: at least one
+        dispatchable prefill-class replica and one non-prefill one."""
+        return bool(
+            self.fabric
+            and self._candidates((), role="prefill")
+            and any(
+                r.replica_class != "prefill"
+                for r in self._candidates((), role="decode")
+            )
+        )
+
+    def maybe_handoff(self, path: str, body: bytes, affinity_key: str,
+                      rid: str,
+                      deadline_ms: Optional[float] = None) -> Optional[dict]:
+        """Phase 1 of the disaggregated dispatch, when it applies: send
+        the request to a prefill-class replica with X-KV-Prefill-Only
+        (it prefills, shadows, flushes, answers with the prefix's chain
+        digests), and return the X-KV-Transfer-* headers phase 2 hands
+        to a decode-class replica. None = dispatch normally: not a
+        disaggregated topology, prompt too short, prefix already
+        resident somewhere (an affinity/fabric hit is strictly better
+        than recomputing it on the prefill tier), phase 1 failed (dead
+        or overloaded prefill replica), or the replica reported no
+        digests. Handoff failure is ALWAYS a degrade, never an error."""
+        if (
+            not self.fabric or not affinity_key
+            or len(affinity_key.encode("utf-8", "ignore"))
+            < self.handoff_min_bytes
+        ):
+            return None
+        if deadline_ms is not None and deadline_ms <= 0:
+            return None
+        digests = (
+            chunk_digests(affinity_key, self.affinity_chunk,
+                          AFFINITY_MAX_CHUNKS)
+            if self.affinity_chunk >= 1 else []
+        )
+        if digests:
+            with self._res_lock:
+                ent = self._residency.get(digests[-1])
+            if ent is not None and ent[1] is not None:
+                # deepest chain already resident with a fetchable name:
+                # the ordinary dispatch's per-pick hint serves it
+                return None
+        pre = self._candidates((), role="prefill")
+        if not pre or not any(
+            r.replica_class != "prefill"
+            for r in self._candidates((), role="decode")
+        ):
+            return None
+        rep = min(pre, key=lambda r: (r.outstanding, r.rid))
+        extra = {"X-KV-Prefill-Only": "1"}
+        if deadline_ms is not None:
+            extra["X-Request-Deadline-Ms"] = f"{deadline_ms:.0f}"
+        self._begin(rep)
+        try:
+            status, rbody, _hdrs = self._proxy(
+                rep, path, body, rid, extra_headers=extra
+            )
+        except (urllib.error.URLError, OSError,
+                http.client.HTTPException) as e:
+            self.note_failure(rep, why=f"handoff_prefill: {e}")
+            self._m_handoffs.labels(outcome="prefill_failed").inc()
+            return None
+        finally:
+            self._end(rep)
+        self._m_requests.labels(replica=rep.rid, code=str(status)).inc()
+        if status != 200:
+            # busy/draining/erroring prefill tier: the token-loop
+            # dispatch serves the request whole, like a mixed fleet
+            if status in (429, 503):
+                ra = parse_retry_after(_hdrs.get("Retry-After"))
+                with rep.lock:
+                    rep.cooldown_until = time.monotonic() + (
+                        ra if ra is not None else float(RETRY_AFTER_S)
+                    )
+            self._m_handoffs.labels(outcome="prefill_failed").inc()
+            return None
+        self.note_success(rep)
+        toks = self._envelope_kv_digests(rbody)
+        if not toks:
+            # fabric off upstream (config drift) or a prompt with no
+            # full block: nothing fetchable, dispatch normally
+            self._m_handoffs.labels(outcome="no_digests").inc()
+            return None
+        self.record_kv_residency(toks, rep.rid)
+        if digests:
+            self.record_residency(digests, rep.rid, token_digest=toks[-1])
+        log.info("handoff_prefilled", request_id=rid, replica=rep.rid,
+                 digest=toks[-1])
+        return {
+            "X-KV-Transfer-Peer": rep.url,
+            "X-KV-Transfer-Digest": toks[-1],
+        }
+
+    def note_handoff_outcome(self, payload):
+        """Score a completed phase 2 off its envelope: did the decode
+        replica import the chain, or re-prefill locally (peer died
+        mid-fetch, digest evicted, pool full)?"""
+        imported = (
+            isinstance(payload, dict) and payload.get("kv_fabric_blocks")
+        )
+        self._m_handoffs.labels(
+            outcome="handoff" if imported else "cold_fallback"
+        ).inc()
 
     # -- aggregate views -----------------------------------------------------
     def replica_health(self, rep: Replica) -> dict:
@@ -501,7 +783,27 @@ class Router:
                 entry["reachable"] = True
         except (urllib.error.URLError, OSError, ValueError):
             entry["reachable"] = False
+            return entry
+        h = entry.get("health") or {}
+        # class + residency discovery off the same poll: URL-joined
+        # replicas specialize via their own --replica-class, and the
+        # kv.resident_digests bootstrap lets the router steer fabric
+        # pulls at a replica it has never routed traffic to
+        cls = h.get("replica_class")
+        if cls in ("prefill", "decode", "mixed"):
+            rep.replica_class = cls
+        kv = h.get("kv") or {}
+        self.record_kv_residency(
+            kv.get("resident_digests") or [], rep.rid, bootstrap=True
+        )
         return entry
+
+    def discover(self):
+        """One /health sweep (class + residency bootstrap), best-effort.
+        The CLI runs it at startup; /health aggregation repeats it on
+        every poll."""
+        for rep in self.replicas:
+            self.replica_health(rep)
 
     def health(self) -> dict:
         replicas = {r.rid: self.replica_health(r) for r in self.replicas}
@@ -531,6 +833,9 @@ class Router:
         return {
             "replicas": {r.rid: r.snapshot() for r in self.replicas},
             "residency_entries": self.residency_entries(),
+            "kv_residency_entries": self.kv_residency_entries(),
+            "fabric": self.fabric,
+            "disaggregated": self.handoff_topology(),
             "rolling_restart": rolling,
         }
 
@@ -823,14 +1128,26 @@ def make_router_handler(router: Router):
                 self._send(400, {"error": "invalid JSON body"})
                 return
             deadline_ms = _deadline_ms(data, self.headers)
-            if data.get("stream") is True or data.get("stream") == "true":
-                self._stream(path, body, _affinity_key(data),
-                             deadline_ms=deadline_ms)
-                return
+            affinity_key = _affinity_key(data)
             t0 = time.perf_counter()
-            rep, status, rbody, headers, attempts = router.dispatch(
-                path, body, _affinity_key(data), self._rid,
+            # disaggregated dispatch: phase 1 (prefill-only on a
+            # prefill-class replica) runs BEFORE the stream split, so
+            # streamed requests hand off transparently too — the client
+            # sees one stream, served by the decode replica. Phase 1's
+            # wall time burns the request's own deadline budget.
+            hint = router.maybe_handoff(
+                path, body, affinity_key, self._rid,
                 deadline_ms=deadline_ms,
+            )
+            if deadline_ms is not None:
+                deadline_ms -= (time.perf_counter() - t0) * 1e3
+            if data.get("stream") is True or data.get("stream") == "true":
+                self._stream(path, body, affinity_key,
+                             deadline_ms=deadline_ms, hint_headers=hint)
+                return
+            rep, status, rbody, headers, attempts = router.dispatch(
+                path, body, affinity_key, self._rid,
+                deadline_ms=deadline_ms, hint_headers=hint,
             )
             fwd = {
                 k: v for k, v in headers.items() if k == "Retry-After"
@@ -840,6 +1157,8 @@ def make_router_handler(router: Router):
             except (ValueError, json.JSONDecodeError):
                 self._send(status, rbody, headers=fwd)
                 return
+            if hint is not None and status == 200:
+                router.note_handoff_outcome(payload)
             if isinstance(payload, dict):
                 # fold the router hop into the envelope's contiguous span
                 # model: router_s = wall time here minus the replica's own
@@ -858,11 +1177,14 @@ def make_router_handler(router: Router):
             self._send(status, payload, headers=fwd)
 
         def _stream(self, path: str, body: bytes, affinity_key: str,
-                    deadline_ms: Optional[float] = None):
+                    deadline_ms: Optional[float] = None,
+                    hint_headers: Optional[dict] = None):
             """Streamed requests: failover ONLY before the upstream
             stream opens; after the first forwarded byte the request is
             bound to its replica (re-dispatching would replay partial
-            output — client.py's own stream-retry rule)."""
+            output — client.py's own stream-retry rule). hint_headers
+            carry a handoff's phase-2 fabric hint; without one, each
+            attempt derives its own from the residency view."""
             t_in = time.monotonic()
             tried: set = set()
             prev = None
@@ -876,9 +1198,21 @@ def make_router_handler(router: Router):
                         self._send(st, json.loads(bd))
                         return
                     hdrs["X-Request-Deadline-Ms"] = f"{left:.0f}"
-                rep, digests = router.pick(affinity_key, exclude=tried)
+                rep, digests = router.pick(affinity_key, exclude=tried,
+                                           role="decode")
                 if rep is None:
                     break
+                hint = (
+                    hint_headers if hint_headers is not None
+                    else router._kv_hint(digests, rep)
+                )
+                if hint:
+                    hdrs.update(hint)
+                    if hint_headers is not None:
+                        # phase-2 envelope is NDJSON/SSE the router never
+                        # parses: count the handoff by its own outcome
+                        router._m_handoffs.labels(outcome="stream").inc()
+                        hint_headers = None  # once per request
                 tried.add(rep.rid)
                 if prev is not None:
                     router._m_failovers.labels(replica=prev.rid).inc()
@@ -1032,10 +1366,15 @@ def _free_port(host: str = "127.0.0.1") -> int:
 
 
 def spawn_replicas(n: int, spawn_args, host: str = "127.0.0.1",
-                   ready_deadline_s: float = 300.0, env=None) -> list:
+                   ready_deadline_s: float = 300.0, env=None,
+                   replica_class: str = "mixed",
+                   name_prefix: str = "r") -> list:
     """Spawn N engine servers as subprocesses on free ports and wait for
     every /ready. Each replica remembers its argv/env so rolling restarts
-    can respawn it identically."""
+    can respawn it identically. replica_class != "mixed" appends
+    --replica-class to every spawn (and tags the router-side Replica), so
+    --spawn-prefill/--spawn-decode build a disaggregated fleet from one
+    argument string."""
     import os
 
     replicas = []
@@ -1046,14 +1385,17 @@ def spawn_replicas(n: int, spawn_args, host: str = "127.0.0.1",
             "distributed_llm_inference_tpu.serving.server",
             "--host", host, "--port", str(port), *spawn_args,
         ]
+        if replica_class != "mixed":
+            argv += ["--replica-class", replica_class]
         spawn_env = dict(os.environ if env is None else env)
         proc = subprocess.Popen(
             argv, env=spawn_env,
             stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
         )
         replicas.append(Replica(
-            f"r{i}", f"http://{host}:{port}", proc=proc, spawn_argv=argv,
-            spawn_env=spawn_env,
+            f"{name_prefix}{i}", f"http://{host}:{port}", proc=proc,
+            spawn_argv=argv, spawn_env=spawn_env,
+            replica_class=replica_class,
         ))
     deadline = time.time() + ready_deadline_s
     for rep in replicas:
@@ -1097,6 +1439,30 @@ def main(argv: Optional[list] = None):
              "and SIGTERM them on router shutdown",
     )
     ap.add_argument(
+        "--spawn-prefill", type=int, default=0, metavar="N",
+        help="spawn N PREFILL-class replicas (--spawn-args plus "
+             "--replica-class prefill): they take fresh long-prompt "
+             "work and hand the finished prefix to a decode-class "
+             "replica by chunk digest over the KV fabric",
+    )
+    ap.add_argument(
+        "--spawn-decode", type=int, default=0, metavar="N",
+        help="spawn N DECODE-class replicas (--spawn-args plus "
+             "--replica-class decode): they run the token loops, "
+             "pulling handed-off prefixes over the KV fabric",
+    )
+    ap.add_argument(
+        "--no-fabric", action="store_true",
+        help="disable KV-fabric hints and prefill->decode handoffs at "
+             "the router (replicas may still serve /kv to each other "
+             "out of band)",
+    )
+    ap.add_argument(
+        "--handoff-min-bytes", type=int, default=192, metavar="BYTES",
+        help="smallest prompt (bytes) worth a two-phase prefill->decode "
+             "handoff; shorter prompts go straight to the decode tier",
+    )
+    ap.add_argument(
         "--spawn-args", default="", metavar="ARGS",
         help="argument string passed to every spawned replica's server "
              "CLI, e.g. \"--model tinyllama-1.1b --continuous 4 --warmup\"",
@@ -1135,11 +1501,24 @@ def main(argv: Optional[list] = None):
         replicas.extend(
             spawn_replicas(args.spawn, shlex.split(args.spawn_args))
         )
+    if args.spawn_prefill > 0:
+        replicas.extend(spawn_replicas(
+            args.spawn_prefill, shlex.split(args.spawn_args),
+            replica_class="prefill", name_prefix="p",
+        ))
+    if args.spawn_decode > 0:
+        replicas.extend(spawn_replicas(
+            args.spawn_decode, shlex.split(args.spawn_args),
+            replica_class="decode", name_prefix="d",
+        ))
     if args.replicas:
         for i, url in enumerate(u for u in args.replicas.split(",") if u):
             replicas.append(Replica(f"u{i}", url.strip()))
     if not replicas:
-        raise SystemExit("router needs --spawn N and/or --replicas URL,URL")
+        raise SystemExit(
+            "router needs --spawn/--spawn-prefill/--spawn-decode N "
+            "and/or --replicas URL,URL"
+        )
     router = Router(
         replicas,
         eject_threshold=args.eject_threshold,
@@ -1150,7 +1529,12 @@ def main(argv: Optional[list] = None):
         request_timeout_s=args.request_timeout,
         drain_deadline_s=args.drain_deadline,
         failover_attempts=args.failover_attempts or None,
+        fabric=not args.no_fabric,
+        handoff_min_bytes=args.handoff_min_bytes,
     )
+    # learn URL-joined replicas' classes + bootstrap digest residency
+    # off one /health sweep (spawned replicas carry their class already)
+    router.discover()
     try:
         RouterServer(router, args.host, args.port).serve_forever()
     finally:

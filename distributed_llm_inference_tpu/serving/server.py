@@ -145,6 +145,8 @@ RETRY_AFTER_S = 2
 
 
 def _route_label(path: str) -> str:
+    if path == "/kv" or path.startswith("/kv/"):
+        return "/kv"  # one label for every digest (bounded cardinality)
     return path if path in _KNOWN_ROUTES else "other"
 
 
@@ -191,8 +193,8 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
         def _send(self, code: int, payload: Any, content_type="application/json",
                   headers=None):
             body = (
-                payload.encode()
-                if isinstance(payload, str)
+                payload if isinstance(payload, bytes)
+                else payload.encode() if isinstance(payload, str)
                 else json.dumps(payload).encode()
             )
             self._count(code)
@@ -241,21 +243,33 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 # readiness is the separate /ready signal (and the `ready`
                 # field here), so an LB can stop routing without the
                 # process being reaped mid-drain.
-                self._send(
-                    200,
-                    {
-                        "status": h["status"],
-                        "ready": ready,
-                        **({"ready_reason": why} if why else {}),
-                        "role": "orchestrator",
-                        "model": h["model"],
-                        "version": __version__,
-                        "backend": h["backend"],
-                        "n_stages": h["n_stages"],
-                        "requests_served": h["requests_served"],
-                        "stats": h["stats"],
-                    },
-                )
+                out = {
+                    "status": h["status"],
+                    "ready": ready,
+                    **({"ready_reason": why} if why else {}),
+                    "role": "orchestrator",
+                    # disaggregation class (--replica-class): the router
+                    # learns prefill/decode/mixed from here, so URL-joined
+                    # replicas specialize without any spawn-time wiring
+                    "replica_class": engine.engine_cfg.replica_class,
+                    "model": h["model"],
+                    "version": __version__,
+                    "backend": h["backend"],
+                    "n_stages": h["n_stages"],
+                    "requests_served": h["requests_served"],
+                    "stats": h["stats"],
+                }
+                if continuous is not None and continuous.fabric_serving:
+                    # residency bootstrap: resident chain digests (MRU
+                    # first, capped) so the router can steer fabric
+                    # pulls at this replica without ever having routed
+                    # traffic to it
+                    out["kv"] = {
+                        "fabric": True,
+                        "block_size": continuous.kv_block_size,
+                        "resident_digests": continuous.fabric_digests(64),
+                    }
+                self._send(200, out)
             elif path == "/ready":
                 # load-balancer readiness probe: 200/503 is the whole
                 # contract (k8s readinessProbe-friendly)
@@ -300,6 +314,30 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 self._send(
                     200, oai.models_response(engine.cfg.name, started_at)
                 )
+            elif path.startswith("/kv/"):
+                # the KV fabric's serving half (serving/kv_fabric.py):
+                # the resident shadow chain ending at this chunk digest,
+                # wire-encoded. A miss — unknown digest, LRU-evicted, or
+                # fabric disabled — is a 404 the fetching peer treats as
+                # "prefill locally", never an error.
+                digest = path[len("/kv/"):]
+                chain = (
+                    continuous.fabric_chain(digest)
+                    if continuous is not None else None
+                )
+                if chain is None:
+                    self._send(404, {
+                        "error": f"no resident chain for digest "
+                                 f"{digest[:64]!r}",
+                    })
+                else:
+                    self._send(
+                        200, chain,
+                        content_type="application/octet-stream",
+                        headers={
+                            "X-KV-Block-Size": str(continuous.kv_block_size),
+                        },
+                    )
             else:
                 self._send(404, {"error": f"no route {path}"})
 
@@ -331,6 +369,26 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             except (ValueError, json.JSONDecodeError):
                 self._send(400, {"error": "invalid JSON body"})
                 return None
+
+        def _kv_headers(self) -> tuple:
+            """(kv_hint, prefill_only) — the router's disaggregation
+            headers. X-KV-Transfer-Peer + X-KV-Transfer-Digest name where
+            this prompt's prefix chain is resident (the engine pulls it
+            over the fabric at admission); X-KV-Prefill-Only marks phase
+            1 of a prefill->decode handoff (prefill + shadow-flush, one
+            token, never streamed). Both are no-ops without
+            --continuous."""
+            peer = self.headers.get("X-KV-Transfer-Peer")
+            digest = self.headers.get("X-KV-Transfer-Digest")
+            hint = (
+                {"peer": peer, "digest": digest}
+                if continuous is not None and peer and digest else None
+            )
+            prefill_only = (
+                continuous is not None
+                and self.headers.get("X-KV-Prefill-Only") in ("1", "true")
+            )
+            return hint, prefill_only
 
         # -- OpenAI-compatible surface (serving/openai_api.py) -----------
 
@@ -415,6 +473,15 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     except (TypeError, ValueError):
                         pass
                 kwargs["request_id"] = self._rid
+                kv_hint, prefill_only = self._kv_headers()
+                if kv_hint is not None:
+                    kwargs["kv_hint"] = kv_hint
+                if prefill_only:
+                    # handoff phase 1 (see /generate): never streamed —
+                    # the decode-class replica streams phase 2, so SSE
+                    # clients see one transparent stream either way
+                    kwargs["prefill_only"] = True
+                    meta["stream"] = False
                 if meta.get("echo_score"):
                     # echo + logprobs + max_tokens=0: teacher-forced
                     # scoring of the prompt itself (lm-eval pattern)
@@ -478,12 +545,21 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 return
             prompt_once = meta.get("n", 1) > 1
             build = oai.chat_response if chat else oai.completion_response
+            # KV-fabric fields ride the OpenAI envelope as extension
+            # keys (clients ignore unknown fields): the router learns
+            # residency / scores handoffs identically on every route
+            kv_extra = {
+                k: envelope[k]
+                for k in ("kv_digests", "kv_fabric_blocks", "prefill_only")
+                if isinstance(envelope, dict) and k in envelope
+            }
             self._send(
                 200,
                 build(entries, engine.cfg.name, kwargs,
                       prompt_once=prompt_once,
                       request_id=envelope.get("request_id", self._rid),
-                      timings=envelope.get("timings")),
+                      timings=envelope.get("timings"),
+                      kv_extra=kv_extra or None),
             )
 
         def do_POST(self):
@@ -635,7 +711,19 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     ):
                         raise ValueError("stop must be a string or list of strings")
                     kwargs["stop"] = raw_stop
-                if _parse_bool(data.get("stream", False), "stream"):
+                kv_hint, prefill_only = self._kv_headers()
+                if kv_hint is not None:
+                    kwargs["kv_hint"] = kv_hint
+                if prefill_only:
+                    # handoff phase 1: prefill + shadow flush + one
+                    # token; the router discards the token and hands the
+                    # prefix digest to a decode-class replica — so the
+                    # body's stream flag is ignored here (the STREAM
+                    # happens on the decode replica, transparently)
+                    kwargs["prefill_only"] = True
+                if not prefill_only and _parse_bool(
+                    data.get("stream", False), "stream"
+                ):
                     # NDJSON token streaming: one {"delta": ...} line per
                     # decode chunk, final line = the standard envelope with
                     # "done": true. Requires --continuous (the solo engine
@@ -1091,6 +1179,27 @@ def main(argv: Optional[list] = None):
              "WARM prefix cache (needs --prefix-cache > 0)",
     )
     ap.add_argument(
+        "--replica-class", default="mixed",
+        choices=["mixed", "prefill", "decode"],
+        help="disaggregation class for the router tier (serving/"
+             "router.py): 'prefill' replicas take fresh long-prompt work "
+             "and hand the finished prefix to a 'decode' replica by "
+             "chunk digest over the KV fabric; 'mixed' (default) serves "
+             "everything. Engine behavior is identical — this labels "
+             "/health and the dli_kv_fabric_* metrics' role",
+    )
+    ap.add_argument(
+        "--no-kv-fabric", action="store_true",
+        help="disable the cross-replica KV fabric (the GET /kv/{digest} "
+             "surface and X-KV-Transfer-* fetch hints); the shadow "
+             "store stays purely local (crash recovery / --restore-dir)",
+    )
+    ap.add_argument(
+        "--kv-fabric-timeout", type=float, default=5.0, metavar="SECONDS",
+        help="hard deadline on one fabric fetch; a dead or wedged peer "
+             "costs at most this long before admission prefills locally",
+    )
+    ap.add_argument(
         "--no-kv-shadow", action="store_true",
         help="disable the warm-recovery shadow store (supervisor "
              "restarts and --restore-dir starts then recover cold, "
@@ -1253,6 +1362,9 @@ def main(argv: Optional[list] = None):
             request_deadline_s=args.deadline,
             prefix_cache_entries=args.prefix_cache,
             kv_shadow=not args.no_kv_shadow,
+            kv_fabric=not args.no_kv_fabric,
+            kv_fabric_timeout_s=args.kv_fabric_timeout,
+            replica_class=args.replica_class,
         ),
         microbatches=args.microbatches,
         params=params,

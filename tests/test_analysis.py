@@ -576,6 +576,28 @@ def test_router_tier_decode_unreachable(real_reachable):
     assert not retry_funcs, retry_funcs
 
 
+def test_kv_fabric_decode_unreachable(real_reachable):
+    """The cross-replica KV fabric (serving/kv_fabric.py) is strictly
+    host-side: blocking urllib fetches with deadlines, npz codec work,
+    digest recomputation. None of it — and none of the continuous
+    engine's fetch/import drivers — may be reachable from a jit root:
+    fabric fetches happen ONLY at the admission host boundary, and the
+    only device work they trigger is the pre-existing pre-warmed
+    restore_shadow_blocks scatter, as its own jit root. Same pin as the
+    router tier and utils/faults.py."""
+    fabric_funcs = sorted(
+        k for k in real_reachable if k[0] == "serving.kv_fabric"
+    )
+    assert not fabric_funcs, fabric_funcs
+    for key in [
+        ("engine.continuous", "ContinuousEngine._fabric_prefetch"),
+        ("engine.continuous", "ContinuousEngine._import_fabric_chain"),
+        ("engine.continuous", "ContinuousEngine.fabric_chain"),
+        ("engine.continuous", "ContinuousEngine.fabric_digests"),
+    ]:
+        assert key not in real_reachable, key
+
+
 def test_repo_is_clean():
     """The package itself lints clean — the same gate CI runs."""
     diags, _ = run_lint(PKG_ROOT)

@@ -1,0 +1,622 @@
+"""KV fabric + prefill/decode disaggregation suite (serving/kv_fabric.py,
+the /kv surface in serving/server.py, the continuous engine's remote-hit
+path, and the router's two-phase handoff).
+
+Layers:
+  * wire-format units: encode/decode round trip, the content-key recheck
+    (tampered tokens, wrong digest, truncation, block-size drift all
+    REJECT — cold prefill, never wrong KV);
+  * shadow digest index units (engine/shadow.py): O(1) digest lookups,
+    chain export ordering, eviction hygiene;
+  * engine-level remote hits over real HTTP: a replica that misses a
+    prefix pulls the chain from the resident peer and its greedy output
+    is bit-identical to a local cold run — plus every rung of the
+    fallback ladder (dead peer, wedged peer under the fetch deadline,
+    corrupt payload) degrading to that same cold-run output;
+  * router units: residency purge on ejection, the byte->token digest
+    bridge that steers fabric pulls;
+  * full-stack disaggregation (chaos, real subprocess replicas): fresh
+    long-prompt work prefilled on the prefill-class replica, decoded on
+    the decode-class one after a fabric pull — greedy bit-identical to
+    single-replica serving, streaming included, and kill -9 of the
+    prefill replica mid-handoff degrades to a local re-prefill with the
+    SAME bytes out.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from distributed_llm_inference_tpu import create_engine
+from distributed_llm_inference_tpu.config import EngineConfig
+from distributed_llm_inference_tpu.engine.continuous import ContinuousEngine
+from distributed_llm_inference_tpu.engine.shadow import ShadowStore
+from distributed_llm_inference_tpu.serving import kv_fabric as KF
+from distributed_llm_inference_tpu.serving.router import (
+    EJECTED,
+    Replica,
+    Router,
+    RouterServer,
+    spawn_replicas,
+)
+from distributed_llm_inference_tpu.serving.server import InferenceServer
+
+BS = 16  # kv block size everywhere in this file
+
+
+# -- wire-format units --------------------------------------------------------
+
+class _E:
+    """Minimal stand-in for a ShadowStore entry (.leaves contract)."""
+
+    def __init__(self, leaves):
+        self.leaves = leaves
+
+
+def _chain(n_blocks: int, bs: int = 4, base: int = 1):
+    ids = [(base + i) % 250 + 1 for i in range(n_blocks * bs)]
+    keys = [tuple(ids[: (i + 1) * bs]) for i in range(n_blocks)]
+    entries = [
+        _E([
+            np.full((2, 3), i, np.float32),
+            (np.arange(6, dtype=np.int8) + i).reshape(2, 3),
+        ])
+        for i in range(n_blocks)
+    ]
+    return ids, keys, entries
+
+
+def test_wire_roundtrip():
+    ids, keys, entries = _chain(3)
+    data = KF.encode_chain(4, keys, entries)
+    digest = KF.chain_digest(ids, 4)
+    keys2, per_block = KF.decode_chain(data, 4, digest)
+    assert keys2 == keys
+    assert len(per_block) == 3
+    for i in range(3):
+        for j in range(2):
+            np.testing.assert_array_equal(
+                per_block[i][j], entries[i].leaves[j]
+            )
+            assert per_block[i][j].dtype == entries[i].leaves[j].dtype
+
+
+def test_wire_rejects_wrong_digest():
+    ids, keys, entries = _chain(3)
+    data = KF.encode_chain(4, keys, entries)
+    other = KF.chain_digest([9] * 12, 4)
+    with pytest.raises(KF.FabricPayloadError, match="content-key recheck"):
+        KF.decode_chain(data, 4, other)
+
+
+def test_wire_rejects_tampered_tokens():
+    """A peer answering with a DIFFERENT prefix under the requested
+    digest (bitrot, a buggy peer, an impostor) fails the recheck."""
+    ids, keys, entries = _chain(3)
+    digest = KF.chain_digest(ids, 4)
+    ids2 = list(ids)
+    ids2[5] = (ids2[5] % 250) + 1  # one token off
+    keys2 = [tuple(ids2[: (i + 1) * 4]) for i in range(3)]
+    data = KF.encode_chain(4, keys2, entries)
+    with pytest.raises(KF.FabricPayloadError, match="content-key recheck"):
+        KF.decode_chain(data, 4, digest)
+
+
+def test_wire_rejects_block_size_drift_and_garbage():
+    ids, keys, entries = _chain(2)
+    data = KF.encode_chain(4, keys, entries)
+    with pytest.raises(KF.FabricPayloadError, match="block_size"):
+        KF.decode_chain(data, 8, KF.chain_digest(ids, 4))
+    with pytest.raises(KF.FabricPayloadError):
+        KF.decode_chain(data[: len(data) // 2], 4, KF.chain_digest(ids, 4))
+    with pytest.raises(KF.FabricPayloadError):
+        KF.decode_chain(b"not an npz at all", 4, "ab12")
+
+
+def test_valid_digest_gate():
+    assert KF.valid_digest("0123abcdef")
+    assert not KF.valid_digest("")
+    assert not KF.valid_digest("../etc/passwd")
+    assert not KF.valid_digest("A" * 20)  # uppercase never emitted
+    assert not KF.valid_digest("a" * 65)
+
+
+# -- shadow digest index units -----------------------------------------------
+
+def test_shadow_digest_index_and_chain_export():
+    st = ShadowStore(4, max_blocks=16)
+    try:
+        ids, keys, entries = _chain(4)
+        st.put_host(keys, [e.leaves for e in entries], seq=7)
+        digests = st.resident_digests()
+        assert len(digests) == 4
+        deep = st.digest_of(keys[-1])
+        assert deep in digests
+        got = st.chain_for_digest(deep)
+        assert got is not None
+        got_keys, got_entries = got
+        assert got_keys == keys  # parents first
+        np.testing.assert_array_equal(
+            got_entries[2].leaves[0], entries[2].leaves[0]
+        )
+        # O(1) misses: unknown digest and structurally-invalid digest
+        assert st.chain_for_digest("deadbeef00") is None
+        # wire round trip straight off the store (the /kv body)
+        data = KF.serve_chain(st, deep)
+        assert data is not None
+        keys2, _ = KF.decode_chain(data, 4, deep)
+        assert keys2 == keys
+        assert KF.serve_chain(st, "deadbeef00") is None
+        assert KF.serve_chain(st, "../escape") is None
+    finally:
+        st.close()
+
+
+def test_shadow_digest_index_tracks_eviction_and_clear():
+    st = ShadowStore(4, max_blocks=4)
+    try:
+        _, keys_a, entries_a = _chain(4, base=1)
+        st.put_host(keys_a, [e.leaves for e in entries_a], seq=0)
+        deep_a = st.digest_of(keys_a[-1])
+        assert st.chain_for_digest(deep_a) is not None
+        # a second chain LRU-evicts the first; its digests must go too
+        _, keys_b, entries_b = _chain(4, base=101)
+        st.put_host(keys_b, [e.leaves for e in entries_b], seq=1)
+        assert st.chain_for_digest(deep_a) is None
+        assert st.chain_for_digest(st.digest_of(keys_b[-1])) is not None
+        st.clear()
+        assert st.resident_digests() == []
+    finally:
+        st.close()
+
+
+# -- engine-level remote hits over real HTTP ---------------------------------
+
+# >= 6 full 16-token blocks under the byte tokenizer, well inside the
+# tiny model's 128-token window with max_tokens 10
+PROMPT_A = "shared fabric preamble " * 4 + "tail one"
+assert 96 <= len(PROMPT_A) <= 112
+
+GEN = dict(max_tokens=10, greedy=True, chat=False)
+
+
+def _mk_replica(cls, timeout_s=5.0, **cfg_kw):
+    eng = create_engine(
+        "test-llama-tiny",
+        engine_cfg=EngineConfig(
+            prefix_cache_entries=8, replica_class=cls,
+            kv_fabric_timeout_s=timeout_s, **cfg_kw,
+        ),
+    )
+    cont = ContinuousEngine(
+        eng, n_slots=2, chunk_steps=4,
+        kv_pool_blocks=48, kv_block_size=BS,
+    )
+    srv = InferenceServer(eng, "127.0.0.1", 0, max_tokens_cap=64,
+                          continuous=cont)
+    srv.start()
+    return eng, cont, srv, f"http://127.0.0.1:{srv.port}"
+
+
+@pytest.fixture(scope="module")
+def ref_engine():
+    return create_engine("test-llama-tiny")
+
+
+@pytest.fixture(scope="module")
+def holder():
+    """Replica A: serves PROMPT_A once so its chain is shadow-resident,
+    then acts as the fabric peer for every fetch test."""
+    eng, cont, srv, url = _mk_replica("prefill")
+    out = cont.submit(PROMPT_A, **GEN)
+    assert out["status"] == "success"
+    assert cont._shadow.flush(10.0)
+    yield eng, cont, srv, url, out
+    srv.shutdown()
+
+
+def test_kv_http_roundtrip_and_404(holder):
+    _, cont, _, url, out = holder
+    digest = out["kv_digests"][-1]
+    with urllib.request.urlopen(f"{url}/kv/{digest}", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"] == "application/octet-stream"
+        assert int(r.headers["X-KV-Block-Size"]) == BS
+        data = r.read()
+    keys, per_block = KF.decode_chain(data, BS, digest)
+    assert len(keys) == len(out["kv_digests"]) >= 6
+    assert len(per_block) == len(keys)
+    # digest miss -> 404 (the fetcher's "prefill locally" signal)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{url}/kv/{'0' * 20}", timeout=10)
+    assert ei.value.code == 404
+    # /health exposes the residency bootstrap surface
+    with urllib.request.urlopen(f"{url}/health", timeout=10) as r:
+        h = json.loads(r.read())
+    assert h["replica_class"] == "prefill"
+    assert digest in h["kv"]["resident_digests"]
+    assert h["kv"]["block_size"] == BS
+
+
+def test_remote_hit_bit_identical_to_local_cold(holder, ref_engine):
+    """THE fabric acceptance property: a replica that has never seen
+    PROMPT_A pulls the chain from the holder and produces byte-identical
+    greedy output to a cold local run — and actually reused the prefix
+    (imported blocks, exact-depth block-prefix hit, one fabric hit)."""
+    _, _, _, peer_url, out = holder
+    ref = ref_engine.generate(PROMPT_A, **GEN)
+    _, cont_b, srv_b, _ = _mk_replica("decode")
+    try:
+        got = cont_b.submit(
+            PROMPT_A, **GEN,
+            kv_hint={"peer": peer_url, "digest": out["kv_digests"][-1]},
+        )
+        assert got["status"] == "success"
+        assert got["response"] == ref["response"]
+        assert got["tokens_generated"] == ref["tokens_generated"]
+        assert got["kv_fabric_blocks"] >= 6
+        assert got["prefix_cached_tokens"] >= 6 * BS
+        st = cont_b.stats()["kv_fabric"]
+        assert st["role"] == "decode"
+        assert (st["fetches"], st["hits"], st["misses"]) == (1, 1, 0)
+        assert st["bytes"] > 0
+        # the fetched chain is onward-servable: B now answers /kv too
+        assert out["kv_digests"][-1] in cont_b.fabric_digests()
+    finally:
+        srv_b.shutdown()
+
+
+def test_dead_peer_degrades_to_cold_bit_identical(holder, ref_engine):
+    _, _, _, _, out = holder
+    ref = ref_engine.generate(PROMPT_A, **GEN)
+    dead = f"http://127.0.0.1:{_free_port()}"  # nothing listens here
+    _, cont_b, srv_b, _ = _mk_replica("decode", timeout_s=2.0)
+    try:
+        got = cont_b.submit(
+            PROMPT_A, **GEN,
+            kv_hint={"peer": dead, "digest": out["kv_digests"][-1]},
+        )
+        assert got["status"] == "success"
+        assert got["response"] == ref["response"]
+        assert "kv_fabric_blocks" not in got
+        st = cont_b.stats()["kv_fabric"]
+        assert (st["fetches"], st["hits"], st["misses"]) == (1, 0, 1)
+    finally:
+        srv_b.shutdown()
+
+
+def test_wedged_peer_times_out_inside_deadline(holder, ref_engine):
+    """A peer that ACCEPTS but never answers (wedged runtime) costs at
+    most kv_fabric_timeout_s, then admission prefills locally — the
+    deadline'd rung of the fallback ladder."""
+    _, _, _, _, out = holder
+    ref = ref_engine.generate(PROMPT_A, **GEN)
+    wedge = socket.socket()
+    wedge.bind(("127.0.0.1", 0))
+    wedge.listen(4)
+    wedge_url = f"http://127.0.0.1:{wedge.getsockname()[1]}"
+    _, cont_b, srv_b, _ = _mk_replica("decode", timeout_s=0.5)
+    try:
+        t0 = time.perf_counter()
+        got = cont_b.submit(
+            PROMPT_A, **GEN,
+            kv_hint={"peer": wedge_url, "digest": out["kv_digests"][-1]},
+        )
+        elapsed = time.perf_counter() - t0
+        assert got["status"] == "success"
+        assert got["response"] == ref["response"]
+        assert "kv_fabric_blocks" not in got
+        st = cont_b.stats()["kv_fabric"]
+        assert st["misses"] == 1
+        # 0.5s fetch deadline + the request's own work; generous bound
+        # so slow CI never flakes, but a hung fetch (no deadline) would
+        # blow way past it
+        assert elapsed < 30.0
+    finally:
+        srv_b.shutdown()
+        wedge.close()
+
+
+def test_corrupt_payload_rejected_then_cold(holder, ref_engine):
+    """A peer serving garbage under a valid digest fails the content-key
+    recheck client-side; the request still completes cold."""
+    _, _, _, _, out = holder
+    ref = ref_engine.generate(PROMPT_A, **GEN)
+
+    class Garbage(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            body = b"\x00garbage, definitely not an npz"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Garbage)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    _, cont_b, srv_b, _ = _mk_replica("decode", timeout_s=2.0)
+    try:
+        got = cont_b.submit(
+            PROMPT_A, **GEN,
+            kv_hint={
+                "peer": f"http://127.0.0.1:{httpd.server_address[1]}",
+                "digest": out["kv_digests"][-1],
+            },
+        )
+        assert got["status"] == "success"
+        assert got["response"] == ref["response"]
+        assert "kv_fabric_blocks" not in got
+        assert cont_b.stats()["kv_fabric"]["misses"] == 1
+    finally:
+        srv_b.shutdown()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- router units ------------------------------------------------------------
+
+def _stub_router(n=2, **kw):
+    kw.setdefault("probe_interval_s", 3600.0)
+    kw.setdefault("eject_threshold", 3)
+    reps = [
+        Replica(f"r{i}", f"http://127.0.0.1:{9000 + i}") for i in range(n)
+    ]
+    return Router(reps, **kw), reps
+
+
+def test_residency_purged_on_ejection():
+    """Satellite: digests pointing at an EJECTED replica must not
+    survive the ejection and steer traffic (or fabric pulls) at a
+    corpse."""
+    router, (r0, r1) = _stub_router()
+    router.record_residency(["b1", "b2"], "r0", token_digest="t0deep")
+    router.record_residency(["b3"], "r1", token_digest="t1deep")
+    router.record_kv_residency(["t0deep", "t0mid"], "r0")
+    router.record_kv_residency(["t1deep"], "r1")
+    assert router.residency_entries() == 3
+    assert router.kv_residency_entries() == 3
+    for _ in range(3):
+        router.note_failure(r0, why="test")
+    assert r0.state == EJECTED
+    assert router.residency_entries() == 1  # only r1's byte entry
+    assert router.kv_residency_entries() == 1
+    # and the survivor's entries still route
+    rep, _ = router.pick("x")
+    assert rep is r1
+
+
+def test_kv_hint_bridges_bytes_to_token_digest():
+    router, (r0, r1) = _stub_router()
+    key = "shared preamble " * 8
+    import distributed_llm_inference_tpu.engine.block_prefix as BP
+
+    digests = BP.chunk_digests(key, router.affinity_chunk, 32)
+    router.record_residency(digests, "r0", token_digest="feedbead01")
+    # dispatching to the holder needs no hint
+    assert router._kv_hint(digests, r0) is None
+    hint = router._kv_hint(digests, r1)
+    assert hint == {
+        "X-KV-Transfer-Peer": r0.url,
+        "X-KV-Transfer-Digest": "feedbead01",
+    }
+    # a same-replica re-serve without digests keeps the token bridge
+    router.record_residency(digests, "r0")
+    assert router._kv_hint(digests, r1) is not None
+    # a failover to r1 moves residency and drops the stale bridge
+    router.record_residency(digests, "r1")
+    assert router._kv_hint(digests, r0) is None
+
+
+def test_candidate_roles_prefer_specialization_not_availability():
+    router, reps = _stub_router(3)
+    reps[0].replica_class = "prefill"
+    reps[1].replica_class = "decode"
+    reps[2].replica_class = "mixed"
+    decode = router._candidates((), role="decode")
+    assert reps[0] not in decode and set(decode) == {reps[1], reps[2]}
+    prefill = router._candidates((), role="prefill")
+    assert prefill == [reps[0]]
+    assert router.handoff_topology()
+    # availability beats specialization: with every non-prefill replica
+    # gone, the token loop falls back to the prefill tier
+    for r in (reps[1], reps[2]):
+        for _ in range(3):
+            router.note_failure(r, why="test")
+    assert router._candidates((), role="decode") == [reps[0]]
+    assert not router.handoff_topology()
+
+
+# -- full-stack disaggregation (real subprocess replicas) --------------------
+
+FLEET_ARGS = [
+    "--model", "test-llama-tiny", "--continuous", "2",
+    "--continuous-chunk", "4", "--kv-pool-blocks", "48",
+    "--kv-block-size", str(BS), "--prefix-cache", "8",
+    "--max-tokens-cap", "64",
+]
+
+
+def _spawn_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("DLI_FAULTS", None)
+    return env
+
+
+@pytest.fixture(scope="module")
+def disagg_fleet():
+    """1 prefill-class + 1 decode-class REAL engine server behind an
+    in-process router — the two-class topology from the README."""
+    pre = spawn_replicas(1, FLEET_ARGS, env=_spawn_env(),
+                         replica_class="prefill", name_prefix="p")[0]
+    dec = spawn_replicas(1, FLEET_ARGS, env=_spawn_env(),
+                         replica_class="decode", name_prefix="d")[0]
+    router = Router(
+        [pre, dec], eject_threshold=3, probe_interval_s=0.25,
+        probe_timeout_s=2.0, request_timeout_s=120.0,
+        handoff_min_bytes=64,
+    )
+    server = RouterServer(router, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        yield router, server, f"http://127.0.0.1:{server.port}", pre, dec
+    finally:
+        server.shutdown()
+        for rep in (pre, dec):
+            if rep.proc is not None:
+                try:
+                    rep.proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    rep.proc.kill()
+
+
+def _post(base, payload, path="/generate", timeout=120, headers=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _handoffs(router, outcome):
+    return router.metrics.get(
+        "dli_router_handoffs_total"
+    ).labels(outcome=outcome).value
+
+
+PROMPT_HANDOFF = "fresh disaggregated workload " * 3 + "alpha"
+PROMPT_STREAMED = "streamed disaggregated workload " * 3
+
+
+@pytest.mark.chaos
+def test_prefill_decode_handoff_bit_exact(disagg_fleet, ref_engine):
+    """Fresh long-prompt work: phase 1 prefills on the prefill-class
+    replica, phase 2 decodes on the decode-class one after a fabric
+    pull — greedy output bit-identical to serving the whole request on
+    one replica."""
+    router, _, base, pre, dec = disagg_fleet
+    ref = ref_engine.generate(PROMPT_HANDOFF, **GEN)
+    code, body, _ = _post(base, {"prompt": PROMPT_HANDOFF, **GEN})
+    assert code == 200 and body["status"] == "success", body
+    assert body["replica"] == "d0"  # the token loop ran on the decode tier
+    assert body.get("kv_fabric_blocks", 0) >= 5, body
+    assert body["response"] == ref["response"]
+    assert body["tokens_generated"] == ref["tokens_generated"]
+    assert _handoffs(router, "handoff") >= 1
+    # residency learned in both spaces, naming the replica that SERVED
+    assert router.kv_residency_entries() > 0
+    # a repeat of the same prompt skips the handoff (prefix resident,
+    # deep byte hit) and lands straight on the decode replica warm
+    before = _handoffs(router, "handoff")
+    code, body2, _ = _post(base, {"prompt": PROMPT_HANDOFF, **GEN})
+    assert code == 200 and body2["replica"] == "d0"
+    assert body2["response"] == ref["response"]
+    assert body2.get("prefix_cached_tokens", 0) >= 5 * BS
+    assert _handoffs(router, "handoff") == before
+
+
+@pytest.mark.chaos
+def test_streaming_handoff_transparent_bit_exact(disagg_fleet, ref_engine):
+    """A streamed request hands off transparently: phase 1 is forced
+    non-streamed on the prefill replica, the client's ONE stream comes
+    from the decode replica, and the joined deltas equal the
+    single-replica response byte for byte."""
+    router, _, base, _, _ = disagg_fleet
+    ref = ref_engine.generate(PROMPT_STREAMED, **GEN)
+    req = urllib.request.Request(
+        base + "/generate",
+        data=json.dumps(
+            {"prompt": PROMPT_STREAMED, "stream": True, **GEN}
+        ).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    deltas, final = [], None
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.status == 200
+        for line in r:
+            ev = json.loads(line)
+            if ev.get("done"):
+                final = ev
+                break
+            deltas.append(ev.get("delta", ""))
+    assert final is not None and final["status"] == "success"
+    assert "".join(deltas) == ref["response"] == final["response"]
+    assert final.get("kv_fabric_blocks", 0) >= 5
+    assert _handoffs(router, "stream") >= 1
+
+
+@pytest.mark.chaos
+def test_prefill_replica_killed_mid_handoff(disagg_fleet, ref_engine):
+    """kill -9 the prefill replica BETWEEN phase 1 and phase 2: the
+    decode replica's fabric fetch hits a corpse, re-prefills locally,
+    and the output is bit-identical. Then the router path: with the
+    prefill tier dead, fresh long-prompt work degrades to a normal
+    single-replica dispatch — same bytes out, never an error. LAST test
+    in the module: it leaves the prefill replica dead."""
+    router, _, base, pre, dec = disagg_fleet
+    prompt = "doomed handoff workload " * 4 + "omega"
+    ref = ref_engine.generate(prompt, **GEN)
+    # phase 1 by hand, directly against the prefill replica
+    code, p1, _ = _post(pre.url, {"prompt": prompt, **GEN},
+                        headers={"X-KV-Prefill-Only": "1"})
+    assert code == 200 and p1.get("prefill_only") is True
+    assert p1["kv_digests"]
+    pre.proc.kill()  # SIGKILL mid-handoff: no drain, no goodbye
+    pre.proc.wait(timeout=15)
+    # phase 2 against the decode replica, hint pointing at the corpse
+    code, p2, _ = _post(
+        dec.url, {"prompt": prompt, **GEN},
+        headers={
+            "X-KV-Transfer-Peer": pre.url,
+            "X-KV-Transfer-Digest": p1["kv_digests"][-1],
+        },
+    )
+    assert code == 200 and p2["status"] == "success", p2
+    assert p2["response"] == ref["response"]  # local re-prefill, bit-exact
+    assert "kv_fabric_blocks" not in p2
+
+    # router path with a dead prefill tier: a FRESH long prompt either
+    # fails phase 1 (connect error -> prefill_failed) or skips the
+    # handoff entirely once the prober ejects p0 — both degrade to the
+    # decode replica serving it whole, bit-identical
+    prompt2 = "post mortem fresh workload " * 4
+    ref2 = ref_engine.generate(prompt2, **GEN)
+    code, body, _ = _post(base, {"prompt": prompt2, **GEN})
+    assert code == 200 and body["status"] == "success", body
+    assert body["replica"] == "d0"
+    assert body["response"] == ref2["response"]
+    # the corpse's residency entries are purged once the breaker trips
+    t0 = time.time()
+    while pre.state != EJECTED and time.time() - t0 < 10:
+        time.sleep(0.05)
+    assert pre.state == EJECTED
+    with router._res_lock:
+        assert all(v[0] != "p0" for v in router._residency.values())
+        assert all(r != "p0" for r in router._kv_residency.values())
