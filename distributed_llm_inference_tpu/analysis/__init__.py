@@ -1,16 +1,24 @@
-"""Static invariant checking for the compiled-decode contract.
+"""Static invariant checking: the compiled-decode contract AND the
+host control plane's concurrency contract.
 
 The whole point of this system versus the reference (JSON-over-HTTP, four
 hops per token) is that decode is ONE compiled XLA program with zero
-Python per token. That invariant is defended here, mechanically, in two
-complementary passes:
+Python per token — served by a multi-threaded host control plane
+(supervisor loop, shadow copier, queue dispatcher, router prober) whose
+own dominant bug classes are lock-order inversions, blocking calls under
+admission locks, and refcount leaks on early-return paths. Both contracts
+are defended here, mechanically:
 
   * `lint` — an AST rule engine over the package (rules/): no host-sync
     calls in functions reachable from the jitted entry points, no Python
     branching on traced values in ops//parallel/, donation coverage for
-    KV caches, recompile-hazard static args, metrics label hygiene, and
-    HTTP status-counter coverage. Per-line suppressions:
-    `# jaxlint: disable=RULE -- reason` (the reason is mandatory).
+    KV caches, recompile-hazard static args, metrics label hygiene, HTTP
+    status-counter coverage — plus the host-control-plane families over
+    the thread-aware call graph (callgraph.py) and lock model (locks.py):
+    thread-reach (derived decode-unreachability), lock-order,
+    blocking-under-lock, guarded-by, resource-lifecycle, join-hygiene.
+    Per-line suppressions: `# jaxlint: disable=RULE -- reason` (the
+    reason is mandatory).
   * `hlo` — compiled-artifact verification: lower the real decode
     programs with tiny configs and assert on the StableHLO (zero host
     callbacks, donation aliasing actually present, the loop compiled,
@@ -20,14 +28,20 @@ CLI: `python -m distributed_llm_inference_tpu.analysis` (CI-gated; see
 .github/workflows/ci.yml and ARCHITECTURE.md "Invariants").
 """
 
-from .callgraph import PackageIndex, build_index, traced_reachable
+from .callgraph import (
+    PackageIndex, build_index, decode_unreachable, host_reachable,
+    thread_roots, traced_reachable,
+)
 from .lint import Diagnostic, format_diagnostics, run_lint
 
 __all__ = [
     "Diagnostic",
     "PackageIndex",
     "build_index",
+    "decode_unreachable",
     "format_diagnostics",
+    "host_reachable",
     "run_lint",
+    "thread_roots",
     "traced_reachable",
 ]

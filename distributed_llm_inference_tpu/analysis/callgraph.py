@@ -20,12 +20,31 @@ cond, body, init)` passes `body` without calling it; a reference is the
 honest "may be traced" signal). Dynamic dispatch through
 `family(cfg).embed(...)` (models/api.py) fans out to the same attribute
 in every package module the dispatching module imports.
+
+Thread-aware half (the host control plane): the same index also knows
+the HOST roots — functions the runtime enters from outside any trace:
+
+  * thread-spawn targets: `threading.Thread(target=f)`, `Timer(t, f)`,
+    `executor.submit(f, ...)` — resolved like any reference (Name,
+    `self.method`, nested def);
+  * daemon/loop entry points the stdlib dispatches to dynamically:
+    `do_GET`/`do_POST`/... HTTP handler methods, module-level `main`,
+    `signal.signal(sig, f)` / `atexit.register(f)` targets.
+
+`host_reachable()` is the closure from those roots; `decode_unreachable()
+= host_reachable() - traced_reachable()` plus any function annotated
+`# jaxlint: decode-unreachable -- reason` on (or directly above) its
+def line — the DERIVED replacement for the hand-pinned decode-
+UNREACHABLE fixture list tests/test_analysis.py used to grow per PR.
+The thread-reach rule (analysis/rules/thread_reach.py) enforces that no
+thread entry point or annotated function is ever traced-reachable.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -361,3 +380,306 @@ def traced_reachable(index: PackageIndex, extra_roots=()) -> set:
         seen.add(key)
         stack.extend(graph[key] - seen)
     return seen
+
+
+# -- thread-aware host-plane reachability ------------------------------------
+
+# callables that take a function and run it on another thread / later:
+# the first positional arg (Timer: second) or target= is a THREAD root
+_SPAWN_CALLS = {"threading.Thread", "Thread"}
+_TIMER_CALLS = {"threading.Timer", "Timer"}
+# dynamic registration sinks whose target runs on the host event plane
+_REGISTER_CALLS = {"signal.signal", "atexit.register"}
+
+# stdlib-dispatched entry points: BaseHTTPRequestHandler methods and CLI
+# mains are never referenced by name inside the package, but the host
+# runtime enters them — they root the host plane like a thread target
+_HANDLER_RE_ATTRS = ("do_", )
+# stdlib hook overrides the server machinery calls by name
+_HANDLER_OVERRIDES = {"log_message", "handle_error", "finish_request"}
+
+_ANNOTATION_RE = re.compile(
+    r"#\s*jaxlint:\s*decode-unreachable\s*(?:--+|—|–|:)?\s*(.*)"
+)
+
+
+def resolve_target(node: ast.AST, fn: FuncInfo, mod: ModuleInfo,
+                   index: PackageIndex) -> list:
+    """Package FuncInfos a spawn-target expression may name: a bare Name
+    (local def, module function, imported function), `self.method`
+    (every class's method of that name in the module — instance typing
+    is out of scope for an AST pass, and a wrong extra root only widens
+    the host plane), or `module.attr`."""
+    out = []
+    if isinstance(node, ast.Name):
+        local = _local_scope(fn, mod)
+        if node.id in local:
+            return [local[node.id]]
+        for q, f in mod.functions.items():
+            if "." not in q and q == node.id:
+                return [f]
+        imp = mod.imports.get(node.id)
+        if imp and imp[0] == "obj":
+            t = index.get(imp[1], imp[2])
+            if t is not None:
+                return [t]
+        return out
+    if isinstance(node, ast.Attribute):
+        if isinstance(node.value, ast.Name):
+            if node.value.id == "self":
+                # every method of this name in the module: the spawning
+                # method's own class first, but Thread(target=obj._run)
+                # style spawns resolve by name across classes too
+                for q, f in mod.functions.items():
+                    if q.endswith("." + node.attr):
+                        out.append(f)
+                return out
+            imp = mod.imports.get(node.value.id)
+            if imp and imp[0] == "module":
+                t = index.get(imp[1], node.attr)
+                if t is not None:
+                    return [t]
+        # obj.attr where obj is a local variable: name-match across the
+        # module (same honesty-over-precision trade as self.*)
+        for q, f in mod.functions.items():
+            if q.endswith("." + node.attr) or q == node.attr:
+                out.append(f)
+    return out
+
+
+def _spawn_target_exprs(call: ast.Call):
+    """The expressions a spawn/submit/register call runs later."""
+    d = dotted(call.func)
+    if d in _SPAWN_CALLS:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                yield kw.value
+        return
+    if d in _TIMER_CALLS:
+        if len(call.args) >= 2:
+            yield call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "function":
+                yield kw.value
+        return
+    if d in _REGISTER_CALLS:
+        if d == "signal.signal" and len(call.args) >= 2:
+            yield call.args[1]
+        elif d == "atexit.register" and call.args:
+            yield call.args[0]
+        return
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "submit":
+        # executor.submit(fn, ...) — any receiver; a non-executor .submit
+        # with a function arg still runs host-side work, so over-approx
+        if call.args:
+            yield call.args[0]
+
+
+def _scope_modules(mod: ModuleInfo, index: PackageIndex) -> list:
+    """This module plus every package module it imports (module aliases
+    AND the source modules of `from X import name` object imports) —
+    the fan-out scope for name-based method resolution."""
+    names = {mod.name}
+    for imp in mod.imports.values():
+        if imp[0] == "module":
+            names.add(imp[1])
+        elif imp[0] == "obj":
+            names.add(imp[1])
+    return [index.modules[n] for n in names if n in index.modules]
+
+
+def _class_inits(mod_scope: list, cls_name: str) -> list:
+    """`Cls(...)` instantiation edges: the constructor bodies the host
+    plane enters (`__init__`, dataclass `__post_init__`)."""
+    out = []
+    for m in mod_scope:
+        for suffix in ("__init__", "__post_init__"):
+            f = m.functions.get(f"{cls_name}.{suffix}")
+            if f is not None:
+                out.append(f)
+    return out
+
+
+def _leaf_map(mods) -> dict:
+    """{leaf name: [func keys]} over the given modules' functions."""
+    out: dict = {}
+    for m in mods:
+        for q, f in m.functions.items():
+            out.setdefault(q.rsplit(".", 1)[-1], []).append(f.key)
+    return out
+
+
+def _walk_with_lambdas(fn: FuncInfo) -> Iterator[ast.AST]:
+    """Like _walk_own_body but DESCENDS into lambdas: lambdas are not
+    separate graph nodes (only defs are registered), so their bodies —
+    `key=lambda c: self.victim_key(...)` — belong to the enclosing
+    function for host-plane purposes."""
+
+    def walk(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from walk(child)
+
+    for stmt in fn.node.body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from walk(stmt)
+
+
+def _host_edges_for(fn: FuncInfo, mod: ModuleInfo, index: PackageIndex,
+                    scoped_leaves: dict, global_leaves: dict) -> set:
+    """Reference edges PLUS the dynamic-dispatch approximations host
+    code actually uses: `Cls(...)` -> `Cls.__init__`, and `obj.method` /
+    `obj.prop` resolved BY NAME — first across this module and
+    everything it imports, falling back to the whole package when the
+    scoped lookup finds nothing (`serve_chain(shadow, ...)` calls a
+    ShadowStore method without importing engine/shadow). The fan-out
+    over-approximates (instance typing is beyond an AST pass); that is
+    safe here because decode_unreachable() subtracts the traced set —
+    an extra host edge can only widen the proven-host-only set toward
+    the truth, never contaminate the traced closure."""
+    out = set(_edges_for(fn, mod, index))
+    scope = _scope_modules(mod, index)
+    local_classes = {
+        q.split(".")[0] for q in mod.functions if "." in q
+    }
+    for node in _walk_with_lambdas(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                cls = None
+                if f.id in local_classes:
+                    cls = f.id
+                else:
+                    imp = mod.imports.get(f.id)
+                    if imp and imp[0] == "obj":
+                        cls = imp[2]
+                if cls:
+                    for t in _class_inits(scope, cls):
+                        out.add(t.key)
+        elif isinstance(node, ast.Attribute) and isinstance(
+            node.ctx, ast.Load
+        ):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in mod.imports \
+                    and mod.imports[base.id][0] == "module":
+                continue  # module.attr: precise resolution above
+            keys = scoped_leaves.get(node.attr)
+            if keys is None:
+                keys = global_leaves.get(node.attr, ())
+            out.update(keys)
+    return out
+
+
+def thread_roots(index: PackageIndex) -> dict:
+    """{func_key: (module, lineno)} for every function handed to a
+    thread/timer/executor spawn or a signal/atexit registration — the
+    entry points of the host control plane's own threads."""
+    roots: dict = {}
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            for node in _walk_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                for expr in _spawn_target_exprs(node):
+                    for target in resolve_target(expr, fn, mod, index):
+                        roots.setdefault(
+                            target.key, (mod.path, node.lineno)
+                        )
+    return roots
+
+
+def host_roots(index: PackageIndex) -> dict:
+    """Thread roots plus the stdlib-dispatched entry points (HTTP
+    `do_*` handler methods, module-level `main`) — everything the host
+    runtime enters from outside any trace."""
+    roots = dict(thread_roots(index))
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            leaf = fn.qualname.rsplit(".", 1)[-1]
+            if leaf == "main" and "." not in fn.qualname:
+                roots.setdefault(fn.key, (mod.path, fn.node.lineno))
+            elif "." in fn.qualname and (
+                any(leaf.startswith(p) for p in _HANDLER_RE_ATTRS)
+                or leaf in _HANDLER_OVERRIDES
+            ):
+                roots.setdefault(fn.key, (mod.path, fn.node.lineno))
+    return roots
+
+
+def host_call_graph(index: PackageIndex) -> dict:
+    """{func_key: set(func_key)} with the host-plane edge enrichments
+    (constructor + name-based method/property dispatch)."""
+    global_leaves = _leaf_map(index.modules.values())
+    graph = {}
+    for mod in index.modules.values():
+        scoped_leaves = _leaf_map(_scope_modules(mod, index))
+        for fn in mod.functions.values():
+            graph[fn.key] = _host_edges_for(
+                fn, mod, index, scoped_leaves, global_leaves
+            )
+    return graph
+
+
+def host_reachable(index: PackageIndex) -> set:
+    """Closure over the ENRICHED graph from the host roots: the host
+    control plane. May OVERLAP traced_reachable — host code builds and
+    launches jitted programs, so builder references leak in; callers
+    wanting the proven-host-only set use decode_unreachable()."""
+    graph = host_call_graph(index)
+    seen = set()
+    stack = list(host_roots(index))
+    while stack:
+        key = stack.pop()
+        if key in seen or key not in graph:
+            continue
+        seen.add(key)
+        stack.extend(graph[key] - seen)
+    return seen
+
+
+def annotated_decode_unreachable(index: PackageIndex) -> dict:
+    """{func_key: reason} for every `# jaxlint: decode-unreachable`
+    annotation sitting on (or directly above) a def line. reason may be
+    "" — the thread-reach rule reports reasonless annotations."""
+    out: dict = {}
+    for mod in index.modules.values():
+        by_line = {}
+        for i, text in enumerate(mod.lines, start=1):
+            m = _ANNOTATION_RE.search(text)
+            if m is None:
+                continue
+            target = i + 1 if text.lstrip().startswith("#") else i
+            by_line[target] = m.group(1).strip()
+        if not by_line:
+            continue
+        for fn in mod.functions.values():
+            # decorators push the def line down; accept the annotation on
+            # the def line itself or on the line the decorator list starts
+            lines = [fn.node.lineno]
+            decs = getattr(fn.node, "decorator_list", ())
+            if decs:
+                lines.append(decs[0].lineno - 1)
+            for ln in lines:
+                if ln in by_line:
+                    out[fn.key] = by_line[ln]
+                    break
+    return out
+
+
+def decode_unreachable(index: PackageIndex,
+                       traced: Optional[set] = None) -> set:
+    """The DERIVED decode-unreachable set: host-plane functions that are
+    provably outside every trace (host-reachable minus traced-reachable)
+    plus the annotated escape hatch. This is what replaced the manual
+    pin fixtures in tests/test_analysis.py — the thread-reach rule
+    guarantees the annotated half really is disjoint from the traced
+    set, so consumers can treat the union as proven."""
+    if traced is None:
+        traced = traced_reachable(index)
+    derived = host_reachable(index) - traced
+    derived.update(annotated_decode_unreachable(index))
+    return derived

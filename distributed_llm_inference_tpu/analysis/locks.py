@@ -1,0 +1,611 @@
+"""Lock model for the host-control-plane rules (lock-order,
+blocking-under-lock, guarded-by, join-hygiene).
+
+The control plane grew from one worker loop into a thread mesh —
+supervisor loop, shadow copier, router prober/rolling-restart, HTTP
+handler threads — and its bug classes are lock bugs: ordering
+inversions, blocking calls under the admission lock, guarded state
+written lock-free. This module builds, once per PackageIndex, the facts
+those rules need:
+
+  * LOCK IDENTITIES: every `self.X = threading.Lock()/RLock()/
+    Condition(...)` assignment declares a lock (module, class, attr).
+    `Condition(self.Y)` ALIASES X to Y (one underlying lock — engine/
+    shadow.py's `_cv`/`_lock` pair). A lock attr declared by several
+    classes of one module resolves by name to the conflated id
+    (module, "*", attr) when the owning instance cannot be typed; the
+    rules never draw self-edges, so conflation can widen the graph but
+    not invent a cycle on its own.
+  * INSTANCE TYPING: `self.X = ClassName(...)` in a class body binds
+    X's type, so `self._shadow.flush()` resolves to ShadowStore.flush —
+    the cross-object call edges lock ordering is about.
+  * HELD-REGION FACTS per function: every lock acquisition (`with
+    self.X:`) with the locks already held at that point, every resolved
+    call with its held set, every potentially BLOCKING call (time.sleep,
+    HTTP fetch, bare `.join()`, `.put(block=True)`, device syncs,
+    `.wait()` on anything but an already-held condition) with its held
+    set, and every `self.ATTR` write with its held set.
+  * GUARDED-BY DECLARATIONS: `# guarded-by: <lock>` on an attribute's
+    initializing assignment declares the attr's lock; on a `def` line it
+    declares the method's precondition ("caller must hold <lock>" — the
+    `_locked`-suffix convention, machine-checked).
+
+Everything here is syntactic and intra-package: a resolution miss makes
+a rule MISS a fact, never invent one, so the rules stay near-zero-noise
+on real code while catching the fixture shapes (and the PR-4/PR-9
+history shapes) exactly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .callgraph import (
+    FuncInfo, ModuleInfo, PackageIndex, _class_scope, _local_scope, dotted,
+)
+
+_LOCK_CTORS = {
+    "threading.Lock", "Lock", "threading.RLock", "RLock",
+    "threading.Condition", "Condition",
+    "threading.Semaphore", "Semaphore",
+    "threading.BoundedSemaphore", "BoundedSemaphore",
+}
+_CONDITION_CTORS = {"threading.Condition", "Condition"}
+
+_GUARDED_RE = re.compile(r"#.*\bguarded-by:\s*([A-Za-z_][\w]*)")
+
+# blocking primitives (the direct facts; may_block() closes them over
+# the call graph)
+_HTTP_PREFIXES = (
+    "urllib.request.urlopen", "urlopen", "requests.", "http.client.",
+)
+_SLEEP_CALLS = {"time.sleep"}
+_DEVICE_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+_DEVICE_SYNC_CALLS = {"jax.device_get"}
+
+
+@dataclass(frozen=True)
+class LockId:
+    module: str
+    cls: str  # "*" = conflated by-name group within the module
+    attr: str
+
+    def label(self) -> str:
+        owner = self.cls if self.cls != "*" else self.module
+        return f"{owner}.{self.attr}"
+
+
+@dataclass
+class FuncFacts:
+    key: tuple
+    # (held lock-id tuple, acquired lock id, lineno)
+    acquisitions: list = field(default_factory=list)
+    # (held lock-id tuple, callee func key, lineno)
+    calls: list = field(default_factory=list)
+    # (held lock-id tuple, kind, detail, lineno); kind "cv-wait" is a
+    # bounded wait on an already-held condition — excluded from the
+    # local blocking-under-lock flag, included in the may-block summary
+    blocking: list = field(default_factory=list)
+    # (held lock-id tuple, (cls, attr), lineno)
+    writes: list = field(default_factory=list)
+    direct_acquires: set = field(default_factory=set)
+
+
+@dataclass
+class ThreadSpawn:
+    module_path: str
+    module: str
+    lineno: int
+    daemon: bool
+    holder: Optional[str]  # "self._thread" / "t" / None (anonymous)
+    timer: bool = False
+
+
+@dataclass
+class LockModel:
+    index: PackageIndex
+    # (module, cls, attr) -> canonical LockId (Condition aliasing folded)
+    decls: dict = field(default_factory=dict)
+    # attr -> [(module, cls)] declaring it (for by-name resolution)
+    by_attr: dict = field(default_factory=dict)
+    # (module, cls, attr) -> (module, cls) instance type
+    attr_types: dict = field(default_factory=dict)
+    # (module, cls, attr) -> lock attr name (guarded state declarations)
+    guarded_attrs: dict = field(default_factory=dict)
+    # func key -> lock attr name (method precondition declarations)
+    guarded_methods: dict = field(default_factory=dict)
+    functions: dict = field(default_factory=dict)  # func key -> FuncFacts
+    spawns: list = field(default_factory=list)  # [ThreadSpawn]
+    # holder leaf name -> [(module, lineno, has_timeout)] join calls
+    joins: dict = field(default_factory=dict)
+    _may_block: Optional[dict] = None
+    _acquires_star: Optional[dict] = None
+
+    # -- lock resolution -----------------------------------------------------
+    def canonical(self, module: str, cls: str, attr: str) -> Optional[LockId]:
+        got = self.decls.get((module, cls, attr))
+        return got
+
+    def resolve_attr(self, module: str, attr: str,
+                     cls: Optional[str]) -> Optional[LockId]:
+        """A lock named by attribute: the function's own class first,
+        then by name — unique declaration anywhere wins, several within
+        reach conflate to (module-of-declaration, "*", attr)."""
+        if cls is not None:
+            got = self.decls.get((module, cls, attr))
+            if got is not None:
+                return got
+        owners = self.by_attr.get(attr, ())
+        if not owners:
+            return None
+        same_mod = [o for o in owners if o[0] == module]
+        pool = same_mod or owners
+        if len(pool) == 1:
+            m, c = pool[0]
+            return self.decls[(m, c, attr)]
+        return LockId(pool[0][0], "*", attr)
+
+
+def _is_class_name(name: str, mod: ModuleInfo, index: PackageIndex):
+    """(module, cls) when `name` names a class with methods in `mod`'s
+    scope (defined here or object-imported from a package module)."""
+    for q in mod.functions:
+        if q.startswith(name + ".") :
+            return (mod.name, name)
+    imp = mod.imports.get(name)
+    if imp and imp[0] == "obj":
+        src = index.modules.get(imp[1])
+        if src is not None:
+            for q in src.functions:
+                if q.startswith(imp[2] + "."):
+                    return (imp[1], imp[2])
+    return None
+
+
+def _collect_decls(model: LockModel):
+    """Lock declarations, Condition aliases, instance typing, and
+    guarded-by annotations — one pass over every `self.X = ...`."""
+    index = model.index
+    pending_aliases = []  # ((module, cls, attr), source attr)
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            if "." not in fn.qualname:
+                continue
+            cls = fn.qualname.split(".")[0]
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    t = node.targets[0]
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    t = node.target
+                else:
+                    continue
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    continue
+                attr = t.attr
+                v = node.value
+                # guarded-by annotation on this assignment's line (or the
+                # line above, for assignments too long to carry it)
+                for ln in (node.lineno, node.lineno - 1):
+                    if 1 <= ln <= len(mod.lines):
+                        m = _GUARDED_RE.search(mod.lines[ln - 1])
+                        if m and (
+                            ln == node.lineno
+                            or mod.lines[ln - 1].lstrip().startswith("#")
+                        ):
+                            model.guarded_attrs[(mod.name, cls, attr)] = (
+                                m.group(1)
+                            )
+                            break
+                if isinstance(v, ast.Call):
+                    d = dotted(v.func)
+                    if d in _LOCK_CTORS:
+                        key = (mod.name, cls, attr)
+                        if (
+                            d in _CONDITION_CTORS and v.args
+                            and isinstance(v.args[0], ast.Attribute)
+                            and isinstance(v.args[0].value, ast.Name)
+                            and v.args[0].value.id == "self"
+                        ):
+                            pending_aliases.append((key, v.args[0].attr))
+                        else:
+                            model.decls[key] = LockId(mod.name, cls, attr)
+                        continue
+                    # instance typing: self.X = ClassName(...)
+                    typed = None
+                    if isinstance(v.func, ast.Name):
+                        typed = _is_class_name(v.func.id, mod, index)
+                    elif isinstance(v.func, ast.Attribute) and isinstance(
+                        v.func.value, ast.Name
+                    ):
+                        imp = mod.imports.get(v.func.value.id)
+                        if imp and imp[0] == "module":
+                            src = index.modules.get(imp[1])
+                            if src is not None and any(
+                                q.startswith(v.func.attr + ".")
+                                for q in src.functions
+                            ):
+                                typed = (imp[1], v.func.attr)
+                    if typed is not None:
+                        model.attr_types[(mod.name, cls, attr)] = typed
+    for (module, cls, attr), src_attr in pending_aliases:
+        target = model.decls.get((module, cls, src_attr))
+        model.decls[(module, cls, attr)] = (
+            target if target is not None else LockId(module, cls, attr)
+        )
+    for (module, cls, attr) in model.decls:
+        model.by_attr.setdefault(attr, []).append((module, cls))
+
+
+def _collect_guarded_methods(model: LockModel):
+    for mod in model.index.modules.values():
+        for fn in mod.functions.values():
+            lines = [fn.node.lineno]
+            decs = getattr(fn.node, "decorator_list", ())
+            if decs:
+                lines.append(decs[0].lineno - 1)
+            else:
+                lines.append(fn.node.lineno - 1)
+            for ln in lines:
+                if not (1 <= ln <= len(mod.lines)):
+                    continue
+                text = mod.lines[ln - 1]
+                m = _GUARDED_RE.search(text)
+                if m and (
+                    ln == fn.node.lineno
+                    or text.lstrip().startswith("#")
+                ):
+                    model.guarded_methods[fn.key] = m.group(1)
+                    break
+
+
+def _resolve_lock_expr(expr: ast.AST, cls: Optional[str],
+                       mod: ModuleInfo, model: LockModel) -> Optional[LockId]:
+    """The lock a `with <expr>:` item or a `<expr>.wait()` receiver
+    names, or None when it is not a known lock."""
+    if not isinstance(expr, ast.Attribute):
+        return None
+    attr = expr.attr
+    base = expr.value
+    if isinstance(base, ast.Name) and base.id == "self":
+        got = model.canonical(mod.name, cls or "", attr)
+        if got is not None:
+            return got
+        return model.resolve_attr(mod.name, attr, None)
+    # typed base: self.X.lock -> type(X).lock
+    if (
+        isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "self"
+        and cls is not None
+    ):
+        typed = model.attr_types.get((mod.name, cls, base.attr))
+        if typed is not None:
+            got = model.canonical(typed[0], typed[1], attr)
+            if got is not None:
+                return got
+    return model.resolve_attr(mod.name, attr, None)
+
+
+def _resolve_call(node: ast.Call, fn: FuncInfo, cls: Optional[str],
+                  mod: ModuleInfo, model: LockModel) -> Optional[tuple]:
+    """Callee func key for edges the lock rules can trust: bare names
+    (local/module/imported), `self.m()`, module-alias calls, and typed
+    `self.X.m()` through the instance-typing map."""
+    index = model.index
+    f = node.func
+    if isinstance(f, ast.Name):
+        local = _local_scope(fn, mod)
+        if f.id in local:
+            return local[f.id].key
+        t = mod.functions.get(f.id)
+        if t is not None and "." not in t.qualname:
+            return t.key
+        imp = mod.imports.get(f.id)
+        if imp and imp[0] == "obj":
+            t = index.get(imp[1], imp[2])
+            if t is not None:
+                return t.key
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    base = f.value
+    if isinstance(base, ast.Name):
+        if base.id == "self":
+            methods = _class_scope(fn, mod)
+            t = methods.get(f.attr)
+            if t is not None:
+                return t.key
+            return None
+        imp = mod.imports.get(base.id)
+        if imp and imp[0] == "module":
+            t = index.get(imp[1], f.attr)
+            if t is not None:
+                return t.key
+        return None
+    if (
+        isinstance(base, ast.Attribute)
+        and isinstance(base.value, ast.Name)
+        and base.value.id == "self"
+        and cls is not None
+    ):
+        typed = model.attr_types.get((mod.name, cls, base.attr))
+        if typed is not None:
+            t = index.get(typed[0], f"{typed[1]}.{f.attr}")
+            if t is not None:
+                return t.key
+    return None
+
+
+def _kwarg(node: ast.Call, name: str):
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _blocking_kind(node: ast.Call, held, cls, mod, model) -> Optional[tuple]:
+    """(kind, detail) when this call can block the calling thread."""
+    d = dotted(node.func)
+    if d in _SLEEP_CALLS:
+        return "sleep", d + "()"
+    if d in _DEVICE_SYNC_CALLS:
+        return "device-sync", d + "()"
+    if d is not None and any(d.startswith(p) for p in _HTTP_PREFIXES):
+        return "http", d + "()"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    base = node.func.value
+    if attr in _DEVICE_SYNC_ATTRS:
+        return "device-sync", f".{attr}()"
+    if attr in ("put", "get"):
+        blk = _kwarg(node, "block")
+        if isinstance(blk, ast.Constant) and blk.value is True:
+            return "queue-block", f".{attr}(block=True)"
+        return None
+    if attr == "join":
+        # str.join / os.path.join are not synchronization
+        if isinstance(base, ast.Constant):
+            return None
+        if d is not None and ("path" in d or d.startswith("str.")):
+            return None
+        return "join", ".join()"
+    if attr == "wait":
+        lid = _resolve_lock_expr(base, cls, mod, model)
+        if lid is not None and lid in held:
+            # waiting on an already-held condition RELEASES it — the
+            # normal pattern; still a may-block fact for callers
+            return "cv-wait", ".wait() on held condition"
+        if held:
+            return "wait", ".wait() on a foreign lock/event"
+        return None
+    return None
+
+
+_SPAWN_DOTTED = {"threading.Thread", "Thread"}
+_TIMER_DOTTED = {"threading.Timer", "Timer"}
+
+
+def _holder_of(stmt: ast.Assign) -> Optional[str]:
+    if len(stmt.targets) != 1:
+        return None
+    t = stmt.targets[0]
+    if isinstance(t, ast.Name):
+        return t.id
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name):
+        return t.attr
+    return None
+
+
+def _analyze_function(fn: FuncInfo, mod: ModuleInfo, model: LockModel):
+    facts = FuncFacts(key=fn.key)
+    cls = fn.qualname.split(".")[0] if "." in fn.qualname else None
+
+    def scan_expr(node: ast.AST, held: tuple):
+        """Calls + blocking + writes inside one statement (lambdas
+        included — they run on this thread; nested defs excluded)."""
+        for child in ast.walk(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Call):
+                callee = _resolve_call(child, fn, cls, mod, model)
+                if callee is not None:
+                    facts.calls.append((held, callee, child.lineno))
+                blk = _blocking_kind(child, held, cls, mod, model)
+                if blk is not None:
+                    facts.blocking.append(
+                        (held, blk[0], blk[1], child.lineno)
+                    )
+
+    def note_writes(stmt: ast.AST, held: tuple):
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        for t in targets:
+            node = t
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            ):
+                facts.writes.append(
+                    (held, (cls, node.attr), t.lineno)
+                )
+
+    def visit(stmts, held: tuple):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in st.items:
+                    scan_expr(item.context_expr, new_held)
+                    lid = _resolve_lock_expr(
+                        item.context_expr, cls, mod, model
+                    )
+                    if lid is not None:
+                        facts.acquisitions.append(
+                            (new_held, lid, st.lineno)
+                        )
+                        facts.direct_acquires.add(lid)
+                        if lid not in new_held:
+                            new_held = new_held + (lid,)
+                visit(st.body, new_held)
+                continue
+            note_writes(st, held)
+            if isinstance(st, ast.If):
+                scan_expr(st.test, held)
+                visit(st.body, held)
+                visit(st.orelse, held)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                scan_expr(st.iter, held)
+                visit(st.body, held)
+                visit(st.orelse, held)
+            elif isinstance(st, ast.While):
+                scan_expr(st.test, held)
+                visit(st.body, held)
+                visit(st.orelse, held)
+            elif isinstance(st, ast.Try):
+                visit(st.body, held)
+                for h in st.handlers:
+                    visit(h.body, held)
+                visit(st.orelse, held)
+                visit(st.finalbody, held)
+            else:
+                scan_expr(st, held)
+
+    visit(fn.node.body, ())
+    model.functions[fn.key] = facts
+
+    # thread spawns + joins (join-hygiene facts)
+    for st in ast.walk(fn.node):
+        if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+            d = dotted(st.value.func)
+            if d in _SPAWN_DOTTED or d in _TIMER_DOTTED:
+                daemon = _kwarg(st.value, "daemon")
+                model.spawns.append(ThreadSpawn(
+                    module_path=mod.path, module=mod.name,
+                    lineno=st.lineno,
+                    daemon=isinstance(daemon, ast.Constant)
+                    and daemon.value is True,
+                    holder=_holder_of(st), timer=d in _TIMER_DOTTED,
+                ))
+        elif isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            d = dotted(call.func)
+            if d in _SPAWN_DOTTED or d in _TIMER_DOTTED:
+                model.spawns.append(ThreadSpawn(
+                    module_path=mod.path, module=mod.name,
+                    lineno=st.lineno,
+                    daemon=isinstance(_kwarg(call, "daemon"), ast.Constant)
+                    and _kwarg(call, "daemon").value is True,
+                    holder=None, timer=d in _TIMER_DOTTED,
+                ))
+            elif (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "start"
+                and isinstance(call.func.value, ast.Call)
+            ):
+                inner = call.func.value
+                di = dotted(inner.func)
+                if di in _SPAWN_DOTTED or di in _TIMER_DOTTED:
+                    dm = _kwarg(inner, "daemon")
+                    model.spawns.append(ThreadSpawn(
+                        module_path=mod.path, module=mod.name,
+                        lineno=st.lineno,
+                        daemon=isinstance(dm, ast.Constant)
+                        and dm.value is True,
+                        holder=None, timer=di in _TIMER_DOTTED,
+                    ))
+        if isinstance(st, ast.Call) and isinstance(st.func, ast.Attribute) \
+                and st.func.attr == "join":
+            base = st.func.value
+            leaf = None
+            if isinstance(base, ast.Name):
+                leaf = base.id
+            elif isinstance(base, ast.Attribute):
+                leaf = base.attr
+            if leaf is not None:
+                has_timeout = bool(st.args) or any(
+                    kw.arg == "timeout" for kw in st.keywords
+                )
+                model.joins.setdefault(leaf, []).append(
+                    (mod.name, st.lineno, has_timeout)
+                )
+
+
+def build_lock_model(index: PackageIndex) -> LockModel:
+    cached = getattr(index, "_lock_model", None)
+    if cached is not None:
+        return cached
+    model = LockModel(index=index)
+    _collect_decls(model)
+    _collect_guarded_methods(model)
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            _analyze_function(fn, mod, model)
+    index._lock_model = model
+    return model
+
+
+def acquires_star(model: LockModel) -> dict:
+    """Transitive lock acquisitions per function (fixpoint over the
+    resolved call edges)."""
+    if model._acquires_star is not None:
+        return model._acquires_star
+    acq = {k: set(f.direct_acquires) for k, f in model.functions.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, f in model.functions.items():
+            for _, callee, _ in f.calls:
+                extra = acq.get(callee)
+                if extra and not extra <= acq[k]:
+                    acq[k] |= extra
+                    changed = True
+    model._acquires_star = acq
+    return acq
+
+
+def may_block(model: LockModel) -> dict:
+    """{func key: (kind, detail) or None}: can calling this function
+    block the calling thread (directly or transitively)? cv-waits count
+    — a bounded wait on the callee's own condition still stalls the
+    CALLER'S held locks."""
+    if model._may_block is not None:
+        return model._may_block
+    out = {}
+    for k, f in model.functions.items():
+        direct = [
+            (kind, detail) for _, kind, detail, _ in f.blocking
+        ]
+        out[k] = direct[0] if direct else None
+    changed = True
+    while changed:
+        changed = False
+        for k, f in model.functions.items():
+            if out[k] is not None:
+                continue
+            for _, callee, _ in f.calls:
+                got = out.get(callee)
+                if got is not None:
+                    out[k] = (got[0], f"{callee[1]} -> {got[1]}")
+                    changed = True
+                    break
+    model._may_block = out
+    return out

@@ -75,11 +75,17 @@ def walk_own_body(fn_node: ast.AST):
 
 
 from . import (  # noqa: E402 — registry needs the helpers above
+    blocking_under_lock,
     donation,
+    guarded_by,
     host_sync,
+    join_hygiene,
+    lifecycle,
+    lock_order,
     metrics_labels,
     routes,
     static_args,
+    thread_reach,
     tracer_branch,
 )
 
@@ -88,6 +94,10 @@ ALL_RULES = {
     for mod in (
         host_sync, tracer_branch, donation, static_args, metrics_labels,
         routes,
+        # host-control-plane rules (lock discipline, resource lifecycle,
+        # thread reachability — ARCHITECTURE.md "Invariants")
+        thread_reach, lock_order, blocking_under_lock, guarded_by,
+        lifecycle, join_hygiene,
     )
 }
 

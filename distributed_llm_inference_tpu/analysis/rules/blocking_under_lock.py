@@ -1,0 +1,67 @@
+"""blocking-under-lock: no blocking call while holding a control-plane
+lock.
+
+A lock in this codebase protects scheduler/admission state that every
+serving thread contends on (the continuous engine's cv, the queue's cv,
+the router's replica/residency locks, the shadow store's lock). A
+blocking call made WHILE HOLDING one — an HTTP fetch, `time.sleep`, an
+unbounded `.join()`, `queue.put(block=True)`, a device sync
+(`.block_until_ready()`, `.item()`, `jax.device_get`), or a `.wait()`
+on some OTHER lock's condition — turns one slow peer into a stall of
+every thread behind that lock (and at worst a deadlock, when the callee
+waits on a thread that needs the held lock). Flagged at the call site,
+with one level of transitivity: a call under a lock into a function the
+lock model proves may block is flagged at the CALL (the blocking is a
+property of the callee's body, the bug is holding the lock across it).
+
+Waiting on the condition you hold is the one legitimate blocking shape
+(wait releases it) and is never flagged locally — but it still makes
+the callee may-block for callers holding OTHER locks."""
+
+from __future__ import annotations
+
+from ..callgraph import PackageIndex
+from ..lint import Diagnostic
+from ..locks import build_lock_model, may_block
+
+RULE_ID = "blocking-under-lock"
+
+
+def check(index: PackageIndex) -> list:
+    model = build_lock_model(index)
+    blocks = may_block(model)
+    out: list = []
+    seen = set()
+    for key, facts in sorted(model.functions.items()):
+        mod = model.index.modules[key[0]]
+        for held, kind, detail, line in facts.blocking:
+            if not held or kind == "cv-wait":
+                continue
+            dedup = (mod.path, line)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            out.append(Diagnostic(
+                path=mod.path, line=line, rule=RULE_ID,
+                message=f"{detail} ({kind}) while holding "
+                        f"{held[-1].label()} — a blocking call under a "
+                        f"control-plane lock stalls every thread behind "
+                        f"it",
+            ))
+        for held, callee, line in facts.calls:
+            if not held:
+                continue
+            got = blocks.get(callee)
+            if got is None:
+                continue
+            dedup = (mod.path, line)
+            if dedup in seen:
+                continue
+            seen.add(dedup)
+            out.append(Diagnostic(
+                path=mod.path, line=line, rule=RULE_ID,
+                message=f"call into {callee[1]} while holding "
+                        f"{held[-1].label()} — it can block "
+                        f"({got[0]}: {got[1]})",
+            ))
+    return out

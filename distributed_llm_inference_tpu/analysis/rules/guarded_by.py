@@ -1,0 +1,94 @@
+"""guarded-by: declared shared state is only written under its lock.
+
+The convention (ARCHITECTURE.md "Invariants"): a comment on the
+attribute's initializing assignment declares which lock guards it —
+
+    self._queue = []  # guarded-by: _cv
+
+— and from then on EVERY write to `self._queue` in that class (plain
+assignment, augmented assignment, subscript store, del) must happen
+while that lock is held. A method may instead declare the precondition
+on its def line —
+
+    def _note_queue_locked(self):  # guarded-by: _cv
+
+— which (a) exempts its own writes (the caller holds the lock) and
+(b) obliges every resolved call site to hold the lock, machine-checking
+the `_locked`-suffix convention the engine has relied on by hand.
+`__init__` is exempt (no second thread can hold a reference yet).
+
+Writes the model cannot see (mutating method calls like `.append()`,
+writes through an alias) are out of scope — declare guarded-by on the
+attributes whose mutation shape IS assignment, which is what the
+control plane's queues/maps/flags use."""
+
+from __future__ import annotations
+
+from ..callgraph import PackageIndex
+from ..lint import Diagnostic
+from ..locks import build_lock_model
+
+RULE_ID = "guarded-by"
+
+
+def check(index: PackageIndex) -> list:
+    model = build_lock_model(index)
+    out: list = []
+
+    # resolve each declaration's lock once
+    resolved_attrs = {}
+    for (module, cls, attr), lock_name in model.guarded_attrs.items():
+        lid = model.canonical(module, cls, lock_name)
+        if lid is None:
+            lid = model.resolve_attr(module, lock_name, cls)
+        if lid is None:
+            mod = index.modules[module]
+            out.append(Diagnostic(
+                path=mod.path, line=1, rule=RULE_ID,
+                message=f"guarded-by on {cls}.{attr} names unknown lock "
+                        f"{lock_name!r} (no threading.Lock/RLock/"
+                        f"Condition assignment found)",
+            ))
+            continue
+        resolved_attrs[(module, cls, attr)] = lid
+
+    guarded_fn = {}
+    for key, lock_name in model.guarded_methods.items():
+        module, qualname = key
+        cls = qualname.split(".")[0] if "." in qualname else None
+        lid = (
+            model.canonical(module, cls, lock_name) if cls else None
+        ) or model.resolve_attr(module, lock_name, cls)
+        if lid is not None:
+            guarded_fn[key] = lid
+
+    for key, facts in sorted(model.functions.items()):
+        module, qualname = key
+        mod = index.modules[module]
+        if qualname.endswith("__init__") and qualname.count(".") <= 1:
+            continue  # construction happens before sharing
+        own = guarded_fn.get(key)
+        for held, (cls, attr), line in facts.writes:
+            lid = resolved_attrs.get((module, cls, attr))
+            if lid is None:
+                continue
+            if lid in held or own == lid:
+                continue
+            out.append(Diagnostic(
+                path=mod.path, line=line, rule=RULE_ID,
+                message=f"write to {cls}.{attr} outside its declared "
+                        f"lock {lid.label()} (guarded-by)",
+            ))
+        for held, callee, line in facts.calls:
+            need = guarded_fn.get(callee)
+            if need is None:
+                continue
+            if need in held or own == need:
+                continue
+            out.append(Diagnostic(
+                path=mod.path, line=line, rule=RULE_ID,
+                message=f"call to {callee[1]} without holding "
+                        f"{need.label()} — its def declares "
+                        f"`# guarded-by: {model.guarded_methods[callee]}`",
+            ))
+    return out

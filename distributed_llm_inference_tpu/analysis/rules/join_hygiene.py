@@ -1,0 +1,83 @@
+"""join-hygiene: spawned threads must be joinable, and joins must be
+bounded.
+
+The PR-9 follower-wedge class, as a rule: a thread another component
+waits on at shutdown can wedge the whole process if (a) it is
+non-daemon with no bounded join anywhere (interpreter exit blocks on
+it forever), or (b) some drain path calls `.join()` on it with NO
+timeout (a wedged thread body — a stuck device call, a dead peer —
+holds shutdown hostage; `multihost.shutdown_followers` grew its
+abandonment timeout for exactly this).
+
+Concretely:
+  * a `threading.Thread(...)`/`Timer(...)` spawn without `daemon=True`
+    must have a `holder.join(timeout=...)` (bounded) somewhere in its
+    module — no holder at all means it can never be joined;
+  * any `.join()` on a KNOWN thread holder (a name some spawn in the
+    module assigns) without a timeout argument is flagged, daemon or
+    not — every drain path in this codebase is deadline-bounded, and an
+    unbounded join is how a wedge propagates."""
+
+from __future__ import annotations
+
+from ..callgraph import PackageIndex
+from ..lint import Diagnostic
+from ..locks import build_lock_model
+
+RULE_ID = "join-hygiene"
+
+
+def check(index: PackageIndex) -> list:
+    model = build_lock_model(index)
+    out: list = []
+    thread_holders: dict = {}  # (module, leaf) -> spawn
+    for spawn in model.spawns:
+        if spawn.holder is not None:
+            thread_holders[(spawn.module, spawn.holder)] = spawn
+    for spawn in model.spawns:
+        if spawn.daemon:
+            continue
+        kind = "Timer" if spawn.timer else "Thread"
+        if spawn.holder is None:
+            out.append(Diagnostic(
+                path=spawn.module_path, line=spawn.lineno, rule=RULE_ID,
+                message=f"non-daemon {kind} spawned without a holder — "
+                        f"it can never be joined; mark it daemon=True "
+                        f"or keep a handle and join(timeout=...) on the "
+                        f"drain path",
+            ))
+            continue
+        joins = [
+            j for j in model.joins.get(spawn.holder, ())
+            if j[0] == spawn.module
+        ]
+        if not joins:
+            out.append(Diagnostic(
+                path=spawn.module_path, line=spawn.lineno, rule=RULE_ID,
+                message=f"non-daemon {kind} {spawn.holder!r} has no "
+                        f"join(timeout=...) in this module — a wedged "
+                        f"body blocks interpreter exit forever",
+            ))
+        elif not any(has_timeout for _, _, has_timeout in joins):
+            out.append(Diagnostic(
+                path=spawn.module_path, line=spawn.lineno, rule=RULE_ID,
+                message=f"non-daemon {kind} {spawn.holder!r} is only "
+                        f"ever joined UNBOUNDED — pass timeout= so a "
+                        f"wedge cannot hold shutdown hostage",
+            ))
+    # unbounded joins on known thread holders (daemon included)
+    for (leaf, joins) in sorted(model.joins.items()):
+        for module, lineno, has_timeout in joins:
+            if has_timeout:
+                continue
+            spawn = thread_holders.get((module, leaf))
+            if spawn is None:
+                continue
+            out.append(Diagnostic(
+                path=index.modules[module].path, line=lineno,
+                rule=RULE_ID,
+                message=f"unbounded .join() on thread {leaf!r} — the "
+                        f"PR-9 follower-wedge shape; pass timeout= and "
+                        f"handle the straggler",
+            ))
+    return out

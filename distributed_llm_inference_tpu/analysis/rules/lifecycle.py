@@ -1,0 +1,299 @@
+"""resource-lifecycle: every alloc/incref/acquire is released on every
+early-return path of the acquiring function.
+
+The PR-4 `_BLOCKED` leak shape, as a rule: paged admission increfs a
+shared block chain, then a LATER acquisition fails (constraint table
+full), and the function returns a retry sentinel without decref'ing
+what it already holds — the pool bleeds a few blocks per retry until
+admission wedges. The matching APIs in this codebase:
+
+    blocks = alloc.alloc(n)      ... alloc.decref(blocks) / .free(blocks)
+    alloc.incref(shared)         ... alloc.decref(shared)
+    off = table.acquire(art)     ... table.release(key)
+
+The rule tracks, per function and in source order: an ACQUIRE binds the
+target variable as a live resource; a RELEASE call (`decref`/`free`/
+`release`) naming it clears it; storing it into an attribute or
+subscript, or returning it, is an OWNERSHIP TRANSFER and clears it
+(request/instance state owns it now — the engine's real convention); a
+`return` while a resource is live is flagged. Returns inside an
+`if X is None:` (or `while X is None:`) body are exempt for X — that is
+the acquisition-FAILED branch. Releases in a `finally` cover the whole
+try statement. `raise` paths are deliberately out of scope: the
+supervisor's unwind handlers own those (and are themselves exercised by
+the chaos suite).
+
+Intentional leaks-on-return (true ownership transfer through a channel
+the tracker cannot see) use the standard reasoned suppression:
+`# jaxlint: disable=resource-lifecycle -- handed to X`."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..callgraph import PackageIndex, dotted
+from ..lint import Diagnostic
+
+RULE_ID = "resource-lifecycle"
+
+_ACQUIRE_ATTRS = {"alloc", "incref", "acquire"}
+_RELEASE_ATTRS = {"decref", "free", "release"}
+
+
+def _holder_name(node: ast.AST) -> Optional[str]:
+    """A trackable holder: bare Name or dotted attribute chain."""
+    if isinstance(node, ast.Name):
+        return node.id
+    d = dotted(node)
+    return d
+
+
+def _names_in(node: ast.AST) -> set:
+    out = set()
+    for child in ast.walk(node):
+        if isinstance(child, ast.Name):
+            out.add(child.id)
+        d = dotted(child)
+        if d is not None:
+            out.add(d)
+    return out
+
+
+def _release_targets(call: ast.Call) -> set:
+    """Holders a release call clears: every Name/attr in its args
+    (including list literals — `decref([b])` releases b)."""
+    out = set()
+    for arg in call.args:
+        out |= _names_in(arg)
+    return out
+
+
+def _is_acquire(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _ACQUIRE_ATTRS
+    )
+
+
+def _is_release(call: ast.Call) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr in _RELEASE_ATTRS
+    )
+
+
+def _scan_releases(stmts) -> set:
+    out = set()
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Call) and _is_release(node):
+                out |= _release_targets(node)
+    return out
+
+
+def _none_guard_var(test: ast.AST) -> Optional[str]:
+    """`X is None` / `not X` -> "X" (the acquisition-FAILED guard)."""
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.Is)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        return _holder_name(test.left)
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _holder_name(test.operand)
+    return None
+
+
+def _not_none_guard_var(test: ast.AST) -> Optional[str]:
+    """`X is not None` / bare `X` -> "X" (held in the body; the ELSE
+    branch — and the fallthrough past a terminating body — means the
+    acquisition failed)."""
+    if (
+        isinstance(test, ast.Compare)
+        and len(test.ops) == 1
+        and isinstance(test.ops[0], ast.IsNot)
+        and isinstance(test.comparators[0], ast.Constant)
+        and test.comparators[0].value is None
+    ):
+        return _holder_name(test.left)
+    if isinstance(test, (ast.Name, ast.Attribute)):
+        return _holder_name(test)
+    return None
+
+
+def _terminates(stmts) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+class _Tracker:
+    def __init__(self, path: str):
+        self.path = path
+        self.diags: list = []
+
+    def run(self, fn_node: ast.AST):
+        self.visit(fn_node.body, {})
+
+    # state: holder -> (lineno, what) for live resources
+    def visit(self, stmts, state: dict):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            self.statement(st, state)
+
+    def handle_calls(self, st: ast.AST, state: dict):
+        """Releases + bare increfs anywhere inside one leaf statement."""
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_release(node):
+                for h in _release_targets(node):
+                    state.pop(h, None)
+
+    def note_transfers(self, st: ast.AST, state: dict):
+        """Attribute / subscript stores referencing a live holder move
+        ownership out of the function's hands."""
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        if not targets or st.value is None:
+            return
+        if all(isinstance(t, ast.Name) for t in targets):
+            return  # local rebinding is not a transfer
+        referenced = _names_in(st.value)
+        for h in [h for h in state if h in referenced]:
+            state.pop(h, None)
+
+    def statement(self, st: ast.AST, state: dict):
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and isinstance(st.value, ast.Call) \
+                and _is_acquire(st.value):
+            self.handle_calls(st, state)  # releases in args, defensively
+            recv = dotted(st.value.func) or st.value.func.attr
+            state[st.targets[0].id] = (st.lineno, recv)
+            return
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call) \
+                and _is_acquire(st.value) \
+                and st.value.func.attr == "incref" and st.value.args:
+            h = _holder_name(st.value.args[0])
+            if h is not None:
+                state[h] = (st.lineno, dotted(st.value.func) or "incref")
+            return
+        if isinstance(st, ast.Return):
+            self.handle_calls(st, state)
+            returned = _names_in(st.value) if st.value is not None else set()
+            for h, (line, recv) in sorted(state.items()):
+                if h in returned:
+                    continue  # ownership transferred to the caller
+                self.diags.append(Diagnostic(
+                    path=self.path, line=st.lineno, rule=RULE_ID,
+                    message=f"return leaks {h!r} acquired via "
+                            f"{recv}() at line {line} — release it "
+                            f"(decref/free/release) on this path or "
+                            f"suppress with the ownership-transfer "
+                            f"reason",
+                ))
+            return
+        if isinstance(st, ast.If):
+            self.handle_calls(st.test, state)
+            guard = _none_guard_var(st.test)
+            pos = _not_none_guard_var(st.test)
+            body_state = dict(state)
+            if guard is not None:
+                body_state.pop(guard, None)  # acquisition failed here
+            else_state = dict(state)
+            if pos is not None:
+                else_state.pop(pos, None)  # failed on the else path
+            self.visit(st.body, body_state)
+            self.visit(st.orelse, else_state)
+            if _terminates(st.body) and not st.orelse:
+                # the body never falls through: onward state is the
+                # else path's (e.g. `if X is not None: return X` — X is
+                # definitely None afterwards)
+                state.clear()
+                state.update(else_state)
+                return
+            if st.orelse and _terminates(st.orelse) \
+                    and not _terminates(st.body):
+                state.clear()
+                state.update(body_state)
+                return
+            # optimistic merge: released in either branch counts (the
+            # flagged shape is the return INSIDE a branch, caught
+            # above); a guard-popped holder only counts released when
+            # gone from BOTH sides
+            for h in list(state):
+                if h not in body_state and h not in else_state:
+                    state.pop(h, None)
+                elif guard is None and pos is None and (
+                    h not in body_state or h not in else_state
+                ):
+                    state.pop(h, None)
+            return
+        if isinstance(st, ast.While):
+            self.handle_calls(st.test, state)
+            guard = _none_guard_var(st.test)
+            body_state = dict(state)
+            if guard is not None:
+                body_state.pop(guard, None)
+            self.visit(st.body, body_state)
+            self.visit(st.orelse, dict(state))
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self.handle_calls(st.iter, state)
+            self.visit(st.body, state)
+            self.visit(st.orelse, state)
+            return
+        if isinstance(st, ast.Try):
+            finally_released = _scan_releases(st.finalbody)
+            body_state = dict(state)
+            for h in finally_released:
+                body_state.pop(h, None)
+            self.visit(st.body, body_state)
+            for handler in st.handlers:
+                self.visit(handler.body, dict(state))
+            self.visit(st.orelse, body_state)
+            self.visit(st.finalbody, state)
+            for h in finally_released:
+                state.pop(h, None)
+            for h in list(state):
+                if h not in body_state:
+                    state.pop(h, None)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self.handle_calls(item.context_expr, state)
+            self.visit(st.body, state)
+            return
+        # leaf statement: transfers first (the engine's incref-then-
+        # store idiom), then releases
+        self.note_transfers(st, state)
+        self.handle_calls(st, state)
+
+
+def check(index: PackageIndex) -> list:
+    out: list = []
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            # cheap pre-filter: only functions containing an acquire
+            has = any(
+                isinstance(n, ast.Call) and _is_acquire(n)
+                for n in ast.walk(fn.node)
+            )
+            if not has:
+                continue
+            leaf = fn.qualname.rsplit(".", 1)[-1]
+            if leaf in _ACQUIRE_ATTRS | _RELEASE_ATTRS:
+                continue  # the allocator/table's own implementation
+            tracker = _Tracker(mod.path)
+            tracker.run(fn.node)
+            out.extend(tracker.diags)
+    return out

@@ -1,0 +1,128 @@
+"""lock-order: no lock-acquisition-order inversions in the control plane.
+
+The host control plane holds several locks (engine cv, shadow store
+lock, router replica/residency/rolling locks, metrics family locks) and
+acquires them from several threads. Deadlock needs exactly one shape: a
+cycle in the lock-ORDER graph — lock B acquired while A is held on one
+path, A while B is held on another. This rule builds that graph from
+the lock model (analysis/locks.py): direct nested `with` acquisitions
+contribute edges, and a call made while holding A contributes A -> every
+lock the callee may transitively acquire. Any cycle over DISTINCT locks
+is flagged at each participating acquisition site (re-entries of the
+same lock are not ordering facts and are ignored — RLock re-entry and
+by-name conflation would otherwise self-loop)."""
+
+from __future__ import annotations
+
+from ..callgraph import PackageIndex
+from ..lint import Diagnostic
+from ..locks import acquires_star, build_lock_model
+
+RULE_ID = "lock-order"
+
+
+def _edges(model) -> dict:
+    """{(a, b): [(path, line)]} — b acquired (directly or via a call)
+    while a is held."""
+    acq = acquires_star(model)
+    out: dict = {}
+    for key, facts in model.functions.items():
+        mod = model.index.modules[key[0]]
+        for held, lid, line in facts.acquisitions:
+            for h in held:
+                if h != lid:
+                    out.setdefault((h, lid), []).append((mod.path, line))
+        for held, callee, line in facts.calls:
+            if not held:
+                continue
+            for lid in acq.get(callee, ()):
+                for h in held:
+                    if h != lid:
+                        out.setdefault((h, lid), []).append(
+                            (mod.path, line)
+                        )
+    return out
+
+
+def _cycle_nodes(edges) -> set:
+    """Nodes on some cycle (Tarjan SCCs of size > 1; the self-loop case
+    is filtered at edge construction)."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    idx = {}
+    low = {}
+    stack = []
+    on = set()
+    out = set()
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan (the control plane is small, but recursion
+        # limits are not a failure mode a linter should have)
+        work = [(v, iter(graph[v]))]
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(graph[w])))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.update(scc)
+
+    for v in graph:
+        if v not in idx:
+            strongconnect(v)
+    return out
+
+
+def check(index: PackageIndex) -> list:
+    model = build_lock_model(index)
+    edges = _edges(model)
+    bad = _cycle_nodes(edges)
+    out: list = []
+    seen = set()
+    for (a, b), sites in sorted(
+        edges.items(), key=lambda kv: (kv[1][0], kv[0][0].label())
+    ):
+        if a not in bad or b not in bad:
+            continue
+        path, line = sites[0]
+        dedup = (path, line, a, b)
+        if dedup in seen:
+            continue
+        seen.add(dedup)
+        out.append(Diagnostic(
+            path=path, line=line, rule=RULE_ID,
+            message=f"lock-order inversion: {b.label()} is acquired "
+                    f"while holding {a.label()}, and the reverse order "
+                    f"exists elsewhere — a cross-thread deadlock shape",
+        ))
+    return out

@@ -1,0 +1,60 @@
+"""thread-reach: host-plane entry points stay out of every trace.
+
+The thread-aware call graph (analysis/callgraph.py) derives the host
+control plane from its real roots — `threading.Thread(target=...)` /
+`Timer` / `executor.submit` spawn targets, HTTP `do_*` handler methods,
+CLI `main`s, signal/atexit registrations — and `decode_unreachable()`
+(host-reachable minus traced-reachable, plus the annotated escape
+hatch) replaced the hand-pinned fixture list tests/test_analysis.py
+used to grow per PR. This rule is what makes that derivation SOUND:
+
+  * a THREAD ENTRY POINT that is also reachable from a jit root is a
+    host loop leaking into compiled code (its blocking waits, sleeps,
+    and mutations would land inside a trace) — flagged at the spawn;
+  * a function annotated `# jaxlint: decode-unreachable -- reason` that
+    IS traced-reachable is a broken promise — flagged at the def;
+  * an annotation without a reason is flagged, exactly like a
+    reasonless suppression.
+"""
+
+from __future__ import annotations
+
+from ..callgraph import (
+    PackageIndex, annotated_decode_unreachable, thread_roots,
+    traced_reachable,
+)
+from ..lint import Diagnostic
+
+RULE_ID = "thread-reach"
+
+
+def check(index: PackageIndex) -> list:
+    out: list = []
+    traced = traced_reachable(index)
+    for key, (path, lineno) in sorted(thread_roots(index).items()):
+        if key in traced:
+            out.append(Diagnostic(
+                path=path, line=lineno, rule=RULE_ID,
+                message=f"thread entry point {key[0]}.{key[1]} is "
+                        f"reachable from a jit root — a spawned loop's "
+                        f"blocking calls must never land inside a trace",
+            ))
+    for key, reason in sorted(annotated_decode_unreachable(index).items()):
+        mod = index.modules.get(key[0])
+        fn = mod.functions.get(key[1]) if mod else None
+        if fn is None:
+            continue
+        if not reason:
+            out.append(Diagnostic(
+                path=mod.path, line=fn.node.lineno, rule=RULE_ID,
+                message="decode-unreachable annotation without a reason "
+                        "— write `# jaxlint: decode-unreachable -- why "
+                        "this is host-only`",
+            ))
+        if key in traced:
+            out.append(Diagnostic(
+                path=mod.path, line=fn.node.lineno, rule=RULE_ID,
+                message=f"{key[1]} is annotated decode-unreachable but "
+                        f"IS reachable from a jit root",
+            ))
+    return out

@@ -422,6 +422,7 @@ class ContinuousEngine:
             None if self._ragged
             else self.backend.init_cache(1, self._scratch_seq)
         )
+        # guarded-by: _cv
         self._assignment: list[Optional[_Request]] = [None] * self.n_slots
         # Prefix reuse, one planner per fleet mode (both drive the shared
         # engine._prefix_plan seam):
@@ -534,12 +535,12 @@ class ContinuousEngine:
                 timeout_s=engine.engine_cfg.kv_fabric_timeout_s,
             )
         self._cv = threading.Condition()
-        self._queue: list[_Request] = []
-        self._closed = False
+        self._queue: list[_Request] = []  # guarded-by: _cv
+        self._closed = False  # guarded-by: _cv
         self._key = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
         # supervisor state (all worker-thread-mutated; readiness reads are
         # racy-but-monotone flags)
-        self._draining = False
+        self._draining = False  # guarded-by: _cv
         self._dead = False        # restart budget exhausted
         self._restarting = False  # mid crash-recovery (readiness = False)
         self._recovery: list[_Request] = []  # salvaged, awaiting re-admission
@@ -550,9 +551,9 @@ class ContinuousEngine:
         self._consecutive_crashes = 0
         self._mutation_seq = 0  # bumped per admission; chunks snapshot it
         # observability
-        self.admitted = 0
-        self.completed = 0
-        self.peak_occupancy = 0
+        self.admitted = 0  # guarded-by: _cv
+        self.completed = 0  # guarded-by: _cv
+        self.peak_occupancy = 0  # guarded-by: _cv
         self.restarts_total = 0
         self.recovered_total = 0
         self.poisoned_total = 0
@@ -717,7 +718,7 @@ class ContinuousEngine:
                 return True
         return False
 
-    def _note_queue_locked(self):
+    def _note_queue_locked(self):  # guarded-by: _cv
         """Refresh the global + per-SLO-class queue-depth gauges (caller
         holds the lock). One helper so every queue mutation keeps both
         views consistent."""
@@ -728,7 +729,7 @@ class ContinuousEngine:
         for name in self._slo:
             self._sched.set_depth(name, counts.get(name, 0))
 
-    def _class_depth_locked(self, cls_name: str) -> int:
+    def _class_depth_locked(self, cls_name: str) -> int:  # guarded-by: _cv
         return sum(1 for r in self._queue if r.slo == cls_name)
 
     def _cancel_env(self, req: _Request) -> dict:
@@ -2159,6 +2160,12 @@ class ContinuousEngine:
                     self._m_resume_s.observe(time.time() - req.preempted_at)
             except ValueError as e:
                 self._admitting = None
+                # a validation error can fire AFTER the block grant /
+                # constraint-row acquire (e.g. a malformed sampling
+                # kwarg float()s late): release everything this failed
+                # admission holds or the pool bleeds per bad request —
+                # the PR-4 _BLOCKED leak shape on the error path
+                self._free_slot_resources(req)
                 log.warning("invalid_request", error=str(e))
                 req.result = {
                     "error": f"Error: {e}", "status": "failed",
@@ -2582,6 +2589,12 @@ class ContinuousEngine:
                     wave.append((req, first_dev))  # past deadline), result set
             except ValueError as e:
                 self._admitting = None
+                # release the failed admission's grants (pool blocks,
+                # constraint row): a validation error raised between the
+                # grant and the insert (late float() of a malformed
+                # sampling kwarg, a constraint compile) must not leak —
+                # the PR-4 _BLOCKED leak shape on the error path
+                self._free_slot_resources(req)
                 log.warning("invalid_request", error=str(e))
                 req.result = {
                     "error": f"Error: {e}", "status": "failed",

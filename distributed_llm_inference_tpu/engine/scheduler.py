@@ -219,6 +219,7 @@ class TokenBudgetScheduler:
             return self.classes[name]
         return self.classes[self.default_name]
 
+    # jaxlint: decode-unreachable -- validation helper for embedders/tests; host-only by construction
     def valid(self, name: str) -> bool:
         return name in self.classes
 

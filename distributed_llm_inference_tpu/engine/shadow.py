@@ -115,15 +115,16 @@ class ShadowStore:
         self.block_size = int(block_size)
         self.max_blocks = max(1, int(max_blocks))
         self.max_pending = max(1, int(max_pending))
+        # guarded-by: _lock
         self._entries: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict()
         )
-        self._children: dict = {}  # key -> set of child keys
+        self._children: dict = {}  # key -> set of child keys; guarded-by: _lock
         # chunk-digest index over the resident keys (the same parent-
         # chained digests engine/block_prefix.chunk_digests exports for
         # router affinity), so the KV fabric's /kv lookups are O(1)
         # instead of a full-store digest sweep per request
-        self._digest_key: dict = {}  # digest hex -> key
+        self._digest_key: dict = {}  # digest hex -> key; guarded-by: _lock
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         # copier queue: (keys, dev_leaves, seq) batches; keys in
@@ -131,8 +132,8 @@ class ShadowStore:
         # a block whose copy is still in flight
         self._q: collections.deque = collections.deque()
         self._pending: set = set()
-        self._busy = False
-        self._closed = False
+        self._busy = False  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         self.copied = 0
         self.dropped = 0
         self.evicted = 0
@@ -361,7 +362,7 @@ class ShadowStore:
                 self._busy = False
                 self._cv.notify_all()
 
-    def _insert_locked(self, key: tuple, entry: _Entry):
+    def _insert_locked(self, key: tuple, entry: _Entry):  # guarded-by: _lock
         if key in self._entries:
             self._entries[key] = entry
             self._entries.move_to_end(key)
@@ -377,7 +378,7 @@ class ShadowStore:
                 break  # never evict what we just inserted
             self._evict_subtree_locked(victim)
 
-    def _evict_subtree_locked(self, key: tuple):
+    def _evict_subtree_locked(self, key: tuple):  # guarded-by: _lock
         """LRU eviction cascades through descendants, like the
         block-prefix index's: a chain with a missing interior block can
         never be restored, so children of an evicted block are dead
@@ -397,7 +398,7 @@ class ShadowStore:
             self._evict_subtree_locked(child)
         self._children.pop(key, None)
 
-    def _note_blocks_locked(self):
+    def _note_blocks_locked(self):  # guarded-by: _lock
         if self._m_blocks is not None:
             self._m_blocks.set(len(self._entries))
 

@@ -74,6 +74,7 @@ class FabricPayloadError(ValueError):
     recheck. Callers degrade to local prefill — never an error."""
 
 
+# jaxlint: decode-unreachable -- public digest helper for peers/tests; no in-package caller
 def chain_digest(ids, block_size: int) -> Optional[str]:
     """The deepest parent-chained digest of `ids`' full blocks — the name
     a peer would serve this prefix under — or None when `ids` has no full

@@ -135,9 +135,10 @@ class BatchingQueue:
             )
         self.max_wait_s = float(max_wait_ms) / 1e3
         self._cv = threading.Condition()
-        self._queue: list[_Pending] = []
-        self._closed = False
-        self._draining = False
+        self._queue: list[_Pending] = []  # guarded-by: _cv
+        self._closed = False  # guarded-by: _cv
+        self._draining = False  # guarded-by: _cv
+        # guarded-by: _cv
         self._busy = False  # dispatcher mid-group (drain must wait for it)
         self.coalesced_batches = 0  # observability: fleets actually formed
         # registry families (engine.metrics — one /metrics scrape covers
@@ -209,7 +210,7 @@ class BatchingQueue:
         dispatches as its own fleet, never coalesced with others)."""
         return self._submit(_Pending(prompts, kwargs, is_batch=True))
 
-    def _note_queue_locked(self):
+    def _note_queue_locked(self):  # guarded-by: _cv
         """Refresh the global + per-SLO-class depth gauges (caller holds
         the lock)."""
         self._m_depth.set(len(self._queue))
@@ -335,7 +336,7 @@ class BatchingQueue:
             return len(self._queue)
 
     # -- dispatcher ----------------------------------------------------------
-    def _take_group(self) -> list[_Pending]:
+    def _take_group(self) -> list[_Pending]:  # guarded-by: _cv
         """Pop the head request plus every compatible queued request (in
         arrival order) up to max_batch. Caller holds the lock."""
         head = self._queue.pop(0)

@@ -204,6 +204,7 @@ class Router:
         # token digest is the byte->token bridge the fabric needs: the
         # router has no tokenizer, so it can only name a fetchable chain
         # by remembering what the serving replica reported.
+        # guarded-by: _res_lock
         self._residency: "collections.OrderedDict[str, tuple]" = (
             collections.OrderedDict()
         )
@@ -212,10 +213,12 @@ class Router:
         # replica /health bootstraps (resident_digests), purged with
         # ejections — stale entries must not steer fabric pulls at a
         # corpse
+        # guarded-by: _res_lock
         self._kv_residency: "collections.OrderedDict[str, str]" = (
             collections.OrderedDict()
         )
         self._res_lock = threading.Lock()
+        # guarded-by: _roll_lock
         self.rolling: dict = {"active": False, "done": [], "current": None,
                               "error": None, "warm": {}}
         self._roll_lock = threading.Lock()

@@ -197,6 +197,7 @@ def arm(rules) -> FaultPlan:
     return _PLAN
 
 
+# jaxlint: decode-unreachable -- test-harness surface: only conftest/tests call it
 def disarm():
     global _PLAN
     _PLAN = None

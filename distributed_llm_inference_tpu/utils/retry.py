@@ -30,6 +30,7 @@ RETRY_STATUSES = (429, 503)
 BACKOFF_CAP_S = 8.0
 
 
+# jaxlint: decode-unreachable -- client-side policy helper; no in-package caller
 def is_retryable(status: int) -> bool:
     """True for the statuses a well-behaved caller may retry blindly."""
     return status in RETRY_STATUSES

@@ -1,7 +1,10 @@
-"""The compiled-decode invariant checker (analysis/): rule fixtures
-(positive + negative + suppressed per rule), call-graph reachability
-units on synthetic packages AND the real one, the CLI exit contract, and
-the compiled-artifact (HLO) assertions for solo and pp decode.
+"""The compiled-decode + host-control-plane invariant checker
+(analysis/): rule fixtures (positive + negative + suppressed per rule,
+the lock-discipline / resource-lifecycle / thread-reachability families
+included), DERIVED thread-aware reachability on the real package (the
+superset-of-the-old-pin-list regression), the CLI exit contract with
+seeded-violation fixtures for each control-plane rule, and the
+compiled-artifact (HLO) assertions for solo and pp decode.
 
 Selectable standalone: `pytest -m analysis`.
 """
@@ -16,7 +19,7 @@ import pytest
 
 from distributed_llm_inference_tpu.analysis import hlo
 from distributed_llm_inference_tpu.analysis.callgraph import (
-    build_index, traced_reachable,
+    build_index, decode_unreachable, thread_roots, traced_reachable,
 )
 from distributed_llm_inference_tpu.analysis.lint import run_lint
 
@@ -424,6 +427,587 @@ def test_route_counter_rule(tmp_path):
     assert "bad_stream" in diags[0].message
 
 
+# -- thread-reach: thread-aware reachability (fixtures) ----------------------
+
+THREAD_PKG = {
+    "engine/mod.py": """
+        import threading
+        import jax
+        import jax.numpy as jnp
+
+        def worker():
+            return jnp.sum(jnp.ones(3))
+
+        def spawn():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            return t
+    """,
+}
+
+
+def test_thread_reach_negative(tmp_path):
+    diags, _ = lint(tmp_path, THREAD_PKG, rules=["thread-reach"])
+    assert diags == []
+
+
+def test_thread_reach_positive_traced_thread_target(tmp_path):
+    files = dict(THREAD_PKG)
+    files["engine/mod.py"] += """
+        @jax.jit
+        def decode(x):
+            return worker() + x
+    """
+    diags, _ = lint(tmp_path, files, rules=["thread-reach"])
+    assert len(diags) == 1
+    assert "thread entry point" in diags[0].message
+    assert "worker" in diags[0].message
+
+
+def test_thread_reach_suppressed_with_reason(tmp_path):
+    files = dict(THREAD_PKG)
+    files["engine/mod.py"] = files["engine/mod.py"].replace(
+        "t = threading.Thread(target=worker, daemon=True)",
+        "t = threading.Thread(target=worker, daemon=True)"
+        "  # jaxlint: disable=thread-reach -- fixture: eager-only helper",
+    ) + """
+        @jax.jit
+        def decode(x):
+            return worker() + x
+    """
+    diags, suppressed = lint(tmp_path, files, rules=["thread-reach"])
+    assert diags == []
+    assert suppressed == 1
+
+
+def test_thread_reach_annotated_but_traced(tmp_path):
+    files = {
+        "engine/mod.py": """
+            import jax
+            import jax.numpy as jnp
+
+            # jaxlint: decode-unreachable -- fixture: believed host-only
+            def helper(x):
+                return jnp.sum(x)
+
+            @jax.jit
+            def decode(x):
+                return helper(x)
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["thread-reach"])
+    assert len(diags) == 1
+    assert "annotated decode-unreachable but IS reachable" in diags[0].message
+
+
+def test_thread_reach_annotation_needs_reason(tmp_path):
+    files = {
+        "engine/mod.py": """
+            # jaxlint: decode-unreachable
+            def host_helper(x):
+                return x
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["thread-reach"])
+    assert len(diags) == 1
+    assert "without a reason" in diags[0].message
+
+
+def test_derived_reachability_on_fixture(tmp_path):
+    """decode_unreachable() proves thread-spawned loops and their
+    callees host-only, and keeps traced helpers out."""
+    root = make_pkg(tmp_path, {
+        "engine/mod.py": """
+            import threading
+            import time
+            import jax
+            import jax.numpy as jnp
+
+            def hot(x):
+                return jnp.sum(x)
+
+            @jax.jit
+            def decode(x):
+                return hot(x)
+
+            def loop_body():
+                helper()
+
+            def helper():
+                time.sleep(0.01)
+
+            def spawn():
+                threading.Thread(target=loop_body, daemon=True).start()
+        """,
+    })
+    index = build_index(root)
+    derived = decode_unreachable(index)
+    assert ("engine.mod", "loop_body") in derived
+    assert ("engine.mod", "helper") in derived
+    assert ("engine.mod", "hot") not in derived
+    assert ("engine.mod", "decode") not in derived
+
+
+# -- lock-order: acquisition-order inversions (fixtures) ---------------------
+
+LOCK_ORDER_BAD = {
+    "engine/locky.py": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.l1 = threading.Lock()
+                self.l2 = threading.Lock()
+
+            def forward(self):
+                with self.l1:
+                    with self.l2:
+                        return 1
+
+            def backward(self):
+                with self.l2:
+                    with self.l1:
+                        return 2
+    """,
+}
+
+
+def test_lock_order_inversion_flagged(tmp_path):
+    diags, _ = lint(tmp_path, LOCK_ORDER_BAD, rules=["lock-order"])
+    assert len(diags) == 2, diags  # both edges of the cycle
+    assert all("inversion" in d.message for d in diags)
+    assert {d.line for d in diags} == {11, 16}
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    files = {
+        "engine/locky.py": LOCK_ORDER_BAD["engine/locky.py"].replace(
+            "with self.l2:\n                    with self.l1:",
+            "with self.l1:\n                    with self.l2:",
+        ),
+    }
+    diags, _ = lint(tmp_path, files, rules=["lock-order"])
+    assert diags == []
+
+
+def test_lock_order_inversion_through_a_call(tmp_path):
+    """The deadlock shape that spans functions: forward holds l1 and
+    CALLS a helper that takes l2; backward nests them the other way."""
+    files = {
+        "engine/locky.py": """
+            import threading
+
+            class A:
+                def __init__(self):
+                    self.l1 = threading.Lock()
+                    self.l2 = threading.Lock()
+
+                def forward(self):
+                    with self.l1:
+                        return self.helper()
+
+                def helper(self):
+                    with self.l2:
+                        return 1
+
+                def backward(self):
+                    with self.l2:
+                        with self.l1:
+                            return 2
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["lock-order"])
+    assert len(diags) == 2, diags
+    assert {d.line for d in diags} == {11, 19}
+
+
+def test_lock_order_suppressed_with_reason(tmp_path):
+    files = {
+        "engine/locky.py": LOCK_ORDER_BAD["engine/locky.py"]
+        .replace(
+            "with self.l2:\n                        return 1",
+            "with self.l2:"
+            "  # jaxlint: disable=lock-order -- fixture: A-then-B is canon\n"
+            "                        return 1",
+        )
+        .replace(
+            "with self.l1:\n                        return 2",
+            "with self.l1:"
+            "  # jaxlint: disable=lock-order -- fixture: migration window\n"
+            "                        return 2",
+        ),
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["lock-order"])
+    assert diags == []
+    assert suppressed == 2
+
+
+# -- blocking-under-lock (fixtures) ------------------------------------------
+
+BLOCKING_PKG = {
+    "serving/q.py": """
+        import threading
+        import time
+        import urllib.request
+
+        class Q:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def bad_sleep(self):
+                with self._cv:
+                    time.sleep(0.1)
+
+            def ok_sleep_outside(self):
+                time.sleep(0.1)
+                with self._cv:
+                    return 1
+
+            def ok_wait_on_held(self):
+                with self._cv:
+                    self._cv.wait(timeout=0.1)
+
+            def fetch(self):
+                return urllib.request.urlopen("http://peer/ready")
+
+            def bad_transitive(self):
+                with self._cv:
+                    return self.fetch()
+    """,
+}
+
+
+def test_blocking_under_lock_catalog(tmp_path):
+    diags, _ = lint(tmp_path, BLOCKING_PKG, rules=["blocking-under-lock"])
+    assert len(diags) == 2, diags
+    by_line = {d.line: d.message for d in diags}
+    assert 12 in by_line and "time.sleep" in by_line[12]
+    assert 28 in by_line and "fetch" in by_line[28]  # transitive call
+
+
+def test_blocking_under_lock_queue_put_and_join(tmp_path):
+    files = {
+        "serving/q.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = None
+                    self._t = None
+
+                def bad_put(self, x):
+                    with self._lock:
+                        self._q.put(x, block=True)
+
+                def ok_put_nowait(self, x):
+                    with self._lock:
+                        self._q.put_nowait(x)
+
+                def bad_join(self):
+                    with self._lock:
+                        self._t.join()
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["blocking-under-lock"])
+    assert len(diags) == 2, diags
+    msgs = " / ".join(d.message for d in diags)
+    assert "block=True" in msgs and ".join()" in msgs
+
+
+def test_blocking_under_lock_suppressed(tmp_path):
+    files = {
+        "serving/q.py": BLOCKING_PKG["serving/q.py"].replace(
+            "time.sleep(0.1)\n\n            def ok_sleep_outside",
+            "time.sleep(0.1)"
+            "  # jaxlint: disable=blocking-under-lock -- fixture: test-only pacing\n"
+            "\n            def ok_sleep_outside",
+        ).replace(
+            "return self.fetch()",
+            "return self.fetch()"
+            "  # jaxlint: disable=blocking-under-lock -- fixture: startup path, single-threaded",
+        ),
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["blocking-under-lock"])
+    assert diags == []
+    assert suppressed == 2
+
+
+# -- guarded-by (fixtures) ---------------------------------------------------
+
+GUARDED_PKG = {
+    "engine/state.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.depth = 0  # guarded-by: _lock
+
+            def good(self):
+                with self._lock:
+                    self.depth = 1
+
+            def bad(self):
+                self.depth = 2
+
+            # guarded-by: _lock
+            def _bump_locked(self):
+                self.depth += 1
+
+            def caller_bad(self):
+                self._bump_locked()
+
+            def caller_good(self):
+                with self._lock:
+                    self._bump_locked()
+    """,
+}
+
+
+def test_guarded_by_write_and_call_violations(tmp_path):
+    diags, _ = lint(tmp_path, GUARDED_PKG, rules=["guarded-by"])
+    assert len(diags) == 2, diags
+    by_line = {d.line: d.message for d in diags}
+    assert 14 in by_line and "outside its declared lock" in by_line[14]
+    assert 21 in by_line and "without holding" in by_line[21]
+
+
+def test_guarded_by_init_exempt_and_subscript_write(tmp_path):
+    files = {
+        "engine/state.py": """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.table = {}  # guarded-by: _lock
+                    self.table = {"seed": 1}  # __init__ is pre-sharing
+
+                def good(self, k, v):
+                    with self._lock:
+                        self.table[k] = v
+
+                def bad(self, k, v):
+                    self.table[k] = v
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["guarded-by"])
+    assert len(diags) == 1
+    assert diags[0].line == 15
+
+
+def test_guarded_by_suppressed_with_reason(tmp_path):
+    files = {
+        "engine/state.py": GUARDED_PKG["engine/state.py"].replace(
+            "self.depth = 2",
+            "self.depth = 2"
+            "  # jaxlint: disable=guarded-by -- fixture: single-threaded setup phase",
+        ).replace(
+            "def caller_bad(self):\n                self._bump_locked()",
+            "def caller_bad(self):\n                self._bump_locked()"
+            "  # jaxlint: disable=guarded-by -- fixture: lock held by caller's caller",
+        ),
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["guarded-by"])
+    assert diags == []
+    assert suppressed == 2
+
+
+# -- resource-lifecycle (fixtures) -------------------------------------------
+
+PR4_LEAK_PKG = {
+    "engine/admission.py": """
+        _BLOCKED = object()
+
+        class Admission:
+            def __init__(self, alloc, ctable):
+                self._alloc = alloc
+                self._ctable = ctable
+
+            def admit(self, req):
+                blocks = self._alloc.alloc(req.need)
+                if blocks is None:
+                    return _BLOCKED
+                off = self._ctable.acquire(req.cart)
+                if off is None:
+                    return _BLOCKED
+                req.block_ids = blocks
+                req.cart = (req.cart, off)
+                return req
+    """,
+}
+
+
+def test_lifecycle_catches_pr4_blocked_leak(tmp_path):
+    """The exact PR-4 shape: blocks granted, a LATER acquisition
+    backpressures, and the retry sentinel returns without decref'ing
+    what is already held."""
+    diags, _ = lint(tmp_path, PR4_LEAK_PKG, rules=["resource-lifecycle"])
+    assert len(diags) == 1, diags
+    assert diags[0].line == 15
+    assert "blocks" in diags[0].message and "alloc" in diags[0].message
+
+
+def test_lifecycle_release_on_every_path_is_clean(tmp_path):
+    files = {
+        "engine/admission.py": PR4_LEAK_PKG["engine/admission.py"].replace(
+            "if off is None:\n                    return _BLOCKED",
+            "if off is None:\n"
+            "                    self._alloc.decref(blocks)\n"
+            "                    return _BLOCKED",
+        ),
+    }
+    diags, _ = lint(tmp_path, files, rules=["resource-lifecycle"])
+    assert diags == []
+
+
+def test_lifecycle_incref_and_finally_and_transfer(tmp_path):
+    files = {
+        "engine/admission.py": """
+            class A:
+                def leak_incref(self, shared, cond):
+                    self._alloc.incref(shared)
+                    if cond:
+                        return None
+                    self._alloc.decref(shared)
+                    return 1
+
+                def ok_finally(self, req):
+                    blocks = self._alloc.alloc(req.need)
+                    if blocks is None:
+                        return None
+                    try:
+                        if req.bad:
+                            return None
+                        return blocks
+                    finally:
+                        self._alloc.decref(blocks)
+
+                def ok_transfer(self, req):
+                    blocks = self._alloc.alloc(req.need)
+                    if blocks is None:
+                        return None
+                    req.block_ids = blocks
+                    if req.fast:
+                        return req
+                    return req
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["resource-lifecycle"])
+    assert len(diags) == 1, diags
+    assert diags[0].line == 6
+    assert "shared" in diags[0].message
+
+
+def test_lifecycle_ownership_transfer_suppression(tmp_path):
+    files = {
+        "engine/admission.py": """
+            class A:
+                def handoff(self, pool):
+                    blocks = pool.alloc(4)
+                    if blocks is None:
+                        return None
+                    self.enqueue(blocks)
+                    return True  # jaxlint: disable=resource-lifecycle -- ownership moved to the enqueue consumer
+        """,
+    }
+    diags, suppressed = lint(
+        tmp_path, files, rules=["resource-lifecycle"]
+    )
+    assert diags == []
+    assert suppressed == 1
+
+
+# -- join-hygiene (fixtures) -------------------------------------------------
+
+def test_join_hygiene_non_daemon_without_join(tmp_path):
+    files = {
+        "serving/w.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["join-hygiene"])
+    assert len(diags) == 1
+    assert "no join(timeout=...)" in diags[0].message
+
+
+def test_join_hygiene_bounded_join_or_daemon_is_clean(tmp_path):
+    files = {
+        "serving/w.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+                    self._d = threading.Thread(target=self._run, daemon=True)
+                    self._d.start()
+
+                def close(self):
+                    self._t.join(timeout=5)
+
+                def _run(self):
+                    pass
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["join-hygiene"])
+    assert diags == []
+
+
+def test_join_hygiene_unbounded_join_flagged(tmp_path):
+    """The PR-9 follower-wedge shape: the drain path joins without a
+    timeout, so one wedged thread holds shutdown hostage."""
+    files = {
+        "serving/w.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def close(self):
+                    self._t.join()
+
+                def _run(self):
+                    pass
+        """,
+    }
+    diags, _ = lint(tmp_path, files, rules=["join-hygiene"])
+    assert len(diags) == 2, diags
+    msgs = " / ".join(d.message for d in diags)
+    assert "UNBOUNDED" in msgs and "unbounded .join()" in msgs
+
+
+def test_join_hygiene_suppressed(tmp_path):
+    files = {
+        "serving/w.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    # jaxlint: disable=join-hygiene -- fixture: process-lifetime thread, reaped by exit
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def _run(self):
+                    pass
+        """,
+    }
+    diags, suppressed = lint(tmp_path, files, rules=["join-hygiene"])
+    assert diags == []
+    assert suppressed == 1
+
+
 # -- call-graph units on the REAL package ------------------------------------
 
 @pytest.fixture(scope="module")
@@ -458,144 +1042,108 @@ def test_real_traced_set_excludes_host_code(real_reachable):
         assert key not in real_reachable, key
 
 
-def test_fault_hooks_decode_unreachable(real_reachable):
-    """The fault-injection harness (utils/faults.py) is strictly
-    host-side: no function in it — and none of the scheduler host-loop
-    functions that call faults.check — may be reachable from any jit
-    root. This is what keeps the chaos suite (tests/test_faults.py)
-    invisible to the compiled-decode invariants: check() can sleep and
-    raise precisely BECAUSE it can never be traced."""
-    fault_funcs = sorted(k for k in real_reachable if k[0] == "utils.faults")
-    assert not fault_funcs, fault_funcs
-    # the host-loop callers of faults.check stay untraced too — if one of
-    # these ever became a jit root, the hook (and its time.sleep wedge)
-    # would land in compiled code
+# -- DERIVED thread-aware reachability (replaces the per-PR manual pin
+# fixtures that grew here in PRs 5-11) --------------------------------------
+
+@pytest.fixture(scope="module")
+def real_index():
+    return build_index(PKG_ROOT)
+
+
+@pytest.fixture(scope="module")
+def real_derived(real_index, real_reachable):
+    return decode_unreachable(real_index, real_reachable)
+
+
+# What this file used to assert by hand, pin by pin, PR by PR. Whole
+# modules are enumerated at test time (so functions ADDED to a pinned
+# module stay covered); the explicit keys are the exact pins the old
+# fixtures carried. The derivation (host roots -> closure, minus the
+# traced set, plus the annotated escape hatch) must prove ALL of it.
+OLD_PIN_MODULES = (
+    "utils.faults", "engine.shadow", "engine.scheduler",
+    "serving.router", "utils.retry", "serving.kv_fabric",
+)
+OLD_PIN_FUNCS = [
+    ("engine.continuous", "ContinuousEngine._launch_chunk"),
+    ("engine.continuous", "ContinuousEngine._process"),
+    ("engine.continuous", "ContinuousEngine._admit_one"),
+    ("engine.continuous", "ContinuousEngine._supervise"),
+    ("engine.continuous", "ContinuousEngine._run_recovery"),
+    ("engine.engine", "InferenceEngine._generate_locked"),
+    ("engine.continuous", "ContinuousEngine._shadow_capture"),
+    ("engine.continuous", "ContinuousEngine._restore_shadow"),
+    ("engine.continuous", "ContinuousEngine._preempt_for"),
+    ("engine.continuous", "ContinuousEngine._victim_for"),
+    ("engine.continuous", "ContinuousEngine._alloc_with_pressure"),
+    ("engine.continuous", "ContinuousEngine._prepare_resume"),
+    ("engine.continuous", "ContinuousEngine._cancel_env"),
+    ("engine.continuous", "ContinuousEngine._deadline_env"),
+    ("engine.continuous", "ContinuousEngine._past_deadline"),
+    ("engine.scheduler", "TokenBudgetScheduler.select_victim"),
+    ("engine.scheduler", "TokenBudgetScheduler.victim_key"),
+    ("engine.paged", "build_ragged_meta"),
+    ("engine.continuous", "ContinuousEngine._ragged_ingest"),
+    ("engine.continuous", "ContinuousEngine._ragged_launch_args"),
+    ("engine.continuous", "ContinuousEngine._launch_mixed"),
+    ("engine.continuous", "ContinuousEngine._process_mixed"),
+    ("engine.continuous", "ContinuousEngine._start_job"),
+    ("engine.continuous", "ContinuousEngine._sched_loop"),
+    ("engine.continuous", "ContinuousEngine._fabric_prefetch"),
+    ("engine.continuous", "ContinuousEngine._import_fabric_chain"),
+    ("engine.continuous", "ContinuousEngine.fabric_chain"),
+    ("engine.continuous", "ContinuousEngine.fabric_digests"),
+]
+
+
+def test_derived_reachability_supersets_old_pins(real_index, real_derived):
+    """The thread-aware derivation proves (at least) everything the old
+    manual pin list asserted — the acceptance criterion that let the
+    pins be deleted. A miss here means a host root went undetected
+    (new spawn idiom?) or a helper lost its last host-side caller:
+    either derive it or annotate it `# jaxlint: decode-unreachable`."""
+    missing = [k for k in OLD_PIN_FUNCS if k not in real_derived]
+    assert not missing, missing
+    for mod_name in OLD_PIN_MODULES:
+        funcs = [
+            f.key for f in real_index.modules[mod_name].functions.values()
+        ]
+        missing = [k for k in funcs if k not in real_derived]
+        assert not missing, (mod_name, missing)
+
+
+def test_derived_set_disjoint_from_traced(real_derived, real_reachable):
+    """Soundness: nothing the derivation (or an annotation) calls
+    host-only may be reachable from a jit root. The thread-reach rule
+    enforces the annotated half in CI; this is the belt to that
+    suspender, over the whole derived set."""
+    overlap = sorted(real_derived & real_reachable)
+    assert not overlap, overlap
+
+
+def test_thread_roots_cover_the_control_plane_loops(real_index):
+    """The spawn-edge detector sees every long-lived control-plane
+    thread this repo starts — supervisor loop, shadow copier, queue
+    dispatcher, router prober, deadline-abandonment runner."""
+    roots = thread_roots(real_index)
     for key in [
-        ("engine.continuous", "ContinuousEngine._launch_chunk"),
-        ("engine.continuous", "ContinuousEngine._process"),
-        ("engine.continuous", "ContinuousEngine._admit_one"),
-        ("engine.continuous", "ContinuousEngine._supervise"),
-        ("engine.continuous", "ContinuousEngine._run_recovery"),
-        ("engine.engine", "InferenceEngine._generate_locked"),
+        ("engine.continuous", "ContinuousEngine._loop"),
+        ("engine.shadow", "ShadowStore._copier"),
+        ("serving.queue", "BatchingQueue._dispatch_loop"),
+        ("serving.router", "Router.start_prober._loop"),
+        ("engine.engine", "InferenceEngine._with_deadline.run"),
+        ("serving.multihost", "MirroredEngine.shutdown_followers._bcast"),
     ]:
-        assert key not in real_reachable, key
+        assert key in roots, key
 
 
-def test_shadow_store_decode_unreachable(real_reachable):
-    """The warm-recovery shadow store (engine/shadow.py) is strictly
-    host-side: its copier thread blocks on device->host transfers and
-    its persistence does file I/O — none of it may be reachable from a
-    jit root, exactly like utils/faults.py. The engine-side capture /
-    restore drivers stay untraced too; only the tiny gather/scatter
-    PROGRAMS (engine/paged.gather_shadow_blocks /
-    restore_shadow_blocks) touch the device, as their own jit roots."""
-    shadow_funcs = sorted(
-        k for k in real_reachable if k[0] == "engine.shadow"
-    )
-    assert not shadow_funcs, shadow_funcs
-    for key in [
-        ("engine.continuous", "ContinuousEngine._shadow_capture"),
-        ("engine.continuous", "ContinuousEngine._restore_shadow"),
-    ]:
-        assert key not in real_reachable, key
-
-
-def test_preemption_host_paths_decode_unreachable(real_reachable):
-    """The SLO-aware preemption machinery (victim selection, the
-    swap-to-host flush, the resume-queue restore, the pressure ladder)
-    and the deadline/cancellation checks are strictly host-side launch-
-    boundary logic: time.time/wall-clock comparisons, allocator walks,
-    and a SYNCHRONOUS shadow flush — exactly the host syncs the hot-path
-    lint exists to keep out of compiled code. None may be reachable from
-    any jit root (the acceptance criterion's 'zero new host syncs in the
-    decode hot path'); only the pre-existing restore/gather PROGRAMS
-    touch the device, as their own jit roots."""
-    for key in [
-        ("engine.continuous", "ContinuousEngine._preempt_for"),
-        ("engine.continuous", "ContinuousEngine._victim_for"),
-        ("engine.continuous", "ContinuousEngine._alloc_with_pressure"),
-        ("engine.continuous", "ContinuousEngine._prepare_resume"),
-        ("engine.continuous", "ContinuousEngine._cancel_env"),
-        ("engine.continuous", "ContinuousEngine._deadline_env"),
-        ("engine.continuous", "ContinuousEngine._past_deadline"),
-        ("engine.scheduler", "TokenBudgetScheduler.select_victim"),
-        ("engine.scheduler", "TokenBudgetScheduler.victim_key"),
-    ]:
-        assert key not in real_reachable, key
-
-
-def test_ragged_host_planner_decode_unreachable(real_reachable):
-    """The ragged launch planner (engine/paged.build_ragged_meta — numpy
-    metadata assembly) and the continuous engine's launch-loop callers
-    are strictly host-side: none may be reachable from a jit root, or
-    their numpy work would land inside compiled programs. The TRACED half
-    of the ragged path (make_ragged_fill_hook's closure, the kernel) must
-    stay reachable — that is what the host-sync rule audits."""
-    for key in [
-        ("engine.paged", "build_ragged_meta"),
-        ("engine.continuous", "ContinuousEngine._ragged_ingest"),
-        ("engine.continuous", "ContinuousEngine._ragged_launch_args"),
-    ]:
-        assert key not in real_reachable, key
+def test_traced_halves_stay_reachable(real_reachable):
+    """The derivation must not swallow the TRACED halves of the paged
+    path: the ragged fill closure and the mixed epilogue execute inside
+    compiled programs, and the host-sync rule audits them only while
+    they stay in the traced set."""
     assert ("engine.paged", "make_ragged_fill_hook.hook") in real_reachable
-
-
-def test_chunked_scheduler_decode_unreachable(real_reachable):
-    """The SLO-aware chunked-prefill scheduler (engine/scheduler.py) is
-    pure host-side planning — numpy/time/metrics work that must never
-    land in a compiled program. Same pin as the ragged meta builder; the
-    TRACED half of the chunked path (engine/paged.mixed_step_ragged's
-    epilogue via slot_step) stays reachable."""
-    sched_funcs = sorted(
-        k for k in real_reachable if k[0] == "engine.scheduler"
-    )
-    assert not sched_funcs, sched_funcs
-    for key in [
-        ("engine.continuous", "ContinuousEngine._launch_mixed"),
-        ("engine.continuous", "ContinuousEngine._process_mixed"),
-        ("engine.continuous", "ContinuousEngine._start_job"),
-        ("engine.continuous", "ContinuousEngine._sched_loop"),
-    ]:
-        assert key not in real_reachable, key
     assert ("engine.paged", "mixed_epilogue") in real_reachable
-
-
-def test_router_tier_decode_unreachable(real_reachable):
-    """The replica router (serving/router.py) is host-side glue — an
-    HTTP front tier that never touches an engine or jax. Nothing in it
-    may be reachable from any jit root: its blocking urllib calls,
-    time.sleep waits, and subprocess management are exactly the host
-    syncs the hot-path lint exists to keep out of compiled code. Same
-    pin as utils/faults.py."""
-    router_funcs = sorted(
-        k for k in real_reachable if k[0] == "serving.router"
-    )
-    assert not router_funcs, router_funcs
-    # the shared retry policy it leans on stays host-side too
-    retry_funcs = sorted(k for k in real_reachable if k[0] == "utils.retry")
-    assert not retry_funcs, retry_funcs
-
-
-def test_kv_fabric_decode_unreachable(real_reachable):
-    """The cross-replica KV fabric (serving/kv_fabric.py) is strictly
-    host-side: blocking urllib fetches with deadlines, npz codec work,
-    digest recomputation. None of it — and none of the continuous
-    engine's fetch/import drivers — may be reachable from a jit root:
-    fabric fetches happen ONLY at the admission host boundary, and the
-    only device work they trigger is the pre-existing pre-warmed
-    restore_shadow_blocks scatter, as its own jit root. Same pin as the
-    router tier and utils/faults.py."""
-    fabric_funcs = sorted(
-        k for k in real_reachable if k[0] == "serving.kv_fabric"
-    )
-    assert not fabric_funcs, fabric_funcs
-    for key in [
-        ("engine.continuous", "ContinuousEngine._fabric_prefetch"),
-        ("engine.continuous", "ContinuousEngine._import_fabric_chain"),
-        ("engine.continuous", "ContinuousEngine.fabric_chain"),
-        ("engine.continuous", "ContinuousEngine.fabric_digests"),
-    ]:
-        assert key not in real_reachable, key
 
 
 def test_repo_is_clean():
@@ -643,6 +1191,81 @@ def test_cli_item_in_decode_reachable_function_exits_nonzero(tmp_path):
     assert "host-sync" in r.stdout
     # file:line diagnostics
     assert "generate.py:" in r.stdout and ".item()" in r.stdout
+
+
+_SEEDED_VIOLATIONS = {
+    "lock-order": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self.l1 = threading.Lock()
+                self.l2 = threading.Lock()
+
+            def forward(self):
+                with self.l1:
+                    with self.l2:
+                        return 1
+
+            def backward(self):
+                with self.l2:
+                    with self.l1:
+                        return 2
+    """,
+    "blocking-under-lock": """
+        import threading
+        import time
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.5)
+    """,
+    "guarded-by": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.depth = 0  # guarded-by: _lock
+
+            def bump(self):
+                self.depth += 1
+    """,
+    "resource-lifecycle": """
+        _BLOCKED = object()
+
+        class Admission:
+            def admit(self, req):
+                blocks = self._alloc.alloc(req.need)
+                if blocks is None:
+                    return _BLOCKED
+                off = self._ctable.acquire(req.cart)
+                if off is None:
+                    return _BLOCKED
+                req.block_ids = blocks
+                req.cart = (req.cart, off)
+                return req
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_SEEDED_VIOLATIONS))
+def test_cli_seeded_violation_fixtures_exit_nonzero(tmp_path, rule):
+    """The acceptance contract for the host-control-plane rules: a
+    seeded violation of each family (lock inversion, blocking call
+    under a lock, guarded-by write, the PR-4 refcount leak) fails the
+    CLI with a file:line diagnostic naming the rule."""
+    root = make_pkg(tmp_path, {
+        "engine/seeded.py": _SEEDED_VIOLATIONS[rule],
+    })
+    r = _run_cli("--root", root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert rule in r.stdout
+    assert "seeded.py:" in r.stdout
 
 
 # -- compiled-artifact (HLO) assertions --------------------------------------

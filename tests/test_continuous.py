@@ -557,3 +557,39 @@ def test_slot_max_seq_clamps_decode_budget(solo_engine):
         assert r["tokens_generated"] <= 48 - r["prompt_tokens"] - 1
     finally:
         cont.close()
+
+
+def test_invalid_kwarg_after_block_grant_releases_pool(solo_engine):
+    """Regression (lock-discipline/lifecycle audit, PR 12): a ValueError
+    raised AFTER the paged admission's block grant — e.g. a malformed
+    sampling kwarg whose float() only runs at arming time — must release
+    the granted blocks (and any constraint row) before the
+    invalid_request envelope is delivered. Pre-fix, the except handler
+    in _admit/_start_jobs pushed the envelope without touching
+    req.block_ids, bleeding the pool on every malformed embedded
+    request — the PR-4 _BLOCKED leak shape on the error path."""
+    eng = solo_engine
+    cont = ContinuousEngine(
+        InferenceEngine(
+            eng.cfg, params=eng.backend.params,
+            engine_cfg=EngineConfig(prefill_buckets=(32, 64)),
+        ),
+        n_slots=2, chunk_steps=4, slot_max_seq=192,
+        kv_pool_blocks=40, kv_block_size=16,
+    )
+    try:
+        total = cont._alloc.free_blocks
+        for _ in range(3):  # a leak compounds; hygiene must not
+            out = cont.submit(
+                "hello there", max_tokens=4, chat=False,
+                repetition_penalty="bogus",
+            )
+            assert out["error_type"] == "invalid_request"
+            assert "failed" == out["status"]
+            assert cont._alloc.free_blocks == total, "pool leaked blocks"
+        # the fleet still serves clean requests afterwards
+        ok = cont.submit("hello there", max_tokens=4, greedy=True,
+                         chat=False)
+        assert ok["status"] == "success"
+    finally:
+        cont.close()
