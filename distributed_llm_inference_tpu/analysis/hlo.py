@@ -342,6 +342,131 @@ def check_mixed_no_recompile(engine=None) -> list:
     return []
 
 
+def _spec_mixed_args(engine, n_spec: int, n_draft: int, chunk: int,
+                     width: int = 32, k_max: int = 4):
+    """Operand tuple for the SPECULATIVE mixed scheduler step: the
+    _mixed_args fleet plus `n_spec` verify rows of `n_draft` drafts each
+    (n-gram mode — the drafts ride the host token plan). The accept
+    pattern is pure DATA (token contents vs the model's argmax), so
+    every composition must share one compiled program."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..engine import generate as G
+    from ..engine import paged as EP
+
+    cfg = engine.cfg.replace(attn_impl="pallas")
+    bs, MB, B = 16, 4, 2
+    pool = EP.init_pool(cfg, 2 * MB + 2, bs)
+    table = jnp.asarray(
+        [list(range(1, MB + 1)), list(range(MB + 1, 2 * MB + 1))], jnp.int32
+    )
+    K1 = k_max + 1
+    entries = [
+        (b, 4 + b, (1 + n_draft) if b < n_spec else 1,
+         EP.RAGGED_PREFILL if b < n_spec else EP.RAGGED_DECODE)
+        for b in range(B)
+    ] + [(1, 0, chunk, EP.RAGGED_PREFILL)]
+    meta, tok_row, tok_pos, offsets, _ = EP.build_ragged_meta(
+        entries, width=width, tile=8,
+    )
+    toks = np.zeros((width,), np.int32)
+    dec_flag = np.zeros((width,), bool)
+    dec_idx = np.zeros((B,), np.int32)
+    dec_on = np.zeros((B,), bool)
+    sp_on = np.zeros((B,), bool)
+    sp_idx = np.zeros((B, K1), np.int32)
+    sp_nd = np.zeros((B,), np.int32)
+    for b in range(B):
+        off = offsets[b]
+        dec_flag[off] = True
+        if b < n_spec:
+            sp_on[b] = True
+            sp_nd[b] = n_draft
+            idxs = off + np.arange(K1, dtype=np.int32)
+            idxs[n_draft + 1:] = off + n_draft
+            sp_idx[b] = idxs
+            toks[off + 1 : off + 1 + n_draft] = 1 + np.arange(n_draft)
+        else:
+            dec_on[b] = True
+            dec_idx[b] = off
+    off = offsets[B]
+    toks[off : off + chunk] = 1
+    state, sparams = G.init_slots(B, cfg.vocab_size)
+    state = state._replace(
+        active=jnp.ones((B,), bool), remaining=jnp.full((B,), 6, jnp.int32),
+        pos=jnp.asarray([4, 5], jnp.int32),
+    )
+    arm = EP.idle_mixed_arm(B, cfg.vocab_size)
+    spec = EP.SpecPlan(
+        jnp.asarray(dec_on), jnp.asarray(sp_on), jnp.asarray(sp_idx),
+        jnp.asarray(sp_nd),
+    )
+    return (
+        cfg, engine.backend.params, jnp.asarray(toks), jnp.asarray(tok_row),
+        jnp.asarray(tok_pos), jnp.asarray(dec_flag), jnp.asarray(meta),
+        pool, table, state, sparams, jax.random.PRNGKey(0),
+        jnp.asarray(dec_idx), arm, spec,
+    )
+
+
+def lower_spec_mixed_step(engine=None, n_spec: int = 1, n_draft: int = 3,
+                          chunk: int = 9) -> str:
+    """StableHLO of the REAL speculative mixed launch (verify rows +
+    decode rows + prefill chunks in one program) — declared pool
+    donation intact, traced accept/reject inside."""
+    from ..engine import paged as EP
+
+    engine = engine or tiny_engine()
+    return EP.mixed_step_ragged.lower(
+        *_spec_mixed_args(engine, n_spec, n_draft, chunk)
+    ).as_text()
+
+
+def check_spec_mixed_shape_stability(engine=None) -> list:
+    """Two DIFFERENT speculative compositions (verify-row count, draft
+    length, chunk length) must lower to the IDENTICAL program: accept
+    patterns and per-slot draft lengths are plan DATA — any
+    composition-dependent shape would recompile per accept pattern."""
+    engine = engine or tiny_engine()
+    a = lower_spec_mixed_step(engine, n_spec=1, n_draft=3, chunk=9)
+    b = lower_spec_mixed_step(engine, n_spec=2, n_draft=2, chunk=14)
+    if a != b:
+        return [
+            "speculative mixed step lowered DIFFERENT programs for two "
+            "verify-row compositions — some per-step spec plan value "
+            "became shape-specializing (compile-per-accept-pattern in "
+            "production)"
+        ]
+    return []
+
+
+def check_spec_mixed_no_recompile(engine=None) -> list:
+    """Execute the speculative mixed step with two different verify
+    compositions; the jit cache must not grow (one compiled program for
+    every accept pattern — the machine check ISSUE 13 names)."""
+    import jax
+
+    from ..engine import paged as EP
+
+    engine = engine or tiny_engine()
+    out = EP.mixed_step_ragged(*_spec_mixed_args(engine, 1, 3, 9))
+    jax.block_until_ready(out[0])
+    size_after_first = EP.mixed_step_ragged._cache_size()
+    out = EP.mixed_step_ragged(*_spec_mixed_args(engine, 2, 2, 14))
+    jax.block_until_ready(out[0])
+    size_after_second = EP.mixed_step_ragged._cache_size()
+    if size_after_second > size_after_first:
+        return [
+            f"speculative mixed step recompiled across verify "
+            f"compositions (jit cache grew {size_after_first} -> "
+            f"{size_after_second}) — accept patterns must stay traced "
+            f"data"
+        ]
+    return []
+
+
 def pp_available() -> bool:
     import jax
 
@@ -436,6 +561,20 @@ def run_hlo_checks() -> dict:
         engine
     )
     results["sched-mixed-recompile-guard"] = check_mixed_no_recompile(engine)
+
+    # speculative mixed step (ISSUE 13: draft-then-verify inside the
+    # mixed launch): the verify rows' accept/reject must stay fully
+    # traced — zero host callbacks, pool donation intact, and ONE
+    # compiled program across every accept pattern / verify composition
+    spec_mixed = lower_spec_mixed_step(engine)
+    results["spec-mixed-callbacks"] = check_no_host_callbacks(spec_mixed)
+    results["spec-mixed-donation"] = check_donation(spec_mixed, min_aliased=2)
+    results["spec-mixed-shape-stability"] = check_spec_mixed_shape_stability(
+        engine
+    )
+    results["spec-mixed-recompile-guard"] = check_spec_mixed_no_recompile(
+        engine
+    )
 
     if pp_available():
         pp = lower_pp_decode()

@@ -422,6 +422,33 @@ class EngineConfig:
     # and /health — specialization is routing policy, not a different
     # engine.
     replica_class: str = "mixed"
+    # Speculative decoding on the ragged paged fleet (engine/continuous.py
+    # + engine/paged.py spec programs): eligible greedy decode slots
+    # submit a [current + K-token draft] VERIFY row instead of a 1-token
+    # decode row inside the mixed scheduler launch — the ragged kernel
+    # already serves arbitrary-length rows, so verifying K drafts costs
+    # ~one decode step of weight streaming and accepts up to K+1 tokens.
+    # Accept/reject is fully traced (match-prefix + correction token on
+    # device, packed into the existing fetch — zero host syncs, one
+    # compiled program for every accept pattern). Greedy acceptance is
+    # bit-identical to plain decode. spec_draft_len = drafted tokens per
+    # verify row (0 disables the machinery entirely).
+    spec_draft_len: int = 4
+    # Fleet-wide self-speculation: True speculates for EVERY eligible
+    # greedy slot; False speculates only for requests that ask
+    # ("speculative": true on /generate). Either way the scheduler
+    # throttles drafting to 0 under decode TPOT pressure (speculation
+    # accelerates idle fleets and self-disables under load), and a slot
+    # whose history has no draft to offer submits a plain decode row —
+    # non-repetitive streams pay nothing.
+    spec_decode: bool = False
+    # Draft-model speculation for the fleet (the decode_draft_speculative
+    # flavor): registry name of a small same-tokenizer model whose greedy
+    # chain proposes the drafts (device-side, batched over the fleet,
+    # sharing the SAME block tables over its own pool leaves) instead of
+    # n-gram lookup. A draft already attached via engine.set_draft()
+    # takes precedence over loading this name. None = n-gram drafts.
+    spec_draft_model: Optional[str] = None
     # SLO-aware KV preemption (engine/continuous.py _preempt_for): when a
     # paged admission still cannot get blocks after the evict-
     # unreferenced-chains retry, the scheduler preempts the lowest-SLO-

@@ -28,9 +28,36 @@ inside its shard_map ring (parallel/pipeline._build_decode_slots — each
 step is S gated microsteps, dp must be 1). Llama AND gpt2 families: slots
 need no left-padding (every slot starts at position 0), so gpt2's learned
 absolute positions stay exact — the one batching mode gpt2 supports.
-Seeded / debug / speculative requests fall back to the solo engine — their
-contracts (deterministic RNG stream, single-stream prefill logits, draft
-verification) are per-request, not per-fleet.
+Seeded / debug requests fall back to the solo engine — their contracts
+(deterministic RNG stream, single-stream prefill logits) are per-request,
+not per-fleet. Greedy `speculative` requests run IN-FLEET on ragged paged
+chunked fleets (draft-then-verify rows inside the mixed launch — see
+"Speculative decoding" below); only fleets without the mixed program
+still serve them solo.
+
+Speculative decoding (ISSUE 13; ARCHITECTURE.md "Speculative decoding"):
+eligible greedy decode slots submit a [current + K-token draft] VERIFY
+row instead of a 1-token decode row in the mixed scheduler launch — the
+ragged kernel already serves arbitrary-length rows, so verifying K
+drafts costs ~one decode step of weight streaming and emits up to K+1
+tokens. Drafts are host-planned n-gram lookups against the slot's own
+fetched history (engine/scheduler.ngram_draft; zero extra weights) or,
+cfg-gated, a small draft model's device-side greedy chain sharing the
+fleet's block tables (engine_cfg.spec_draft_model). Accept/reject is
+fully traced (engine/paged.spec_verify — match-prefix + correction token
+on device, packed into the existing fetch), the slot's position simply
+advances by the accepted count (rejected draft K/V beyond the new
+frontier is overwritten before it can be attended or shadow-captured),
+and the host position model resyncs from the fetched advance — a slot
+with an unfetched verify row is skipped (frozen on device via
+SpecPlan.dec_on) until its fetch lands, so the kernel's host-planned
+q_start metadata stays exact. Speculated tokens debit step_token_budget
+(TokenBudgetScheduler.spec_draft_len), so the SLO layer throttles K to 0
+under decode TPOT pressure — speculation accelerates idle fleets and
+self-disables under load. Greedy output is bit-identical to
+non-speculative decode (spec_verify replicates slot_step token for
+token), crash/preemption salvage included (unfetched verify emissions
+drop exactly like unfetched chunks).
 
 Failure containment (ARCHITECTURE.md "Failure containment"): the worker
 loop runs under a SUPERVISOR (_loop/_supervise). A crash anywhere in the
@@ -73,6 +100,7 @@ import numpy as np
 
 from ..utils import faults
 from ..utils.logging import get_logger
+from ..utils.metrics import DEFAULT_SIZE_BUCKETS
 from ..utils.retry import overload_retry_after
 from ..utils.tracing import Trace
 from . import generate as G
@@ -95,6 +123,7 @@ class _Request:
         "ids", "shadow_depth", "recovering",
         "deadline_at", "cancel_cause", "preemptions", "preempted_at",
         "resume_seq", "drop_seq", "kv_hint", "fabric_blocks",
+        "spec_want", "spec_drafted", "spec_accepted", "spec_launches",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
@@ -189,6 +218,15 @@ class _Request:
         # blocks imported over the fabric for this request (envelope
         # observability: the router reads it to score handoff outcomes)
         self.fabric_blocks = 0
+        # speculative decoding (mixed-fleet draft-then-verify): the
+        # request asked for it ("speculative": true — fleet-wide
+        # engine_cfg.spec_decode makes every eligible greedy request a
+        # candidate too), plus per-request draft/accept/launch counts
+        # for the envelope
+        self.spec_want = bool(kwargs.get("speculative"))
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_launches = 0
 
 
 class ContinuousEngine:
@@ -397,6 +435,45 @@ class ContinuousEngine:
             self._idle_arm = _P_arm.idle_mixed_arm(
                 self.n_slots, cfg.vocab_size
             )
+        # Speculative decoding on the mixed fleet (ISSUE 13): eligible
+        # greedy decode slots submit [current + K-draft] verify rows
+        # inside the mixed launch. Host state: which slots have an
+        # UNFETCHED verify row (skipped from planning until the packed
+        # fetch resyncs their position) and how many unfetched launches
+        # carry each slot at all (n-gram drafts read the fetched
+        # history; a fully-fetched slot drafts from its true frontier).
+        ecfg = engine.engine_cfg
+        self._spec_k_max = max(0, int(getattr(ecfg, "spec_draft_len", 0)))
+        self._spec_auto = bool(getattr(ecfg, "spec_decode", False))
+        self._spec_capable = bool(self._chunked and self._spec_k_max > 0)
+        self._spec_inflight: dict = {}  # slot -> (req, n_draft) unfetched
+        self._row_inflight = np.zeros((self.n_slots,), np.int64)
+        self.spec_launches = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        # cfg-gated draft model (the decode_draft_speculative flavor):
+        # a small same-tokenizer model proposes drafts device-side,
+        # batched over the fleet, over its OWN pool leaves indexed by
+        # the SAME block tables — draft KV shares the target pool's
+        # allocation lifecycle for free. An attached engine.set_draft()
+        # draft takes precedence over loading the named config.
+        self._draft_mode = False
+        self._dcfg = self._dparams = self._dpool = None
+        if self._spec_capable and getattr(ecfg, "spec_draft_model", None):
+            if engine._draft is None:
+                from ..models.registry import get_model_config
+
+                engine.set_draft(get_model_config(ecfg.spec_draft_model))
+            self._dcfg, self._dparams = engine._draft
+            if self._dcfg.arch not in ("llama", "gpt2"):
+                raise ValueError(
+                    f"spec_draft_model must be a llama/gpt2-family config "
+                    f"(the paged hook seam); got {self._dcfg.arch!r}"
+                )
+            self._dpool = self._P.init_pool(
+                self._dcfg, self._pool_blocks, self.kv_block_size
+            )
+            self._draft_mode = True
         self.state, self.sparams = G.init_slots(self.n_slots, cfg.vocab_size)
         # Grammar-constraint fleet state (constrain/): per-slot FSM rows
         # into the COMBINED resident table (row 0 = the free state every
@@ -678,6 +755,33 @@ class ContinuousEngine:
             "dli_sched_decode_rows_total",
             "decode rows carried by mixed scheduler launches",
         ).labels()
+        # fleet speculative-decoding families (pre-registered in
+        # engine/engine.py): draft/accept/reject token flow, verify-row
+        # launches by draft source, tokens-per-launch distribution
+        self._m_spec_drafted = m.counter(
+            "dli_spec_drafted_tokens_total",
+            "draft tokens submitted in mixed-launch verify rows",
+        ).labels()
+        self._m_spec_accepted = m.counter(
+            "dli_spec_accepted_tokens_total",
+            "draft tokens accepted (matched the model's own argmax and "
+            "were emitted)",
+        ).labels()
+        self._m_spec_rejected = m.counter(
+            "dli_spec_rejected_tokens_total",
+            "draft tokens rejected by the traced verify",
+        ).labels()
+        self._m_spec_launches = m.counter(
+            "dli_spec_launches_total",
+            "verify rows launched inside mixed scheduler steps, by draft "
+            "source", ("mode",),
+        )
+        self._m_spec_hist = m.histogram(
+            "dli_spec_tokens_per_launch",
+            "tokens emitted per verify row (accepted drafts + the "
+            "correction token; > 1 is the speculation win)",
+            buckets=DEFAULT_SIZE_BUCKETS,
+        ).labels()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="continuous-engine"
         )
@@ -686,13 +790,17 @@ class ContinuousEngine:
     # -- client side ---------------------------------------------------------
     def _needs_solo(self, kwargs: dict) -> bool:
         """Contracts slots cannot honor (deterministic RNG stream, single-
-        stream prefill logits, draft verification, per-token logprob
-        buffers) run solo on the wrapped engine — one condition shared by
-        submit() and stream()."""
+        stream prefill logits, per-token logprob buffers) run solo on the
+        wrapped engine — one condition shared by submit() and stream().
+        Speculative requests run IN-FLEET on ragged paged chunked fleets
+        (draft-then-verify rows inside the mixed launch; non-greedy /
+        penalized ones simply decode plainly there) — the solo fallback
+        remains only for seeded/debug contracts and for fleets without
+        the mixed program."""
         if (
             kwargs.get("seed") is not None
             or bool(kwargs.get("debug"))
-            or bool(kwargs.get("speculative"))
+            or (bool(kwargs.get("speculative")) and not self._spec_capable)
             or bool(kwargs.get("logprobs"))
             # slots share one sampling program; a per-request [V] bias
             # isn't in the slot params
@@ -870,9 +978,11 @@ class ContinuousEngine:
         caller iterates on its own thread (e.g. an HTTP handler writing
         NDJSON lines); the worker thread pushes into a per-request queue.
 
-        Seeded / debug / speculative requests cannot stream (they run solo
-        on the wrapped engine, which decodes entirely on-device) — one
-        final envelope event is yielded instead.
+        Seeded / debug requests cannot stream (they run solo on the
+        wrapped engine, which decodes entirely on-device) — one final
+        envelope event is yielded instead. Speculative requests stream
+        normally on spec-capable fleets (verify-row emissions land per
+        fetched step, like any chunk).
         """
         kv_hint = kwargs.pop("kv_hint", None)
         if self._needs_solo(kwargs):
@@ -1141,6 +1251,16 @@ class ContinuousEngine:
                 "tile": self._ragged_tile,
                 "prefilling": len(self._jobs),
             }
+        if self._spec_capable:
+            out["speculative"] = {
+                "mode": "draft_model" if self._draft_mode else "ngram",
+                "draft_len": self._spec_k_max,
+                "fleet_wide": self._spec_auto,
+                "launches": self.spec_launches,
+                "drafted_tokens": self.spec_drafted,
+                "accepted_tokens": self.spec_accepted,
+                "inflight_rows": len(self._spec_inflight),
+            }
         cstats = self._ctable.stats()
         if cstats["resident"]:
             out["constraints"] = cstats
@@ -1192,6 +1312,11 @@ class ContinuousEngine:
         self._jobs = []
         self._prefilling = {}
         self._host_pos[:] = 0
+        # speculation bookkeeping dies with the fleet too: unfetched
+        # verify rows are unfetched launches (their emissions drop, the
+        # salvage record holds fetched tokens only — same contract)
+        self._spec_inflight.clear()
+        self._row_inflight[:] = 0
         if (
             admitting is not None and admitting not in running
             and not admitting.done.is_set()
@@ -1255,6 +1380,14 @@ class ContinuousEngine:
             self.n_slots, self.cfg.vocab_size
         )
         self._fsm = jnp.zeros((self.n_slots,), jnp.int32)
+        if self._draft_mode:
+            # the draft pool is rebuilt outright like the target pool
+            # (it may have been donated mid-crash); its content is pure
+            # draft-quality state — recovered tenants re-prefill it
+            # through the ordinary admission fill
+            self._dpool = self._P.init_pool(
+                self._dcfg, self._pool_blocks, self.kv_block_size
+            )
 
     def _shadow_capture(self, req: _Request, written: Optional[int] = None):
         """Hand req's newly FILLED pool blocks to the shadow copier
@@ -2011,8 +2144,15 @@ class ContinuousEngine:
                     return
             self._reap_jobs()
             self._start_jobs()
-            if self._jobs:
-                step = self._launch_mixed()
+            spec_rows = self._plan_spec()
+            if self._jobs or spec_rows or self._spec_inflight:
+                # mixed step: prefill chunks and/or verify rows ride the
+                # flat token axis with the decode rows. A slot whose
+                # verify row is still unfetched keeps the fleet on the
+                # mixed program too (it must stay frozen via dec_on
+                # until its position resyncs — the amortized chunk
+                # program would advance it)
+                step = self._launch_mixed(spec_rows)
             else:
                 step = self._launch_chunk()
                 if step is not None:
@@ -2288,12 +2428,10 @@ class ContinuousEngine:
         self._table_dev = None
         self._host_pos[slot] = 0
         req.slot = slot
-        if self._shadow is not None:
-            # chunked admissions shadow as their chunks land (the
-            # _launch_mixed capture hook); the mapped shared head is
-            # usually resident already — content keys dedup it
-            req.ids = ids
-            req.shadow_depth = 0
+        # the admitted token sequence: shadow capture keys off it, and
+        # the n-gram draft planner reads it as the slot's history head
+        req.ids = ids
+        req.shadow_depth = 0
         with self._cv:
             self._assignment[slot] = req
         self._jobs.append(job)
@@ -2305,21 +2443,126 @@ class ContinuousEngine:
         )
         return job
 
-    def _launch_mixed(self):
+    # -- speculative decoding: host-side planning (ISSUE 13) -----------------
+    # jaxlint: decode-unreachable -- host-side eligibility check over request kwargs (scheduler worker thread only)
+    def _spec_req_ok(self, req: Optional[_Request]) -> bool:
+        """Is this tenant a speculation candidate? Greedy only (the
+        verify compares the model's own argmax) with every logit-
+        mutating knob at its disabled value, so the verify argmax and
+        slot_step's penalized argmax coincide bitwise; and the request
+        (or the fleet, via engine_cfg.spec_decode) opted in."""
+        if req is None or not (self._spec_auto or req.spec_want):
+            return False
+        k = req.kwargs
+        return (
+            bool(k.get("greedy", False))
+            and float(k.get("repetition_penalty", 1.0)) == 1.0
+            and float(k.get("frequency_penalty", 0.0)) == 0.0
+            and float(k.get("presence_penalty", 0.0)) == 0.0
+            and k.get("constraint") is None
+        )
+
+    # jaxlint: decode-unreachable -- host-side launch planning over Python lists (scheduler worker thread only)
+    def _plan_spec(self) -> dict:
+        """Plan this step's verify rows: {slot: (n_draft, drafts|None)}
+        (drafts None = device draft-model proposals). A slot qualifies
+        when its tenant is eligible, its previous verify row (if any)
+        has been fetched (the host position model must be exact for the
+        kernel's q_start metadata), its history is fully fetched (the
+        n-gram planner drafts from the true frontier), and — n-gram
+        mode — the history actually offers a draft: a slot with nothing
+        to draft submits a plain decode row, so non-repetitive streams
+        pay nothing. The scheduler picks K (0 under decode TPOT
+        pressure — speculation self-disables under load), and each
+        slot's draft is clamped to its allocated blocks so a verify
+        write can never run the lblk clamp into a live block."""
+        if not self._spec_capable:
+            return {}
+        cand = []
+        for b, req in enumerate(self._assignment):
+            if (
+                req is None or b in self._prefilling
+                or b in self._spec_inflight or self._row_inflight[b] != 0
+                or req.done.is_set() or req.cancelled
+                or not self._spec_req_ok(req)
+            ):
+                continue
+            cand.append(b)
+        if not cand:
+            return {}
+        n_active = sum(
+            1 for b, r in enumerate(self._assignment)
+            if r is not None and b not in self._prefilling
+        )
+        k = self._sched.spec_draft_len(
+            self._spec_k_max, len(cand), n_active - len(cand),
+            active_classes={
+                r.slo for b, r in enumerate(self._assignment)
+                if r is not None and b not in self._prefilling
+            },
+            jobs_pending=bool(self._jobs),
+        )
+        if k <= 0:
+            return {}
+        bs = self.kv_block_size
+        out = {}
+        for b in cand:
+            req = self._assignment[b]
+            # never draft past the slot's allocated blocks: the verify
+            # writes K/V at pos..pos+k, and positions beyond the table
+            # tail-redirect to the trash block, but positions past
+            # MB*bs would CLAMP into the slot's own last live block
+            blocks = len(req.block_ids) if req.block_ids else 0
+            cap = blocks * bs - 1 - int(self._host_pos[b])
+            kb = min(k, cap)
+            if kb < 1:
+                continue
+            if self._draft_mode:
+                out[b] = (kb, None)
+                continue
+            head = (
+                [req.first_id]
+                if req.first_id is not None
+                and req.first_id not in self.cfg.all_stop_ids else []
+            )
+            from .scheduler import ngram_draft
+
+            drafts = ngram_draft(
+                (req.ids or []) + head + req.tokens, kb
+            )
+            if drafts:
+                out[b] = (len(drafts), drafts)
+        return out
+
+    def _launch_mixed(self, spec_rows: Optional[dict] = None):
         """ONE scheduler step: every active decode row plus the budget
-        slice of pending prefill chunks, in one mixed ragged launch.
-        Returns the inflight tuple ("mixed", packed [5, B] dev, decode
-        snapshot, {slot: req} completions, launch time, mutation seq) or
-        None when the fleet is empty."""
+        slice of pending prefill chunks — and, for slots in `spec_rows`,
+        a [current + draft] verify row instead of the 1-token decode row
+        — in one mixed ragged launch. Returns the inflight tuple
+        ("mixed", packed dev, decode snapshot, {slot: req} completions,
+        launch time, mutation seq, spec bookkeeping) or None when the
+        fleet is empty."""
         P = self._P
-        active = [
+        spec_rows = spec_rows or {}
+        assigned = [
             b for b, r in enumerate(self._assignment)
             if r is not None and b not in self._prefilling
         ]
+        # a slot with an UNFETCHED verify row is skipped outright: its
+        # device position is unknown to the host until the packed fetch
+        # resyncs it, so it gets no row (and stays frozen via dec_on)
+        active = [b for b in assigned if b not in self._spec_inflight]
+        # speculated tokens debit the step budget exactly like prefill
+        # tokens: a verify row reserves ceil((1+k)/tile) query tiles
+        tile = self._ragged_tile
+        n_decode_tiles = sum(
+            -(-(1 + spec_rows[b][0]) // tile) if b in spec_rows else 1
+            for b in active
+        )
         plan = self._sched.plan(
-            len(active), self._jobs,
+            n_decode_tiles, self._jobs,
             active_classes={
-                self._assignment[b].slo for b in active
+                self._assignment[b].slo for b in assigned
                 if self._assignment[b] is not None
             },
         )
@@ -2332,10 +2575,21 @@ class ContinuousEngine:
             faults.check("prefill", tag=",".join(
                 job.req.prompt for job, _ in plan
             ))
-        W, tile, B = self._sched_width, self._ragged_tile, self.n_slots
+        W, B = self._sched_width, self.n_slots
         entries = []
         for b in active:
-            entries.append((b, int(self._host_pos[b]), 1, P.RAGGED_DECODE))
+            if b in spec_rows:
+                # verify row: [current + k drafts] — a short prefill-kind
+                # row over the slot's own block table (the whole point:
+                # the ragged kernel already serves it, no new kernel)
+                entries.append((
+                    b, int(self._host_pos[b]), 1 + spec_rows[b][0],
+                    P.RAGGED_PREFILL,
+                ))
+            else:
+                entries.append(
+                    (b, int(self._host_pos[b]), 1, P.RAGGED_DECODE)
+                )
         chunk_list = []
         for job, n in plan:
             start = job.p0 + job.done
@@ -2348,9 +2602,28 @@ class ContinuousEngine:
         dec_flag = np.zeros((W,), bool)
         dec_idx = np.zeros((B,), np.int32)
         n_dec = len(active)
+        K1 = self._spec_k_max + 1
+        sp_on = np.zeros((B,), bool)
+        sp_idx = np.zeros((B, K1), np.int32)
+        sp_nd = np.zeros((B,), np.int32)
+        dec_on = np.zeros((B,), bool)
         for b, off in zip(active, offsets[:n_dec]):
+            # the entry's FIRST flat slot is dec_flag-substituted from
+            # device state (token AND position) for plain decode rows
+            # and verify rows alike
             dec_flag[off] = True
-            dec_idx[b] = off
+            if b in spec_rows:
+                kb, drafts = spec_rows[b]
+                sp_on[b] = True
+                sp_nd[b] = kb
+                idxs = off + np.arange(K1, dtype=np.int32)
+                idxs[kb + 1:] = off + kb  # pad by repeating the last
+                sp_idx[b] = idxs
+                if drafts is not None:  # n-gram drafts ride the host plan
+                    toks[off + 1 : off + 1 + kb] = drafts
+            else:
+                dec_on[b] = True
+                dec_idx[b] = off
         completions = {}
         arm = self._idle_arm
         arm_np = None
@@ -2385,6 +2658,46 @@ class ContinuousEngine:
             )
         if self._table_dev is None:
             self._table_dev = jnp.asarray(self._table)
+        # the spec operands ride only when needed: launches with neither
+        # a verify row nor a frozen (unfetched-verify) slot dispatch the
+        # plain program — the pre-speculation fast path, byte-identical
+        spec_plan_dev = spec_toks_dev = None
+        spec_meta = None
+        if self._draft_mode:
+            # keep the DRAFT pool tracking the canonical stream: every
+            # mixed step lands its prefill chunks and each decode row's
+            # current token (dec_flag-substituted from slot state, like
+            # the target) in the draft model's pool — so the propose
+            # chain's context matches the target's position for
+            # position. Launches the fleet serves through the amortized
+            # chunk program leave draft-pool holes; those only ever
+            # degrade draft QUALITY (acceptance is verified against the
+            # target's own argmax).
+            self._dpool = P.mixed_fill_draft(
+                self._dcfg, self._dparams, jnp.asarray(toks),
+                jnp.asarray(tok_row), jnp.asarray(tok_pos),
+                jnp.asarray(dec_flag), jnp.asarray(meta), self._dpool,
+                self._table_dev, self.state.token, self.state.pos,
+            )
+        if spec_rows or any(b in self._spec_inflight for b in assigned):
+            spec_plan_dev = P.SpecPlan(
+                jnp.asarray(dec_on), jnp.asarray(sp_on),
+                jnp.asarray(sp_idx), jnp.asarray(sp_nd),
+            )
+            spec_meta = {
+                b: (self._assignment[b], spec_rows[b][0])
+                for b in spec_rows
+            }
+            if self._draft_mode and spec_rows:
+                # batched greedy draft chain from every slot's current
+                # (token, pos) over the shared block tables; the
+                # proposals feed the mixed program as a device operand —
+                # zero host syncs anywhere in the draft path
+                spec_toks_dev, self._dpool = P.draft_propose_paged(
+                    self._dcfg, self._dparams, self.state.token,
+                    self.state.pos, self._dpool, self._table_dev,
+                    draft_len=self._spec_k_max,
+                )
         packed, self.state, self.sparams, self.cache = (
             self.backend.mixed_step_ragged(
                 jnp.asarray(toks), jnp.asarray(tok_row),
@@ -2392,12 +2705,32 @@ class ContinuousEngine:
                 jnp.asarray(meta), self.cache, self._table_dev,
                 self.state, self.sparams, self._next_key(),
                 jnp.asarray(dec_idx), arm,
+                spec=spec_plan_dev, spec_toks=spec_toks_dev,
             )
         )
         # host position model + completion bookkeeping AFTER the launch
-        # is enqueued (the arming rode the program itself)
+        # is enqueued (the arming rode the program itself). Verify rows
+        # do NOT advance here: their advance is data-dependent (the
+        # accept count), so the host resyncs from the packed fetch and
+        # the slot is skipped until then (_spec_inflight).
         for b in active:
-            self._host_pos[b] += 1
+            self._row_inflight[b] += 1
+            if b in spec_rows:
+                self._spec_inflight[b] = spec_meta[b]
+            else:
+                self._host_pos[b] += 1
+        if spec_rows:
+            mode = "draft_model" if self._draft_mode else "ngram"
+            drafted = sum(nd for nd, _ in spec_rows.values())
+            self._m_spec_launches.labels(mode=mode).inc(len(spec_rows))
+            self._m_spec_drafted.inc(drafted)
+            self.spec_launches += len(spec_rows)
+            self.spec_drafted += drafted
+            for b, (nd, _) in spec_rows.items():
+                req = self._assignment[b]
+                if req is not None:
+                    req.spec_launches += 1
+                    req.spec_drafted += nd
         for slot, req in completions.items():
             job = self._prefilling.pop(slot)
             self._jobs.remove(job)
@@ -2434,13 +2767,15 @@ class ContinuousEngine:
         self._m_ragged_launches.labels(phase="mixed").inc()
         # decode snapshot: only rows DECODING at launch (mid-prefill rows
         # emit nothing; the completing slot's first decode token arrives
-        # with the NEXT launch) — attribution discipline as ever
+        # with the NEXT launch; slots frozen behind an unfetched verify
+        # row carry no row at all) — attribution discipline as ever
         snapshot = [
             self._assignment[b] if b in active else None for b in range(B)
         ]
         return (
             "mixed", packed, snapshot, completions, time.perf_counter(),
             self._mutation_seq,
+            spec_meta if spec_plan_dev is not None else None,
         )
 
     def _fresh_arm(self):
@@ -2462,15 +2797,25 @@ class ContinuousEngine:
 
     def _process_mixed(self, step):
         """Fetch one mixed step's packed results: first-token bookkeeping
-        for admissions that completed their prefill in that launch, then
-        the shared decode distribution (stop/cancel/deadline/finalize)."""
-        _, packed_dev, snapshot, completions, t_launch, seq = step
+        for admissions that completed their prefill in that launch,
+        verify-row resync/accounting (position advance, accept counts),
+        then the shared decode distribution (stop/cancel/deadline/
+        finalize) over the combined emission matrix."""
+        _, packed_dev, snapshot, completions, t_launch, seq, spec_meta = step
         faults.check("fetch", tag=",".join(
             r.prompt for r in snapshot if r is not None
         ))
-        packed = np.asarray(packed_dev)  # [5, B] — the ONE fetch per step
+        # [5, B] plain / [5 + 2*(K+1) + 1, B] with a SpecPlan — still the
+        # ONE fetch per step
+        packed = np.asarray(packed_dev)
         self._m_step.observe(max(0.0, time.perf_counter() - t_launch))
-        emitted, mask, active, firsts, armed = packed
+        emitted, mask, active, firsts, armed = packed[:5]
+        sp_emit = sp_mask = sp_adv = None
+        if spec_meta is not None:
+            K1 = self._spec_k_max + 1
+            sp_emit = packed[5 : 5 + K1]
+            sp_mask = packed[5 + K1 : 5 + 2 * K1].astype(bool)
+            sp_adv = packed[5 + 2 * K1]
         now = time.time()
         for slot, req in completions.items():
             if req.done.is_set() or req.drop_seq > seq:
@@ -2497,10 +2842,43 @@ class ContinuousEngine:
                 request_id=req.trace.request_id,
             )
             self._post_admit(req)
-        self._distribute(
-            emitted[None, :], mask[None, :].astype(bool),
-            active.astype(bool), snapshot, seq=seq,
-        )
+        em = emitted[None, :]
+        mk = mask[None, :].astype(bool)
+        if spec_meta:
+            # combined emission matrix: decode rows keep their one
+            # token in row 0, verify rows splice their whole emission
+            # stream — _distribute then applies the shared stop/cancel/
+            # deadline/finalize/shadow discipline to both uniformly
+            B = self.n_slots
+            K1 = self._spec_k_max + 1
+            em = np.zeros((K1, B), emitted.dtype)
+            mk = np.zeros((K1, B), bool)
+            em[0] = emitted
+            mk[0] = mask.astype(bool)
+            for slot, (req, nd) in spec_meta.items():
+                em[:, slot] = sp_emit[:, slot]
+                mk[:, slot] = sp_mask[:, slot]
+                self._spec_inflight.pop(slot, None)
+                n_emit = int(sp_mask[:, slot].sum())
+                if (
+                    self._assignment[slot] is req
+                    and not req.done.is_set() and req.drop_seq <= seq
+                ):
+                    # position resync: the verify advanced the slot by
+                    # the accepted count (+1 on an EOS step) — the host
+                    # model is exact again and the slot re-enters the
+                    # next launch plan
+                    self._host_pos[slot] += int(sp_adv[slot])
+                acc = max(0, n_emit - 1)
+                self._m_spec_accepted.inc(acc)
+                self._m_spec_rejected.inc(max(0, nd - acc))
+                self._m_spec_hist.observe(n_emit)
+                self.spec_accepted += acc
+                req.spec_accepted += acc
+        self._distribute(em, mk, active.astype(bool), snapshot, seq=seq)
+        for b, r in enumerate(snapshot):
+            if r is not None and self._row_inflight[b] > 0:
+                self._row_inflight[b] -= 1
         self._consecutive_crashes = 0
         if seq >= self._mutation_seq:
             self._suspects.clear()
@@ -2915,12 +3293,14 @@ class ContinuousEngine:
             # become cached chains, the mapped head is promoted. Later
             # admissions' gathers serialize behind this insert on device.
             self._bpx.register(ids, prompt_len, req.block_ids)
+        # the admitted token sequence: shadow capture keys off it, the
+        # n-gram draft planner reads it as the slot's history head
+        req.ids = ids
+        req.shadow_depth = 0
         if self._shadow is not None:
             # shadow the prompt's full blocks (same immutability point
             # as the register above); the gather rides the launch queue
             # behind the prefill, the copy lands on the shadow thread
-            req.ids = ids
-            req.shadow_depth = 0
             self._shadow_capture(req, written=prompt_len)
         req.slot = slot
         req.trace.checkpoint("admission")  # prefill + splice into the slot
@@ -2992,11 +3372,24 @@ class ContinuousEngine:
             self.cache = be.extend_ragged_paged(
                 toks, tok_row, tok_pos, meta, self.cache, table1
             )
+            if self._draft_mode:
+                # draft-model speculation: the prompt must land in the
+                # draft pool too (draft_spec_loop's prefill-into-BOTH
+                # contract) — same launch plan, draft weights
+                self._dpool = self._P.extend_ragged_paged(
+                    self._dcfg, self._dparams, toks, tok_row, tok_pos,
+                    meta, self._dpool, table1,
+                )
             self._m_ragged_launches.labels(phase="extend").inc()
         rem = tail[n_full * W :]
         toks, tok_row, tok_pos, meta = self._ragged_launch_args(
             rem, p0 + n_full * W
         )
+        if self._draft_mode:
+            self._dpool = self._P.extend_ragged_paged(
+                self._dcfg, self._dparams, toks, tok_row, tok_pos,
+                meta, self._dpool, table1,
+            )
         first, _, self.cache = be.prefill_ragged_paged(
             toks, tok_row, tok_pos, meta, self.cache, table1,
             jnp.int32(len(rem) - 1), key, sampling,
@@ -3178,6 +3571,15 @@ class ContinuousEngine:
         if req.preemptions:
             # evicted for pool pressure and resumed (swap or recompute)
             req.result["preempted"] = req.preemptions
+        if req.spec_launches or (req.spec_want and self._spec_req_ok(req)):
+            # which path served + the draft/accept counts (the solo
+            # loops report spec_path "solo" with acceptance on device;
+            # a non-greedy/penalized "speculative" request decodes
+            # plainly and — like solo — carries no speculative marker)
+            req.result["speculative"] = True
+            req.result["spec_path"] = "fleet"
+            req.result["spec_drafted"] = req.spec_drafted
+            req.result["spec_accepted"] = req.spec_accepted
         if req.prefix_hit_tokens:
             req.result["prefix_cached_tokens"] = req.prefix_hit_tokens
         if req.fabric_blocks:

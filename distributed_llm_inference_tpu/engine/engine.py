@@ -262,12 +262,14 @@ class SingleDeviceBackend:
         return self.supports_ragged_fill
 
     def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
-                          pool, table, state, sparams, key, dec_idx, arm):
+                          pool, table, state, sparams, key, dec_idx, arm,
+                          spec=None, spec_toks=None):
         from . import paged as P
 
         return P.mixed_step_ragged(
             self.cfg, self.params, tokens, tok_row, tok_pos, dec_flag,
             meta, pool, table, state, sparams, key, dec_idx, arm,
+            spec=spec, spec_toks=spec_toks,
         )
 
     def ragged_program_count(self) -> int:
@@ -565,6 +567,35 @@ class InferenceEngine:
         self.metrics.counter(
             "dli_sched_decode_rows_total",
             "decode rows carried by mixed scheduler launches",
+        )
+        # fleet speculative-decoding families (engine/continuous.py
+        # labels them when the mixed fleet speculates — ISSUE 13):
+        # draft/accept/reject token flow, verify-row launches by draft
+        # source, and the accepted-tokens-per-launch distribution the
+        # bench leg's headline derives from
+        self.metrics.counter(
+            "dli_spec_drafted_tokens_total",
+            "draft tokens submitted in mixed-launch verify rows",
+        )
+        self.metrics.counter(
+            "dli_spec_accepted_tokens_total",
+            "draft tokens accepted (matched the model's own argmax and "
+            "were emitted)",
+        )
+        self.metrics.counter(
+            "dli_spec_rejected_tokens_total",
+            "draft tokens rejected by the traced verify",
+        )
+        self.metrics.counter(
+            "dli_spec_launches_total",
+            "verify rows launched inside mixed scheduler steps, by draft "
+            "source", ("mode",),
+        )
+        self.metrics.histogram(
+            "dli_spec_tokens_per_launch",
+            "tokens emitted per verify row (accepted drafts + the "
+            "correction token; > 1 is the speculation win)",
+            buckets=DEFAULT_SIZE_BUCKETS,
         )
         self.metrics.gauge(
             "dli_slo_queue_depth",
@@ -1934,6 +1965,11 @@ class InferenceEngine:
             result["token_strings"] = token_strings
         if use_spec or use_draft:
             result["speculative"] = True
+            # which path served (the continuous mixed fleet reports
+            # "fleet" with spec_drafted/spec_accepted counts; the solo
+            # loops keep acceptance entirely on device and report counts
+            # only through tokens_generated)
+            result["spec_path"] = "solo"
         if cart is not None:
             result["constrained"] = True
         if use_draft:

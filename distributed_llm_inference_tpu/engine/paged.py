@@ -873,10 +873,123 @@ def idle_mixed_arm(n_slots: int, vocab_size: int) -> MixedArm:
     )
 
 
+class SpecPlan(NamedTuple):
+    """Per-slot speculation operands for one mixed launch (draft-then-
+    verify inside the existing program — ISSUE 13). A speculating slot's
+    launch entry is a [current + K-token draft] VERIFY row: a short
+    prefill-kind row over the block table whose first flat slot is
+    dec_flag-substituted from device state (token AND position, like any
+    decode row) and whose draft slots carry host-planned (n-gram) or
+    draft-model tokens. Shapes are fixed by the fleet's max draft length,
+    so ONE compiled program serves every accept pattern and every
+    per-slot draft length — the host only moves int32 plan data."""
+
+    dec_on: jnp.ndarray  # bool [B]: slot has a PLAIN decode row this
+    # launch — slot_step advances exactly these rows; verify rows and
+    # rows skipped while their previous verify row is still unfetched
+    # stay frozen (their state advances through spec_verify / not at all)
+    on: jnp.ndarray  # bool [B]: slot carries a verify row this launch
+    idx: jnp.ndarray  # i32 [B, K+1]: flat launch indices of the row's
+    # [current, draft...] slots (entries past the slot's own draft
+    # length repeat the last valid index — duplicate gathers, never read)
+    n_draft: jnp.ndarray  # i32 [B]: drafted tokens in the row (<= K)
+
+
+def idle_spec_plan(n_slots: int, draft_len: int) -> SpecPlan:
+    """An all-off SpecPlan with every slot marked as a plain decode row
+    (the compiled shape for a fleet whose speculation is armed but idle
+    this launch)."""
+    return SpecPlan(
+        jnp.ones((n_slots,), bool),
+        jnp.zeros((n_slots,), bool),
+        jnp.zeros((n_slots, draft_len + 1), jnp.int32),
+        jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def spec_verify(cfg: ModelConfig, state: G.SlotState, window, draft,
+                n_draft, live):
+    """Traced accept/reject for the mixed launch's verify rows — the
+    whole speculation decision stays on device (zero host syncs; the
+    host learns the outcome from the packed fetch it already does).
+
+    window [B, K+1] i32: greedy argmax at the verify row's flat
+    positions (position j's argmax is the model's next token after
+    consuming [current, draft[:j]]); draft [B, K] i32: the drafted
+    tokens; n_draft [B]: drafts actually planned per row; live [B]:
+    rows carrying a verify row AND still active on device.
+
+    Emits the longest draft prefix matching the model's own argmax plus
+    the model's correction token, replicating generate.slot_step's
+    greedy semantics token for token so the STATE after a verify step is
+    bit-identical to having decoded the same tokens one-by-one:
+    break-before-append EOS (the EOS step still advances pos by one,
+    like the plain step that sampled it), remaining-budget clamp
+    (can_emit requires remaining > 0; budget exhaustion deactivates
+    without the extra EOS-step position bump), pad token on
+    deactivation. Rejected draft positions' K/V is overwritten before it
+    can ever be attended or shadow-captured — the pool-rewind invariant
+    (ARCHITECTURE.md "Speculative decoding").
+
+    Returns (state', spec_emit [B, K+1], spec_mask [B, K+1], adv [B] —
+    the per-row position advance the host position model resyncs from).
+    """
+    pad = jnp.int32(cfg.pad_token_id)
+    K1 = window.shape[1]
+    K = K1 - 1
+    j = jnp.arange(K1, dtype=jnp.int32)[None, :]
+    jk = jnp.arange(K, dtype=jnp.int32)[None, :]
+    match = (draft == window[:, :K]) & (jk < n_draft[:, None])
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    valid = j <= n_acc[:, None]  # candidate emission stream: accepted
+    # drafts + the correction token (all of them the model's own argmax)
+    cum_eos = (
+        jnp.cumsum(G.stop_mask(cfg, window).astype(jnp.int32), axis=1) > 0
+    )
+    emit_pre = valid & ~cum_eos  # break BEFORE appending a stop token
+    n_pre = jnp.sum(emit_pre.astype(jnp.int32), axis=1)
+    room = state.remaining
+    n_emit = jnp.where(live, jnp.minimum(n_pre, room), 0)
+    # the EOS "step" only happens when plain decode would have reached
+    # it: budget exhaustion first means no EOS step (and no extra pos)
+    saw_eos = live & jnp.any(valid & cum_eos, axis=1) & (n_pre < room)
+    emit_ok = emit_pre & (j < n_emit[:, None]) & live[:, None]
+    spec_emit = jnp.where(emit_ok, window, pad)
+    adv = n_emit + saw_eos.astype(jnp.int32)
+    last = jnp.take_along_axis(
+        window, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+    )[:, 0]
+    new_token = jnp.where(saw_eos | (n_emit <= 0), pad, last)
+    new_rem = state.remaining - n_emit
+    new_active = live & ~saw_eos & (new_rem > 0)
+    # presence marks every token plain decode would have SAMPLED (the
+    # emitted stream + the final EOS); counts only the emitted ones —
+    # the exact slot_step bookkeeping, batched over the window. Inert
+    # for eligible rows (speculation requires the penalties disabled),
+    # kept exact so the state merge has one discipline.
+    mark = emit_ok | (saw_eos[:, None] & (j == n_emit[:, None]))
+    vocab = jnp.arange(state.presence.shape[-1], dtype=jnp.int32)
+    onehot = window[:, :, None] == vocab[None, None, :]  # [B, K+1, V]
+    pres_add = jnp.any(onehot & mark[:, :, None], axis=1)
+    cnt_add = jnp.sum(
+        onehot & emit_ok[:, :, None], axis=1
+    ).astype(jnp.int32)
+    state = G.SlotState(
+        token=jnp.where(live, new_token, state.token),
+        pos=state.pos + jnp.where(live, adv, 0),
+        active=jnp.where(live, new_active, state.active),
+        remaining=jnp.where(live, new_rem, state.remaining),
+        presence=state.presence | pres_add,
+        counts=state.counts + cnt_add,
+    )
+    return state, spec_emit, emit_ok, adv
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
 def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
                       dec_flag, meta, pool, table, state: G.SlotState,
-                      sparams: G.SlotParams, key, dec_idx, arm: MixedArm):
+                      sparams: G.SlotParams, key, dec_idx, arm: MixedArm,
+                      spec: Optional[SpecPlan] = None, spec_toks=None):
     """One scheduler step: advance every active slot one decode token AND
     write the launch's prefill chunks into the pool, in one program.
 
@@ -893,12 +1006,35 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     like idle rows in decode_slots_paged). arm: completing-prefill
     operands (MixedArm; all-off most steps).
 
-    Returns (packed [5, B] int32 — emitted / emit_mask / active / firsts /
-    armed, ONE fetch per step — state, sparams, pool)."""
+    spec (SpecPlan, optional): draft-then-verify rows for eligible
+    decode slots — each is a [current + draft] prefill-kind row whose
+    first flat slot is dec_flag-substituted like any decode row, whose
+    accept/reject runs fully traced (spec_verify), and whose emissions
+    extend the packed fetch. spec_toks ([B, K] i32, optional): device-
+    generated draft-model proposals scattered into the flat token axis
+    (n-gram drafts arrive host-planned in `tokens` instead — either way
+    zero extra host syncs).
+
+    Returns (packed int32 — [5, B] plain, [5 + 2*(K+1) + 1, B] with
+    spec: emitted / emit_mask / active / firsts / armed [/ spec_emit /
+    spec_mask / position advance], ONE fetch per step — state, sparams,
+    pool)."""
     from ..models import api as M
 
     rows_ix = jnp.maximum(tok_row, 0)
     toks = jnp.where(dec_flag, state.token[rows_ix], tokens)
+    if spec is not None and spec_toks is not None:
+        # draft-model proposals: scatter each verify row's drafts into
+        # its flat slots (rows without a verify row — and draft slots
+        # past a row's own draft length — target an out-of-range index,
+        # which the scatter drops)
+        K = spec_toks.shape[1]
+        jk = jnp.arange(K, dtype=jnp.int32)[None, :]
+        want = spec.on[:, None] & (jk < spec.n_draft[:, None])
+        tgt = jnp.where(want, spec.idx[:, 1:], jnp.int32(toks.shape[0]))
+        toks = toks.at[tgt.reshape(-1)].set(
+            spec_toks.reshape(-1), mode="drop"
+        )
     pos = jnp.where(dec_flag, state.pos[rows_ix], tok_pos)
     x = M.embed(cfg, params, toks[:, None], pos)
     x, pool = M.forward_layers(
@@ -914,25 +1050,124 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     # slot in place — vectorized generate.arm_slot (budget / EOS-on-first
     # decided on device, same as insert_slot)
     pf_logits = M.unembed(cfg, params, x[arm.idx])[:, 0, :]  # [B, V]
+    sp_logits = sp_draft = None
+    if spec is not None:
+        B, K1 = spec.idx.shape
+        sel = x[spec.idx.reshape(-1)]  # [B*(K+1), 1, D]
+        sp_logits = M.unembed(cfg, params, sel)[:, 0, :].reshape(B, K1, -1)
+        sp_draft = toks[spec.idx[:, 1:]]  # [B, K] the verified drafts
     packed, state, sparams = mixed_epilogue(
-        cfg, state, sparams, logits, pf_logits, key, arm
+        cfg, state, sparams, logits, pf_logits, key, arm,
+        spec=spec, sp_logits=sp_logits, sp_draft=sp_draft,
     )
     return packed, state, sparams, pool
 
 
+@functools.partial(
+    jax.jit, static_argnames=("dcfg",), donate_argnames=("dpool",)
+)
+def mixed_fill_draft(dcfg: ModelConfig, dparams, tokens, tok_row, tok_pos,
+                     dec_flag, meta, dpool, table, token, pos_state):
+    """Draft-pool twin of the mixed step's forward (no sampling): land
+    this step's prefill chunks AND every decode row's current token in
+    the DRAFT model's pool, with the same dec_flag substitution from the
+    (replicated) slot state — so the draft chain's context tracks the
+    canonical stream position by position. draft slots of verify rows
+    carry placeholder zeros here; the propose chain rewrites exactly
+    those positions before anything attends them (write-then-attend)."""
+    from ..models import api as M
+
+    rows_ix = jnp.maximum(tok_row, 0)
+    toks = jnp.where(dec_flag, token[rows_ix], tokens)
+    pos = jnp.where(dec_flag, pos_state[rows_ix], tok_pos)
+    x = M.embed(dcfg, dparams, toks[:, None], pos)
+    _, dpool = M.forward_layers(
+        dcfg, dparams["layers"], x, dpool, pos,
+        attn_hook=make_ragged_fill_hook(table, meta, tok_row),
+        attn_seq_len=1,
+    )
+    return dpool
+
+
+@functools.partial(
+    jax.jit, static_argnames=("dcfg", "draft_len"), donate_argnames=("dpool",)
+)
+def draft_propose_paged(dcfg: ModelConfig, dparams, token, pos, dpool,
+                        table, *, draft_len: int):
+    """Batched greedy draft chain over the fleet (the cfg-gated
+    spec_draft_model flavor): `draft_len`+1 decode steps of the SMALL
+    draft model from every slot's current (token, pos), over the draft
+    model's own pool leaves indexed by the SAME block tables as the
+    target pool — draft KV shares the target's allocation lifecycle for
+    free. The +1 step writes the last proposal's K/V (draft_spec_loop's
+    hole-free-full-accept discipline); its proposal is discarded.
+
+    Rows not speculating this launch ride along: their chain writes
+    their current token's K/V (canonical for the draft pool) plus
+    proposal K/V beyond the frontier that later canonical writes
+    overwrite — the same stale-region argument as the target pool, and
+    in the draft pool even a violation could only degrade draft QUALITY
+    (acceptance is verified against the target's own argmax).
+
+    Returns (proposals [B, draft_len] i32, dpool)."""
+
+    def body(carry, _):
+        tok, p, dpool = carry
+        logits, dpool = _forward_step_paged(
+            dcfg, dparams, tok[:, None], dpool, table, p
+        )
+        nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+            jnp.int32
+        )
+        return (nxt, p + 1, dpool), nxt
+
+    (_, _, dpool), props = jax.lax.scan(
+        body, (token, pos, dpool), None, length=draft_len + 1
+    )
+    return props[:draft_len].swapaxes(0, 1), dpool
+
+
 def mixed_epilogue(cfg: ModelConfig, state: G.SlotState,
                    sparams: G.SlotParams, logits, pf_logits, key,
-                   arm: MixedArm):
+                   arm: MixedArm, spec: Optional[SpecPlan] = None,
+                   sp_logits=None, sp_draft=None):
     """Sampling/arming tail of the mixed step, ONE copy for the single-
     device program above and the pp shard_map twin (parallel/pipeline.
     _build_mixed_step_ragged — both hand replicated [B, V] logits in):
     slot_step advances the decoding rows, completing prefills sample
-    their first token and arm via the vectorized arm_slot recipe.
-    Returns (packed [5, B], state, sparams)."""
+    their first token and arm via the vectorized arm_slot recipe. With a
+    SpecPlan, slot_step's advance is gated to the rows that actually
+    carried a plain decode row (spec.dec_on), verify rows advance
+    through the traced spec_verify instead, and the packed fetch grows
+    the spec emission block. Returns (packed, state, sparams)."""
     from ..ops.sampling import sample_token
 
     k_dec, k_arm = jax.random.split(key)
+    prev = state
     state, emit, can_emit = G.slot_step(cfg, state, sparams, logits, k_dec)
+    if spec is not None:
+        # rows without a plain decode row this launch (verify rows, and
+        # rows skipped while their previous verify row is unfetched)
+        # must not advance through slot_step's garbage logits: freeze
+        # them back to the pre-step state, then run the traced verify
+        dec_col = spec.dec_on[:, None]
+        state = G.SlotState(*(
+            jnp.where(dec_col if n.ndim > 1 else spec.dec_on, n, o)
+            for n, o in zip(state, prev)
+        ))
+        emit = jnp.where(spec.dec_on, emit, jnp.int32(cfg.pad_token_id))
+        can_emit = can_emit & spec.dec_on
+        # greedy argmax over the verify row's positions — the identical
+        # argmax sample_token's all-greedy bypass computes (speculation
+        # eligibility requires the penalties disabled, so the penalized
+        # and raw logits coincide bitwise)
+        window = jnp.argmax(
+            sp_logits.astype(jnp.float32), axis=-1
+        ).astype(jnp.int32)
+        live = spec.on & prev.active
+        state, spec_emit, spec_mask, spec_adv = spec_verify(
+            cfg, state, window, sp_draft, spec.n_draft, live
+        )
     firsts = sample_token(
         k_arm, pf_logits,
         arm.params.temperature[:, None], arm.params.top_k[:, None],
@@ -962,12 +1197,17 @@ def mixed_epilogue(cfg: ModelConfig, state: G.SlotState,
         jnp.where(on, new, old)
         for new, old in zip(arm.params, sparams)
     ))
-    packed = jnp.concatenate(
-        [
-            emit[None], can_emit.astype(jnp.int32)[None],
-            state.active.astype(jnp.int32)[None], firsts[None],
-            on.astype(jnp.int32)[None],
-        ],
-        axis=0,
-    )
+    rows = [
+        emit[None], can_emit.astype(jnp.int32)[None],
+        state.active.astype(jnp.int32)[None], firsts[None],
+        on.astype(jnp.int32)[None],
+    ]
+    if spec is not None:
+        # verify-row results ride the SAME packed fetch: emissions,
+        # their mask, and the per-row position advance the host position
+        # model resyncs from — zero extra device->host round trips
+        rows += [
+            spec_emit.T, spec_mask.astype(jnp.int32).T, spec_adv[None],
+        ]
+    packed = jnp.concatenate(rows, axis=0)
     return packed, state, sparams

@@ -62,6 +62,46 @@ MIN_SHED_DEPTH = 4
 # ceiling for a class-derived Retry-After hint (seconds)
 RETRY_AFTER_CAP_S = 30.0
 
+# how far back the n-gram draft planner scans a slot's token history for
+# the current bigram (host Python per slot per launch — bounded so a
+# max-window chat history cannot stretch the launch-planning hot loop)
+NGRAM_SCAN_WINDOW = 1024
+
+
+# jaxlint: decode-unreachable -- host-side launch planning over Python lists (scheduler worker thread only)
+def ngram_draft(hist: list, k: int) -> list:
+    """Prompt-lookup draft for one decode slot: the (up to) `k` tokens
+    that followed the most recent earlier occurrence of the current
+    bigram in `hist` (prompt + emitted tokens, fetched so far).
+
+    The host twin of the traced rule in engine/generate.spec_loop, with
+    one scheduler-grade difference: where the traced loop runs a junk
+    draft when no bigram matches (the forward is already paid for), this
+    planner returns [] so the slot submits a PLAIN decode row instead —
+    a draft only spends step_token_budget when the history actually
+    offers one, and non-repetitive streams pay nothing. A wrong draft is
+    never a correctness hazard either way: the verify row accepts a
+    token only where it equals the model's own argmax."""
+    n = len(hist)
+    if k <= 0 or n < 3:
+        return []
+    c0, c1 = hist[-2], hist[-1]
+    lo = max(0, n - 2 - NGRAM_SCAN_WINDOW)
+    # the match must be strictly earlier than the current bigram; prefer
+    # the most recent match, but keep scanning while it cannot supply a
+    # full k-token draft (a short-period repetition's latest match sits
+    # so close to the end that its follower slice truncates — an earlier
+    # occurrence of the same bigram drafts the whole period)
+    best: list = []
+    for i in range(n - 3, lo - 1, -1):
+        if hist[i] == c0 and hist[i + 1] == c1:
+            cand = list(hist[i + 2 : i + 2 + k])
+            if len(cand) > len(best):
+                best = cand
+                if len(best) == k:
+                    break
+    return best
+
 
 @dataclasses.dataclass(frozen=True)
 class SLOClass:
@@ -317,22 +357,50 @@ class TokenBudgetScheduler:
                 return True
         return False
 
-    def plan(self, n_decode_rows: int, jobs: list,
+    # -- speculation throttle ------------------------------------------------
+    def spec_draft_len(self, k_max: int, n_spec_rows: int,
+                       n_plain_rows: int, active_classes=(),
+                       jobs_pending: bool = False) -> int:
+        """Draft length K for this step's verify rows (0 = speculation
+        off). Speculated tokens spend step_token_budget like any other
+        flat token, so the SLO layer throttles them with the knobs it
+        already owns: under decode TPOT pressure (the SAME signal that
+        halves the prefill budget) K drops to 0 — speculation
+        accelerates idle fleets and self-disables under load — and
+        otherwise K shrinks until every verify row (ceil((1+K)/tile)
+        tiles each), every plain decode row, and one prefill-progress
+        tile (when prefill is pending) fit the step budget together."""
+        if k_max <= 0 or n_spec_rows <= 0:
+            return 0
+        if self.decode_pressure(active_classes):
+            return 0
+        tiles_total = self.width // self.tile
+        reserve = n_plain_rows + (1 if jobs_pending else 0)
+        for k in range(k_max, 0, -1):
+            spec_tiles = -(-(1 + k) // self.tile) * n_spec_rows
+            if spec_tiles + reserve <= tiles_total:
+                return k
+        return 0
+
+    def plan(self, n_decode_tiles: int, jobs: list,
              active_classes=(), now: Optional[float] = None) -> list:
         """Slice one step's budget: returns [(job, chunk_tokens)] with
         chunk_tokens >= 1, tile-granular except a job's FINAL chunk.
 
-        Decode rows were reserved upstream (one tile each); `jobs` are
-        the pending prefills in arrival order. Tiles left after decode are
-        apportioned across classes by weight x urgency, distributed FIFO
-        within a class; leftovers spill FIFO across classes; the OLDEST
-        job is guaranteed a tile (starvation freedom). Under decode TPOT
+        Decode rows were reserved upstream — `n_decode_tiles` query
+        tiles, one per plain decode row plus ceil((1+K)/tile) per
+        speculative verify row, so speculated tokens debit the budget
+        exactly like prefill tokens; `jobs` are the pending prefills in
+        arrival order. Tiles left after decode are apportioned across
+        classes by weight x urgency, distributed FIFO within a class;
+        leftovers spill FIFO across classes; the OLDEST job is
+        guaranteed a tile (starvation freedom). Under decode TPOT
         pressure the prefill budget halves (never below one tile)."""
         if not jobs:
             return []
         t = time.time() if now is None else now
         tiles_total = self.width // self.tile
-        tiles_left = tiles_total - n_decode_rows
+        tiles_left = tiles_total - n_decode_tiles
         if tiles_left < 1:
             # structurally unreachable (width clamps to n_slots + 1 tiles
             # and a prefilling admission occupies a slot), but never plan

@@ -855,16 +855,27 @@ class PipelineBackend(SPMDBackendBase):
         return self.supports_ragged_fill
 
     def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
-                          pool, table, state, sparams, key, dec_idx, arm):
-        fn = self._programs.get("mixed_step_ragged")
+                          pool, table, state, sparams, key, dec_idx, arm,
+                          spec=None, spec_toks=None):
+        mkey = ("mixed_step_ragged", spec is not None,
+                spec_toks is not None)
+        fn = self._programs.get(mkey)
         if fn is None:
-            fn = self._build_mixed_step_ragged()
-            self._programs["mixed_step_ragged"] = fn
-        return fn(self.shared, self.layers, tokens, tok_row, tok_pos,
-                  dec_flag, meta, pool, table, state, sparams, key,
-                  dec_idx, arm)
+            fn = self._build_mixed_step_ragged(
+                spec is not None, spec_toks is not None
+            )
+            self._programs[mkey] = fn
+        args = [self.shared, self.layers, tokens, tok_row, tok_pos,
+                dec_flag, meta, pool, table, state, sparams, key,
+                dec_idx, arm]
+        if spec is not None:
+            args.append(spec)
+        if spec_toks is not None:
+            args.append(spec_toks)
+        return fn(*args)
 
-    def _build_mixed_step_ragged(self):
+    def _build_mixed_step_ragged(self, with_spec: bool = False,
+                                 with_spec_toks: bool = False):
         """shard_map twin of engine/paged.mixed_step_ragged: the flat
         token fleet (decode rows gathered from the replicated slot state,
         prefill chunks from the host plan) runs the S ring microsteps
@@ -874,18 +885,41 @@ class PipelineBackend(SPMDBackendBase):
         unembedded through the vocab shards — then the SHARED
         engine/paged.mixed_epilogue advances/arm-s the slots, so tokens
         are identical on every device and cannot drift from the
-        single-device program."""
+        single-device program. The speculative variants (with_spec /
+        with_spec_toks) gather the verify rows' positions through the
+        same replicated-logits seam and run the SHARED
+        engine/paged.spec_verify inside the epilogue — pp verify rows
+        are token-identical to the single chip by construction."""
         cfg, S = self.cfg, self.pp
         from ..engine import paged as EP
         from ..engine.generate import SlotParams, SlotState
         from .partition import pool_spec
 
         def body(shared, layers, tokens, tok_row, tok_pos, dec_flag, meta,
-                 pool, table, state, sparams, key, dec_idx, arm):
+                 pool, table, state, sparams, key, dec_idx, arm, *extra):
+            spec = spec_toks = None
+            i = 0
+            if with_spec:
+                spec = extra[i]
+                i += 1
+            if with_spec_toks:
+                spec_toks = extra[i]
             hook = EP.make_ragged_fill_hook(table, meta, tok_row)
             s = jax.lax.axis_index(AXIS_PP)
             rows_ix = jnp.maximum(tok_row, 0)
             toks = jnp.where(dec_flag, state.token[rows_ix], tokens)
+            if spec is not None and spec_toks is not None:
+                # draft-model proposals scattered into the flat axis —
+                # same drop-out-of-range recipe as the single device
+                K = spec_toks.shape[1]
+                jk = jnp.arange(K, dtype=jnp.int32)[None, :]
+                want = spec.on[:, None] & (jk < spec.n_draft[:, None])
+                tgt = jnp.where(
+                    want, spec.idx[:, 1:], jnp.int32(toks.shape[0])
+                )
+                toks = toks.at[tgt.reshape(-1)].set(
+                    spec_toks.reshape(-1), mode="drop"
+                )
             pos = jnp.where(dec_flag, state.pos[rows_ix], tok_pos)
             x = embed_sharded(cfg, shared, toks[:, None], pos, S)
             buf, pool = self._microstep_loop(
@@ -893,16 +927,24 @@ class PipelineBackend(SPMDBackendBase):
             )
 
             def replicated_logits(idx):
-                sel = buf[idx]  # [B, 1, D]
+                sel = buf[idx]  # [N, 1, D]
                 sel = jax.lax.psum(
                     jnp.where(s == 0, sel, jnp.zeros((), sel.dtype)),
                     AXIS_PP,
                 )
                 return unembed_sharded(cfg, shared, sel, S)[:, 0, :]
 
+            sp_logits = sp_draft = None
+            if spec is not None:
+                B, K1 = spec.idx.shape
+                sp_logits = replicated_logits(
+                    spec.idx.reshape(-1)
+                ).reshape(B, K1, -1)
+                sp_draft = toks[spec.idx[:, 1:]]
             packed, state, sparams = EP.mixed_epilogue(
                 cfg, state, sparams, replicated_logits(dec_idx),
                 replicated_logits(arm.idx), key, arm,
+                spec=spec, sp_logits=sp_logits, sp_draft=sp_draft,
             )
             return packed, state, sparams, pool
 
@@ -911,13 +953,18 @@ class PipelineBackend(SPMDBackendBase):
         arm_specs = EP.MixedArm(
             P(), P(), P(), P(), _replicated_specs(SlotParams), P()
         )
+        specs = [
+            self._shared_specs, self._layer_specs, P(), P(), P(), P(),
+            P(), pool_spec(cfg), P(), state_specs, sparam_specs, P(),
+            P(), arm_specs,
+        ]
+        if with_spec:
+            specs.append(EP.SpecPlan(P(), P(), P(), P()))
+        if with_spec_toks:
+            specs.append(P())
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, P(), P(), P(), P(),
-                P(), pool_spec(cfg), P(), state_specs, sparam_specs, P(),
-                P(), arm_specs,
-            ),
+            in_specs=tuple(specs),
             out_specs=(P(), state_specs, sparam_specs, pool_spec(cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(7,))

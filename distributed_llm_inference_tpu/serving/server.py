@@ -796,8 +796,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     if continuous is not None:
                         # in-flight batching (engine/continuous.py): joins a
                         # free KV slot mid-decode; bounded admission queue
-                        # sheds with 429; seeded/debug/speculative requests
-                        # fall back to the solo engine inside submit()
+                        # sheds with 429; seeded/debug requests fall back
+                        # to the solo engine inside submit() (greedy
+                        # speculative ones run in-fleet on spec-capable
+                        # ragged paged fleets — verify rows in the mixed
+                        # launch)
                         result = continuous.submit(prompt, **kwargs)
                     elif queue is not None:
                         # bounded backpressure + concurrent-singles
@@ -1206,6 +1209,27 @@ def main(argv: Optional[list] = None):
              "re-prefilling every salvaged request from its full prompt)",
     )
     ap.add_argument(
+        "--spec-decode", action="store_true",
+        help="fleet-wide speculative decoding on the continuous ragged "
+             "paged fleet: EVERY eligible greedy slot submits draft-then-"
+             "verify rows inside the mixed launch (without this flag only "
+             "requests passing \"speculative\": true speculate); the SLO "
+             "scheduler throttles drafting to 0 under decode TPOT "
+             "pressure, and greedy output stays bit-identical",
+    )
+    ap.add_argument(
+        "--spec-draft-len", type=int, default=4, metavar="K",
+        help="drafted tokens per mixed-launch verify row (0 disables the "
+             "fleet speculation machinery entirely)",
+    )
+    ap.add_argument(
+        "--spec-draft-model", default=None, metavar="NAME",
+        help="draft the fleet's verify rows with a small same-tokenizer "
+             "model's device-side greedy chain (shares the block tables "
+             "over its own pool) instead of n-gram lookup; an attached "
+             "--draft-model takes precedence over loading NAME",
+    )
+    ap.add_argument(
         "--die-on-wedge", type=float, default=None, metavar="SECONDS",
         help="exit the process (code 17) once an abandoned deadline-overrun "
              "device call has been stuck this long — a supervisor restart "
@@ -1365,6 +1389,9 @@ def main(argv: Optional[list] = None):
             kv_fabric=not args.no_kv_fabric,
             kv_fabric_timeout_s=args.kv_fabric_timeout,
             replica_class=args.replica_class,
+            spec_decode=args.spec_decode,
+            spec_draft_len=args.spec_draft_len,
+            spec_draft_model=args.spec_draft_model,
         ),
         microbatches=args.microbatches,
         params=params,
