@@ -21,7 +21,13 @@ so they run in CI on CPU in seconds:
     production;
   * on a pp mesh (gated on `jax.shard_map`, like every pp test): the
     decode program contains the ring `collective_permute` and no
-    callbacks.
+    callbacks;
+  * the `wire-dtype` family (EngineConfig.pp_wire_quant): with the int8
+    wire ON, every full-rank `collective_permute` operand is si8 (fp32
+    allowed only for the rank-(n-1) scale companions) — the byte claim
+    machine-checked on the artifact — plus callbacks/donation/
+    recompile-guard legs for the quantized program; with the knob OFF,
+    no int8 ships at all (the bit-identity contract).
 
 Reused by tests/test_analysis.py and tests/test_constrained_decode.py —
 one implementation of the artifact assertions.
@@ -473,20 +479,31 @@ def pp_available() -> bool:
     return hasattr(jax, "shard_map") and len(jax.devices()) >= 2
 
 
-def lower_pp_decode(max_steps: int = 4) -> str:
+@functools.lru_cache(maxsize=2)
+def _pp_engine(wire_quant=None):
+    """Cached 2-stage pp engine on the tiny config (one per wire mode —
+    the wire-dtype family lowers the SAME decode with the knob on and
+    off). Caller must gate on pp_available()."""
+    from ..config import EngineConfig, MeshConfig
+    from ..runtime import create_engine
+
+    return create_engine(
+        "test-llama-tiny", mesh_cfg=MeshConfig(pp=2),
+        engine_cfg=EngineConfig(
+            prefill_buckets=(32,), pp_wire_quant=wire_quant
+        ),
+    )
+
+
+def lower_pp_decode(max_steps: int = 4, wire_quant=None) -> str:
     """StableHLO of the pp-ring decode step (2 stages, tiny config).
     Caller must gate on pp_available()."""
     import jax
     import jax.numpy as jnp
 
-    from ..config import EngineConfig, MeshConfig
     from ..engine import generate as G
-    from ..runtime import create_engine
 
-    engine = create_engine(
-        "test-llama-tiny", mesh_cfg=MeshConfig(pp=2),
-        engine_cfg=EngineConfig(prefill_buckets=(32,)),
-    )
+    engine = _pp_engine(wire_quant)
     backend = engine.backend
     cache = backend.init_cache(1, engine.cfg.max_seq_len)
     fn = backend._build_decode(max_steps)
@@ -496,6 +513,99 @@ def lower_pp_decode(max_steps: int = 4) -> str:
         G.default_sampling(greedy=True),
     )
     return lowered.as_text()
+
+
+def _collective_permute_operands(text: str) -> list:
+    """(rank, dtype, line) of every collective_permute operand in the
+    lowered text — the function-type clause `: (tensor<...>) -> ...`."""
+    import re
+
+    ops = []
+    for line in text.splitlines():
+        if "collective_permute" not in line:
+            continue
+        m = re.search(r":\s*\(tensor<([^>]+)>\)", line)
+        if not m:
+            continue
+        parts = m.group(1).split("x")
+        ops.append((len(parts) - 1, parts[-1], line.strip()[:110]))
+    return ops
+
+
+def check_wire_dtype(text: str) -> list:
+    """With pp_wire_quant="int8", every collective_permute on the pp axis
+    must ship si8 DATA: the full-rank ([B, T, D]) operands are i8, and
+    any non-i8 operand is a rank-(n-1) scale companion (one fp32 per
+    token row). This is the machine check that the wire really carries
+    int8 — the byte claim, proven on the artifact."""
+    ops = _collective_permute_operands(text)
+    if not ops:
+        return ["no collective_permute in the wire-quantized pp decode "
+                "program — the ring hand-off is missing"]
+    data_rank = max(r for r, _, _ in ops)
+    problems = []
+    if not any(d == "i8" for r, d, _ in ops if r == data_rank):
+        problems.append(
+            "no si8 activation collective_permute — the pp wire is not "
+            "int8 despite pp_wire_quant"
+        )
+    for r, d, line in ops:
+        if r == data_rank and d != "i8":
+            problems.append(
+                f"full-rank collective_permute ships {d}, not si8: {line}"
+            )
+    return problems
+
+
+def check_wire_off_exact(text: str) -> list:
+    """With the knob OFF (the default), NO collective_permute may carry
+    i8 — the off path must be the bit-identical unquantized wire."""
+    bad = [
+        line for r, d, line in _collective_permute_operands(text) if d == "i8"
+    ]
+    return [
+        f"pp_wire_quant=None program ships int8 on the wire (the off "
+        f"path must be bit-identical): {line}" for line in bad
+    ]
+
+
+def check_wire_no_recompile() -> list:
+    """Run the wire-quantized pp decode twice with different TRACED
+    values; neither the variant memo nor the jit cache may grow — the
+    quantized programs obey the same one-program-per-topology contract
+    as the plain wire."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import generate as G
+
+    engine = _pp_engine("int8")
+    backend = engine.backend
+    sampling = G.default_sampling(greedy=True)
+
+    def run(limit, start_pos, seed):
+        cache = backend.init_cache(1, engine.cfg.max_seq_len)
+        return backend.decode(
+            jnp.zeros((1,), jnp.int32), cache, jnp.int32(start_pos),
+            jnp.int32(limit), jax.random.PRNGKey(seed), sampling,
+            max_steps=8,
+        )
+
+    out = run(4, 2, 0)
+    jax.block_until_ready(out[0])
+    variants = len(backend._decode_cache)
+    size_first = next(iter(backend._decode_cache.values()))._cache_size()
+    out = run(6, 3, 1)
+    jax.block_until_ready(out[0])
+    size_second = next(iter(backend._decode_cache.values()))._cache_size()
+    if len(backend._decode_cache) > variants or size_second > size_first:
+        return [
+            f"wire-quantized pp decode recompiled across invocations "
+            f"(programs {variants} -> {len(backend._decode_cache)}, jit "
+            f"cache {size_first} -> {size_second}) — quantize/dequantize "
+            f"must stay inside the one compiled program"
+        ]
+    return []
 
 
 def check_pp_ring(text: str, max_per_step: int = 2) -> list:
@@ -580,6 +690,25 @@ def run_hlo_checks() -> dict:
         pp = lower_pp_decode()
         results["pp-decode-callbacks"] = check_no_host_callbacks(pp)
         results["pp-decode-ring"] = check_pp_ring(pp)
+        # wire-dtype family (EngineConfig.pp_wire_quant, ops/
+        # wire_quant.py): knob OFF must ship NO int8 on the ring (the
+        # bit-identity contract, checked on the artifact); knob ON must
+        # ship si8 data on every full-rank collective_permute (fp32 only
+        # for the rank-(n-1) scale companions), with the usual
+        # callbacks / donation / recompile-guard legs on the quantized
+        # program
+        results["wire-dtype-off"] = check_wire_off_exact(pp)
+        wired = lower_pp_decode(wire_quant="int8")
+        results["wire-dtype"] = check_wire_dtype(wired)
+        # data + scale = two rolled collective_permutes per microstep hop
+        results["wire-ring"] = check_pp_ring(wired, max_per_step=4)
+        results["wire-callbacks"] = check_no_host_callbacks(wired)
+        # donation is covered by the donate-cache AST rule for the pp
+        # builders — tf.aliasing_output does not survive shard_map
+        # lowering text, so the artifact leg would be vacuous here (the
+        # plain pp-decode checks skip it for the same reason)
+        results["wire-recompile-guard"] = check_wire_no_recompile()
     else:
         results["pp-decode (skipped: no jax.shard_map / < 2 devices)"] = []
+        results["wire-dtype (skipped: no jax.shard_map / < 2 devices)"] = []
     return results

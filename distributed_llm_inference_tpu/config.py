@@ -112,20 +112,29 @@ class ModelConfig:
     # GPT-2 only: learned absolute position embeddings.
     use_learned_pos: bool = False
     dtype: str = "float32"  # parameter / activation dtype: "float32" | "bfloat16"
+    # Three quantization knobs, one per byte stream (the first two live
+    # here; the third is a TRANSPORT property, so it lives on
+    # EngineConfig.pp_wire_quant beside the other engine-level levers):
+    #   quant         — weight HBM bytes (the batch-1 decode bound)
+    #   kv_quant      — KV-cache HBM bytes (the context/slot-count bound)
+    #   pp_wire_quant — inter-stage ICI bytes (the deep-pipeline bound)
     # Weight-only quantization of the matmul weights (ops/quant.py):
-    # None | "int8" | "int4". int8 halves decode's HBM bytes/token (the
-    # batch-1 decode bound; ~1.6x measured on v5e); int4 halves them
-    # again (packed nibbles, group-wise scales). Llama family; works on
-    # the single device AND the SPMD mesh backends (quantized leaves
-    # shard like their weights).
+    # None | "int8" | "int4". int8 halves decode's HBM bytes/token
+    # (~1.6x measured on v5e); int4 halves them again (packed nibbles,
+    # group-wise scales). Both families; works on the single device AND
+    # the SPMD mesh backends (quantized leaves shard like their weights).
     quant: Optional[str] = None
     # KV-CACHE quantization (ops/kv_quant.py): "int8" stores K/V as int8
     # with per-(token, head) fp32 scales — half the cache HBM, 2x the
-    # slots/context at the same budget. Llama family, on the single
-    # device or a pp/tp/dp pipeline mesh; composes with the prefix KV
-    # cache (snapshots carry the scales) AND the paged block pool
-    # (int8 blocks + scale blocks). The flash kernels, ring attention,
-    # and the 1F1B schedule read raw dtypes and reject the combination.
+    # slots/context at the same budget. Both families via the shared
+    # attn_hook seam, on EVERY topology — single device, pp/tp/dp
+    # pipeline meshes, the 1F1B schedule (per-leaf cache specs +
+    # tree-aware row slicing), and sp rings (the ring/cp hooks quantize
+    # on write and rotate int8 chunks + scales over ICI). Composes with
+    # the prefix KV cache (snapshots carry the scales), the paged block
+    # pool (int8 blocks + scale blocks), warm recovery (shadowed KVQuant
+    # leaves), and attn_impl="pallas" (the flash/paged kernels
+    # dequantize int8 tiles/blocks in their prologues).
     kv_quant: Optional[str] = None
     # Attention implementation: "xla" (einsum + full mask, fused by XLA) or
     # "pallas" (flash kernel, ops/flash_attention.py; interpret-mode on CPU).
@@ -467,6 +476,26 @@ class EngineConfig:
     # Livelock guard: a request preempted this many times becomes immune
     # (it keeps its blocks until completion; admission waits instead).
     max_preemptions_per_req: int = 2
+    # Quantized inter-stage transfers (ops/wire_quant.py): "int8"
+    # quantizes the [B, T, D] activation immediately before EVERY
+    # inter-stage hand-off on an SPMD mesh and dequantizes on landing —
+    # the gated microstep ring's ppermute, the 1F1B schedule's two
+    # ppermute sites, the sp ring/ulysses chunk hops, and the masked
+    # psum broadcasts of the final-stage [B, 1, D] window (int8 data +
+    # fp32 per-token-row scales on the wire, EQuARX-style) — cutting the
+    # ICI bytes that bound deeper pipelines ~4x at fp32 (~2x at bf16).
+    # None (the default) is bit-identical to the unquantized wire on
+    # every topology; "int8" is toleranced (greedy token-match-rate
+    # gated in tests). The `wire-dtype` HLO rules machine-check that the
+    # lowered collective-permutes really carry si8 when this is on.
+    pp_wire_quant: Optional[str] = None
+
+    def __post_init__(self):
+        if self.pp_wire_quant not in (None, "int8"):
+            raise ValueError(
+                f"pp_wire_quant must be None or 'int8', got "
+                f"{self.pp_wire_quant!r}"
+            )
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":

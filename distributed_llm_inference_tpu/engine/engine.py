@@ -606,6 +606,26 @@ class InferenceEngine:
             "requests shed with 429 by SLO admission control (class drain "
             "estimate over the TTFT target, or queue full)", ("slo_class",),
         )
+        # pp wire-format families (ops/wire_quant.py + the SPMD backends'
+        # static per-launch accounting): inter-stage activation bytes per
+        # ICI link by transfer family, and whether the int8 wire is on.
+        # Byte counts are host-side arithmetic from program shapes at the
+        # launch seams — nothing is traced, decode while_loops count
+        # their full ring-pass upper bound.
+        self.metrics.counter(
+            "dli_pp_wire_bytes_total",
+            "inter-stage activation bytes shipped on the pp/sp wire, by "
+            "transfer family", ("path",),
+        )
+        self.metrics.gauge(
+            "dli_pp_wire_quant",
+            "1 when the int8 inter-stage wire format "
+            "(EngineConfig.pp_wire_quant) is active on this backend",
+        ).labels().set(
+            1.0 if getattr(self.backend, "wire_quant", None) else 0.0
+        )
+        if hasattr(self.backend, "attach_wire_metrics"):
+            self.backend.attach_wire_metrics(self.metrics)
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
         # between requests are harmless — prefill rewrites slots [0, bucket)

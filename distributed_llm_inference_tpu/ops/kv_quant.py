@@ -44,6 +44,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from .wire_quant import quantize_rows
+
 
 @jax.tree_util.register_pytree_node_class
 class KVQuant:
@@ -88,12 +90,10 @@ def init_quant_cache(
 
 def quantize_chunk(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric int8 over the head_dim axis: x [B, T, KV, Dh] ->
-    (q [B, T, KV, Dh] int8, s [B, T, KV] fp32)."""
-    x32 = x.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(x32), axis=-1)
-    s = jnp.maximum(absmax / 127.0, 1e-12)  # all-zero rows stay zero
-    q = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
-    return q, s
+    (q [B, T, KV, Dh] int8, s [B, T, KV] fp32). The symmetric per-row
+    primitive is shared with the pp wire format (ops/wire_quant.py), so
+    cache and wire quantization cannot drift numerically."""
+    return quantize_rows(x)
 
 
 def dequantize(leaf: KVQuant) -> jnp.ndarray:

@@ -91,7 +91,7 @@ class ContextParallelBackend(SPMDBackendBase):
     name = "context-parallel"
 
     def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh,
-                 sp_strategy: str = "ring"):
+                 sp_strategy: str = "ring", wire_quant=None):
         if sp_strategy not in ("ring", "ulysses"):
             raise ValueError(
                 f"sp_strategy must be 'ring' or 'ulysses', got {sp_strategy!r}"
@@ -135,7 +135,11 @@ class ContextParallelBackend(SPMDBackendBase):
                 f"pp ({pp}) for now (uneven stage splits pad layer slots, "
                 f"which the context-sharded cache does not model yet)"
             )
-        super().__init__(cfg, params, mesh)
+        super().__init__(cfg, params, mesh, wire_quant=wire_quant)
+        # the masked broadcast of the sampled window crosses the sp axis
+        # (sp >= 2 always — a real transfer), so the wire knob applies
+        # regardless of pp; the ring-hop flag stays pp-gated (base class)
+        self._wire_bcast = wire_quant is not None
         # pp > 1 composes now (round-5): layers shard over pp exactly like
         # the PipelineBackend (SPMDBackendBase.shard_params is mesh-
         # driven), prefill/decode run the gated microstep ring over pp
@@ -225,7 +229,27 @@ class ContextParallelBackend(SPMDBackendBase):
             args.append(presence)
         if wb:
             args.append(bias)
+        self._account_sp_prefill_wire(tokens.shape)
         return fn(*args)
+
+    def _account_sp_prefill_wire(self, tokens_shape):
+        """Static sp-wire accounting for one ring/ulysses forward: every
+        layer rotates its K and V chunk (sp - 1) hops (the a2a moves the
+        same chunk volume once re-sharded); int8 caches ship int8 +
+        scales with or without the wire knob, so `quant` reflects what
+        actually crossed. pp microstep hops and the sampled-window
+        broadcast ride their own families."""
+        cfg = self.cfg
+        B, bucket = int(tokens_shape[0]), int(tokens_shape[1])
+        Tc = bucket // self.sp
+        chunk = (B, Tc, cfg.n_kv_heads, cfg.head_dim)
+        self._wire_account(
+            "sp", chunk, 2 * cfg.n_layers * (self.sp - 1),
+            axis_size=self.sp,
+            quant=self.wire_quant is not None or cfg.kv_quant is not None,
+        )
+        self._wire_account("microstep", (B, Tc, cfg.dim), self.pp)
+        self._wire_account("broadcast", (B, 1, cfg.dim), 1, axis_size=self.sp)
 
     # -- shared hook ---------------------------------------------------------
     def _layer_window(self, window_flag):
@@ -292,10 +316,14 @@ class ContextParallelBackend(SPMDBackendBase):
                     ),
                 )
                 return attn, _gated(gate, ck_new, ck), _gated(gate, cv_new, cv)
+            # raw-dtype cache: with pp_wire_quant on, the chunk hops
+            # adopt the int8 recipe (quantize once at entry, rotate int8
+            # + scales, dequantize at use — parallel/ring.py `wire`)
             attn = prefill_attend(
                 q, k, v, AXIS_SP, scale=cfg.query_scale,
                 softcap=cfg.attn_softcap, window=win,
                 valid_start=valid_start,
+                wire=self.wire_quant is not None,
             )
             kc = k.astype(ck.dtype).transpose(0, 2, 1, 3)  # [B,KV,Tc,Dh]
             vc = v.astype(cv.dtype).transpose(0, 2, 1, 3)
@@ -343,6 +371,7 @@ class ContextParallelBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_score(top_n)
             self._programs[("score", top_n)] = fn
+        self._account_sp_prefill_wire(tokens.shape)
         return fn(self.shared, self.layers, tokens, cache)
 
     def _build_score(self, top_n: int):
@@ -461,10 +490,7 @@ class ContextParallelBackend(SPMDBackendBase):
             owner = (li >= 0) & (li < Tc)
             last = jax.lax.dynamic_slice_in_dim(x, jnp.clip(li, 0, Tc - 1), 1, axis=1)
             sel = owner & (jax.lax.axis_index(AXIS_PP) == 0)
-            last = jax.lax.psum(
-                jnp.where(sel, last, jnp.zeros((), last.dtype)),
-                (AXIS_SP, AXIS_PP),
-            )
+            last = self._bcast(last, sel, (AXIS_SP, AXIS_PP))
             logits = unembed_sharded(cfg, shared, last, PP)[:, 0, :]
             first = sample_token(
                 key, logits, *sampling, presence=presence, bias=bias
@@ -624,12 +650,11 @@ class ContextParallelBackend(SPMDBackendBase):
                     layers, x, {"k": ck, "v": cv}, pos, valid_start,
                     attn_hook=cp_hook,
                 )
-                x = jax.lax.psum(
-                    jnp.where(
-                        jax.lax.axis_index(AXIS_PP) == 0, x,
-                        jnp.zeros((), x.dtype),
-                    ),
-                    AXIS_PP,
+                # pp-only broadcast: quantize only when the pp axis is a
+                # real wire (pp == 1 psums a no-op and must stay exact)
+                x = self._bcast(
+                    x, jax.lax.axis_index(AXIS_PP) == 0, AXIS_PP,
+                    quant=self._wire_ring,
                 )
                 logits = unembed_sharded(cfg, shared, x[:, -1:, :], PP)[:, 0, :]
                 key, sub = jax.random.split(key)

@@ -41,6 +41,7 @@ from ..engine.generate import (
 )
 from ..models import api as M
 from ..ops.sampling import sample_token
+from ..ops.wire_quant import masked_psum, wire_bytes, wire_ppermute
 from .mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_TP
 from .partition import (
     cache_spec, init_sharded_cache, layer_specs, shard_params, shared_specs,
@@ -74,7 +75,14 @@ class SPMDBackendBase:
     # loudly at build time
     supports_presence = False
 
-    def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh):
+    def __init__(self, cfg: ModelConfig, params: dict, mesh: Mesh,
+                 wire_quant=None):
+        if wire_quant not in (None, "int8"):
+            # same error shape as EngineConfig's validation — backends
+            # constructed directly (tests, embedders) fail identically
+            raise ValueError(
+                f"pp_wire_quant must be None or 'int8', got {wire_quant!r}"
+            )
         self.cfg = cfg
         self.mesh = mesh
         self.dp = int(mesh.shape.get(AXIS_DP, 1))
@@ -84,6 +92,20 @@ class SPMDBackendBase:
         self.n_stages = self.pp
         self.tp_axis = AXIS_TP if self.tp > 1 else None
         self.ep_axis = AXIS_EP if self.ep > 1 else None
+        # int8 wire format (EngineConfig.pp_wire_quant, ops/wire_quant.py):
+        # _wire_ring quantizes the microstep ring's ppermute hops,
+        # _wire_bcast the masked-psum broadcasts of the final-stage
+        # window. Both stay False on a singleton pp axis — there is no
+        # wire, and a quantize round trip there would break the
+        # pp == 1 exact-degeneration contract. The context backend
+        # widens _wire_bcast for its sp axis (sp >= 2 always transfers).
+        self.wire_quant = wire_quant
+        self._wire_ring = wire_quant is not None and self.pp > 1
+        self._wire_bcast = wire_quant is not None and self.pp > 1
+        # dli_pp_wire_bytes_total family — attached by the engine
+        # (attach_wire_metrics); accounting is host-side static
+        # arithmetic at program-call seams, never traced
+        self._wire_metrics = None
         self.shared, self.layers = shard_params(cfg, params, mesh)
         self._layer_specs = layer_specs(cfg, self.layers)
         self._shared_specs = shared_specs(self.shared)
@@ -121,6 +143,13 @@ class SPMDBackendBase:
         (max_steps, ragged, presence, counts, bias, constraint, logprobs);
         builders that don't support a variant raise NotImplementedError at
         build time (loud, not silently wrong)."""
+        # static wire accounting: a host-int limit bounds the ring passes
+        # exactly; a traced limit falls back to max_steps (never forces a
+        # device sync for a byte counter)
+        self._account_decode_wire(
+            int(first_token.shape[0]),
+            min(limit, max_steps) if isinstance(limit, int) else max_steps,
+        )
         return self._decode_dispatch(
             self._decode_cache, self._variant_builder, first_token, cache,
             start_pos, limit, key, sampling, valid_start, presence, counts,
@@ -256,10 +285,68 @@ class SPMDBackendBase:
                 ep_axis=self.ep_axis, attn_hook=attn_hook,
                 attn_seq_len=attn_seq_len,
             )
-            buf = jax.lax.ppermute(y, AXIS_PP, perm)
+            # the inter-stage hand-off: int8 data + fp32 per-token-row
+            # scales on the wire when pp_wire_quant is on (quant=False
+            # IS lax.ppermute — bit-identical off path)
+            buf = wire_ppermute(y, AXIS_PP, perm, quant=self._wire_ring)
             return buf, cache
 
         return jax.lax.fori_loop(0, S, micro, (x, cache))
+
+    def _bcast(self, x, sel, axes=AXIS_PP, quant=None):
+        """Masked psum broadcast of a single owner's [B, .., D] activation
+        window — the hand-off every pp program's sampling tail starts
+        with. With pp_wire_quant on, the all-reduce ships int8 data +
+        fp32 scales (EQuARX recipe; ops/wire_quant.masked_psum);
+        off, it is the exact masked-psum idiom this replaced."""
+        if quant is None:
+            quant = self._wire_bcast
+        return masked_psum(x, sel, axes, quant=quant)
+
+    # -- host-side static wire accounting (dli_pp_wire_bytes_total) ---------
+    def attach_wire_metrics(self, registry):
+        """Engine seam (engine/engine.py pre-registers the families): the
+        backend increments per-launch byte counts computed from static
+        shapes — no tracing cost, no host syncs."""
+        self._wire_metrics = registry.counter(
+            "dli_pp_wire_bytes_total",
+            "inter-stage activation bytes shipped on the pp/sp wire, by "
+            "transfer family", ("path",),
+        )
+
+    def _wire_account(self, path: str, shape, hops: int, axis_size=None,
+                      quant=None):
+        """Count `hops` crossings of one [..., D] activation on the wire
+        (static shapes only; decode while_loops count their full
+        ring-pass upper bound — documented in ARCHITECTURE.md).
+        axis_size: participants on the transfer axis (default pp) — a
+        singleton axis moves nothing, so it counts nothing. quant: what
+        actually crossed (default: the wire knob; the sp path passes
+        `or kv_quant` — an int8 cache's chunks are int8 on the wire
+        with or without the knob)."""
+        fam = self._wire_metrics
+        if axis_size is None:
+            axis_size = self.pp
+        if fam is None or hops <= 0 or axis_size <= 1:
+            return
+        if quant is None:
+            quant = self.wire_quant is not None
+        itemsize = jnp.dtype(self.cfg.jnp_dtype).itemsize
+        fam.labels(path=path).inc(
+            wire_bytes(shape, itemsize, hops, quant=quant)
+        )
+
+    def _account_decode_wire(self, rows: int, steps: int):
+        """Per-decode-launch accounting for the plain microstep ring:
+        S ppermute hops + one broadcast per emitted token (bytes are
+        PER ICI LINK — the binding quantity; dp rings are independent,
+        so a dp shard's rows divide out)."""
+        if self.pp <= 1:
+            return
+        D = self.cfg.dim
+        r = max(1, rows // self.dp)
+        self._wire_account("microstep", (r, 1, D), steps * self.pp)
+        self._wire_account("broadcast", (r, 1, D), steps)
 
     def _dp_key(self, key):
         """Decorrelate sampling across dp batch shards. dp=1 keeps the key
@@ -324,6 +411,9 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_extend()
             self._programs["extend"] = fn
+        self._wire_account(
+            "microstep", tokens.shape + (self.cfg.dim,), self.pp
+        )
         return fn(self.shared, self.layers, tokens, pos, cache)
 
     def prefill_at(self, tokens, pos, valid_len, cache, key, sampling,
@@ -357,6 +447,9 @@ class PipelineBackend(SPMDBackendBase):
             args.append(presence)
         if wb:
             args.append(bias)
+        B, D = int(tokens.shape[0]), self.cfg.dim
+        self._wire_account("microstep", tokens.shape + (D,), self.pp)
+        self._wire_account("broadcast", (B, 1, D), 1)
         return fn(*args)
 
     def _build_prefill(self):
@@ -393,9 +486,7 @@ class PipelineBackend(SPMDBackendBase):
             # [B, 1, D] slice (not the vocab row) then compute the vocab-
             # sharded logits everywhere
             last = jax.lax.dynamic_slice_in_dim(buf, valid_len - 1, 1, axis=1)
-            last = jax.lax.psum(
-                jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
-            )
+            last = self._bcast(last, s == 0)
             logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
             first = sample_token(
                 key, logits, *sampling, presence=presence, bias=bias
@@ -447,11 +538,18 @@ class PipelineBackend(SPMDBackendBase):
         in slots mode — every slot starts at position 0 (no left-pad)."""
         return self.dp == 1 and self.cfg.arch in ("llama", "gpt2")
 
+    def _account_slots_wire(self, rows: int, num_steps: int):
+        """Slot-decode chunk: S ring hops + one broadcast per step."""
+        D = self.cfg.dim
+        self._wire_account("microstep", (rows, 1, D), num_steps * self.pp)
+        self._wire_account("broadcast", (rows, 1, D), num_steps)
+
     def decode_slots(self, state, cache, key, sparams, *, num_steps):
         fn = self._programs.get(("slots", num_steps))
         if fn is None:
             fn = self._build_decode_slots(num_steps)
             self._programs[("slots", num_steps)] = fn
+        self._account_slots_wire(int(state.token.shape[0]), num_steps)
         return fn(self.shared, self.layers, state, cache, key, sparams)
 
     def _build_decode_slots(self, num_steps: int):
@@ -470,10 +568,7 @@ class PipelineBackend(SPMDBackendBase):
                 x = embed_sharded(cfg, shared, state.token[:, None], state.pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, state.pos)
                 s = jax.lax.axis_index(AXIS_PP)
-                last = jax.lax.psum(
-                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
-                    AXIS_PP,
-                )
+                last = self._bcast(buf[:, -1:, :], s == 0)
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 # shared per-step sampling/bookkeeping (engine/generate.py):
                 # the cross-backend token-parity guarantee lives in ONE place
@@ -513,6 +608,7 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_decode_slots_constrained(num_steps)
             self._programs[("slots_cn", num_steps)] = fn
+        self._account_slots_wire(int(state.token.shape[0]), num_steps)
         return fn(self.shared, self.layers, state, cache, key, sparams,
                   fsm, cmask, ctrans)
 
@@ -531,10 +627,7 @@ class PipelineBackend(SPMDBackendBase):
                 x = embed_sharded(cfg, shared, state.token[:, None], state.pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, state.pos)
                 s = jax.lax.axis_index(AXIS_PP)
-                last = jax.lax.psum(
-                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
-                    AXIS_PP,
-                )
+                last = self._bcast(buf[:, -1:, :], s == 0)
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 new, emit, can_emit, fsm = slot_step_constrained(
                     cfg, state, sparams, logits, sub, fsm, cmask, ctrans
@@ -628,6 +721,7 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_decode_slots_paged(num_steps)
             self._programs[("slots_paged", num_steps)] = fn
+        self._account_slots_wire(int(state.token.shape[0]), num_steps)
         return fn(self.shared, self.layers, state, pool, table, key, sparams)
 
     def fill_scratch_paged(self, pool, table_row):
@@ -728,6 +822,9 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_extend_ragged_paged()
             self._programs["extend_ragged_paged"] = fn
+        self._wire_account(
+            "microstep", (int(tokens.shape[0]), 1, self.cfg.dim), self.pp
+        )
         return fn(self.shared, self.layers, tokens, tok_row, tok_pos, meta,
                   pool, table)
 
@@ -775,6 +872,9 @@ class PipelineBackend(SPMDBackendBase):
             args.append(presence)
         if wb:
             args.append(bias)
+        D = self.cfg.dim
+        self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
+        self._wire_account("broadcast", (1, 1, D), 1)
         return fn(*args)
 
     def _build_prefill_ragged_paged(self, with_presence: bool,
@@ -805,9 +905,7 @@ class PipelineBackend(SPMDBackendBase):
                 layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1
             )
             last = jax.lax.dynamic_slice_in_dim(buf, sample_at, 1, axis=0)
-            last = jax.lax.psum(
-                jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
-            )  # [1, 1, D]
+            last = self._bcast(last, s == 0)  # [1, 1, D]
             logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
             first = sample_token(
                 key, logits, *sampling, presence=presence, bias=bias
@@ -872,6 +970,12 @@ class PipelineBackend(SPMDBackendBase):
             args.append(spec)
         if spec_toks is not None:
             args.append(spec_toks)
+        D = self.cfg.dim
+        self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
+        # two replicated-logits gathers (decode rows + arm positions),
+        # plus the K+1 verify positions per slot on the spec variant
+        bh = 2 + (int(spec.idx.shape[1]) if spec is not None else 0)
+        self._wire_account("broadcast", (int(dec_idx.shape[0]), 1, D), bh)
         return fn(*args)
 
     def _build_mixed_step_ragged(self, with_spec: bool = False,
@@ -928,10 +1032,7 @@ class PipelineBackend(SPMDBackendBase):
 
             def replicated_logits(idx):
                 sel = buf[idx]  # [N, 1, D]
-                sel = jax.lax.psum(
-                    jnp.where(s == 0, sel, jnp.zeros((), sel.dtype)),
-                    AXIS_PP,
-                )
+                sel = self._bcast(sel, s == 0)
                 return unembed_sharded(cfg, shared, sel, S)[:, 0, :]
 
             sp_logits = sp_draft = None
@@ -996,10 +1097,7 @@ class PipelineBackend(SPMDBackendBase):
                     layers, x, pool, state.pos, attn_hook=hook,
                     attn_seq_len=MB * bs,
                 )
-                last = jax.lax.psum(
-                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
-                    AXIS_PP,
-                )
+                last = self._bcast(buf[:, -1:, :], s == 0)
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 new, emit, can_emit = slot_step(cfg, state, sparams, logits, sub)
                 return (new, pool), (emit, can_emit)
@@ -1102,10 +1200,7 @@ class PipelineBackend(SPMDBackendBase):
                 # shipped), then every stage computes its vocab shard and
                 # the all_gather'd logits are identical everywhere — so the
                 # sampled token needs no further collective
-                last = jax.lax.psum(
-                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
-                    AXIS_PP,
-                )
+                last = self._bcast(buf[:, -1:, :], s == 0)
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
                 key, sub = jax.random.split(key)
                 nxt = sample_token(
@@ -1206,6 +1301,9 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_score(top_n)
             self._programs[("score", top_n)] = fn
+        shape = tokens.shape + (self.cfg.dim,)
+        self._wire_account("microstep", shape, self.pp)
+        self._wire_account("broadcast", shape, 1)
         return fn(self.shared, self.layers, tokens, pos, cache)
 
     def _build_score(self, top_n: int):
@@ -1221,9 +1319,7 @@ class PipelineBackend(SPMDBackendBase):
             s = jax.lax.axis_index(AXIS_PP)
             x = embed_sharded(cfg, shared, tokens, pos, S)
             buf, cache = self._microstep_loop(layers, x, cache, pos)
-            full = jax.lax.psum(
-                jnp.where(s == 0, buf, jnp.zeros((), buf.dtype)), AXIS_PP
-            )
+            full = self._bcast(buf, s == 0)
             logits = unembed_sharded(cfg, shared, full, S)
             return score_post(logits, tokens, top_n) + (cache,)
 
@@ -1255,6 +1351,10 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_speculative(max_steps, draft_len)
             self._programs[key_] = fn
+        # upper bound: one [1, 1+G, D] verify window per spec cycle
+        shape = (1, 1 + draft_len, self.cfg.dim)
+        self._wire_account("microstep", shape, max_steps * self.pp)
+        self._wire_account("broadcast", shape, max_steps)
         return fn(
             self.shared, self.layers, first_token, cache, hist,
             jnp.int32(hist_len), jnp.int32(limit),
@@ -1275,9 +1375,7 @@ class PipelineBackend(SPMDBackendBase):
             def fwd(tokens_in, cache, pos):
                 x = embed_sharded(cfg, shared, tokens_in, pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos)
-                full = jax.lax.psum(
-                    jnp.where(s == 0, buf, jnp.zeros((), buf.dtype)), AXIS_PP
-                )
+                full = self._bcast(buf, s == 0)
                 return unembed_sharded(cfg, shared, full, S), cache
 
             return spec_loop(
@@ -1308,6 +1406,9 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_draft_speculative(dcfg, max_steps, draft_len)
             self._programs[key_] = fn
+        shape = (1, 1 + draft_len, self.cfg.dim)
+        self._wire_account("microstep", shape, max_steps * self.pp)
+        self._wire_account("broadcast", shape, max_steps)
         return fn(
             self.shared, self.layers, dparams, first_token, cache, dcache,
             jnp.int32(start_pos), jnp.int32(limit),
@@ -1330,9 +1431,7 @@ class PipelineBackend(SPMDBackendBase):
             def fwd(tokens_in, cache, pos):
                 x = embed_sharded(cfg, shared, tokens_in, pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos)
-                full = jax.lax.psum(
-                    jnp.where(s == 0, buf, jnp.zeros((), buf.dtype)), AXIS_PP
-                )
+                full = self._bcast(buf, s == 0)
                 return unembed_sharded(cfg, shared, full, S), cache
 
             def dfwd(tok_11, dc, p):
@@ -1369,6 +1468,8 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_beam(max_steps, num_beams, early_stopping)
             self._programs[key_] = fn
+        steps = min(limit, max_steps) if isinstance(limit, int) else max_steps
+        self._account_slots_wire(num_beams, steps)
         return fn(
             self.shared, self.layers, logits0, cache, start_pos,
             jnp.int32(limit), jnp.float32(length_penalty),
@@ -1393,10 +1494,7 @@ class PipelineBackend(SPMDBackendBase):
             def fwd(last, cache, pos):
                 x = embed_sharded(cfg, shared, last, pos, S)
                 buf, cache = self._microstep_loop(layers, x, cache, pos)
-                lastb = jax.lax.psum(
-                    jnp.where(s == 0, buf[:, -1:, :], jnp.zeros((), buf.dtype)),
-                    AXIS_PP,
-                )
+                lastb = self._bcast(buf[:, -1:, :], s == 0)
                 logits = unembed_sharded(cfg, shared, lastb, S)[:, 0, :]
                 return logits, cache
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..ops.wire_quant import quantize_rows
 from .mesh import AXIS_SP
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -76,6 +77,7 @@ def ring_attend(
     softcap: float | None = None,
     window: int | None = None,
     valid_start: jnp.ndarray | None = None,
+    wire: bool = False,
 ) -> jnp.ndarray:
     """Causal ring attention on sequence-sharded Q/K/V chunks.
 
@@ -94,6 +96,12 @@ def ring_attend(
     positions < valid_start[b] are row-b padding and masked out — the
     mask gains a batch dim, nothing else changes (pad QUERY rows produce
     all-masked scores and are already guarded by the l==0 floor).
+    wire (EngineConfig.pp_wire_quant): raw-dtype K/V chunks adopt the
+    int8 cache's rotation recipe — quantized ONCE at entry with the same
+    per-(token, head) scales (ops/wire_quant.quantize_rows), int8 +
+    scales rotate, dequant at use — so every ICI hop ships int8 whether
+    the CACHE is quantized or not. Identical numerics to an int8 cache's
+    ring; a no-op when k_scale is already present.
     """
     sp = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -102,6 +110,9 @@ def ring_attend(
     G = H // KV
     if scale is None:
         scale = Dh**-0.5
+    if wire and k_scale is None:
+        k, k_scale = quantize_rows(k)
+        v, v_scale = quantize_rows(v)
     quant = k_scale is not None
 
     qg = (q.astype(jnp.float32) * scale).reshape(B, Tc, KV, G, Dh)
@@ -171,6 +182,7 @@ def ulysses_attend(
     softcap: float | None = None,
     window: int | None = None,
     valid_start: jnp.ndarray | None = None,
+    wire: bool = False,
 ) -> jnp.ndarray:
     """Ulysses-style (DeepSpeed) sequence parallelism: two all-to-alls
     instead of a ring.
@@ -189,9 +201,15 @@ def ulysses_attend(
     k_scale/v_scale [B,Tc,KV]: int8 chunks + scales ride the a2a (same
     traffic saving as ring_attend's quantized rotation), dequantized at
     use after the re-shard.
+    wire: as in ring_attend — raw-dtype K/V quantize once at entry so
+    the two fat a2a hops ship int8 + scales; q stays full precision
+    (matching the int8-cache recipe, which never quantizes queries).
     """
     sp = jax.lax.psum(1, axis_name)
     B, Tc, H, Dh = q.shape
+    if wire and k_scale is None:
+        k, k_scale = quantize_rows(k)
+        v, v_scale = quantize_rows(v)
     quant = k_scale is not None
     # seq -> heads: split the head axis sp ways, concat chunks on the
     # sequence axis (tiled a2a concatenates in ring order, so positions

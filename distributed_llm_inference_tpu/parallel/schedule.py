@@ -45,10 +45,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import ModelConfig
 from ..models import api as M
 from ..ops.sampling import sample_token
+from ..ops.wire_quant import wire_ppermute
 from .mesh import AXIS_DP, AXIS_PP
 from .partition import cache_spec
 from ..engine.generate import stop_mask
-from .pipeline import PipelineBackend, _ring_perm
+from .pipeline import PipelineBackend, SPMDBackendBase, _ring_perm
 from .vocab import embed_sharded, unembed_sharded
 
 
@@ -102,6 +103,7 @@ class MicrobatchPipelineBackend(PipelineBackend):
         mesh: Mesh,
         n_microbatches: int | None = None,
         return_prefill_logits: bool = False,
+        wire_quant=None,
     ):
         pp = int(mesh.shape[AXIS_PP])
         self.n_microbatches = int(n_microbatches or pp)
@@ -117,7 +119,7 @@ class MicrobatchPipelineBackend(PipelineBackend):
         # each sample event psums one int32 per row instead of the full
         # vocab row. Parity tests opt in to get comparable logits.
         self.return_prefill_logits = bool(return_prefill_logits)
-        super().__init__(cfg, params, mesh)
+        super().__init__(cfg, params, mesh, wire_quant=wire_quant)
         # plain-ring variant programs get their own memo: the base
         # _decode_cache is keyed by (max_steps, flags) alone, which cannot
         # distinguish a fleet-shaped call (1F1B program) from a solo /
@@ -134,6 +136,22 @@ class MicrobatchPipelineBackend(PipelineBackend):
             dict(stage, microbatches=self.n_microbatches)
             for stage in super().health()
         ]
+
+    def _account_decode_wire(self, rows: int, steps: int):
+        """Fleet-shaped dispatches run the 1F1B schedule: S - 1 + steps*M
+        microsteps of one [b_m, 1, D] buffer per link + one broadcast
+        per sample event. Non-fleet shapes fall back to the plain ring's
+        accounting (matching decode()'s dispatch; the variant branch
+        accounts for itself)."""
+        if self.pp <= 1:
+            return
+        if rows % self.batch_granularity:
+            return super()._account_decode_wire(rows, steps)
+        Mb = self.n_microbatches
+        b_m = rows // self.batch_granularity
+        D = self.cfg.dim
+        self._wire_account("1f1b", (b_m, 1, D), self.pp - 1 + steps * Mb)
+        self._wire_account("broadcast", (b_m, 1, D), steps * Mb)
 
     # -- schedule pieces ----------------------------------------------------
     def _stage_apply(self, layers, x, cache, pos_m, m_here, b_m, gate,
@@ -175,9 +193,7 @@ class MicrobatchPipelineBackend(PipelineBackend):
         everywhere — are sampled with the shared key. Returns
         (tok [b_m], logits [b_m, V]).
         """
-        last = jax.lax.psum(
-            jnp.where(s == 0, last, jnp.zeros((), last.dtype)), AXIS_PP
-        )
+        last = self._bcast(last, s == 0)
         logits = unembed_sharded(self.cfg, shared, last, self.pp)[:, 0, :]
         tok = sample_token(key, logits, *sampling)
         return tok, logits
@@ -198,6 +214,16 @@ class MicrobatchPipelineBackend(PipelineBackend):
                 tokens, jnp.int32(0), prompt_len, cache, key, sampling,
                 valid_start, presence, bias,
             )
+        # static wire accounting for the 1F1B ingest: M + S - 1
+        # microsteps of one [b_m, bucket, D] buffer per link + one
+        # sampled-window broadcast per microbatch
+        b_m = rows // self.batch_granularity
+        D = self.cfg.dim
+        self._wire_account(
+            "1f1b", (b_m, int(tokens.shape[1]), D),
+            self.n_microbatches + self.pp - 1,
+        )
+        self._wire_account("broadcast", (b_m, 1, D), self.n_microbatches)
         if valid_start is None:
             return self._prefill(
                 self.shared, self.layers, tokens, prompt_len, cache, key,
@@ -245,7 +271,9 @@ class MicrobatchPipelineBackend(PipelineBackend):
                     layers, x, cache, jnp.int32(0), m_here, b_m, gate,
                     valid_start_m=None if vs is None else vs[m_here],
                 )
-                buf = jax.lax.ppermute(y, AXIS_PP, perm)
+                # microbatch hand-off: int8 + per-token-row scales when
+                # pp_wire_quant is on (quant=False IS lax.ppermute)
+                buf = wire_ppermute(y, AXIS_PP, perm, quant=self._wire_ring)
                 # sample: microbatch (t-S+1) finished all stages and just
                 # rotated onto stage 0
                 m_done = jnp.mod(t - (S - 1), Mb)
@@ -309,6 +337,10 @@ class MicrobatchPipelineBackend(PipelineBackend):
                 first_token, cache, start_pos, limit, key, sampling,
                 valid_start=valid_start, max_steps=max_steps,
             )
+        # variant fallback runs the inherited plain-ring programs —
+        # account those bytes, not the 1F1B schedule's
+        steps = min(limit, max_steps) if isinstance(limit, int) else max_steps
+        SPMDBackendBase._account_decode_wire(self, rows, steps)
         return self._decode_dispatch(
             self._ring_variants, self._ring_builder, first_token, cache,
             start_pos, limit, key, sampling, valid_start, presence, counts,
@@ -388,7 +420,7 @@ class MicrobatchPipelineBackend(PipelineBackend):
                     layers, x, cache, pos[m_here], m_here, b_m, gate,
                     valid_start_m=None if vs is None else vs[m_here],
                 )
-                buf = jax.lax.ppermute(y, AXIS_PP, perm)
+                buf = wire_ppermute(y, AXIS_PP, perm, quant=self._wire_ring)
                 # sample event: microbatch (t-S+1) completed a ring pass
                 m_done = jnp.mod(t - (S - 1), Mb)
                 ev = (t >= S - 1) & ~done[m_done]

@@ -34,6 +34,7 @@ def create_backend(
     seed: int = 0,
     sp_strategy: str = "ring",
     lora: Optional[str] = None,
+    wire_quant: Optional[str] = None,
 ):
     """Build a compute backend alone (no engine/tokenizer around it).
 
@@ -42,7 +43,10 @@ def create_backend(
     (parallel/schedule.py, BASELINE config 5) when microbatches > 1.
     Batched workloads (bench harness, dryrun, batch-serving callers) use
     the backend interface directly: batch % (dp * microbatches) == 0.
-    Returns (cfg, backend).
+    wire_quant (EngineConfig.pp_wire_quant through create_engine):
+    "int8" quantizes every inter-stage activation hand-off on the SPMD
+    backends (ops/wire_quant.py); ignored on the single device — there
+    is no wire. Returns (cfg, backend).
     """
     cfg = get_model_config(model) if isinstance(model, str) else model
     if dtype is not None:
@@ -112,18 +116,20 @@ def create_backend(
             )
         mesh = build_mesh(mesh_cfg)
         return cfg, MicrobatchPipelineBackend(
-            cfg, params, mesh, n_microbatches=microbatches
+            cfg, params, mesh, n_microbatches=microbatches,
+            wire_quant=wire_quant,
         )
     if mesh_cfg.sp > 1:
         mesh = build_mesh(mesh_cfg)
         return cfg, ContextParallelBackend(
-            cfg, params, mesh, sp_strategy=sp_strategy
+            cfg, params, mesh, sp_strategy=sp_strategy,
+            wire_quant=wire_quant,
         )
     if not mesh_cfg.is_trivial:
         # sp > 1 already returned above, so a non-trivial mesh here means
         # dp/pp/tp/ep — the SPMD pipeline's axes
         mesh = build_mesh(mesh_cfg)
-        return cfg, PipelineBackend(cfg, params, mesh)
+        return cfg, PipelineBackend(cfg, params, mesh, wire_quant=wire_quant)
     return cfg, SingleDeviceBackend(cfg, params)
 
 
@@ -170,6 +176,7 @@ def create_engine(
         model, mesh_cfg=mesh_cfg, microbatches=microbatches, params=params,
         dtype=dtype, quant=quant, kv_quant=kv_quant, attn_impl=attn_impl,
         seed=seed, sp_strategy=sp_strategy, lora=lora,
+        wire_quant=engine_cfg.pp_wire_quant,
     )
     engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed

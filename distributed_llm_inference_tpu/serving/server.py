@@ -1130,6 +1130,15 @@ def main(argv: Optional[list] = None):
              "block pool stacks both HBM levers — and --attn-impl "
              "pallas, whose kernels dequantize in their prologues)",
     )
+    ap.add_argument(
+        "--pp-wire-quant", default=None, choices=[None, "int8"],
+        help="quantized inter-stage transfers: int8 + per-token-row fp32 "
+             "scales on every pp/sp activation hand-off (microstep ring, "
+             "1F1B, sp chunk rotation, final-stage broadcast) — ~4x "
+             "fewer ICI bytes at fp32 (~2x at bf16), the binding "
+             "constraint for deeper pipelines; default off = "
+             "bit-identical wire (greedy output toleranced when on)",
+    )
     ap.add_argument("--max-tokens-cap", type=int, default=30)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
@@ -1392,6 +1401,7 @@ def main(argv: Optional[list] = None):
             spec_decode=args.spec_decode,
             spec_draft_len=args.spec_draft_len,
             spec_draft_model=args.spec_draft_model,
+            pp_wire_quant=args.pp_wire_quant,
         ),
         microbatches=args.microbatches,
         params=params,
