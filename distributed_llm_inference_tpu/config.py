@@ -458,6 +458,19 @@ class EngineConfig:
     # n-gram lookup. A draft already attached via engine.set_draft()
     # takes precedence over loading this name. None = n-gram drafts.
     spec_draft_model: Optional[str] = None
+    # Device-derived launch metadata for the speculative mixed launch
+    # (engine/paged.DeviceMeta + apply_device_meta): decode/verify rows
+    # read their q_start / per-token positions from the device-resident
+    # slot state instead of the host position model, so a slot with an
+    # unfetched verify row is never frozen — every eligible slot submits
+    # a verify row EVERY scheduler step, back to back under lag
+    # pipelining, and the packed fetch only confirms emissions. On top,
+    # the scheduler sizes each slot's next draft adaptively from its
+    # acceptance-rate EWMA (TokenBudgetScheduler.spec_slot_k). False
+    # pins the PR-13 skip-until-fetched behavior (host-planned q_start,
+    # one verify row per fetch round trip) — kept as the bench.py
+    # `spec_lag` baseline.
+    spec_device_meta: bool = True
     # SLO-aware KV preemption (engine/continuous.py _preempt_for): when a
     # paged admission still cannot get blocks after the evict-
     # unreferenced-chains retry, the scheduler preempts the lowest-SLO-

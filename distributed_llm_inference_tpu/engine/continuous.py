@@ -48,10 +48,23 @@ fully traced (engine/paged.spec_verify — match-prefix + correction token
 on device, packed into the existing fetch), the slot's position simply
 advances by the accepted count (rejected draft K/V beyond the new
 frontier is overwritten before it can be attended or shadow-captured),
-and the host position model resyncs from the fetched advance — a slot
-with an unfetched verify row is skipped (frozen on device via
-SpecPlan.dec_on) until its fetch lands, so the kernel's host-planned
-q_start metadata stays exact. Speculated tokens debit step_token_budget
+and the host position model resyncs from the fetched advance. With
+device-derived launch metadata (ISSUE 15, engine_cfg.spec_device_meta,
+default ON) the kernel reads each decode/verify row's q_start and
+per-token positions from the device-resident slot state
+(engine/paged.DeviceMeta + apply_device_meta), so an unfetched verify
+row never freezes its slot: every eligible slot submits a verify row
+EVERY scheduler step, back to back under lag pipelining, the host
+drafts from an OPTIMISTIC history (fetched tokens + its own pending
+predicted windows — a misprediction only lowers acceptance, never
+correctness: the verify accepts only the model's own argmax), and the
+packed fetch confirms emissions after the fact. Per-slot adaptive K
+(TokenBudgetScheduler.spec_slot_k): an acceptance-rate EWMA fed from
+the same fetch sizes each slot's next draft between 0 and
+spec_draft_len. spec_device_meta=False pins the PR-13 behavior — a
+slot with an unfetched verify row is skipped (frozen on device via
+SpecPlan.dec_on) so the host-planned q_start stays exact — kept as the
+bench.py spec_lag baseline. Speculated tokens debit step_token_budget
 (TokenBudgetScheduler.spec_draft_len), so the SLO layer throttles K to 0
 under decode TPOT pressure — speculation accelerates idle fleets and
 self-disables under load. Greedy output is bit-identical to
@@ -420,10 +433,15 @@ class ContinuousEngine:
         )
         # chunked-mode host state: pending PrefillJobs (arrival order),
         # slot -> job for slots whose prompt is still landing, and the
-        # host's position model per slot (exact for live rows — used for
-        # the decode tiles' kernel metadata; over-advance on rows that
-        # went inactive since the last fetch is masked garbage, the
-        # frozen-row argument)
+        # host's position model per slot. With device-derived launch
+        # metadata (spec_device_meta) the kernel reads decode/verify
+        # positions from slot state and this model is a LAGGED
+        # accounting view (launch entries carry it only as a
+        # placeholder; verify fetches catch it up by the accepted
+        # advance); without it, it must be exact for live rows — it IS
+        # the decode tiles' kernel metadata there (over-advance on rows
+        # that went inactive since the last fetch is masked garbage,
+        # the frozen-row argument)
         self._jobs: list = []
         self._prefilling: dict = {}
         self._host_pos = np.zeros((self.n_slots,), np.int64)
@@ -435,22 +453,47 @@ class ContinuousEngine:
             self._idle_arm = _P_arm.idle_mixed_arm(
                 self.n_slots, cfg.vocab_size
             )
-        # Speculative decoding on the mixed fleet (ISSUE 13): eligible
-        # greedy decode slots submit [current + K-draft] verify rows
-        # inside the mixed launch. Host state: which slots have an
-        # UNFETCHED verify row (skipped from planning until the packed
-        # fetch resyncs their position) and how many unfetched launches
-        # carry each slot at all (n-gram drafts read the fetched
-        # history; a fully-fetched slot drafts from its true frontier).
+        # Speculative decoding on the mixed fleet (ISSUE 13 + 15):
+        # eligible greedy decode slots submit [current + K-draft] verify
+        # rows inside the mixed launch. Two position disciplines:
+        #   * spec_device_meta (default): q_start / per-token positions
+        #     derive ON DEVICE from slot state (engine/paged.DeviceMeta)
+        #     — verify rows launch EVERY step, back to back; the host
+        #     keeps a FIFO of pending (unfetched) verify launches per
+        #     slot (_spec_pending) carrying each launch's predicted
+        #     window so n-gram drafting continues from the optimistic
+        #     frontier, plus the advance upper bound for the block-
+        #     capacity clamp.
+        #   * legacy (spec_device_meta=False, the bench baseline): a
+        #     slot with an unfetched verify row is skipped from planning
+        #     (_spec_inflight) until the packed fetch resyncs its
+        #     position — the PR-13 alternation.
         ecfg = engine.engine_cfg
         self._spec_k_max = max(0, int(getattr(ecfg, "spec_draft_len", 0)))
         self._spec_auto = bool(getattr(ecfg, "spec_decode", False))
         self._spec_capable = bool(self._chunked and self._spec_k_max > 0)
-        self._spec_inflight: dict = {}  # slot -> (req, n_draft) unfetched
+        self._spec_devmeta = bool(
+            self._spec_capable
+            and getattr(ecfg, "spec_device_meta", True)
+        )
+        self._spec_inflight: dict = {}  # legacy: slot -> (req, n_draft)
+        # device-meta mode: slot -> FIFO of dicts per unfetched verify
+        # launch ({req, nd, pred (drafts + predicted correction, n-gram
+        # mode), adv (position-advance upper bound nd + 1)})
+        self._spec_pending: dict = {}
+        # amortized decode-chunk launches not yet fetched: their
+        # emissions are unpredictable many-token advances, so drafting
+        # pauses while any are outstanding (positions stay exact either
+        # way — they derive on device)
+        self._chunk_unfetched = 0
         self._row_inflight = np.zeros((self.n_slots,), np.int64)
         self.spec_launches = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # verify rows launched while an earlier one was still unfetched
+        # — the back-to-back counter the lag-pipelining tests pin (zero
+        # by construction in the legacy mode)
+        self.spec_pipelined = 0
         # cfg-gated draft model (the decode_draft_speculative flavor):
         # a small same-tokenizer model proposes drafts device-side,
         # batched over the fleet, over its OWN pool leaves indexed by
@@ -1256,10 +1299,16 @@ class ContinuousEngine:
                 "mode": "draft_model" if self._draft_mode else "ngram",
                 "draft_len": self._spec_k_max,
                 "fleet_wide": self._spec_auto,
+                "device_meta": self._spec_devmeta,
                 "launches": self.spec_launches,
                 "drafted_tokens": self.spec_drafted,
                 "accepted_tokens": self.spec_accepted,
-                "inflight_rows": len(self._spec_inflight),
+                "inflight_rows": len(self._spec_inflight) + sum(
+                    len(v) for v in self._spec_pending.values()
+                ),
+                # verify rows launched while an earlier one was still
+                # unfetched — >0 proves lag-pipelined speculation
+                "pipelined_launches": self.spec_pipelined,
             }
         cstats = self._ctable.stats()
         if cstats["resident"]:
@@ -1314,8 +1363,12 @@ class ContinuousEngine:
         self._host_pos[:] = 0
         # speculation bookkeeping dies with the fleet too: unfetched
         # verify rows are unfetched launches (their emissions drop, the
-        # salvage record holds fetched tokens only — same contract)
+        # salvage record holds fetched tokens only — same contract);
+        # pending device-meta windows and the chunk-fetch gate reset
+        # with them
         self._spec_inflight.clear()
+        self._spec_pending.clear()
+        self._chunk_unfetched = 0
         self._row_inflight[:] = 0
         if (
             admitting is not None and admitting not in running
@@ -2145,20 +2198,28 @@ class ContinuousEngine:
             self._reap_jobs()
             self._start_jobs()
             spec_rows = self._plan_spec()
-            if self._jobs or spec_rows or self._spec_inflight:
+            if (
+                self._jobs or spec_rows or self._spec_inflight
+                or self._spec_pending
+            ):
                 # mixed step: prefill chunks and/or verify rows ride the
                 # flat token axis with the decode rows. A slot whose
                 # verify row is still unfetched keeps the fleet on the
-                # mixed program too (it must stay frozen via dec_on
-                # until its position resyncs — the amortized chunk
-                # program would advance it)
+                # mixed program too (legacy mode: it must stay frozen
+                # via dec_on until its position resyncs; device-meta
+                # mode: its next row's positions derive from slot state,
+                # and staying mixed keeps the per-launch emission
+                # bookkeeping uniform while verify fetches are pending)
                 step = self._launch_mixed(spec_rows)
             else:
                 step = self._launch_chunk()
                 if step is not None:
                     # host position model: every believed-active slot
                     # advanced chunk_steps (over-advance on rows that die
-                    # mid-chunk is masked garbage, the frozen-row rule)
+                    # mid-chunk is masked garbage, the frozen-row rule).
+                    # Drafting pauses until this launch's many-token
+                    # emissions are fetched (_chunk_unfetched).
+                    self._chunk_unfetched += 1
                     for b, r in enumerate(self._assignment):
                         if r is not None:
                             self._host_pos[b] += self.chunk_steps
@@ -2175,6 +2236,8 @@ class ContinuousEngine:
             self._process_mixed(step)
         else:
             self._process(step)
+            if self._chunk_unfetched > 0:
+                self._chunk_unfetched -= 1
 
     def _reap_jobs(self):
         """Fail pending prefills whose client went away or whose deadline
@@ -2427,6 +2490,9 @@ class ContinuousEngine:
         self._table[slot] = table_row
         self._table_dev = None
         self._host_pos[slot] = 0
+        # a new tenant's stream predicts nothing about the previous
+        # one's: its adaptive-K acceptance EWMA starts fresh
+        self._sched.spec_reset(slot)
         req.slot = slot
         # the admitted token sequence: shadow capture keys off it, and
         # the n-gram draft planner reads it as the slot's history head
@@ -2464,28 +2530,59 @@ class ContinuousEngine:
 
     # jaxlint: decode-unreachable -- host-side launch planning over Python lists (scheduler worker thread only)
     def _plan_spec(self) -> dict:
-        """Plan this step's verify rows: {slot: (n_draft, drafts|None)}
-        (drafts None = device draft-model proposals). A slot qualifies
-        when its tenant is eligible, its previous verify row (if any)
-        has been fetched (the host position model must be exact for the
-        kernel's q_start metadata), its history is fully fetched (the
-        n-gram planner drafts from the true frontier), and — n-gram
-        mode — the history actually offers a draft: a slot with nothing
-        to draft submits a plain decode row, so non-repetitive streams
-        pay nothing. The scheduler picks K (0 under decode TPOT
-        pressure — speculation self-disables under load), and each
-        slot's draft is clamped to its allocated blocks so a verify
-        write can never run the lblk clamp into a live block."""
+        """Plan this step's verify rows: {slot: (n_draft, drafts|None,
+        pred|None)} (drafts None = device draft-model proposals; pred =
+        the optimistic window — drafts + predicted correction — pending
+        fetches extend the drafting history with).
+
+        Device-meta mode (the default): an unfetched verify row never
+        disqualifies its slot — positions derive on device, so the only
+        gates are DRAFT QUALITY ones: no amortized decode chunk may be
+        unfetched (many-token unpredictable advances), every pending
+        launch carrying the slot must be a verify launch of THIS tenant
+        with a predicted window (a pending plain row adds one token the
+        host cannot predict), and — n-gram mode — the optimistic
+        history must offer at least a 2-token window (draft + predicted
+        correction) so back-to-back drafts stay frontier-aligned under
+        full accept. Legacy mode (spec_device_meta=False) keeps the
+        PR-13 gates: previous verify row fetched, history fully fetched.
+
+        The scheduler picks the global K (0 under decode TPOT pressure
+        — speculation self-disables under load), each slot's K is then
+        sized by its acceptance EWMA (spec_slot_k — adaptive drafting),
+        and clamped to its allocated blocks so a verify write can never
+        run the lblk clamp into a live block; in device-meta mode the
+        clamp uses the PESSIMISTIC frontier (host position + every
+        pending launch's maximum advance), since the device may already
+        sit that far ahead."""
         if not self._spec_capable:
             return {}
+        devmeta = self._spec_devmeta
         cand = []
         for b, req in enumerate(self._assignment):
             if (
                 req is None or b in self._prefilling
-                or b in self._spec_inflight or self._row_inflight[b] != 0
                 or req.done.is_set() or req.cancelled
                 or not self._spec_req_ok(req)
             ):
+                continue
+            if devmeta:
+                pending = self._spec_pending.get(b, [])
+                if any(e["req"] is not req for e in pending):
+                    continue  # stale entries from the slot's previous
+                    # tenant: wait for their fetches to drain
+                if not self._draft_mode:
+                    # the n-gram planner needs an ALIGNED optimistic
+                    # history; the draft model needs none of these
+                    # gates (it proposes from true device state)
+                    if self._chunk_unfetched:
+                        continue
+                    if self._row_inflight[b] > len(pending):
+                        continue  # pending PLAIN rows: 1 unpredictable
+                        # token each — drafting would desync the frontier
+                    if any(e["pred"] is None for e in pending):
+                        continue
+            elif b in self._spec_inflight or self._row_inflight[b] != 0:
                 continue
             cand.append(b)
         if not cand:
@@ -2511,14 +2608,27 @@ class ContinuousEngine:
             # never draft past the slot's allocated blocks: the verify
             # writes K/V at pos..pos+k, and positions beyond the table
             # tail-redirect to the trash block, but positions past
-            # MB*bs would CLAMP into the slot's own last live block
+            # MB*bs would CLAMP into the slot's own last live block.
+            # Device-meta mode: pos is the DEVICE frontier, which may
+            # lead the host model by every pending launch's advance —
+            # clamp against the upper bound, not the lagged host value.
+            from .scheduler import spec_block_cap
+
+            pending = self._spec_pending.get(b, []) if devmeta else []
+            frontier = int(self._host_pos[b]) + sum(
+                e["adv"] for e in pending
+            )
             blocks = len(req.block_ids) if req.block_ids else 0
-            cap = blocks * bs - 1 - int(self._host_pos[b])
+            cap = spec_block_cap(blocks, bs, frontier)
             kb = min(k, cap)
+            if devmeta:
+                # adaptive drafting: the slot's acceptance EWMA sizes
+                # its next draft (0 = plain decode row, no verify tiles)
+                kb = min(kb, self._sched.spec_slot_k(b, k))
             if kb < 1:
                 continue
             if self._draft_mode:
-                out[b] = (kb, None)
+                out[b] = (kb, None, None)
                 continue
             head = (
                 [req.first_id]
@@ -2527,31 +2637,54 @@ class ContinuousEngine:
             )
             from .scheduler import ngram_draft
 
-            drafts = ngram_draft(
-                (req.ids or []) + head + req.tokens, kb
-            )
-            if drafts:
-                out[b] = (len(drafts), drafts)
+            hist = (req.ids or []) + head + req.tokens
+            if devmeta:
+                # optimistic frontier: assume every pending verify row
+                # fully accepts its predicted window. Wrong guesses only
+                # reject (the verify admits nothing but the model's own
+                # argmax); the fetch replaces prediction with truth.
+                # Draft kb tokens and PREDICT the correction too
+                # (window[-1]) so the next back-to-back plan stays
+                # frontier-aligned under full accept.
+                for e in pending:
+                    hist = hist + e["pred"]
+                window = ngram_draft(hist, kb + 1)
+                if len(window) >= 2:
+                    out[b] = (len(window) - 1, window[:-1], window)
+            else:
+                drafts = ngram_draft(hist, kb)
+                if drafts:
+                    out[b] = (len(drafts), drafts, None)
         return out
 
     def _launch_mixed(self, spec_rows: Optional[dict] = None):
         """ONE scheduler step: every active decode row plus the budget
-        slice of pending prefill chunks — and, for slots in `spec_rows`,
-        a [current + draft] verify row instead of the 1-token decode row
-        — in one mixed ragged launch. Returns the inflight tuple
-        ("mixed", packed dev, decode snapshot, {slot: req} completions,
-        launch time, mutation seq, spec bookkeeping) or None when the
-        fleet is empty."""
+        slice of pending prefill chunks — and, for slots in `spec_rows`
+        ({slot: (n_draft, drafts|None, pred|None)}), a [current + draft]
+        verify row instead of the 1-token decode row — in one mixed
+        ragged launch. In device-meta mode every decode/verify row's
+        positions are substituted on device (DeviceMeta), so the launch
+        is exact even while earlier verify rows are unfetched. Returns
+        the inflight tuple ("mixed", packed dev, decode snapshot,
+        {slot: req} completions, launch time, mutation seq, spec
+        bookkeeping) or None when the fleet is empty."""
         P = self._P
         spec_rows = spec_rows or {}
         assigned = [
             b for b, r in enumerate(self._assignment)
             if r is not None and b not in self._prefilling
         ]
-        # a slot with an UNFETCHED verify row is skipped outright: its
-        # device position is unknown to the host until the packed fetch
-        # resyncs it, so it gets no row (and stays frozen via dec_on)
-        active = [b for b in assigned if b not in self._spec_inflight]
+        if self._spec_devmeta:
+            # device-derived metadata: positions come from slot state,
+            # so an unfetched verify row never freezes its slot — every
+            # assigned decode slot rows EVERY step (the whole point)
+            active = assigned
+        else:
+            # legacy: a slot with an UNFETCHED verify row is skipped
+            # outright — its device position is unknown to the host
+            # until the packed fetch resyncs it, so it gets no row (and
+            # stays frozen via dec_on)
+            active = [b for b in assigned if b not in self._spec_inflight]
         # speculated tokens debit the step budget exactly like prefill
         # tokens: a verify row reserves ceil((1+k)/tile) query tiles
         tile = self._ragged_tile
@@ -2598,6 +2731,18 @@ class ContinuousEngine:
         meta, tok_row, tok_pos, offsets, stats = P.build_ragged_meta(
             entries, width=W, tile=tile,
         )
+        dev_dev = None
+        if self._spec_devmeta:
+            # mark every decode/verify entry (the first n_dec) for
+            # on-device position substitution — the host start values
+            # above are placeholders for those rows
+            t_on, t_off, k_on, k_off = P.build_device_meta(
+                entries, offsets, len(active), width=W, tile=tile,
+            )
+            dev_dev = P.DeviceMeta(
+                jnp.asarray(t_on), jnp.asarray(t_off),
+                jnp.asarray(k_on), jnp.asarray(k_off),
+            )
         toks = np.zeros((W,), np.int32)
         dec_flag = np.zeros((W,), bool)
         dec_idx = np.zeros((B,), np.int32)
@@ -2613,7 +2758,7 @@ class ContinuousEngine:
             # and verify rows alike
             dec_flag[off] = True
             if b in spec_rows:
-                kb, drafts = spec_rows[b]
+                kb, drafts, _pred = spec_rows[b]
                 sp_on[b] = True
                 sp_nd[b] = kb
                 idxs = off + np.arange(K1, dtype=np.int32)
@@ -2678,6 +2823,7 @@ class ContinuousEngine:
                 jnp.asarray(tok_row), jnp.asarray(tok_pos),
                 jnp.asarray(dec_flag), jnp.asarray(meta), self._dpool,
                 self._table_dev, self.state.token, self.state.pos,
+                dev=dev_dev,
             )
         if spec_rows or any(b in self._spec_inflight for b in assigned):
             spec_plan_dev = P.SpecPlan(
@@ -2706,27 +2852,41 @@ class ContinuousEngine:
                 self.state, self.sparams, self._next_key(),
                 jnp.asarray(dec_idx), arm,
                 spec=spec_plan_dev, spec_toks=spec_toks_dev,
+                dev=dev_dev,
             )
         )
         # host position model + completion bookkeeping AFTER the launch
         # is enqueued (the arming rode the program itself). Verify rows
         # do NOT advance here: their advance is data-dependent (the
-        # accept count), so the host resyncs from the packed fetch and
-        # the slot is skipped until then (_spec_inflight).
+        # accept count), so the host resyncs from the packed fetch —
+        # legacy mode freezes the slot until then (_spec_inflight),
+        # device-meta mode records the pending launch (predicted window
+        # + advance bound) and keeps submitting rows.
         for b in active:
             self._row_inflight[b] += 1
             if b in spec_rows:
-                self._spec_inflight[b] = spec_meta[b]
+                if self._spec_devmeta:
+                    nd, _drafts, pred = spec_rows[b]
+                    lst = self._spec_pending.setdefault(b, [])
+                    if lst:
+                        self.spec_pipelined += 1
+                    lst.append({
+                        "req": self._assignment[b], "nd": nd,
+                        "pred": pred, "adv": nd + 1,
+                    })
+                else:
+                    self._spec_inflight[b] = spec_meta[b]
             else:
                 self._host_pos[b] += 1
         if spec_rows:
             mode = "draft_model" if self._draft_mode else "ngram"
-            drafted = sum(nd for nd, _ in spec_rows.values())
+            drafted = sum(nd for nd, _, _ in spec_rows.values())
             self._m_spec_launches.labels(mode=mode).inc(len(spec_rows))
             self._m_spec_drafted.inc(drafted)
             self.spec_launches += len(spec_rows)
             self.spec_drafted += drafted
-            for b, (nd, _) in spec_rows.items():
+            for b, (nd, _, _) in spec_rows.items():
+                self._sched.count_spec_plan(nd)
                 req = self._assignment[b]
                 if req is not None:
                     req.spec_launches += 1
@@ -2767,8 +2927,9 @@ class ContinuousEngine:
         self._m_ragged_launches.labels(phase="mixed").inc()
         # decode snapshot: only rows DECODING at launch (mid-prefill rows
         # emit nothing; the completing slot's first decode token arrives
-        # with the NEXT launch; slots frozen behind an unfetched verify
-        # row carry no row at all) — attribution discipline as ever
+        # with the NEXT launch; legacy-mode slots frozen behind an
+        # unfetched verify row carry no row at all) — attribution
+        # discipline as ever
         snapshot = [
             self._assignment[b] if b in active else None for b in range(B)
         ]
@@ -2859,17 +3020,30 @@ class ContinuousEngine:
                 em[:, slot] = sp_emit[:, slot]
                 mk[:, slot] = sp_mask[:, slot]
                 self._spec_inflight.pop(slot, None)
+                pend = self._spec_pending.get(slot)
+                if pend:
+                    # device-meta mode: this fetch confirms the slot's
+                    # OLDEST pending verify launch (fetches are FIFO) —
+                    # its predicted window retires; the actual emissions
+                    # land in req.tokens via _distribute below
+                    pend.pop(0)
+                    if not pend:
+                        del self._spec_pending[slot]
                 n_emit = int(sp_mask[:, slot].sum())
+                acc = max(0, n_emit - 1)
                 if (
                     self._assignment[slot] is req
                     and not req.done.is_set() and req.drop_seq <= seq
                 ):
                     # position resync: the verify advanced the slot by
                     # the accepted count (+1 on an EOS step) — the host
-                    # model is exact again and the slot re-enters the
-                    # next launch plan
+                    # model catches up (and in legacy mode the slot
+                    # re-enters the next launch plan)
                     self._host_pos[slot] += int(sp_adv[slot])
-                acc = max(0, n_emit - 1)
+                    # adaptive-K feedback: the slot's acceptance EWMA
+                    # sizes its next draft (same packed fetch, zero
+                    # extra syncs)
+                    self._sched.observe_spec(slot, nd, acc)
                 self._m_spec_accepted.inc(acc)
                 self._m_spec_rejected.inc(max(0, nd - acc))
                 self._m_spec_hist.observe(n_emit)

@@ -263,13 +263,13 @@ class SingleDeviceBackend:
 
     def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
                           pool, table, state, sparams, key, dec_idx, arm,
-                          spec=None, spec_toks=None):
+                          spec=None, spec_toks=None, dev=None):
         from . import paged as P
 
         return P.mixed_step_ragged(
             self.cfg, self.params, tokens, tok_row, tok_pos, dec_flag,
             meta, pool, table, state, sparams, key, dec_idx, arm,
-            spec=spec, spec_toks=spec_toks,
+            spec=spec, spec_toks=spec_toks, dev=dev,
         )
 
     def ragged_program_count(self) -> int:
@@ -596,6 +596,19 @@ class InferenceEngine:
             "tokens emitted per verify row (accepted drafts + the "
             "correction token; > 1 is the speculation win)",
             buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        # adaptive drafting (device-derived metadata, ISSUE 15): the
+        # planned K per verify row and the fleet-mean per-slot
+        # acceptance EWMA the adaptive throttle steers by
+        self.metrics.histogram(
+            "dli_spec_draft_len",
+            "planned draft length K per verify row (after the adaptive "
+            "per-slot throttle)",
+            buckets=DEFAULT_SIZE_BUCKETS,
+        )
+        self.metrics.gauge(
+            "dli_spec_accept_ewma",
+            "fleet-mean per-slot draft acceptance-rate EWMA (0..1)",
         )
         self.metrics.gauge(
             "dli_slo_queue_depth",

@@ -606,6 +606,14 @@ def build_ragged_meta(entries, *, width: int, tile: int):
     Dead tiles copy their predecessor's (row, q_start) with q_len 0, so
     their clamped KV walk repeats the predecessor's physical indices and
     Pallas skips the DMA (see ops/paged_attention._ragged_live_range).
+
+    The plan's POSITIONAL half is only authoritative where the host
+    position model is exact. For decode/verify rows in the mixed
+    scheduler launch the serving path marks the tiles/slots with
+    build_device_meta and the program substitutes state.pos on device
+    (apply_device_meta) — the start values planned here become
+    placeholders there, which is what lets verify rows launch
+    back-to-back without waiting for their fetch (ISSUE 15).
     """
     import numpy as np
 
@@ -882,12 +890,20 @@ class SpecPlan(NamedTuple):
     decode row) and whose draft slots carry host-planned (n-gram) or
     draft-model tokens. Shapes are fixed by the fleet's max draft length,
     so ONE compiled program serves every accept pattern and every
-    per-slot draft length — the host only moves int32 plan data."""
+    per-slot draft length — the host only moves int32 plan data.
+
+    With device-derived launch metadata (ISSUE 15; DeviceMeta below),
+    a verify row's positions come from the device-resident slot state,
+    so the host submits verify rows EVERY step, back to back — the
+    packed fetch only confirms emissions. The PR-13 skip-until-fetched
+    freeze (a slot with an unfetched verify row carries no row, host
+    q_start stays exact) remains only behind
+    EngineConfig.spec_device_meta=False as the bench baseline."""
 
     dec_on: jnp.ndarray  # bool [B]: slot has a PLAIN decode row this
-    # launch — slot_step advances exactly these rows; verify rows and
-    # rows skipped while their previous verify row is still unfetched
-    # stay frozen (their state advances through spec_verify / not at all)
+    # launch — slot_step advances exactly these rows; verify rows
+    # advance through spec_verify instead (and, in the legacy
+    # host-planned mode, frozen unfetched-verify slots not at all)
     on: jnp.ndarray  # bool [B]: slot carries a verify row this launch
     idx: jnp.ndarray  # i32 [B, K+1]: flat launch indices of the row's
     # [current, draft...] slots (entries past the slot's own draft
@@ -905,6 +921,100 @@ def idle_spec_plan(n_slots: int, draft_len: int) -> SpecPlan:
         jnp.zeros((n_slots, draft_len + 1), jnp.int32),
         jnp.zeros((n_slots,), jnp.int32),
     )
+
+
+class DeviceMeta(NamedTuple):
+    """Device-derivation masks for one mixed launch (ISSUE 15): which
+    tiles/flat slots of the host tile plan read their POSITIONS from the
+    device-resident slot state instead of the host position model.
+
+    The host still owns the STRUCTURAL half of the plan — which fleet
+    row each tile serves, how many flat slots it spans, the launch
+    width — because those are shapes/indices the program needs before
+    dispatch. The POSITIONAL half (a decode/verify row's q_start and
+    per-token write/RoPE positions) is data, and for decode and verify
+    rows it is exactly `state.pos[row] (+ offset within the row)` — a
+    value the device already holds post-previous-launch. Marking those
+    tiles/slots here and substituting on device (apply_device_meta)
+    means the host never needs the fetched result of launch N to plan
+    launch N+1: verify rows ride lag pipelining like plain decode rows,
+    and the SpecPlan.dec_on freeze is deleted. All leaves are plain
+    traced operands — one compiled program for every derivation pattern.
+    """
+
+    tile_on: jnp.ndarray  # bool [G]: tile's q_start = pos[row] + tile_off
+    tile_off: jnp.ndarray  # i32 [G]: tile's offset within its row entry
+    tok_on: jnp.ndarray  # bool [W]: slot's position = pos[row] + tok_off
+    tok_off: jnp.ndarray  # i32 [W]: flat slot's offset within its entry
+
+
+def idle_device_meta(width: int, tile: int) -> DeviceMeta:
+    """An all-off DeviceMeta (every position host-planned — the legacy
+    contract, as a fixed-shape operand)."""
+    G_ = width // tile
+    return DeviceMeta(
+        jnp.zeros((G_,), bool), jnp.zeros((G_,), jnp.int32),
+        jnp.zeros((width,), bool), jnp.zeros((width,), jnp.int32),
+    )
+
+
+def build_device_meta(entries, offsets, n_dev: int, *, width: int,
+                      tile: int):
+    """HOST-side companion to build_ragged_meta (strictly decode-
+    unreachable, same derivation): mark the first `n_dev` entries'
+    tiles and flat slots for on-device position substitution. `entries`
+    / `offsets` are the SAME lists build_ragged_meta consumed/returned —
+    the walk here only recomputes each tile's offset within its entry.
+    Launch-padding tiles inherit their predecessor's flags exactly like
+    build_ragged_meta copies its (row, q_start): a pad tile behind a
+    derived tile must derive the SAME value so its clamped KV walk keeps
+    repeating physical indices and Pallas keeps skipping the DMA.
+
+    Returns numpy (tile_on [G] bool, tile_off [G] i32, tok_on [W] bool,
+    tok_off [W] i32) — wrap in a DeviceMeta for the launch."""
+    import numpy as np
+
+    G = width // tile
+    tile_on = np.zeros((G,), bool)
+    tile_off = np.zeros((G,), np.int32)
+    tok_on = np.zeros((width,), bool)
+    tok_off = np.zeros((width,), np.int32)
+    g = 0
+    for i, ((row, start, length, kind), off) in enumerate(
+        zip(entries, offsets)
+    ):
+        need = -(-length // tile)
+        if i < n_dev:
+            for t in range(need):
+                tile_on[g + t] = True
+                tile_off[g + t] = t * tile
+            tok_on[off : off + length] = True
+            tok_off[off : off + length] = np.arange(length, dtype=np.int32)
+        g += need
+    while g < G:
+        if g > 0:
+            tile_on[g] = tile_on[g - 1]
+            tile_off[g] = tile_off[g - 1]
+        g += 1
+    return tile_on, tile_off, tok_on, tok_off
+
+
+def apply_device_meta(meta, tok_row, tok_pos, dev: DeviceMeta, pos):
+    """TRACED half of the device-derived launch metadata: substitute
+    `pos[row] + offset` into the marked tiles' q_start column and the
+    marked flat slots' positions. Runs inside the mixed program BEFORE
+    the kernel/hook sees either array, so the scalar-prefetch metadata
+    the ragged kernel's index maps read — and the write/RoPE positions
+    of the XLA twin — are exact device values with zero host syncs.
+    Unmarked tiles/slots (prefill chunks, launch padding) keep the host
+    plan verbatim."""
+    rows = jnp.maximum(meta[:, 0], 0)
+    q_dev = pos[rows].astype(jnp.int32) + dev.tile_off
+    meta = meta.at[:, 1].set(jnp.where(dev.tile_on, q_dev, meta[:, 1]))
+    rix = jnp.maximum(tok_row, 0)
+    p_dev = pos[rix].astype(jnp.int32) + dev.tok_off
+    tok_pos = jnp.where(dev.tok_on, p_dev, tok_pos)
+    return meta, tok_pos
 
 
 def spec_verify(cfg: ModelConfig, state: G.SlotState, window, draft,
@@ -989,7 +1099,8 @@ def spec_verify(cfg: ModelConfig, state: G.SlotState, window, draft,
 def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
                       dec_flag, meta, pool, table, state: G.SlotState,
                       sparams: G.SlotParams, key, dec_idx, arm: MixedArm,
-                      spec: Optional[SpecPlan] = None, spec_toks=None):
+                      spec: Optional[SpecPlan] = None, spec_toks=None,
+                      dev: Optional[DeviceMeta] = None):
     """One scheduler step: advance every active slot one decode token AND
     write the launch's prefill chunks into the pool, in one program.
 
@@ -998,9 +1109,14 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     flat slot is a decode-row token — its token/position are REPLACED by
     the owning slot's device state (state.token / state.pos), so the host
     plans launches ahead of its fetches without ever syncing. meta [G,4] /
-    tok_row [W]: the build_ragged_meta plan (decode tiles' q_start is the
-    host's position model — exact for live rows, masked garbage for rows
-    that went inactive since the last fetch, the frozen-row argument).
+    tok_row [W]: the build_ragged_meta plan. With `dev` (DeviceMeta, the
+    default serving mode) the decode/verify tiles' q_start and flat-slot
+    positions are DERIVED ON DEVICE from state.pos (apply_device_meta) —
+    the host plan carries placeholders there and the host never needs a
+    fetch to plan the next launch, even for verify rows whose advance is
+    data-dependent. Without `dev` the host position model must be exact
+    (the PR-13 contract: over-advance on rows that went inactive since
+    the last fetch is masked garbage, the frozen-row argument).
     dec_idx [B]: flat index of each slot's decode token (0 for slots
     without one — their sampled garbage is gated by state.active exactly
     like idle rows in decode_slots_paged). arm: completing-prefill
@@ -1021,6 +1137,9 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     pool)."""
     from ..models import api as M
 
+    if dev is not None:
+        meta, tok_pos = apply_device_meta(meta, tok_row, tok_pos, dev,
+                                          state.pos)
     rows_ix = jnp.maximum(tok_row, 0)
     toks = jnp.where(dec_flag, state.token[rows_ix], tokens)
     if spec is not None and spec_toks is not None:
@@ -1067,16 +1186,23 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     jax.jit, static_argnames=("dcfg",), donate_argnames=("dpool",)
 )
 def mixed_fill_draft(dcfg: ModelConfig, dparams, tokens, tok_row, tok_pos,
-                     dec_flag, meta, dpool, table, token, pos_state):
+                     dec_flag, meta, dpool, table, token, pos_state,
+                     dev: Optional[DeviceMeta] = None):
     """Draft-pool twin of the mixed step's forward (no sampling): land
     this step's prefill chunks AND every decode row's current token in
     the DRAFT model's pool, with the same dec_flag substitution from the
     (replicated) slot state — so the draft chain's context tracks the
     canonical stream position by position. draft slots of verify rows
     carry placeholder zeros here; the propose chain rewrites exactly
-    those positions before anything attends them (write-then-attend)."""
+    those positions before anything attends them (write-then-attend).
+    `dev` rides the same apply_device_meta substitution as the target's
+    mixed step, so the draft pool's positions track the device frontier
+    under back-to-back verify rows too."""
     from ..models import api as M
 
+    if dev is not None:
+        meta, tok_pos = apply_device_meta(meta, tok_row, tok_pos, dev,
+                                          pos_state)
     rows_ix = jnp.maximum(tok_row, 0)
     toks = jnp.where(dec_flag, token[rows_ix], tokens)
     pos = jnp.where(dec_flag, pos_state[rows_ix], tok_pos)

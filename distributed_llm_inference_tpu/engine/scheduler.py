@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import math
 import time
 from typing import Optional
 
@@ -66,6 +67,19 @@ RETRY_AFTER_CAP_S = 30.0
 # the current bigram (host Python per slot per launch — bounded so a
 # max-window chat history cannot stretch the launch-planning hot loop)
 NGRAM_SCAN_WINDOW = 1024
+
+# Adaptive per-slot drafting (rides the device-derived-metadata unfrozen
+# loop, ISSUE 15): each slot's draft acceptance rate feeds an EWMA that
+# sizes its NEXT draft between 0 and spec_draft_len — repetitive streams
+# keep long drafts, incompressible ones degrade to plain decode without
+# burning verify tiles.
+SPEC_EWMA_ALPHA = 0.35
+# below this acceptance EWMA a slot stops speculating entirely (K = 0:
+# a verify row that mostly rejects still costs its extra flat tokens)...
+SPEC_MIN_RATE = 0.2
+# ...and re-probes with a 1-token draft after this many skipped plans,
+# so a stream that turns repetitive later is not locked out forever
+SPEC_REPROBE = 16
 
 
 # jaxlint: decode-unreachable -- host-side launch planning over Python lists (scheduler worker thread only)
@@ -101,6 +115,17 @@ def ngram_draft(hist: list, k: int) -> list:
                 if len(best) == k:
                     break
     return best
+
+
+# jaxlint: decode-unreachable -- host-side launch planning arithmetic (scheduler worker thread only)
+def spec_block_cap(n_blocks: int, block_size: int, frontier: int) -> int:
+    """Max draft length a slot at `frontier` can verify-write without
+    the kernel's lblk clamp folding positions past its allocation into
+    its own last LIVE block (engine/paged.make_ragged_fill_hook). In
+    device-meta mode `frontier` must be the PESSIMISTIC bound — the
+    lagged host position plus every pending verify launch's maximum
+    advance — because the device may already sit that far ahead."""
+    return n_blocks * block_size - 1 - frontier
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,7 +258,24 @@ class TokenBudgetScheduler:
             )
         self.n_slots = int(n_slots)
         self.feedback = {name: _ClassFeedback() for name in classes}
+        # per-slot draft-acceptance feedback: slot -> [EWMA, skipped
+        # plans] (adaptive K; reset on re-assignment via spec_reset)
+        self._spec_fb: dict = {}
         self._m_depth = self._m_shed = None
+        self._m_spec_k = self._m_spec_ewma = None
+        if registry is not None:
+            from ..utils.metrics import DEFAULT_SIZE_BUCKETS
+
+            self._m_spec_k = registry.histogram(
+                "dli_spec_draft_len",
+                "planned draft length K per verify row (after the "
+                "adaptive per-slot throttle)",
+                buckets=DEFAULT_SIZE_BUCKETS,
+            ).labels()
+            self._m_spec_ewma = registry.gauge(
+                "dli_spec_accept_ewma",
+                "fleet-mean per-slot draft acceptance-rate EWMA (0..1)",
+            ).labels()
         if registry is not None:
             self._m_depth = registry.gauge(
                 "dli_slo_queue_depth",
@@ -358,6 +400,59 @@ class TokenBudgetScheduler:
         return False
 
     # -- speculation throttle ------------------------------------------------
+    def observe_spec(self, slot: int, drafted: int, accepted: int):
+        """Per-slot acceptance feedback, fed from the SAME packed fetch
+        that carries the verify row's emissions (engine/continuous.
+        _process_mixed) — one EWMA write per fetched verify row."""
+        if drafted <= 0:
+            return
+        rate = min(1.0, max(0.0, accepted / drafted))
+        fb = self._spec_fb.get(slot)
+        if fb is None:
+            fb = [rate, 0]
+            self._spec_fb[slot] = fb
+        else:
+            fb[0] = (1 - SPEC_EWMA_ALPHA) * fb[0] + SPEC_EWMA_ALPHA * rate
+        fb[1] = 0
+        if self._m_spec_ewma is not None:
+            self._m_spec_ewma.set(
+                sum(f[0] for f in self._spec_fb.values())
+                / len(self._spec_fb)
+            )
+
+    def spec_slot_k(self, slot: int, k_max: int) -> int:
+        """Adaptive per-slot draft length: size the slot's NEXT draft by
+        its observed acceptance EWMA. No data yet -> full `k_max` (new
+        streams probe at full depth — the n-gram gate already filters
+        slots with nothing to draft); EWMA below SPEC_MIN_RATE -> 0 (a
+        plain decode row, no verify tiles burnt), with a 1-token
+        re-probe every SPEC_REPROBE skipped plans; otherwise the draft
+        scales with the EWMA, converging back to k_max as acceptance
+        recovers."""
+        if k_max <= 0:
+            return 0
+        fb = self._spec_fb.get(slot)
+        if fb is None:
+            return k_max
+        ewma = fb[0]
+        if ewma < SPEC_MIN_RATE:
+            fb[1] += 1
+            if fb[1] >= SPEC_REPROBE:
+                fb[1] = 0
+                return 1
+            return 0
+        return max(1, min(k_max, math.ceil(ewma * k_max)))
+
+    def spec_reset(self, slot: int):
+        """Forget a slot's acceptance history (the slot was re-assigned:
+        a new tenant's stream predicts nothing about the old one's)."""
+        self._spec_fb.pop(slot, None)
+
+    def count_spec_plan(self, k: int):
+        """Record one verify row's planned K (dli_spec_draft_len)."""
+        if self._m_spec_k is not None:
+            self._m_spec_k.observe(k)
+
     def spec_draft_len(self, k_max: int, n_spec_rows: int,
                        n_plain_rows: int, active_classes=(),
                        jobs_pending: bool = False) -> int:

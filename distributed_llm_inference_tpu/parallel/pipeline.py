@@ -954,13 +954,13 @@ class PipelineBackend(SPMDBackendBase):
 
     def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
                           pool, table, state, sparams, key, dec_idx, arm,
-                          spec=None, spec_toks=None):
+                          spec=None, spec_toks=None, dev=None):
         mkey = ("mixed_step_ragged", spec is not None,
-                spec_toks is not None)
+                spec_toks is not None, dev is not None)
         fn = self._programs.get(mkey)
         if fn is None:
             fn = self._build_mixed_step_ragged(
-                spec is not None, spec_toks is not None
+                spec is not None, spec_toks is not None, dev is not None
             )
             self._programs[mkey] = fn
         args = [self.shared, self.layers, tokens, tok_row, tok_pos,
@@ -970,6 +970,8 @@ class PipelineBackend(SPMDBackendBase):
             args.append(spec)
         if spec_toks is not None:
             args.append(spec_toks)
+        if dev is not None:
+            args.append(dev)
         D = self.cfg.dim
         self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
         # two replicated-logits gathers (decode rows + arm positions),
@@ -979,7 +981,8 @@ class PipelineBackend(SPMDBackendBase):
         return fn(*args)
 
     def _build_mixed_step_ragged(self, with_spec: bool = False,
-                                 with_spec_toks: bool = False):
+                                 with_spec_toks: bool = False,
+                                 with_dev: bool = False):
         """shard_map twin of engine/paged.mixed_step_ragged: the flat
         token fleet (decode rows gathered from the replicated slot state,
         prefill chunks from the host plan) runs the S ring microsteps
@@ -993,7 +996,11 @@ class PipelineBackend(SPMDBackendBase):
         with_spec_toks) gather the verify rows' positions through the
         same replicated-logits seam and run the SHARED
         engine/paged.spec_verify inside the epilogue — pp verify rows
-        are token-identical to the single chip by construction."""
+        are token-identical to the single chip by construction. The
+        with_dev variant applies the SHARED engine/paged.
+        apply_device_meta substitution (decode/verify positions derived
+        from the replicated slot state) before the hook sees the plan —
+        device-derived metadata cannot drift across backends either."""
         cfg, S = self.cfg, self.pp
         from ..engine import paged as EP
         from ..engine.generate import SlotParams, SlotState
@@ -1001,13 +1008,20 @@ class PipelineBackend(SPMDBackendBase):
 
         def body(shared, layers, tokens, tok_row, tok_pos, dec_flag, meta,
                  pool, table, state, sparams, key, dec_idx, arm, *extra):
-            spec = spec_toks = None
+            spec = spec_toks = dev = None
             i = 0
             if with_spec:
                 spec = extra[i]
                 i += 1
             if with_spec_toks:
                 spec_toks = extra[i]
+                i += 1
+            if with_dev:
+                dev = extra[i]
+            if dev is not None:
+                meta, tok_pos = EP.apply_device_meta(
+                    meta, tok_row, tok_pos, dev, state.pos
+                )
             hook = EP.make_ragged_fill_hook(table, meta, tok_row)
             s = jax.lax.axis_index(AXIS_PP)
             rows_ix = jnp.maximum(tok_row, 0)
@@ -1063,6 +1077,8 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(EP.SpecPlan(P(), P(), P(), P()))
         if with_spec_toks:
             specs.append(P())
+        if with_dev:
+            specs.append(EP.DeviceMeta(P(), P(), P(), P()))
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
