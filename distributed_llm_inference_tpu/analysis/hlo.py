@@ -27,7 +27,12 @@ so they run in CI on CPU in seconds:
     allowed only for the rank-(n-1) scale companions) — the byte claim
     machine-checked on the artifact — plus callbacks/donation/
     recompile-guard legs for the quantized program; with the knob OFF,
-    no int8 ships at all (the bit-identity contract).
+    no int8 ships at all (the bit-identity contract);
+  * the `adapter-mixed` family (engine/adapters.py paged runtime LoRA):
+    the adapter-conditioned mixed launch — per-slot page ids as a
+    traced device gather — keeps zero callbacks, pool donation,
+    IDENTICAL StableHLO across adapter mixes, and a no-recompile
+    execution guard: one compiled program serves any adapter mix.
 
 Reused by tests/test_analysis.py and tests/test_constrained_decode.py —
 one implementation of the artifact assertions.
@@ -550,6 +555,88 @@ def check_spec_devmeta_no_recompile(engine=None) -> list:
     return []
 
 
+@functools.lru_cache(maxsize=1)
+def tiny_adapter_engine():
+    """tiny_engine plus the paged runtime-LoRA leaves (slots=4, rank=4)
+    and an attached AdapterPool — the engine the adapter-mixed legs
+    lower against. Separate from tiny_engine: the extra leaves change
+    the params pytree, so sharing would shadow its cached programs."""
+    from ..config import EngineConfig
+    from ..engine.adapters import attach_adapter_pool
+    from ..engine.engine import InferenceEngine
+    from ..models.registry import get_model_config
+
+    cfg = get_model_config("test-llama-tiny")
+    engine = InferenceEngine(
+        cfg, engine_cfg=EngineConfig(prefill_buckets=(32,))
+    )
+    attach_adapter_pool(engine, slots=4, rank=4)
+    return engine
+
+
+def lower_adapter_mixed_step(engine=None, pages=(0, 1), n_decode: int = 1,
+                             chunk: int = 9) -> str:
+    """StableHLO of the ADAPTER-conditioned mixed scheduler launch: the
+    ordinary mixed step plus the per-slot adapter page ids as a traced
+    operand (engine/adapters.py; page 0 = the base page)."""
+    import jax.numpy as jnp
+
+    from ..engine import paged as EP
+
+    engine = engine or tiny_adapter_engine()
+    return EP.mixed_step_ragged.lower(
+        *_mixed_args(engine, n_decode, chunk),
+        pages=jnp.asarray(pages, jnp.int32),
+    ).as_text()
+
+
+def check_adapter_mixed_shape_stability(engine=None) -> list:
+    """Two DIFFERENT adapter mixes (per-slot page assignments) on two
+    DIFFERENT launch compositions must lower to the IDENTICAL program:
+    page ids are traced DATA riding a device gather, so any mix-
+    dependent shape would recompile per adapter mix — the multi-tenant
+    equivalent of the bucket ladder."""
+    engine = engine or tiny_adapter_engine()
+    a = lower_adapter_mixed_step(engine, pages=(0, 1), n_decode=1, chunk=9)
+    b = lower_adapter_mixed_step(engine, pages=(3, 2), n_decode=2, chunk=14)
+    if a != b:
+        return [
+            "adapter mixed step lowered DIFFERENT programs for two "
+            "adapter mixes — some page assignment became shape-"
+            "specializing (compile-per-adapter-mix in production)"
+        ]
+    return []
+
+
+def check_adapter_mixed_no_recompile(engine=None) -> list:
+    """Execute the adapter mixed step with two different adapter mixes
+    AND launch compositions; the jit cache must not grow — ONE compiled
+    program serves any adapter mix, the acceptance invariant."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import paged as EP
+
+    engine = engine or tiny_adapter_engine()
+    out = EP.mixed_step_ragged(
+        *_mixed_args(engine, 1, 9), pages=jnp.asarray([0, 1], jnp.int32)
+    )
+    jax.block_until_ready(out[0])
+    size_after_first = EP.mixed_step_ragged._cache_size()
+    out = EP.mixed_step_ragged(
+        *_mixed_args(engine, 2, 14), pages=jnp.asarray([3, 2], jnp.int32)
+    )
+    jax.block_until_ready(out[0])
+    size_after_second = EP.mixed_step_ragged._cache_size()
+    if size_after_second > size_after_first:
+        return [
+            f"adapter mixed step recompiled across adapter mixes (jit "
+            f"cache grew {size_after_first} -> {size_after_second}) — "
+            f"page ids must stay traced data"
+        ]
+    return []
+
+
 def pp_available() -> bool:
     import jax
 
@@ -775,6 +862,26 @@ def run_hlo_checks() -> dict:
     )
     results["spec-devmeta-recompile-guard"] = check_spec_devmeta_no_recompile(
         engine
+    )
+
+    # adapter-conditioned mixed step (engine/adapters.py: paged runtime
+    # LoRA): the per-slot page ids are traced data riding a device
+    # gather, so the multi-tenant launch must stay ONE host-sync-free
+    # donated program across every adapter mix — the acceptance
+    # invariant of the adapter subsystem, proven on the artifact
+    adapter_engine = tiny_adapter_engine()
+    adapter_mixed = lower_adapter_mixed_step(adapter_engine)
+    results["adapter-mixed-callbacks"] = check_no_host_callbacks(
+        adapter_mixed
+    )
+    results["adapter-mixed-donation"] = check_donation(
+        adapter_mixed, min_aliased=2
+    )
+    results["adapter-mixed-shape-stability"] = (
+        check_adapter_mixed_shape_stability(adapter_engine)
+    )
+    results["adapter-mixed-recompile-guard"] = (
+        check_adapter_mixed_no_recompile(adapter_engine)
     )
 
     if pp_available():

@@ -502,6 +502,32 @@ class EngineConfig:
     # gated in tests). The `wire-dtype` HLO rules machine-check that the
     # lowered collective-permutes really carry si8 when this is on.
     pp_wire_quant: Optional[str] = None
+    # Paged LoRA adapter serving (engine/adapters.py): number of HBM
+    # adapter pages the resident base model carries (0 disables the
+    # subsystem entirely — no lora_* leaves are installed and the paged
+    # programs trace without the pages operand, lowering byte-identically
+    # to the pre-adapter build). Each page holds one adapter's stacked
+    # A/B factors for every supported projection at `adapter_rank`; page
+    # 0 is the all-zero BASE page (never written, never evicted), so
+    # adapter id 0 is the base model by construction. Pages are
+    # refcounted and LRU-evicted exactly like KV blocks (BlockAllocator
+    # discipline): admission acquires, completion releases, eviction only
+    # ever takes refcount-0 residents.
+    adapter_slots: int = 0
+    # Uniform rank budget of every adapter page: registered adapters of
+    # LOWER rank are zero-padded to it (exact — padding contributes
+    # nothing to the delta); higher rank is rejected at registration.
+    adapter_rank: int = 8
+    # Per-tenant prefill-budget weights, ((tenant, weight), ...): within
+    # each SLO class's tile grant the chunked-prefill scheduler splits
+    # across tenants by these weights (FIFO within a tenant). Unlisted
+    # tenants weigh 1.0; empty = every tenant equal.
+    tenant_weights: tuple = ()
+    # Tenant admission quota: one tenant's queued share of the bounded
+    # request queue may not exceed this fraction (beyond a small absolute
+    # floor) — the over-quota tenant sheds with 429 + Retry-After before
+    # other tenants starve. 1.0 disables the quota.
+    tenant_max_queue_share: float = 0.5
 
     def __post_init__(self):
         if self.pp_wire_quant not in (None, "int8"):
@@ -509,6 +535,26 @@ class EngineConfig:
                 f"pp_wire_quant must be None or 'int8', got "
                 f"{self.pp_wire_quant!r}"
             )
+        if self.adapter_slots < 0:
+            raise ValueError(
+                f"adapter_slots must be >= 0, got {self.adapter_slots}"
+            )
+        if self.adapter_slots and self.adapter_rank < 1:
+            raise ValueError(
+                f"adapter_rank must be >= 1, got {self.adapter_rank}"
+            )
+        if not (0.0 < self.tenant_max_queue_share <= 1.0):
+            raise ValueError(
+                f"tenant_max_queue_share must be in (0, 1], got "
+                f"{self.tenant_max_queue_share}"
+            )
+        for entry in self.tenant_weights:
+            name, w = entry
+            if not name or float(w) <= 0:
+                raise ValueError(
+                    f"tenant_weights entries need a name and a positive "
+                    f"weight, got {entry!r}"
+                )
 
 
 def resolve_attn_impl(cfg: "ModelConfig", requested: Optional[str]) -> "ModelConfig":

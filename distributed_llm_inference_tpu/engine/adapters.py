@@ -1,0 +1,387 @@
+"""Paged runtime LoRA adapter pool: many adapters, one resident base.
+
+Merge-at-load (models/lora.merge_lora) bakes ONE adapter into the dense
+weights — the single-adapter fast path. This module is the multi-tenant
+shape: the base model's layer stack grows fourteen `lora_{leaf}_{a,b}`
+leaves, each a PAGED stack of low-rank factors —
+
+    lora_wq_a [L, P, D, r]     lora_wq_b [L, P, r, H*Dh]   (etc.)
+
+with P = adapter_slots + 1 device pages. Page 0 is the RESERVED base
+page: all-zero, never written, never evicted — a row selecting page 0
+computes the bit-identical base output (the delta is skipped by a traced
+select, not added as zero, so not even -0.0 can flip). Registered
+adapters (models/lora.load_lora_stacked: rank-padded, scale folded into
+b) are written into pages 1..P-1 by donation-aliased jitted updates, so
+a load is two HBM writes per leaf and zero recompiles: the leaves ride
+`params["layers"]`, the pytree structure is fixed at engine build, and
+every launch program (decode chunks, ragged admission, the mixed
+scheduler step, the pp shard_map twins) takes the per-row page ids as a
+TRACED operand — one compiled program serves any adapter mix.
+
+Pool discipline mirrors engine/paged.BlockAllocator: pages are
+refcounted holders (one per live decode slot using the adapter), a
+refcount-0 resident adapter parks in an LRU instead of being dropped
+(the next request for it costs zero loads), and a new registration under
+pressure evicts the LRU victim — never a referenced page. acquire() with
+every page referenced returns None, the same backpressure contract as
+block exhaustion (the admission requeues at the front and retries after
+a release).
+
+Threading (same split as BlockAllocator / BlockPrefixIndex): acquire /
+release / reset_refs mutate only on the continuous engine's worker
+thread; the lock exists because stats()//metrics render from serving
+threads. register() is serving-startup / admin-path territory and takes
+the lock for the registry map.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..utils.logging import get_logger
+
+log = get_logger("adapters")
+
+# stacked-leaf name -> (in_dim, out_dim) factory; mirrors the mm sites in
+# models/llama.decoder_layer (stacked leaves hold W.T [in, out])
+_ATTN_LEAVES = ("wq", "wk", "wv", "wo")
+_MLP_LEAVES = ("w_gate", "w_up", "w_down")
+
+
+def adapter_leaf_dims(cfg: ModelConfig) -> dict:
+    """{base leaf: (in_dim, out_dim)} of every projection the adapter
+    delta can target on this config. MoE configs carry no dense mlp
+    leaves, so mlp-targeting adapters are rejected at registration."""
+    D, Dh = cfg.dim, cfg.head_dim
+    H, KV, F = cfg.n_heads, cfg.n_kv_heads, cfg.ffn_dim
+    dims = {
+        "wq": (D, H * Dh),
+        "wk": (D, KV * Dh),
+        "wv": (D, KV * Dh),
+        "wo": (H * Dh, D),
+    }
+    if not cfg.n_experts:
+        dims.update({
+            "w_gate": (D, F),
+            "w_up": (D, F),
+            "w_down": (F, D),
+        })
+    return dims
+
+
+def install_adapter_leaves(cfg: ModelConfig, params: dict, slots: int,
+                           rank: int) -> dict:
+    """Add the zeroed paged lora_* leaves to params["layers"] (page 0 =
+    the base page). Runs at engine build, AFTER quantization (the lora
+    leaves stay dense — ops/quant only touches _QUANT_KEYS) and BEFORE
+    sharding, so pp/tp meshes shard them through the ordinary
+    parallel/partition specs."""
+    if cfg.arch != "llama":
+        raise ValueError(
+            f"runtime adapters are wired for the llama family; got "
+            f"{cfg.arch!r}"
+        )
+    if slots < 1:
+        raise ValueError(f"adapter_slots must be >= 1, got {slots}")
+    if rank < 1:
+        raise ValueError(f"adapter_rank must be >= 1, got {rank}")
+    L, P = cfg.n_layers, slots + 1
+    dt = cfg.jnp_dtype
+    layers = dict(params["layers"])
+    for leaf, (d_in, d_out) in adapter_leaf_dims(cfg).items():
+        if leaf not in layers:
+            continue  # defensive: only shadow projections that exist
+        layers[f"lora_{leaf}_a"] = jnp.zeros((L, P, d_in, rank), dt)
+        layers[f"lora_{leaf}_b"] = jnp.zeros((L, P, rank, d_out), dt)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _page_write(buf, page, val):
+    """One donation-aliased page write: buf [L, P, ...] <- val [L, ...]
+    at page `page` (traced int32 — no recompile across pages)."""
+    return buf.at[:, page].set(val)
+
+
+class AdapterPool:
+    """Refcounted LRU pool of device-resident LoRA adapters.
+
+    backend must expose write_adapter_page(page, updates) (engine/
+    engine.SingleDeviceBackend and parallel/pipeline.PipelineBackend
+    do); updates = {base leaf: (a [L, in, r], b [L, r, out]) host
+    arrays}.
+
+    registry (utils/metrics.MetricsRegistry, optional): the
+    dli_adapter_* families pre-registered in engine/engine.py.
+    """
+
+    def __init__(self, cfg: ModelConfig, backend: Any, slots: int,
+                 rank: int, registry=None,
+                 merged_source: Optional[str] = None):
+        self.cfg = cfg
+        self.backend = backend
+        self.slots = int(slots)
+        self.rank = int(rank)
+        # --lora merge-at-load source, if any: registering the SAME
+        # adapter as a runtime adapter would apply its delta twice
+        self.merged_source = (
+            os.path.abspath(merged_source) if merged_source else None
+        )
+        self._dims = adapter_leaf_dims(cfg)
+        # name -> host stacked tensors ({leaf: (a, b)} np.float32)
+        self._registry: dict = {}          # guarded-by: _lock
+        self._page_of: dict = {}           # name -> page (resident)
+        self._name_of: dict = {}           # page -> name
+        self._refs: dict = {}              # page -> holder count
+        self._free = list(range(1, self.slots + 1))
+        # refcount-0 residents, insertion order == LRU order
+        self._lru: dict = {}               # name -> page (ordered)
+        self._lock = threading.Lock()
+        self.loads = 0
+        self.evictions = 0
+        self.swaps = 0
+        self._m_resident = self._m_bytes = None
+        self._m_loads = self._m_evictions = self._m_swaps = None
+        if registry is not None:
+            self._m_resident = registry.gauge(
+                "dli_adapter_pool_resident",
+                "adapters resident in device pool pages (referenced + LRU)",
+            ).labels()
+            self._m_bytes = registry.gauge(
+                "dli_adapter_pool_bytes",
+                "HBM bytes reserved by the paged adapter leaves (all "
+                "pages, base page included)",
+            ).labels()
+            self._m_loads = registry.counter(
+                "dli_adapter_loads_total",
+                "adapter page writes into the device pool",
+            ).labels()
+            self._m_evictions = registry.counter(
+                "dli_adapter_evictions_total",
+                "resident adapters dropped from their page (LRU "
+                "reclaim; referenced pages are never evicted)",
+            ).labels()
+            self._m_swaps = registry.counter(
+                "dli_adapter_swaps_total",
+                "page loads that displaced another adapter (evict + "
+                "write on one page)",
+            ).labels()
+            self._m_bytes.set(self.pool_bytes)
+
+    # -- sizing --------------------------------------------------------------
+    @property
+    def pool_bytes(self) -> int:
+        """Reserved HBM of the paged lora leaves (fixed at install)."""
+        per_page = sum(
+            (d_in * self.rank + self.rank * d_out) * self.cfg.n_layers
+            for d_in, d_out in self._dims.values()
+        )
+        itemsize = jnp.dtype(self.cfg.jnp_dtype).itemsize
+        return per_page * (self.slots + 1) * itemsize
+
+    @property
+    def total(self) -> int:
+        return self.slots
+
+    @property
+    def free(self) -> int:
+        """Pages acquirable RIGHT NOW without backpressure: never-
+        written free pages plus refcount-0 LRU residents."""
+        with self._lock:
+            return len(self._free) + len(self._lru)
+
+    # -- registration (serving startup / admin path) -------------------------
+    def register(self, name: str, source) -> None:
+        """Register `name` -> host adapter tensors. `source` is a PEFT
+        adapter directory path (models/lora.load_lora_stacked) or a
+        preloaded {leaf: (a, b)} dict (tests / programmatic callers).
+        Rejects adapters targeting projections this config has no lora
+        leaves for (MoE mlp), rank overflow (inside load_lora_stacked),
+        empty/reserved names, and double registration."""
+        if not name or not isinstance(name, str):
+            raise ValueError("adapter name must be a non-empty string")
+        if name == self.cfg.name:
+            raise ValueError(
+                f"adapter name {name!r} collides with the base model name "
+                f"— `model: {name!r}` must keep meaning the base"
+            )
+        if isinstance(source, str):
+            if (self.merged_source is not None
+                    and os.path.abspath(source) == self.merged_source):
+                raise ValueError(
+                    f"adapter {name!r} points at {source!r}, which is "
+                    f"already merged into the base weights (--lora "
+                    f"merge-at-load, the single-adapter fast path); its "
+                    f"output IS the base output — registering it again "
+                    f"would apply the delta twice"
+                )
+            from ..models.lora import load_lora_stacked
+
+            tensors = load_lora_stacked(self.cfg, source, self.rank)
+        else:
+            tensors = dict(source)
+        bad = sorted(set(tensors) - set(self._dims))
+        if bad:
+            raise ValueError(
+                f"adapter {name!r} targets projections with no adapter "
+                f"leaves on this config: {bad} (MoE configs carry "
+                f"attention adapters only)"
+            )
+        L = self.cfg.n_layers
+        for leaf, (a, b) in tensors.items():
+            d_in, d_out = self._dims[leaf]
+            if a.shape != (L, d_in, self.rank) or (
+                b.shape != (L, self.rank, d_out)
+            ):
+                raise ValueError(
+                    f"adapter {name!r} {leaf}: stacked shapes "
+                    f"{a.shape}/{b.shape} do not match "
+                    f"[L={L}, {d_in}|{d_out}, rank={self.rank}]"
+                )
+        with self._lock:
+            if name in self._registry:
+                raise ValueError(f"adapter {name!r} is already registered")
+            self._registry[name] = tensors
+        log.info("adapter_registered", name=name,
+                 leaves=sorted(tensors))
+
+    def names(self) -> list:
+        with self._lock:
+            return sorted(self._registry)
+
+    def is_registered(self, name: str) -> bool:
+        with self._lock:
+            return name in self._registry
+
+    # -- page lifecycle (worker thread) --------------------------------------
+    def acquire(self, name: str) -> Optional[int]:
+        """One holder on `name`'s device page, loading/evicting as
+        needed. Returns the page id, or None when every page is
+        referenced (the caller backpressures exactly like block
+        exhaustion). KeyError for unregistered names — the serving edge
+        400s those before they reach admission."""
+        with self._lock:
+            if name not in self._registry:
+                raise KeyError(f"unknown adapter {name!r}")
+            page = self._page_of.get(name)
+            if page is not None:
+                self._refs[page] = self._refs.get(page, 0) + 1
+                self._lru.pop(name, None)  # referenced: out of the LRU
+                return page
+            if self._free:
+                page = self._free.pop()
+                swapped = False
+            elif self._lru:
+                # evict the LRU refcount-0 resident; referenced pages
+                # are untouchable (the eviction-under-refs contract)
+                victim, page = next(iter(self._lru.items()))
+                self._lru.pop(victim)
+                self._page_of.pop(victim, None)
+                self._name_of.pop(page, None)
+                self.evictions += 1
+                swapped = True
+            else:
+                return None  # every page referenced: backpressure
+            tensors = self._registry[name]
+        # the device write happens OUTSIDE the lock: it is worker-thread
+        # serialized anyway, and a multi-MB host->HBM copy must not
+        # block a /metrics render
+        updates = {
+            leaf: (a, b) for leaf, (a, b) in tensors.items()
+        }
+        self.backend.write_adapter_page(page, updates)
+        with self._lock:
+            self._page_of[name] = page
+            self._name_of[page] = name
+            self._refs[page] = 1
+            self.loads += 1
+            if swapped:
+                self.swaps += 1
+            n_resident = len(self._page_of)
+        if self._m_loads is not None:
+            self._m_loads.inc()
+            if swapped:
+                self._m_swaps.inc()
+                self._m_evictions.inc()
+            self._m_resident.set(n_resident)
+        log.info("adapter_loaded", name=name, page=page, swapped=swapped)
+        return page
+
+    def release(self, name: str) -> None:
+        """Drop one holder; at refcount 0 the adapter PARKS in the LRU
+        (still resident — the next acquire is free) instead of freeing
+        its page."""
+        with self._lock:
+            page = self._page_of.get(name)
+            if page is None:
+                return
+            refs = self._refs.get(page, 0) - 1
+            if refs < 0:
+                # over-release is an accounting bug — surface loudly,
+                # then clamp so the pool keeps serving
+                log.error("adapter_over_release", name=name, page=page)
+                refs = 0
+            self._refs[page] = refs
+            if refs == 0:
+                self._lru[name] = page
+
+    def reset_refs(self) -> None:
+        """Crash-recovery fleet rebuild: every live holder died with the
+        fleet (engine/continuous._release_fleet_resources discipline);
+        re-admissions re-acquire. Device page CONTENT survives — the
+        leaves live in params, which no crashed launch donated — so the
+        residents all park in the LRU and recovered requests reload
+        nothing."""
+        with self._lock:
+            for name, page in self._page_of.items():
+                self._refs[page] = 0
+                self._lru.setdefault(name, page)
+
+    def referenced(self) -> int:
+        """Pages with live holders (the post-drain `free == total`
+        hygiene check is `referenced() == 0`)."""
+        with self._lock:
+            return sum(1 for r in self._refs.values() if r > 0)
+
+    def page_name(self, page: int) -> Optional[str]:
+        with self._lock:
+            return self._name_of.get(page)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "registered": len(self._registry),
+                "resident": len(self._page_of),
+                "referenced": sum(1 for r in self._refs.values() if r > 0),
+                "free": len(self._free) + len(self._lru),
+                "total": self.slots,
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "swaps": self.swaps,
+                "pool_bytes": self.pool_bytes,
+            }
+
+
+def attach_adapter_pool(engine, slots: int, rank: int) -> AdapterPool:
+    """Install the paged lora leaves into a built engine's single-device
+    backend and hang an AdapterPool off it (engine.adapters). The
+    create_engine path installs the leaves BEFORE sharding instead
+    (runtime.create_backend), so this helper is for directly-constructed
+    engines — tests and the analysis tiny engines."""
+    be = engine.backend
+    be.params = install_adapter_leaves(engine.cfg, be.params, slots, rank)
+    engine.adapters = AdapterPool(
+        engine.cfg, be, slots, rank, registry=engine.metrics
+    )
+    return engine.adapters

@@ -53,7 +53,18 @@ import hashlib
 import threading
 from typing import Optional
 
-ROOT = -1  # parent id of a prompt's first block
+ROOT = -1  # parent id of a prompt's first block (base-model chains)
+
+
+def _root_for(adapter) -> object:
+    """Chain root for a (possibly adapter-serving) prompt. The adapter
+    changes every KV byte its prompt writes, so adapter chains hang off
+    a per-adapter sentinel root instead of ROOT — two adapters (or an
+    adapter and the base) never match each other's chains even for
+    IDENTICAL prompts. Roots are compared by dict equality like any
+    parent id; an int parent is always a physical block, so sentinel
+    tuples can never collide with real chain interiors."""
+    return ("adapter", adapter) if adapter is not None else ROOT
 
 
 def chunk_digests(seq, chunk: int, max_chunks: int = 64) -> list:
@@ -156,13 +167,18 @@ class BlockPrefixIndex:
             ).labels()
 
     # -- planner interface (engine._prefix_plan) ----------------------------
-    def lookup(self, ids: list) -> tuple[int, Optional[list], Optional[tuple]]:
+    def lookup(self, ids: list, adapter=None) -> tuple[int, Optional[list],
+                                                       Optional[tuple]]:
         """(p0, shared block ids, key) for the deepest cached chain whose
         full blocks token-match the prompt; (0, None, None) on miss. Pure
         — no counters, no LRU promotion, no refcounts: the engine increfs
         the returned blocks once it commits to mapping them, and
         _prefix_plan calls mark() on the PLANNED outcome (a hit that fell
         back cold must not count — and must not hold references).
+
+        adapter: runtime adapter name — the walk starts at that adapter's
+        own root (_root_for), so content keys are (adapter, chain), never
+        chain alone (the adapter changes the KV).
 
         Depth is capped to leave at least one tail token to prefill (the
         sampling chunk needs a real token), so a prompt that IS a cached
@@ -173,7 +189,7 @@ class BlockPrefixIndex:
         cap = (len(ids_t) - 1) // bs  # full blocks usable after the cap
         blocks: list = []
         keys: list = []
-        parent = ROOT
+        parent = _root_for(adapter)
         with self._lock:
             for i in range(cap):
                 key = (parent, ids_t[i * bs : (i + 1) * bs])
@@ -215,16 +231,18 @@ class BlockPrefixIndex:
             self._m_saved.inc(saved)
 
     # -- cache mutation (worker thread) --------------------------------------
-    def register(self, ids: list, prompt_len: int, row_blocks: list) -> int:
+    def register(self, ids: list, prompt_len: int, row_blocks: list,
+                 adapter=None) -> int:
         """Index the admitted prompt's FULL blocks (positions below
         prompt_len // bs * bs — complete, immutable once the insert
         scatter lands). Blocks already cached (the mapped shared head, or
         a chain another request registered) are promoted, not re-added;
-        each newly cached block gains the index's own reference. Returns
+        each newly cached block gains the index's own reference. Adapter
+        chains register under their adapter's root (see lookup). Returns
         the number of newly cached blocks."""
         bs = self.block_size
         n_full = prompt_len // bs
-        parent = ROOT
+        parent = _root_for(adapter)
         new = 0
         with self._lock:
             for i in range(n_full):
@@ -276,7 +294,13 @@ class BlockPrefixIndex:
         (engine/shadow.py save ordering) and tests use it; physical
         block ids deliberately do NOT appear — they are meaningless
         across a pool rebuild, which is the whole point of the
-        content-keyed shadow."""
+        content-keyed shadow.
+
+        Adapter-rooted chains are deliberately EXCLUDED: adapter KV is
+        never shadow-captured (the shadow store is content-keyed by
+        tokens alone, and adapter KV under base keys would be wrong KV
+        on restore), so exporting their chains would persist orderings
+        with no backing data."""
         with self._lock:
             parents_with_children = {k[0] for k in self._entries}
             chains = []
@@ -289,8 +313,13 @@ class BlockPrefixIndex:
                     chunks.append(k[1])
                     if k[0] == ROOT:
                         break
+                    if not isinstance(k[0], int):
+                        # adapter sentinel root: drop the whole chain
+                        chunks = None
+                        break
                     k = self._block_key[k[0]]
-                chains.append(tuple(reversed(chunks)))
+                if chunks is not None:
+                    chains.append(tuple(reversed(chunks)))
         return chains
 
     def evictable_blocks(self) -> int:

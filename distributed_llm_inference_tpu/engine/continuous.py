@@ -137,11 +137,20 @@ class _Request:
         "deadline_at", "cancel_cause", "preemptions", "preempted_at",
         "resume_seq", "drop_seq", "kv_hint", "fabric_blocks",
         "spec_want", "spec_drafted", "spec_accepted", "spec_launches",
+        "adapter", "tenant", "adapter_page",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
-                 request_id=None, kv_hint=None):
+                 request_id=None, kv_hint=None, adapter=None, tenant=None):
         self.prompt = prompt
+        # multi-tenant adapter serving (engine/adapters.py): registered
+        # adapter name (None = base model), the tenant the request bills
+        # against, and — once admitted — the HBM adapter page its launch
+        # rows select (0 = the base page; held via the pool's refcount
+        # from admission to release)
+        self.adapter = adapter
+        self.tenant = tenant
+        self.adapter_page: Optional[int] = None
         # SLO class name (engine/scheduler.py): resolved against the
         # configured classes at enqueue; drives prefill-budget
         # apportionment, shed decisions, and class-aware Retry-After
@@ -395,6 +404,11 @@ class ContinuousEngine:
                 (self.n_slots, self._max_blocks), np.int32
             )
             self._table_dev = None
+            # per-slot adapter page ids (engine/adapters.py): 0 = the
+            # base page, set beside the block-table row at admission and
+            # zeroed with it at release. Worker-thread-mutated like
+            # _table; every paged launch carries a snapshot of it.
+            self._slot_pages = np.zeros((self.n_slots,), np.int32)
             self._ragged = ragged_planned
             # query-tile granularity of the ragged kernel's flat token
             # axis; the launch width rounds up to a whole number of tiles
@@ -425,6 +439,7 @@ class ContinuousEngine:
             self._slo, engine.engine_cfg.slo_default_class,
             int(engine.engine_cfg.step_token_budget), self._ragged_tile,
             self.n_slots, registry=engine.metrics,
+            tenant_weights=engine.engine_cfg.tenant_weights,
         )
         self._chunked = bool(
             self._ragged
@@ -654,8 +669,22 @@ class ContinuousEngine:
                 registry=engine.metrics, role=self.replica_class,
                 timeout_s=engine.engine_cfg.kv_fabric_timeout_s,
             )
+        # Paged LoRA adapter serving (engine/adapters.py): the engine's
+        # AdapterPool, honored only on fleets whose launch programs can
+        # carry the traced pages operand (ragged paged — every other
+        # fleet rejects adapter requests at submit with a 400 envelope).
+        self._adapters = (
+            getattr(engine, "adapters", None)
+            if (self.paged and self._ragged) else None
+        )
+        self._tenant_max_share = float(
+            engine.engine_cfg.tenant_max_queue_share
+        )
         self._cv = threading.Condition()
         self._queue: list[_Request] = []  # guarded-by: _cv
+        # tenants that have ever queued (guarded-by: _cv) — keeps the
+        # per-tenant queue-depth gauge schema stable after they drain
+        self._gauge_tenants: set = {""}
         self._closed = False  # guarded-by: _cv
         self._key = jax.random.PRNGKey(int(time.time()) & 0x7FFFFFFF)
         # supervisor state (all worker-thread-mutated; readiness reads are
@@ -706,6 +735,14 @@ class ContinuousEngine:
         self._m_shed = m.counter(
             "dli_queue_shed_total", "requests shed with 429", ("queue",)
         ).labels(queue="continuous")
+        # multi-tenant admission quota (family pre-registered in
+        # engine/engine.py): requests shed because one tenant's queued
+        # share crossed engine_cfg.tenant_max_queue_share
+        self._m_tenant_shed = m.counter(
+            "dli_tenant_shed_total",
+            "requests shed with 429 by the per-tenant queue quota",
+            ("tenant",),
+        )
         # graceful-degradation families (pre-registered in
         # engine/engine.py): preempt->resume latency, cancellations by
         # cause, deadline overruns
@@ -870,15 +907,22 @@ class ContinuousEngine:
         return False
 
     def _note_queue_locked(self):  # guarded-by: _cv
-        """Refresh the global + per-SLO-class queue-depth gauges (caller
-        holds the lock). One helper so every queue mutation keeps both
-        views consistent."""
+        """Refresh the global + per-(SLO class, tenant) queue-depth
+        gauges (caller holds the lock). One helper so every queue
+        mutation keeps both views consistent. Tenants ever seen stay in
+        the gauge schema (so a drained tenant's series reads 0, not its
+        stale last value)."""
         self._m_depth.set(len(self._queue))
         counts: dict = {}
         for r in self._queue:
-            counts[r.slo] = counts.get(r.slo, 0) + 1
+            t = r.tenant or ""
+            self._gauge_tenants.add(t)
+            counts[(r.slo, t)] = counts.get((r.slo, t), 0) + 1
         for name in self._slo:
-            self._sched.set_depth(name, counts.get(name, 0))
+            for t in self._gauge_tenants:
+                self._sched.set_depth(
+                    name, counts.get((name, t), 0), tenant=t
+                )
 
     def _class_depth_locked(self, cls_name: str) -> int:  # guarded-by: _cv
         return sum(1 for r in self._queue if r.slo == cls_name)
@@ -958,6 +1002,41 @@ class ContinuousEngine:
                         cls, class_depth
                     ),
                 }
+            if req.tenant is not None and self._tenant_max_share < 1.0:
+                # tenant quota: one tenant's queued share of the bounded
+                # queue is capped (beyond a small absolute floor — the
+                # share is meaningless at tiny depths) so a tenant
+                # flooding the queue sheds before OTHER tenants start
+                # eating 429s off the global queue-full check
+                from .scheduler import MIN_SHED_DEPTH
+
+                t_depth = sum(
+                    1 for r in self._queue if r.tenant == req.tenant
+                )
+                t_cap = max(
+                    MIN_SHED_DEPTH,
+                    int(self.max_queue * self._tenant_max_share),
+                )
+                if t_depth >= t_cap:
+                    log.warning(
+                        "tenant_shed", tenant=req.tenant, depth=t_depth,
+                        cap=t_cap, slo_class=cls.name,
+                    )
+                    self._m_shed.inc()
+                    self._m_tenant_shed.labels(tenant=req.tenant).inc()
+                    return {
+                        "error": (
+                            f"Error: tenant {req.tenant!r} is at its "
+                            f"queue quota ({t_cap} of {self.max_queue})"
+                        ),
+                        "status": "failed",
+                        "error_type": "overloaded",
+                        "slo_class": cls.name,
+                        "tenant": req.tenant,
+                        "retry_after_s": self._sched.retry_after_s(
+                            cls, class_depth
+                        ),
+                    }
             if self._sched.should_shed(cls, class_depth):
                 # the class's drain estimate already overruns its TTFT
                 # target: admitting would burn prefill budget on a
@@ -986,6 +1065,37 @@ class ContinuousEngine:
             self._cv.notify_all()
         return None
 
+    def _adapter_reject(self, adapter, kwargs) -> Optional[dict]:
+        """400-style envelope for adapter requests the fleet cannot
+        serve — no attached pool (the fleet is not ragged-paged or
+        engine_cfg.adapter_slots is 0), an unregistered adapter name, or
+        a solo-contract request (the solo engine serves only the one
+        merged/base model — runtime adapter selection lives in the
+        fleet's paged launch programs). None = serveable."""
+        if adapter is None:
+            return None
+
+        def env(msg):
+            return {
+                "error": f"Error: {msg}", "status": "failed",
+                "error_type": "invalid_request", "adapter": adapter,
+            }
+
+        if self._adapters is None:
+            return env(
+                "adapter serving needs the ragged paged fleet with an "
+                "attached adapter pool (engine_cfg.adapter_slots > 0)"
+            )
+        if not self._adapters.is_registered(adapter):
+            return env(f"unknown adapter {adapter!r}")
+        if self._needs_solo(kwargs):
+            return env(
+                "adapter requests cannot combine with solo-engine "
+                "contracts (seed / debug / logprobs / logit_bias / "
+                "beams / constraints)"
+            )
+        return None
+
     def submit(self, prompt: str, **kwargs) -> dict:
         # KV-fabric handoff surface (serving/kv_fabric.py): the hint is
         # consumed at admission; prefill_only serves the disaggregation
@@ -994,6 +1104,11 @@ class ContinuousEngine:
         # so the decode-class replica's immediate fetch finds the chain
         # resident instead of racing the copier thread.
         kv_hint = kwargs.pop("kv_hint", None)
+        adapter = kwargs.pop("adapter", None) or None
+        tenant = kwargs.pop("tenant", None) or None
+        err = self._adapter_reject(adapter, kwargs)
+        if err is not None:
+            return err
         prefill_only = bool(kwargs.pop("prefill_only", False))
         if prefill_only:
             kwargs["max_tokens"] = 1
@@ -1001,7 +1116,7 @@ class ContinuousEngine:
             return self.engine.generate(prompt, **kwargs)
         req = _Request(prompt, kwargs,
                        request_id=kwargs.pop("request_id", None),
-                       kv_hint=kv_hint)
+                       kv_hint=kv_hint, adapter=adapter, tenant=tenant)
         err = self._enqueue(req)
         if err is not None:
             return err
@@ -1028,6 +1143,12 @@ class ContinuousEngine:
         fetched step, like any chunk).
         """
         kv_hint = kwargs.pop("kv_hint", None)
+        adapter = kwargs.pop("adapter", None) or None
+        tenant = kwargs.pop("tenant", None) or None
+        err = self._adapter_reject(adapter, kwargs)
+        if err is not None:
+            yield {**err, "done": True}
+            return
         if self._needs_solo(kwargs):
             out = self.engine.generate(prompt, **kwargs)
             out["done"] = True
@@ -1038,7 +1159,7 @@ class ContinuousEngine:
         q: _queue.Queue = _queue.Queue()
         req = _Request(prompt, kwargs, stream_q=q,
                        request_id=kwargs.pop("request_id", None),
-                       kv_hint=kv_hint)
+                       kv_hint=kv_hint, adapter=adapter, tenant=tenant)
         err = self._enqueue(req)  # error yielded OUTSIDE the engine lock:
         if err is not None:  # the consumer may block on a slow socket write
             yield {**err, "done": True}
@@ -1273,6 +1394,8 @@ class ContinuousEngine:
                 **self._fabric.stats(),
                 "serving": self.fabric_serving,
             }
+        if self._adapters is not None:
+            out["adapters"] = self._adapters.stats()
         out["slo"] = {
             "default": self._sched.default_name,
             "classes": {
@@ -1390,6 +1513,14 @@ class ContinuousEngine:
             if self.paged and req.block_ids is not None:
                 self._alloc.decref(req.block_ids)
                 req.block_ids = None
+            req.adapter_page = None
+        if self._adapters is not None:
+            # adapter-page refcounts reset wholesale: every holder was
+            # detached above, and the device content SURVIVES the crash
+            # (the lora leaves live in params, never in a donated launch
+            # buffer) — recovery re-admissions re-acquire still-resident
+            # pages for free
+            self._adapters.reset_refs()
         if self._bpx is not None:
             # cached chains point into the pool buffer the rebuild below
             # replaces — drop them (and the index's refs) wholesale
@@ -1397,6 +1528,7 @@ class ContinuousEngine:
         if self.paged:
             self._table[:] = 0
             self._table_dev = None
+            self._slot_pages[:] = 0
             if self._alloc.outstanding:
                 # the explicit releases above must zero the books; a
                 # mismatch is an accounting bug — surface it loudly, then
@@ -1453,6 +1585,13 @@ class ContinuousEngine:
         enqueue happens here, the device->host copy runs on the shadow
         thread — the scheduler loop never blocks."""
         if self._shadow is None or req.block_ids is None or req.ids is None:
+            return
+        if req.adapter is not None:
+            # adapter-conditioned KV never enters the shadow: the store
+            # (and the fabric it serves) keys chains by TOKEN CONTENT
+            # alone, and an adapter's KV differs from the base model's
+            # for the same tokens — persisting it would poison warm
+            # restores and cross-replica imports with wrong-model bytes
             return
         bs = self.kv_block_size
         if written is None:
@@ -1754,9 +1893,13 @@ class ContinuousEngine:
             if victim.first_id is not None
             and victim.first_id not in self.cfg.all_stop_ids else []
         )
-        if swapped and victim.ids is not None:
+        if swapped and victim.ids is not None and victim.adapter is None:
             victim.resume_seq = list(victim.ids) + head + victim.tokens
         else:
+            # adapter victims always drop-and-recompute: their KV never
+            # enters the shadow (base-keyed content store), so there is
+            # no chain to restore — the recompute resume is still greedy
+            # bit-identical via the salvage record
             victim.resume_seq = None
         victim.salvaged = victim.salvaged + head + victim.tokens
         victim.first_id = None
@@ -2081,11 +2224,19 @@ class ContinuousEngine:
         if self.paged:
             if self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table)
+            # adapter serving: the per-slot page snapshot rides every
+            # launch (pages=None when no pool is attached — a DISTINCT
+            # compiled program that lowers byte-identically to the
+            # pre-adapter build)
+            pages = (
+                jnp.asarray(self._slot_pages)
+                if self._adapters is not None else None
+            )
             emitted, mask, self.state, self.cache = (
                 self.backend.decode_slots_paged(
                     self.state, self.cache, self._table_dev,
                     self._next_key(), self.sparams,
-                    num_steps=self.chunk_steps,
+                    num_steps=self.chunk_steps, pages=pages,
                 )
             )
         elif self._ctable.any_active:
@@ -2265,6 +2416,30 @@ class ContinuousEngine:
             ).inc()
             self._release(req)  # drops the job via the slot mapping
 
+    # -- adapter page lifecycle (engine/adapters.py) -------------------------
+    def _acquire_adapter(self, req: _Request) -> bool:
+        """Pin req's adapter page (refcount + HBM upload on a miss) for
+        the request's whole slot tenure. Acquired FIRST in admission —
+        before any block incref — so every unwind path below it only has
+        to release what it took. False = every page is referenced by
+        other in-flight requests right now (backpressure, same contract
+        as pool-block exhaustion). Base requests are a no-op (page 0)."""
+        if req.adapter is None or req.adapter_page is not None:
+            return True
+        page = self._adapters.acquire(req.adapter)
+        if page is None:
+            return False
+        req.adapter_page = page
+        return True
+
+    def _release_adapter(self, req: _Request):
+        """Drop req's adapter-page reference (idempotent). The page
+        stays RESIDENT at refcount 0 (LRU-parked) — the next request for
+        the same adapter re-acquires it without a device write."""
+        if req.adapter_page is not None and self._adapters is not None:
+            self._adapters.release(req.adapter)
+        req.adapter_page = None
+
     def _start_jobs(self):
         """Move queued requests into PrefillJobs while a slot and pool
         blocks are available. Host-side only — tokenize, plan prefix
@@ -2407,6 +2582,11 @@ class ContinuousEngine:
             }
             self._push_final(req)
             return None
+        if not self._acquire_adapter(req):
+            # every adapter page is referenced by other in-flight
+            # requests: backpressure exactly like pool-block exhaustion
+            # (the caller requeues at the front; a release frees a page)
+            return _BLOCKED
         k = req.kwargs
         text = (
             eng.render_chat(req.prompt)
@@ -2418,12 +2598,15 @@ class ContinuousEngine:
             # crash-recovery continuation: prompt + pre-crash tokens
             ids = ids + list(req.salvaged)
         prompt_len = len(ids)
-        if req.kv_hint is not None:
+        if req.kv_hint is not None and req.adapter is None:
             # same remote-hit seam as the whole-prefill admission: a
-            # fetched chain becomes a deeper exact-depth hit below
+            # fetched chain becomes a deeper exact-depth hit below.
+            # Adapter requests never prefetch — the fabric serves BASE
+            # KV chains keyed by token content alone.
             self._fabric_prefetch(req, ids)
         p0, entry, plan = eng._prefix_plan(
             self._bpx, ids, capacity=self.slot_max_seq, ragged=True,
+            adapter=req.adapter,
         )
         if plan is None:
             raise ValueError(
@@ -2462,6 +2645,7 @@ class ContinuousEngine:
             if shared:
                 self._alloc.decref(shared)
             req.block_ids = None
+            self._release_adapter(req)
             return _BLOCKED
         req.block_ids = shared + blk_ids
         table_row = np.zeros((self._max_blocks,), np.int32)
@@ -2489,6 +2673,7 @@ class ContinuousEngine:
         )
         self._table[slot] = table_row
         self._table_dev = None
+        self._slot_pages[slot] = req.adapter_page or 0
         self._host_pos[slot] = 0
         # a new tenant's stream predicts nothing about the previous
         # one's: its adaptive-K acceptance EWMA starts fresh
@@ -2844,6 +3029,14 @@ class ContinuousEngine:
                     self.state.pos, self._dpool, self._table_dev,
                     draft_len=self._spec_k_max,
                 )
+        # adapter serving: the per-slot page snapshot rides the launch
+        # (row -> page via the same tok_row indirection as the block
+        # table; page 0 = base). pages=None when no pool is attached —
+        # a distinct program that lowers byte-identically to before.
+        pages_dev = (
+            jnp.asarray(self._slot_pages)
+            if self._adapters is not None else None
+        )
         packed, self.state, self.sparams, self.cache = (
             self.backend.mixed_step_ragged(
                 jnp.asarray(toks), jnp.asarray(tok_row),
@@ -2852,7 +3045,7 @@ class ContinuousEngine:
                 self.state, self.sparams, self._next_key(),
                 jnp.asarray(dec_idx), arm,
                 spec=spec_plan_dev, spec_toks=spec_toks_dev,
-                dev=dev_dev,
+                dev=dev_dev, pages=pages_dev,
             )
         )
         # host position model + completion bookkeeping AFTER the launch
@@ -2898,8 +3091,14 @@ class ContinuousEngine:
             if self._bpx is not None:
                 # full prompt blocks are complete + immutable once this
                 # launch lands; later gathers serialize behind it on
-                # device — same register point as the whole-prefill path
-                self._bpx.register(job.ids, job.prompt_len, req.block_ids)
+                # device — same register point as the whole-prefill path.
+                # Adapter requests register under their ADAPTER root:
+                # the KV bytes are adapter-conditioned, so only requests
+                # of the same adapter may reuse them.
+                self._bpx.register(
+                    job.ids, job.prompt_len, req.block_ids,
+                    adapter=req.adapter,
+                )
         if self._shadow is not None:
             # chunk crossed a block boundary -> those blocks are now
             # immutable; the capture gather dispatches BEHIND the mixed
@@ -3221,6 +3420,12 @@ class ContinuousEngine:
             }
             self._push_final(req)
             return
+        if not self._acquire_adapter(req):
+            # every adapter page is referenced by other in-flight
+            # requests: backpressure, caller requeues at the front.
+            # Acquired BEFORE any block incref so the unwind paths below
+            # only release what they took on top of it.
+            return _BLOCKED
         k = req.kwargs
         text = (
             eng.render_chat(req.prompt)
@@ -3234,11 +3439,13 @@ class ContinuousEngine:
             # resumes bit-exactly where the fetched stream stopped
             ids = ids + list(req.salvaged)
         prompt_len = len(ids)
-        if req.kv_hint is not None:
+        if req.kv_hint is not None and req.adapter is None:
             # router handoff hint: pull the prefix chain from the
             # resident peer BEFORE planning, so the plan below sees it
             # as an ordinary (deeper) block-prefix hit; every fetch
-            # failure degrades to the cold plan
+            # failure degrades to the cold plan. Adapter requests never
+            # prefetch — fabric chains are BASE-model KV keyed by token
+            # content alone.
             self._fabric_prefetch(req, ids)
         # prefix lookup + ingest plan: the solo engine's shared planner
         # helper (one copy of the lookup/cold-fallback/mark discipline);
@@ -3250,6 +3457,7 @@ class ContinuousEngine:
         p0, entry, plan = eng._prefix_plan(
             self._bpx if self.paged else self._prefix, ids,
             capacity=self.slot_max_seq, ragged=self._ragged,
+            adapter=req.adapter,
         )
         if plan is None:
             raise ValueError(
@@ -3300,6 +3508,7 @@ class ContinuousEngine:
                 if shared:
                     self._alloc.decref(shared)
                 req.block_ids = None
+                self._release_adapter(req)
                 return _BLOCKED  # pool exhausted; caller requeues at front
             req.block_ids = shared + blk_ids
             table_row = np.zeros((self._max_blocks,), np.int32)
@@ -3327,6 +3536,7 @@ class ContinuousEngine:
                     # orphan the first grant
                     self._alloc.decref(req.block_ids)
                     req.block_ids = None
+                self._release_adapter(req)
                 return _BLOCKED  # retry after a release frees rows
             req.cart = (cart, off)
         sampling = G.default_sampling(
@@ -3370,7 +3580,8 @@ class ContinuousEngine:
                 if p0:
                     self._m_ragged_exact.inc()
                 first = self._ragged_ingest(
-                    ids, p0, table_row, key, sampling, presence, bias
+                    ids, p0, table_row, key, sampling, presence, bias,
+                    page=req.adapter_page,
                 )
             elif self.paged:
                 if p0:
@@ -3421,6 +3632,9 @@ class ContinuousEngine:
                 )
                 self._table[slot] = table_row
                 self._table_dev = None  # rebuilt at the next chunk launch
+                # the slot decodes under the request's adapter page from
+                # its first chunk launch (0 = base)
+                self._slot_pages[slot] = req.adapter_page or 0
                 # chunked mode reaches here through RECOVERY's serialized
                 # whole-prefill re-admissions: seed the host position
                 # model so subsequent mixed launches plan this row exactly
@@ -3452,6 +3666,7 @@ class ContinuousEngine:
                 # same discipline for the constraint residency refcount
                 self._ctable.release(req.cart[0].key)
                 req.cart = None
+            self._release_adapter(req)  # and the adapter-page refcount
             raise
         finally:
             if not use_ragged and self._scratch is None:
@@ -3466,7 +3681,10 @@ class ContinuousEngine:
             # target later positions): the request's own fresh blocks
             # become cached chains, the mapped head is promoted. Later
             # admissions' gathers serialize behind this insert on device.
-            self._bpx.register(ids, prompt_len, req.block_ids)
+            # Adapter requests register under their adapter root — the
+            # KV bytes are adapter-conditioned.
+            self._bpx.register(ids, prompt_len, req.block_ids,
+                               adapter=req.adapter)
         # the admitted token sequence: shadow capture keys off it, the
         # n-gram draft planner reads it as the slot's history head
         req.ids = ids
@@ -3522,7 +3740,7 @@ class ContinuousEngine:
         )
 
     def _ragged_ingest(self, ids, p0, table_row, key, sampling, presence,
-                       bias):
+                       bias, page=None):
         """Prefill ids[p0:] straight into the pool through the ragged
         launch programs: whole-width extend launches for the body of the
         tail, then ONE width-padded prefill launch that samples the first
@@ -3531,7 +3749,12 @@ class ContinuousEngine:
         analysis ragged rule pins), and a prefix hit's mapped shared head
         is attended in place through the block table — no gather, no
         insert scatter, no bucket ladder. Returns the [1] first-token
-        device array (the admission wave's stacked-fetch contract)."""
+        device array (the admission wave's stacked-fetch contract).
+
+        `page`: the admission's adapter page id (engine/adapters.py) —
+        rides every TARGET launch as the [1] per-row pages operand so
+        prompt KV is computed under the adapter's delta. Draft-model
+        twins stay base-only (draft quality, never correctness)."""
         be = self.backend
         W = self._ragged_width
         tail = ids[p0:]
@@ -3539,12 +3762,17 @@ class ContinuousEngine:
         table1 = jnp.asarray(
             np.asarray(table_row, np.int32)[None, :]
         )  # [1, MB]: this admission's single fleet row
+        pages1 = (
+            jnp.asarray(np.asarray([page or 0], np.int32))
+            if self._adapters is not None else None
+        )
         for c in range(n_full):
             toks, tok_row, tok_pos, meta = self._ragged_launch_args(
                 tail[c * W : (c + 1) * W], p0 + c * W
             )
             self.cache = be.extend_ragged_paged(
-                toks, tok_row, tok_pos, meta, self.cache, table1
+                toks, tok_row, tok_pos, meta, self.cache, table1,
+                pages=pages1,
             )
             if self._draft_mode:
                 # draft-model speculation: the prompt must land in the
@@ -3567,7 +3795,7 @@ class ContinuousEngine:
         first, _, self.cache = be.prefill_ragged_paged(
             toks, tok_row, tok_pos, meta, self.cache, table1,
             jnp.int32(len(rem) - 1), key, sampling,
-            presence=presence, bias=bias,
+            presence=presence, bias=bias, pages=pages1,
         )
         self._m_ragged_launches.labels(phase="prefill").inc()
         if hasattr(be, "ragged_program_count"):
@@ -3716,6 +3944,12 @@ class ContinuousEngine:
                 req.slo, req.ttft or None,
                 max(0.0, elapsed - req.ttft) / (n - 1) if n > 1 else None,
             )
+            # per-tenant twin of the same samples (tenant EWMAs for the
+            # operator's fairness view; no-op for anonymous requests)
+            self._sched.observe_tenant(
+                req.tenant, req.ttft or None,
+                max(0.0, elapsed - req.ttft) / (n - 1) if n > 1 else None,
+            )
         req.result = {
             "prompt": req.prompt,
             "response": response,
@@ -3739,6 +3973,10 @@ class ContinuousEngine:
         }
         if req.slo is not None:
             req.result["slo_class"] = req.slo
+        if req.adapter is not None:
+            req.result["adapter"] = req.adapter
+        if req.tenant is not None:
+            req.result["tenant"] = req.tenant
         if req.salvaged:
             # served across a scheduler restart (continuation prefill)
             req.result["recovered"] = True
@@ -3760,10 +3998,16 @@ class ContinuousEngine:
             # prefix blocks pulled over the KV fabric instead of
             # prefilled: the router scores handoff outcomes off this
             req.result["kv_fabric_blocks"] = req.fabric_blocks
-        if self.fabric_serving and req.ids is not None:
+        if (
+            self.fabric_serving and req.ids is not None
+            and req.adapter is None
+        ):
             # the prompt chain's parent-chained digests (deepest last):
             # the router learns digest->replica residency from these,
-            # and a handoff's phase-2 hint carries the deepest one
+            # and a handoff's phase-2 hint carries the deepest one.
+            # Adapter requests export NONE — their KV was never
+            # shadowed (content keys are base-model-only), so
+            # advertising residency would hand out wrong-model bytes
             ds = chunk_digests(
                 req.ids, self.kv_block_size,
                 max_chunks=len(req.ids) // self.kv_block_size,
@@ -3819,6 +4063,11 @@ class ContinuousEngine:
             if req.slot is not None:
                 self._table[req.slot] = 0
                 self._table_dev = None
+        if self.paged and req.slot is not None:
+            # the slot reverts to the base page; later launches carrying
+            # the frozen row read page 0 (the all-zero delta — inert)
+            self._slot_pages[req.slot] = 0
+        self._release_adapter(req)
         with self._cv:
             if req.slot is not None and self._assignment[req.slot] is req:
                 self._assignment[req.slot] = None

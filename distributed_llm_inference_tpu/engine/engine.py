@@ -191,12 +191,12 @@ class SingleDeviceBackend:
         )
 
     def decode_slots_paged(self, state, pool, table, key, sparams, *,
-                           num_steps):
+                           num_steps, pages=None):
         from . import paged as P
 
         return P.decode_slots_paged(
             self.cfg, self.params, state, pool, table, key, sparams,
-            num_steps=num_steps,
+            num_steps=num_steps, pages=pages,
         )
 
     def fill_scratch_paged(self, pool, table_row):
@@ -230,22 +230,23 @@ class SingleDeviceBackend:
         return self.supports_paged
 
     def extend_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
-                            table):
+                            table, pages=None):
         from . import paged as P
 
         return P.extend_ragged_paged(
             self.cfg, self.params, tokens, tok_row, tok_pos, meta, pool,
-            table,
+            table, pages=pages,
         )
 
     def prefill_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
                              table, sample_at, key, sampling, presence=None,
-                             bias=None):
+                             bias=None, pages=None):
         from . import paged as P
 
         return P.prefill_ragged_paged(
             self.cfg, self.params, tokens, tok_row, tok_pos, meta, pool,
             table, sample_at, key, sampling, presence=presence, bias=bias,
+            pages=pages,
         )
 
     def arm_slot_paged(self, state, sparams, slot, *arm):
@@ -263,14 +264,32 @@ class SingleDeviceBackend:
 
     def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
                           pool, table, state, sparams, key, dec_idx, arm,
-                          spec=None, spec_toks=None, dev=None):
+                          spec=None, spec_toks=None, dev=None, pages=None):
         from . import paged as P
 
         return P.mixed_step_ragged(
             self.cfg, self.params, tokens, tok_row, tok_pos, dec_flag,
             meta, pool, table, state, sparams, key, dec_idx, arm,
-            spec=spec, spec_toks=spec_toks, dev=dev,
+            spec=spec, spec_toks=spec_toks, dev=dev, pages=pages,
         )
+
+    # paged adapter pool (engine/adapters.py): the lora leaves live in
+    # self.params["layers"]; a load is one donation-aliased write per
+    # factor stack with the page id TRACED (no recompile across pages)
+    def write_adapter_page(self, page, updates):
+        from .adapters import _page_write
+
+        layers = dict(self.params["layers"])
+        page = jnp.int32(page)
+        for leaf, (a, b) in updates.items():
+            for suffix, val in (("a", a), ("b", b)):
+                name = f"lora_{leaf}_{suffix}"
+                layers[name] = _page_write(
+                    layers[name], page,
+                    jnp.asarray(val, self.cfg.jnp_dtype),
+                )
+        self.params = dict(self.params)
+        self.params["layers"] = layers
 
     def ragged_program_count(self) -> int:
         """Compiled ragged-ingest program count (jit cache entries of the
@@ -612,12 +631,43 @@ class InferenceEngine:
         )
         self.metrics.gauge(
             "dli_slo_queue_depth",
-            "queued requests per SLO class", ("slo_class",),
+            "queued requests per SLO class and tenant", ("slo_class", "tenant"),
         )
         self.metrics.counter(
             "dli_slo_shed_total",
             "requests shed with 429 by SLO admission control (class drain "
             "estimate over the TTFT target, or queue full)", ("slo_class",),
+        )
+        # multi-tenant adapter-serving families (engine/adapters.py pool +
+        # the continuous engine's per-tenant quota shed): pool residency /
+        # reserved HBM, page traffic, and tenant-level shedding
+        self.metrics.gauge(
+            "dli_adapter_pool_resident",
+            "adapters resident in device pool pages (referenced + LRU)",
+        )
+        self.metrics.gauge(
+            "dli_adapter_pool_bytes",
+            "HBM bytes reserved by the paged adapter leaves (all pages, "
+            "base page included)",
+        )
+        self.metrics.counter(
+            "dli_adapter_loads_total",
+            "adapter page writes into the device pool",
+        )
+        self.metrics.counter(
+            "dli_adapter_evictions_total",
+            "resident adapters dropped from their page (LRU reclaim; "
+            "referenced pages are never evicted)",
+        )
+        self.metrics.counter(
+            "dli_adapter_swaps_total",
+            "page loads that displaced another adapter (evict + write on "
+            "one page)",
+        )
+        self.metrics.counter(
+            "dli_tenant_shed_total",
+            "requests shed with 429 by per-tenant quota control (router "
+            "inflight share or scheduler queue share)", ("tenant",),
         )
         # pp wire-format families (ops/wire_quant.py + the SPMD backends'
         # static per-launch accounting): inter-stage activation bytes per
@@ -639,6 +689,10 @@ class InferenceEngine:
         )
         if hasattr(self.backend, "attach_wire_metrics"):
             self.backend.attach_wire_metrics(self.metrics)
+        # Paged runtime LoRA adapter pool (engine/adapters.AdapterPool) —
+        # wired by create_engine (EngineConfig.adapter_slots > 0) or
+        # adapters.attach_adapter_pool; None = base-only serving.
+        self.adapters = None
         # Reusable KV cache buffer: allocated once, donated to prefill/decode
         # each request and replaced by the returned buffer. Stale contents
         # between requests are harmless — prefill rewrites slots [0, bucket)
@@ -1173,7 +1227,7 @@ class InferenceEngine:
         )
 
     def _prefix_plan(self, prefix, ids: list, capacity: Optional[int] = None,
-                     ragged: bool = False):
+                     ragged: bool = False, adapter: Optional[str] = None):
         """Prefix lookup + ingest planning, ONE copy for every serving
         path: lookup -> plan the tail -> cold fallback when no tail plan
         fits -> mark hit/miss on the PLANNED outcome (a lookup hit that
@@ -1196,12 +1250,22 @@ class InferenceEngine:
         depth is used AS IS — exact-chunk-depth reuse, never degraded.
         The plan is the ("ragged", tail_len) sentinel; only the capacity
         guard can reject (same bound as the cold path, so acceptance
-        stays independent of cache state)."""
+        stays independent of cache state).
+
+        adapter: runtime adapter name for content-keyed planners — the
+        adapter changes the KV bytes, so BlockPrefixIndex keys chains
+        under a per-adapter root and two adapters (or an adapter and the
+        base) never share blocks even for identical prompts. Dense
+        PrefixCache planners don't take it (adapter requests bypass them
+        entirely — they run the paged fleet)."""
         buckets = self._buckets()
         prompt_len = len(ids)
         p0, entry, pkey = 0, None, None
         if prefix is not None:
-            p0, entry, pkey = prefix.lookup(ids)
+            if adapter is not None:
+                p0, entry, pkey = prefix.lookup(ids, adapter=adapter)
+            else:
+                p0, entry, pkey = prefix.lookup(ids)
         if ragged:
             cap = capacity if capacity is not None else self.cfg.max_seq_len
             ok = 1 <= prompt_len <= cap - 2

@@ -465,9 +465,11 @@ def restore_shadow_blocks(pool, blocks, block_ids):
     return _restore_shadow(pool, blocks, block_ids)
 
 
-def _forward_step_paged(cfg, params, tokens, pool, table, pos):
+def _forward_step_paged(cfg, params, tokens, pool, table, pos, pages=None):
     """One decode step through the stack over the paged pool (family-
-    dispatched: gpt2 rides the same hook seam)."""
+    dispatched: gpt2 rides the same hook seam). pages: optional [B] i32
+    adapter-pool page ids (0 = base) — traced, so adapter mixes never
+    recompile."""
     from ..models import api as M
 
     bs = pool["k"].shape[3]
@@ -476,6 +478,7 @@ def _forward_step_paged(cfg, params, tokens, pool, table, pos):
     x, pool = M.forward_layers(
         cfg, params["layers"], x, pool, pos,
         attn_hook=make_paged_hook(table), attn_seq_len=MB * bs,
+        lora_pages=pages,
     )
     logits = M.unembed(cfg, params, x[:, -1:, :])
     return logits[:, 0, :], pool
@@ -494,17 +497,20 @@ def decode_slots_paged(
     sparams: G.SlotParams,
     *,
     num_steps: int,
+    pages=None,
 ):
     """Paged twin of generate.decode_slots: advance every slot num_steps
     tokens over the block pool. Same slot_step, same emitted/emit_mask
     contract — only the cache strategy differs, so cross-mode token parity
     is structural. The table is a plain (traced) input: admission changes
-    it without recompiling."""
+    it without recompiling. pages: optional [B] i32 per-slot adapter
+    pages (0 = base), traced like the table."""
 
     def body(carry, sub):
         state, pool = carry
         logits, pool = _forward_step_paged(
-            cfg, params, state.token[:, None], pool, table, state.pos
+            cfg, params, state.token[:, None], pool, table, state.pos,
+            pages=pages,
         )
         new, emit, can_emit = G.slot_step(cfg, state, sparams, logits, sub)
         return (new, pool), (emit, can_emit)
@@ -788,20 +794,34 @@ def make_ragged_fill_hook(table, meta, tok_row):
     return hook
 
 
+def _token_pages(pages, tok_row):
+    """Per-flat-token adapter pages from a per-row page vector: token w
+    rides pages[tok_row[w]]; launch padding (row -1) rides the base page
+    (0), whose delta is skipped anyway. None passes through — programs
+    without a pages operand lower byte-identically to today's."""
+    if pages is None:
+        return None
+    return jnp.where(
+        tok_row >= 0, pages[jnp.maximum(tok_row, 0)], jnp.int32(0)
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
 def extend_ragged_paged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
-                        meta, pool, table):
+                        meta, pool, table, pages=None):
     """One full ragged launch with no sampling — the chunked-prefill
     extend() twin over the pool. tokens [W] int32 flat launch tokens;
     tok_row/tok_pos [W]; meta [G, 4]; table [R, MB]. The pool is donated
-    (updated in place); the table is read-only."""
+    (updated in place); the table is read-only. pages: optional [R] i32
+    per-table-row adapter pages — each flat token reads its owning row's
+    page; launch padding (row -1) rides the base page."""
     from ..models import api as M
 
     x = M.embed(cfg, params, tokens[:, None], tok_pos)
     _, pool = M.forward_layers(
         cfg, params["layers"], x, pool, tok_pos,
         attn_hook=make_ragged_fill_hook(table, meta, tok_row),
-        attn_seq_len=1,
+        attn_seq_len=1, lora_pages=_token_pages(pages, tok_row),
     )
     return pool
 
@@ -809,7 +829,7 @@ def extend_ragged_paged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
 def prefill_ragged_paged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
                          meta, pool, table, sample_at, key, sampling,
-                         presence=None, bias=None):
+                         presence=None, bias=None, pages=None):
     """Final ragged launch: run the tail chunk, unembed ONE flat position
     (`sample_at` — the entry's last valid token, traced so every tail
     length shares this compiled program) and sample the first token.
@@ -822,7 +842,7 @@ def prefill_ragged_paged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     x, pool = M.forward_layers(
         cfg, params["layers"], x, pool, tok_pos,
         attn_hook=make_ragged_fill_hook(table, meta, tok_row),
-        attn_seq_len=1,
+        attn_seq_len=1, lora_pages=_token_pages(pages, tok_row),
     )
     last = jax.lax.dynamic_slice_in_dim(x, sample_at, 1, axis=0)  # [1, 1, D]
     logits = M.unembed(cfg, params, last)[:, 0, :]
@@ -1100,7 +1120,7 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
                       dec_flag, meta, pool, table, state: G.SlotState,
                       sparams: G.SlotParams, key, dec_idx, arm: MixedArm,
                       spec: Optional[SpecPlan] = None, spec_toks=None,
-                      dev: Optional[DeviceMeta] = None):
+                      dev: Optional[DeviceMeta] = None, pages=None):
     """One scheduler step: advance every active slot one decode token AND
     write the launch's prefill chunks into the pool, in one program.
 
@@ -1121,6 +1141,12 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     without one — their sampled garbage is gated by state.active exactly
     like idle rows in decode_slots_paged). arm: completing-prefill
     operands (MixedArm; all-off most steps).
+
+    pages ([B] i32, optional): per-slot adapter-pool pages (engine/
+    adapters) — every flat token (decode, verify, prefill chunk alike)
+    computes with its owning slot's adapter delta; page 0 = base. A
+    TRACED operand like the table, so one compiled program serves any
+    adapter mix across launches.
 
     spec (SpecPlan, optional): draft-then-verify rows for eligible
     decode slots — each is a [current + draft] prefill-kind row whose
@@ -1159,7 +1185,7 @@ def mixed_step_ragged(cfg: ModelConfig, params, tokens, tok_row, tok_pos,
     x, pool = M.forward_layers(
         cfg, params["layers"], x, pool, pos,
         attn_hook=make_ragged_fill_hook(table, meta, tok_row),
-        attn_seq_len=1,
+        attn_seq_len=1, lora_pages=_token_pages(pages, tok_row),
     )
     # decode: gather each slot's flat position, one shared slot_step —
     # the same sampler/bookkeeping the whole-chunk decode programs run

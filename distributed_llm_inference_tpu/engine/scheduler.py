@@ -29,6 +29,17 @@ that planning:
     per-class EWMAs here). When any decoding class's observed TPOT runs
     over its target, the whole prefill budget is halved for the step
     (decode protection), never below one tile (prefill liveness).
+  * TENANCY: requests additionally carry a `tenant` (the multi-tenant
+    adapter-serving surface, engine/adapters.py). Within each class's
+    tile grant the budget is re-apportioned ACROSS TENANTS by the
+    operator-configured tenant weight (engine_cfg.tenant_weights,
+    default 1.0 — equal shares), FIFO within a tenant, so one tenant's
+    prompt flood cannot monopolise a class's prefill budget. Per-tenant
+    TTFT/TPOT EWMAs (`observe_tenant`) give the operator the same
+    feedback signal per tenant the class loop has per class, and the
+    queue-depth gauge carries a tenant label. The tenant QUOTA shed
+    (429 before other tenants starve) lives at the enqueue edge in
+    engine/continuous.py — this module only supplies the weights.
   * ADMISSION CONTROL: the head-of-queue evictable-block check grew into
     a policy object — a class whose queue drain ESTIMATE (class depth x
     observed per-request service time) already overruns its TTFT target
@@ -241,9 +252,17 @@ class TokenBudgetScheduler:
     """
 
     def __init__(self, classes, default_name: str, width: int, tile: int,
-                 n_slots: int, registry=None):
+                 n_slots: int, registry=None, tenant_weights=()):
         self.classes = classes
         self.default_name = default_name
+        # tenant -> prefill-budget weight (engine_cfg.tenant_weights);
+        # unlisted tenants (and the anonymous "" tenant) weigh 1.0
+        self.tenant_weights = {
+            str(name): float(w) for name, w in tenant_weights
+        }
+        # tenant -> _ClassFeedback, created lazily at first observation
+        # (the tenant population is open-ended, unlike the class set)
+        self.tenant_feedback: dict = {}
         self.tile = int(tile)
         # every active slot's decode row costs one tile, and at least one
         # tile must remain for prefill progress (starvation freedom) —
@@ -279,7 +298,8 @@ class TokenBudgetScheduler:
         if registry is not None:
             self._m_depth = registry.gauge(
                 "dli_slo_queue_depth",
-                "queued requests per SLO class", ("slo_class",),
+                "queued requests per SLO class and tenant",
+                ("slo_class", "tenant"),
             )
             self._m_shed = registry.counter(
                 "dli_slo_shed_total",
@@ -288,9 +308,9 @@ class TokenBudgetScheduler:
                 ("slo_class",),
             )
             for name in classes:
-                # pre-touch every class series so the scrape schema is
-                # stable from the first request
-                self._m_depth.labels(slo_class=name).set(0)
+                # pre-touch every class series (anonymous tenant) so the
+                # scrape schema is stable from the first request
+                self._m_depth.labels(slo_class=name, tenant="").set(0)
 
     # -- classification ------------------------------------------------------
     def classify(self, name: Optional[str]) -> SLOClass:
@@ -312,9 +332,31 @@ class TokenBudgetScheduler:
         if fb is not None:
             fb.observe(ttft_s, tpot_s)
 
-    def set_depth(self, cls_name: str, depth: int):
+    def observe_tenant(self, tenant: Optional[str],
+                       ttft_s: Optional[float], tpot_s: Optional[float]):
+        """Per-tenant twin of `observe`: the same completed-request TTFT
+        / TPOT samples, keyed by the request's tenant. Anonymous
+        requests (no tenant) record nothing — their feedback already
+        lands in the class EWMAs."""
+        if not tenant:
+            return
+        fb = self.tenant_feedback.get(tenant)
+        if fb is None:
+            fb = self.tenant_feedback[tenant] = _ClassFeedback()
+        fb.observe(ttft_s, tpot_s)
+
+    def tenant_weight(self, tenant: Optional[str]) -> float:
+        """Configured prefill-budget weight for `tenant` (1.0 when the
+        tenant is anonymous or unlisted in engine_cfg.tenant_weights)."""
+        if not tenant:
+            return 1.0
+        return self.tenant_weights.get(tenant, 1.0)
+
+    def set_depth(self, cls_name: str, depth: int, tenant: str = ""):
         if self._m_depth is not None:
-            self._m_depth.labels(slo_class=cls_name).set(depth)
+            self._m_depth.labels(
+                slo_class=cls_name, tenant=tenant or ""
+            ).set(depth)
 
     def count_shed(self, cls_name: str):
         if self._m_shed is not None:
@@ -477,6 +519,52 @@ class TokenBudgetScheduler:
                 return k
         return 0
 
+    def _grant_class(self, members, tiles: int, give) -> int:
+        """Distribute one class's tile grant across its TENANTS by
+        configured weight (FIFO within a tenant), returning the unspent
+        remainder. A single-tenant class degenerates to plain FIFO — the
+        pre-tenancy behavior, byte-for-byte. Unused tenant shares spill
+        FIFO within the class before leaking up to the cross-class
+        spill, so a light tenant's share is never wasted while a heavy
+        one still has work."""
+        if tiles <= 0:
+            return 0
+        by_tenant: dict = collections.OrderedDict()
+        for job in members:
+            t = getattr(job.req, "tenant", None) or ""
+            by_tenant.setdefault(t, []).append(job)
+        if len(by_tenant) == 1:
+            for job in members:
+                tiles -= give(job, tiles)
+                if tiles <= 0:
+                    break
+            return max(0, tiles)
+        weights = {t: self.tenant_weight(t) for t in by_tenant}
+        total = sum(weights.values())
+        shares = {t: int(tiles * w / total) for t, w in weights.items()}
+        spare = tiles - sum(shares.values())
+        # remainder tiles to the heaviest tenants (stable sort keeps
+        # arrival order among equal weights — deterministic)
+        for t in sorted(weights, key=lambda n: -weights[n]):
+            if spare <= 0:
+                break
+            shares[t] += 1
+            spare -= 1
+        leftover = 0
+        for t, tjobs in by_tenant.items():
+            share = shares.get(t, 0)
+            for job in tjobs:
+                share -= give(job, share)
+                if share <= 0:
+                    break
+            leftover += max(0, share)
+        if leftover > 0:
+            for job in members:
+                leftover -= give(job, leftover)
+                if leftover <= 0:
+                    break
+        return max(0, leftover)
+
     def plan(self, n_decode_tiles: int, jobs: list,
              active_classes=(), now: Optional[float] = None) -> list:
         """Slice one step's budget: returns [(job, chunk_tokens)] with
@@ -487,10 +575,12 @@ class TokenBudgetScheduler:
         speculative verify row, so speculated tokens debit the budget
         exactly like prefill tokens; `jobs` are the pending prefills in
         arrival order. Tiles left after decode are apportioned across
-        classes by weight x urgency, distributed FIFO within a class;
-        leftovers spill FIFO across classes; the OLDEST job is
-        guaranteed a tile (starvation freedom). Under decode TPOT
-        pressure the prefill budget halves (never below one tile)."""
+        classes by weight x urgency, then WITHIN each class across
+        tenants by configured tenant weight (`_grant_class`), FIFO
+        within a tenant; leftovers spill FIFO across classes; the
+        OLDEST job is guaranteed a tile (starvation freedom). Under
+        decode TPOT pressure the prefill budget halves (never below one
+        tile)."""
         if not jobs:
             return []
         t = time.time() if now is None else now
@@ -536,12 +626,9 @@ class TokenBudgetScheduler:
 
         leftover = 0
         for name, members in by_class.items():
-            tiles = tiles_for.get(name, 0)
-            for job in members:
-                tiles -= give(job, tiles)
-                if tiles <= 0:
-                    break
-            leftover += max(0, tiles)
+            leftover += self._grant_class(
+                members, tiles_for.get(name, 0), give
+            )
         # spill unused class budget FIFO across every class
         if leftover > 0:
             for job in jobs:

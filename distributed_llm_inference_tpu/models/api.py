@@ -32,18 +32,28 @@ def embed(cfg, params, tokens, pos=0):
 
 def forward_layers(cfg, layers, x, cache, pos, update_gate=None, tp_axis=None,
                    attn_hook=None, valid_start=None, ep_axis=None,
-                   attn_seq_len=None):
+                   attn_seq_len=None, lora_pages=None):
     # Both families expose the same seams now: attn_hook (the shared
     # attention/cache strategy hook — parallel/context.py, the paged
     # pool), attn_seq_len (paged logical window). valid_start (ragged
-    # left-padding) and ep_axis (MoE) stay llama-only — gpt2's
-    # forward_layers rejects them loudly (learned absolute positions are
-    # not shift-invariant; no MoE blocks).
+    # left-padding), ep_axis (MoE) and lora_pages (paged adapter delta)
+    # stay llama-only — gpt2's forward_layers rejects them loudly
+    # (learned absolute positions are not shift-invariant; no MoE
+    # blocks; no lora leaves).
+    if lora_pages is not None and cfg.arch != "llama":
+        raise ValueError(
+            f"lora_pages (runtime adapters) requires the llama family; "
+            f"got {cfg.arch!r}"
+        )
     if (attn_hook is not None or valid_start is not None
-            or ep_axis is not None or attn_seq_len is not None):
+            or ep_axis is not None or attn_seq_len is not None
+            or lora_pages is not None):
+        # gpt2.forward_layers has no lora_pages parameter; only thread
+        # it when set (guaranteed llama by the check above)
+        extra = {} if lora_pages is None else {"lora_pages": lora_pages}
         return family(cfg).forward_layers(
             cfg, layers, x, cache, pos, update_gate, tp_axis, attn_hook,
-            valid_start, ep_axis, attn_seq_len=attn_seq_len,
+            valid_start, ep_axis, attn_seq_len=attn_seq_len, **extra,
         )
     return family(cfg).forward_layers(cfg, layers, x, cache, pos, update_gate,
                                       tp_axis)

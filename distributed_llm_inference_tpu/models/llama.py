@@ -319,6 +319,7 @@ def decoder_layer(
     attn_hook=None,
     valid_start: Optional[jnp.ndarray] = None,
     ep_axis: Optional[str] = None,
+    lora_pages: Optional[jnp.ndarray] = None,
 ):
     """One pre-norm decoder block on a chunk x [B,T,D] at offset `pos`.
 
@@ -335,6 +336,16 @@ def decoder_layer(
     `tp_axis` names the axis — head counts are derived from the local param
     shapes, and the two row-sharded projections psum their partial outputs
     before the residual add, keeping activations replicated over tp.
+
+    lora_pages: optional [B] int32 adapter-pool page ids (engine/
+    adapters.AdapterPool), TRACED — one compiled program serves any
+    adapter mix. When lp carries paged lora_{leaf}_{a,b} leaves, every
+    projection adds its per-row low-rank delta (x @ a[page]) @ b[page]
+    via a traced gather + batched matmul. Page 0 is the reserved base
+    page: its rows SELECT the undisturbed base product (jnp.where, not
+    +0.0 — IEEE -0.0 + 0.0 would break bit-identity with the no-adapter
+    program). Deltas apply BEFORE the tp psums: a/b shard so the partial
+    products sum correctly by linearity (parallel/partition.py).
     """
     B, T, D = x.shape
     Dh = cfg.head_dim  # invariant under tp (heads shard, head_dim doesn't)
@@ -350,10 +361,23 @@ def decoder_layer(
 
     # OLMo-2 (pre_norms=False): the sublayer reads x raw, its OUTPUT is
     # normed before the residual (post_norms carries those weights)
+    def lmm(hh, leaf):
+        # mm: plain array or int8 QTensor (ops/quant.py) transparently;
+        # paged LoRA delta rides on top when the leaves are installed
+        out = mm(hh, lp[leaf])
+        a = lp.get(f"lora_{leaf}_a")
+        if lora_pages is None or a is None:
+            return out
+        b = lp[f"lora_{leaf}_b"]
+        u = jnp.einsum("bti,bir->btr", hh, a[lora_pages])
+        d = jnp.einsum("btr,bro->bto", u, b[lora_pages])
+        return jnp.where(
+            (lora_pages > 0)[:, None, None], out + d.astype(out.dtype), out
+        )
+
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps, unit_offset=uo) \
         if cfg.pre_norms else x
-    # mm: plain array or int8 QTensor (ops/quant.py) transparently
-    q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
+    q, k, v = lmm(h, "wq"), lmm(h, "wk"), lmm(h, "wv")
     if cfg.attn_qkv_bias:  # Qwen2-style (biases tp-shard with their columns)
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     if cfg.use_qk_norm and cfg.qk_norm_dim == "proj":
@@ -384,7 +408,7 @@ def decoder_layer(
         cfg, q, k, v, cache_k, cache_v, pos, mask, update_gate, valid_start,
         lp.get("window_flag"),
     )
-    attn_out = mm(attn.reshape(B, T, H * Dh), lp["wo"])
+    attn_out = lmm(attn.reshape(B, T, H * Dh), "wo")
     if tp_axis is not None:
         attn_out = jax.lax.psum(attn_out, tp_axis)
     if cfg.post_norms:  # Gemma-2: norm the branch output before the residual
@@ -399,8 +423,8 @@ def decoder_layer(
         mlp_out = moe_ffn(cfg, lp, h, ep_axis)  # psums over ep internally
     else:
         act = jax.nn.silu if cfg.act == "silu" else _gelu_tanh
-        gate = act(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-        mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
+        gate = act(lmm(h, "w_gate").astype(jnp.float32)).astype(h.dtype)
+        mlp_out = lmm(gate * lmm(h, "w_up"), "w_down")
         if tp_axis is not None:
             mlp_out = jax.lax.psum(mlp_out, tp_axis)
     if cfg.post_norms:
@@ -428,6 +452,7 @@ def forward_layers(
     valid_start: Optional[jnp.ndarray] = None,
     ep_axis: Optional[str] = None,
     attn_seq_len: Optional[int] = None,
+    lora_pages: Optional[jnp.ndarray] = None,
 ):
     """Scan the stacked layer params over a chunk. Works for any contiguous
     slice of layers (full model or one pipeline stage's slice).
@@ -490,7 +515,7 @@ def forward_layers(
         lp, ck, cv = xs
         xc, ck, cv = decoder_layer(
             cfg, lp, xc, ck, cv, pos, cos, sin, mask, update_gate, tp_axis,
-            attn_hook, valid_start, ep_axis,
+            attn_hook, valid_start, ep_axis, lora_pages,
         )
         return xc, (ck, cv)
 

@@ -6,13 +6,23 @@ merging the low-rank deltas into the stacked weights ONCE at load:
 
     W' = W + (lora_alpha / r) * B @ A          (per layer, per module)
 
-Merging (rather than keeping A/B live at runtime) is the TPU-friendly
-serving shape here: decode is HBM-bound on the DENSE weight bytes either
-way, a merged checkpoint runs every existing program (quantization,
-pipeline sharding, speculation) unchanged, and there is no per-step
-low-rank matmul overhead. Multi-adapter hot-swap batching is a possible
-later extension; the reference has no adapter story at all (full
-fine-tuned checkpoints only, /root/reference/Worker1.py:60).
+Merge-at-load is the SINGLE-ADAPTER fast path: decode is HBM-bound on
+the DENSE weight bytes either way, a merged checkpoint runs every
+existing program (quantization, pipeline sharding, speculation)
+unchanged, and there is no per-step low-rank matmul overhead. Use it
+when one deployment serves one fine-tune.
+
+Multi-adapter serving keeps A/B live instead: load_lora_stacked() below
+reads the same PEFT directory into per-layer stacked A/B tensors
+(rank-padded, scale folded into B) that engine/adapters.AdapterPool
+writes into a paged slot of the resident base model's lora_* leaves —
+many adapters share one base without merging, selected per-row inside
+the batched launches (models/llama.decoder_layer's lora_pages gather).
+The two paths are numerically the token-identical under greedy decode
+(the fp32 delta math is shared); bit-level identity holds for rows with
+adapter page 0, which skip the delta entirely. The reference has no
+adapter story at all (full fine-tuned checkpoints only,
+/root/reference/Worker1.py:60).
 
 PEFT tensor naming (peft >= 0.5 `save_pretrained`):
     base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight  [r, in]
@@ -65,26 +75,13 @@ def load_lora_adapter(path: str) -> tuple[dict, dict]:
     return acfg, load_safetensors_file(tensor_path)
 
 
-def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
-    """Merge a PEFT LoRA adapter into converted stacked params.
-
-    Runs BEFORE quantization/sharding (the merged dense weights then flow
-    through every existing path). Raises on adapters that target modules
-    this layout doesn't carry, on rank/shape mismatches, and on already-
-    quantized params (merge order matters: quantizing first would merge
-    into nothing).
-    """
-    from ..ops.quant import Q4Tensor, QTensor
-
-    if cfg.arch != "llama":
-        raise ValueError(
-            f"LoRA merging is wired for the llama family; got {cfg.arch!r}"
-        )
-    acfg, tensors = load_lora_adapter(adapter_path)
+def _check_adapter_cfg(acfg: dict) -> tuple[int, float]:
+    """(rank, merge scale) after rejecting every PEFT variant that
+    changes the delta MATH (not just naming) — a silently-wrong adapter
+    is the worst failure mode a weights loader can have. Shared by the
+    merge-at-load and runtime-stacked loaders so both paths accept and
+    reject the exact same adapter population."""
     r = int(acfg["r"])
-    # PEFT variants that change the merge MATH (not just naming) must be
-    # rejected, not approximated — a silently-wrong merged model is the
-    # worst failure mode a weights loader can have
     if acfg.get("use_dora"):
         raise ValueError(
             "DoRA adapters (use_dora=true) are not supported: the "
@@ -114,6 +111,28 @@ def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
         scale = float(acfg.get("lora_alpha", r)) / (r ** 0.5)
     else:
         scale = float(acfg.get("lora_alpha", r)) / r
+    return r, scale
+
+
+def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
+    """Merge a PEFT LoRA adapter into converted stacked params — the
+    single-adapter fast path (see the module docstring; runtime
+    multi-adapter serving goes through load_lora_stacked instead).
+
+    Runs BEFORE quantization/sharding (the merged dense weights then flow
+    through every existing path). Raises on adapters that target modules
+    this layout doesn't carry, on rank/shape mismatches, and on already-
+    quantized params (merge order matters: quantizing first would merge
+    into nothing).
+    """
+    from ..ops.quant import Q4Tensor, QTensor
+
+    if cfg.arch != "llama":
+        raise ValueError(
+            f"LoRA merging is wired for the llama family; got {cfg.arch!r}"
+        )
+    acfg, tensors = load_lora_adapter(adapter_path)
+    r, scale = _check_adapter_cfg(acfg)
     L = cfg.n_layers
 
     layers = dict(params["layers"])
@@ -201,4 +220,100 @@ def merge_lora(cfg: ModelConfig, params: dict, adapter_path: str) -> dict:
     )
     out = dict(params)
     out["layers"] = layers
+    return out
+
+
+def load_lora_stacked(cfg: ModelConfig, adapter_path: str,
+                      max_rank: int) -> dict:
+    """Read a PEFT adapter into RUNTIME stacked host tensors:
+    {leaf: (a, b)} with a = A^T stacked [L, in, max_rank] and
+    b = scale * B^T stacked [L, max_rank, out] (np.float32; the pool
+    writes them in the model dtype). Rank-padding with zeros makes every
+    adapter the pool's uniform rank so one compiled program serves any
+    mix — padded rank columns contribute exactly 0 to the delta. The
+    merge scale folds into b, so the traced delta is just
+    (x @ a) @ b == scale * x @ A^T @ B^T, matching merge_lora's
+    W' = W + scale * (B @ A) transposed into the stacked W.T layout.
+
+    Accepts/rejects the exact same adapter population as merge_lora
+    (shared _check_adapter_cfg + the same unknown-tensor sweep), plus a
+    pool-specific rank bound: adapters above max_rank cannot ride the
+    uniform batched delta and are rejected at load.
+    """
+    if cfg.arch != "llama":
+        raise ValueError(
+            f"LoRA adapters are wired for the llama family; got {cfg.arch!r}"
+        )
+    acfg, tensors = load_lora_adapter(adapter_path)
+    r, scale = _check_adapter_cfg(acfg)
+    if r > max_rank:
+        raise ValueError(
+            f"adapter rank {r} exceeds the adapter pool rank {max_rank} "
+            f"(EngineConfig.adapter_rank) — raise the pool rank or use "
+            f"merge-at-load (--lora) for this adapter"
+        )
+    L = cfg.n_layers
+    prefixes = (
+        "base_model.model.model.layers.{}.self_attn.{}",
+        "base_model.model.model.layers.{}.mlp.{}",
+    )
+    out: dict = {}
+    loaded_modules = set()
+    for module, leaf in _MODULE_TO_LEAF.items():
+        a_name = b_name = None
+        for pref in prefixes:
+            if any(
+                pref.format(i, module) + ".lora_A.weight" in tensors
+                for i in range(L)
+            ):
+                a_name = pref + ".lora_A.weight"
+                b_name = pref + ".lora_B.weight"
+                break
+        if a_name is None:
+            continue
+        a_stack, b_stack = [], []
+        for i in range(L):
+            a = tensors.get(a_name.format(i, module))
+            b = tensors.get(b_name.format(i, module))
+            if a is None or b is None:
+                raise ValueError(
+                    f"adapter is missing {module} lora_A/lora_B for layer "
+                    f"{i} (partial-layer adapters are not supported)"
+                )
+            if a.shape[0] != r or b.shape[1] != r:
+                raise ValueError(
+                    f"layer {i} {module}: rank mismatch (adapter_config r="
+                    f"{r}, tensors {a.shape} / {b.shape})"
+                )
+            # stacked leaves hold W.T [in, out]: A [r, in] -> a = A.T
+            # [in, r]; B [out, r] -> b = scale * B.T [r, out]
+            a_p = np.zeros((a.shape[1], max_rank), np.float32)
+            a_p[:, :r] = a.astype(np.float32).T
+            b_p = np.zeros((max_rank, b.shape[0]), np.float32)
+            b_p[:r, :] = scale * b.astype(np.float32).T
+            a_stack.append(a_p)
+            b_stack.append(b_p)
+        out[leaf] = (np.stack(a_stack, axis=0), np.stack(b_stack, axis=0))
+        loaded_modules.add(module)
+    if not loaded_modules:
+        raise ValueError(
+            f"adapter at {adapter_path} targets none of the supported "
+            f"modules {sorted(_MODULE_TO_LEAF)}"
+        )
+    unknown = {
+        n for n in tensors
+        if not any(
+            f".{m}.lora_A." in n or f".{m}.lora_B." in n
+            for m in loaded_modules
+        )
+    }
+    if unknown:
+        raise ValueError(
+            f"adapter has tensors the runtime loader would silently drop, "
+            f"e.g. {sorted(unknown)[:3]}"
+        )
+    log.info(
+        "lora_stacked_loaded", adapter=adapter_path, r=r, scale=scale,
+        pool_rank=max_rank, modules=sorted(loaded_modules),
+    )
     return out

@@ -52,6 +52,29 @@ _LLAMA_LAYER_SPECS = {
     "w_gate": P(AXIS_PP, None, AXIS_TP),
     "w_up": P(AXIS_PP, None, AXIS_TP),
     "w_down": P(AXIS_PP, AXIS_TP, None),
+    # Paged LoRA adapter leaves [L, P(ages), in, r] / [L, P, r, out]
+    # (engine/adapters.py): the delta (h @ a) @ b is added BEFORE each
+    # base projection's psum, so the factors shard to make the partial
+    # products sum by linearity. Column-sharded bases (wq/wk/wv/
+    # w_gate/w_up): a replicates (h is replicated, the rank dim is
+    # tiny), b shards its OUT dim with the base columns. Row-sharded
+    # bases (wo/w_down): a shards its IN dim with the base rows (h
+    # arrives input-sharded), b replicates — each tp shard contributes
+    # (h_s @ a_s) @ b and the existing psum completes the contraction.
+    "lora_wq_a": P(AXIS_PP, None, None, None),
+    "lora_wq_b": P(AXIS_PP, None, None, AXIS_TP),
+    "lora_wk_a": P(AXIS_PP, None, None, None),
+    "lora_wk_b": P(AXIS_PP, None, None, AXIS_TP),
+    "lora_wv_a": P(AXIS_PP, None, None, None),
+    "lora_wv_b": P(AXIS_PP, None, None, AXIS_TP),
+    "lora_wo_a": P(AXIS_PP, None, AXIS_TP, None),
+    "lora_wo_b": P(AXIS_PP, None, None, None),
+    "lora_w_gate_a": P(AXIS_PP, None, None, None),
+    "lora_w_gate_b": P(AXIS_PP, None, None, AXIS_TP),
+    "lora_w_up_a": P(AXIS_PP, None, None, None),
+    "lora_w_up_b": P(AXIS_PP, None, None, AXIS_TP),
+    "lora_w_down_a": P(AXIS_PP, None, AXIS_TP, None),
+    "lora_w_down_b": P(AXIS_PP, None, None, None),
 }
 
 _GPT2_LAYER_SPECS = {

@@ -266,12 +266,16 @@ class SPMDBackendBase:
     # degenerates exactly (singleton-axis ppermute is a no-op and the
     # gate is always True) -------------------------------------------------
     def _microstep_loop(self, layers, x, cache, pos, valid_start=None,
-                        attn_hook=None, attn_seq_len=None):
+                        attn_hook=None, attn_seq_len=None, lora_pages=None):
         """S microsteps of (apply local stage, ring-shift). Returns the
         final-stage output (landed on stage 0 by the last shift) + cache.
         attn_hook/attn_seq_len thread the paged-pool seam (cache = block
         pool, hook = engine/paged.make_paged_hook) through the same gated
-        ring — one loop for the dense and paged cache strategies."""
+        ring — one loop for the dense and paged cache strategies.
+        lora_pages threads the paged-adapter delta (engine/adapters) —
+        replicated per-row page ids; the lora leaves shard with their
+        base projections (parallel/partition.py) so each stage computes
+        its local delta shard."""
         cfg, S = self.cfg, self.pp
         s = jax.lax.axis_index(AXIS_PP)
         perm = _ring_perm(S)
@@ -283,7 +287,7 @@ class SPMDBackendBase:
                 cfg, layers, buf, cache, pos, update_gate=gate,
                 tp_axis=self.tp_axis, valid_start=valid_start,
                 ep_axis=self.ep_axis, attn_hook=attn_hook,
-                attn_seq_len=attn_seq_len,
+                attn_seq_len=attn_seq_len, lora_pages=lora_pages,
             )
             # the inter-stage hand-off: int8 data + fp32 per-token-row
             # scales on the wire when pp_wire_quant is on (quant=False
@@ -716,13 +720,17 @@ class PipelineBackend(SPMDBackendBase):
         return jax.jit(shmapped, donate_argnums=(0,))
 
     def decode_slots_paged(self, state, pool, table, key, sparams, *,
-                           num_steps):
-        fn = self._programs.get(("slots_paged", num_steps))
+                           num_steps, pages=None):
+        mkey = ("slots_paged", num_steps, pages is not None)
+        fn = self._programs.get(mkey)
         if fn is None:
-            fn = self._build_decode_slots_paged(num_steps)
-            self._programs[("slots_paged", num_steps)] = fn
+            fn = self._build_decode_slots_paged(num_steps, pages is not None)
+            self._programs[mkey] = fn
         self._account_slots_wire(int(state.token.shape[0]), num_steps)
-        return fn(self.shared, self.layers, state, pool, table, key, sparams)
+        args = [self.shared, self.layers, state, pool, table, key, sparams]
+        if pages is not None:
+            args.append(pages)
+        return fn(*args)
 
     def fill_scratch_paged(self, pool, table_row):
         fn = self._programs.get("fill_paged")
@@ -817,54 +825,64 @@ class PipelineBackend(SPMDBackendBase):
         return self.supports_paged
 
     def extend_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
-                            table):
-        fn = self._programs.get("extend_ragged_paged")
+                            table, pages=None):
+        mkey = ("extend_ragged_paged", pages is not None)
+        fn = self._programs.get(mkey)
         if fn is None:
-            fn = self._build_extend_ragged_paged()
-            self._programs["extend_ragged_paged"] = fn
+            fn = self._build_extend_ragged_paged(pages is not None)
+            self._programs[mkey] = fn
         self._wire_account(
             "microstep", (int(tokens.shape[0]), 1, self.cfg.dim), self.pp
         )
-        return fn(self.shared, self.layers, tokens, tok_row, tok_pos, meta,
-                  pool, table)
+        args = [self.shared, self.layers, tokens, tok_row, tok_pos, meta,
+                pool, table]
+        if pages is not None:
+            args.append(pages)
+        return fn(*args)
 
-    def _build_extend_ragged_paged(self):
+    def _build_extend_ragged_paged(self, with_pages: bool = False):
         """shard_map twin of engine/paged.extend_ragged_paged: each of the
         S ring microsteps runs the local layer shard over the flat token
         fleet with the ragged fill hook; the pool is donated (updated in
-        place), the table/metadata replicate."""
+        place), the table/metadata/adapter pages replicate."""
         cfg = self.cfg
         from ..engine import paged as EP
         from .partition import pool_spec
 
         def body(shared, layers, tokens, tok_row, tok_pos, meta, pool,
-                 table):
+                 table, *extra):
+            pages = extra[0] if with_pages else None
             hook = EP.make_ragged_fill_hook(table, meta, tok_row)
             x = embed_sharded(cfg, shared, tokens[:, None], tok_pos, self.pp)
             _, pool = self._microstep_loop(
-                layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1
+                layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1,
+                lora_pages=EP._token_pages(pages, tok_row),
             )
             return pool
 
+        specs = [
+            self._shared_specs, self._layer_specs, P(), P(), P(), P(),
+            pool_spec(cfg), P(),
+        ]
+        if with_pages:
+            specs.append(P())
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, P(), P(), P(), P(),
-                pool_spec(cfg), P(),
-            ),
+            in_specs=tuple(specs),
             out_specs=pool_spec(cfg),
         )
         return jax.jit(shmapped, donate_argnums=(6,))
 
     def prefill_ragged_paged(self, tokens, tok_row, tok_pos, meta, pool,
                              table, sample_at, key, sampling, presence=None,
-                             bias=None):
+                             bias=None, pages=None):
         pres = presence is not None
         wb = bias is not None
-        mkey = ("prefill_ragged_paged", pres, wb)
+        wp = pages is not None
+        mkey = ("prefill_ragged_paged", pres, wb, wp)
         fn = self._programs.get(mkey)
         if fn is None:
-            fn = self._build_prefill_ragged_paged(pres, wb)
+            fn = self._build_prefill_ragged_paged(pres, wb, wp)
             self._programs[mkey] = fn
         args = [self.shared, self.layers, tokens, tok_row, tok_pos, meta,
                 pool, table, sample_at, key, sampling]
@@ -872,13 +890,16 @@ class PipelineBackend(SPMDBackendBase):
             args.append(presence)
         if wb:
             args.append(bias)
+        if wp:
+            args.append(pages)
         D = self.cfg.dim
         self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
         self._wire_account("broadcast", (1, 1, D), 1)
         return fn(*args)
 
     def _build_prefill_ragged_paged(self, with_presence: bool,
-                                    with_bias: bool):
+                                    with_bias: bool,
+                                    with_pages: bool = False):
         """Final ragged launch on the ring: after the microstep loop the
         real final-stage output sits on stage 0; the sampled flat position
         is sliced there, psum-broadcast, and unembedded through the vocab
@@ -891,18 +912,22 @@ class PipelineBackend(SPMDBackendBase):
         def body(shared, layers, tokens, tok_row, tok_pos, meta, pool,
                  table, sample_at, key, sampling, *extra):
             i = 0
-            presence = bias = None
+            presence = bias = pages = None
             if with_presence:
                 presence = extra[i]
                 i += 1
             if with_bias:
                 bias = extra[i]
                 i += 1
+            if with_pages:
+                pages = extra[i]
+                i += 1
             hook = EP.make_ragged_fill_hook(table, meta, tok_row)
             s = jax.lax.axis_index(AXIS_PP)
             x = embed_sharded(cfg, shared, tokens[:, None], tok_pos, S)
             buf, pool = self._microstep_loop(
-                layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1
+                layers, x, pool, tok_pos, attn_hook=hook, attn_seq_len=1,
+                lora_pages=EP._token_pages(pages, tok_row),
             )
             last = jax.lax.dynamic_slice_in_dim(buf, sample_at, 1, axis=0)
             last = self._bcast(last, s == 0)  # [1, 1, D]
@@ -920,6 +945,8 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(P())
         if with_bias:
             specs.append(P())
+        if with_pages:
+            specs.append(P())
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
@@ -934,14 +961,56 @@ class PipelineBackend(SPMDBackendBase):
 
         return EP.arm_slot_only(self.cfg, state, sparams, slot, *arm)
 
+    # -- paged adapter pool writes (engine/adapters.AdapterPool seam) --------
+    def write_adapter_page(self, page, updates):
+        """shard_map twin of the single-device adapter page write: each
+        host [L, ...] factor stack is padded/reordered to the ring's
+        padded layer layout (partition.pad_stacked_layers — uneven pp
+        splits put each stage's padding at its own tail), sharded like
+        its buffer (parallel/partition.py lora specs), and written into
+        `page` of the donated lora leaves. `page` is traced — loading
+        into any page runs ONE compiled program per leaf set."""
+        from .partition import pad_stacked_layers
+
+        host = {}
+        for leaf, (a, b) in updates.items():
+            host[f"lora_{leaf}_a"] = jnp.asarray(a, self.cfg.jnp_dtype)
+            host[f"lora_{leaf}_b"] = jnp.asarray(b, self.cfg.jnp_dtype)
+        vals = pad_stacked_layers(self.cfg, host, self.pp)
+        names = tuple(sorted(vals))
+        mkey = ("adapter_write", names)
+        fn = self._programs.get(mkey)
+        if fn is None:
+            fn = self._build_adapter_write(names)
+            self._programs[mkey] = fn
+        new = fn(
+            {n: self.layers[n] for n in names}, jnp.int32(page), vals
+        )
+        self.layers.update(new)
+
+    def _build_adapter_write(self, names):
+        bspecs = {n: self._layer_specs[n] for n in names}
+        vspecs = {
+            n: P(*((tuple(s)[:1]) + tuple(s)[2:]))
+            for n, s in bspecs.items()
+        }
+
+        def body(bufs, page, vals):
+            return {n: bufs[n].at[:, page].set(vals[n]) for n in bufs}
+
+        shmapped = self._shard(
+            body, in_specs=(bspecs, P(), vspecs), out_specs=bspecs,
+        )
+        return jax.jit(shmapped, donate_argnums=(0,))
+
     def ragged_program_count(self) -> int:
         """Compiled ragged-ingest programs resident on this backend (the
         dli_ragged_compiled_programs gauge: flat after warmup = no
         per-tail recompile)."""
         return sum(
             1 for k in self._programs
-            if (isinstance(k, str) and k == "extend_ragged_paged")
-            or (isinstance(k, tuple) and k and k[0] == "prefill_ragged_paged")
+            if isinstance(k, tuple) and k
+            and k[0] in ("extend_ragged_paged", "prefill_ragged_paged")
         )
 
     # -- mixed scheduler step on the pp ring (engine/scheduler.py) -----------
@@ -954,13 +1023,14 @@ class PipelineBackend(SPMDBackendBase):
 
     def mixed_step_ragged(self, tokens, tok_row, tok_pos, dec_flag, meta,
                           pool, table, state, sparams, key, dec_idx, arm,
-                          spec=None, spec_toks=None, dev=None):
+                          spec=None, spec_toks=None, dev=None, pages=None):
         mkey = ("mixed_step_ragged", spec is not None,
-                spec_toks is not None, dev is not None)
+                spec_toks is not None, dev is not None, pages is not None)
         fn = self._programs.get(mkey)
         if fn is None:
             fn = self._build_mixed_step_ragged(
-                spec is not None, spec_toks is not None, dev is not None
+                spec is not None, spec_toks is not None, dev is not None,
+                pages is not None,
             )
             self._programs[mkey] = fn
         args = [self.shared, self.layers, tokens, tok_row, tok_pos,
@@ -972,6 +1042,8 @@ class PipelineBackend(SPMDBackendBase):
             args.append(spec_toks)
         if dev is not None:
             args.append(dev)
+        if pages is not None:
+            args.append(pages)
         D = self.cfg.dim
         self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
         # two replicated-logits gathers (decode rows + arm positions),
@@ -982,7 +1054,8 @@ class PipelineBackend(SPMDBackendBase):
 
     def _build_mixed_step_ragged(self, with_spec: bool = False,
                                  with_spec_toks: bool = False,
-                                 with_dev: bool = False):
+                                 with_dev: bool = False,
+                                 with_pages: bool = False):
         """shard_map twin of engine/paged.mixed_step_ragged: the flat
         token fleet (decode rows gathered from the replicated slot state,
         prefill chunks from the host plan) runs the S ring microsteps
@@ -1008,7 +1081,7 @@ class PipelineBackend(SPMDBackendBase):
 
         def body(shared, layers, tokens, tok_row, tok_pos, dec_flag, meta,
                  pool, table, state, sparams, key, dec_idx, arm, *extra):
-            spec = spec_toks = dev = None
+            spec = spec_toks = dev = pages = None
             i = 0
             if with_spec:
                 spec = extra[i]
@@ -1018,6 +1091,9 @@ class PipelineBackend(SPMDBackendBase):
                 i += 1
             if with_dev:
                 dev = extra[i]
+                i += 1
+            if with_pages:
+                pages = extra[i]
             if dev is not None:
                 meta, tok_pos = EP.apply_device_meta(
                     meta, tok_row, tok_pos, dev, state.pos
@@ -1041,7 +1117,8 @@ class PipelineBackend(SPMDBackendBase):
             pos = jnp.where(dec_flag, state.pos[rows_ix], tok_pos)
             x = embed_sharded(cfg, shared, toks[:, None], pos, S)
             buf, pool = self._microstep_loop(
-                layers, x, pool, pos, attn_hook=hook, attn_seq_len=1
+                layers, x, pool, pos, attn_hook=hook, attn_seq_len=1,
+                lora_pages=EP._token_pages(pages, tok_row),
             )
 
             def replicated_logits(idx):
@@ -1079,6 +1156,8 @@ class PipelineBackend(SPMDBackendBase):
             specs.append(P())
         if with_dev:
             specs.append(EP.DeviceMeta(P(), P(), P(), P()))
+        if with_pages:
+            specs.append(P())
         shmapped = self._shard(
             body,
             in_specs=tuple(specs),
@@ -1086,7 +1165,8 @@ class PipelineBackend(SPMDBackendBase):
         )
         return jax.jit(shmapped, donate_argnums=(7,))
 
-    def _build_decode_slots_paged(self, num_steps: int):
+    def _build_decode_slots_paged(self, num_steps: int,
+                                  with_pages: bool = False):
         """Paged twin of _build_decode_slots: each of the S ring
         microsteps runs the local layer shard over the slot fleet with the
         paged attn_hook (engine/paged.make_paged_hook); pool writes are
@@ -1098,7 +1178,8 @@ class PipelineBackend(SPMDBackendBase):
         from ..engine.generate import SlotParams, SlotState, slot_step
         from .partition import pool_spec
 
-        def body(shared, layers, state, pool, table, key, sparams):
+        def body(shared, layers, state, pool, table, key, sparams, *extra):
+            pages = extra[0] if with_pages else None
             hook = EP.make_paged_hook(table)
             bs = pool["k"].shape[3]
             MB = table.shape[1]
@@ -1111,7 +1192,7 @@ class PipelineBackend(SPMDBackendBase):
                 )
                 buf, pool = self._microstep_loop(
                     layers, x, pool, state.pos, attn_hook=hook,
-                    attn_seq_len=MB * bs,
+                    attn_seq_len=MB * bs, lora_pages=pages,
                 )
                 last = self._bcast(buf[:, -1:, :], s == 0)
                 logits = unembed_sharded(cfg, shared, last, S)[:, 0, :]
@@ -1126,12 +1207,15 @@ class PipelineBackend(SPMDBackendBase):
 
         state_specs = _replicated_specs(SlotState)
         sparam_specs = _replicated_specs(SlotParams)
+        specs = [
+            self._shared_specs, self._layer_specs, state_specs,
+            pool_spec(cfg), P(), P(), sparam_specs,
+        ]
+        if with_pages:
+            specs.append(P())
         shmapped = self._shard(
             body,
-            in_specs=(
-                self._shared_specs, self._layer_specs, state_specs,
-                pool_spec(cfg), P(), P(), sparam_specs,
-            ),
+            in_specs=tuple(specs),
             out_specs=(P(), P(), state_specs, pool_spec(cfg)),
         )
         return jax.jit(shmapped, donate_argnums=(3,))

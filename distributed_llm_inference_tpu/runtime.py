@@ -35,6 +35,8 @@ def create_backend(
     sp_strategy: str = "ring",
     lora: Optional[str] = None,
     wire_quant: Optional[str] = None,
+    adapter_slots: int = 0,
+    adapter_rank: int = 8,
 ):
     """Build a compute backend alone (no engine/tokenizer around it).
 
@@ -99,6 +101,20 @@ def create_backend(
         from .ops.quant import quantize_params
 
         params = quantize_params(cfg, params)
+    if adapter_slots:
+        if microbatches > 1 or mesh_cfg.sp > 1:
+            raise ValueError(
+                "adapter_slots > 0 (runtime LoRA serving) rides the "
+                "single-device and pp/tp pipeline backends; the 1F1B "
+                "and context-parallel backends carry no adapter pages"
+            )
+        # install AFTER quantization (the paged lora leaves stay dense)
+        # and BEFORE backend construction, so pp/tp meshes shard them
+        # through the ordinary parallel/partition specs
+        from .engine.adapters import install_adapter_leaves
+
+        params = install_adapter_leaves(cfg, params, adapter_slots,
+                                        adapter_rank)
     if microbatches > 1:
         if mesh_cfg.pp < 2:
             raise ValueError(
@@ -162,6 +178,12 @@ def create_engine(
     config 5) through the engine: fleets decode M microbatches chasing
     each other around the pp ring, batched requests pad to a multiple of
     M, and solo requests ride the batched path.
+    engine_cfg.adapter_slots > 0 installs the paged runtime LoRA leaves
+    (engine/adapters.py) and hangs an AdapterPool off engine.adapters:
+    requests carrying `adapter` select a page inside the one compiled
+    mixed program, with `--lora` merge-at-load staying the
+    single-adapter fast path (the same adapter cannot be served both
+    ways).
     """
     if mesh_cfg.dp > 1:
         # the serving engine decodes batch=1, which cannot shard over dp
@@ -177,10 +199,22 @@ def create_engine(
         dtype=dtype, quant=quant, kv_quant=kv_quant, attn_impl=attn_impl,
         seed=seed, sp_strategy=sp_strategy, lora=lora,
         wire_quant=engine_cfg.pp_wire_quant,
+        adapter_slots=engine_cfg.adapter_slots,
+        adapter_rank=engine_cfg.adapter_rank,
     )
     engine = InferenceEngine(
         cfg, backend=backend, tokenizer=tokenizer, engine_cfg=engine_cfg, seed=seed
     )
+    if engine_cfg.adapter_slots:
+        from .engine.adapters import AdapterPool
+
+        # merged_source records the --lora merge-at-load path so a later
+        # register() of the SAME adapter (which would apply its delta on
+        # top of the already-merged weights) fails loudly
+        engine.adapters = AdapterPool(
+            cfg, backend, engine_cfg.adapter_slots, engine_cfg.adapter_rank,
+            registry=engine.metrics, merged_source=lora,
+        )
     if draft_model is not None:
         dcfg = (
             get_model_config(draft_model)

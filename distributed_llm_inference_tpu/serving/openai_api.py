@@ -178,6 +178,17 @@ def _common_kwargs(data: dict, cap: int, default_max: int = None) -> dict:
             raise OpenAIError("slo_class must be a string",
                               param="slo_class")
         kwargs["slo_class"] = slo
+    tenant = data.get("tenant")
+    if tenant is not None:
+        # extension field (multi-tenant serving): the fairness /
+        # queue-quota identity on the continuous fleet — tenant-weighted
+        # token apportionment within each SLO class, per-tenant queue
+        # quota shed, per-tenant TTFT/TPOT EWMAs. Free-form label; no
+        # server-side registry to validate against.
+        if not isinstance(tenant, str) or not tenant:
+            raise OpenAIError("tenant must be a non-empty string",
+                              param="tenant")
+        kwargs["tenant"] = tenant
     dl = data.get("deadline_ms")
     if dl is not None:
         # extension field: end-to-end deadline in milliseconds. Expiry
@@ -504,16 +515,26 @@ def echo_score_response(result: dict, model: str) -> dict:
     }
 
 
-def models_response(model: str, created: int) -> dict:
-    return {
-        "object": "list",
-        "data": [{
-            "id": model,
+def models_response(model: str, created: int, adapters=()) -> dict:
+    """The base model plus every registered runtime LoRA adapter —
+    adapters are addressable as `model` on the OpenAI routes, so they
+    must be discoverable where SDK clients look for model ids. `root`
+    marks which base weights an adapter entry rides (vLLM convention)."""
+    data = [{
+        "id": model,
+        "object": "model",
+        "created": created,
+        "owned_by": "distributed_llm_inference_tpu",
+    }]
+    for name in adapters:
+        data.append({
+            "id": name,
             "object": "model",
             "created": created,
             "owned_by": "distributed_llm_inference_tpu",
-        }],
-    }
+            "root": model,
+        })
+    return {"object": "list", "data": data}
 
 
 # -- SSE streaming ----------------------------------------------------------

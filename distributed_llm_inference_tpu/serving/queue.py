@@ -174,7 +174,8 @@ class BatchingQueue:
         self._slo_default = engine.engine_cfg.slo_default_class
         self._m_slo_depth = m.gauge(
             "dli_slo_queue_depth",
-            "queued requests per SLO class", ("slo_class",),
+            "queued requests per SLO class and tenant",
+            ("slo_class", "tenant"),
         )
         self._m_slo_shed = m.counter(
             "dli_slo_shed_total",
@@ -218,7 +219,10 @@ class BatchingQueue:
         for p in self._queue:
             counts[p.slo] = counts.get(p.slo, 0) + 1
         for name in self._slo:
-            self._m_slo_depth.labels(slo_class=name).set(
+            # the batching queue carries no tenant identity; its series
+            # report under the anonymous tenant like untagged continuous
+            # traffic
+            self._m_slo_depth.labels(slo_class=name, tenant="").set(
                 counts.get(name, 0)
             )
 

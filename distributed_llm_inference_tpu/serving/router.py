@@ -173,7 +173,8 @@ class Router:
                  drain_deadline_s: float = 60.0,
                  failover_attempts: Optional[int] = None,
                  fabric: bool = True,
-                 handoff_min_bytes: int = 192):
+                 handoff_min_bytes: int = 192,
+                 tenant_max_inflight_share: float = 0.5):
         if not replicas:
             raise ValueError("router needs at least one replica")
         self.replicas = list(replicas)
@@ -192,6 +193,17 @@ class Router:
         # long-prompt work" worth a two-phase dispatch.
         self.fabric = bool(fabric)
         self.handoff_min_bytes = int(handoff_min_bytes)
+        # tenant-aware shedding: one tenant holding more than this share
+        # of ALL router-inflight requests is turned away with 429 +
+        # Retry-After BEFORE a replica is picked, so a flooding tenant
+        # saturates its own quota instead of every replica's admission
+        # queue. Requests without a tenant field are never shed here
+        # (they count toward the total only). 1.0 disables.
+        self.tenant_max_inflight_share = float(tenant_max_inflight_share)
+        # guarded-by: _tenant_lock; tenant -> inflight count ("" = the
+        # anonymous bucket, tracked so shares are of the true total)
+        self._tenant_inflight: dict = {}
+        self._tenant_lock = threading.Lock()
         # each request tries at most every replica once by default
         self.failover_attempts = (
             int(failover_attempts) if failover_attempts
@@ -262,6 +274,11 @@ class Router:
             "dli_router_affinity_total",
             "routing decisions by affinity outcome (hit = residency map "
             "named a dispatchable replica)", ("result",),
+        )
+        self._m_tenant_shed = self.metrics.counter(
+            "dli_tenant_shed_total",
+            "requests shed with 429 by the per-tenant inflight quota at "
+            "the router edge", ("tenant",),
         )
         self._m_handoffs = self.metrics.counter(
             "dli_router_handoffs_total",
@@ -523,6 +540,37 @@ class Router:
             return None
         out = env.get("kv_digests") if isinstance(env, dict) else None
         return out if isinstance(out, list) and out else None
+
+    # -- tenant admission ----------------------------------------------------
+    def tenant_begin(self, tenant: Optional[str]) -> bool:
+        """Admission-control one request for `tenant` (None/"" = the
+        anonymous bucket). True admits and counts it — the caller MUST
+        pair with tenant_end() on every exit path. False sheds: the
+        tenant already holds >= max(4, share * total) of the router's
+        inflight requests. The floor keeps a quiet router permissive
+        (any tenant may hold a few requests before shares bind)."""
+        key = tenant or ""
+        with self._tenant_lock:
+            if key and self.tenant_max_inflight_share < 1.0:
+                total = sum(self._tenant_inflight.values())
+                cap = max(4, int(total * self.tenant_max_inflight_share))
+                if self._tenant_inflight.get(key, 0) >= cap:
+                    self._m_tenant_shed.labels(tenant=key).inc()
+                    log.info("router_tenant_shed", tenant=key,
+                             inflight=self._tenant_inflight.get(key, 0),
+                             cap=cap, total=total)
+                    return False
+            self._tenant_inflight[key] = self._tenant_inflight.get(key, 0) + 1
+        return True
+
+    def tenant_end(self, tenant: Optional[str]):
+        key = tenant or ""
+        with self._tenant_lock:
+            n = self._tenant_inflight.get(key, 0) - 1
+            if n <= 0:
+                self._tenant_inflight.pop(key, None)
+            else:
+                self._tenant_inflight[key] = n
 
     # -- upstream calls ------------------------------------------------------
     def _begin(self, rep: Replica):
@@ -961,16 +1009,26 @@ def _affinity_key(data: dict) -> str:
     /generate and /v1/completions, the rendered message contents on chat
     (the replica-side chat template is deterministic, so equal message
     lists produce equal prompts — hashing the raw contents keys the same
-    equivalence classes)."""
+    equivalence classes). Requests naming an adapter (`adapter` on
+    /generate, `model` on the OpenAI routes) get an adapter-tagged key:
+    adapter KV is conditioned on the adapter's weights, so the same
+    prompt under two adapters must never share an affinity chain —
+    mirroring the replica-side BlockPrefixIndex's adapter-rooted
+    content keys."""
+    adapter = data.get("adapter") or data.get("model")
+    prefix = (
+        f"\x1dadapter:{adapter}\x1d"
+        if isinstance(adapter, str) and adapter else ""
+    )
     p = data.get("prompt")
     if isinstance(p, str) and p:
-        return p
+        return prefix + p
     prompts = data.get("prompts")
     if isinstance(prompts, list) and prompts and isinstance(prompts[0], str):
-        return prompts[0]
+        return prefix + prompts[0]
     msgs = data.get("messages")
     if isinstance(msgs, list):
-        return "\x1e".join(
+        return prefix + "\x1e".join(
             str(m.get("role", "")) + ":" + str(m.get("content", ""))
             for m in msgs if isinstance(m, dict)
         )
@@ -1130,6 +1188,29 @@ def make_router_handler(router: Router):
             except (ValueError, json.JSONDecodeError):
                 self._send(400, {"error": "invalid JSON body"})
                 return
+            tenant = data.get("tenant")
+            tenant = tenant if isinstance(tenant, str) and tenant else None
+            if not router.tenant_begin(tenant):
+                # per-tenant inflight quota: the same overloaded
+                # envelope + Retry-After a full replica queue answers,
+                # so tenant backoff is server-directed identically
+                self._send(
+                    429,
+                    {
+                        "error": "Error: tenant inflight quota exceeded "
+                                 "at the router",
+                        "status": "failed", "error_type": "overloaded",
+                        "tenant": tenant,
+                    },
+                    headers={"Retry-After": str(RETRY_AFTER_S)},
+                )
+                return
+            try:
+                self._dispatch_post(path, body, data)
+            finally:
+                router.tenant_end(tenant)
+
+        def _dispatch_post(self, path: str, body: bytes, data: dict):
             deadline_ms = _deadline_ms(data, self.headers)
             affinity_key = _affinity_key(data)
             t0 = time.perf_counter()
@@ -1497,6 +1578,14 @@ def main(argv: Optional[list] = None):
         "--failover-attempts", type=int, default=0, metavar="N",
         help="max replicas one request may try (0 = one try per replica)",
     )
+    ap.add_argument(
+        "--tenant-share", type=float, default=0.5, metavar="F",
+        help="per-tenant inflight quota as a fraction of ALL router-"
+             "inflight requests: a tenant at max(4, F * total) sheds "
+             "with 429 + Retry-After before a replica is picked "
+             "(requests without a 'tenant' field are never shed; 1.0 "
+             "disables)",
+    )
     args = ap.parse_args(argv)
 
     replicas = []
@@ -1534,6 +1623,7 @@ def main(argv: Optional[list] = None):
         failover_attempts=args.failover_attempts or None,
         fabric=not args.no_fabric,
         handoff_min_bytes=args.handoff_min_bytes,
+        tenant_max_inflight_share=args.tenant_share,
     )
     # learn URL-joined replicas' classes + bootstrap digest residency
     # off one /health sweep (spawned replicas carry their class already)

@@ -165,6 +165,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
     from ..engine.scheduler import parse_slo_classes
 
     slo_classes = parse_slo_classes(engine.engine_cfg)
+    # runtime LoRA adapter pool (engine/adapters.py), if configured —
+    # requests select a registered adapter by name (`adapter` on
+    # /generate, `model` on the OpenAI routes); unknown names are 400s
+    # at this edge, before admission
+    adapters = getattr(engine, "adapters", None)
     # HTTP request/error counter by route + status — every response path
     # (JSON, HTML, SSE, NDJSON) passes through exactly one counting point
     http_requests = engine.metrics.counter(
@@ -312,7 +317,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 )
             elif path == "/v1/models":
                 self._send(
-                    200, oai.models_response(engine.cfg.name, started_at)
+                    200, oai.models_response(
+                        engine.cfg.name, started_at,
+                        adapters=adapters.names() if adapters else (),
+                    )
                 )
             elif path.startswith("/kv/"):
                 # the KV fabric's serving half (serving/kv_fabric.py):
@@ -464,6 +472,27 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         f"configured: {sorted(slo_classes)}",
                         param="slo_class",
                     )
+                req_model = data.get("model")
+                if (
+                    adapters is not None
+                    and isinstance(req_model, str)
+                    and req_model
+                    and req_model != engine.cfg.name
+                ):
+                    # `model` resolves to a registered runtime adapter
+                    # (the base model's own name keeps meaning the base).
+                    # With a pool attached, an unknown model id is a
+                    # caller bug — 400, never a silent base fallback.
+                    # Without a pool, `model` stays informational, as
+                    # before.
+                    if not adapters.is_registered(req_model):
+                        raise oai.OpenAIError(
+                            f"model {req_model!r} is neither the base "
+                            f"model {engine.cfg.name!r} nor a registered "
+                            f"adapter; see GET /v1/models",
+                            param="model",
+                        )
+                    kwargs["adapter"] = req_model
                 hdr_dl = self.headers.get("X-Request-Deadline-Ms")
                 if hdr_dl is not None:
                     # router relay of the REMAINING end-to-end budget:
@@ -555,7 +584,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             }
             self._send(
                 200,
-                build(entries, engine.cfg.name, kwargs,
+                # adapter-resolved requests echo the adapter id as the
+                # model (vLLM convention): the client asked for that id
+                # and /v1/models lists it
+                build(entries, kwargs.get("adapter") or engine.cfg.name,
+                      kwargs,
                       prompt_once=prompt_once,
                       request_id=envelope.get("request_id", self._rid),
                       timings=envelope.get("timings"),
@@ -664,6 +697,38 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                             f"{sorted(slo_classes)}"
                         )
                     kwargs["slo_class"] = raw_slo
+                raw_tenant = data.get("tenant")
+                if raw_tenant is not None:
+                    # multi-tenant identity (engine/scheduler.py):
+                    # tenant-weighted apportionment within each SLO
+                    # class, per-tenant queue quota shed, per-tenant
+                    # TTFT/TPOT EWMAs. Free-form label.
+                    if not isinstance(raw_tenant, str) or not raw_tenant:
+                        raise ValueError(
+                            "tenant must be a non-empty string"
+                        )
+                    kwargs["tenant"] = raw_tenant
+                raw_adapter = data.get("adapter")
+                if raw_adapter is not None and raw_adapter != engine.cfg.name:
+                    # runtime LoRA adapter selection (engine/adapters.py):
+                    # the request's decode rows ride the named adapter's
+                    # device page inside the one compiled mixed program.
+                    # The base model's own name means "no adapter" so
+                    # callers can pass their model id unconditionally.
+                    if not isinstance(raw_adapter, str):
+                        raise ValueError("adapter must be a string")
+                    if adapters is None:
+                        raise ValueError(
+                            "adapter serving is not configured: start "
+                            "with --adapter-slots (and --continuous + "
+                            "--kv-pool-blocks)"
+                        )
+                    if not adapters.is_registered(raw_adapter):
+                        raise ValueError(
+                            f"unknown adapter {raw_adapter!r}; "
+                            f"registered: {adapters.names()}"
+                        )
+                    kwargs["adapter"] = raw_adapter
                 nbeams = data.get("num_beams")
                 if nbeams is not None and int(nbeams) > 1:
                     # deterministic beam search (HF num_beams semantics);
@@ -1102,7 +1167,50 @@ def main(argv: Optional[list] = None):
     ap.add_argument(
         "--lora", default=None, metavar="DIR",
         help="PEFT-format LoRA adapter directory to merge into the base "
-             "weights at load (W + alpha/r * BA; before quantization)",
+             "weights at load (W + alpha/r * BA; before quantization) — "
+             "the SINGLE-adapter fast path: zero per-step delta cost, "
+             "but the whole server speaks that one adapter. Serve many "
+             "adapters concurrently with --adapter-slots/--adapter "
+             "instead (the same adapter cannot be used both ways)",
+    )
+    ap.add_argument(
+        "--adapter-slots", type=int, default=0, metavar="N",
+        help="runtime LoRA adapter pool (engine/adapters.py): reserve N "
+             "device pages of paged A/B factors next to the resident "
+             "base weights; requests select a registered adapter by "
+             "name ('adapter' on /generate, 'model' on the OpenAI "
+             "routes) and decode through ONE compiled program whatever "
+             "the adapter mix. Needs --continuous + --kv-pool-blocks "
+             "(the ragged paged fleet); 0 = disabled",
+    )
+    ap.add_argument(
+        "--adapter-rank", type=int, default=8, metavar="R",
+        help="pool page rank: every registered adapter is zero-padded "
+             "to rank R (registration rejects adapters with a larger "
+             "trained rank)",
+    )
+    ap.add_argument(
+        "--adapter", action="append", default=None, metavar="NAME=DIR",
+        help="register a PEFT-format LoRA adapter directory under NAME "
+             "at startup (repeatable); requests then address it by "
+             "name. Requires --adapter-slots; more adapters than slots "
+             "is fine — pages are refcounted and LRU-swapped on demand",
+    )
+    ap.add_argument(
+        "--tenant-weight", action="append", default=None, metavar="NAME=W",
+        help="per-tenant fairness weight on the continuous fleet "
+             "(repeatable): within each SLO class, queued tenants split "
+             "the class's token budget in proportion to their weights "
+             "(unlisted tenants weigh 1.0); requests carry their tenant "
+             "in the 'tenant' field",
+    )
+    ap.add_argument(
+        "--tenant-queue-share", type=float, default=0.5, metavar="F",
+        help="per-tenant admission-queue quota as a fraction of the "
+             "continuous queue bound: one tenant's queued requests "
+             "beyond max(4, F * queue-bound) shed with 429 + "
+             "Retry-After so a flooding tenant cannot starve the "
+             "others' admission; 1.0 disables the quota",
     )
     ap.add_argument(
         "--draft-model", default=None, metavar="NAME",
@@ -1329,6 +1437,41 @@ def main(argv: Optional[list] = None):
             "--die-on-wedge needs --deadline: wedges are detected by "
             "deadline-overrun calls that never drain"
         )
+    if args.adapter and not args.adapter_slots:
+        raise SystemExit(
+            "--adapter needs --adapter-slots N: the runtime pool's "
+            "device pages are reserved at engine build"
+        )
+    if args.adapter_slots and (
+        args.continuous <= 0 or args.kv_pool_blocks is None
+    ):
+        # also pre-model-load: a pool no request could ever select
+        # (the adapter path rides the ragged paged fleet's mixed
+        # launch) is a misconfiguration, not a degraded mode
+        raise SystemExit(
+            "--adapter-slots needs --continuous SLOTS with "
+            "--kv-pool-blocks N: runtime adapters ride the ragged "
+            "paged fleet's mixed launch"
+        )
+    adapter_specs = []
+    for spec in args.adapter or ():
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--adapter {spec!r}: expected NAME=DIR")
+        adapter_specs.append((name, path))
+    tenant_weights = []
+    for spec in args.tenant_weight or ():
+        name, sep, w = spec.partition("=")
+        if not sep or not name:
+            raise SystemExit(
+                f"--tenant-weight {spec!r}: expected NAME=WEIGHT"
+            )
+        try:
+            tenant_weights.append((name, float(w)))
+        except ValueError:
+            raise SystemExit(
+                f"--tenant-weight {spec!r}: WEIGHT must be a number"
+            ) from None
     from ..utils import faults as _faults
 
     if args.faults:
@@ -1402,6 +1545,10 @@ def main(argv: Optional[list] = None):
             spec_draft_len=args.spec_draft_len,
             spec_draft_model=args.spec_draft_model,
             pp_wire_quant=args.pp_wire_quant,
+            adapter_slots=args.adapter_slots,
+            adapter_rank=args.adapter_rank,
+            tenant_weights=tuple(tenant_weights),
+            tenant_max_queue_share=args.tenant_queue_share,
         ),
         microbatches=args.microbatches,
         params=params,
@@ -1415,6 +1562,18 @@ def main(argv: Optional[list] = None):
         draft_model=args.draft_model,
         lora=args.lora,
     )
+    for name, path in adapter_specs:
+        try:
+            # fails startup loudly on a bad directory, rank overflow,
+            # shape mismatch, or the --lora merge-at-load collision
+            engine.adapters.register(name, path)
+        except (ValueError, OSError) as e:
+            raise SystemExit(f"--adapter {name}={path}: {e}") from e
+    if adapter_specs:
+        print(
+            f"🎛  {len(adapter_specs)} adapter(s) registered: "
+            f"{', '.join(n for n, _ in adapter_specs)}"
+        )
     if args.die_on_wedge:
 
         def _wedge_reaper():

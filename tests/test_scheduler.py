@@ -419,9 +419,10 @@ def test_slo_queue_depth_gauge(setup):
         tuple(sorted(s["labels"].items())): s["value"]
         for s in snap.get("dli_slo_queue_depth", {}).get("series", [])
     }
-    # every configured class exposes a series (schema-stable scrape)
+    # every configured class exposes a series (schema-stable scrape);
+    # the anonymous tenant "" carries untagged traffic
     for name in ("interactive", "standard", "batch"):
-        assert (("slo_class", name),) in series, series
+        assert (("slo_class", name), ("tenant", "")) in series, series
 
 
 # -- serving surface ----------------------------------------------------------
