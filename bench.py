@@ -2003,6 +2003,120 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
+    # Tracing-overhead leg (ISSUE 17): the dense continuous churn again,
+    # now with every request carrying a client-minted trace context, at
+    # --trace-sample-rate 0 / 0.1 / 1.0. Rate 0 is the always-on cost of
+    # the seam itself (one deterministic float compare per submit; no
+    # spans started, no launch notes) and is gated against this run's
+    # OWN dense number (same prompts, same process, same compile cache):
+    # off_within_1pct is the <=1% regression gate. The sampled rates
+    # price launch-level attribution — launch.* spans keyed by dispatch
+    # seq, host-side timestamps only, never a device sync — as tok/s and
+    # client-observed TPOT p99.
+    if (
+        cont_block.get("dense_tokens_per_sec")
+        and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S
+    ):
+        try:
+            from distributed_llm_inference_tpu.utils.tracing import (
+                SpanContext,
+            )
+
+            def tracing_leg(rate):
+                eng_t = InferenceEngine(
+                    c_cfg, params=c_params,
+                    engine_cfg=EngineConfig(trace_sample_rate=rate),
+                )
+                cont_t = ContinuousEngine(
+                    eng_t, n_slots=n_slots, chunk_steps=chunk,
+                    slot_max_seq=slot_max_seq,
+                )
+                try:
+                    cont_t.submit(prompts[0], **kw)  # warm slot programs
+                    done = [0]
+                    tpots = []
+                    lock = threading.Lock()
+                    it = iter(prompts)
+
+                    def client():
+                        while True:
+                            with lock:
+                                p = next(it, None)
+                            if p is None:
+                                return
+                            tq = time.perf_counter()
+                            r = cont_t.submit(
+                                p, trace_ctx=SpanContext.new_root(), **kw
+                            )
+                            el = time.perf_counter() - tq
+                            if r.get("status") == "success":
+                                n = r["tokens_generated"]
+                                with lock:
+                                    done[0] += n
+                                    if n > 1:
+                                        tpots.append(
+                                            max(
+                                                0.0,
+                                                el - float(r["ttft_s"]),
+                                            ) / (n - 1)
+                                        )
+
+                    t0 = time.perf_counter()
+                    threads = [
+                        threading.Thread(target=client) for _ in range(8)
+                    ]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    wall = time.perf_counter() - t0
+                    tpots.sort()
+                    return {
+                        "tokens_per_sec": (
+                            round(done[0] / wall, 3) if done[0] else None
+                        ),
+                        "tpot_p99_s": (
+                            round(
+                                tpots[
+                                    min(
+                                        len(tpots) - 1,
+                                        int(0.99 * len(tpots)),
+                                    )
+                                ],
+                                5,
+                            ) if tpots else None
+                        ),
+                        # proves each rate did what it says: 0 spans at
+                        # off, launch.* spans present when sampled
+                        "spans_recorded": eng_t.trace_store.stats()[
+                            "spans"
+                        ],
+                    }
+                finally:
+                    cont_t.close()
+
+            trc = {
+                "off": tracing_leg(0.0),
+                "rate_0p1": tracing_leg(0.1),
+                "rate_1p0": tracing_leg(1.0),
+            }
+            base = cont_block["dense_tokens_per_sec"]
+            off_v = trc["off"]["tokens_per_sec"]
+            if off_v:
+                trc["off_vs_dense"] = round(off_v / base, 3)
+                trc["off_within_1pct"] = bool(off_v >= 0.99 * base)
+            on_v = trc["rate_1p0"]["tokens_per_sec"]
+            if off_v and on_v:
+                trc["sampled_overhead_frac"] = round(
+                    1.0 - on_v / off_v, 3
+                )
+            cont_block["tracing_overhead"] = trc
+            _write_sidecar(dict(result, continuous=cont_block))
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
     if cont_block:
         result["continuous"] = cont_block
         # keep the round-3 flat key so round-over-round comparisons of the

@@ -10,6 +10,14 @@ admission wedges. The matching APIs in this codebase:
     blocks = alloc.alloc(n)      ... alloc.decref(blocks) / .free(blocks)
     alloc.incref(shared)         ... alloc.decref(shared)
     off = table.acquire(art)     ... table.release(key)
+    sp = store.start_span(...)   ... store.end_span(sp)
+
+The last pair is the tracing span discipline (serving/trace_store.py):
+an explicitly started span left open on a return path never commits to
+the store — the trace silently loses that hop. Prefer the `span()`
+contextmanager (invisible to this rule, safe by construction); the
+explicit pair is for spans that outlive one frame, which is exactly the
+ownership-transfer shape the tracker already exempts.
 
 The rule tracks, per function and in source order: an ACQUIRE binds the
 target variable as a live resource; a RELEASE call (`decref`/`free`/
@@ -37,8 +45,8 @@ from ..lint import Diagnostic
 
 RULE_ID = "resource-lifecycle"
 
-_ACQUIRE_ATTRS = {"alloc", "incref", "acquire"}
-_RELEASE_ATTRS = {"decref", "free", "release"}
+_ACQUIRE_ATTRS = {"alloc", "incref", "acquire", "start_span"}
+_RELEASE_ATTRS = {"decref", "free", "release", "end_span"}
 
 
 def _holder_name(node: ast.AST) -> Optional[str]:

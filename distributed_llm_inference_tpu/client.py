@@ -18,6 +18,7 @@ from typing import Any, Optional
 # bounded-retry policy shared with the router tier (utils/retry.py):
 # 429/503 retryable, Retry-After wins over jittered exponential backoff
 from .utils.retry import RETRY_STATUSES, retry_delay
+from .utils.tracing import SpanContext
 
 
 class DistributedLLMClient:
@@ -32,6 +33,16 @@ class DistributedLLMClient:
         # 0 retries restores the old fail-fast behavior
         self.max_retries = int(max_retries)
         self.retry_backoff_s = float(retry_backoff_s)
+        # trace id of the most recent POST — the client ROOTS each
+        # request's trace (W3C traceparent), so the whole fleet hop chain
+        # is fetchable afterwards at GET /debug/traces/{last_trace_id}
+        self.last_trace_id: Optional[str] = None
+
+    def _trace_headers(self) -> dict:
+        ctx = SpanContext.new_root()
+        self.last_trace_id = ctx.trace_id
+        return {"Content-Type": "application/json",
+                "traceparent": ctx.header()}
 
     def _get(self, path: str, timeout: Optional[float] = None) -> dict:
         with urllib.request.urlopen(
@@ -49,7 +60,7 @@ class DistributedLLMClient:
         req = urllib.request.Request(
             f"{self.base_url}{path}",
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=self._trace_headers(),
             method="POST",
         )
         for attempt in range(self.max_retries + 1):
@@ -146,7 +157,7 @@ class DistributedLLMClient:
             data=json.dumps(
                 {"prompt": prompt, "max_tokens": max_tokens, "stream": True, **kw}
             ).encode(),
-            headers={"Content-Type": "application/json"},
+            headers=self._trace_headers(),
             method="POST",
         )
         final: dict = {}

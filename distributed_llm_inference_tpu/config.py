@@ -528,8 +528,23 @@ class EngineConfig:
     # floor) — the over-quota tenant sheds with 429 + Retry-After before
     # other tenants starve. 1.0 disables the quota.
     tenant_max_queue_share: float = 0.5
+    # Launch-level device-time attribution (utils/tracing.py +
+    # serving/trace_store.py): fraction of traces whose requests get
+    # per-launch dispatch→packed-fetch spans recorded host-side (launch
+    # seq keyed — lag-pipelined launches attribute correctly with ZERO
+    # extra device syncs; `analysis --hlo` stays clean because nothing
+    # here touches compiled code). The decision is a deterministic
+    # function of the trace id (tracing.sample_decision), so all
+    # replicas agree per trace. 0 (the default) keeps the hot path
+    # allocation-free: no profiling structure is ever created.
+    trace_sample_rate: float = 0.0
 
     def __post_init__(self):
+        if not (0.0 <= self.trace_sample_rate <= 1.0):
+            raise ValueError(
+                f"trace_sample_rate must be in [0, 1], got "
+                f"{self.trace_sample_rate}"
+            )
         if self.pp_wire_quant not in (None, "int8"):
             raise ValueError(
                 f"pp_wire_quant must be None or 'int8', got "

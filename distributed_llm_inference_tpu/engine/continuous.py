@@ -103,6 +103,8 @@ with a WARM prefix cache (tests/test_recovery.py chaos matrix).
 from __future__ import annotations
 
 import collections
+import json
+import os
 import threading
 import time
 from typing import Any, Optional
@@ -115,7 +117,7 @@ from ..utils import faults
 from ..utils.logging import get_logger
 from ..utils.metrics import DEFAULT_SIZE_BUCKETS
 from ..utils.retry import overload_retry_after
-from ..utils.tracing import Trace
+from ..utils.tracing import Trace, sample_decision
 from . import generate as G
 from .block_prefix import chunk_digests
 
@@ -137,11 +139,12 @@ class _Request:
         "deadline_at", "cancel_cause", "preemptions", "preempted_at",
         "resume_seq", "drop_seq", "kv_hint", "fabric_blocks",
         "spec_want", "spec_drafted", "spec_accepted", "spec_launches",
-        "adapter", "tenant", "adapter_page",
+        "adapter", "tenant", "adapter_page", "trace_ctx", "profiled",
     )
 
     def __init__(self, prompt: str, kwargs: dict, stream_q=None,
-                 request_id=None, kv_hint=None, adapter=None, tenant=None):
+                 request_id=None, kv_hint=None, adapter=None, tenant=None,
+                 trace_ctx=None):
         self.prompt = prompt
         # multi-tenant adapter serving (engine/adapters.py): registered
         # adapter name (None = base model), the tenant the request bills
@@ -160,6 +163,13 @@ class _Request:
         # admission / decode / detokenize spans + the request id echoed
         # in the response and the X-Request-Id header
         self.trace = Trace(request_id)
+        # fleet trace context (ISSUE 17): the SpanContext parsed from the
+        # inbound traceparent header (None = untraced request). profiled
+        # flips True only when the deterministic per-trace sample
+        # decision under engine_cfg.trace_sample_rate says this request
+        # gets launch-level attribution spans.
+        self.trace_ctx = trace_ctx
+        self.profiled = False
         self.done = threading.Event()
         self.result: Optional[dict] = None
         self.enqueued = time.time()
@@ -699,6 +709,17 @@ class ContinuousEngine:
         self._admitting: Optional[_Request] = None
         self._consecutive_crashes = 0
         self._mutation_seq = 0  # bumped per admission; chunks snapshot it
+        # Launch-level device-time attribution (ISSUE 17,
+        # engine_cfg.trace_sample_rate): launch records appended at
+        # dispatch and closed at the matching packed fetch — matched by
+        # the launch's own perf_counter timestamp, so lag-pipelined
+        # launches attribute correctly with ZERO extra device syncs.
+        # At rate 0 (the default) the ONLY hot-path cost is one float
+        # compare: _prof_note_launch is never called, the deque stays
+        # empty, nothing allocates.
+        self._trace_rate = float(engine.engine_cfg.trace_sample_rate)
+        self._prof_active = 0  # profiled requests seen in the last launch
+        self._launch_log: collections.deque = collections.deque()
         # observability
         self.admitted = 0  # guarded-by: _cv
         self.completed = 0  # guarded-by: _cv
@@ -991,6 +1012,11 @@ class ContinuousEngine:
             if len(self._queue) >= self.max_queue:
                 log.warning("queue_full", depth=len(self._queue),
                             slo_class=cls.name)
+                self.engine.flight.record(
+                    "shed", reason="queue_full",
+                    request_id=req.trace.request_id,
+                    depth=len(self._queue), slo_class=cls.name,
+                )
                 self._m_shed.inc()
                 self._sched.count_shed(cls.name)
                 return {
@@ -1022,6 +1048,11 @@ class ContinuousEngine:
                         "tenant_shed", tenant=req.tenant, depth=t_depth,
                         cap=t_cap, slo_class=cls.name,
                     )
+                    self.engine.flight.record(
+                        "shed", reason="tenant_quota",
+                        request_id=req.trace.request_id,
+                        tenant=req.tenant, depth=t_depth, cap=t_cap,
+                    )
                     self._m_shed.inc()
                     self._m_tenant_shed.labels(tenant=req.tenant).inc()
                     return {
@@ -1045,6 +1076,11 @@ class ContinuousEngine:
                 log.warning(
                     "slo_shed", slo_class=cls.name, depth=class_depth,
                     ttft_target_s=cls.ttft_target_s,
+                )
+                self.engine.flight.record(
+                    "shed", reason="slo_drain",
+                    request_id=req.trace.request_id,
+                    slo_class=cls.name, depth=class_depth,
                 )
                 self._m_shed.inc()
                 self._sched.count_shed(cls.name)
@@ -1104,6 +1140,7 @@ class ContinuousEngine:
         # so the decode-class replica's immediate fetch finds the chain
         # resident instead of racing the copier thread.
         kv_hint = kwargs.pop("kv_hint", None)
+        trace_ctx = kwargs.pop("trace_ctx", None)
         adapter = kwargs.pop("adapter", None) or None
         tenant = kwargs.pop("tenant", None) or None
         err = self._adapter_reject(adapter, kwargs)
@@ -1116,7 +1153,12 @@ class ContinuousEngine:
             return self.engine.generate(prompt, **kwargs)
         req = _Request(prompt, kwargs,
                        request_id=kwargs.pop("request_id", None),
-                       kv_hint=kv_hint, adapter=adapter, tenant=tenant)
+                       kv_hint=kv_hint, adapter=adapter, tenant=tenant,
+                       trace_ctx=trace_ctx)
+        if trace_ctx is not None and trace_ctx.sampled:
+            req.profiled = sample_decision(
+                trace_ctx.trace_id, self._trace_rate
+            )
         err = self._enqueue(req)
         if err is not None:
             return err
@@ -1143,6 +1185,7 @@ class ContinuousEngine:
         fetched step, like any chunk).
         """
         kv_hint = kwargs.pop("kv_hint", None)
+        trace_ctx = kwargs.pop("trace_ctx", None)
         adapter = kwargs.pop("adapter", None) or None
         tenant = kwargs.pop("tenant", None) or None
         err = self._adapter_reject(adapter, kwargs)
@@ -1159,7 +1202,12 @@ class ContinuousEngine:
         q: _queue.Queue = _queue.Queue()
         req = _Request(prompt, kwargs, stream_q=q,
                        request_id=kwargs.pop("request_id", None),
-                       kv_hint=kv_hint, adapter=adapter, tenant=tenant)
+                       kv_hint=kv_hint, adapter=adapter, tenant=tenant,
+                       trace_ctx=trace_ctx)
+        if trace_ctx is not None and trace_ctx.sampled:
+            req.profiled = sample_decision(
+                trace_ctx.trace_id, self._trace_rate
+            )
         err = self._enqueue(req)  # error yielded OUTSIDE the engine lock:
         if err is not None:  # the consumer may block on a slow socket write
             yield {**err, "done": True}
@@ -1258,6 +1306,7 @@ class ContinuousEngine:
         Returns True when fully drained; stragglers past the deadline are
         failed by the caller's close(). Idempotent."""
         t0 = time.time()
+        self.engine.flight.record("drain", deadline_s=deadline_s)
         with self._cv:
             self._draining = True
             self._cv.notify_all()
@@ -1755,7 +1804,15 @@ class ContinuousEngine:
         p0_local, _, _ = self._bpx.lookup(ids)
         if cap <= 0 or p0_local >= cap:
             return
-        fetched = self._fabric.fetch(peer, digest, bs)
+        fetched = self._fabric.fetch(
+            peer, digest, bs, ctx=req.trace_ctx,
+            request_id=req.trace.request_id,
+            store=self.engine.trace_store,
+        )
+        self.engine.flight.record(
+            "fabric_fetch", request_id=req.trace.request_id, peer=peer,
+            digest=str(digest)[:16], hit=fetched is not None,
+        )
         if fetched is None:
             return  # counted as a miss; admission continues cold
         keys, leaves = fetched
@@ -1922,6 +1979,13 @@ class ContinuousEngine:
         victim.shadow_depth = 0
         self.preempted_total += 1
         self._m_preempt.labels(reason="pool").inc()
+        self.engine.flight.record(
+            "preempt", request_id=victim.trace.request_id,
+            policy=self.preempt_policy, swap=swapped,
+            preemptions=victim.preemptions, slo_class=victim.slo,
+            beneficiary=req.trace.request_id,
+            **self._alloc.span_attrs(),
+        )
         log.info(
             "request_preempted", policy=self.preempt_policy, swap=swapped,
             preemptions=victim.preemptions, slo_class=victim.slo,
@@ -2024,6 +2088,37 @@ class ContinuousEngine:
             "continuous_loop_crashed", exc_info=True, error=str(exc),
             consecutive=self._consecutive_crashes,
         )
+        # crash flight recorder (ISSUE 17): the event ring's tail goes
+        # into the crash report (the structured log record below) and
+        # the FULL dump is persisted next to --restore-dir, so a
+        # poison-quarantine or restart-loop episode is reconstructable
+        # after the process is gone. Persist failures only cost the
+        # forensics file — containment proceeds regardless.
+        self.engine.flight.record(
+            "crash", error=str(exc),
+            consecutive=self._consecutive_crashes,
+        )
+        flight = self.engine.flight.dump()
+        log.error(
+            "crash_flight_recorder",
+            recorded_total=flight["recorded_total"],
+            tail=flight["events"][-20:],
+        )
+        if self._restore_dir:
+            try:
+                os.makedirs(self._restore_dir, exist_ok=True)
+                with open(
+                    os.path.join(self._restore_dir, "flight_crash.json"),
+                    "w",
+                ) as f:
+                    json.dump(
+                        {"error": str(exc),
+                         "consecutive": self._consecutive_crashes,
+                         **flight},
+                        f,
+                    )
+            except OSError as e:
+                log.warning("flight_persist_failed", error=str(e))
         casualties = self._casualties()
         for req in casualties:
             if req in self._suspects:
@@ -2037,6 +2132,10 @@ class ContinuousEngine:
                 # fail it ALONE; its fleet-mates are salvaged below
                 self.poisoned_total += 1
                 self._m_poison.inc()
+                self.engine.flight.record(
+                    "quarantine", request_id=req.trace.request_id,
+                    strikes=req.strikes,
+                )
                 log.error(
                     "request_quarantined", strikes=req.strikes,
                     request_id=req.trace.request_id,
@@ -2077,6 +2176,9 @@ class ContinuousEngine:
             self._recovery = []
             self._resume = []
             self._restarting = False
+            self.engine.flight.record(
+                "scheduler_dead", restarts=self.restarts_total,
+            )
             log.error(
                 "continuous_scheduler_dead", restarts=self.restarts_total
             )
@@ -2123,6 +2225,10 @@ class ContinuousEngine:
         ]
         self.restarts_total += 1
         self._m_restarts.inc()
+        self.engine.flight.record(
+            "restart", restart=self.restarts_total,
+            salvaged=len(survivors),
+        )
         log.info(
             "continuous_scheduler_restarted", restart=self.restarts_total,
             salvaged=len(survivors),
@@ -2209,6 +2315,56 @@ class ContinuousEngine:
         finally:
             self._restarting = False
 
+    # -- launch-level device-time attribution (ISSUE 17) ---------------------
+    def _prof_note_launch(self, kind: str, t_launch: float, snapshot,
+                          **attrs):
+        """Open one launch-attribution record (worker thread, called at
+        the dispatch boundary ONLY behind the `self._trace_rate > 0`
+        guard — at the default rate 0 this method is unreachable from
+        the hot path and nothing here ever allocates). The record closes
+        at the matching packed fetch (_prof_close_launch), keyed by the
+        launch's own perf_counter timestamp: fetches drain the inflight
+        deque FIFO in launch order, so lag-pipelined launches attribute
+        correctly without any extra device sync."""
+        targets = [
+            (r.trace_ctx.trace_id, r.trace_ctx.span_id)
+            for r in snapshot
+            if r is not None and r.profiled and r.trace_ctx is not None
+        ]
+        self._prof_active = len(targets)
+        if not targets:
+            return
+        self._launch_log.append({
+            "t_launch": t_launch,
+            "wall": time.time(),
+            "kind": kind,
+            "targets": targets,
+            "attrs": attrs,
+        })
+
+    def _prof_close_launch(self, t_launch: float, **attrs):
+        """Close the oldest launch record IF it belongs to the fetch
+        being processed (exact float equality on the launch timestamp —
+        unrecorded launches between recorded ones just don't match), and
+        emit one `launch.<kind>` span per profiled tenant into the
+        engine's span store, parented under that request's inbound span
+        so the assembled tree nests router → replica → launch."""
+        if not self._launch_log or self._launch_log[0]["t_launch"] != t_launch:
+            return
+        rec = self._launch_log.popleft()
+        t1 = time.time()
+        span_attrs = dict(rec["attrs"])
+        span_attrs.update(attrs)
+        span_attrs["launch_to_fetch_s"] = round(
+            time.perf_counter() - t_launch, 6
+        )
+        store = self.engine.trace_store
+        for trace_id, parent in rec["targets"]:
+            store.add_span(
+                trace_id, f"launch.{rec['kind']}", rec["wall"], t1,
+                parent_id=parent, attrs=span_attrs,
+            )
+
     def _launch_chunk(self):
         """Launch one decode chunk over the current fleet (paged /
         constrained / plain slot program — state, cache, and fsm chain
@@ -2260,10 +2416,14 @@ class ContinuousEngine:
                 )
             )
         packed = G.pack_chunk(emitted, mask, self.state.active)
-        return (
-            packed, list(self._assignment), time.perf_counter(),
-            self._mutation_seq,
-        )
+        snapshot = list(self._assignment)
+        t_launch = time.perf_counter()
+        if self._trace_rate > 0.0:
+            self._prof_note_launch(
+                "chunk", t_launch, snapshot, steps=self.chunk_steps,
+                rows=sum(1 for r in snapshot if r is not None),
+            )
+        return (packed, snapshot, t_launch, self._mutation_seq)
 
     def _loop_inner(self):
         # In-flight decode chunks, oldest first. Launch up to chunk_lag
@@ -2272,6 +2432,9 @@ class ContinuousEngine:
         # (insert_slot) and kill (kill_slot) mutate the FUTURE-most state,
         # which is exactly the one the next launch uses.
         inflight: collections.deque = collections.deque()
+        # a restart abandoned any in-flight launches — their attribution
+        # records can never be closed (the fetches died with the crash)
+        self._launch_log.clear()
         # warm restore FIRST (supervisor restart or --restore-dir start):
         # the rebuilt pool takes the shadowed blocks back in one scatter
         # and the block-prefix index re-learns the chains, so the
@@ -3107,6 +3270,16 @@ class ContinuousEngine:
                 self._shadow_capture(job.req, written=job.p0 + job.done)
         # launch-composition observability
         n_pf_tokens = sum(n for _, n, _ in chunk_list)
+        # flight recorder: the scheduler plan with its budget split —
+        # only steps that actually interleaved prefill work are recorded
+        # (pure-decode steps would flood the ring with no forensic value)
+        if chunk_list or spec_rows:
+            self.engine.flight.record(
+                "plan", seq=self._mutation_seq, decode_rows=n_dec,
+                prefill_chunks=len(chunk_list),
+                prefill_tokens=n_pf_tokens, spec_rows=len(spec_rows),
+                budget=self._sched.last_plan,
+            )
         self._m_sched_rows.inc(n_dec)
         self._m_sched_chunks.inc(len(chunk_list))
         self._m_sched_tokens.labels(kind="decode").inc(n_dec)
@@ -3132,8 +3305,16 @@ class ContinuousEngine:
         snapshot = [
             self._assignment[b] if b in active else None for b in range(B)
         ]
+        t_launch = time.perf_counter()
+        if self._trace_rate > 0.0:
+            self._prof_note_launch(
+                "mixed", t_launch, snapshot, seq=self._mutation_seq,
+                decode_rows=n_dec, prefill_chunks=len(chunk_list),
+                prefill_tokens=n_pf_tokens,
+                spec_drafted=sum(nd for nd, _, _ in spec_rows.values()),
+            )
         return (
-            "mixed", packed, snapshot, completions, time.perf_counter(),
+            "mixed", packed, snapshot, completions, t_launch,
             self._mutation_seq,
             spec_meta if spec_plan_dev is not None else None,
         )
@@ -3204,6 +3385,7 @@ class ContinuousEngine:
             self._post_admit(req)
         em = emitted[None, :]
         mk = mask[None, :].astype(bool)
+        prof_acc = 0  # accepted draft tokens in THIS launch (attribution)
         if spec_meta:
             # combined emission matrix: decode rows keep their one
             # token in row 0, verify rows splice their whole emission
@@ -3248,10 +3430,15 @@ class ContinuousEngine:
                 self._m_spec_hist.observe(n_emit)
                 self.spec_accepted += acc
                 req.spec_accepted += acc
+                prof_acc += acc
         self._distribute(em, mk, active.astype(bool), snapshot, seq=seq)
         for b, r in enumerate(snapshot):
             if r is not None and self._row_inflight[b] > 0:
                 self._row_inflight[b] -= 1
+        # close this launch's attribution record (empty deque at sample
+        # rate 0 — the guard is one truthiness check, no allocation)
+        if self._launch_log:
+            self._prof_close_launch(t_launch, spec_accepted=prof_acc)
         self._consecutive_crashes = 0
         if seq >= self._mutation_seq:
             self._suspects.clear()
@@ -3376,6 +3563,12 @@ class ContinuousEngine:
         token — BEFORE the next chunk launch (same future-most-state
         contract as insert_slot); streaming clients get their first
         event right after TTFT."""
+        self.engine.flight.record(
+            "admit", request_id=req.trace.request_id, slot=req.slot,
+            prompt_tokens=req.prompt_tokens, budget=req.budget,
+            slo_class=req.slo,
+            **(self._alloc.span_attrs() if self.paged else {}),
+        )
         if req.first_id in self.cfg.all_stop_ids or req.budget == 0:
             self._finalize(req)
             return
@@ -3824,6 +4017,8 @@ class ContinuousEngine:
         mask = packed[K : 2 * K].astype(bool)
         active = packed[2 * K].astype(bool)
         self._distribute(emitted, mask, active, snapshot, seq=seq)
+        if self._launch_log:
+            self._prof_close_launch(t_launch)
         # healthy step: the fleet (as launched) fetched clean — reset the
         # supervisor's consecutive-crash window, and vindicate suspects
         # when no admission happened after this chunk's launch (an older
@@ -3935,8 +4130,13 @@ class ContinuousEngine:
         n = len(gen_ids)
         tps = n / elapsed if elapsed > 0 else 0.0
         if req.record:
-            self.engine._record_sample(req.ttft, tps, n, elapsed=elapsed,
-                                       engine="continuous")
+            self.engine._record_sample(
+                req.ttft, tps, n, elapsed=elapsed, engine="continuous",
+                trace_id=(
+                    req.trace_ctx.trace_id
+                    if req.trace_ctx is not None else None
+                ),
+            )
             # SLO feedback: the same per-request TTFT/TPOT samples the
             # timing histograms record feed the scheduler's per-class
             # EWMAs — drain estimates, urgency, and decode protection

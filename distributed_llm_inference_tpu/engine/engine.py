@@ -34,7 +34,8 @@ from ..utils import faults
 from ..utils.logging import get_logger, request_id_context
 from ..utils.metrics import DEFAULT_SIZE_BUCKETS, MetricsRegistry
 from ..utils.tokenizer import load_tokenizer
-from ..utils.tracing import Trace
+from ..utils.tracing import FlightRecorder, Trace
+from ..serving.trace_store import TraceStore
 from . import generate as G
 from .prefix import PrefixCache
 
@@ -689,6 +690,37 @@ class InferenceEngine:
         )
         if hasattr(self.backend, "attach_wire_metrics"):
             self.backend.attach_wire_metrics(self.metrics)
+        # Build identity (ISSUE 17 satellite): one always-1 gauge whose
+        # LABELS carry the version/runtime/config identity — the standard
+        # Prometheus build_info idiom, joinable against every other
+        # dli_* series. Kept to 4 literal labels (metrics-labels rule):
+        # the pp-wire/model-quant knobs collapse into one `knobs` string.
+        from .. import __version__ as _dli_version
+        self.metrics.gauge(
+            "dli_build_info",
+            "build/version identity (value is always 1; the labels are "
+            "the payload — join against any dli_* series)",
+            ("version", "jax", "replica_class", "knobs"),
+        ).labels(
+            version=_dli_version,
+            jax=jax.__version__,
+            replica_class=engine_cfg.replica_class,
+            knobs=(
+                f"quant={cfg.quant or 'none'}"
+                f",kv={cfg.kv_quant or 'none'}"
+                f",wire={engine_cfg.pp_wire_quant or 'none'}"
+            ),
+        ).set(1.0)
+        # Fleet tracing (ISSUE 17): the per-process span store this
+        # engine's serving edge records into (replica request spans,
+        # stage-segment child spans, fabric pulls, sampled launch
+        # attribution), and the control-plane flight recorder the
+        # continuous supervisor dumps into crash reports. Both bounded,
+        # both host-side only.
+        self.trace_store = TraceStore(
+            service=f"replica-{engine_cfg.replica_class}"
+        )
+        self.flight = FlightRecorder()
         # Paged runtime LoRA adapter pool (engine/adapters.AdapterPool) —
         # wired by create_engine (EngineConfig.adapter_slots > 0) or
         # adapters.attach_adapter_pool; None = base-only serving.
@@ -919,29 +951,38 @@ class InferenceEngine:
 
     def _record_sample(self, ttft: float, per_stream_tps: float, tokens: int,
                        elapsed: Optional[float] = None,
-                       engine: str = "solo"):
+                       engine: str = "solo",
+                       trace_id: Optional[str] = None):
         """Per-STREAM throughput sample (batch requests divide by B), so
         /stats percentiles stay comparable to the single-stream metric.
 
         The ONE seam feeding both observability views: the rolling deque
         (/stats percentiles) and the registry histograms (/metrics). Only
         recorded traffic reaches either — warmup never calls this, so it
-        is excluded from both views identically."""
+        is excluded from both views identically.
+
+        trace_id, when the request carried a fleet trace context, becomes
+        the latency histograms' EXEMPLAR: each bucket remembers the most
+        recent (trace_id, value) that landed in it, so a p99 bucket in
+        the JSON snapshot links to one concrete inspectable trace."""
         with self._samples_lock:
             self._samples.append(
                 {"ttft_s": ttft, "tokens_per_sec": per_stream_tps, "tokens": tokens}
             )
             self._samples_total += 1
-        self._m_ttft.labels(engine=engine).observe(ttft)
+        self._m_ttft.labels(engine=engine).observe(ttft, trace_id=trace_id)
         self._m_tokens.labels(engine=engine).inc(tokens)
         if elapsed is not None:
-            self._m_duration.labels(engine=engine).observe(elapsed)
+            self._m_duration.labels(engine=engine).observe(
+                elapsed, trace_id=trace_id
+            )
             if tokens > 1:
                 # TPOT (inter-token time): decode wall over the tokens
                 # after the first — the metric that exposes slow steps
                 # independently of prompt length
                 self._m_tpot.labels(engine=engine).observe(
-                    max(0.0, elapsed - ttft) / (tokens - 1)
+                    max(0.0, elapsed - ttft) / (tokens - 1),
+                    trace_id=trace_id,
                 )
 
     # -- main entry ----------------------------------------------------------
@@ -2549,6 +2590,21 @@ class InferenceEngine:
         }
         if self._prefix is not None:
             out["prefix_cache"] = self._prefix.stats()
+        # exemplars: the metrics -> traces pivot (ISSUE 17). Each latency
+        # bucket names the most recent traced request that landed in it,
+        # so a p99 outlier in this JSON view links straight to one
+        # assembled trace at GET /debug/traces/{trace_id}.
+        snap = self.metrics.snapshot()
+        exemplars: dict = {}
+        for fam in ("dli_ttft_seconds", "dli_tpot_seconds",
+                    "dli_request_duration_seconds"):
+            for series in snap.get(fam, {}).get("series", []):
+                if series.get("exemplars"):
+                    exemplars.setdefault(fam, {}).update(
+                        series["exemplars"]
+                    )
+        if exemplars:
+            out["exemplars"] = exemplars
         return out
 
     def drain(self, deadline_s: Optional[float] = None) -> bool:

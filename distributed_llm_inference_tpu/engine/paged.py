@@ -181,6 +181,17 @@ class BlockAllocator:
     def shared_blocks(self) -> int:
         return self._shared
 
+    def span_attrs(self) -> dict:
+        """Pool occupancy as flat span/flight-event attributes (ISSUE
+        17): the tracing span and flight-recorder payloads want a
+        JSON-ready snapshot, not live gauge objects. Cheap — three ints
+        already maintained by alloc/decref bookkeeping."""
+        return {
+            "pool_free": len(self._free),
+            "pool_outstanding": len(self._ref),
+            "pool_shared": self._shared,
+        }
+
     def refcount(self, block: int) -> int:
         """Current holder count (0 = on the free list / never allocated)."""
         return self._ref.get(block, 0)

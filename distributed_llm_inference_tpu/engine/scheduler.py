@@ -277,6 +277,11 @@ class TokenBudgetScheduler:
             )
         self.n_slots = int(n_slots)
         self.feedback = {name: _ClassFeedback() for name in classes}
+        # summary of the most recent non-empty plan() — the flight
+        # recorder's "plan" event embeds it so a crash dump shows the
+        # last budget split (per-class tiles) without replaying the
+        # scheduler (ISSUE 17 forensics)
+        self.last_plan: Optional[dict] = None
         # per-slot draft-acceptance feedback: slot -> [EWMA, skipped
         # plans] (adaptive K; reset on re-assignment via spec_reset)
         self._spec_fb: dict = {}
@@ -657,4 +662,12 @@ class TokenBudgetScheduler:
             tiles = grants.get(id(job), 0)
             if tiles > 0:
                 out.append((job, min(tiles * self.tile, job.remaining)))
+        self.last_plan = {
+            "decode_tiles": int(n_decode_tiles),
+            "prefill_tiles": int(sum(grants.values())),
+            "tiles_total": tiles_total,
+            "class_tiles": dict(tiles_for),
+            "jobs": len(jobs),
+            "chunks": len(out),
+        }
         return out

@@ -49,7 +49,7 @@ from typing import Optional
 import numpy as np
 
 from ..engine.block_prefix import chunk_digests
-from ..utils.logging import get_logger
+from ..utils.logging import get_logger, request_id_context
 
 log = get_logger("kv_fabric")
 
@@ -217,39 +217,67 @@ class KVFabricClient:
                 "fabric fetch wall time, failures included",
             ).labels()
 
-    def fetch(self, peer_url: str, digest: str,
-              block_size: int) -> Optional[tuple]:
+    def fetch(self, peer_url: str, digest: str, block_size: int,
+              ctx=None, request_id=None, store=None) -> Optional[tuple]:
         """GET {peer}/kv/{digest}, verify, return (keys, per_block_leaves)
         or None. Bounded by timeout_s end to end (a wedged peer costs one
-        deadline, then the caller prefills locally)."""
+        deadline, then the caller prefills locally).
+
+        Fleet tracing (ISSUE 17): `ctx` (a tracing.SpanContext) rides
+        the request as a `traceparent` header so the serving peer's /kv
+        span joins the same trace, `request_id` rides as X-Request-Id
+        (echoed back by the peer), and `store` (a TraceStore) records
+        this side's `fabric.pull` span around the whole fetch —
+        context managed, so every early return above closes it."""
         self.fetches += 1
         if self._m_fetches is not None:
             self._m_fetches.inc()
         t0 = time.perf_counter()
+        wall0 = time.time()
         ok = False
-        try:
-            if not valid_digest(digest):
-                raise FabricPayloadError(f"invalid digest {digest[:80]!r}")
-            url = peer_url.rstrip("/") + "/kv/" + digest
-            with urllib.request.urlopen(url, timeout=self.timeout_s) as r:
-                data = r.read()
-            out = decode_chain(data, block_size, digest)
-            ok = True
-        except FabricPayloadError as e:
-            log.warning("kv_fabric_payload_rejected", peer=peer_url,
-                        digest=digest, error=str(e))
-            out = None
-        except (urllib.error.URLError, urllib.error.HTTPError, OSError,
-                TimeoutError, ValueError) as e:
-            # 404 (evicted / never resident), connect refused (peer
-            # kill -9'd mid-handoff), socket timeout (wedged peer) — all
-            # one outcome: prefill locally
-            log.info("kv_fabric_miss", peer=peer_url, digest=digest,
-                     error=str(e))
-            out = None
-        finally:
-            if self._m_seconds is not None:
-                self._m_seconds.observe(time.perf_counter() - t0)
+        with request_id_context(request_id, getattr(ctx, "trace_id", None)):
+            try:
+                if not valid_digest(digest):
+                    raise FabricPayloadError(
+                        f"invalid digest {digest[:80]!r}"
+                    )
+                url = peer_url.rstrip("/") + "/kv/" + digest
+                headers = {}
+                if ctx is not None:
+                    headers["traceparent"] = ctx.header()
+                if request_id:
+                    headers["X-Request-Id"] = request_id
+                req = urllib.request.Request(url, headers=headers)
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as r:
+                    data = r.read()
+                out = decode_chain(data, block_size, digest)
+                ok = True
+            except FabricPayloadError as e:
+                log.warning("kv_fabric_payload_rejected", peer=peer_url,
+                            digest=digest, error=str(e))
+                out = None
+            except (urllib.error.URLError, urllib.error.HTTPError, OSError,
+                    TimeoutError, ValueError) as e:
+                # 404 (evicted / never resident), connect refused (peer
+                # kill -9'd mid-handoff), socket timeout (wedged peer) —
+                # all one outcome: prefill locally
+                log.info("kv_fabric_miss", peer=peer_url, digest=digest,
+                         error=str(e))
+                out = None
+            finally:
+                if self._m_seconds is not None:
+                    self._m_seconds.observe(time.perf_counter() - t0)
+                if store is not None and ctx is not None:
+                    store.add_span(
+                        ctx.trace_id, "fabric.pull", wall0, time.time(),
+                        parent_id=ctx.span_id,
+                        attrs={
+                            "peer": peer_url, "digest": str(digest)[:16],
+                            "hit": ok,
+                        },
+                    )
         if not ok or out is None:
             self.misses += 1
             if self._m_misses is not None:

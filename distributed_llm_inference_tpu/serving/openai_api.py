@@ -399,14 +399,18 @@ def _logprobs_obj(entry: dict) -> Optional[dict]:
             "text_offset": None}
 
 
-def _observability_fields(request_id, timings) -> dict:
+def _observability_fields(request_id, timings, trace_id=None) -> dict:
     """Extension keys carried on every non-streaming response: the
-    request_id (also echoed as the X-Request-Id header) and the trace's
-    stage breakdown. Extra top-level keys are OpenAI-SDK-safe (clients
-    ignore unknown fields)."""
+    request_id (also echoed as the X-Request-Id header), the fleet
+    trace_id (also the X-Trace-Id header — fetch the assembled tree at
+    GET /debug/traces/{trace_id}), and the trace's stage breakdown.
+    Extra top-level keys are OpenAI-SDK-safe (clients ignore unknown
+    fields)."""
     out = {}
     if request_id:
         out["request_id"] = request_id
+    if trace_id:
+        out["trace_id"] = trace_id
     if timings:
         out["timings"] = timings
     return out
@@ -416,7 +420,8 @@ def completion_response(entries: list, model: str, kwargs: dict,
                         prompt_once: bool = False,
                         request_id: Optional[str] = None,
                         timings: Optional[dict] = None,
-                        kv_extra: Optional[dict] = None) -> dict:
+                        kv_extra: Optional[dict] = None,
+                        trace_id: Optional[str] = None) -> dict:
     """Engine success envelope(s) -> one text_completion response.
 
     kv_extra: KV-fabric extension fields (kv_digests / kv_fabric_blocks /
@@ -444,7 +449,7 @@ def completion_response(entries: list, model: str, kwargs: dict,
         "model": model,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
-        **_observability_fields(request_id, timings),
+        **_observability_fields(request_id, timings, trace_id),
         **(kv_extra or {}),
     }
 
@@ -453,7 +458,8 @@ def chat_response(entries: list, model: str, kwargs: dict,
                   prompt_once: bool = False,
                   request_id: Optional[str] = None,
                   timings: Optional[dict] = None,
-                  kv_extra: Optional[dict] = None) -> dict:
+                  kv_extra: Optional[dict] = None,
+                  trace_id: Optional[str] = None) -> dict:
     choices = []
     for i, entry in enumerate(entries):
         choice = {
@@ -480,7 +486,7 @@ def chat_response(entries: list, model: str, kwargs: dict,
         "model": model,
         "choices": choices,
         "usage": _usage(entries, prompt_once),
-        **_observability_fields(request_id, timings),
+        **_observability_fields(request_id, timings, trace_id),
         **(kv_extra or {}),
     }
 

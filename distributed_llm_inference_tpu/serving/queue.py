@@ -43,7 +43,7 @@ log = get_logger("queue")
 
 class _Pending:
     __slots__ = ("prompt", "kwargs", "done", "result", "enqueued", "is_batch",
-                 "trace", "slo", "deadline_at")
+                 "trace", "slo", "deadline_at", "trace_ctx")
 
     def __init__(self, prompt, kwargs: dict, is_batch: bool = False):
         self.prompt = prompt  # str, or list[str] for a client batch
@@ -69,6 +69,12 @@ class _Pending:
         # span; solo dispatch hands the SAME trace to the engine so the
         # response's timings cover enqueue -> detokenize contiguously
         self.trace = Trace(kwargs.pop("request_id", None))
+        # fleet trace context (serving/server.py sets it): consumed here —
+        # engine.generate has no seam for it, and the server's own
+        # replica.request span already brackets the queue wait (which
+        # lands in this trace's queue_wait timing, hence in the exported
+        # stage spans)
+        self.trace_ctx = kwargs.pop("trace_ctx", None)
 
     def coalesce_key(self):
         k = self.kwargs

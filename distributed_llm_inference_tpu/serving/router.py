@@ -71,15 +71,27 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..engine.block_prefix import chunk_digests
-from ..utils.logging import get_logger
+from ..utils.logging import get_logger, request_id_context
 from ..utils.metrics import MetricsRegistry
 from ..utils.retry import parse_retry_after
-from ..utils.tracing import new_request_id, sanitize_request_id
+from ..utils.tracing import (
+    SpanContext,
+    new_request_id,
+    parse_traceparent,
+    sanitize_request_id,
+)
+from .trace_store import (
+    TraceStore,
+    assemble_tree,
+    span_tree_total,
+    to_chrome_trace,
+)
 
 log = get_logger("router")
 
@@ -113,11 +125,14 @@ _FORWARD_ROUTES = ("/generate", "/v1/completions", "/v1/chat/completions")
 
 _KNOWN_ROUTES = frozenset((
     "/", "/health", "/ready", "/stats", "/metrics", "/v1/models",
-    "/admin/rolling-restart", *_FORWARD_ROUTES,
+    "/admin/rolling-restart", "/debug/traces", "/debug/flight",
+    *_FORWARD_ROUTES,
 ))
 
 
 def _route_label(path: str) -> str:
+    if path.startswith("/debug/traces"):
+        return "/debug/traces"  # one label for every trace id
     return path if path in _KNOWN_ROUTES else "other"
 
 
@@ -238,6 +253,24 @@ class Router:
         self._probe_thread: Optional[threading.Thread] = None
 
         self.metrics = MetricsRegistry()
+        # the router's half of the fleet trace: its request/dispatch/
+        # retry/handoff spans land here; GET /debug/traces/{id} merges
+        # them with every replica's spans into one tree (collect_trace)
+        self.trace_store = TraceStore(service="router")
+        from .. import __version__ as _dli_version
+
+        # build-identity gauge, same family the engines pre-register
+        # (engine/engine.py) — always 1, the labels are the payload; the
+        # router never imports jax, so that label reports "none" here
+        self.metrics.gauge(
+            "dli_build_info",
+            "build/version identity (value is always 1; the labels are "
+            "the payload — join against any dli_* series)",
+            ("version", "jax", "replica_class", "knobs"),
+        ).labels(
+            version=_dli_version, jax="none", replica_class="router",
+            knobs="",
+        ).set(1.0)
         self._m_requests = self.metrics.counter(
             "dli_router_requests_total",
             "requests proxied per replica by upstream outcome",
@@ -584,11 +617,16 @@ class Router:
             self._m_outstanding.labels(replica=rep.rid).set(rep.outstanding)
 
     def _proxy(self, rep: Replica, path: str, body: bytes, rid: str,
-               timeout: Optional[float] = None, extra_headers=None):
+               timeout: Optional[float] = None, extra_headers=None,
+               trace_ctx=None):
         """One POST to one replica. Returns (status, body_bytes, headers);
         HTTP error statuses come back as values, connect-level failures
-        raise (urllib.error.URLError / OSError)."""
+        raise (urllib.error.URLError / OSError). trace_ctx (a
+        tracing.SpanContext) rides as `traceparent` so the replica's
+        spans join this trace under the attempt's span."""
         hdrs = {"Content-Type": "application/json", "X-Request-Id": rid}
+        if trace_ctx is not None:
+            hdrs["traceparent"] = trace_ctx.header()
         if extra_headers:
             hdrs.update(extra_headers)
         req = urllib.request.Request(
@@ -604,7 +642,8 @@ class Router:
 
     def dispatch(self, path: str, body: bytes, affinity_key: str,
                  rid: str, deadline_ms: Optional[float] = None,
-                 hint_headers: Optional[dict] = None) -> tuple:
+                 hint_headers: Optional[dict] = None,
+                 trace_ctx=None) -> tuple:
         """Route one NON-STREAMED request with transparent failover.
 
         Returns (replica_or_None, status, body_bytes, headers, attempts).
@@ -627,7 +666,13 @@ class Router:
         hint_headers: fixed X-KV-Transfer-* headers (a handoff's phase
         2); when absent, each attempt derives its own fabric hint from
         the residency view, so a replica that misses the prefix pulls
-        it from the resident peer instead of re-prefilling."""
+        it from the resident peer instead of re-prefilling.
+
+        trace_ctx: the request's SpanContext. Every attempt records its
+        own span — `router.dispatch` for the first, `router.retry` for
+        failover hops — and the replica joins the trace UNDER that
+        attempt's span via the relayed traceparent, so a failed-over
+        request's tree shows exactly which hop served it."""
         t_in = time.monotonic()
         tried: set = set()
         prev: Optional[Replica] = None
@@ -658,10 +703,23 @@ class Router:
                 self._m_failovers.labels(replica=prev.rid).inc()
                 log.info("failover", request_id=rid,
                          from_replica=prev.rid, to_replica=rep.rid)
+            sp = None
+            sub_ctx = None
+            if trace_ctx is not None:
+                # one span per attempt: the first is the dispatch, every
+                # further hop is a retry — the failover trail is readable
+                # straight off the assembled tree
+                sp = self.trace_store.start_span(
+                    "router.dispatch" if attempt == 0 else "router.retry",
+                    trace_ctx,
+                    attrs={"replica": rep.rid, "attempt": attempt + 1},
+                )
+                sub_ctx = trace_ctx.child(sp["span_id"])
             self._begin(rep)
             try:
                 status, rbody, headers = self._proxy(
-                    rep, path, body, rid, extra_headers=extra
+                    rep, path, body, rid, extra_headers=extra,
+                    trace_ctx=sub_ctx,
                 )
             # HTTPException covers IncompleteRead/RemoteDisconnected — a
             # replica kill -9'd MID-RESPONSE surfaces as one of these,
@@ -673,10 +731,16 @@ class Router:
                     replica=rep.rid, code="connect_error"
                 ).inc()
                 self.note_failure(rep, why=f"proxy: {e}")
+                if sp is not None:
+                    self.trace_store.end_span(
+                        sp, attrs={"outcome": "connect_error"}
+                    )
                 prev = rep
                 continue
             finally:
                 self._end(rep)
+            if sp is not None:
+                self.trace_store.end_span(sp, attrs={"status": status})
             self._m_requests.labels(replica=rep.rid, code=str(status)).inc()
             if status == 504:
                 # deadline_exceeded: a property of the REQUEST's budget,
@@ -729,8 +793,8 @@ class Router:
         )
 
     def maybe_handoff(self, path: str, body: bytes, affinity_key: str,
-                      rid: str,
-                      deadline_ms: Optional[float] = None) -> Optional[dict]:
+                      rid: str, deadline_ms: Optional[float] = None,
+                      trace_ctx=None) -> Optional[dict]:
         """Phase 1 of the disaggregated dispatch, when it applies: send
         the request to a prefill-class replica with X-KV-Prefill-Only
         (it prefills, shadows, flushes, answers with the prefix's chain
@@ -771,10 +835,21 @@ class Router:
         extra = {"X-KV-Prefill-Only": "1"}
         if deadline_ms is not None:
             extra["X-Request-Deadline-Ms"] = f"{deadline_ms:.0f}"
+        sp = None
+        sub_ctx = None
+        if trace_ctx is not None:
+            # phase 1 of the two-phase dispatch gets its own span; the
+            # prefill replica's spans nest under it via the traceparent
+            sp = self.trace_store.start_span(
+                "router.handoff_prefill", trace_ctx,
+                attrs={"replica": rep.rid},
+            )
+            sub_ctx = trace_ctx.child(sp["span_id"])
         self._begin(rep)
         try:
             status, rbody, _hdrs = self._proxy(
-                rep, path, body, rid, extra_headers=extra
+                rep, path, body, rid, extra_headers=extra,
+                trace_ctx=sub_ctx,
             )
         except (urllib.error.URLError, OSError,
                 http.client.HTTPException) as e:
@@ -783,6 +858,8 @@ class Router:
             return None
         finally:
             self._end(rep)
+            if sp is not None:
+                self.trace_store.end_span(sp)
         self._m_requests.labels(replica=rep.rid, code=str(status)).inc()
         if status != 200:
             # busy/draining/erroring prefill tier: the token-loop
@@ -822,6 +899,44 @@ class Router:
         self._m_handoffs.labels(
             outcome="handoff" if imported else "cold_fallback"
         ).inc()
+
+    # -- fleet trace / flight assembly ---------------------------------------
+    def collect_trace(self, trace_id: str) -> list:
+        """The full cross-process span list for `trace_id`: this router's
+        own spans plus every replica's (GET /debug/traces/{id} — the flat
+        `spans` field, one schema fleet-wide). Unreachable or evicted
+        stores degrade to a PARTIAL trace — assemble_tree surfaces the
+        orphaned subtrees as extra roots — never an error."""
+        spans = self.trace_store.get(trace_id)
+        for rep in self.replicas:
+            try:
+                with urllib.request.urlopen(
+                    rep.url + "/debug/traces/"
+                    + urllib.parse.quote(trace_id, safe=""),
+                    timeout=self.probe_timeout_s,
+                ) as resp:
+                    payload = json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError):
+                continue
+            got = payload.get("spans") if isinstance(payload, dict) else None
+            if isinstance(got, list):
+                spans.extend(s for s in got if isinstance(s, dict))
+        return spans
+
+    def collect_flight(self) -> dict:
+        """Every replica's flight-recorder dump, keyed by replica id
+        (the router itself keeps no ring — it is stateless glue)."""
+        out = {}
+        for rep in self.replicas:
+            try:
+                with urllib.request.urlopen(
+                    rep.url + "/debug/flight",
+                    timeout=self.probe_timeout_s,
+                ) as resp:
+                    out[rep.rid] = json.loads(resp.read())
+            except (urllib.error.URLError, OSError, ValueError):
+                out[rep.rid] = {"error": "unreachable"}
+        return out
 
     # -- aggregate views -----------------------------------------------------
     def replica_health(self, rep: Replica) -> dict:
@@ -1080,6 +1195,12 @@ def make_router_handler(router: Router):
             pass
 
         _rid: Optional[str] = None
+        # inbound (traceparent) or freshly-rooted SpanContext, set per
+        # POST; echoed as X-Trace-Id so clients can fetch their trace
+        _trace_ctx: Optional[SpanContext] = None
+        # child context under the router.request span — what rides the
+        # traceparent header to replicas on dispatch/handoff/stream
+        _span_ctx: Optional[SpanContext] = None
 
         def _count(self, code: int):
             http_requests.labels(
@@ -1100,6 +1221,8 @@ def make_router_handler(router: Router):
             self.send_header("Content-Length", str(len(body)))
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
+            if self._trace_ctx is not None:
+                self.send_header("X-Trace-Id", self._trace_ctx.trace_id)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1107,6 +1230,10 @@ def make_router_handler(router: Router):
 
         # -- GET surface -----------------------------------------------------
         def do_GET(self):
+            # keep-alive connections reuse this handler instance: a prior
+            # POST's correlation ids must not leak into GET responses
+            self._rid = None
+            self._trace_ctx = None
             path = self.path.split("?")[0].rstrip("/") or "/"
             if path == "/":
                 h = router.stats()
@@ -1144,6 +1271,33 @@ def make_router_handler(router: Router):
                     200, router.metrics.render(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
+            elif path == "/debug/flight":
+                # the router keeps no flight recorder of its own
+                # (stateless glue) — aggregate the replicas' rings
+                self._send(200, {"replicas": router.collect_flight()})
+            elif path.startswith("/debug/traces"):
+                rest = path[len("/debug/traces"):].lstrip("/")
+                if not rest:
+                    self._send(200, {
+                        "traces": router.trace_store.trace_ids(),
+                        "stats": router.trace_store.stats(),
+                    })
+                    return
+                trace_id = urllib.parse.unquote(rest)
+                spans = router.collect_trace(trace_id)
+                if not spans:
+                    self._send(404, {"error": f"unknown trace {trace_id}"})
+                    return
+                if "format=chrome" in self.path.partition("?")[2]:
+                    self._send(200, to_chrome_trace(spans))
+                    return
+                roots = assemble_tree(spans)
+                self._send(200, {
+                    "trace_id": trace_id,
+                    "spans": spans,
+                    "tree": roots,
+                    "total_s": span_tree_total(roots),
+                })
             elif path == "/v1/models":
                 # proxy to any dispatchable replica (model list is
                 # identical across a homogeneous fleet)
@@ -1171,6 +1325,12 @@ def make_router_handler(router: Router):
             self._rid = (
                 sanitize_request_id(self.headers.get("X-Request-Id"))
                 or new_request_id()
+            )
+            # join the caller's trace (W3C traceparent) or root a fresh
+            # one; a malformed header degrades to a fresh root
+            self._trace_ctx = (
+                parse_traceparent(self.headers.get("traceparent"))
+                or SpanContext.new_root()
             )
             if path == "/admin/rolling-restart":
                 res = router.start_rolling_restart()
@@ -1206,7 +1366,17 @@ def make_router_handler(router: Router):
                 )
                 return
             try:
-                self._dispatch_post(path, body, data)
+                ctx = self._trace_ctx
+                with request_id_context(self._rid, ctx.trace_id):
+                    # root span of the router hop: every downstream span
+                    # (dispatch attempts, handoff, the replica's own
+                    # replica.request) nests under it via traceparent
+                    with router.trace_store.span(
+                        "router.request", ctx,
+                        attrs={"request_id": self._rid, "route": path},
+                    ) as sp:
+                        self._span_ctx = ctx.child(sp["span_id"])
+                        self._dispatch_post(path, body, data)
             finally:
                 router.tenant_end(tenant)
 
@@ -1221,7 +1391,7 @@ def make_router_handler(router: Router):
             # wall time burns the request's own deadline budget.
             hint = router.maybe_handoff(
                 path, body, affinity_key, self._rid,
-                deadline_ms=deadline_ms,
+                deadline_ms=deadline_ms, trace_ctx=self._span_ctx,
             )
             if deadline_ms is not None:
                 deadline_ms -= (time.perf_counter() - t0) * 1e3
@@ -1232,6 +1402,7 @@ def make_router_handler(router: Router):
             rep, status, rbody, headers, attempts = router.dispatch(
                 path, body, affinity_key, self._rid,
                 deadline_ms=deadline_ms, hint_headers=hint,
+                trace_ctx=self._span_ctx,
             )
             fwd = {
                 k: v for k, v in headers.items() if k == "Retry-After"
@@ -1275,6 +1446,11 @@ def make_router_handler(router: Router):
             for _ in range(router.failover_attempts):
                 hdrs = {"Content-Type": "application/json",
                         "X-Request-Id": self._rid}
+                if self._span_ctx is not None:
+                    # streamed attempts join under the router.request
+                    # span (which stays open across the whole stream —
+                    # do_POST's contextmanager closes it after we return)
+                    hdrs["traceparent"] = self._span_ctx.header()
                 if deadline_ms is not None:
                     left = deadline_ms - (time.monotonic() - t_in) * 1e3
                     if left <= 0:
@@ -1354,6 +1530,10 @@ def make_router_handler(router: Router):
                     )
                     if self._rid:
                         self.send_header("X-Request-Id", self._rid)
+                    if self._trace_ctx is not None:
+                        self.send_header(
+                            "X-Trace-Id", self._trace_ctx.trace_id
+                        )
                     self.end_headers()
                     router.record_residency(digests, rep.rid)
                     while True:

@@ -136,7 +136,7 @@ class _Profiler:
 _KNOWN_ROUTES = frozenset((
     "/", "/health", "/ready", "/workers", "/stats", "/metrics", "/v1/models",
     "/generate", "/v1/completions", "/v1/chat/completions",
-    "/profiler/start", "/profiler/stop",
+    "/profiler/start", "/profiler/stop", "/debug/traces", "/debug/flight",
 ))
 
 # Retry-After (seconds) sent with every drain/overload rejection — the
@@ -147,14 +147,23 @@ RETRY_AFTER_S = 2
 def _route_label(path: str) -> str:
     if path == "/kv" or path.startswith("/kv/"):
         return "/kv"  # one label for every digest (bounded cardinality)
+    if path.startswith("/debug/traces"):
+        return "/debug/traces"  # one label for every trace id
     return path if path in _KNOWN_ROUTES else "other"
 
 
 def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = None,
                  queue=None, continuous=None, state=None,
                  wedge_unready_s: float = 10.0):
-    from ..utils.tracing import new_request_id, sanitize_request_id
+    from ..utils.logging import request_id_context
+    from ..utils.tracing import (
+        SpanContext,
+        new_request_id,
+        parse_traceparent,
+        sanitize_request_id,
+    )
     from . import openai_api as oai
+    from .trace_store import assemble_tree, span_tree_total, to_chrome_trace
 
     profiler = profiler or _Profiler()
     if state is None:  # embedding callers without an InferenceServer
@@ -188,6 +197,9 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             pass
 
         _rid: Optional[str] = None  # set per POST; echoed as X-Request-Id
+        # inbound (traceparent header) or freshly-rooted SpanContext; set
+        # per POST, echoed as X-Trace-Id so callers can find their trace
+        _trace_ctx: Optional[SpanContext] = None
 
         def _count(self, code: int):
             http_requests.labels(
@@ -208,6 +220,8 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             self.send_header("Content-Length", str(len(body)))
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
+            if self._trace_ctx is not None:
+                self.send_header("X-Trace-Id", self._trace_ctx.trace_id)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -236,6 +250,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             return True, None
 
         def do_GET(self):
+            # reset per-request correlation state: keep-alive connections
+            # reuse this handler instance, and a prior POST's ids must not
+            # leak into this response's headers
+            self._rid = None
+            self._trace_ctx = None
             path = self.path.split("?")[0].rstrip("/") or "/"
             if path == "/":
                 self._send(200, _status_html(engine), content_type="text/html")
@@ -322,17 +341,74 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         adapters=adapters.names() if adapters else (),
                     )
                 )
+            elif path == "/debug/flight":
+                # live flight-recorder view: the SAME bounded ring the
+                # continuous supervisor dumps into crash reports (and
+                # persists next to --restore-dir on a crash)
+                flight = getattr(engine, "flight", None)
+                self._send(
+                    200,
+                    flight.dump() if flight is not None
+                    else {"capacity": 0, "recorded_total": 0, "events": []},
+                )
+            elif path == "/debug/traces" or path.startswith("/debug/traces/"):
+                # this process's span store: the bare route lists known
+                # trace ids; /debug/traces/{id} returns that trace's spans
+                # plus the locally-assembled tree (the router concatenates
+                # the flat `spans` lists from every replica to build the
+                # full cross-process view); ?format=chrome emits Chrome
+                # trace-event JSON loadable in Perfetto
+                store = getattr(engine, "trace_store", None)
+                if store is None:
+                    self._send(404, {"error": "no trace store"})
+                    return
+                trace_id = path[len("/debug/traces/"):] if path.startswith(
+                    "/debug/traces/"
+                ) else ""
+                if not trace_id:
+                    self._send(200, {
+                        "traces": store.trace_ids(), "stats": store.stats(),
+                    })
+                elif "format=chrome" in self.path.partition("?")[2]:
+                    self._send(200, to_chrome_trace(store.get(trace_id)))
+                else:
+                    spans = store.get(trace_id)
+                    tree = assemble_tree(spans)
+                    self._send(200, {
+                        "trace_id": trace_id,
+                        "service": store.service,
+                        "spans": spans,
+                        "tree": tree,
+                        "total_s": round(span_tree_total(tree), 6),
+                    })
             elif path.startswith("/kv/"):
                 # the KV fabric's serving half (serving/kv_fabric.py):
                 # the resident shadow chain ending at this chunk digest,
                 # wire-encoded. A miss — unknown digest, LRU-evicted, or
                 # fabric disabled — is a 404 the fetching peer treats as
-                # "prefill locally", never an error.
+                # "prefill locally", never an error. The fetching peer's
+                # X-Request-Id is echoed back and its traceparent joins
+                # this serve to the same trace as its fabric.pull span.
+                self._rid = sanitize_request_id(
+                    self.headers.get("X-Request-Id")
+                )
+                ctx = parse_traceparent(self.headers.get("traceparent"))
+                self._trace_ctx = ctx
                 digest = path[len("/kv/"):]
+                t0 = time.time()
                 chain = (
                     continuous.fabric_chain(digest)
                     if continuous is not None else None
                 )
+                if ctx is not None:
+                    engine.trace_store.add_span(
+                        ctx.trace_id, "kv.serve", t0, time.time(),
+                        parent_id=ctx.span_id,
+                        attrs={
+                            "digest": digest[:16],
+                            "hit": chain is not None,
+                        },
+                    )
                 if chain is None:
                     self._send(404, {
                         "error": f"no resident chain for digest "
@@ -402,18 +478,84 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
 
         def _run_single(self, prompt: str, kwargs: dict) -> dict:
             """One prompt through the same dispatch ladder as /generate:
-            continuous fleet > bounded queue > bare engine."""
+            continuous fleet > bounded queue > bare engine. This is the
+            replica's span-recording point: the whole dispatch runs under
+            a `replica.request` span, the finished envelope's contiguous
+            stage timings re-export as its child spans (uniform across
+            all three ladder rungs), and the child context rides kwargs
+            into the continuous engine so its launch-attribution spans
+            nest under the same parent."""
+            ctx = self._trace_ctx
+            store = getattr(engine, "trace_store", None)
+            if ctx is None or store is None:  # embedding callers
+                return self._dispatch(prompt, kwargs)
+            with store.span("replica.request", ctx, attrs={
+                "request_id": kwargs.get("request_id"),
+            }) as sp:
+                kwargs["trace_ctx"] = ctx.child(sp["span_id"])
+                result = self._dispatch(prompt, kwargs)
+                sp["attrs"]["status"] = result.get("status")
+                self._stage_spans(store, sp, result)
+            return result
+
+        def _stream_span(self, kwargs: dict):
+            """Open the replica.request span for a STREAMED request and
+            thread the child context into kwargs. The span outlives this
+            frame by design — ownership transfers to the stream loop,
+            whose finally calls end_span (the explicit-pair form the
+            span-store docstring reserves for exactly this case)."""
+            ctx = self._trace_ctx
+            store = getattr(engine, "trace_store", None)
+            if ctx is None or store is None:
+                return None
+            sp = store.start_span("replica.request", ctx, attrs={
+                "request_id": kwargs.get("request_id"), "stream": True,
+            })
+            kwargs["trace_ctx"] = ctx.child(sp["span_id"])
+            return sp
+
+        def _dispatch(self, prompt: str, kwargs: dict) -> dict:
             if continuous is not None:
                 return continuous.submit(prompt, **kwargs)
             if queue is not None:
                 return queue.submit(prompt, **kwargs)
+            kwargs.pop("trace_ctx", None)  # no bare-engine seam for it
             return engine.generate(prompt, **kwargs)
+
+        @staticmethod
+        def _stage_spans(store, parent: dict, result: dict):
+            """Re-export the envelope's contiguous `timings` breakdown
+            (utils/tracing.Trace: spans sum to ≈ total by construction)
+            as child spans of `parent`, laid end to end from the request
+            span's start — the per-stage view (queue_wait / admission /
+            prefill / decode / detokenize) lands in the assembled fleet
+            trace without a second engine-side recording hook."""
+            timings = result.get("timings")
+            if not isinstance(timings, dict):
+                return
+            t = parent["t0"]
+            for key, dur in timings.items():
+                if key == "total_s" or not key.endswith("_s"):
+                    continue
+                try:
+                    dur = float(dur)
+                except (TypeError, ValueError):
+                    continue
+                store.add_span(
+                    parent["trace_id"], f"stage.{key[:-2]}", t, t + dur,
+                    parent_id=parent["span_id"],
+                )
+                t += dur
 
         def _openai_stream(self, prompt: str, kwargs: dict, chat: bool):
             """SSE streaming: real per-chunk deltas on a --continuous
             server, single-chunk emulation otherwise (still valid SSE, so
             OpenAI-SDK streaming clients work against any server config)."""
+            sp = None
             if continuous is not None:
+                # real streaming records its request span here (the
+                # non-continuous emulation goes through _run_single's)
+                sp = self._stream_span(kwargs)
                 events = continuous.stream(prompt, **kwargs)
             else:
                 def _one_shot():
@@ -429,6 +571,8 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             self.send_header("Cache-Control", "no-cache")
             if self._rid:
                 self.send_header("X-Request-Id", self._rid)
+            if self._trace_ctx is not None:
+                self.send_header("X-Trace-Id", self._trace_ctx.trace_id)
             self.end_headers()
             try:
                 for payload, _final in oai.stream_events(
@@ -447,6 +591,9 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 # tests/test_preemption.py)
                 if hasattr(events, "close"):
                     events.close()  # cancel: frees the decode slot
+            finally:
+                if sp is not None:
+                    engine.trace_store.end_span(sp)
 
         def _openai(self, path: str, data: dict):
             chat = path == "/v1/chat/completions"
@@ -592,7 +739,9 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                       prompt_once=prompt_once,
                       request_id=envelope.get("request_id", self._rid),
                       timings=envelope.get("timings"),
-                      kv_extra=kv_extra or None),
+                      kv_extra=kv_extra or None,
+                      trace_id=(self._trace_ctx.trace_id
+                                if self._trace_ctx is not None else None)),
             )
 
         def do_POST(self):
@@ -604,6 +753,17 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 sanitize_request_id(self.headers.get("X-Request-Id"))
                 or new_request_id()
             )
+            # join the caller's trace (router/client `traceparent`) or
+            # root a fresh one; every log record inside the request then
+            # carries both ids (utils/logging request_id_context)
+            self._trace_ctx = (
+                parse_traceparent(self.headers.get("traceparent"))
+                or SpanContext.new_root()
+            )
+            with request_id_context(self._rid, self._trace_ctx.trace_id):
+                self._do_POST(path)
+
+        def _do_POST(self, path: str):
             if state.draining and path in (
                 "/generate", "/v1/completions", "/v1/chat/completions"
             ):
@@ -812,7 +972,12 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     self.send_header("Content-Type", "application/x-ndjson")
                     if self._rid:
                         self.send_header("X-Request-Id", self._rid)
+                    if self._trace_ctx is not None:
+                        self.send_header(
+                            "X-Trace-Id", self._trace_ctx.trace_id
+                        )
                     self.end_headers()
+                    sp = self._stream_span(kwargs)
                     gen = continuous.stream(prompt, **kwargs)
                     try:
                         for ev in gen:
@@ -824,6 +989,9 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         # its slot at the next chunk boundary so the fleet
                         # serves queued work instead of a dead socket
                         gen.close()
+                    finally:
+                        if sp is not None:
+                            engine.trace_store.end_span(sp)
                     return
                 if prompts is not None:
                     # batched form: "prompts": [...] -> one fleet, N results
@@ -858,21 +1026,12 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     kwargs["logprobs"] = _parse_bool(
                         data.get("logprobs", False), "logprobs"
                     )
-                    if continuous is not None:
-                        # in-flight batching (engine/continuous.py): joins a
-                        # free KV slot mid-decode; bounded admission queue
-                        # sheds with 429; seeded/debug requests fall back
-                        # to the solo engine inside submit() (greedy
-                        # speculative ones run in-fleet on spec-capable
-                        # ragged paged fleets — verify rows in the mixed
-                        # launch)
-                        result = continuous.submit(prompt, **kwargs)
-                    elif queue is not None:
-                        # bounded backpressure + concurrent-singles
-                        # coalescing (serving/queue.py); full -> 429
-                        result = queue.submit(prompt, **kwargs)
-                    else:
-                        result = engine.generate(prompt, **kwargs)
+                    # the same dispatch ladder as the OpenAI routes —
+                    # continuous (in-flight batching, engine/continuous.py)
+                    # > bounded queue (serving/queue.py) > bare engine —
+                    # via the one span-recording point, so /generate and
+                    # /v1/* requests trace identically
+                    result = self._run_single(prompt, kwargs)
             except (TypeError, ValueError) as e:
                 self._send(400, {"error": f"bad parameter: {e}"})
                 return
@@ -1021,7 +1180,8 @@ class InferenceServer:
         get_logger("server").info(
             "serving", port=self.port,
             routes=["/generate", "/health", "/ready", "/workers", "/stats",
-                    "/metrics", "/profiler/*"],
+                    "/metrics", "/profiler/*", "/debug/traces",
+                    "/debug/flight"],
         )
         print(f"🚀 serving on :{self.port} — /generate /health /ready /workers /metrics /")
         self.httpd.serve_forever()
@@ -1280,6 +1440,15 @@ def main(argv: Optional[list] = None):
              "(utils/faults.py), e.g. 'decode_launch:transient:on=3'; "
              "the DLI_FAULTS env var is the config-file-free spelling. "
              "Chaos drills only — never in front of real traffic",
+    )
+    ap.add_argument(
+        "--trace-sample-rate", type=float, default=0.0, metavar="F",
+        help="fraction of traced requests that also get launch-level "
+             "device-time attribution on the continuous fleet: sampled "
+             "requests' mixed/chunk launches record dispatch->fetch "
+             "spans (host timestamps keyed by launch seq — never an "
+             "extra device sync) into GET /debug/traces/{trace_id}. "
+             "0 (default) keeps the hot path allocation-free",
     )
     ap.add_argument(
         "--wedge-unready", type=float, default=10.0, metavar="SECONDS",
@@ -1549,6 +1718,7 @@ def main(argv: Optional[list] = None):
             adapter_rank=args.adapter_rank,
             tenant_weights=tuple(tenant_weights),
             tenant_max_queue_share=args.tenant_queue_share,
+            trace_sample_rate=args.trace_sample_rate,
         ),
         microbatches=args.microbatches,
         params=params,

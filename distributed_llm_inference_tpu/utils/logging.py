@@ -30,6 +30,13 @@ _CONFIGURED = False
 _REQUEST_ID: contextvars.ContextVar = contextvars.ContextVar(
     "request_id", default=None
 )
+# Current W3C trace id (utils/tracing.SpanContext): same contract as the
+# request id, set by the serving edges (router POST handling, replica
+# request handling, fabric code paths) so router- and fabric-side log
+# records carry the fleet-wide trace id too — not just the engine side.
+_TRACE_ID: contextvars.ContextVar = contextvars.ContextVar(
+    "trace_id", default=None
+)
 
 
 def set_request_id(rid: Optional[str]):
@@ -42,12 +49,19 @@ def get_request_id() -> Optional[str]:
     return _REQUEST_ID.get()
 
 
+def get_trace_id() -> Optional[str]:
+    return _TRACE_ID.get()
+
+
 @contextlib.contextmanager
-def request_id_context(rid: Optional[str]):
+def request_id_context(rid: Optional[str], trace_id: Optional[str] = None):
     token = _REQUEST_ID.set(rid)
+    t_token = _TRACE_ID.set(trace_id) if trace_id is not None else None
     try:
         yield
     finally:
+        if t_token is not None:
+            _TRACE_ID.reset(t_token)
         _REQUEST_ID.reset(token)
 
 
@@ -62,6 +76,9 @@ class _JsonFormatter(logging.Formatter):
         rid = _REQUEST_ID.get()
         if rid is not None:
             out["request_id"] = rid
+        tid = _TRACE_ID.get()
+        if tid is not None:
+            out["trace_id"] = tid
         fields = getattr(record, "fields", None)
         if fields:
             out.update(fields)  # an explicit request_id field wins

@@ -35,6 +35,7 @@ from __future__ import annotations
 import collections
 import math
 import threading
+import time
 from typing import Optional, Sequence
 
 # Log-spaced latency buckets (seconds): sub-ms TPU decode steps through
@@ -138,7 +139,8 @@ class GaugeChild(_Child):
 
 
 class HistogramChild(_Child):
-    __slots__ = ("_bucket_counts", "_sum", "_count", "_window")
+    __slots__ = ("_bucket_counts", "_sum", "_count", "_window",
+                 "_exemplars")
 
     def __init__(self, family):
         super().__init__(family)
@@ -146,8 +148,15 @@ class HistogramChild(_Child):
         self._sum = 0.0
         self._count = 0
         self._window = collections.deque(maxlen=WINDOW)
+        # bucket index -> (trace_id, value, ts): the most recent traced
+        # observation per bucket, so a p99 bucket links to one concrete
+        # inspectable trace (GET /debug/traces/{trace_id}). Bounded by
+        # construction (<= len(buckets)+1 entries); exposed in the JSON
+        # snapshot, not the text exposition (the 0.0.4 format has no
+        # exemplar syntax).
+        self._exemplars: dict = {}
 
-    def observe(self, v: float):
+    def observe(self, v: float, trace_id: Optional[str] = None):
         v = float(v)
         with self._family._lock:
             i = 0
@@ -158,6 +167,21 @@ class HistogramChild(_Child):
             self._sum += v
             self._count += 1
             self._window.append(v)
+            if trace_id is not None:
+                self._exemplars[i] = (trace_id, v, time.time())
+
+    def exemplars(self) -> dict:
+        """{bucket_le: {trace_id, value, ts}} for buckets that have seen
+        a traced observation."""
+        with self._family._lock:
+            items = dict(self._exemplars)
+        les = tuple(self._family.buckets) + (math.inf,)
+        return {
+            _fmt(les[i]): {
+                "trace_id": t, "value": round(v, 6), "ts": round(ts, 3),
+            }
+            for i, (t, v, ts) in sorted(items.items())
+        }
 
     @property
     def count(self) -> int:
@@ -275,6 +299,9 @@ class _Family:
                 entry["p50"] = child.percentile(0.5)
                 entry["p90"] = child.percentile(0.9)
                 entry["p99"] = child.percentile(0.99)
+                ex = child.exemplars()
+                if ex:
+                    entry["exemplars"] = ex
             series.append(entry)
         return {"type": self.type, "help": self.help, "series": series}
 

@@ -18,6 +18,25 @@ decode records one growing `decode` span, not N).
 The breakdown is returned in each response's `timings` field and logged
 as one structured `request_done` event (utils/logging.py attaches the
 request_id to every record logged inside `request_id_context`).
+
+Fleet-wide tracing (ISSUE 17) grows this module from stage timer to span
+tree: W3C-style `traceparent` ids (`SpanContext`, parse/format helpers)
+propagate across every inter-process hop — client → router dispatch /
+failover attempts → replica → KV-fabric pulls → prefill→decode handoff —
+and each process records spans into its bounded in-memory store
+(serving/trace_store.TraceStore). The `Trace` stage timer now also keeps
+absolute-timestamped segments so a finished request's contiguous stage
+breakdown can be exported as child spans of the replica's request span
+with real wall-clock bounds. A `FlightRecorder` (bounded ring of
+control-plane events) lives here too: engine-side code records
+admissions, scheduler plans, preemptions, fabric fetches and restarts
+into it; the supervisor dumps it into crash reports and
+`GET /debug/flight` serves it live.
+
+Everything here stays strictly host-side: nothing crosses into traced
+XLA code, and the launch-level attribution the continuous engine records
+under `engine_cfg.trace_sample_rate` is host timestamps keyed by launch
+seq — never an extra device sync.
 """
 
 from __future__ import annotations
@@ -30,6 +49,12 @@ import uuid
 from typing import Optional
 
 _SAFE_ID = re.compile(r"^[A-Za-z0-9_\-\.:]{1,128}$")
+
+# W3C traceparent: version "00", 32-hex trace id, 16-hex parent span id,
+# 2-hex flags (bit 0 = sampled). The all-zero ids are invalid per spec.
+_TRACEPARENT = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$"
+)
 
 
 def new_request_id() -> str:
@@ -46,18 +71,104 @@ def sanitize_request_id(raw) -> Optional[str]:
     return raw if _SAFE_ID.match(raw) else None
 
 
+# -- W3C-style trace context -------------------------------------------------
+def new_trace_id() -> str:
+    return uuid.uuid4().hex  # 32 hex chars
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class SpanContext:
+    """One hop's trace context: the trace id, the CURRENT span id (the
+    parent of anything started under this context), and the sampled flag.
+    Immutable by convention; `child()` derives the next hop's context."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def new_root(cls, sampled: bool = True) -> "SpanContext":
+        return cls(new_trace_id(), new_span_id(), sampled)
+
+    def child(self, span_id: Optional[str] = None) -> "SpanContext":
+        return SpanContext(
+            self.trace_id, span_id or new_span_id(), self.sampled
+        )
+
+    def header(self) -> str:
+        """The `traceparent` header value for the NEXT hop (this
+        context's span id is the downstream parent)."""
+        return (
+            f"00-{self.trace_id}-{self.span_id}-"
+            f"{'01' if self.sampled else '00'}"
+        )
+
+    def __repr__(self):  # debug output only
+        return f"SpanContext({self.header()})"
+
+
+def parse_traceparent(raw) -> Optional[SpanContext]:
+    """Parse an inbound `traceparent` header; None on absent/malformed
+    (the hop then starts a fresh root — propagation degrades, never
+    errors). Only version 00 is accepted; all-zero ids are invalid."""
+    if not isinstance(raw, str):
+        return None
+    m = _TRACEPARENT.match(raw.strip().lower())
+    if not m:
+        return None
+    trace_id, span_id, flags = m.groups()
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return SpanContext(trace_id, span_id, bool(int(flags, 16) & 1))
+
+
+def sample_decision(trace_id: str, rate: float) -> bool:
+    """Deterministic per-trace sampling for launch-level profiling
+    (engine_cfg.trace_sample_rate): a pure function of the trace id — no
+    RNG on the hot path, and every process agrees on the decision.
+    rate <= 0 never samples; rate >= 1 always does."""
+    if rate <= 0.0:
+        return False
+    if rate >= 1.0:
+        return True
+    return int(trace_id[:8], 16) / float(0x100000000) < rate
+
+
+_MAX_SEGMENTS = 256  # bounded per-request segment log (span-tree export)
+
+
 class Trace:
     """Ordered, contiguous stage spans for one request."""
 
-    __slots__ = ("request_id", "_t0", "_last", "_spans", "_lock")
+    __slots__ = ("request_id", "_t0", "_wall0", "_last", "_spans",
+                 "_segments", "_lock")
 
     def __init__(self, request_id: Optional[str] = None):
         self.request_id = request_id or new_request_id()
         now = time.perf_counter()
         self._t0 = now
+        # wall-clock anchor for absolute span export: abs(t) =
+        # _wall0 + (t - _t0). One pair read at construction so the
+        # perf_counter deltas (monotonic, the timing source of record)
+        # map onto a wall timeline consistent across processes to within
+        # clock skew.
+        self._wall0 = time.time()
         self._last = now
         self._spans: "collections.OrderedDict[str, float]" = (
             collections.OrderedDict()
+        )
+        # absolute-timestamped (name, start, end) segments, bounded — the
+        # span-tree export reads these; the contiguous accumulator above
+        # stays the `timings` source so the two views cannot diverge on
+        # totals
+        self._segments: collections.deque = collections.deque(
+            maxlen=_MAX_SEGMENTS
         )
         # a deadline-abandoned generation keeps checkpointing from its
         # daemon thread while the caller reads timings(): cheap lock
@@ -68,6 +179,7 @@ class Trace:
         now = time.perf_counter()
         with self._lock:
             dur = now - self._last
+            self._segments.append((name, self._last, now))
             self._last = now
             self._spans[name] = self._spans.get(name, 0.0) + dur
         return dur
@@ -82,6 +194,18 @@ class Trace:
         with self._lock:
             return dict(self._spans)
 
+    def segments(self) -> list:
+        """[(name, start_wall, end_wall)] — the absolute-timestamped
+        stage segments, chronological. The span-tree export turns these
+        into child spans of the process's request span."""
+        with self._lock:
+            off = self._wall0 - self._t0
+            return [(n, a + off, b + off) for n, a, b in self._segments]
+
+    @property
+    def start_wall(self) -> float:
+        return self._wall0
+
     def timings(self) -> dict:
         """`{"<span>_s": dur, ..., "total_s": wall}` in chronological span
         order. Spans sum to ≈ total_s (the unspanned tail is whatever ran
@@ -91,3 +215,53 @@ class Trace:
             out = {f"{k}_s": round(v, 6) for k, v in self._spans.items()}
             out["total_s"] = round(now - self._t0, 6)
         return out
+
+
+class FlightRecorder:
+    """Bounded ring of recent control-plane events for one engine.
+
+    The crash-forensics companion of the span store: admissions,
+    scheduler plans (budget splits), preemptions, fabric fetches,
+    quarantines and restarts append here as cheap host-side dicts; the
+    ring is dumped into the supervisor's crash report, served live at
+    `GET /debug/flight`, and persisted next to `--restore-dir` on a
+    crash — so a poison-quarantine or restart-loop episode is
+    reconstructable after the fact. Strictly host-side control-plane
+    code; never called from anywhere decode-launch-adjacent except
+    behind the existing per-event seams (admission, plan, preempt,
+    fetch, restart), all of which already do host work."""
+
+    __slots__ = ("_events", "_lock", "_seq", "capacity")
+
+    def __init__(self, capacity: int = 512):
+        self.capacity = int(capacity)
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+
+    def record(self, kind: str, **fields):
+        """Append one event. `fields` must already be JSON-safe scalars
+        (the dump is json.dumps'd into crash reports verbatim)."""
+        with self._lock:
+            self._seq += 1
+            ev = {"seq": self._seq, "ts": round(time.time(), 6),
+                  "kind": kind}
+            if fields:
+                ev.update(fields)
+            self._events.append(ev)
+
+    def events(self, limit: Optional[int] = None) -> list:
+        with self._lock:
+            out = list(self._events)
+        return out[-limit:] if limit else out
+
+    def dump(self) -> dict:
+        """The /debug/flight + crash-report payload."""
+        events = self.events()
+        return {
+            "capacity": self.capacity,
+            "recorded_total": self._seq,
+            "events": events,
+        }
