@@ -178,6 +178,82 @@ print(json.dumps({
 """
 
 
+# comms-contract cross-check leg (analysis/comms.py): run real pp=2
+# prefill + decode launches with the wire knob off and on, read the
+# dli_pp_wire_bytes_total per-path deltas a MetricsRegistry actually
+# accumulated, and recompute the same launches through the symbolic link
+# table. The two MUST agree to the byte — the runtime accounting routes
+# through the table (parallel/pipeline.py _account_link), so a mismatch
+# means the static model lies about what the wire carries.
+_COMMS_LEG_SRC = """
+import json, os
+import jax
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_platforms", "cpu")
+if not hasattr(jax, "shard_map"):
+    print(json.dumps({"skipped": "no jax.shard_map in this jax"}))
+    raise SystemExit(0)
+import jax.numpy as jnp
+import numpy as np
+from distributed_llm_inference_tpu import MeshConfig, get_model_config
+from distributed_llm_inference_tpu.analysis import comms
+from distributed_llm_inference_tpu.engine import generate as G
+from distributed_llm_inference_tpu.runtime import create_backend
+from distributed_llm_inference_tpu.utils.metrics import MetricsRegistry
+
+B, PLEN, BUCKET, STEPS = 2, 24, 32, 8
+out = {"modes": {}, "exact_agreement": True, "pp": 2,
+       "model": "test-llama-tiny"}
+for mode, wq in (("off", None), ("on", "int8")):
+    cfg = get_model_config(
+        "test-llama-tiny", dtype="float32", eos_token_id=-1
+    )
+    cfg, be = create_backend(
+        cfg, mesh_cfg=MeshConfig(pp=2), wire_quant=wq
+    )
+    reg = MetricsRegistry()
+    be.attach_wire_metrics(reg)
+    row = ([cfg.bos_token_id] + [7] * (PLEN - 1)
+           + [cfg.pad_token_id] * (BUCKET - PLEN))
+    tokens = jnp.asarray([row] * B, jnp.int32)
+    sampling = G.default_sampling(greedy=True)
+    kp, kd = jax.random.split(jax.random.PRNGKey(0))
+    cache = be.init_cache(B, 128)
+    first, _, cache = be.prefill(
+        tokens, jnp.int32(PLEN), cache, kp, sampling
+    )
+    _, n_gen, cache = be.decode(
+        first, cache, jnp.int32(PLEN), jnp.int32(STEPS), kd, sampling,
+        max_steps=STEPS,
+    )
+    np.asarray(n_gen)
+    fam = reg.get("dli_pp_wire_bytes_total")
+    measured = {
+        path: int(fam.labels(path=path).value)
+        for path in ("microstep", "broadcast")
+    }
+    q = wq is not None
+    p = comms.params_from_config(
+        cfg, dp=1, pp=2, rows=B, t=BUCKET, steps=STEPS
+    )
+    derived = {
+        "microstep":
+            comms.link_bytes("pp-microstep-prefill", p, itemsize=4, quant=q)
+            + comms.link_bytes("pp-microstep-decode", p, itemsize=4, quant=q),
+        "broadcast":
+            comms.link_bytes("pp-broadcast-prefill", p, itemsize=4, quant=q)
+            + comms.link_bytes("pp-broadcast-decode", p, itemsize=4, quant=q),
+    }
+    agree = measured == derived
+    out["modes"][mode] = {
+        "measured": measured, "derived": derived, "agree": agree,
+    }
+    out["exact_agreement"] = out["exact_agreement"] and agree
+assert out["exact_agreement"], out
+print(json.dumps(out))
+"""
+
+
 def _prev_cpu_value():
     """Newest committed BENCH_r*.json CPU headline: the value itself on a
     platform=cpu round, or the recorded cpu_fallback field on a TPU round.
@@ -2157,6 +2233,43 @@ def run_benchmark():
             else:
                 sys.stderr.write(
                     f"1f1b leg rc={proc.returncode}: "
+                    f"{(proc.stderr or '')[-800:]}\n"
+                )
+            _write_sidecar(result)
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
+    # comms-contract cross-check leg (analysis/comms.py): derived static
+    # bytes/launch per wire link vs the dli_pp_wire_bytes_total deltas a
+    # real pp=2 run accumulates, wire off AND on, exact agreement
+    # asserted IN the child. Same subprocess pattern as the 1f1b leg
+    # (the 2-device mesh needs xla_force_host_platform_device_count
+    # before backend init). Never fatal.
+    if time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        try:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=2"
+            )
+            proc = subprocess.run(
+                [sys.executable, "-c", _COMMS_LEG_SRC],
+                capture_output=True, text=True, timeout=240, env=env,
+            )
+            line = next(
+                (
+                    ln for ln in reversed(proc.stdout.splitlines())
+                    if ln.strip().startswith("{")
+                ),
+                None,
+            )
+            if proc.returncode == 0 and line:
+                result["comms_report"] = json.loads(line)
+            else:
+                sys.stderr.write(
+                    f"comms leg rc={proc.returncode}: "
                     f"{(proc.stderr or '')[-800:]}\n"
                 )
             _write_sidecar(result)

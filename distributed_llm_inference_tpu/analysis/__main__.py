@@ -40,6 +40,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--hlo-only", action="store_true", help="skip the lint pass"
     )
+    ap.add_argument(
+        "--comms", action="store_true",
+        help="emit the comms-contract report: per-link symbolic wire "
+             "bytes with accounting provenance, the collective-site "
+             "census by role, and the fat-collective inventory",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="with --comms: emit the report as JSON",
+    )
     args = ap.parse_args(argv)
 
     from .rules import ALL_RULES
@@ -61,8 +71,24 @@ def main(argv=None) -> int:
 
         rules = args.rules.split(",") if args.rules else None
         diagnostics, suppressed = run_lint(root, rules=rules)
-        print(format_diagnostics(diagnostics, suppressed))
+        if not (args.comms and args.json):
+            print(format_diagnostics(diagnostics, suppressed))
         failed = failed or bool(diagnostics)
+
+    if args.comms:
+        import json as _json
+
+        from .comms import build_report, format_report
+
+        report = build_report(root=root)
+        if args.json:
+            if not args.hlo_only:
+                report["diagnostics"] = [d.format() for d in diagnostics]
+                report["suppressed"] = suppressed
+            print(_json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print(format_report(report))
+        failed = failed or bool(report["problems"])
 
     if args.hlo or args.hlo_only:
         # CPU is the reference surface for artifact checks (CI runs here);

@@ -679,14 +679,16 @@ def lower_pp_decode(max_steps: int = 4, wire_quant=None) -> str:
     return lowered.as_text()
 
 
-def _collective_permute_operands(text: str) -> list:
-    """(rank, dtype, line) of every collective_permute operand in the
-    lowered text — the function-type clause `: (tensor<...>) -> ...`."""
+def _collective_operands(text: str, opname: str) -> list:
+    """(rank, dtype, line) of every `opname` collective operand in the
+    lowered text — the function-type clause `: (tensor<...>) -> ...`.
+    (The attribute dict's `replica_groups ... : tensor<...>` has no
+    paren wrapper, so the regex cannot mistake it for an operand.)"""
     import re
 
     ops = []
     for line in text.splitlines():
-        if "collective_permute" not in line:
+        if opname not in line:
             continue
         m = re.search(r":\s*\(tensor<([^>]+)>\)", line)
         if not m:
@@ -694,6 +696,10 @@ def _collective_permute_operands(text: str) -> list:
         parts = m.group(1).split("x")
         ops.append((len(parts) - 1, parts[-1], line.strip()[:110]))
     return ops
+
+
+def _collective_permute_operands(text: str) -> list:
+    return _collective_operands(text, "collective_permute")
 
 
 def check_wire_dtype(text: str) -> list:
@@ -770,6 +776,107 @@ def check_wire_no_recompile() -> list:
             f"must stay inside the one compiled program"
         ]
     return []
+
+
+def check_gather_dtype(text: str) -> list:
+    """The pp decode program's all_gather is the vocab logits gather
+    (the FAT_INVENTORY edge): its operand must be fp32 in BOTH wire
+    modes — the wire knob quantizes the ring hand-off, never the
+    logits path (sampling parity depends on exact fp32 logits)."""
+    ops = _collective_operands(text, "all_gather")
+    if not ops:
+        return ["no all_gather in the pp decode program — the vocab-"
+                "sharded logits gather (parallel/vocab.unembed_sharded) "
+                "is missing"]
+    return [
+        f"all_gather ships {d}, not f32 — the logits gather must stay "
+        f"full precision (quantizing it is the tracked FAT_INVENTORY "
+        f"worklist, not a silent wire side effect): {line}"
+        for r, d, line in ops if d != "f32"
+    ]
+
+
+def check_a2a_dtype(text: str, *, wire: bool) -> list:
+    """Operand dtypes of the ulysses all_to_all exchanges (parallel/
+    ring.ulysses_attend). With `wire` on, the K and V head-scatter a2a
+    ship si8 data (their fp32 scale companions ride rank-(n-1) a2a);
+    off, nothing on the sp wire may be int8 — the same bit-identity
+    contract as the pp ring, proven per-primitive on the artifact."""
+    ops = _collective_operands(text, "all_to_all")
+    if not ops:
+        return ["no all_to_all in the sp attend program — the ulysses "
+                "head<->sequence exchange is missing"]
+    data_rank = max(r for r, _, _ in ops)
+    si8 = [line for r, d, line in ops if r == data_rank and d == "i8"]
+    if wire and len(si8) < 2:
+        return [
+            f"wire-quantized ulysses attend ships {len(si8)} si8 "
+            f"full-rank all_to_all (expected >= 2: K and V) — the sp "
+            f"wire is not int8 despite the knob"
+        ]
+    if not wire and any(d == "i8" for _, d, _ in ops):
+        return [
+            f"wire=off ulysses attend ships int8 on the sp wire (the "
+            f"off path must be bit-identical): {next(l for _, d, l in ops if d == 'i8')}"
+        ]
+    return []
+
+
+def check_comms_graph(text: str, topology: str) -> list:
+    """Cross-validate the lowered program against the statically derived
+    edge set (analysis/comms.HLO_PREDICTED): every predicted StableHLO
+    collective kind appears, and nothing unpredicted appears. This is
+    the twin that keeps the static comms model honest — a new collective
+    in the source shows up here before it ships unaccounted."""
+    from .comms import STABLEHLO_COLLECTIVES, predicted_hlo_ops
+
+    found = {k for k in STABLEHLO_COLLECTIVES if k in text}
+    want = predicted_hlo_ops(topology)
+    problems = []
+    for k in sorted(want - found):
+        problems.append(
+            f"{topology}: predicted collective {k} absent from the "
+            f"lowered program — the static graph "
+            f"(analysis/comms.HLO_PREDICTED) is stale"
+        )
+    for k in sorted(found - want):
+        problems.append(
+            f"{topology}: lowered program contains unpredicted "
+            f"collective {k} — add the edge to analysis/comms."
+            f"HLO_PREDICTED (and the link table, if it moves "
+            f"activation bytes)"
+        )
+    return problems
+
+
+def lower_sp_attend(wire: bool = False) -> str:
+    """StableHLO of one ulysses attention body shard_mapped over a
+    2-device sp mesh (tiny head counts: H=4, KV=2 scatter over sp=2).
+    Caller must gate on pp_available() — same capability set."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_SP
+    from ..parallel.ring import ulysses_attend
+
+    mesh = Mesh(np.array(jax.devices()[:2]), (AXIS_SP,))
+    B, T, H, KV, Dh = 1, 8, 4, 2, 16
+    q = jnp.zeros((B, T, H, Dh), jnp.float32)
+    k = jnp.zeros((B, T, KV, Dh), jnp.float32)
+    v = jnp.zeros((B, T, KV, Dh), jnp.float32)
+
+    def body(q, k, v):
+        return ulysses_attend(q, k, v, AXIS_SP, wire=wire)
+
+    shmapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, AXIS_SP), P(None, AXIS_SP), P(None, AXIS_SP)),
+        out_specs=P(None, AXIS_SP),
+        check_vma=False,
+    )
+    return jax.jit(shmapped).lower(q, k, v).as_text()
 
 
 def check_pp_ring(text: str, max_per_step: int = 2) -> list:
@@ -906,7 +1013,34 @@ def run_hlo_checks() -> dict:
         # lowering text, so the artifact leg would be vacuous here (the
         # plain pp-decode checks skip it for the same reason)
         results["wire-recompile-guard"] = check_wire_no_recompile()
+        # comms-graph twin (analysis/comms.HLO_PREDICTED): the statically
+        # derived edge set must match the lowered program exactly, in
+        # BOTH wire modes — every predicted collective kind appears and
+        # nothing unpredicted appears; plus the logits all_gather dtype
+        # proof (fp32 both modes — the knob never touches the logits)
+        results["comms-graph-pp"] = (
+            check_comms_graph(pp, "pp-decode")
+            + check_comms_graph(wired, "pp-decode")
+        )
+        results["gather-dtype"] = (
+            check_gather_dtype(pp) + check_gather_dtype(wired)
+        )
+        # sp twin: the ulysses attention body lowers to all_to_all
+        # exchanges only, and the a2a operand dtypes prove the sp wire
+        # (int8 K/V data + fp32 scales with `wire` on; zero int8 off)
+        sp_off = lower_sp_attend(False)
+        sp_on = lower_sp_attend(True)
+        results["comms-graph-sp"] = (
+            check_comms_graph(sp_off, "sp-attend")
+            + check_comms_graph(sp_on, "sp-attend")
+        )
+        results["a2a-dtype"] = (
+            check_a2a_dtype(sp_on, wire=True)
+            + check_a2a_dtype(sp_off, wire=False)
+        )
     else:
         results["pp-decode (skipped: no jax.shard_map / < 2 devices)"] = []
         results["wire-dtype (skipped: no jax.shard_map / < 2 devices)"] = []
+        results["comms-graph (skipped: no jax.shard_map / < 2 devices)"] = []
+        results["a2a-dtype (skipped: no jax.shard_map / < 2 devices)"] = []
     return results

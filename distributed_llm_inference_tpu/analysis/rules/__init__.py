@@ -76,6 +76,10 @@ def walk_own_body(fn_node: ast.AST):
 
 from . import (  # noqa: E402 — registry needs the helpers above
     blocking_under_lock,
+    comms_axis,
+    comms_fat_collective,
+    comms_masked_psum,
+    comms_wire_coverage,
     donation,
     guarded_by,
     host_sync,
@@ -98,6 +102,10 @@ ALL_RULES = {
         # thread reachability — ARCHITECTURE.md "Invariants")
         thread_reach, lock_order, blocking_under_lock, guarded_by,
         lifecycle, join_hygiene,
+        # comms-contract rules (collective graph, wire coverage, fat
+        # inventory — ARCHITECTURE.md "Comms contract")
+        comms_axis, comms_wire_coverage, comms_masked_psum,
+        comms_fat_collective,
     )
 }
 

@@ -1,0 +1,45 @@
+"""comms-axis: collective axis names must resolve to declared mesh axes.
+
+A typo'd axis name (`lax.ppermute(x, "ppp", perm)`) is invisible until
+trace time on a mesh that actually binds the axis — which CPU CI never
+builds, so the bug ships. Statically: every axis argument of a
+collective (raw lax primitive or wire wrapper) that resolves to a
+string constant — a literal, a module-level `AXIS_*` binding, or an
+import of one — must be a member of the package's declared axis set
+(the values of every module-level `AXIS_* = "..."`; parallel/mesh.py
+declares all five). Function parameters and attribute chains are
+honestly unresolvable by an AST pass and are skipped, not flagged —
+their call sites resolve somewhere up the stack where this rule DOES
+see the constant.
+"""
+
+from __future__ import annotations
+
+from ..comms import collect_sites, declared_axes
+from ..lint import Diagnostic
+
+RULE_ID = "comms-axis"
+
+
+def check(index):
+    declared = declared_axes(index)
+    if not declared:
+        # no AXIS_* declarations anywhere (bare fixture tree): nothing
+        # to validate against
+        return []
+    out = []
+    for site in collect_sites(index, traced=set()):
+        for axis in site.axes:
+            if axis not in declared:
+                out.append(Diagnostic(
+                    path=site.path,
+                    line=site.line,
+                    rule=RULE_ID,
+                    message=(
+                        f"{site.primitive} uses axis {axis!r} which is "
+                        f"not a declared mesh axis "
+                        f"({', '.join(sorted(declared))}) — typo'd axes "
+                        "only fail at trace time on a real mesh"
+                    ),
+                ))
+    return out

@@ -1,0 +1,89 @@
+"""comms-fat-collective: wide unquantized collectives are inventoried.
+
+The ROADMAP's "quantized logits all_gather" item exists because one
+class of collective dwarfs the (now int8) activation hops: full-vocab
+fp32 gathers. This rule turns that prose into a machine-tracked
+worklist. Both directions are enforced:
+
+  * every raw `all_gather` on a parallel/ module must either match a
+    `FAT_INVENTORY` entry in analysis/comms.py (classified: a standing
+    fat collective with symbolic bytes, visible in `--comms`) or be
+    suppressed with a reason (cheap control payloads — int32 slot-fill
+    vectors and the like);
+  * every inventory entry whose module exists in the indexed package
+    must still match a live call site — a stale entry means the fat
+    collective moved or died and the worklist lied.
+
+all_to_all is out of scope here: the ulysses exchanges quantize their
+operands at function entry under the wire flag, so they have a
+quantized path (they carry comms-wire-coverage suppressions that say
+so). Entries also must actually be fat: a below-threshold entry at the
+reference dims is itself flagged, so the inventory cannot silt up.
+"""
+
+from __future__ import annotations
+
+from ..comms import (
+    FAT_INVENTORY, FAT_THRESHOLD, REFERENCE_PARAMS, collect_sites,
+    fat_entry_for, in_parallel,
+)
+from ..lint import Diagnostic
+
+RULE_ID = "comms-fat-collective"
+
+
+def check(index):
+    sites = collect_sites(index, traced=set())
+    out = []
+    for entry in FAT_INVENTORY:
+        mods = [
+            m for m in index.modules
+            if m == entry.module or m.endswith("." + entry.module)
+        ]
+        if not mods:
+            continue  # fixture tree without the module: entry inactive
+        matched = [s for s in sites if fat_entry_for(s) is entry]
+        if not matched:
+            out.append(Diagnostic(
+                path=index.modules[mods[0]].path,
+                line=1,
+                rule=RULE_ID,
+                message=(
+                    f"stale fat-collective inventory entry "
+                    f"{entry.module}.{entry.func} ({entry.primitive}) — "
+                    "no matching call site; update FAT_INVENTORY in "
+                    "analysis/comms.py"
+                ),
+            ))
+            continue
+        if entry.bytes_fn(REFERENCE_PARAMS) < FAT_THRESHOLD:
+            out.append(Diagnostic(
+                path=index.modules[mods[0]].path,
+                line=matched[0].line,
+                rule=RULE_ID,
+                message=(
+                    f"inventory entry {entry.module}.{entry.func} is "
+                    f"below FAT_THRESHOLD at the reference dims — not "
+                    "fat; drop it from FAT_INVENTORY"
+                ),
+            ))
+    for site in sites:
+        if site.primitive != "all_gather" or site.role != "raw":
+            continue
+        if not in_parallel(site.module):
+            continue
+        if fat_entry_for(site) is not None:
+            continue
+        out.append(Diagnostic(
+            path=site.path,
+            line=site.line,
+            rule=RULE_ID,
+            message=(
+                f"raw all_gather (in {site.func}) with no "
+                "fat-collective inventory entry — classify it in "
+                "FAT_INVENTORY (analysis/comms.py) with its symbolic "
+                "bytes, or suppress with a reason if the payload is "
+                "control-plane cheap"
+            ),
+        ))
+    return out

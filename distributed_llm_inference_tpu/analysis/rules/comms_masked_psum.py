@@ -1,0 +1,98 @@
+"""comms-masked-psum: int8 psum operands must carry the one-hot mask.
+
+The quantized masked-psum broadcast is only overflow-safe because
+EXACTLY ONE participant contributes a nonzero operand — int8 values
+sum across the axis, and two live participants would wrap at ±127.
+ops/wire_quant.masked_psum establishes that precondition syntactically:
+`lax.psum(jnp.where(sel, w.q, zeros), axis)`. This rule enforces the
+same discipline at every raw psum site: an operand that is (or aliases)
+the output of `quantize_rows`/`wire_encode` — including its `.q`/`.s`
+leaves — may only be psum'd wrapped in a `where` mask. A bare
+`lax.psum(q, axis)` of quantized data is a lint error: nothing
+establishes the single-owner precondition, so the sum can overflow.
+
+Scope: a per-function taint pass (assignments from the quantizers and
+direct aliases of tainted names/attributes), matching how the wire code
+is actually written — quantize immediately before the collective, in
+the same function. Cross-function data flow is out of scope; the wire
+contract routes those through masked_psum itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..callgraph import _walk_own_body, dotted
+from ..comms import _primitive_of
+from ..lint import Diagnostic
+
+RULE_ID = "comms-masked-psum"
+
+_QUANT_SOURCES = {"quantize_rows", "wire_encode"}
+
+
+def _is_quant_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return d is not None and d.split(".")[-1] in _QUANT_SOURCES
+
+
+def _is_tainted(expr, tainted: set) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in tainted
+    if isinstance(expr, ast.Attribute) and expr.attr in ("q", "s"):
+        return isinstance(expr.value, ast.Name) and expr.value.id in tainted
+    return False
+
+
+def _is_where_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func)
+    return d is not None and d.split(".")[-1] == "where"
+
+
+def check(index):
+    out = []
+    for mod in index.modules.values():
+        for fn in mod.functions.values():
+            tainted: set = set()
+            for node in _walk_own_body(fn):
+                if isinstance(node, ast.Assign):
+                    targets = []
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            targets.append([t])
+                        elif isinstance(t, ast.Tuple):
+                            targets.append(
+                                [e for e in t.elts
+                                 if isinstance(e, ast.Name)]
+                            )
+                    flat = [n for group in targets for n in group]
+                    if _is_quant_call(node.value):
+                        tainted.update(n.id for n in flat)
+                    elif _is_tainted(node.value, tainted):
+                        tainted.update(n.id for n in flat)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if _primitive_of(node) != "psum" or not node.args:
+                    continue
+                operand = node.args[0]
+                if _is_where_call(operand):
+                    continue  # masked — the precondition is established
+                if _is_tainted(operand, tainted):
+                    out.append(Diagnostic(
+                        path=mod.path,
+                        line=node.lineno,
+                        rule=RULE_ID,
+                        message=(
+                            "psum of a quantized operand without the "
+                            "exactly-one-nonzero mask — int8 partial "
+                            "sums overflow with >1 live participant; "
+                            "wrap in jnp.where(sel, ..., zeros) or use "
+                            "ops/wire_quant.masked_psum"
+                        ),
+                    ))
+    return out

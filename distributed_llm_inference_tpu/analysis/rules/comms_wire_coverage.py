@@ -1,0 +1,48 @@
+"""comms-wire-coverage: parallel/ transfer paths must use the wire wrappers.
+
+The int8 wire (ops/wire_quant.py) only covers hand-offs that route
+through `wire_ppermute`/`masked_psum`; a raw `lax.{ppermute, psum,
+all_gather, all_to_all, psum_scatter}` added to a parallel/ module
+silently bypasses quantization AND the bytes accounting. This rule
+makes that a lint error: raw transfer-class collectives in parallel/
+modules are flagged unless suppressed with a reason (the suppression
+census in ARCHITECTURE.md "Comms contract" documents every sanctioned
+one: control-plane int32 gathers, log-sum-exp merges, operands already
+quantized at function entry, and the fat-inventory logits gathers).
+
+Exempt by classification, not suppression: ops/wire_quant.py internals
+(the one sanctioned home of raw collectives), the `psum(1, axis)`
+axis-size idiom (constant-folded bookkeeping), `pmax`/`pmin` scalar
+merges, and tp/ep weight-reduction psums in models/ (not a transfer —
+see the role taxonomy in analysis/comms.py).
+"""
+
+from __future__ import annotations
+
+from ..comms import TRANSFER_PRIMS, collect_sites, in_parallel
+from ..lint import Diagnostic
+
+RULE_ID = "comms-wire-coverage"
+
+
+def check(index):
+    out = []
+    for site in collect_sites(index, traced=set()):
+        if site.role != "raw":
+            continue
+        if site.primitive not in TRANSFER_PRIMS:
+            continue
+        if not in_parallel(site.module):
+            continue
+        out.append(Diagnostic(
+            path=site.path,
+            line=site.line,
+            rule=RULE_ID,
+            message=(
+                f"raw lax.{site.primitive} on a parallel/ transfer path "
+                f"(in {site.func}) bypasses the int8 wire and the bytes "
+                "accounting — route it through wire_ppermute/masked_psum "
+                "(ops/wire_quant) or suppress with a reason"
+            ),
+        ))
+    return out

@@ -41,7 +41,6 @@ the greedy token-match-rate tests in tests/test_wire_quant.py.
 from __future__ import annotations
 
 import functools as _functools
-import math
 
 import jax
 import jax.numpy as jnp
@@ -262,11 +261,10 @@ def proxy_stage_match(cfg, params, prompt_ids, max_new: int,
 
 def wire_bytes(shape, itemsize: int, hops: int, *, quant: bool) -> int:
     """Host-side static wire accounting (no tracing cost): bytes one
-    activation of `shape` costs crossing `hops` hand-offs. Quantized, a
-    [..., D] tensor ships D int8 + one fp32 scale per row — the
-    dli_pp_wire_bytes_total counters and the bench leg's bytes/token
-    headline both derive from this one formula."""
-    n = math.prod(shape)
-    rows = n // shape[-1]
-    per_hop = n + 4 * rows if quant else n * itemsize
-    return per_hop * hops
+    activation of `shape` costs crossing `hops` hand-offs. The formula
+    itself lives in analysis/comms.wire_link_bytes — the ONE
+    implementation the dli_pp_wire_bytes_total counters, the symbolic
+    link table, and the bench leg's bytes/token headline all evaluate."""
+    from ..analysis.comms import wire_link_bytes
+
+    return wire_link_bytes(shape, itemsize, hops, quant=quant)

@@ -242,14 +242,14 @@ class ContextParallelBackend(SPMDBackendBase):
         cfg = self.cfg
         B, bucket = int(tokens_shape[0]), int(tokens_shape[1])
         Tc = bucket // self.sp
-        chunk = (B, Tc, cfg.n_kv_heads, cfg.head_dim)
-        self._wire_account(
-            "sp", chunk, 2 * cfg.n_layers * (self.sp - 1),
-            axis_size=self.sp,
+        self._account_link(
+            "sp-kv-ring", rows=B, t_chunk=Tc, axis_size=self.sp,
             quant=self.wire_quant is not None or cfg.kv_quant is not None,
         )
-        self._wire_account("microstep", (B, Tc, cfg.dim), self.pp)
-        self._wire_account("broadcast", (B, 1, cfg.dim), 1, axis_size=self.sp)
+        self._account_link("pp-microstep-prefill", rows=B, t=Tc)
+        self._account_link(
+            "sp-broadcast-prefill", rows=B, axis_size=self.sp
+        )
 
     # -- shared hook ---------------------------------------------------------
     def _layer_window(self, window_flag):
@@ -391,9 +391,11 @@ class ContextParallelBackend(SPMDBackendBase):
                 tp_axis=self.tp_axis, attn_hook=ring_hook,
             )
             logits_local = M.unembed(cfg, shared, x)  # [B, Tc, V]
+            # jaxlint: disable=comms-wire-coverage -- fp32 [B, Tc, V] scoring logits gather, tracked in FAT_INVENTORY (analysis/comms.py): score-call duty cycle, same quantization story as the vocab gather
             logits = jax.lax.all_gather(
                 logits_local, AXIS_SP, axis=1, tiled=True
             )
+            # jaxlint: disable=comms-wire-coverage,comms-fat-collective -- int32 token ids re-gathered for score_post alignment, 4*T bytes: control payload, not an activation
             toks_full = jax.lax.all_gather(tokens, AXIS_SP, axis=1, tiled=True)
             cache2 = {
                 "k": kv["k"], "v": kv["v"],

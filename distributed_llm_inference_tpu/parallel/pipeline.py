@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..analysis import comms
 from ..config import ModelConfig
 from ..engine.generate import (
     SamplingParams, count_update, presence_update, stop_mask,
@@ -340,6 +341,30 @@ class SPMDBackendBase:
             wire_bytes(shape, itemsize, hops, quant=quant)
         )
 
+    def _account_link(self, name: str, *, axis_size=None, quant=None,
+                      **launch):
+        """Account one launch of a named wire link (the ONE symbolic
+        bytes model: analysis/comms.WIRE_LINKS). Shape and hop-count
+        arithmetic live in the link table — the `--comms` report, the
+        bench `comms_report` leg, and these counters all evaluate the
+        same formulas, so they cannot drift. `launch` supplies the
+        per-call params (rows/t/steps/...); topology dims default from
+        the backend."""
+        spec = comms.WIRE_LINKS[name]
+        p = comms.params_from_config(self.cfg, **launch)
+        p.setdefault("dp", self.dp)
+        p.setdefault("pp", self.pp)
+        sp = getattr(self, "sp", None)
+        if sp is not None:
+            p.setdefault("sp", sp)
+        mb = getattr(self, "n_microbatches", None)
+        if mb is not None:
+            p.setdefault("mb", mb)
+        self._wire_account(
+            spec.path, spec.shape(p), spec.hops(p),
+            axis_size=axis_size, quant=quant,
+        )
+
     def _account_decode_wire(self, rows: int, steps: int):
         """Per-decode-launch accounting for the plain microstep ring:
         S ppermute hops + one broadcast per emitted token (bytes are
@@ -347,10 +372,8 @@ class SPMDBackendBase:
         so a dp shard's rows divide out)."""
         if self.pp <= 1:
             return
-        D = self.cfg.dim
-        r = max(1, rows // self.dp)
-        self._wire_account("microstep", (r, 1, D), steps * self.pp)
-        self._wire_account("broadcast", (r, 1, D), steps)
+        self._account_link("pp-microstep-decode", rows=rows, steps=steps)
+        self._account_link("pp-broadcast-decode", rows=rows, steps=steps)
 
     def _dp_key(self, key):
         """Decorrelate sampling across dp batch shards. dp=1 keeps the key
@@ -415,8 +438,9 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_extend()
             self._programs["extend"] = fn
-        self._wire_account(
-            "microstep", tokens.shape + (self.cfg.dim,), self.pp
+        self._account_link(
+            "pp-microstep-prefill",
+            rows=int(tokens.shape[0]), t=int(tokens.shape[1]),
         )
         return fn(self.shared, self.layers, tokens, pos, cache)
 
@@ -451,9 +475,9 @@ class PipelineBackend(SPMDBackendBase):
             args.append(presence)
         if wb:
             args.append(bias)
-        B, D = int(tokens.shape[0]), self.cfg.dim
-        self._wire_account("microstep", tokens.shape + (D,), self.pp)
-        self._wire_account("broadcast", (B, 1, D), 1)
+        B, T = int(tokens.shape[0]), int(tokens.shape[1])
+        self._account_link("pp-microstep-prefill", rows=B, t=T)
+        self._account_link("pp-broadcast-prefill", rows=B)
         return fn(*args)
 
     def _build_prefill(self):
@@ -544,9 +568,8 @@ class PipelineBackend(SPMDBackendBase):
 
     def _account_slots_wire(self, rows: int, num_steps: int):
         """Slot-decode chunk: S ring hops + one broadcast per step."""
-        D = self.cfg.dim
-        self._wire_account("microstep", (rows, 1, D), num_steps * self.pp)
-        self._wire_account("broadcast", (rows, 1, D), num_steps)
+        self._account_link("pp-microstep-slots", rows=rows, steps=num_steps)
+        self._account_link("pp-broadcast-slots", rows=rows, steps=num_steps)
 
     def decode_slots(self, state, cache, key, sparams, *, num_steps):
         fn = self._programs.get(("slots", num_steps))
@@ -831,8 +854,8 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_extend_ragged_paged(pages is not None)
             self._programs[mkey] = fn
-        self._wire_account(
-            "microstep", (int(tokens.shape[0]), 1, self.cfg.dim), self.pp
+        self._account_link(
+            "pp-microstep-prefill", rows=int(tokens.shape[0]), t=1
         )
         args = [self.shared, self.layers, tokens, tok_row, tok_pos, meta,
                 pool, table]
@@ -892,9 +915,10 @@ class PipelineBackend(SPMDBackendBase):
             args.append(bias)
         if wp:
             args.append(pages)
-        D = self.cfg.dim
-        self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
-        self._wire_account("broadcast", (1, 1, D), 1)
+        self._account_link(
+            "pp-microstep-prefill", rows=int(tokens.shape[0]), t=1
+        )
+        self._account_link("pp-broadcast-prefill", rows=1)
         return fn(*args)
 
     def _build_prefill_ragged_paged(self, with_presence: bool,
@@ -1044,12 +1068,15 @@ class PipelineBackend(SPMDBackendBase):
             args.append(dev)
         if pages is not None:
             args.append(pages)
-        D = self.cfg.dim
-        self._wire_account("microstep", (int(tokens.shape[0]), 1, D), self.pp)
+        self._account_link(
+            "pp-microstep-prefill", rows=int(tokens.shape[0]), t=1
+        )
         # two replicated-logits gathers (decode rows + arm positions),
         # plus the K+1 verify positions per slot on the spec variant
         bh = 2 + (int(spec.idx.shape[1]) if spec is not None else 0)
-        self._wire_account("broadcast", (int(dec_idx.shape[0]), 1, D), bh)
+        self._account_link(
+            "pp-broadcast-prefill", rows=int(dec_idx.shape[0]), bh=bh
+        )
         return fn(*args)
 
     def _build_mixed_step_ragged(self, with_spec: bool = False,
@@ -1401,9 +1428,9 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_score(top_n)
             self._programs[("score", top_n)] = fn
-        shape = tokens.shape + (self.cfg.dim,)
-        self._wire_account("microstep", shape, self.pp)
-        self._wire_account("broadcast", shape, 1)
+        B, T = int(tokens.shape[0]), int(tokens.shape[1])
+        self._account_link("pp-microstep-prefill", rows=B, t=T)
+        self._account_link("pp-broadcast-score", rows=B, t=T)
         return fn(self.shared, self.layers, tokens, pos, cache)
 
     def _build_score(self, top_n: int):
@@ -1452,9 +1479,12 @@ class PipelineBackend(SPMDBackendBase):
             fn = self._build_speculative(max_steps, draft_len)
             self._programs[key_] = fn
         # upper bound: one [1, 1+G, D] verify window per spec cycle
-        shape = (1, 1 + draft_len, self.cfg.dim)
-        self._wire_account("microstep", shape, max_steps * self.pp)
-        self._wire_account("broadcast", shape, max_steps)
+        self._account_link(
+            "pp-microstep-spec", rows=1, draft=draft_len, steps=max_steps
+        )
+        self._account_link(
+            "pp-broadcast-spec", rows=1, draft=draft_len, steps=max_steps
+        )
         return fn(
             self.shared, self.layers, first_token, cache, hist,
             jnp.int32(hist_len), jnp.int32(limit),
@@ -1506,9 +1536,12 @@ class PipelineBackend(SPMDBackendBase):
         if fn is None:
             fn = self._build_draft_speculative(dcfg, max_steps, draft_len)
             self._programs[key_] = fn
-        shape = (1, 1 + draft_len, self.cfg.dim)
-        self._wire_account("microstep", shape, max_steps * self.pp)
-        self._wire_account("broadcast", shape, max_steps)
+        self._account_link(
+            "pp-microstep-spec", rows=1, draft=draft_len, steps=max_steps
+        )
+        self._account_link(
+            "pp-broadcast-spec", rows=1, draft=draft_len, steps=max_steps
+        )
         return fn(
             self.shared, self.layers, dparams, first_token, cache, dcache,
             jnp.int32(start_pos), jnp.int32(limit),

@@ -22,8 +22,9 @@ stage. Autoregressive dependencies are respected because a sequence's token
 t+1 starts only after token t has been sampled (the round-trip around the
 ring IS the dependency chain).
 
-All of it is one compiled SPMD program (shard_map over the (dp, pp, tp)
-mesh; `lax.while_loop` over microsteps; `lax.ppermute` hand-off), with the
+All of it is one compiled SPMD program (shard_map over the (dp, pp, sp,
+tp, ep) mesh; `lax.fori_loop` over the prefill-ingest microsteps and
+`lax.while_loop` over decode; `wire_ppermute` hand-off), with the
 same gated-cache-write discipline as the plain pipeline: each stage's KV
 write lands in the batch-row slice of the microbatch it currently holds,
 and warmup/drain/finished microsteps are discarded at slice granularity.
@@ -147,11 +148,9 @@ class MicrobatchPipelineBackend(PipelineBackend):
             return
         if rows % self.batch_granularity:
             return super()._account_decode_wire(rows, steps)
-        Mb = self.n_microbatches
         b_m = rows // self.batch_granularity
-        D = self.cfg.dim
-        self._wire_account("1f1b", (b_m, 1, D), self.pp - 1 + steps * Mb)
-        self._wire_account("broadcast", (b_m, 1, D), steps * Mb)
+        self._account_link("fleet-1f1b-decode", b_m=b_m, steps=steps)
+        self._account_link("fleet-broadcast-decode", b_m=b_m, steps=steps)
 
     # -- schedule pieces ----------------------------------------------------
     def _stage_apply(self, layers, x, cache, pos_m, m_here, b_m, gate,
@@ -218,12 +217,10 @@ class MicrobatchPipelineBackend(PipelineBackend):
         # microsteps of one [b_m, bucket, D] buffer per link + one
         # sampled-window broadcast per microbatch
         b_m = rows // self.batch_granularity
-        D = self.cfg.dim
-        self._wire_account(
-            "1f1b", (b_m, int(tokens.shape[1]), D),
-            self.n_microbatches + self.pp - 1,
+        self._account_link(
+            "fleet-1f1b-prefill", b_m=b_m, t=int(tokens.shape[1])
         )
-        self._wire_account("broadcast", (b_m, 1, D), self.n_microbatches)
+        self._account_link("fleet-broadcast-prefill", b_m=b_m)
         if valid_start is None:
             return self._prefill(
                 self.shared, self.layers, tokens, prompt_len, cache, key,

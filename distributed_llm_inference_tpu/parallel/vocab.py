@@ -99,6 +99,7 @@ def embed_sharded(cfg: ModelConfig, shared: dict, tokens: jnp.ndarray, pos, pp: 
     x = e[jnp.clip(idx, 0, V_loc - 1)]
     x = jnp.where(valid[..., None], x, jnp.zeros((), x.dtype))
     if pp > 1:
+        # jaxlint: disable=comms-wire-coverage -- one-hot shard merge: each id lives in exactly one vocab shard, so this psum adds one real [B, T, D] row-set to zeros; quantizing it is the embed half of the ROADMAP logits item
         x = jax.lax.psum(x, AXIS_PP)
     if cfg.embed_scale:  # gemma: sqrt(dim) in the activation dtype
         x = x * jnp.asarray(cfg.dim ** 0.5, x.dtype)
@@ -134,6 +135,7 @@ def unembed_sharded(cfg: ModelConfig, shared: dict, x: jnp.ndarray, pp: int):
         # qmm: dense array or int8 QTensor column shard transparently
         lg = qmm(h, shared["lm_head"]).astype(jnp.float32)
     if pp > 1:
+        # jaxlint: disable=comms-wire-coverage -- THE fat collective: fp32 [B, T, V_pad/pp] logits gather, tracked in FAT_INVENTORY (analysis/comms.py) as the ROADMAP quantized-logits worklist seed
         lg = jax.lax.all_gather(lg, AXIS_PP, axis=lg.ndim - 1, tiled=True)
     lg = lg[..., : cfg.vocab_size]
     if cfg.final_softcap is not None:  # gemma-2
