@@ -2278,6 +2278,232 @@ def run_benchmark():
 
             traceback.print_exc(file=sys.stderr)
 
+    # tiered-KV leg (engine/shadow.py HBM -> host -> disk; ISSUE r16):
+    # a Zipf(alpha=1.0) long-tail prefix workload over a population far
+    # wider than the HBM pool, served three ways — pool-only (kv_shadow
+    # off), +host shadow, +host+disk — giving the hit-rate-vs-tier-depth
+    # curve; then disk-warm-vs-cold TTFT on a long chain through a fresh
+    # engine over the SAME chunk-file dir (the crash-restart shape), and
+    # streamed vs whole-blob /kv pull timing on that chain. CPU: tiny
+    # model, direction-only round-over-round signal. Never fatal.
+    if cont_block and time.perf_counter() - T_START < BATCH_LEG_DEADLINE_S:
+        try:
+            import random as _random
+            import shutil as _shutil
+            import tempfile as _tempfile
+            import urllib.request as _urlreq
+
+            from distributed_llm_inference_tpu.serving.server import (
+                InferenceServer,
+            )
+
+            from distributed_llm_inference_tpu.models import api as _M
+
+            rng = _random.Random(16)
+            KBS = 16
+            POP, REQS = 24, 48
+            zw = [1.0 / (r + 1) for r in range(POP)]  # Zipf alpha=1.0
+            fam = [
+                f"tier bench family {i:02d} prefix body text " * 2 + "go"
+                for i in range(POP)
+            ]  # ~80 chars -> 5 full 16-token blocks each
+            order = rng.choices(range(POP), weights=zw, k=REQS)
+            kw_t = dict(max_tokens=8, greedy=True, chat=False)
+            tmp_disk = _tempfile.mkdtemp(prefix="dli-kvtier-")
+            tmp_disk2 = _tempfile.mkdtemp(prefix="dli-kvtier-deep-")
+            kvt = {
+                "model": c_cfg.name, "platform": platform,
+                "block_size": KBS, "pool_blocks": 26,
+                "slot_max_seq": 128,
+                "host_blocks": 48, "population": POP,
+                "requests": REQS, "zipf_alpha": 1.0,
+            }
+
+            # curve variants: a deliberate capacity LADDER — pool (25
+            # usable blocks, ~4 families) < host tier (56 blocks, ~11
+            # families) < disk (unbounded) — against a 24-family x
+            # 5-block prefix population, so each deeper tier can only
+            # add hit rate the shallower one lacks the capacity for,
+            # and the host tier churns enough to demote onto disk.
+            def tier_variant(shadow, disk_dir, cfg_v=None, params_v=None,
+                             pool=26, slot=128, host=48):
+                eng_t = InferenceEngine(
+                    cfg_v if cfg_v is not None else c_cfg,
+                    params=params_v if params_v is not None else c_params,
+                    engine_cfg=EngineConfig(
+                        prefix_cache_entries=64, kv_shadow=shadow,
+                        kv_shadow_blocks=host, kv_disk_dir=disk_dir,
+                    ),
+                )
+                cont_t = ContinuousEngine(
+                    eng_t, n_slots=2, chunk_steps=8, slot_max_seq=slot,
+                    kv_pool_blocks=pool, kv_block_size=KBS,
+                )
+                return eng_t, cont_t
+
+            def zipf_pass(cont_t):
+                cont_t.submit(fam[0], **kw_t)  # warm slot programs
+                cached = total = 0
+                for i in order:
+                    r = cont_t.submit(fam[i], **kw_t)
+                    if r.get("status") == "success":
+                        cached += r.get("prefix_cached_tokens", 0)
+                        total += 5 * KBS  # full blocks per family prompt
+                return (round(cached / total, 3) if total else None)
+
+            curve = {}
+            eng_t, cont_t = tier_variant(False, None)
+            try:
+                curve["pool_only"] = zipf_pass(cont_t)
+            finally:
+                cont_t.close()
+            eng_t, cont_t = tier_variant(True, None)
+            try:
+                curve["host"] = zipf_pass(cont_t)
+                cont_t._shadow.flush(10.0)
+                sh = cont_t._shadow.stats()
+                # host-only churn ledger: evictions here DROP (no tier
+                # below) — the delta the +disk variant recovers
+                kvt["host_variant_counters"] = {
+                    k: sh[k] for k in ("copied", "evicted", "dropped")
+                }
+            finally:
+                cont_t.close()
+            eng_t, cont_t = tier_variant(True, tmp_disk)
+            try:
+                curve["host_disk"] = zipf_pass(cont_t)
+                cont_t._shadow.flush(10.0)
+                sh = cont_t._shadow.stats()
+                kvt["tier_counters"] = {
+                    k: sh[k] for k in (
+                        "copied", "evicted", "demoted", "promoted",
+                        "disk_hits", "disk_blocks", "disk_bytes", "dropped",
+                    )
+                }
+            finally:
+                cont_t.close()
+            kvt["hit_rate_curve"] = curve
+            _write_sidecar(dict(result, kv_tiers=kvt))
+
+            # disk-warm vs cold TTFT, on a DEEP chain (118 blocks at a
+            # 2048-token window — the regime the disk tier exists for:
+            # cold re-prefill cost grows superlinearly with depth while
+            # promotion stays one parallel chunk-file read + one batched
+            # restore launch). Seed engine runs the chain once and
+            # gracefully drains its host tier to disk; a FRESH engine
+            # over the same chunk dir (the crash-restart shape) rescans
+            # tier 2 and promotes at admission; the cold engine
+            # re-prefills the whole chain.
+            c_cfg_t = get_model_config(
+                "test-llama-tiny", dtype="float32", eos_token_id=-1,
+                max_seq_len=2048,
+            )
+            c_params_t = _M.init_params(c_cfg_t, jax.random.PRNGKey(2))
+            long_prompt = "deep chain segment data " * 79 + "end!"
+            deep_kw = dict(
+                cfg_v=c_cfg_t, params_v=c_params_t,
+                pool=260, slot=2048, host=160,
+            )
+            kvt["deep_chain"] = {
+                "max_seq_len": 2048, "pool_blocks": 260,
+                "host_blocks": 160,
+            }
+            eng_s, cont_s = tier_variant(True, tmp_disk2, **deep_kw)
+            deep = None
+            try:
+                r_long = cont_s.submit(long_prompt, **kw_t)
+                deep = (r_long.get("kv_digests") or [None])[-1]
+                cont_s._shadow.flush(10.0)
+                kvt["drained_to_disk"] = cont_s._shadow.demote_host_tier()
+                kvt["long_chain_tier_at_seed_close"] = (
+                    cont_s._shadow.digest_tier(deep) if deep else None
+                )
+            finally:
+                cont_s.close()
+            eng_w, cont_w = tier_variant(True, tmp_disk2, **deep_kw)
+            try:
+                cont_w.submit(fam[0], **kw_t)  # warm slot programs
+                r_w = cont_w.submit(long_prompt, **kw_t)
+                eng_c, cont_c = tier_variant(True, None, **deep_kw)
+                try:
+                    cont_c.submit(fam[0], **kw_t)  # warm programs
+                    r_c = cont_c.submit(long_prompt, **kw_t)
+                finally:
+                    cont_c.close()
+                if (
+                    r_w.get("status") == "success"
+                    and r_c.get("status") == "success"
+                ):
+                    warm, cold = float(r_w["ttft_s"]), float(r_c["ttft_s"])
+                    kvt["ttft"] = {
+                        "disk_warm_s": round(warm, 5),
+                        "cold_s": round(cold, 5),
+                        "promoted_blocks": r_w.get(
+                            "kv_promoted_blocks", 0
+                        ),
+                        "speedup": (
+                            round(cold / warm, 2) if warm > 0 else None
+                        ),
+                        "warm_ge_2x": bool(warm > 0 and cold >= 2 * warm),
+                    }
+
+                # streamed vs whole-blob /kv pull on the same long chain
+                # (now host-resident after the warm promotion): time to
+                # first importable byte is the number decode overlap
+                # actually sees
+                if deep:
+                    srv_t = InferenceServer(
+                        eng_w, "127.0.0.1", 0, max_tokens_cap=64,
+                        continuous=cont_w,
+                    )
+                    srv_t.start()
+                    try:
+                        base = f"http://127.0.0.1:{srv_t.port}/kv/{deep}"
+
+                        def pull(streamed):
+                            req = _urlreq.Request(base)
+                            if streamed:
+                                req.add_header("X-KV-Stream", "1")
+                            t0 = time.perf_counter()
+                            with _urlreq.urlopen(req, timeout=30) as resp:
+                                first = resp.read(9)
+                                t1 = time.perf_counter()
+                                body = first + resp.read()
+                                t2 = time.perf_counter()
+                            return t1 - t0, t2 - t0, len(body)
+
+                        # warm both paths once (encode caches, TCP stack)
+                        pull(False), pull(True)
+                        b_first, b_total, b_len = pull(False)
+                        s_first, s_total, s_len = pull(True)
+                        kvt["pull"] = {
+                            "chain_blocks": r_w.get(
+                                "kv_promoted_blocks", 0
+                            ),
+                            "blob_first_byte_s": round(b_first, 5),
+                            "blob_total_s": round(b_total, 5),
+                            "blob_bytes": b_len,
+                            "stream_first_byte_s": round(s_first, 5),
+                            "stream_total_s": round(s_total, 5),
+                            "stream_bytes": s_len,
+                            "stream_first_byte_speedup": (
+                                round(b_first / s_first, 2)
+                                if s_first > 0 else None
+                            ),
+                        }
+                    finally:
+                        srv_t.shutdown()
+            finally:
+                cont_w.close()
+                _shutil.rmtree(tmp_disk, ignore_errors=True)
+                _shutil.rmtree(tmp_disk2, ignore_errors=True)
+            result["kv_tiers"] = kvt
+            _write_sidecar(result)
+        except Exception:  # noqa: BLE001 - optional leg, never fatal
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+
     # CPU round-over-round drift guard (round-4 review weak #2: 0.24 ->
     # 0.213 -> 0.206 with nothing watching). Compare this run's headline
     # against the newest committed BENCH_r*.json CPU number and FLAG when

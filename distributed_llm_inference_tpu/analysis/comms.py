@@ -201,6 +201,21 @@ WIRE_LINKS = _links(
         lambda p: (p["rows"], 1, p["dim"]),
         lambda p: 1,
     ),
+    # The KV fabric's replica-to-replica chain transfer (GET/POST /kv,
+    # serving/kv_fabric.py) — DCN, not ICI: it rides plain HTTP between
+    # hosts, so its bytes never appear in any HLO collective. The shape
+    # is one full chain of kv_blocks cache blocks: K and V planes
+    # (2*n_layers) x block tokens x GQA kv heads x head dim. One "hop"
+    # = one verified chain moved (pull or push); runtime bytes land on
+    # dli_kv_fabric_bytes_total{tier=...} via the same _account_link
+    # seam the ICI links use.
+    LinkSpec(
+        "kv-fabric-dcn", "kv", "dcn", "HTTP /kv (npz chain, streamed)",
+        "(kv_blocks, 2*n_layers, kv_block, n_kv_heads, head_dim) x 1 hop",
+        lambda p: (p["kv_blocks"], 2 * p["n_layers"], p["kv_block"],
+                   p["n_kv_heads"], p["head_dim"]),
+        lambda p: 1,
+    ),
 )
 
 # ModelConfig attrs the link formulas and fat inventory may read.
@@ -437,6 +452,9 @@ REFERENCE_PARAMS = dict(
     vocab_size=128256,
     dp=1, pp=8, sp=8, mb=8,
     rows=8, t=4096, t_chunk=512, steps=1, draft=4, bh=1, b_m=1,
+    # KV-fabric chain transfer: a 4096-token prefix at kv_block=32
+    # tokens per cache block = 256 blocks shipped per handoff
+    kv_blocks=256, kv_block=32,
 )
 
 # A collective is "fat" when its symbolic bytes at the reference dims

@@ -423,6 +423,32 @@ class EngineConfig:
     # peer costs at most this long, then admission degrades to a local
     # cold prefill (the fallback ladder never errors).
     kv_fabric_timeout_s: float = 5.0
+    # Disk tier of the KV cache hierarchy (ARCHITECTURE.md "Tiered KV"):
+    # a directory of persisted parent-chained chunk files
+    # (chunk_<digest>.npz) that LRU-evicted host-shadow entries DEMOTE
+    # into instead of dropping, and every shadow read surface
+    # (block-prefix restore planning, warm recovery, preemption swap,
+    # the fabric) PROMOTES hits back out of — bounding the replica's
+    # logical prefix cache by disk, not HBM. None (the default)
+    # disables tier 2: eviction drops, as before.
+    kv_disk_dir: Optional[str] = None
+    # Disk-tier bound, in blocks (chunk files; LRU with the same
+    # cascade discipline as the host tier). 0 = auto: 8x the host
+    # tier, so the logical cache is an order of magnitude deeper than
+    # host DRAM before files churn.
+    kv_disk_blocks: int = 0
+    # Streamed fabric transfer: pull peer chains chunk-at-a-time
+    # (GET /kv/{digest}?stream=1 — length-prefixed single-block frames,
+    # per-chunk digest recheck) so the importing replica overlaps the
+    # network pull with its device scatters instead of buffering the
+    # whole manifest first. False pins the PR-11 whole-manifest pull
+    # (also the automatic fallback against pre-stream peers).
+    kv_fabric_stream: bool = True
+    # Cap on the digests /health advertises for router residency
+    # bootstrap (MRU-first, host tier before disk): the disk tier makes
+    # the full resident set unbounded, and bootstrap payloads must stay
+    # O(1) however deep it grows.
+    kv_health_digests: int = 64
     # Replica specialization class for prefill/decode disaggregation
     # ("prefill" | "decode" | "mixed"): the router sends fresh
     # long-prompt work to prefill-class replicas and hands the finished
@@ -549,6 +575,15 @@ class EngineConfig:
             raise ValueError(
                 f"pp_wire_quant must be None or 'int8', got "
                 f"{self.pp_wire_quant!r}"
+            )
+        if self.kv_disk_blocks < 0:
+            raise ValueError(
+                f"kv_disk_blocks must be >= 0, got {self.kv_disk_blocks}"
+            )
+        if self.kv_health_digests < 1:
+            raise ValueError(
+                f"kv_health_digests must be >= 1, got "
+                f"{self.kv_health_digests}"
             )
         if self.adapter_slots < 0:
             raise ValueError(

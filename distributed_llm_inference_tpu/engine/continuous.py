@@ -138,6 +138,7 @@ class _Request:
         "ids", "shadow_depth", "recovering",
         "deadline_at", "cancel_cause", "preemptions", "preempted_at",
         "resume_seq", "drop_seq", "kv_hint", "fabric_blocks",
+        "promoted_blocks",
         "spec_want", "spec_drafted", "spec_accepted", "spec_launches",
         "adapter", "tenant", "adapter_page", "trace_ctx", "profiled",
     )
@@ -250,6 +251,7 @@ class _Request:
         # blocks imported over the fabric for this request (envelope
         # observability: the router reads it to score handoff outcomes)
         self.fabric_blocks = 0
+        self.promoted_blocks = 0
         # speculative decoding (mixed-fleet draft-then-verify): the
         # request asked for it ("speculative": true — fleet-wide
         # engine_cfg.spec_decode makes every eligible greedy request a
@@ -629,6 +631,12 @@ class ContinuousEngine:
                     or 2 * self._pool_blocks
                 ),
                 registry=engine.metrics,
+                # tier 2 (ARCHITECTURE.md "Tiered KV"): host-LRU
+                # evictions demote into chunk files here instead of
+                # dropping, and every shadow read surface promotes hits
+                # back out — None keeps the flat PR-9 behavior
+                disk_dir=engine.engine_cfg.kv_disk_dir,
+                max_disk_blocks=engine.engine_cfg.kv_disk_blocks,
             )
             if restore_dir and self._shadow.load(restore_dir):
                 # persisted warm state (a drained predecessor's blocks +
@@ -679,6 +687,14 @@ class ContinuousEngine:
                 registry=engine.metrics, role=self.replica_class,
                 timeout_s=engine.engine_cfg.kv_fabric_timeout_s,
             )
+        # streamed pulls (chunk-at-a-time frames, scatter overlapping
+        # the wire) vs the PR-11 whole-manifest pull; and the /health
+        # residency-bootstrap cap (MRU-first — the disk tier makes the
+        # full resident set unbounded)
+        self._fabric_stream = bool(engine.engine_cfg.kv_fabric_stream)
+        self._kv_health_digests = max(
+            1, int(engine.engine_cfg.kv_health_digests)
+        )
         # Paged LoRA adapter serving (engine/adapters.py): the engine's
         # AdapterPool, honored only on fleets whose launch programs can
         # carry the traced pages operand (ragged paged — every other
@@ -1140,6 +1156,7 @@ class ContinuousEngine:
         # so the decode-class replica's immediate fetch finds the chain
         # resident instead of racing the copier thread.
         kv_hint = kwargs.pop("kv_hint", None)
+        kv_push_to = kwargs.pop("kv_push_to", None) or None
         trace_ctx = kwargs.pop("trace_ctx", None)
         adapter = kwargs.pop("adapter", None) or None
         tenant = kwargs.pop("tenant", None) or None
@@ -1167,6 +1184,15 @@ class ContinuousEngine:
             if self._shadow is not None:
                 self._shadow.flush(timeout_s=10.0)
             req.result.setdefault("prefill_only", True)
+            if kv_push_to:
+                # proactive chain push (the handoff's phase 1.5): the
+                # chain is resident NOW — POST it to the decode replica
+                # the router pre-picked, so phase 2's admission finds
+                # the prefix host-resident instead of round-tripping a
+                # pull. Any failure silently keeps the pull fallback.
+                pushed = self._fabric_push(req, kv_push_to)
+                if pushed:
+                    req.result["kv_pushed"] = pushed
         return req.result
 
     def stream(self, prompt: str, **kwargs):
@@ -1749,6 +1775,7 @@ class ContinuousEngine:
         # (index-held, evictable), the steady-state cached-chain invariant
         self._alloc.decref(blocks)
         n = len(entries)
+        self._shadow.count_pool_promotion(n)
         self.shadow_restored_total += n
         self._m_shadow_restored.inc(n)
         log.info(
@@ -1771,11 +1798,59 @@ class ContinuousEngine:
 
         return serve_chain(self._shadow, digest)
 
-    def fabric_digests(self, limit: int = 64) -> list:
-        """Resident chain digests, MRU first (capped) — the /health field
-        the router's residency bootstrap reads."""
+    def fabric_chain_stream(self, digest: str):
+        """(n_chunks, tier, frame iterator) for the resident chain ending
+        at `digest`, or None — the server's streamed GET /kv/{digest}
+        body (X-KV-Stream: 1). Same any-thread contract as
+        fabric_chain, but frames encode lazily, one block at a time."""
+        if not self.fabric_serving:
+            return None
+        from ..serving.kv_fabric import serve_chain_stream
+
+        return serve_chain_stream(self._shadow, digest)
+
+    def fabric_digest_tier(self, digest: str):
+        """The shallowest shadow tier holding `digest` ("host" | "disk" |
+        None) — the server labels X-KV-Tier and bytes{tier} off this."""
+        if not self.fabric_serving:
+            return None
+        return self._shadow.digest_tier(digest)
+
+    def fabric_accept_push(self, data: bytes):
+        """The POST /kv route's body (any thread): validate a peer's
+        proactively pushed chain against its OWN content key (the
+        digest is recomputed from the payload's tokens — nothing
+        external to trust) and land it in the host shadow tier, where
+        the phase-2 admission's promotion path scatters it pool-ward
+        without a pull round-trip. Returns the response dict, or None
+        (-> 400) on a payload that fails validation."""
+        if not self.fabric_serving or self._shadow is None:
+            return None
+        from ..serving.kv_fabric import FabricPayloadError, decode_push
+
+        try:
+            digest, keys, per_block = decode_push(
+                data, self.kv_block_size
+            )
+        except FabricPayloadError as e:
+            log.warning("fabric_push_rejected", error=str(e))
+            return None
+        n = self._shadow.put_host(keys, per_block, self._mutation_seq)
+        self.engine.flight.record(
+            "fabric_push_in", digest=str(digest)[:16], blocks=n,
+        )
+        return {"accepted": n, "digest": digest}
+
+    def fabric_digests(self, limit: Optional[int] = None) -> list:
+        """Resident chain digests, MRU first, host tier before disk —
+        the /health field the router's residency bootstrap reads.
+        Capped (default --kv-health-digests): the disk tier makes the
+        full resident set unbounded, and bootstrap payloads must stay
+        O(1) however deep it grows."""
         if not self.fabric_serving:
             return []
+        if limit is None:
+            limit = self._kv_health_digests
         return self._shadow.resident_digests(limit=limit)
 
     def _fabric_prefetch(self, req: _Request, ids: list):
@@ -1804,19 +1879,43 @@ class ContinuousEngine:
         p0_local, _, _ = self._bpx.lookup(ids)
         if cap <= 0 or p0_local >= cap:
             return
-        fetched = self._fabric.fetch(
-            peer, digest, bs, ctx=req.trace_ctx,
-            request_id=req.trace.request_id,
-            store=self.engine.trace_store,
-        )
+        if self._shadow is not None and self._shadow.has_resident(
+            tuple(ids[:cap])
+        ):
+            # a proactive push (or an earlier demotion) already landed
+            # the full chain in the local tier hierarchy: the promotion
+            # pass scatters it without a wire round-trip
+            return
+        streamed = self._fabric_stream
+        tier = ""
+        if streamed:
+            res = self._fabric.fetch_stream(
+                peer, digest, bs, ctx=req.trace_ctx,
+                request_id=req.trace.request_id,
+                store=self.engine.trace_store,
+            )
+            hit = False
+            if res is not None:
+                _n_chunks, tier, blocks_iter = res
+                hit, req.fabric_blocks = self._import_fabric_stream(
+                    blocks_iter
+                )
+        else:
+            fetched = self._fabric.fetch(
+                peer, digest, bs, ctx=req.trace_ctx,
+                request_id=req.trace.request_id,
+                store=self.engine.trace_store,
+            )
+            hit = fetched is not None
+            tier = getattr(self._fabric, "last_tier", "") if hit else ""
         self.engine.flight.record(
             "fabric_fetch", request_id=req.trace.request_id, peer=peer,
-            digest=str(digest)[:16], hit=fetched is not None,
+            digest=str(digest)[:16], hit=hit, tier=tier,
+            streamed=streamed,
         )
-        if fetched is None:
-            return  # counted as a miss; admission continues cold
-        keys, leaves = fetched
-        req.fabric_blocks = self._import_fabric_chain(keys, leaves)
+        if not streamed and fetched is not None:
+            keys, leaves = fetched
+            req.fabric_blocks = self._import_fabric_chain(keys, leaves)
 
     def _import_fabric_chain(self, keys: list, per_block_leaves: list) -> int:
         """Scatter a verified fetched chain into the pool (the SAME
@@ -1825,8 +1924,15 @@ class ContinuousEngine:
         replica can onward-serve it through /kv. Returns blocks imported
         (0 when the pool has no headroom — local prefill still works)."""
         # one slot-class of headroom, like _restore_shadow: an import
-        # must never make the admission it serves unplaceable
+        # must never make the admission it serves unplaceable. Under
+        # steady-state load the free list is empty while the pool is
+        # full of COLD refcount-1 cached chains — reclaim those first
+        # (the same evict-and-retry the admission path uses) so tier
+        # promotion is never starved by its own tier-0 occupancy.
         budget = self._alloc.free_blocks - self._max_blocks
+        if budget < len(keys) and self._bpx is not None:
+            self._bpx.evict(len(keys) - budget)
+            budget = self._alloc.free_blocks - self._max_blocks
         if budget <= 0:
             return 0
         if len(keys) > budget:
@@ -1864,6 +1970,7 @@ class ContinuousEngine:
             self._shadow.put_host(
                 keys, per_block_leaves, self._mutation_seq
             )
+            self._shadow.count_pool_promotion(len(keys))
         # the index now holds its reference per cached block; drop the
         # allocation's — imported chains end refcount-1 (evictable),
         # exactly like restored ones
@@ -1873,6 +1980,196 @@ class ContinuousEngine:
             free_blocks=self._alloc.free_blocks,
         )
         return len(keys)
+
+    def _scatter_stream_batch(self, batch: list, keys: list,
+                              leaves_kept: list, blocks: list) -> bool:
+        """Scatter one batch of streamed (key, leaves) frames into
+        freshly allocated pool blocks through the pre-warmed restore
+        program — the streamed import's unit of network/device overlap
+        (JAX dispatches the scatter asynchronously, so the device works
+        while the next frames are still on the wire). Appends to the
+        caller's ledgers only on success; False = pool dry or a
+        leaf-shape mismatch (the caller keeps its already-scattered
+        prefix — a chain prefix is still a valid chain)."""
+        blk = self._alloc.alloc(len(batch))
+        if blk is None and self._bpx is not None:
+            # cold cached chains are reclaimable, exactly as at admission
+            self._bpx.evict(len(batch) - self._alloc.free_blocks)
+            blk = self._alloc.alloc(len(batch))
+        if blk is None:
+            return False
+        W = self._shadow_restore_w
+        pad = (-len(batch)) % W
+        ids_padded = blk + [self._P.TRASH_BLOCK] * pad
+        try:
+            stacked = []
+            for j in range(len(batch[0][1])):
+                arr = np.stack([leaves[j] for _, leaves in batch])
+                if pad:
+                    arr = np.concatenate(
+                        [arr, np.repeat(arr[:1], pad, axis=0)]
+                    )
+                stacked.append(jnp.asarray(arr))
+            restored = jax.tree.unflatten(
+                jax.tree.structure(self.cache), stacked
+            )
+            self.cache = self.backend.restore_shadow_blocks(
+                self.cache, restored, jnp.asarray(ids_padded, jnp.int32)
+            )
+        except Exception as e:  # noqa: BLE001 - peer leaf-shape drift
+            log.warning("fabric_stream_scatter_invalid", error=str(e))
+            self._alloc.decref(blk)
+            return False
+        for (key, leaves), b in zip(batch, blk):
+            keys.append(key)
+            leaves_kept.append(leaves)
+            blocks.append(b)
+        # jaxlint: disable=resource-lifecycle -- blk handed to the caller's `blocks` ledger: registered on final-digest verify or decref'd on stream failure
+        return True
+
+    def _import_fabric_stream(self, blocks_iter) -> tuple:
+        """Consume a verified /kv stream (kv_fabric.fetch_stream's block
+        iterator), scattering frames into the pool in restore-width
+        batches AS THEY ARRIVE — decode's tail prefill overlaps the
+        pull instead of waiting behind a whole-manifest buffer. Nothing
+        is REGISTERED until the stream finishes cleanly (the iterator's
+        final content-key recheck): on tamper, truncation, or a died
+        socket mid-stream the scattered-but-unregistered blocks are
+        simply decref'd — unreachable garbage, bit-identical fallback
+        to local prefill, the same bar the whole-blob path meets.
+        Returns (verified, blocks_imported); budget-truncated imports
+        still drain and verify every frame before registering the
+        prefix that fit."""
+        # cold refcount-1 cached chains count toward the budget — the
+        # per-batch scatter evicts them on demand (same reclaim the
+        # admission path uses), so a pool full of cold prefixes never
+        # starves a streamed import
+        budget = (
+            self._alloc.free_blocks
+            + (self._bpx.evictable_blocks() if self._bpx is not None else 0)
+            - self._max_blocks
+        )
+        if budget <= 0:
+            blocks_iter.close()  # settles the client's hit/miss + span
+            return False, 0
+        W = self._shadow_restore_w
+        keys: list = []  # scattered, parents-first
+        leaves_kept: list = []
+        blocks: list = []  # their pool ids, aligned
+        batch: list = []
+        pool_dry = False
+        verified = False
+        try:
+            for key, leaves in blocks_iter:
+                if pool_dry or len(keys) + len(batch) >= budget:
+                    continue  # verify-drain the tail; import what fit
+                batch.append((key, leaves))
+                if len(batch) == W:
+                    if not self._scatter_stream_batch(
+                        batch, keys, leaves_kept, blocks
+                    ):
+                        pool_dry = True
+                    batch = []
+            if batch and not pool_dry:
+                self._scatter_stream_batch(
+                    batch, keys, leaves_kept, blocks
+                )
+            verified = True
+        except Exception as e:  # noqa: BLE001 - FabricPayloadError /
+            # socket death mid-stream: one outcome, local prefill
+            log.warning("fabric_stream_rejected", error=str(e))
+        finally:
+            blocks_iter.close()
+        if not verified or not keys:
+            if blocks:
+                self._alloc.decref(blocks)
+            return verified, 0
+        self._bpx.import_chain(list(keys[-1]), blocks)
+        if self._shadow is not None:
+            self._shadow.put_host(
+                keys, leaves_kept, self._mutation_seq
+            )
+            self._shadow.count_pool_promotion(len(keys))
+        self._alloc.decref(blocks)
+        log.info(
+            "fabric_stream_imported", blocks=len(keys),
+            free_blocks=self._alloc.free_blocks,
+        )
+        return True, len(keys)
+
+    def _promote_local_chain(self, req: _Request, ids: list):
+        """Tier promotion at admission (worker thread, after any fabric
+        prefetch, strictly BEFORE the prefix plan): when the shadow
+        hierarchy — host tier or DISK tier — holds a deeper contiguous
+        chain for this prompt than the pool's block-prefix index does,
+        load it (disk hits promote host-ward inside entries_for, each
+        chunk file content-key-verified) and scatter it through the
+        same import path a fabric fetch uses. A disk-resident warm
+        prefix re-enters in one restore launch instead of a cold
+        re-prefill; a corrupt chunk file rejects into exactly that cold
+        re-prefill. Nothing here can fail the request."""
+        if (
+            self._shadow is None or self._bpx is None or not self.paged
+            or req.adapter is not None  # adapter KV is fenced from
+            # every token-keyed reuse surface (PR 16)
+        ):
+            return
+        bs = self.kv_block_size
+        cap = max(0, (len(ids) - 1) // bs) * bs
+        if cap <= 0:
+            return
+        p0_local, _, _ = self._bpx.lookup(ids)
+        if p0_local >= cap:
+            return
+        depth = 0
+        for nb in range(cap // bs, p0_local // bs, -1):
+            if self._shadow.has_resident(tuple(ids[: nb * bs])):
+                depth = nb
+                break
+        if depth == 0:
+            return
+        keys = [tuple(ids[: (i + 1) * bs]) for i in range(depth)]
+        entries = self._shadow.entries_for(keys)
+        if entries is None:
+            return  # churned out / corrupt chunk file: cold prefill
+        imported = self._import_fabric_chain(
+            keys, [e.leaves for e in entries]
+        )
+        if imported:
+            req.promoted_blocks = imported
+            self.engine.flight.record(
+                "tier_promote", request_id=req.trace.request_id,
+                blocks=imported, depth=depth * bs,
+            )
+
+    def _fabric_push(self, req: _Request, peer_url: str) -> int:
+        """Phase 1.5 of the prefill->decode handoff: encode this
+        finished request's deepest shadow chain and POST it to the
+        decode replica the router pre-picked (X-KV-Push-To), so phase
+        2's admission finds the prefix already host-resident there —
+        no pull round-trip on the decode critical path. Runs on the
+        submit() caller's HTTP thread AFTER the shadow flush (the chain
+        is resident by construction), never the scheduler loop. Any
+        failure returns 0 — the pull path remains the fallback."""
+        res = req.result if isinstance(req.result, dict) else None
+        ds = (res or {}).get("kv_digests") or []
+        if not ds or self._fabric is None:
+            return 0
+        digest = ds[-1]  # deepest chain the decode peer will want
+        data = self.fabric_chain(digest)
+        if data is None:
+            return 0
+        accepted = self._fabric.push_chain(
+            peer_url, data, ctx=req.trace_ctx,
+            request_id=req.trace.request_id,
+            store=self.engine.trace_store,
+        )
+        self.engine.flight.record(
+            "fabric_push", request_id=req.trace.request_id,
+            peer=peer_url, digest=str(digest)[:16],
+            accepted=-1 if accepted is None else accepted,
+        )
+        return accepted or 0
 
     # -- SLO-aware KV preemption (graceful degradation under memory
     # pressure; ARCHITECTURE.md "Preemption & cancellation") ----------------
@@ -2767,6 +3064,10 @@ class ContinuousEngine:
             # Adapter requests never prefetch — the fabric serves BASE
             # KV chains keyed by token content alone.
             self._fabric_prefetch(req, ids)
+        # tier promotion: a host/disk-shadowed chain deeper than the
+        # pool's becomes a deeper exact-depth hit below, same as a
+        # fabric import (self-gates; can never fail the request)
+        self._promote_local_chain(req, ids)
         p0, entry, plan = eng._prefix_plan(
             self._bpx, ids, capacity=self.slot_max_seq, ragged=True,
             adapter=req.adapter,
@@ -3640,6 +3941,11 @@ class ContinuousEngine:
             # prefetch — fabric chains are BASE-model KV keyed by token
             # content alone.
             self._fabric_prefetch(req, ids)
+        # tier promotion: a host/disk-shadowed chain deeper than the
+        # pool's block-prefix index becomes a deeper exact-depth hit in
+        # the plan below — the disk tier's re-entry point (self-gates;
+        # can never fail the request)
+        self._promote_local_chain(req, ids)
         # prefix lookup + ingest plan: the solo engine's shared planner
         # helper (one copy of the lookup/cold-fallback/mark discipline);
         # the planner is mode-specific — block-chain index (paged) or
@@ -4198,6 +4504,11 @@ class ContinuousEngine:
             # prefix blocks pulled over the KV fabric instead of
             # prefilled: the router scores handoff outcomes off this
             req.result["kv_fabric_blocks"] = req.fabric_blocks
+        if req.promoted_blocks:
+            # prefix blocks promoted out of the local shadow hierarchy
+            # (a pushed chain, or a host/disk-tier warm hit) instead of
+            # prefilled — a handoff served by a push scores off this
+            req.result["kv_promoted_blocks"] = req.promoted_blocks
         if (
             self.fabric_serving and req.ids is not None
             and req.adapter is None

@@ -528,11 +528,44 @@ class InferenceEngine:
         )
         self.metrics.counter(
             "dli_kv_fabric_bytes_total",
-            "wire bytes of verified fabric chains received", ("role",),
+            "wire bytes of verified fabric chains moved, by serving tier "
+            "(host/disk = pull source at the peer, push = proactive "
+            "POST /kv at the prefill->decode handoff)",
+            ("role", "tier"),
         )
         self.metrics.histogram(
             "dli_kv_fabric_fetch_seconds",
             "fabric fetch wall time, failures included",
+        )
+        # KV tier-hierarchy families (engine/shadow.py — ARCHITECTURE.md
+        # "Tiered KV"): per-tier occupancy plus promotion/demotion flow
+        # between HBM pool (tier 0), host shadow (tier 1), disk chunk
+        # files (tier 2)
+        self.metrics.gauge(
+            "dli_kv_tier_entries",
+            "KV blocks resident per cache tier (host = shadow DRAM, "
+            "disk = persisted chunk files)", ("tier",),
+        )
+        self.metrics.gauge(
+            "dli_kv_tier_bytes",
+            "approximate bytes resident per KV cache tier", ("tier",),
+        )
+        self.metrics.counter(
+            "dli_kv_tier_promotions_total",
+            "KV blocks promoted up the tier hierarchy, by destination "
+            "tier (host = disk->DRAM load, pool = scattered into HBM)",
+            ("tier",),
+        )
+        self.metrics.counter(
+            "dli_kv_tier_demotions_total",
+            "KV blocks demoted down the tier hierarchy, by destination "
+            "tier (disk = host-LRU spill or copier-backpressure spill)",
+            ("tier",),
+        )
+        self.metrics.counter(
+            "dli_kv_tier_disk_hits_total",
+            "lookups served from the disk tier (chunk files loaded and "
+            "verified on a read that missed the host tier)",
         )
         # wedge observability (engine._with_deadline): abandoned
         # deadline-overrun device calls still occupying the device — the

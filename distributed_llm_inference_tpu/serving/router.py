@@ -120,6 +120,10 @@ RETRY_AFTER_S = 2
 # usable chain, it cannot route to wrong output.
 AFFINITY_CHUNK_BYTES = 64
 AFFINITY_MAX_CHUNKS = 32
+# holders remembered per residency digest: enough to spread a hot
+# prefix across a small decode tier, small enough that a fleet-wide
+# prefix doesn't make every entry fleet-sized
+MAX_RESIDENCY_HOLDERS = 4
 
 _FORWARD_ROUTES = ("/generate", "/v1/completions", "/v1/chat/completions")
 
@@ -189,6 +193,7 @@ class Router:
                  failover_attempts: Optional[int] = None,
                  fabric: bool = True,
                  handoff_min_bytes: int = 192,
+                 kv_push: bool = True,
                  tenant_max_inflight_share: float = 0.5):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -208,6 +213,12 @@ class Router:
         # long-prompt work" worth a two-phase dispatch.
         self.fabric = bool(fabric)
         self.handoff_min_bytes = int(handoff_min_bytes)
+        # proactive chain push: when a prefill-only phase succeeds, the
+        # router pre-picks the least-loaded decode replica, names it in
+        # X-KV-Push-To, and the prefill replica POSTs the finished chain
+        # there before phase 2 dispatches — the decode replica starts
+        # with the KV already in its host tier instead of pulling it.
+        self.kv_push = bool(kv_push)
         # tenant-aware shedding: one tenant holding more than this share
         # of ALL router-inflight requests is turned away with 429 +
         # Retry-After BEFORE a replica is picked, so a flooding tenant
@@ -224,24 +235,28 @@ class Router:
             int(failover_attempts) if failover_attempts
             else max(2, len(self.replicas))
         )
-        # chunk-chain digest -> (replica id, deepest TOKEN digest the
-        # replica reported for this chain, or None), LRU-bounded. One
-        # entry per digest DEPTH, so a long shared prefix costs several
-        # entries — that is the point: a deeper match wins routing. The
-        # token digest is the byte->token bridge the fabric needs: the
-        # router has no tokenizer, so it can only name a fetchable chain
-        # by remembering what the serving replica reported.
+        # chunk-chain digest -> (holder replica ids MRU-first, deepest
+        # TOKEN digest reported for this chain, or None), LRU-bounded.
+        # One entry per digest DEPTH, so a long shared prefix costs
+        # several entries — that is the point: a deeper match wins
+        # routing. KV is content-addressed, so one digest legitimately
+        # lives on several replicas at once (pushes, pulls, repeated
+        # prompts); keeping every holder lets pick() spread a hot prefix
+        # by load instead of pinning it to the last server. The token
+        # digest is the byte->token bridge the fabric needs: the router
+        # has no tokenizer, so it can only name a fetchable chain by
+        # remembering what a serving replica reported.
         # guarded-by: _res_lock
         self._residency: "collections.OrderedDict[str, tuple]" = (
             collections.OrderedDict()
         )
-        # the global digest->replica residency view in TOKEN-digest
-        # space: learned from response envelopes (kv_digests) and from
-        # replica /health bootstraps (resident_digests), purged with
-        # ejections — stale entries must not steer fabric pulls at a
-        # corpse
+        # the global digest->holders residency view in TOKEN-digest
+        # space (tuple of replica ids, MRU-first): learned from response
+        # envelopes (kv_digests) and from replica /health bootstraps
+        # (resident_digests), purged with ejections — stale entries must
+        # not steer fabric pulls at a corpse
         # guarded-by: _res_lock
-        self._kv_residency: "collections.OrderedDict[str, str]" = (
+        self._kv_residency: "collections.OrderedDict[str, tuple]" = (
             collections.OrderedDict()
         )
         self._res_lock = threading.Lock()
@@ -469,9 +484,21 @@ class Router:
         with self._res_lock:
             for d in reversed(digests):
                 ent = self._residency.get(d)
-                rep = by_id.get(ent[0]) if ent is not None else None
-                if rep is not None:
+                if ent is None:
+                    continue
+                held = [
+                    (by_id[h], i) for i, h in enumerate(ent[0])
+                    if h in by_id
+                ]
+                if held:
+                    # a hot prefix resident on several decode replicas
+                    # spreads by load instead of pinning to one holder;
+                    # equal-load ties keep the MRU holder so a failover
+                    # still "moves" residency with the traffic
                     self._m_affinity.labels(result="hit").inc()
+                    rep = min(
+                        held, key=lambda t: (t[0].outstanding, t[1]),
+                    )[0]
                     return rep, digests
         self._m_affinity.labels(result="miss").inc()
         return min(cands, key=lambda r: (r.outstanding, r.rid)), digests
@@ -479,56 +506,78 @@ class Router:
     def record_residency(self, digests, rid: str,
                          token_digest: Optional[str] = None):
         """Remember that `rid` now holds the KV blocks for this chain
-        (called with the replica that ACTUALLY served, so failovers —
-        and fabric pulls — move the residency with the traffic).
-        token_digest is the deepest TOKEN-chain digest the replica
-        reported for this prompt (its fetchable name on /kv); a
-        same-replica overwrite without one keeps the previous bridge, a
-        replica CHANGE drops it (the new holder's digest arrives with
-        its own envelope)."""
+        (called with the replica that ACTUALLY served — and with every
+        replica a push or pull COPIED the chain to, so one digest keeps
+        all its holders, MRU-first, capped at MAX_RESIDENCY_HOLDERS).
+        token_digest is the deepest TOKEN-chain digest a replica
+        reported for this prompt (its fetchable name on /kv); an update
+        without one keeps the previous bridge only when `rid` was
+        already a known holder — a brand-new holder's bridge arrives
+        with its own envelope."""
         if not digests:
             return
         with self._res_lock:
             for d in digests:
                 prev = self._residency.get(d)
                 tok = token_digest
-                if tok is None and prev is not None and prev[0] == rid:
+                if prev is not None and tok is None and rid in prev[0]:
                     tok = prev[1]
-                self._residency[d] = (rid, tok)
+                holders = (rid,)
+                if prev is not None:
+                    holders += tuple(h for h in prev[0] if h != rid)
+                self._residency[d] = (
+                    holders[:MAX_RESIDENCY_HOLDERS], tok,
+                )
                 self._residency.move_to_end(d)
             while len(self._residency) > self.affinity_entries:
                 self._residency.popitem(last=False)
 
     def record_kv_residency(self, token_digests, rid: str,
                             bootstrap: bool = False):
-        """Update the token-digest residency view. bootstrap=True (the
-        /health resident_digests sweep) only fills gaps — a digest
-        learned from live traffic is fresher than a poll."""
+        """Update the token-digest residency view (holders tuple,
+        MRU-first, capped at MAX_RESIDENCY_HOLDERS). bootstrap=True (the
+        /health resident_digests sweep) appends behind existing holders
+        and never reorders — a digest learned from live traffic is
+        fresher than a poll."""
         if not token_digests:
             return
         with self._res_lock:
             for d in token_digests:
-                if bootstrap and d in self._kv_residency:
-                    continue
-                self._kv_residency[d] = rid
+                prev = self._kv_residency.get(d, ())
+                if bootstrap:
+                    if rid in prev:
+                        continue  # already known; a poll adds nothing
+                    holders = prev + (rid,)
+                else:
+                    holders = (rid,) + tuple(h for h in prev if h != rid)
+                self._kv_residency[d] = holders[:MAX_RESIDENCY_HOLDERS]
                 self._kv_residency.move_to_end(d)
             while len(self._kv_residency) > self.affinity_entries:
                 self._kv_residency.popitem(last=False)
 
     def purge_residency(self, rid: str):
-        """Drop every residency entry naming `rid` — byte-affinity AND
-        token-digest views. Called on ejection (and rolling-restart
-        kills): a dead replica's digests must neither pin affinity nor
-        steer fabric pulls at a corpse until overwritten."""
+        """Strip `rid` from every residency entry — byte-affinity AND
+        token-digest views — and drop entries it alone held. Called on
+        ejection (and rolling-restart kills): a dead replica's digests
+        must neither pin affinity nor steer fabric pulls at a corpse
+        until overwritten; surviving co-holders keep serving."""
         with self._res_lock:
-            for d in [
-                d for d, v in self._residency.items() if v[0] == rid
-            ]:
-                del self._residency[d]
-            for d in [
-                d for d, r in self._kv_residency.items() if r == rid
-            ]:
-                del self._kv_residency[d]
+            for d, (holders, tok) in list(self._residency.items()):
+                if rid not in holders:
+                    continue
+                rest = tuple(h for h in holders if h != rid)
+                if rest:
+                    self._residency[d] = (rest, tok)
+                else:
+                    del self._residency[d]
+            for d, holders in list(self._kv_residency.items()):
+                if rid not in holders:
+                    continue
+                rest = tuple(h for h in holders if h != rid)
+                if rest:
+                    self._kv_residency[d] = rest
+                else:
+                    del self._kv_residency[d]
 
     def residency_entries(self) -> int:
         with self._res_lock:
@@ -552,10 +601,18 @@ class Router:
                 ent = self._residency.get(d)
                 if ent is None or ent[1] is None:
                     continue
-                if ent[0] == rep.rid:
-                    return None  # the pick already lands on the holder
-                peer = self._by_id.get(ent[0])
-                if peer is not None and peer.state == READY:
+                if rep.rid in ent[0]:
+                    return None  # the pick already lands on a holder
+                peers = [
+                    p for p in (self._by_id.get(h) for h in ent[0])
+                    if p is not None and p.state == READY
+                ]
+                if peers:
+                    # least-loaded holder serves the pull: the wire cost
+                    # lands where it hurts decode batching the least
+                    peer = min(
+                        peers, key=lambda r: (r.outstanding, r.rid),
+                    )
                     return {
                         "X-KV-Transfer-Peer": peer.url,
                         "X-KV-Transfer-Digest": ent[1],
@@ -833,6 +890,22 @@ class Router:
             return None
         rep = min(pre, key=lambda r: (r.outstanding, r.rid))
         extra = {"X-KV-Prefill-Only": "1"}
+        # proactive push: pre-pick the decode replica most likely to run
+        # phase 2 (least outstanding now) and have the prefill replica
+        # POST the finished chain straight at it — by the time phase 2
+        # dispatches, the chain is already in the decode host tier and
+        # the pull hint is just a fallback. A wrong guess (load shifted
+        # between phases) costs nothing: phase 2 still carries the pull
+        # hint, and the pushed copy ages out of the host tier.
+        push_to: Optional[Replica] = None
+        if self.kv_push:
+            dec = [
+                r for r in self._candidates((), role="decode")
+                if r.replica_class != "prefill"
+            ]
+            if dec:
+                push_to = min(dec, key=lambda r: (r.outstanding, r.rid))
+                extra["X-KV-Push-To"] = push_to.url
         if deadline_ms is not None:
             extra["X-Request-Deadline-Ms"] = f"{deadline_ms:.0f}"
         sp = None
@@ -882,8 +955,27 @@ class Router:
         self.record_kv_residency(toks, rep.rid)
         if digests:
             self.record_residency(digests, rep.rid, token_digest=toks[-1])
+        pushed = 0
+        if push_to is not None:
+            try:
+                env = json.loads(rbody)
+                if isinstance(env, dict):
+                    pushed = int(env.get("kv_pushed") or 0)
+            except (ValueError, TypeError, json.JSONDecodeError):
+                pushed = 0
+        if pushed > 0:
+            # the decode replica holds the chain NOW: record it as a
+            # co-holder so pick() lands phase 2 on it (MRU-first — the
+            # push is fresher than the prefill replica's copy) and the
+            # wire pull never happens
+            self._m_handoffs.labels(outcome="pushed").inc()
+            self.record_kv_residency(toks, push_to.rid)
+            if digests:
+                self.record_residency(
+                    digests, push_to.rid, token_digest=toks[-1],
+                )
         log.info("handoff_prefilled", request_id=rid, replica=rep.rid,
-                 digest=toks[-1])
+                 digest=toks[-1], pushed_blocks=pushed)
         return {
             "X-KV-Transfer-Peer": rep.url,
             "X-KV-Transfer-Digest": toks[-1],
@@ -891,10 +983,13 @@ class Router:
 
     def note_handoff_outcome(self, payload):
         """Score a completed phase 2 off its envelope: did the decode
-        replica import the chain, or re-prefill locally (peer died
+        replica import the chain — pulled over the fabric
+        (kv_fabric_blocks) or promoted from a proactive push
+        (kv_promoted_blocks) — or re-prefill locally (peer died
         mid-fetch, digest evicted, pool full)?"""
-        imported = (
-            isinstance(payload, dict) and payload.get("kv_fabric_blocks")
+        imported = isinstance(payload, dict) and (
+            payload.get("kv_fabric_blocks")
+            or payload.get("kv_promoted_blocks")
         )
         self._m_handoffs.labels(
             outcome="handoff" if imported else "cold_fallback"
@@ -1727,6 +1822,12 @@ def main(argv: Optional[list] = None):
              "handoff; shorter prompts go straight to the decode tier",
     )
     ap.add_argument(
+        "--no-kv-push", action="store_true",
+        help="disable the proactive chain push at the prefill->decode "
+             "handoff (X-KV-Push-To); phase 2 then always PULLS the "
+             "chain from the prefill replica on demand",
+    )
+    ap.add_argument(
         "--spawn-args", default="", metavar="ARGS",
         help="argument string passed to every spawned replica's server "
              "CLI, e.g. \"--model tinyllama-1.1b --continuous 4 --warmup\"",
@@ -1803,6 +1904,7 @@ def main(argv: Optional[list] = None):
         failover_attempts=args.failover_attempts or None,
         fabric=not args.no_fabric,
         handoff_min_bytes=args.handoff_min_bytes,
+        kv_push=not args.no_kv_push,
         tenant_max_inflight_share=args.tenant_share,
     )
     # learn URL-joined replicas' classes + bootstrap digest residency

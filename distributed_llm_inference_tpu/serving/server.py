@@ -21,6 +21,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
+from . import kv_fabric as kvf
+
 __version__ = "tpu_pipeline_v1"
 
 # Reference defaults: orchestration.py:339-347 (max_tokens default 20, cap
@@ -291,7 +293,10 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     out["kv"] = {
                         "fabric": True,
                         "block_size": continuous.kv_block_size,
-                        "resident_digests": continuous.fabric_digests(64),
+                        # capped MRU-first (--kv-health-digests): the
+                        # disk tier makes the full resident set
+                        # unbounded, bootstrap payloads must stay O(1)
+                        "resident_digests": continuous.fabric_digests(),
                     }
                 self._send(200, out)
             elif path == "/ready":
@@ -396,6 +401,56 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 self._trace_ctx = ctx
                 digest = path[len("/kv/"):]
                 t0 = time.time()
+                want_stream = self.headers.get("X-KV-Stream") in (
+                    "1", "true"
+                )
+                tier = (
+                    continuous.fabric_digest_tier(digest)
+                    if continuous is not None else None
+                ) or "host"
+                if want_stream and continuous is not None:
+                    # streamed serve: length-prefixed one-block frames,
+                    # encoded lazily (O(1) time-to-first-byte), each
+                    # carrying its running parent-chained digest so the
+                    # peer verifies chunk-at-a-time and overlaps its
+                    # pool scatters with the wire
+                    res = continuous.fabric_chain_stream(digest)
+                    if ctx is not None:
+                        engine.trace_store.add_span(
+                            ctx.trace_id, "kv.serve", t0, time.time(),
+                            parent_id=ctx.span_id,
+                            attrs={
+                                "digest": digest[:16],
+                                "hit": res is not None,
+                                "streamed": True, "tier": tier,
+                            },
+                        )
+                    if res is None:
+                        self._send(404, {
+                            "error": f"no resident chain for digest "
+                                     f"{digest[:64]!r}",
+                        })
+                        return
+                    n_chunks, tier, frames = res
+                    # manual write path (like the NDJSON stream): no
+                    # Content-Length — frames land as they encode
+                    self._count(200)
+                    self.send_response(200)
+                    self.send_header("Content-Type", kvf.STREAM_CONTENT_TYPE)
+                    self.send_header(
+                        "X-KV-Block-Size", str(continuous.kv_block_size)
+                    )
+                    self.send_header("X-KV-Chain-Len", str(n_chunks))
+                    self.send_header("X-KV-Tier", tier)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    try:
+                        for frame in frames:
+                            self.wfile.write(frame)
+                        self.wfile.flush()
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        pass  # peer gave up mid-pull: its problem only
+                    return
                 chain = (
                     continuous.fabric_chain(digest)
                     if continuous is not None else None
@@ -407,6 +462,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         attrs={
                             "digest": digest[:16],
                             "hit": chain is not None,
+                            "streamed": False, "tier": tier,
                         },
                     )
                 if chain is None:
@@ -420,6 +476,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                         content_type="application/octet-stream",
                         headers={
                             "X-KV-Block-Size": str(continuous.kv_block_size),
+                            "X-KV-Tier": tier,
                         },
                     )
             else:
@@ -455,12 +512,16 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 return None
 
         def _kv_headers(self) -> tuple:
-            """(kv_hint, prefill_only) — the router's disaggregation
-            headers. X-KV-Transfer-Peer + X-KV-Transfer-Digest name where
-            this prompt's prefix chain is resident (the engine pulls it
-            over the fabric at admission); X-KV-Prefill-Only marks phase
-            1 of a prefill->decode handoff (prefill + shadow-flush, one
-            token, never streamed). Both are no-ops without
+            """(kv_hint, prefill_only, kv_push_to) — the router's
+            disaggregation headers. X-KV-Transfer-Peer +
+            X-KV-Transfer-Digest name where this prompt's prefix chain
+            is resident (the engine pulls it over the fabric at
+            admission); X-KV-Prefill-Only marks phase 1 of a
+            prefill->decode handoff (prefill + shadow-flush, one token,
+            never streamed); X-KV-Push-To names the decode replica the
+            router pre-picked, so phase 1 PUSHES the finished chain
+            (POST /kv) before answering — phase 2's admission finds it
+            resident with no pull round-trip. All no-ops without
             --continuous."""
             peer = self.headers.get("X-KV-Transfer-Peer")
             digest = self.headers.get("X-KV-Transfer-Digest")
@@ -472,7 +533,11 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                 continuous is not None
                 and self.headers.get("X-KV-Prefill-Only") in ("1", "true")
             )
-            return hint, prefill_only
+            push_to = (
+                self.headers.get("X-KV-Push-To")
+                if continuous is not None and prefill_only else None
+            )
+            return hint, prefill_only, push_to
 
         # -- OpenAI-compatible surface (serving/openai_api.py) -----------
 
@@ -649,7 +714,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     except (TypeError, ValueError):
                         pass
                 kwargs["request_id"] = self._rid
-                kv_hint, prefill_only = self._kv_headers()
+                kv_hint, prefill_only, kv_push_to = self._kv_headers()
                 if kv_hint is not None:
                     kwargs["kv_hint"] = kv_hint
                 if prefill_only:
@@ -658,6 +723,8 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     # clients see one transparent stream either way
                     kwargs["prefill_only"] = True
                     meta["stream"] = False
+                    if kv_push_to:
+                        kwargs["kv_push_to"] = kv_push_to
                 if meta.get("echo_score"):
                     # echo + logprobs + max_tokens=0: teacher-forced
                     # scoring of the prompt itself (lm-eval pattern)
@@ -726,7 +793,9 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             # residency / scores handoffs identically on every route
             kv_extra = {
                 k: envelope[k]
-                for k in ("kv_digests", "kv_fabric_blocks", "prefill_only")
+                for k in ("kv_digests", "kv_fabric_blocks",
+                          "kv_promoted_blocks", "prefill_only",
+                          "kv_pushed")
                 if isinstance(envelope, dict) and k in envelope
             }
             self._send(
@@ -795,6 +864,31 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
             if path == "/profiler/stop":
                 res = profiler.stop()
                 self._send(400 if "error" in res else 200, res)
+                return
+            if path == "/kv":
+                # the KV fabric's push half: a peer's proactive chain
+                # push at the prefill->decode handoff. The payload is
+                # validated against its OWN content key (the digest is
+                # recomputed from its tokens) and landed in the host
+                # shadow tier; a payload failing validation is a 400 the
+                # pusher treats as "the pull fallback will cover it".
+                if continuous is None or not continuous.fabric_serving:
+                    self._send(404, {"error": "kv fabric not serving"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    length = 0
+                if length <= 0:
+                    self._send(400, {"error": "empty /kv push"})
+                    return
+                body = self.rfile.read(length)
+                res = continuous.fabric_accept_push(body)
+                if res is None:
+                    self._send(400, {"error": "push payload failed "
+                                              "content-key validation"})
+                else:
+                    self._send(200, res)
                 return
             if path != "/generate":
                 self._send(404, {"error": f"no route {path}"})
@@ -936,7 +1030,7 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     ):
                         raise ValueError("stop must be a string or list of strings")
                     kwargs["stop"] = raw_stop
-                kv_hint, prefill_only = self._kv_headers()
+                kv_hint, prefill_only, kv_push_to = self._kv_headers()
                 if kv_hint is not None:
                     kwargs["kv_hint"] = kv_hint
                 if prefill_only:
@@ -946,6 +1040,8 @@ def make_handler(engine, max_tokens_cap: int, profiler: Optional[_Profiler] = No
                     # body's stream flag is ignored here (the STREAM
                     # happens on the decode replica, transparently)
                     kwargs["prefill_only"] = True
+                    if kv_push_to:
+                        kwargs["kv_push_to"] = kv_push_to
                 if not prefill_only and _parse_bool(
                     data.get("stream", False), "stream"
                 ):
@@ -1495,6 +1591,34 @@ def main(argv: Optional[list] = None):
              "re-prefilling every salvaged request from its full prompt)",
     )
     ap.add_argument(
+        "--kv-disk-dir", default=None, metavar="DIR",
+        help="disk tier (tier 2) of the KV cache hierarchy: LRU-evicted "
+             "host-shadow entries demote into parent-chained chunk files "
+             "here instead of dropping, and every shadow read surface "
+             "(prefix planning, warm recovery, preemption swap, the "
+             "fabric) promotes hits back out — the replica's logical "
+             "prefix cache becomes disk-bounded. Default: no disk tier",
+    )
+    ap.add_argument(
+        "--kv-disk-blocks", type=int, default=0, metavar="N",
+        help="disk-tier bound in blocks (chunk files, LRU). 0 = auto: "
+             "8x the host shadow tier",
+    )
+    ap.add_argument(
+        "--no-kv-stream", action="store_true",
+        help="pull fabric chains as one whole-manifest blob instead of "
+             "chunk-at-a-time streamed frames (the streamed pull "
+             "overlaps the wire with the importing replica's pool "
+             "scatters; this pins the pre-stream behavior)",
+    )
+    ap.add_argument(
+        "--kv-health-digests", type=int, default=64, metavar="N",
+        help="cap on the resident-chain digests /health advertises for "
+             "router residency bootstrap (MRU-first, host tier before "
+             "disk) — keeps bootstrap payloads O(1) however deep the "
+             "disk tier grows",
+    )
+    ap.add_argument(
         "--spec-decode", action="store_true",
         help="fleet-wide speculative decoding on the continuous ragged "
              "paged fleet: EVERY eligible greedy slot submits draft-then-"
@@ -1709,6 +1833,10 @@ def main(argv: Optional[list] = None):
             kv_shadow=not args.no_kv_shadow,
             kv_fabric=not args.no_kv_fabric,
             kv_fabric_timeout_s=args.kv_fabric_timeout,
+            kv_disk_dir=args.kv_disk_dir,
+            kv_disk_blocks=args.kv_disk_blocks,
+            kv_fabric_stream=not args.no_kv_stream,
+            kv_health_digests=args.kv_health_digests,
             replica_class=args.replica_class,
             spec_decode=args.spec_decode,
             spec_draft_len=args.spec_draft_len,

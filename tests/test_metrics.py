@@ -438,6 +438,11 @@ def test_bare_engine_exposes_full_catalog_schema():
     assert {
         "dli_ttft_seconds", "dli_queue_depth", "dli_slots_occupied",
         "dli_prefix_cache_hits_total", "dli_preemptions_total",
+        # tiered-KV families pre-register on every engine, so the
+        # scrape schema is stable whether or not a tier ever fills
+        "dli_kv_tier_entries", "dli_kv_tier_bytes",
+        "dli_kv_tier_promotions_total", "dli_kv_tier_demotions_total",
+        "dli_kv_tier_disk_hits_total",
     } <= fams
 
 
