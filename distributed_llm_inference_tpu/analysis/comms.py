@@ -216,6 +216,31 @@ WIRE_LINKS = _links(
                    p["n_kv_heads"], p["head_dim"]),
         lambda p: 1,
     ),
+    # The MPMD stage transport's inter-PROCESS activation hand-off
+    # (POST /stage/step, serving/stage_runtime.py) — like kv-fabric-dcn
+    # this is DCN/HTTP, invisible to HLO. One hop = one stage boundary
+    # crossed by one step's hidden states [rows, t, dim]; with
+    # pp_wire_quant="int8" the body ships int8 rows + fp32 scales, so
+    # the same wire_link_bytes quant formula applies to the cross-
+    # process wire. Runtime bytes land on
+    # dli_pp_wire_bytes_total{path="stage"}.
+    LinkSpec(
+        "stage-activation-dcn", "stage", "dcn",
+        "HTTP /stage/step (npz hidden, int8-quantizable)",
+        "(rows, t, dim) x 1 hop",
+        lambda p: (p["rows"], p["t"], p["dim"]),
+        lambda p: 1,
+    ),
+    # The last stage's reply when it closes the ring: sampled token ids
+    # [rows] int32 back to the controller (never quantized — ids, not
+    # activations; accounted at fp32 itemsize as 1 id per row).
+    LinkSpec(
+        "stage-result-dcn", "stage", "dcn",
+        "HTTP /stage/step reply (sampled ids)",
+        "(rows, 1, 1) x 1 hop",
+        lambda p: (p["rows"], 1, 1),
+        lambda p: 1,
+    ),
 )
 
 # ModelConfig attrs the link formulas and fat inventory may read.

@@ -23,7 +23,15 @@ order. So serving becomes a mirroring problem, not an RPC problem:
 Scope: the bare engine surface (generate / generate_batch / score).
 `--continuous` and `--queue` are admission layers whose batching depends
 on request ARRIVAL TIMING — inherently different per process — and are
-rejected at startup for multi-process serving.
+rejected at startup for MIRRORED multi-process serving, where every
+process must replay the identical launch sequence. That restriction is
+specific to this module's mirroring model: the MPMD stage runtime
+(serving/stage_runtime.py) is the multi-process deployment that lifts
+it, by making arrival timing a controller-only concern — stages receive
+an explicit, replayable (request_id, pos, window) stream over the stage
+transport, so admission layers batch freely in the one process that
+owns timing. Use stage_runtime for pipeline-sharded fleets; this module
+remains the SPMD-mirroring path for meshes that fit one program.
 """
 
 from __future__ import annotations

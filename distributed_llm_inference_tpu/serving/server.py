@@ -1799,7 +1799,11 @@ def main(argv: Optional[list] = None):
         raise SystemExit(
             "--continuous/--queue batch by request ARRIVAL TIMING, "
             "which cannot mirror deterministically across processes; "
-            "multi-process serving drives the bare engine"
+            "mirrored multi-process serving drives the bare engine. "
+            "For admission layers on a multi-process fleet, use the "
+            "MPMD stage runtime (serving/stage_runtime.py --frontend): "
+            "its controller owns arrival timing and drives stages over "
+            "the stage transport"
         )
     mesh_cfg = MeshConfig(
         dp=args.dp, pp=args.pp, sp=args.sp, tp=args.tp, ep=args.ep

@@ -30,6 +30,16 @@ containment path runs in CI, deterministically:
                    _preempt_for): after the victim is selected, before
                    any of its state is touched (tag = the victim's
                    prompt) — the crash-during-preempt chaos drill
+    stage_send     the MPMD stage transport (serving/stage_runtime.py):
+                   before a cross-process activation/token hand-off is
+                   shipped to the next stage (tag =
+                   "{request_id}:{phase}:stage{i}") — drop/delay/wedge
+                   the inter-stage wire deterministically
+    stage_recv     the receiving side of the same hand-off: inside the
+                   stage server's /stage/step handler before compute,
+                   and inside the heartbeat handler (tag
+                   "heartbeat:stage{i}" — a wedge rule here is the
+                   heartbeat-timeout → unready drill)
 
 Design rules:
   * Zero overhead disarmed: check() is one module-global None test.
@@ -67,7 +77,7 @@ from typing import Optional
 
 POINTS = (
     "admission", "prefill", "decode_launch", "fetch", "alloc",
-    "shadow_copy", "solo", "preempt",
+    "shadow_copy", "solo", "preempt", "stage_send", "stage_recv",
 )
 
 

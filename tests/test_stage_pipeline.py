@@ -1,0 +1,505 @@
+"""Chaos matrix for the multi-process MPMD stage pipeline
+(serving/stage_runtime.py).
+
+Every test here runs the REAL deployment shape on CPU: each stage is a
+separate OS process owning a contiguous layer slice, driven over the
+HTTP stage transport. The matrix kills each stage role (first / middle
+/ last) with SIGKILL at the prefill and decode launch boundaries, under
+warm (shadow present) and cold (shadow wiped) restore, and requires the
+greedy output to be BIT-IDENTICAL to a fault-free single-process run in
+every cell — plus pool `free == total` on every stage after recovery,
+heartbeat-timeout -> unready -> readmission, and a rolling stage
+restart under live concurrent load with zero failures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_llm_inference_tpu.analysis.callgraph import (
+    build_index, decode_unreachable, traced_reachable,
+)
+from distributed_llm_inference_tpu.models import api as M
+from distributed_llm_inference_tpu.models.registry import get_model_config
+from distributed_llm_inference_tpu.parallel.schedule import (
+    mpmd_1f1b_order, plan_stages,
+)
+from distributed_llm_inference_tpu.serving.stage_runtime import (
+    HttpStageTransport, MPMDPipeline, StageSupervisor, free_port,
+)
+from distributed_llm_inference_tpu.utils import faults
+from distributed_llm_inference_tpu.utils.tokenizer import ByteTokenizer
+
+MODEL = "test-llama-tiny"
+BLOCK = 8
+PROMPT = "stage chaos!"  # 13 tokens with bos: boundary-misaligned on purpose
+N_NEW = 16
+KILL_AFTER = 6  # decode steps before the mid-decode SIGKILL
+
+PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "distributed_llm_inference_tpu",
+)
+
+
+def _stage_env(extra=None):
+    env = dict(os.environ)
+    # stage processes need no virtual mesh — one device boots faster
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DLI_FAULTS", None)
+    env.update(extra or {})
+    return env
+
+
+def wait_until(pred, timeout_s: float, interval_s: float = 0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """Fault-free single-process greedy transcripts, by (prompt, n)."""
+    cfg = get_model_config(MODEL)
+    tok = ByteTokenizer()
+    params = M.init_params(cfg, jax.random.PRNGKey(0))
+    memo = {}
+
+    def run(prompt: str, max_new: int):
+        key = (prompt, max_new)
+        if key in memo:
+            return memo[key]
+        ids = tok.encode(prompt)
+        cache = M.init_kv_cache(cfg, 1, cfg.max_seq_len, cfg.n_layers)
+        logits, cache = M.forward(
+            cfg, params, jnp.asarray([ids], jnp.int32), cache, 0
+        )
+        t = int(jnp.argmax(logits[0, -1]))
+        out, pos = [t], len(ids)
+        for _ in range(max_new - 1):
+            if t == tok.eos_token_id:
+                break
+            logits, cache = M.forward(
+                cfg, params, jnp.asarray([[t]], jnp.int32), cache, pos
+            )
+            t = int(jnp.argmax(logits[0, -1]))
+            out.append(t)
+            pos += 1
+        if out and out[-1] == tok.eos_token_id:
+            out = out[:-1]
+        memo[key] = out
+        return out
+
+    return run
+
+
+class Fleet:
+    def __init__(self, n_stages: int, restore_dir: str, *,
+                 wire_quant=None, env_extra=None, **pipe_kw):
+        self.restore_dir = restore_dir
+        ports = [free_port() for _ in range(n_stages)]
+        self.sup = StageSupervisor(
+            MODEL, n_stages, ports, seed=0, block_size=BLOCK,
+            restore_dir=restore_dir, wire_quant=wire_quant,
+            restart_budget=100, env=_stage_env(env_extra),
+        )
+        self.pipe = MPMDPipeline(
+            self.sup,
+            transport=HttpStageTransport(wire_quant=wire_quant),
+            **pipe_kw,
+        )
+
+    def start(self):
+        self.pipe.start_fleet(ready_timeout_s=120)
+        return self
+
+    def stage_slots(self, s: int) -> dict:
+        return self.pipe.transport.get_json(
+            self.sup.addr(s), "/health"
+        )["kv_slots"]
+
+    def shutdown(self):
+        self.pipe.shutdown()
+
+
+@pytest.fixture(scope="module")
+def fleet3(tmp_path_factory):
+    f = Fleet(3, str(tmp_path_factory.mktemp("restore3"))).start()
+    yield f
+    f.shutdown()
+
+
+# -- the kill -9 chaos matrix -------------------------------------------------
+#
+# The decode x warm diagonal (the acceptance headline: kill -9 any stage
+# mid-decode, warm restore recomputes < block_size) runs in the fast
+# tier; the other nine cells carry the `slow` marker like every other
+# subprocess-heavy leg (pytest.ini) and run in CI's dedicated
+# test_stage_pipeline.py step.
+
+def _cells():
+    out = []
+    for victim in (0, 1, 2):
+        for boundary in ("prefill", "decode"):
+            for restore in ("warm", "cold"):
+                fast = boundary == "decode" and restore == "warm"
+                out.append(pytest.param(
+                    victim, boundary, restore,
+                    marks=() if fast else (pytest.mark.slow,),
+                    id=f"victim{victim}-{boundary}-{restore}",
+                ))
+    return out
+
+
+@pytest.mark.parametrize("victim,boundary,restore", _cells())
+def test_chaos_matrix_bit_identical(fleet3, reference, victim, boundary,
+                                    restore):
+    """SIGKILL stage `victim` at `boundary` under `restore`; greedy
+    output must be bit-identical to the fault-free run, the pool must
+    drain back to free == total, and a warm restore must recompute
+    fewer than block_size tokens."""
+    pipe, sup = fleet3.pipe, fleet3.sup
+    ref = reference(PROMPT, N_NEW)
+    assert len(ref) == N_NEW  # the drill needs a full-length transcript
+
+    rid = pipe.start(PROMPT)
+    got = 1  # start() accepted the first token
+    if boundary == "decode":
+        for _ in range(KILL_AFTER):
+            assert pipe.step_once(rid) is not None
+            got += 1
+    sup.proc(victim).kill()  # SIGKILL: no drain, no flush, no goodbye
+    sup.proc(victim).wait(timeout=10)
+    if restore == "cold":
+        shutil.rmtree(
+            os.path.join(fleet3.restore_dir, f"stage{victim}"),
+            ignore_errors=True,
+        )
+    while got < N_NEW:
+        tok = pipe.step_once(rid)
+        if tok is None:
+            break
+        got += 1
+    out = pipe.finish(rid)
+    assert out["tokens"] == ref, (victim, boundary, restore)
+
+    salvage = pipe.last_salvage()
+    assert salvage["stage"] == victim
+    recomputed = salvage["tokens_recomputed"][rid]
+    fed_at_kill = len(ByteTokenizer().encode(PROMPT)) + (
+        KILL_AFTER if boundary == "decode" else 0
+    )
+    if restore == "warm":
+        assert 0 < recomputed < BLOCK, recomputed
+    else:
+        assert recomputed == fed_at_kill, recomputed
+
+    for s in range(3):
+        slots = fleet3.stage_slots(s)
+        assert slots["free"] == slots["total"], (s, slots)
+
+
+def test_transport_fault_points_retry_transparently(fleet3, reference):
+    """Armed stage_send drops are absorbed by the controller's retry
+    loop: output stays bit-identical and the rules actually fired."""
+    plan = faults.arm("stage_send:transient:on=2,every=3,times=3")
+    try:
+        out = fleet3.pipe.generate(PROMPT, N_NEW)
+        assert out["tokens"] == reference(PROMPT, N_NEW)
+        assert plan.fired("stage_send") == 3
+    finally:
+        faults.disarm()
+
+
+def test_trace_propagation_reaches_every_stage(fleet3):
+    """traceparent flows controller -> every stage: the same trace id
+    shows up in each stage's span store with stage.step spans."""
+    fleet3.pipe.generate(PROMPT, 4)
+    ids_per_stage = []
+    for s in range(3):
+        traces = fleet3.pipe.transport.get_json(
+            fleet3.sup.addr(s), "/debug/traces"
+        )
+        spans = [sp for tid in traces for sp in traces[tid]]
+        assert any(sp["name"] == "stage.step" for sp in spans)
+        ids_per_stage.append(set(traces))
+    shared = set.intersection(*ids_per_stage)
+    assert shared, ids_per_stage
+
+
+# -- heartbeat: wedge -> unready -> readmission ------------------------------
+
+@pytest.mark.slow
+def test_heartbeat_timeout_unready_then_readmitted(tmp_path):
+    """A wedged stage (heartbeat handler stalls past the timeout, armed
+    via DLI_FAULTS in the STAGE process) flips the pipeline unready;
+    when the wedge clears, heartbeats resume and it is readmitted."""
+    fleet = Fleet(
+        2, str(tmp_path / "restore"),
+        env_extra={
+            "DLI_FAULTS":
+                "stage_recv:transient:match=heartbeat:stage1,"
+                "on=1,every=1,times=4,wedge=1.5",
+        },
+        hb_interval_s=0.15, hb_timeout_s=0.5,
+    ).start()
+    seen = {}
+
+    def unready(pipe=fleet.pipe):
+        if pipe.ready():
+            return False
+        seen["liveness"] = pipe.liveness()
+        return True
+
+    try:
+        assert wait_until(unready, timeout_s=15)
+        assert seen["liveness"].get(1) in ("wedged", "dead")
+        kinds = [e["kind"] for e in fleet.pipe.flight.events()]
+        assert "heartbeat_lost" in kinds
+        # the rule exhausts after 4 firings: heartbeats succeed again
+        assert wait_until(fleet.pipe.ready, timeout_s=30)
+    finally:
+        fleet.shutdown()
+
+
+# -- rolling restart under live load -----------------------------------------
+
+@pytest.mark.slow
+def test_rolling_restart_zero_drops_under_live_load(tmp_path, reference):
+    """Cycle every stage through drain -> respawn -> /ready while two
+    driver threads generate continuously: zero failed requests, every
+    transcript bit-identical to its fault-free reference."""
+    fleet = Fleet(2, str(tmp_path / "restore")).start()
+    prompts = ["rolling load A", "rolling load B"]
+    results = {p: [] for p in prompts}
+    errors = []
+    stop = threading.Event()
+
+    def driver(prompt):
+        while not stop.is_set():
+            try:
+                out = fleet.pipe.generate(prompt, 8)
+                results[prompt].append(out["tokens"])
+            except Exception as e:  # any drop is a failure
+                errors.append((prompt, repr(e)))
+                return
+
+    threads = [
+        threading.Thread(target=driver, args=(p,), daemon=True)
+        for p in prompts
+    ]
+    try:
+        for t in threads:
+            t.start()
+        assert wait_until(
+            lambda: all(results[p] for p in prompts), timeout_s=60
+        )
+        report = fleet.pipe.rolling_restart()
+        assert [r["stage"] for r in report["stages"]] == [0, 1]
+        assert wait_until(
+            lambda: all(len(results[p]) >= 3 for p in prompts),
+            timeout_s=60,
+        )
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        fleet.shutdown()
+    assert not errors, errors
+    for p in prompts:
+        ref = reference(p, 8)
+        assert results[p], p
+        for transcript in results[p]:
+            assert transcript == ref, p
+    kinds = [e["kind"] for e in fleet.pipe.flight.events()]
+    assert kinds.count("rolling_stage_done") == 2
+
+
+# -- int8 cross-process wire --------------------------------------------------
+
+@pytest.mark.slow
+def test_int8_wire_quant_applies_to_cross_process_hops(tmp_path):
+    """pp_wire_quant="int8" on the stage transport: bodies ship int8 +
+    scales, the pipeline still generates, and the bytes land on
+    dli_pp_wire_bytes_total{path="stage"} at the quantized size."""
+    fleet = Fleet(2, str(tmp_path / "restore"), wire_quant="int8").start()
+    try:
+        out = fleet.pipe.generate(PROMPT, 6)
+        assert len(out["tokens"]) == 6
+        fam = fleet.pipe.transport.registry.get("dli_pp_wire_bytes_total")
+        quant_bytes = fam.labels(path="stage").value
+        assert quant_bytes > 0
+    finally:
+        fleet.shutdown()
+
+    # the same traffic unquantized is strictly fatter on the wire
+    fleet = Fleet(2, str(tmp_path / "restore_fp")).start()
+    try:
+        fleet.pipe.generate(PROMPT, 6)
+        fam = fleet.pipe.transport.registry.get("dli_pp_wire_bytes_total")
+        raw_bytes = fam.labels(path="stage").value
+        assert raw_bytes > quant_bytes
+    finally:
+        fleet.shutdown()
+
+
+# -- frontend over HTTP -------------------------------------------------------
+
+@pytest.mark.slow
+def test_frontend_http_surface(tmp_path, reference):
+    """The --frontend CLI: spawns its stage fleet, serves /generate,
+    /ready, /health, /debug/flight and /admin/rolling-restart, and
+    reaps the stages on SIGTERM."""
+    import subprocess
+    import sys
+
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m",
+         "distributed_llm_inference_tpu.serving.stage_runtime",
+         "--frontend", "--stages", "2", "--model", MODEL,
+         "--port", str(port), "--block-size", str(BLOCK),
+         "--restore-dir", str(tmp_path / "restore")],
+        env=_stage_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    base = f"http://127.0.0.1:{port}"
+
+    def ready():
+        try:
+            with urllib.request.urlopen(f"{base}/ready", timeout=2) as r:
+                return r.status == 200
+        except Exception:
+            return False
+
+    try:
+        assert wait_until(ready, timeout_s=120, interval_s=0.25)
+        body = json.dumps(
+            {"prompt": PROMPT, "max_new_tokens": 8}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+        assert out["tokens"] == reference(PROMPT, 8)
+        with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["ready"] and health["n_stages"] == 2
+        rr = urllib.request.Request(
+            f"{base}/admin/rolling-restart", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(rr, timeout=120) as r:
+            report = json.loads(r.read())
+        assert [x["stage"] for x in report["stages"]] == [0, 1]
+        with urllib.request.urlopen(f"{base}/debug/flight", timeout=10) as r:
+            flight = json.loads(r.read())
+        kinds = [e["kind"] for e in flight["events"]]
+        assert "rolling_restart_done" in kinds
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+# -- pure glue: stage planning + 1F1B order ----------------------------------
+
+def test_plan_stages_contiguous_cover():
+    assert plan_stages(4, 2) == [(0, 2), (2, 4)]
+    assert plan_stages(5, 2) == [(0, 3), (3, 5)]
+    assert plan_stages(7, 3) == [(0, 3), (3, 5), (5, 7)]
+    ranges = plan_stages(32, 8)
+    assert ranges[0][0] == 0 and ranges[-1][1] == 32
+    assert all(a[1] == b[0] for a, b in zip(ranges, ranges[1:]))
+    with pytest.raises(ValueError):
+        plan_stages(2, 3)
+
+
+def test_mpmd_1f1b_order_properties():
+    S, Mb = 3, 5
+    events = mpmd_1f1b_order(S, Mb)
+    assert len(events) == S * Mb
+    # per-stage order is FIFO in microbatch id (queue drain == schedule)
+    for s in range(S):
+        mbs = [m for _, ss, m in events if ss == s]
+        assert mbs == sorted(mbs)
+    # stage s+1 sees microbatch m strictly after stage s
+    tick = {(s, m): t for t, s, m in events}
+    for m in range(Mb):
+        for s in range(S - 1):
+            assert tick[(s + 1, m)] > tick[(s, m)]
+    # fill-drain trapezoid makespan
+    assert max(t for t, _, _ in events) == Mb + S - 2
+    with pytest.raises(ValueError):
+        mpmd_1f1b_order(0, 1)
+
+
+# -- fault-point grammar ------------------------------------------------------
+
+def test_stage_fault_points_in_grammar():
+    assert "stage_send" in faults.POINTS
+    assert "stage_recv" in faults.POINTS
+    rules = faults.parse_spec(
+        "stage_send:transient:on=3,every=2;"
+        "stage_recv:fatal:match=heartbeat:stage1,wedge=0.5"
+    )
+    assert rules[0].point == "stage_send" and rules[0].on_call == 3
+    assert rules[1].point == "stage_recv" and rules[1].wedge_s == 0.5
+    with pytest.raises(ValueError):
+        faults.FaultRule(point="stage_bogus")
+
+
+# -- derived callgraph + comms contract --------------------------------------
+
+@pytest.fixture(scope="module")
+def pkg_index():
+    return build_index(PKG_ROOT)
+
+
+def test_stage_runtime_pinned_decode_unreachable(pkg_index):
+    """Every host loop in serving.stage_runtime is decode-UNREACHABLE
+    by the DERIVED callgraph (no manual pin list): the stage/frontend
+    servers, the transport, the supervisor and the controller can never
+    leak into a traced program."""
+    derived = decode_unreachable(pkg_index, traced_reachable(pkg_index))
+    funcs = [
+        f.key
+        for f in pkg_index.modules["serving.stage_runtime"].functions.values()
+    ]
+    assert funcs, "serving.stage_runtime not indexed"
+    missing = [k for k in funcs if k not in derived]
+    assert not missing, missing
+
+
+def test_stage_wire_links_registered_and_accounted(pkg_index):
+    from distributed_llm_inference_tpu.analysis import comms
+
+    for name in ("stage-activation-dcn", "stage-result-dcn"):
+        spec = comms.WIRE_LINKS[name]
+        assert spec.axis == "dcn" and spec.path == "stage"
+    report = comms.build_report(pkg_index)
+    assert not report["problems"], report["problems"]
+    by_name = {l["name"]: l for l in report["links"]}
+    for name in ("stage-activation-dcn", "stage-result-dcn"):
+        assert by_name[name]["accounted_at"], name
+    # the int8 wire formula applies to the cross-process hop too
+    act = by_name["stage-activation-dcn"]
+    assert act["reference_bytes_quant"] < act["reference_bytes_raw"]
